@@ -1,0 +1,23 @@
+"""jobset_tpu — a TPU-native framework with the capabilities of JobSet.
+
+Two cooperating planes:
+
+* **Control plane** (`jobset_tpu.api`, `jobset_tpu.core`, `jobset_tpu.placement`):
+  a declarative multi-job workload API with gang lifecycle semantics —
+  replicated job groups, stable per-rank network identity, all-or-nothing
+  restart, success/failure/startup policies, suspend/resume, TTL cleanup and
+  topology-exclusive placement.  Behavior contract mirrors the reference
+  JobSet controller (see SURVEY.md for the file:line map) but the
+  architecture is an event-driven reconcile core over an in-memory cluster
+  state store, with placement pluggable between a greedy per-pod path and a
+  batched linear-assignment solver that runs under `jax.jit` on TPU.
+
+* **TPU plane** (`jobset_tpu.parallel`, `jobset_tpu.models`, `jobset_tpu.ops`,
+  `jobset_tpu.runtime`): the in-pod workload framework — device-mesh
+  bootstrap from JobSet rank identity, pjit/shard_map parallelism
+  (DP/FSDP/TP/PP/EP and ring-attention sequence parallelism), a flagship
+  transformer model, and orbax-style checkpoint/resume that composes with the
+  control plane's gang-restart semantics.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,50 @@
+"""Pure defaulting of JobSet specs.
+
+Mirrors the reference admission defaulting (`pkg/webhooks/jobset_webhook.go:105-150`):
+success policy All, startup policy AnyOrder, Indexed completion mode, pod
+restartPolicy OnFailure, DNS hostnames + publishNotReadyAddresses on, and
+failure-policy rule names `failurePolicyRuleN`.  Mutates the JobSet in place
+and also returns it (callers that need copy-on-default should `clone()` first).
+"""
+
+from __future__ import annotations
+
+from . import keys
+from .types import JobSet, Network, StartupPolicy, SuccessPolicy
+
+DEFAULT_RULE_NAME_FMT = "failurePolicyRule{index}"
+
+
+def apply_defaults(js: JobSet) -> JobSet:
+    spec = js.spec
+
+    if spec.success_policy is None:
+        spec.success_policy = SuccessPolicy(operator=keys.OPERATOR_ALL)
+
+    if spec.startup_policy is None:
+        spec.startup_policy = StartupPolicy(startup_policy_order=keys.STARTUP_ANY_ORDER)
+
+    for rjob in spec.replicated_jobs:
+        job_spec = rjob.template.spec
+        if job_spec.completion_mode is None:
+            job_spec.completion_mode = keys.COMPLETION_MODE_INDEXED
+        if job_spec.template.spec.restart_policy == "":
+            job_spec.template.spec.restart_policy = keys.RESTART_POLICY_ON_FAILURE
+        # k8s defaults parallelism to 1; keep the same observable behavior so
+        # ready-count math (min(parallelism, completions)) is well-defined.
+        if job_spec.parallelism is None:
+            job_spec.parallelism = 1
+
+    if spec.network is None:
+        spec.network = Network()
+    if spec.network.enable_dns_hostnames is None:
+        spec.network.enable_dns_hostnames = True
+    if spec.network.publish_not_ready_addresses is None:
+        spec.network.publish_not_ready_addresses = True
+
+    if spec.failure_policy is not None:
+        for i, rule in enumerate(spec.failure_policy.rules):
+            if not rule.name:
+                rule.name = DEFAULT_RULE_NAME_FMT.format(index=i)
+
+    return js

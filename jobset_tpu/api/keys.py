@@ -1,0 +1,151 @@
+"""Well-known label/annotation keys, condition types and event reasons.
+
+Mirrors the reference API's key space (reference:
+`api/jobset/v1alpha2/jobset_types.go:22-74` and
+`pkg/constants/constants.go:19-93`) so that workloads written against
+JobSet's labels/annotations find the same contract here.
+"""
+
+# ---------------------------------------------------------------------------
+# Label / annotation keys (jobset_types.go:22-58)
+# ---------------------------------------------------------------------------
+
+JOBSET_NAME_KEY = "jobset.sigs.k8s.io/jobset-name"
+REPLICATED_JOB_REPLICAS_KEY = "jobset.sigs.k8s.io/replicatedjob-replicas"
+REPLICATED_JOB_NAME_KEY = "jobset.sigs.k8s.io/replicatedjob-name"
+# Index of the Job replica within its parent ReplicatedJob (0..replicas-1).
+JOB_INDEX_KEY = "jobset.sigs.k8s.io/job-index"
+# Index of the Job within the entire JobSet (0..total_jobs-1).
+JOB_GLOBAL_INDEX_KEY = "jobset.sigs.k8s.io/job-global-index"
+# SHA256 hash of the namespaced job name; unique id for the job.
+JOB_KEY = "jobset.sigs.k8s.io/job-key"
+# Restart attempt this job belongs to (constants.go:29).
+RESTARTS_KEY = "jobset.sigs.k8s.io/restart-attempt"
+# Exclusive-placement topology annotation; value is the node topology label
+# key defining the domain (e.g. a rack or TPU-slice label).
+EXCLUSIVE_KEY = "alpha.jobset.sigs.k8s.io/exclusive-topology"
+# Flag annotation: use the node-selector strategy for exclusive placement
+# (nodes pre-labelled out of band) instead of affinity injection.
+NODE_SELECTOR_STRATEGY_KEY = "alpha.jobset.sigs.k8s.io/node-selector"
+NAMESPACED_JOB_KEY = "alpha.jobset.sigs.k8s.io/namespaced-job"
+NO_SCHEDULE_TAINT_KEY = "alpha.jobset.sigs.k8s.io/no-schedule"
+# Stable endpoint of the coordinator pod, stamped on jobs + pods.
+COORDINATOR_KEY = "jobset.sigs.k8s.io/coordinator"
+
+# Reserved managedBy value for the built-in controller.
+JOBSET_CONTROLLER_NAME = "jobset.sigs.k8s.io/jobset-controller"
+
+# Pod completion-index annotation (the simulated Job controller stamps this
+# the way the k8s Job controller stamps batch.kubernetes.io/job-completion-index).
+POD_COMPLETION_INDEX_KEY = "batch.kubernetes.io/job-completion-index"
+
+# ---------------------------------------------------------------------------
+# JobSet condition types (jobset_types.go:60-74)
+# ---------------------------------------------------------------------------
+
+JOBSET_COMPLETED = "Completed"
+JOBSET_FAILED = "Failed"
+JOBSET_SUSPENDED = "Suspended"
+JOBSET_STARTUP_POLICY_IN_PROGRESS = "StartupPolicyInProgress"
+JOBSET_STARTUP_POLICY_COMPLETED = "StartupPolicyCompleted"
+
+# ---------------------------------------------------------------------------
+# Enumerations
+# ---------------------------------------------------------------------------
+
+OPERATOR_ALL = "All"
+OPERATOR_ANY = "Any"
+
+FAIL_JOBSET = "FailJobSet"
+RESTART_JOBSET = "RestartJobSet"
+RESTART_JOBSET_AND_IGNORE_MAX_RESTARTS = "RestartJobSetAndIgnoreMaxRestarts"
+FAILURE_POLICY_ACTIONS = (
+    FAIL_JOBSET,
+    RESTART_JOBSET,
+    RESTART_JOBSET_AND_IGNORE_MAX_RESTARTS,
+)
+
+STARTUP_ANY_ORDER = "AnyOrder"
+STARTUP_IN_ORDER = "InOrder"
+
+COMPLETION_MODE_INDEXED = "Indexed"
+COMPLETION_MODE_NON_INDEXED = "NonIndexed"
+
+RESTART_POLICY_ON_FAILURE = "OnFailure"
+RESTART_POLICY_NEVER = "Never"
+RESTART_POLICY_ALWAYS = "Always"
+
+# Job terminal condition types (batchv1 analog).
+JOB_COMPLETE = "Complete"
+JOB_FAILED = "Failed"
+
+# Supported job failure reasons for failure-policy rules
+# (jobset_webhook.go:68-74; mirrors batchv1 job failure reasons).
+JOB_REASON_BACKOFF_LIMIT_EXCEEDED = "BackoffLimitExceeded"
+JOB_REASON_DEADLINE_EXCEEDED = "DeadlineExceeded"
+JOB_REASON_FAILED_INDEXES = "FailedIndexes"
+JOB_REASON_MAX_FAILED_INDEXES_EXCEEDED = "MaxFailedIndexesExceeded"
+JOB_REASON_POD_FAILURE_POLICY = "PodFailurePolicy"
+VALID_ON_JOB_FAILURE_REASONS = (
+    JOB_REASON_BACKOFF_LIMIT_EXCEEDED,
+    JOB_REASON_DEADLINE_EXCEEDED,
+    JOB_REASON_FAILED_INDEXES,
+    JOB_REASON_MAX_FAILED_INDEXES_EXCEEDED,
+    JOB_REASON_POD_FAILURE_POLICY,
+)
+
+# ---------------------------------------------------------------------------
+# Operating parameters + event reasons (constants.go:19-93)
+# ---------------------------------------------------------------------------
+
+MAX_PARALLELISM = 50
+
+REACHED_MAX_RESTARTS_REASON = "ReachedMaxRestarts"
+REACHED_MAX_RESTARTS_MESSAGE = "jobset failed due to reaching max number of restarts"
+
+FAILED_JOBS_REASON = "FailedJobs"
+FAILED_JOBS_MESSAGE = "jobset failed due to one or more job failures"
+
+ALL_JOBS_COMPLETED_REASON = "AllJobsCompleted"
+ALL_JOBS_COMPLETED_MESSAGE = "jobset completed successfully"
+
+JOB_CREATION_FAILED_REASON = "JobCreationFailed"
+HEADLESS_SERVICE_CREATION_FAILED_REASON = "HeadlessServiceCreationFailed"
+
+EXCLUSIVE_PLACEMENT_VIOLATION_REASON = "ExclusivePlacementViolation"
+EXCLUSIVE_PLACEMENT_VIOLATION_MESSAGE = (
+    "Pod violated JobSet exclusive placement policy"
+)
+
+IN_ORDER_STARTUP_POLICY_IN_PROGRESS_REASON = "InOrderStartupPolicyInProgress"
+IN_ORDER_STARTUP_POLICY_IN_PROGRESS_MESSAGE = "in order startup policy is in progress"
+IN_ORDER_STARTUP_POLICY_COMPLETED_REASON = "InOrderStartupPolicyCompleted"
+IN_ORDER_STARTUP_POLICY_COMPLETED_MESSAGE = "in order startup policy has completed"
+
+JOBSET_RESTART_REASON = "Restarting"
+
+JOBSET_SUSPENDED_REASON = "SuspendedJobs"
+JOBSET_SUSPENDED_MESSAGE = "jobset is suspended"
+JOBSET_RESUMED_REASON = "ResumeJobs"
+JOBSET_RESUMED_MESSAGE = "jobset is resumed"
+
+FAIL_JOBSET_ACTION_REASON = "FailJobSetFailurePolicyAction"
+FAIL_JOBSET_ACTION_MESSAGE = "applying FailJobSet failure policy action"
+
+RESTART_JOBSET_ACTION_REASON = "RestartJobSetFailurePolicyAction"
+RESTART_JOBSET_ACTION_MESSAGE = "applying RestartJobSet failure policy action"
+
+RESTART_JOBSET_AND_IGNORE_MAX_RESTARTS_ACTION_REASON = (
+    "RestartJobSetAndIgnoreMaxRestartsFailurePolicyAction"
+)
+RESTART_JOBSET_AND_IGNORE_MAX_RESTARTS_ACTION_MESSAGE = (
+    "applying RestartJobSetAndIgnoreMaxRestarts failure policy action"
+)
+
+# Event types (corev1 analog).
+EVENT_NORMAL = "Normal"
+EVENT_WARNING = "Warning"
+
+# Pod condition used to mark controller-initiated deletions so that pod
+# failure policies can ignore them (pod_controller.go:208-215).
+POD_CONDITION_DISRUPTION_TARGET = "DisruptionTarget"

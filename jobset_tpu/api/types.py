@@ -1,0 +1,328 @@
+"""JobSet API schema as plain Python dataclasses.
+
+The semantic contract mirrors the reference CRD
+(`api/jobset/v1alpha2/jobset_types.go:76-357`): a `JobSet` groups
+`ReplicatedJob`s, each of which stamps out `replicas` Jobs from a template;
+network identity, coordinator, and the success/failure/startup policies hang
+off the spec.  The representation here is deliberately *not* a Kubernetes
+object model — specs are lightweight immutable-ish dataclasses consumed by
+pure defaulting/validation functions and by the reconcile core; deep-copy
+semantics come from `clone()` which round-trips through `dataclasses.replace`
+on nested fields.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+def _clone(obj):
+    return copy.deepcopy(obj)
+
+
+# ---------------------------------------------------------------------------
+# Pod / Job templates (minimal batchv1/corev1 analog surface)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Toleration:
+    """Analog of corev1.Toleration (only the fields the framework touches)."""
+
+    key: str = ""
+    operator: str = "Equal"  # "Equal" | "Exists"
+    value: str = ""
+    effect: str = ""  # "" | "NoSchedule"
+
+    def matches_taint(self, taint: "Taint") -> bool:
+        if self.effect and self.effect != taint.effect:
+            return False
+        if self.operator == "Exists":
+            return self.key == "" or self.key == taint.key
+        return self.key == taint.key and self.value == taint.value
+
+
+@dataclass
+class Taint:
+    """Analog of corev1.Taint."""
+
+    key: str = ""
+    value: str = ""
+    effect: str = "NoSchedule"
+
+
+@dataclass
+class AffinityTerm:
+    """One required pod (anti-)affinity term over the job-key label.
+
+    A reduced corev1.PodAffinityTerm: the reference only ever injects terms
+    whose label selector is over `jobset.sigs.k8s.io/job-key`
+    (`pod_mutating_webhook.go:95-135`), so the schema models exactly that —
+    match a topology domain where a pod with (or without) the given job-key
+    runs.
+    """
+
+    topology_key: str = ""
+    # Pods whose JOB_KEY label is in this list satisfy the selector.
+    job_key_in: Optional[list[str]] = None
+    # If true, selector matches any pod carrying a JOB_KEY label
+    # (combined with job_key_not_in for the anti-affinity term).
+    job_key_exists: bool = False
+    job_key_not_in: Optional[list[str]] = None
+
+
+@dataclass
+class Affinity:
+    pod_affinity: list[AffinityTerm] = field(default_factory=list)
+    pod_anti_affinity: list[AffinityTerm] = field(default_factory=list)
+
+
+@dataclass
+class PodSpec:
+    """Reduced corev1.PodSpec carrying the fields the framework reads/writes."""
+
+    restart_policy: str = ""  # defaulted to OnFailure by admission
+    node_selector: dict[str, str] = field(default_factory=dict)
+    tolerations: list[Toleration] = field(default_factory=list)
+    affinity: Optional[Affinity] = None
+    subdomain: str = ""
+    hostname: str = ""
+    scheduling_gates: list[str] = field(default_factory=list)
+    node_name: str = ""  # set by the scheduler when bound
+    # Opaque workload payload: what the pod "runs" (used by the runtime layer
+    # to launch the JAX worker; ignored by the control plane).
+    workload: dict = field(default_factory=dict)
+
+
+@dataclass
+class PodTemplateSpec:
+    labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+    spec: PodSpec = field(default_factory=PodSpec)
+
+
+@dataclass
+class JobSpec:
+    """Reduced batchv1.JobSpec."""
+
+    parallelism: Optional[int] = None
+    completions: Optional[int] = None
+    completion_mode: Optional[str] = None  # "Indexed" | "NonIndexed"
+    backoff_limit: int = 6
+    suspend: Optional[bool] = None
+    active_deadline_seconds: Optional[int] = None
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+
+
+@dataclass
+class JobTemplateSpec:
+    """Analog of batchv1.JobTemplateSpec (metadata + spec)."""
+
+    labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+    spec: JobSpec = field(default_factory=JobSpec)
+
+
+# ---------------------------------------------------------------------------
+# JobSet spec types (jobset_types.go:217-357)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ReplicatedJob:
+    """`replicas` Jobs stamped from one template; job names are
+    `<jobset>-<name>-<jobIdx>` (jobset_types.go:217-228)."""
+
+    name: str
+    template: JobTemplateSpec = field(default_factory=JobTemplateSpec)
+    replicas: int = 1
+
+
+@dataclass
+class Network:
+    """DNS config (jobset_types.go:230-247): pod hostnames are
+    `<jobset>-<rjob>-<jobIdx>-<podIdx>.<subdomain>`."""
+
+    enable_dns_hostnames: Optional[bool] = None
+    subdomain: str = ""
+    publish_not_ready_addresses: Optional[bool] = None
+
+
+@dataclass
+class SuccessPolicy:
+    """Operator All/Any over target replicated jobs (jobset_types.go:312-322)."""
+
+    operator: str = "All"
+    target_replicated_jobs: list[str] = field(default_factory=list)
+
+
+@dataclass
+class FailurePolicyRule:
+    """First-match rule: (failure reason, parent rjob) -> action
+    (jobset_types.go:283-310)."""
+
+    name: str = ""
+    action: str = "RestartJobSet"
+    on_job_failure_reasons: list[str] = field(default_factory=list)
+    target_replicated_jobs: list[str] = field(default_factory=list)
+
+
+@dataclass
+class FailurePolicy:
+    max_restarts: int = 0
+    rules: list[FailurePolicyRule] = field(default_factory=list)
+
+
+@dataclass
+class StartupPolicy:
+    startup_policy_order: str = "AnyOrder"  # "AnyOrder" | "InOrder"
+
+
+@dataclass
+class Coordinator:
+    """Which pod is the coordinator; its stable endpoint is stamped on all
+    jobs/pods (jobset_types.go:345-357)."""
+
+    replicated_job: str = ""
+    job_index: int = 0
+    pod_index: int = 0
+
+
+@dataclass
+class JobSetSpec:
+    replicated_jobs: list[ReplicatedJob] = field(default_factory=list)
+    network: Optional[Network] = None
+    success_policy: Optional[SuccessPolicy] = None
+    failure_policy: Optional[FailurePolicy] = None
+    startup_policy: Optional[StartupPolicy] = None
+    suspend: Optional[bool] = None
+    coordinator: Optional[Coordinator] = None
+    managed_by: Optional[str] = None
+    ttl_seconds_after_finished: Optional[int] = None
+
+
+# ---------------------------------------------------------------------------
+# Status types (jobset_types.go:144-190)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Condition:
+    """Analog of metav1.Condition."""
+
+    type: str = ""
+    status: str = "False"  # "True" | "False"
+    reason: str = ""
+    message: str = ""
+    last_transition_time: float = 0.0
+
+
+@dataclass
+class ReplicatedJobStatus:
+    name: str = ""
+    ready: int = 0
+    succeeded: int = 0
+    failed: int = 0
+    active: int = 0
+    suspended: int = 0
+
+    def key(self):
+        return (
+            self.name,
+            self.ready,
+            self.succeeded,
+            self.failed,
+            self.active,
+            self.suspended,
+        )
+
+
+@dataclass
+class JobSetStatus:
+    conditions: list[Condition] = field(default_factory=list)
+    restarts: int = 0
+    restarts_count_towards_max: int = 0
+    terminal_state: str = ""  # "" | "Completed" | "Failed"
+    replicated_jobs_status: list[ReplicatedJobStatus] = field(default_factory=list)
+
+
+# ---------------------------------------------------------------------------
+# Object metadata + top-level JobSet
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = "default"
+    uid: str = ""
+    labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+    creation_time: float = 0.0
+    deletion_time: Optional[float] = None
+    owner_uid: str = ""  # controller owner reference (single-owner model)
+
+
+@dataclass
+class JobSet:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: JobSetSpec = field(default_factory=JobSetSpec)
+    status: JobSetStatus = field(default_factory=JobSetStatus)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+    def clone(self) -> "JobSet":
+        return _clone(self)
+
+
+def replicated_job_by_name(js: JobSet, name: str) -> Optional[ReplicatedJob]:
+    for rjob in js.spec.replicated_jobs:
+        if rjob.name == name:
+            return rjob
+    return None
+
+
+def replicated_job_names(js: JobSet) -> list[str]:
+    return [rjob.name for rjob in js.spec.replicated_jobs]
+
+
+def jobset_suspended(js: JobSet) -> bool:
+    return bool(js.spec.suspend)
+
+
+def dns_hostnames_enabled(js: JobSet) -> bool:
+    return bool(js.spec.network and js.spec.network.enable_dns_hostnames)
+
+
+def get_subdomain(js: JobSet) -> str:
+    """Subdomain defaults to the JobSet name (jobset_types.go:236-240)."""
+    if js.spec.network and js.spec.network.subdomain:
+        return js.spec.network.subdomain
+    return js.name
+
+
+def coordinator_endpoint(js: JobSet) -> str:
+    """`<js>-<rjob>-<jobIdx>-<podIdx>.<subdomain>` (jobset_controller.go:1032-1036)."""
+    c = js.spec.coordinator
+    assert c is not None
+    return f"{js.name}-{c.replicated_job}-{c.job_index}-{c.pod_index}.{get_subdomain(js)}"
+
+
+def global_job_index(js: JobSet, replicated_job_name: str, job_idx: int) -> str:
+    """Unique index of a job across the whole JobSet: cumulative replicas of
+    preceding replicated jobs plus the local index
+    (jobset_controller.go:1040-1065)."""
+    total = 0
+    for rjob in js.spec.replicated_jobs:
+        if rjob.name == replicated_job_name:
+            return str(total + job_idx)
+        total += int(rjob.replicas)
+    return ""

@@ -1,0 +1,288 @@
+"""Pure validation of JobSet specs (create + update).
+
+Mirrors the reference admission validation
+(`pkg/webhooks/jobset_webhook.go:155-373`): DNS-1035 length math on generated
+job/pod names, subdomain validity, managedBy domain-prefixed-path rules,
+success/failure-policy cross-references, rule-name regex + uniqueness,
+coordinator bounds, and update immutability (replicatedJobs/managedBy
+immutable except the Kueue-mutable pod-template fields while suspended).
+
+All functions return a list of error strings (empty == valid).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+from . import keys
+from .types import FailurePolicy, JobSet
+from ..placement.naming import gen_job_name, gen_pod_name
+
+MAX_MANAGED_BY_LENGTH = 63
+
+# \Z (not $) so a trailing newline can't sneak past validation.
+DNS1035_RE = re.compile(r"^[a-z]([-a-z0-9]*[a-z0-9])?\Z")
+DNS1123_LABEL_RE = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?\Z")
+DNS1123_SUBDOMAIN_RE = re.compile(
+    r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?(\.[a-z0-9]([-a-z0-9]*[a-z0-9])?)*\Z"
+)
+HTTP_PATH_SEGMENT_RE = re.compile(r"^[A-Za-z0-9/\-._~%!$&'()*+,;=:]+\Z")
+
+MIN_RULE_NAME_LENGTH = 1
+MAX_RULE_NAME_LENGTH = 128
+# Rule names: start alphabetic, middle alphanumeric or `_,:`, end
+# alphanumeric or `_` (jobset_webhook.go:288-292).
+RULE_NAME_RE = re.compile(r"^[A-Za-z]([A-Za-z0-9_,:]*[A-Za-z0-9_])?\Z")
+
+JOB_NAME_TOO_LONG_MSG = (
+    "JobSet name is too long, job names generated for this JobSet "
+    "will exceed 63 characters"
+)
+POD_NAME_TOO_LONG_MSG = (
+    "JobSet name is too long, pod names generated for this JobSet "
+    "will exceed 63 characters"
+)
+SUBDOMAIN_TOO_LONG_MSG = (
+    ".spec.network.subdomain is too long, must be less than 63 characters"
+)
+
+
+def is_dns1035_label(value: str) -> list[str]:
+    errs = []
+    if len(value) > 63:
+        errs.append("must be no more than 63 characters")
+    if not DNS1035_RE.match(value):
+        errs.append(
+            "a DNS-1035 label must consist of lower case alphanumeric characters "
+            "or '-', start with an alphabetic character, and end with an "
+            f"alphanumeric character (got {value!r})"
+        )
+    return errs
+
+
+def is_dns1123_subdomain(value: str) -> list[str]:
+    errs = []
+    if len(value) > 253:
+        errs.append("must be no more than 253 characters")
+    if not DNS1123_SUBDOMAIN_RE.match(value):
+        errs.append(
+            "a lowercase RFC 1123 subdomain must consist of lower case "
+            "alphanumeric characters, '-' or '.', and must start and end with "
+            f"an alphanumeric character (got {value!r})"
+        )
+    return errs
+
+
+def is_domain_prefixed_path(value: str) -> list[str]:
+    """Valid domain-prefixed path, e.g. `acme.io/foo` (jobset_types.go:125-131)."""
+    errs = []
+    if "/" not in value:
+        errs.append(f"must be a domain-prefixed path (such as 'acme.io/foo'): {value!r}")
+        return errs
+    prefix, _, path = value.partition("/")
+    if prefix:
+        errs.extend(is_dns1123_subdomain(prefix))
+    else:
+        errs.append("prefix part of a domain-prefixed path must be non-empty")
+    if not path:
+        errs.append("path part of a domain-prefixed path must be non-empty")
+    elif not HTTP_PATH_SEGMENT_RE.match(path):
+        errs.append(f"path part must contain only valid HTTP path characters: {path!r}")
+    return errs
+
+
+def validate_create(js: JobSet) -> list[str]:
+    """Validation at creation time (jobset_webhook.go:158-242).
+
+    Assumes defaults have been applied (success/startup policies non-nil).
+    """
+    errs: list[str] = []
+    valid_rjobs = [rjob.name for rjob in js.spec.replicated_jobs]
+
+    # ReplicatedJob names must be unique. The reference enforces this at the
+    # CRD layer via listType=map/listMapKey=name (jobset_types.go:79-80);
+    # with no CRD layer here the check lands in create validation.
+    seen: set[str] = set()
+    for name in valid_rjobs:
+        if name in seen:
+            errs.append(f"duplicate replicatedJob name '{name}'")
+        seen.add(name)
+
+    # Subdomain must be a valid DNS-1123 subdomain AND (since it doubles as a
+    # service name) a DNS-1035 label.
+    if js.spec.network is not None and js.spec.network.subdomain:
+        errs.extend(is_dns1123_subdomain(js.spec.network.subdomain))
+        for msg in is_dns1035_label(js.spec.network.subdomain):
+            if "no more than 63 characters" in msg:
+                msg = SUBDOMAIN_TOO_LONG_MSG
+            errs.append(msg)
+
+    # managedBy: domain-prefixed path, <= 63 chars. The reserved built-in
+    # controller name is always accepted.
+    if js.spec.managed_by is not None:
+        manager = js.spec.managed_by
+        errs.extend(is_domain_prefixed_path(manager))
+        if len(manager) > MAX_MANAGED_BY_LENGTH:
+            errs.append(
+                f"spec.managedBy: must be no more than {MAX_MANAGED_BY_LENGTH} characters"
+            )
+
+    for rjob in js.spec.replicated_jobs:
+        parallelism = rjob.template.spec.parallelism or 1
+        if parallelism * max(int(rjob.replicas), 0) > 2**31 - 1:
+            errs.append(
+                "the product of replicas and parallelism must not exceed "
+                f"{2**31 - 1} for replicatedJob '{rjob.name}'"
+            )
+
+        # Generated job names must be DNS-1035 compliant; use the largest job
+        # index, which has the longest name (jobset_webhook.go:203-212).
+        longest_job_name = gen_job_name(js.name, rjob.name, max(int(rjob.replicas) - 1, 0))
+        for msg in is_dns1035_label(longest_job_name):
+            if "no more than 63 characters" in msg:
+                msg = JOB_NAME_TOO_LONG_MSG
+            errs.append(msg)
+
+        # Generated pod hostnames likewise, including the 5-char random suffix
+        # (jobset_webhook.go:214-227).
+        is_indexed = rjob.template.spec.completion_mode == keys.COMPLETION_MODE_INDEXED
+        if is_indexed and rjob.template.spec.completions is not None:
+            max_job_idx = str(max(int(rjob.replicas) - 1, 0))
+            max_pod_idx = str(max(int(rjob.template.spec.completions) - 1, 0))
+            longest_pod_name = (
+                gen_pod_name(js.name, rjob.name, max_job_idx, max_pod_idx) + "-abcde"
+            )
+            for msg in is_dns1035_label(longest_pod_name):
+                if "no more than 63 characters" in msg:
+                    msg = POD_NAME_TOO_LONG_MSG
+                errs.append(msg)
+
+    if js.spec.success_policy is not None:
+        for rjob_name in js.spec.success_policy.target_replicated_jobs:
+            if rjob_name not in valid_rjobs:
+                errs.append(
+                    f"invalid replicatedJob name '{rjob_name}' does not appear "
+                    "in .spec.ReplicatedJobs"
+                )
+
+    if js.spec.failure_policy is not None:
+        errs.extend(validate_failure_policy(js.spec.failure_policy, valid_rjobs))
+
+    if js.spec.coordinator is not None:
+        err = validate_coordinator(js)
+        if err:
+            errs.append(err)
+
+    return errs
+
+
+def validate_failure_policy(
+    failure_policy: FailurePolicy, valid_rjobs: list[str]
+) -> list[str]:
+    """Rule-name length/regex/uniqueness + cross-refs (jobset_webhook.go:296-345)."""
+    errs: list[str] = []
+    name_to_indices: dict[str, list[int]] = {}
+    for index, rule in enumerate(failure_policy.rules):
+        name_len = len(rule.name)
+        if not (MIN_RULE_NAME_LENGTH <= name_len <= MAX_RULE_NAME_LENGTH):
+            errs.append(
+                f"invalid failure policy rule name of length {name_len}, the rule "
+                f"name must be at least {MIN_RULE_NAME_LENGTH} characters long "
+                f"and at most {MAX_RULE_NAME_LENGTH} characters long"
+            )
+        name_to_indices.setdefault(rule.name, []).append(index)
+        if not RULE_NAME_RE.match(rule.name):
+            errs.append(
+                f"invalid failure policy rule name '{rule.name}', a failure "
+                "policy rule name must start with an alphabetic character, "
+                "optionally followed by a string of alphanumeric characters or "
+                "'_,:', and must end with an alphanumeric character or '_'"
+            )
+        if rule.action not in keys.FAILURE_POLICY_ACTIONS:
+            errs.append(f"invalid failure policy action '{rule.action}'")
+        for rjob_name in rule.target_replicated_jobs:
+            if rjob_name not in valid_rjobs:
+                errs.append(
+                    f"invalid replicatedJob name '{rjob_name}' in failure policy "
+                    "does not appear in .spec.ReplicatedJobs"
+                )
+        for reason in rule.on_job_failure_reasons:
+            if reason not in keys.VALID_ON_JOB_FAILURE_REASONS:
+                errs.append(
+                    f"invalid job failure reason '{reason}' in failure policy "
+                    "is not a recognized job failure reason"
+                )
+    for rule_name, indices in name_to_indices.items():
+        if len(indices) > 1:
+            errs.append(
+                f"rule names are not unique, rules with indices {indices} all "
+                f"have the same name '{rule_name}'"
+            )
+    return errs
+
+
+def validate_coordinator(js: JobSet) -> Optional[str]:
+    """Coordinator cross-refs and index bounds (jobset_webhook.go:351-373)."""
+    coord = js.spec.coordinator
+    assert coord is not None
+    rjob = next(
+        (r for r in js.spec.replicated_jobs if r.name == coord.replicated_job), None
+    )
+    if rjob is None:
+        return f"coordinator replicatedJob {coord.replicated_job} does not exist"
+    if not (0 <= coord.job_index < int(rjob.replicas)):
+        return (
+            f"coordinator job index {coord.job_index} is invalid for "
+            f"replicatedJob {rjob.name}"
+        )
+    if rjob.template.spec.completion_mode != keys.COMPLETION_MODE_INDEXED:
+        return "job for coordinator pod must be indexed completion mode"
+    completions = rjob.template.spec.completions
+    if completions is None or not (0 <= coord.pod_index < int(completions)):
+        return (
+            f"coordinator pod index {coord.pod_index} is invalid for "
+            f"replicatedJob {coord.replicated_job} job index {coord.job_index}"
+        )
+    return None
+
+
+def validate_update(old: JobSet, new: JobSet) -> list[str]:
+    """Update immutability (jobset_webhook.go:245-280).
+
+    ReplicatedJobs and managedBy are immutable, except that while the JobSet
+    is (or is becoming) suspended, pod-template labels/annotations/
+    nodeSelector/tolerations/schedulingGates may be mutated (Kueue/DWS
+    integration).  Network, success/failure/startup policies are immutable via
+    CRD CEL rules in the reference (jobset_types.go:84-104); enforced here
+    alongside the webhook checks.
+    """
+    errs: list[str] = []
+
+    munged = new.clone()
+    if bool(old.spec.suspend) or bool(new.spec.suspend):
+        if len(munged.spec.replicated_jobs) == len(old.spec.replicated_jobs):
+            for idx, rjob in enumerate(munged.spec.replicated_jobs):
+                old_tmpl = old.spec.replicated_jobs[idx].template.spec.template
+                tmpl = rjob.template.spec.template
+                tmpl.labels = dict(old_tmpl.labels)
+                tmpl.annotations = dict(old_tmpl.annotations)
+                tmpl.spec.node_selector = dict(old_tmpl.spec.node_selector)
+                tmpl.spec.tolerations = list(old_tmpl.spec.tolerations)
+                tmpl.spec.scheduling_gates = list(old_tmpl.spec.scheduling_gates)
+
+    if munged.spec.replicated_jobs != old.spec.replicated_jobs:
+        errs.append("spec.replicatedJobs: Invalid value: field is immutable")
+    if munged.spec.managed_by != old.spec.managed_by:
+        errs.append("spec.managedBy: Invalid value: field is immutable")
+
+    # CEL-immutable fields.
+    if munged.spec.network != old.spec.network:
+        errs.append("spec.network: Invalid value: field is immutable")
+    if munged.spec.success_policy != old.spec.success_policy:
+        errs.append("spec.successPolicy: Invalid value: field is immutable")
+    if munged.spec.failure_policy != old.spec.failure_policy:
+        errs.append("spec.failurePolicy: Invalid value: field is immutable")
+    if munged.spec.startup_policy != old.spec.startup_policy:
+        errs.append("spec.startupPolicy: Invalid value: field is immutable")
+    return errs

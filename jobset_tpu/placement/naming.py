@@ -1,0 +1,43 @@
+"""Deterministic naming and identity for jobs and pods.
+
+Mirrors `pkg/util/placement/placement.go:14-28` plus the job hash key used by
+the exclusive-placement machinery (`jobset_controller.go:714-720`): job names
+are `<jobset>-<rjob>-<jobIdx>`, pod (host)names are
+`<jobset>-<rjob>-<jobIdx>-<podIdx>`, a pod is the leader iff its completion
+index is 0, and the job key is the SHA-256 of the namespaced job name.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from ..api import keys
+
+
+def gen_job_name(jobset_name: str, rjob_name: str, job_index: int) -> str:
+    return f"{jobset_name}-{rjob_name}-{job_index}"
+
+
+def gen_pod_name(
+    jobset_name: str, rjob_name: str, job_index: str | int, pod_index: str | int
+) -> str:
+    return f"{jobset_name}-{rjob_name}-{job_index}-{pod_index}"
+
+
+def job_hash_key(namespace: str, job_name: str) -> str:
+    """SHA-256 of the namespaced job name; the JOB_KEY label value."""
+    return hashlib.sha256(f"{namespace}/{job_name}".encode()).hexdigest()
+
+
+def is_leader_pod(pod) -> bool:
+    """Leader == completion index 0 (placement.go:25-28)."""
+    return pod.annotations.get(keys.POD_COMPLETION_INDEX_KEY) == "0"
+
+
+def leader_pod_name_for(pod) -> str:
+    """Name of the completion-index-0 pod in the same child job, derived from
+    the pod's identity labels (pod_admission_webhook.go:128-144)."""
+    jobset_name = pod.labels[keys.JOBSET_NAME_KEY]
+    rjob_name = pod.labels[keys.REPLICATED_JOB_NAME_KEY]
+    job_index = pod.labels[keys.JOB_INDEX_KEY]
+    return gen_pod_name(jobset_name, rjob_name, job_index, "0")

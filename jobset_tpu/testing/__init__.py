@@ -1,0 +1,3 @@
+from .wrappers import make_jobset, make_replicated_job, test_pod_spec
+
+__all__ = ["make_jobset", "make_replicated_job", "test_pod_spec"]

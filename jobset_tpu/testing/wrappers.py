@@ -1,0 +1,167 @@
+"""Builder-pattern test fixtures.
+
+Mirrors the fixture style of the reference test suite
+(`pkg/util/testing/wrappers.go:43-117`):
+`make_jobset("js").replicated_job(make_replicated_job("rj").replicas(2).obj()).obj()`.
+"""
+
+from __future__ import annotations
+
+from ..api import (
+    Coordinator,
+    FailurePolicy,
+    JobSet,
+    JobSetSpec,
+    JobSpec,
+    JobTemplateSpec,
+    Network,
+    ObjectMeta,
+    PodSpec,
+    PodTemplateSpec,
+    ReplicatedJob,
+    StartupPolicy,
+    SuccessPolicy,
+    keys,
+)
+
+
+def test_pod_spec() -> PodSpec:
+    """Default pod spec used across tests (wrappers.go:27-35 analog)."""
+    return PodSpec(restart_policy=keys.RESTART_POLICY_ON_FAILURE)
+
+
+class ReplicatedJobWrapper:
+    def __init__(self, name: str):
+        self._rjob = ReplicatedJob(
+            name=name,
+            template=JobTemplateSpec(
+                spec=JobSpec(template=PodTemplateSpec(spec=test_pod_spec()))
+            ),
+        )
+
+    def replicas(self, n: int) -> "ReplicatedJobWrapper":
+        self._rjob.replicas = n
+        return self
+
+    def parallelism(self, n: int) -> "ReplicatedJobWrapper":
+        self._rjob.template.spec.parallelism = n
+        return self
+
+    def completions(self, n: int) -> "ReplicatedJobWrapper":
+        self._rjob.template.spec.completions = n
+        return self
+
+    def completion_mode(self, mode: str) -> "ReplicatedJobWrapper":
+        self._rjob.template.spec.completion_mode = mode
+        return self
+
+    def job_annotations(self, annotations: dict) -> "ReplicatedJobWrapper":
+        self._rjob.template.annotations.update(annotations)
+        return self
+
+    def job_labels(self, labels: dict) -> "ReplicatedJobWrapper":
+        self._rjob.template.labels.update(labels)
+        return self
+
+    def pod_annotations(self, annotations: dict) -> "ReplicatedJobWrapper":
+        self._rjob.template.spec.template.annotations.update(annotations)
+        return self
+
+    def pod_labels(self, labels: dict) -> "ReplicatedJobWrapper":
+        self._rjob.template.spec.template.labels.update(labels)
+        return self
+
+    def node_selector(self, selector: dict) -> "ReplicatedJobWrapper":
+        self._rjob.template.spec.template.spec.node_selector.update(selector)
+        return self
+
+    def restart_policy(self, policy: str) -> "ReplicatedJobWrapper":
+        self._rjob.template.spec.template.spec.restart_policy = policy
+        return self
+
+    def workload(self, payload: dict) -> "ReplicatedJobWrapper":
+        self._rjob.template.spec.template.spec.workload = dict(payload)
+        return self
+
+    def obj(self) -> ReplicatedJob:
+        return self._rjob
+
+
+class JobSetWrapper:
+    def __init__(self, name: str, namespace: str = "default"):
+        self._js = JobSet(
+            metadata=ObjectMeta(name=name, namespace=namespace),
+            spec=JobSetSpec(),
+        )
+
+    def replicated_job(self, rjob: ReplicatedJob) -> "JobSetWrapper":
+        self._js.spec.replicated_jobs.append(rjob)
+        return self
+
+    def suspend(self, suspended: bool) -> "JobSetWrapper":
+        self._js.spec.suspend = suspended
+        return self
+
+    def success_policy(self, policy: SuccessPolicy) -> "JobSetWrapper":
+        self._js.spec.success_policy = policy
+        return self
+
+    def failure_policy(self, policy: FailurePolicy) -> "JobSetWrapper":
+        self._js.spec.failure_policy = policy
+        return self
+
+    def startup_policy(self, policy: StartupPolicy) -> "JobSetWrapper":
+        self._js.spec.startup_policy = policy
+        return self
+
+    def network(self, network: Network) -> "JobSetWrapper":
+        self._js.spec.network = network
+        return self
+
+    def network_subdomain(self, subdomain: str) -> "JobSetWrapper":
+        if self._js.spec.network is None:
+            self._js.spec.network = Network()
+        self._js.spec.network.subdomain = subdomain
+        return self
+
+    def enable_dns_hostnames(self, enabled: bool) -> "JobSetWrapper":
+        if self._js.spec.network is None:
+            self._js.spec.network = Network()
+        self._js.spec.network.enable_dns_hostnames = enabled
+        return self
+
+    def coordinator(self, coordinator: Coordinator) -> "JobSetWrapper":
+        self._js.spec.coordinator = coordinator
+        return self
+
+    def managed_by(self, manager: str) -> "JobSetWrapper":
+        self._js.spec.managed_by = manager
+        return self
+
+    def ttl_seconds_after_finished(self, ttl: int) -> "JobSetWrapper":
+        self._js.spec.ttl_seconds_after_finished = ttl
+        return self
+
+    def annotations(self, annotations: dict) -> "JobSetWrapper":
+        self._js.metadata.annotations.update(annotations)
+        return self
+
+    def exclusive_placement(self, topology_key: str) -> "JobSetWrapper":
+        self._js.metadata.annotations[keys.EXCLUSIVE_KEY] = topology_key
+        return self
+
+    def node_selector_strategy(self, enabled: bool = True) -> "JobSetWrapper":
+        if enabled:
+            self._js.metadata.annotations[keys.NODE_SELECTOR_STRATEGY_KEY] = "true"
+        return self
+
+    def obj(self) -> JobSet:
+        return self._js
+
+
+def make_jobset(name: str, namespace: str = "default") -> JobSetWrapper:
+    return JobSetWrapper(name, namespace)
+
+
+def make_replicated_job(name: str) -> ReplicatedJobWrapper:
+    return ReplicatedJobWrapper(name)

@@ -1,0 +1,30 @@
+"""Injectable clocks.
+
+The reconcile core takes a clock so TTL/condition timing is testable with a
+fake clock, mirroring the clock injection at `jobset_controller.go:56,90`.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+class Clock:
+    def now(self) -> float:
+        return time.time()
+
+
+class FakeClock(Clock):
+    """Deterministic clock for tests and for the simulator's virtual time."""
+
+    def __init__(self, start: float = 0.0):
+        self._now = float(start)
+
+    def now(self) -> float:
+        return self._now
+
+    def advance(self, seconds: float) -> None:
+        self._now += float(seconds)
+
+    def set(self, t: float) -> None:
+        self._now = float(t)

@@ -1,0 +1,26 @@
+"""Small generic helpers (analog of `pkg/util/collections/collections.go`)."""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+def merge_maps(*maps: Optional[dict]) -> dict:
+    """Merge left to right; later maps win on key conflicts
+    (collections.go MergeMaps semantics)."""
+    out: dict = {}
+    for m in maps:
+        if m:
+            out.update(m)
+    return out
+
+
+def merge_slices(a: Optional[Iterable[T]], b: Optional[Iterable[T]]) -> list[T]:
+    """Concatenate, dropping duplicates from `b` already present in `a`."""
+    out: list[T] = list(a or [])
+    for item in b or []:
+        if item not in out:
+            out.append(item)
+    return out

@@ -1,0 +1,127 @@
+"""Defaulting tests (behavior parity with jobset_webhook.go:105-150,
+exercised in the reference by pkg/webhooks/jobset_webhook_test.go:45+)."""
+
+from jobset_tpu.api import (
+    FailurePolicy,
+    FailurePolicyRule,
+    StartupPolicy,
+    SuccessPolicy,
+    apply_defaults,
+    keys,
+)
+from jobset_tpu.testing import make_jobset, make_replicated_job
+
+
+def basic_jobset():
+    return (
+        make_jobset("js")
+        .replicated_job(make_replicated_job("rj").replicas(2).obj())
+        .obj()
+    )
+
+
+def test_success_policy_defaulted_to_all():
+    js = apply_defaults(basic_jobset())
+    assert js.spec.success_policy is not None
+    assert js.spec.success_policy.operator == keys.OPERATOR_ALL
+    assert js.spec.success_policy.target_replicated_jobs == []
+
+
+def test_existing_success_policy_untouched():
+    js = basic_jobset()
+    js.spec.success_policy = SuccessPolicy(
+        operator=keys.OPERATOR_ANY, target_replicated_jobs=["rj"]
+    )
+    apply_defaults(js)
+    assert js.spec.success_policy.operator == keys.OPERATOR_ANY
+    assert js.spec.success_policy.target_replicated_jobs == ["rj"]
+
+
+def test_startup_policy_defaulted_to_any_order():
+    js = apply_defaults(basic_jobset())
+    assert js.spec.startup_policy.startup_policy_order == keys.STARTUP_ANY_ORDER
+
+
+def test_existing_startup_policy_untouched():
+    js = basic_jobset()
+    js.spec.startup_policy = StartupPolicy(startup_policy_order=keys.STARTUP_IN_ORDER)
+    apply_defaults(js)
+    assert js.spec.startup_policy.startup_policy_order == keys.STARTUP_IN_ORDER
+
+
+def test_completion_mode_defaulted_to_indexed():
+    js = apply_defaults(basic_jobset())
+    assert (
+        js.spec.replicated_jobs[0].template.spec.completion_mode
+        == keys.COMPLETION_MODE_INDEXED
+    )
+
+
+def test_non_indexed_completion_mode_untouched():
+    js = basic_jobset()
+    js.spec.replicated_jobs[0].template.spec.completion_mode = (
+        keys.COMPLETION_MODE_NON_INDEXED
+    )
+    apply_defaults(js)
+    assert (
+        js.spec.replicated_jobs[0].template.spec.completion_mode
+        == keys.COMPLETION_MODE_NON_INDEXED
+    )
+
+
+def test_pod_restart_policy_defaulted_to_on_failure():
+    js = basic_jobset()
+    js.spec.replicated_jobs[0].template.spec.template.spec.restart_policy = ""
+    apply_defaults(js)
+    assert (
+        js.spec.replicated_jobs[0].template.spec.template.spec.restart_policy
+        == keys.RESTART_POLICY_ON_FAILURE
+    )
+
+
+def test_pod_restart_policy_never_untouched():
+    js = basic_jobset()
+    js.spec.replicated_jobs[0].template.spec.template.spec.restart_policy = (
+        keys.RESTART_POLICY_NEVER
+    )
+    apply_defaults(js)
+    assert (
+        js.spec.replicated_jobs[0].template.spec.template.spec.restart_policy
+        == keys.RESTART_POLICY_NEVER
+    )
+
+
+def test_dns_hostnames_and_publish_not_ready_defaulted_true():
+    js = apply_defaults(basic_jobset())
+    assert js.spec.network is not None
+    assert js.spec.network.enable_dns_hostnames is True
+    assert js.spec.network.publish_not_ready_addresses is True
+
+
+def test_explicit_dns_hostnames_false_untouched():
+    js = basic_jobset()
+    js = make_jobset("js2").replicated_job(make_replicated_job("rj").obj()).enable_dns_hostnames(False).obj()
+    apply_defaults(js)
+    assert js.spec.network.enable_dns_hostnames is False
+    # publish_not_ready_addresses still gets its own default.
+    assert js.spec.network.publish_not_ready_addresses is True
+
+
+def test_failure_policy_rule_names_defaulted():
+    js = basic_jobset()
+    js.spec.failure_policy = FailurePolicy(
+        max_restarts=3,
+        rules=[
+            FailurePolicyRule(name="", action=keys.FAIL_JOBSET),
+            FailurePolicyRule(name="custom", action=keys.RESTART_JOBSET),
+            FailurePolicyRule(name="", action=keys.RESTART_JOBSET),
+        ],
+    )
+    apply_defaults(js)
+    names = [r.name for r in js.spec.failure_policy.rules]
+    assert names == ["failurePolicyRule0", "custom", "failurePolicyRule2"]
+
+
+def test_parallelism_defaulted_to_one():
+    js = apply_defaults(basic_jobset())
+    assert js.spec.replicated_jobs[0].template.spec.parallelism == 1

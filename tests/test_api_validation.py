@@ -1,0 +1,313 @@
+"""Create/update validation tests (behavior parity with
+jobset_webhook.go:155-373, reference tests pkg/webhooks/jobset_webhook_test.go:761+)."""
+
+import pytest
+
+from jobset_tpu.api import (
+    Coordinator,
+    FailurePolicy,
+    FailurePolicyRule,
+    Network,
+    SuccessPolicy,
+    apply_defaults,
+    keys,
+    validate_create,
+    validate_update,
+)
+from jobset_tpu.testing import make_jobset, make_replicated_job
+
+
+def valid_jobset(name="js"):
+    js = (
+        make_jobset(name)
+        .replicated_job(
+            make_replicated_job("rj").replicas(2).parallelism(2).completions(2).obj()
+        )
+        .obj()
+    )
+    return apply_defaults(js)
+
+
+def test_valid_jobset_passes():
+    assert validate_create(valid_jobset()) == []
+
+
+# --- name-length arithmetic -------------------------------------------------
+
+
+def test_job_name_too_long_rejected():
+    # jobset name + rjob name + index must fit in 63 chars (DNS-1035).
+    js = apply_defaults(
+        make_jobset("a" * 55)
+        .replicated_job(make_replicated_job("longname").replicas(1).obj())
+        .obj()
+    )
+    errs = validate_create(js)
+    assert any("job names generated" in e for e in errs)
+
+
+def test_job_name_length_boundary_ok():
+    # 56 + 1 + 4 + 1 + 1 = 63 chars exactly -> valid.
+    js = apply_defaults(
+        make_jobset("a" * 56)
+        .replicated_job(make_replicated_job("rjob").replicas(2).obj())
+        .obj()
+    )
+    assert validate_create(js) == []
+
+
+def test_pod_name_too_long_rejected():
+    # Job name fits, but pod name + "-<podIdx>-abcde" suffix does not.
+    js = apply_defaults(
+        make_jobset("a" * 50)
+        .replicated_job(
+            make_replicated_job("rjob").replicas(2).completions(10).parallelism(10).obj()
+        )
+        .obj()
+    )
+    errs = validate_create(js)
+    assert any("pod names generated" in e for e in errs)
+
+
+def test_uppercase_jobset_name_rejected():
+    js = apply_defaults(
+        make_jobset("NotDNS").replicated_job(make_replicated_job("rj").obj()).obj()
+    )
+    errs = validate_create(js)
+    assert any("DNS-1035" in e for e in errs)
+
+
+# --- subdomain --------------------------------------------------------------
+
+
+def test_invalid_subdomain_rejected():
+    js = valid_jobset()
+    js.spec.network.subdomain = "Invalid_Subdomain"
+    errs = validate_create(js)
+    assert errs
+
+
+def test_subdomain_too_long_rejected():
+    js = valid_jobset()
+    js.spec.network.subdomain = "a" * 64
+    errs = validate_create(js)
+    assert any("subdomain is too long" in e for e in errs)
+
+
+def test_valid_subdomain_ok():
+    js = valid_jobset()
+    js.spec.network.subdomain = "my-subdomain"
+    assert validate_create(js) == []
+
+
+# --- managedBy --------------------------------------------------------------
+
+
+def test_managed_by_valid_domain_prefixed_path():
+    js = valid_jobset()
+    js.spec.managed_by = "acme.io/foo"
+    assert validate_create(js) == []
+
+
+def test_managed_by_builtin_controller_name_ok():
+    js = valid_jobset()
+    js.spec.managed_by = keys.JOBSET_CONTROLLER_NAME
+    assert validate_create(js) == []
+
+
+def test_managed_by_missing_slash_rejected():
+    js = valid_jobset()
+    js.spec.managed_by = "not-a-path"
+    assert any("domain-prefixed path" in e for e in validate_create(js))
+
+
+def test_managed_by_too_long_rejected():
+    js = valid_jobset()
+    js.spec.managed_by = "acme.io/" + "a" * 60
+    assert any("no more than 63" in e for e in validate_create(js))
+
+
+# --- policy cross-references ------------------------------------------------
+
+
+def test_success_policy_unknown_target_rejected():
+    js = valid_jobset()
+    js.spec.success_policy = SuccessPolicy(
+        operator=keys.OPERATOR_ALL, target_replicated_jobs=["nope"]
+    )
+    assert any("invalid replicatedJob name 'nope'" in e for e in validate_create(js))
+
+
+def test_failure_policy_unknown_target_rejected():
+    js = valid_jobset()
+    js.spec.failure_policy = FailurePolicy(
+        rules=[
+            FailurePolicyRule(
+                name="r0", action=keys.FAIL_JOBSET, target_replicated_jobs=["nope"]
+            )
+        ]
+    )
+    assert any("in failure policy" in e for e in validate_create(js))
+
+
+def test_failure_policy_invalid_reason_rejected():
+    js = valid_jobset()
+    js.spec.failure_policy = FailurePolicy(
+        rules=[
+            FailurePolicyRule(
+                name="r0",
+                action=keys.FAIL_JOBSET,
+                on_job_failure_reasons=["NotAReason"],
+            )
+        ]
+    )
+    assert any("not a recognized job failure reason" in e for e in validate_create(js))
+
+
+def test_failure_policy_valid_reasons_ok():
+    js = valid_jobset()
+    js.spec.failure_policy = FailurePolicy(
+        rules=[
+            FailurePolicyRule(
+                name="r0",
+                action=keys.RESTART_JOBSET,
+                on_job_failure_reasons=list(keys.VALID_ON_JOB_FAILURE_REASONS),
+            )
+        ]
+    )
+    assert validate_create(js) == []
+
+
+@pytest.mark.parametrize(
+    "rule_name,valid",
+    [
+        ("validName", True),
+        ("valid_name_2", True),
+        ("a", True),
+        ("Ab,c:d_", True),
+        ("0startsWithDigit", False),
+        ("has space", False),
+        ("endsWithComma,", False),
+        ("", False),
+        ("x" * 129, False),
+    ],
+)
+def test_failure_policy_rule_name_format(rule_name, valid):
+    js = valid_jobset()
+    js.spec.failure_policy = FailurePolicy(
+        rules=[FailurePolicyRule(name=rule_name, action=keys.FAIL_JOBSET)]
+    )
+    errs = validate_create(js)
+    assert (errs == []) == valid
+
+
+def test_failure_policy_duplicate_rule_names_rejected():
+    js = valid_jobset()
+    js.spec.failure_policy = FailurePolicy(
+        rules=[
+            FailurePolicyRule(name="dup", action=keys.FAIL_JOBSET),
+            FailurePolicyRule(name="dup", action=keys.RESTART_JOBSET),
+        ]
+    )
+    assert any("not unique" in e for e in validate_create(js))
+
+
+# --- coordinator ------------------------------------------------------------
+
+
+def test_coordinator_valid():
+    js = valid_jobset()
+    js.spec.coordinator = Coordinator(replicated_job="rj", job_index=1, pod_index=1)
+    assert validate_create(js) == []
+
+
+def test_coordinator_unknown_rjob_rejected():
+    js = valid_jobset()
+    js.spec.coordinator = Coordinator(replicated_job="nope")
+    assert any("does not exist" in e for e in validate_create(js))
+
+
+def test_coordinator_job_index_out_of_bounds_rejected():
+    js = valid_jobset()
+    js.spec.coordinator = Coordinator(replicated_job="rj", job_index=2)
+    assert any("job index" in e for e in validate_create(js))
+
+
+def test_coordinator_pod_index_out_of_bounds_rejected():
+    js = valid_jobset()
+    js.spec.coordinator = Coordinator(replicated_job="rj", job_index=0, pod_index=5)
+    assert any("pod index" in e for e in validate_create(js))
+
+
+# --- update immutability ----------------------------------------------------
+
+
+def test_update_replicated_jobs_immutable():
+    old = valid_jobset()
+    new = old.clone()
+    new.spec.replicated_jobs[0].replicas = 5
+    assert any("replicatedJobs" in e for e in validate_update(old, new))
+
+
+def test_update_managed_by_immutable():
+    old = valid_jobset()
+    new = old.clone()
+    new.spec.managed_by = "acme.io/foo"
+    assert any("managedBy" in e for e in validate_update(old, new))
+
+
+def test_update_identical_ok():
+    old = valid_jobset()
+    assert validate_update(old, old.clone()) == []
+
+
+def test_update_pod_template_mutable_while_suspended():
+    # Kueue integration: nodeSelector/labels/annotations/tolerations of the
+    # pod template may change while suspended (jobset_webhook.go:261-274).
+    old = valid_jobset()
+    old.spec.suspend = True
+    new = old.clone()
+    new.spec.replicated_jobs[0].template.spec.template.spec.node_selector["pool"] = "a"
+    new.spec.replicated_jobs[0].template.spec.template.labels["queue"] = "q"
+    assert validate_update(old, new) == []
+
+
+def test_update_pod_template_immutable_while_running():
+    old = valid_jobset()
+    old.spec.suspend = False
+    new = old.clone()
+    new.spec.replicated_jobs[0].template.spec.template.spec.node_selector["pool"] = "a"
+    assert any("replicatedJobs" in e for e in validate_update(old, new))
+
+
+def test_update_suspend_mutable():
+    old = valid_jobset()
+    new = old.clone()
+    new.spec.suspend = True
+    assert validate_update(old, new) == []
+
+
+# --- review-found regressions ----------------------------------------------
+
+
+def test_trailing_newline_in_name_rejected():
+    js = apply_defaults(
+        make_jobset("js\n").replicated_job(make_replicated_job("rj").obj()).obj()
+    )
+    assert validate_create(js) != []
+
+
+def test_trailing_newline_in_subdomain_rejected():
+    js = valid_jobset()
+    js.spec.network.subdomain = "sub\n"
+    assert validate_create(js) != []
+
+
+def test_duplicate_replicated_job_names_rejected():
+    js = apply_defaults(
+        make_jobset("js")
+        .replicated_job(make_replicated_job("workers").obj())
+        .replicated_job(make_replicated_job("workers").obj())
+        .obj()
+    )
+    assert any("duplicate replicatedJob name" in e for e in validate_create(js))

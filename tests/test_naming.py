@@ -1,0 +1,56 @@
+"""Naming/identity tests (parity with placement.go:14-28 and the global-index
+math at jobset_controller.go:1040-1065)."""
+
+from jobset_tpu.api import global_job_index, coordinator_endpoint, get_subdomain, Coordinator, Network
+from jobset_tpu.placement.naming import (
+    gen_job_name,
+    gen_pod_name,
+    job_hash_key,
+)
+from jobset_tpu.testing import make_jobset, make_replicated_job
+
+
+def test_gen_job_name():
+    assert gen_job_name("js", "rj", 3) == "js-rj-3"
+
+
+def test_gen_pod_name():
+    assert gen_pod_name("js", "rj", 1, 0) == "js-rj-1-0"
+    assert gen_pod_name("js", "rj", "1", "2") == "js-rj-1-2"
+
+
+def test_job_hash_key_deterministic_and_namespaced():
+    assert job_hash_key("ns", "job") == job_hash_key("ns", "job")
+    assert job_hash_key("ns1", "job") != job_hash_key("ns2", "job")
+    assert len(job_hash_key("ns", "job")) == 64  # sha256 hex
+
+
+def test_global_job_index():
+    js = (
+        make_jobset("js")
+        .replicated_job(make_replicated_job("a").replicas(2).obj())
+        .replicated_job(make_replicated_job("b").replicas(3).obj())
+        .obj()
+    )
+    assert global_job_index(js, "a", 0) == "0"
+    assert global_job_index(js, "a", 1) == "1"
+    assert global_job_index(js, "b", 0) == "2"
+    assert global_job_index(js, "b", 2) == "4"
+    assert global_job_index(js, "missing", 0) == ""
+
+
+def test_subdomain_defaults_to_jobset_name():
+    js = make_jobset("my-js").obj()
+    assert get_subdomain(js) == "my-js"
+    js.spec.network = Network(subdomain="custom")
+    assert get_subdomain(js) == "custom"
+
+
+def test_coordinator_endpoint():
+    js = (
+        make_jobset("js")
+        .replicated_job(make_replicated_job("driver").replicas(1).obj())
+        .coordinator(Coordinator(replicated_job="driver", job_index=0, pod_index=0))
+        .obj()
+    )
+    assert coordinator_endpoint(js) == "js-driver-0-0.js"
