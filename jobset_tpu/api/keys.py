@@ -32,6 +32,11 @@ NO_SCHEDULE_TAINT_KEY = "alpha.jobset.sigs.k8s.io/no-schedule"
 # Stable endpoint of the coordinator pod, stamped on jobs + pods.
 COORDINATOR_KEY = "jobset.sigs.k8s.io/coordinator"
 
+# Annotation stamped by a PlacementProvider when it has pinned a job's
+# topology domain via a precomputed nodeSelector plan (new in this build; the
+# pod webhooks skip planned pods the way they skip the nodeSelector strategy).
+PLACEMENT_PLAN_KEY = "tpu.jobset.x-k8s.io/placement-plan"
+
 # Reserved managedBy value for the built-in controller.
 JOBSET_CONTROLLER_NAME = "jobset.sigs.k8s.io/jobset-controller"
 

@@ -1,0 +1,53 @@
+"""Control plane: cluster simulation kernel, reconcilers, policy engines."""
+
+from typing import Optional
+
+from ..utils.clock import Clock
+from .cluster import AdmissionError, Cluster
+from .job_controller import JobController
+from .objects import Job, Node, Pod, Service
+from .pod_reconciler import PodReconciler
+from .reconciler import JobSetReconciler
+from .scheduler import Scheduler
+
+
+def make_cluster(
+    clock: Optional[Clock] = None,
+    auto_ready: bool = True,
+    placement=None,
+) -> Cluster:
+    """Build a fully-wired cluster: reconcilers, simulated Job controller,
+    scheduler, and the pod webhook chain (mirrors the manager wiring at
+    main.go:94-192 of the reference).
+
+    `placement` defaults to `SolverPlacement`, which behaves exactly like the
+    greedy path unless the `TPUPlacementSolver` feature gate is enabled.
+    """
+    from ..placement import webhooks
+    from ..placement.provider import SolverPlacement
+
+    cluster = Cluster(clock=clock, auto_ready=auto_ready)
+    JobController(cluster)
+    Scheduler(cluster)
+    JobSetReconciler(
+        cluster, placement_provider=placement if placement is not None else SolverPlacement()
+    )
+    PodReconciler(cluster)
+    cluster.pod_mutators.append(webhooks.mutate_pod)
+    cluster.pod_validators.append(webhooks.validate_pod_create)
+    return cluster
+
+
+__all__ = [
+    "AdmissionError",
+    "Cluster",
+    "Job",
+    "JobController",
+    "JobSetReconciler",
+    "Node",
+    "Pod",
+    "PodReconciler",
+    "Scheduler",
+    "Service",
+    "make_cluster",
+]

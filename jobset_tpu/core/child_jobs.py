@@ -1,0 +1,53 @@
+"""Child-job bucketing by restart attempt and finished state.
+
+The restart dance (`jobset_controller.go:267-305`, SURVEY.md §3.3): jobs
+whose `restart-attempt` label is behind `status.restarts` belong to a
+previous run and are marked for deletion; current-run jobs are bucketed
+active/successful/failed by their terminal condition.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..api import keys
+from ..api.types import JobSet
+from .objects import Job
+
+
+@dataclass
+class ChildJobs:
+    active: list[Job] = field(default_factory=list)
+    successful: list[Job] = field(default_factory=list)
+    failed: list[Job] = field(default_factory=list)
+    delete: list[Job] = field(default_factory=list)
+
+    def all_current(self) -> list[Job]:
+        return self.active + self.successful + self.failed
+
+    def names(self) -> set[str]:
+        return {j.metadata.name for j in self.all_current() + self.delete}
+
+
+def bucket_child_jobs(js: JobSet, jobs: list[Job]) -> ChildJobs:
+    owned = ChildJobs()
+    for job in jobs:
+        try:
+            job_restarts = int(job.labels.get(keys.RESTARTS_KEY, ""))
+        except ValueError:
+            # Invalid/missing label: treat as stale (defensive; the reference
+            # errors the reconcile here, but an in-store object can only get
+            # this way through a bug, so deletion is the safe recovery).
+            owned.delete.append(job)
+            continue
+        if job_restarts < js.status.restarts:
+            owned.delete.append(job)
+            continue
+        finished, cond_type = job.finished()
+        if not finished:
+            owned.active.append(job)
+        elif cond_type == keys.JOB_FAILED:
+            owned.failed.append(job)
+        elif cond_type == keys.JOB_COMPLETE:
+            owned.successful.append(job)
+    return owned

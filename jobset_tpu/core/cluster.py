@@ -1,0 +1,635 @@
+"""In-memory cluster state store and simulation kernel.
+
+This is the build's envtest/kwok replacement (SURVEY.md §4, §7 phase 2): a
+deterministic, single-threaded object store with the same *observable*
+semantics the reference gets from the kube-apiserver + Job controller +
+kube-scheduler:
+
+* typed stores for JobSets, Jobs, Pods, Services, Nodes with the reference's
+  field indexes (jobs-by-owner `jobset_controller.go:231-246`,
+  pods-by-job-key and pods-by-base-name `pod_controller.go:75-106`),
+* an admission chain (JobSet defaulting/validation, pod mutating + admission
+  webhooks) applied on create/update exactly where the apiserver would call
+  webhooks,
+* a virtual-time clock, an event recorder, and a reconcile work queue with
+  watch-style triggers (child Job/Service mutations requeue the owner),
+* drive helpers so tests and benches can transition Job/Pod status the way
+  the reference integration suite does with `jobUpdateFn`
+  (`test/integration/controller/jobset_controller_test.go:118-207`).
+
+The tick loop (`run_until_stable`) runs: JobSet reconciler -> simulated Job
+controller -> scheduler -> Pod reconciler, until a fixed point.
+"""
+
+from __future__ import annotations
+
+import itertools
+from collections import deque
+from typing import Callable, Iterable, Optional
+
+from ..api import keys
+from ..api.defaulting import apply_defaults
+from ..api.types import Condition, JobSet, Taint
+from ..api.validation import validate_create, validate_update
+from ..utils.clock import Clock, FakeClock
+from .objects import (
+    Event,
+    Job,
+    Node,
+    POD_FAILED,
+    POD_PENDING,
+    POD_RUNNING,
+    POD_SUCCEEDED,
+    Pod,
+    Service,
+)
+
+
+class AdmissionError(Exception):
+    """Raised when create/update is rejected by validation."""
+
+
+def _base36(n: int, width: int = 5) -> str:
+    chars = "abcdefghijklmnopqrstuvwxyz0123456789"
+    out = []
+    for _ in range(width):
+        n, r = divmod(n, 36)
+        out.append(chars[r])
+    return "".join(reversed(out))
+
+
+class Cluster:
+    def __init__(
+        self,
+        clock: Optional[Clock] = None,
+        auto_ready: bool = True,
+    ):
+        self.clock = clock or FakeClock()
+        # `auto_ready`: bound pods become Running+Ready on the next tick
+        # (stands in for kubelet). Tests that drive readiness explicitly can
+        # turn it off.
+        self.auto_ready = auto_ready
+
+        self.jobsets: dict[tuple[str, str], JobSet] = {}
+        self.jobs: dict[tuple[str, str], Job] = {}
+        self.pods: dict[tuple[str, str], Pod] = {}
+        self.services: dict[tuple[str, str], Service] = {}
+        self.nodes: dict[str, Node] = {}
+        self.events: list[Event] = []
+
+        # Field indexes (jobset_controller.go:231-246, pod_controller.go:75-106).
+        self.jobs_by_owner: dict[str, set[tuple[str, str]]] = {}
+        self.jobs_by_uid: dict[str, tuple[str, str]] = {}
+        self.pods_by_job_key: dict[str, set[tuple[str, str]]] = {}
+        self.pods_by_base_name: dict[tuple[str, str], set[tuple[str, str]]] = {}
+        self.pods_by_job_uid: dict[str, set[tuple[str, str]]] = {}
+
+        # Domain occupancy for exclusive placement, maintained by the
+        # scheduler: topology_key -> domain value -> set of job keys present.
+        self.domain_job_keys: dict[str, dict[str, set[str]]] = {}
+        # Last domain each job key was placed in (job_key is the SHA-256 of
+        # the namespaced job name, so it is stable across gang restarts);
+        # feeds the solver's stickiness cost for recovery locality.
+        self.placement_history: dict[str, str] = {}
+        # topology_key -> domain value -> [node names]; built lazily.
+        self._domain_nodes: dict[str, dict[str, list[str]]] = {}
+
+        self._uid_iter = itertools.count(1)
+        self.reconcile_queue: deque[tuple[str, str]] = deque()
+        self._queued: set[tuple[str, str]] = set()
+        # (ns, name) -> virtual time at which to requeue (TTL handling).
+        self.requeue_after: dict[tuple[str, str], float] = {}
+
+        # Wired by controllers module to avoid import cycles.
+        self.jobset_reconciler = None
+        self.pod_reconciler = None
+        self.job_controller = None
+        self.scheduler = None
+        # Pod webhook chain: callables(cluster, pod) -> None / raise AdmissionError.
+        self.pod_mutators: list[Callable] = []
+        self.pod_validators: list[Callable] = []
+
+    # ------------------------------------------------------------------
+    # Identity helpers
+    # ------------------------------------------------------------------
+
+    def next_uid(self) -> str:
+        return f"uid-{next(self._uid_iter)}"
+
+    def pod_suffix(self) -> str:
+        """Deterministic stand-in for the kubelet's random 5-char pod suffix."""
+        return _base36(next(self._uid_iter) * 2654435761 % 36**5)
+
+    def record_event(self, kind: str, name: str, etype: str, reason: str, message: str):
+        self.events.append(
+            Event(
+                object_kind=kind,
+                object_name=name,
+                type=etype,
+                reason=reason,
+                message=message,
+                time=self.clock.now(),
+            )
+        )
+
+    def events_with_reason(self, reason: str) -> list[Event]:
+        return [e for e in self.events if e.reason == reason]
+
+    # ------------------------------------------------------------------
+    # Nodes
+    # ------------------------------------------------------------------
+
+    def add_node(
+        self,
+        name: str,
+        labels: Optional[dict] = None,
+        capacity: int = 110,
+        taints: Optional[list[Taint]] = None,
+    ) -> Node:
+        node = Node(
+            name=name, labels=dict(labels or {}), capacity=capacity,
+            taints=list(taints or []),
+        )
+        self.nodes[name] = node
+        self._domain_nodes.clear()  # invalidate lazy domain->nodes map
+        return node
+
+    def add_topology(
+        self,
+        topology_key: str,
+        num_domains: int,
+        nodes_per_domain: int,
+        capacity: int = 110,
+        domain_prefix: str = "domain",
+        extra_labels: Optional[dict] = None,
+    ) -> None:
+        """Convenience: build a synthetic topology (racks / TPU slices)."""
+        for d in range(num_domains):
+            for n in range(nodes_per_domain):
+                self.add_node(
+                    f"{domain_prefix}-{d}-node-{n}",
+                    labels={topology_key: f"{domain_prefix}-{d}", **(extra_labels or {})},
+                    capacity=capacity,
+                )
+
+    def domain_nodes(self, topology_key: str) -> dict[str, list[str]]:
+        """Lazily-built map of domain value -> node names for a topology key."""
+        cached = self._domain_nodes.get(topology_key)
+        if cached is None:
+            cached = {}
+            for node in self.nodes.values():
+                value = node.labels.get(topology_key)
+                if value is not None:
+                    cached.setdefault(value, []).append(node.name)
+            self._domain_nodes[topology_key] = cached
+        return cached
+
+    # ------------------------------------------------------------------
+    # JobSets (admission chain applied like the apiserver would)
+    # ------------------------------------------------------------------
+
+    def create_jobset(self, js: JobSet) -> JobSet:
+        key = (js.metadata.namespace, js.metadata.name)
+        if key in self.jobsets:
+            raise AdmissionError(f"jobset {key} already exists")
+        apply_defaults(js)
+        errs = validate_create(js)
+        if errs:
+            raise AdmissionError("; ".join(errs))
+        js.metadata.uid = self.next_uid()
+        js.metadata.creation_time = self.clock.now()
+        self.jobsets[key] = js
+        self.enqueue_reconcile(*key)
+        return js
+
+    def update_jobset(self, js: JobSet) -> JobSet:
+        key = (js.metadata.namespace, js.metadata.name)
+        old = self.jobsets.get(key)
+        if old is None:
+            raise AdmissionError(f"jobset {key} not found")
+        apply_defaults(js)
+        errs = validate_update(old, js) + validate_create(js)
+        if errs:
+            raise AdmissionError("; ".join(errs))
+        # Carry over server-owned fields: the status subresource and identity
+        # survive a spec update, exactly as with a real apiserver.
+        js.metadata.uid = old.metadata.uid
+        js.metadata.creation_time = old.metadata.creation_time
+        js.status = old.status
+        self.jobsets[key] = js
+        self.enqueue_reconcile(*key)
+        return js
+
+    def delete_jobset(self, namespace: str, name: str) -> None:
+        """Foreground cascade: child jobs (and their pods) + services go too."""
+        key = (namespace, name)
+        js = self.jobsets.pop(key, None)
+        if js is None:
+            return
+        for job_key in list(self.jobs_by_owner.get(js.metadata.uid, ())):
+            self.delete_job(*job_key)
+        self.jobs_by_owner.pop(js.metadata.uid, None)
+        for svc_key, svc in list(self.services.items()):
+            if svc.selector.get(keys.JOBSET_NAME_KEY) == name and svc_key[0] == namespace:
+                del self.services[svc_key]
+        self.requeue_after.pop(key, None)
+
+    def get_jobset(self, namespace: str, name: str) -> Optional[JobSet]:
+        return self.jobsets.get((namespace, name))
+
+    # ------------------------------------------------------------------
+    # Jobs
+    # ------------------------------------------------------------------
+
+    def create_job(self, job: Job, owner: JobSet) -> Job:
+        key = (job.metadata.namespace, job.metadata.name)
+        if key in self.jobs:
+            raise AdmissionError(f"job {key} already exists")
+        job.metadata.uid = self.next_uid()
+        job.metadata.creation_time = self.clock.now()
+        job.metadata.owner_uid = owner.metadata.uid
+        self.jobs[key] = job
+        self.jobs_by_owner.setdefault(owner.metadata.uid, set()).add(key)
+        self.jobs_by_uid[job.metadata.uid] = key
+        self.enqueue_reconcile(owner.metadata.namespace, owner.metadata.name)
+        return job
+
+    def update_job(self, job: Job) -> Job:
+        key = (job.metadata.namespace, job.metadata.name)
+        if key not in self.jobs:
+            raise AdmissionError(f"job {key} not found")
+        self.jobs[key] = job
+        self._enqueue_owner_of(job)
+        return job
+
+    def delete_job(self, namespace: str, name: str) -> None:
+        """Foreground propagation: pods are deleted with the job."""
+        key = (namespace, name)
+        job = self.jobs.pop(key, None)
+        if job is None:
+            return
+        owner_set = self.jobs_by_owner.get(job.metadata.owner_uid)
+        if owner_set is not None:
+            owner_set.discard(key)
+        self.jobs_by_uid.pop(job.metadata.uid, None)
+        for pod_key in list(self.pods_by_job_uid.get(job.metadata.uid, ())):
+            self.delete_pod(*pod_key)
+        self.pods_by_job_uid.pop(job.metadata.uid, None)
+        # Release a plan-time domain claim (all pods are gone at this point,
+        # so per-pod release can no longer cover the never-bound case).
+        planned_domain = job.metadata.annotations.get(keys.PLACEMENT_PLAN_KEY)
+        topology_key = job.metadata.annotations.get(keys.EXCLUSIVE_KEY)
+        job_key = job.labels.get(keys.JOB_KEY)
+        if planned_domain and topology_key and job_key:
+            self.release_domain_claim(topology_key, planned_domain, job_key)
+        self._enqueue_owner_of(job)
+
+    def get_job(self, namespace: str, name: str) -> Optional[Job]:
+        return self.jobs.get((namespace, name))
+
+    def jobs_for_jobset(self, js: JobSet) -> list[Job]:
+        """The owner-index List (jobset_controller.go:267-280)."""
+        return [
+            self.jobs[k]
+            for k in self.jobs_by_owner.get(js.metadata.uid, ())
+            if k in self.jobs
+        ]
+
+    def _enqueue_owner_of(self, job: Job) -> None:
+        owner_name = job.labels.get(keys.JOBSET_NAME_KEY)
+        if owner_name:
+            self.enqueue_reconcile(job.metadata.namespace, owner_name)
+
+    # ------------------------------------------------------------------
+    # Pods (created through the webhook chain)
+    # ------------------------------------------------------------------
+
+    def create_pod(self, pod: Pod, owner: Job) -> Pod:
+        """Apply mutating + validating webhooks, then persist; raises
+        AdmissionError on rejection (the Job controller analog retries)."""
+        for mutate in self.pod_mutators:
+            mutate(self, pod)
+        for validate in self.pod_validators:
+            validate(self, pod)
+
+        key = (pod.metadata.namespace, pod.metadata.name)
+        if key in self.pods:
+            raise AdmissionError(f"pod {key} already exists")
+        pod.metadata.uid = self.next_uid()
+        pod.metadata.creation_time = self.clock.now()
+        pod.metadata.owner_uid = owner.metadata.uid
+        self.pods[key] = pod
+
+        job_key = pod.labels.get(keys.JOB_KEY)
+        if job_key:
+            self.pods_by_job_key.setdefault(job_key, set()).add(key)
+        base = self._pod_base_name(pod.metadata.name)
+        self.pods_by_base_name.setdefault((pod.metadata.namespace, base), set()).add(key)
+        self.pods_by_job_uid.setdefault(owner.metadata.uid, set()).add(key)
+        return pod
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        key = (namespace, name)
+        pod = self.pods.pop(key, None)
+        if pod is None:
+            return
+        self._release_pod_placement(pod)
+        job_key = pod.labels.get(keys.JOB_KEY)
+        if job_key and job_key in self.pods_by_job_key:
+            self.pods_by_job_key[job_key].discard(key)
+        base = self._pod_base_name(name)
+        if (namespace, base) in self.pods_by_base_name:
+            self.pods_by_base_name[(namespace, base)].discard(key)
+        owner_pods = self.pods_by_job_uid.get(pod.metadata.owner_uid)
+        if owner_pods is not None:
+            owner_pods.discard(key)
+
+    def get_pod(self, namespace: str, name: str) -> Optional[Pod]:
+        return self.pods.get((namespace, name))
+
+    def pods_for_job_key(self, namespace: str, job_key: str) -> list[Pod]:
+        return [
+            self.pods[k]
+            for k in self.pods_by_job_key.get(job_key, ())
+            if k in self.pods and k[0] == namespace
+        ]
+
+    def pods_with_base_name(self, namespace: str, base: str) -> list[Pod]:
+        """PodNameKey index analog: pods whose name minus the random suffix
+        equals `base` (pod_controller.go:94-106)."""
+        return [
+            self.pods[k]
+            for k in self.pods_by_base_name.get((namespace, base), ())
+            if k in self.pods
+        ]
+
+    def pods_for_job(self, job: Job) -> list[Pod]:
+        return [
+            self.pods[k]
+            for k in self.pods_by_job_uid.get(job.metadata.uid, ())
+            if k in self.pods
+        ]
+
+    @staticmethod
+    def _pod_base_name(name: str) -> str:
+        return name.rsplit("-", 1)[0]
+
+    # ------------------------------------------------------------------
+    # Placement bookkeeping (shared with the scheduler)
+    # ------------------------------------------------------------------
+
+    def claim_domain(self, topology_key: str, domain: str, job_key: str) -> None:
+        """Pre-claim a topology domain for a job key at *plan* time (before
+        any pod exists), so subsequent solves and the scheduler's ownership
+        checks see the reservation and never double-book a domain."""
+        self.domain_job_keys.setdefault(topology_key, {}).setdefault(
+            domain, set()
+        ).add(job_key)
+        self.placement_history[job_key] = domain
+
+    def release_domain_claim(self, topology_key: str, domain: str, job_key: str) -> None:
+        domains = self.domain_job_keys.get(topology_key, {})
+        if domain in domains:
+            domains[domain].discard(job_key)
+
+    def bind_pod(self, pod: Pod, node: Node) -> None:
+        pod.spec.node_name = node.name
+        node.allocated += 1
+        topology_key = pod.annotations.get(keys.EXCLUSIVE_KEY)
+        job_key = pod.labels.get(keys.JOB_KEY)
+        if topology_key and job_key:
+            value = node.labels.get(topology_key)
+            if value is not None:
+                self.domain_job_keys.setdefault(topology_key, {}).setdefault(
+                    value, set()
+                ).add(job_key)
+                self.placement_history[job_key] = value
+
+    def _release_pod_placement(self, pod: Pod) -> None:
+        if not pod.spec.node_name:
+            return
+        node = self.nodes.get(pod.spec.node_name)
+        # Clear the binding before the domain-occupancy scan below so the pod
+        # being released never counts as "still there".
+        pod.spec.node_name = ""
+        if node is not None:
+            node.allocated = max(node.allocated - 1, 0)
+        topology_key = pod.annotations.get(keys.EXCLUSIVE_KEY)
+        job_key = pod.labels.get(keys.JOB_KEY)
+        if node is not None and topology_key and job_key:
+            value = node.labels.get(topology_key)
+            domains = self.domain_job_keys.get(topology_key, {})
+            if value in domains:
+                # Only clear the key if no other bound pod of this job
+                # remains in the domain.
+                still_there = any(
+                    p.spec.node_name
+                    and self.nodes.get(p.spec.node_name) is not None
+                    and self.nodes[p.spec.node_name].labels.get(topology_key) == value
+                    for p in self.pods_for_job_key(pod.metadata.namespace, job_key)
+                )
+                if not still_there:
+                    domains[value].discard(job_key)
+
+    # ------------------------------------------------------------------
+    # Services
+    # ------------------------------------------------------------------
+
+    def create_service(self, svc: Service) -> Service:
+        key = (svc.metadata.namespace, svc.metadata.name)
+        if key in self.services:
+            raise AdmissionError(f"service {key} already exists")
+        svc.metadata.uid = self.next_uid()
+        self.services[key] = svc
+        return svc
+
+    def get_service(self, namespace: str, name: str) -> Optional[Service]:
+        return self.services.get((namespace, name))
+
+    def resolve_hostname(self, namespace: str, fqdn: str) -> Optional[Pod]:
+        """DNS analog: `<pod-hostname>.<subdomain>` -> Pod, honoring the
+        headless service + publishNotReadyAddresses contract
+        (jobset_controller.go:580-625)."""
+        parts = fqdn.split(".")
+        if len(parts) < 2:
+            return None
+        hostname, subdomain = parts[0], parts[1]
+        svc = self.get_service(namespace, subdomain)
+        if svc is None:
+            return None
+        for pod in self.pods.values():
+            if (
+                pod.metadata.namespace == namespace
+                and pod.spec.hostname == hostname
+                and pod.spec.subdomain == subdomain
+            ):
+                selector_ok = all(
+                    pod.labels.get(k) == v for k, v in svc.selector.items()
+                )
+                if not selector_ok:
+                    continue
+                if svc.publish_not_ready_addresses or pod.status.ready:
+                    return pod
+        return None
+
+    # ------------------------------------------------------------------
+    # Reconcile queue + tick loop
+    # ------------------------------------------------------------------
+
+    def enqueue_reconcile(self, namespace: str, name: str) -> None:
+        key = (namespace, name)
+        if key not in self._queued:
+            self._queued.add(key)
+            self.reconcile_queue.append(key)
+
+    def _drain_requeues(self) -> None:
+        now = self.clock.now()
+        due = [k for k, t in self.requeue_after.items() if t <= now]
+        for k in due:
+            del self.requeue_after[k]
+            self.enqueue_reconcile(*k)
+
+    def tick(self) -> bool:
+        """One control-plane pass; returns True if anything changed."""
+        changed = False
+        self._drain_requeues()
+
+        # 1. JobSet reconciler drains the work queue.
+        while self.reconcile_queue:
+            key = self.reconcile_queue.popleft()
+            self._queued.discard(key)
+            if self.jobset_reconciler is not None:
+                changed |= bool(self.jobset_reconciler.reconcile(*key))
+
+        # 2. Simulated Job controller creates pods / aggregates status.
+        if self.job_controller is not None:
+            changed |= self.job_controller.sync()
+
+        # 3. Scheduler binds pending pods.
+        if self.scheduler is not None:
+            changed |= self.scheduler.schedule_pending()
+
+        # 4. kubelet analog: bound pods become running/ready.
+        if self.auto_ready:
+            for pod in self.pods.values():
+                if pod.status.phase == POD_PENDING and pod.spec.node_name:
+                    pod.status.phase = POD_RUNNING
+                    pod.status.ready = True
+                    changed = True
+
+        # 5. Pod reconciler enforces exclusive-placement drift.
+        if self.pod_reconciler is not None:
+            changed |= self.pod_reconciler.sync()
+
+        return changed
+
+    def run_until_stable(self, max_ticks: int = 200) -> int:
+        """Tick until fixed point; returns number of ticks run."""
+        for i in range(max_ticks):
+            if not self.tick():
+                return i + 1
+        raise RuntimeError(f"cluster did not stabilize in {max_ticks} ticks")
+
+    # ------------------------------------------------------------------
+    # Drive helpers (envtest-style jobUpdateFn analogs)
+    # ------------------------------------------------------------------
+
+    def _finish_pods(self, job: Job, phase: str) -> None:
+        for pod in self.pods_for_job(job):
+            if pod.status.phase in (POD_PENDING, POD_RUNNING):
+                self._release_pod_placement(pod)
+                pod.status.phase = phase
+                pod.status.ready = False
+
+    def complete_job(self, namespace: str, name: str) -> None:
+        job = self.jobs[(namespace, name)]
+        completions = job.spec.completions if job.spec.completions is not None else (
+            job.spec.parallelism or 1
+        )
+        job.status.succeeded = completions
+        job.status.active = 0
+        job.status.ready = 0
+        job.status.completion_time = self.clock.now()
+        job.status.conditions.append(
+            Condition(
+                type="Complete",
+                status="True",
+                reason="Completed",
+                last_transition_time=self.clock.now(),
+            )
+        )
+        self._finish_pods(job, POD_SUCCEEDED)
+        self._enqueue_owner_of(job)
+
+    def complete_all_jobs(self, js: JobSet) -> None:
+        for job in self.jobs_for_jobset(js):
+            finished, _ = job.finished()
+            if not finished:
+                self.complete_job(job.metadata.namespace, job.metadata.name)
+
+    def fail_job(
+        self,
+        namespace: str,
+        name: str,
+        reason: str = keys.JOB_REASON_BACKOFF_LIMIT_EXCEEDED,
+        message: str = "simulated failure",
+    ) -> None:
+        job = self.jobs[(namespace, name)]
+        job.status.failed += 1
+        job.status.active = 0
+        job.status.ready = 0
+        job.status.conditions.append(
+            Condition(
+                type="Failed",
+                status="True",
+                reason=reason,
+                message=message,
+                last_transition_time=self.clock.now(),
+            )
+        )
+        self._finish_pods(job, POD_FAILED)
+        self._enqueue_owner_of(job)
+
+    def set_job_ready(self, namespace: str, name: str) -> None:
+        """Mark a job's pods Running+Ready (used with auto_ready=False); the
+        simulated Job controller then aggregates ready counts from pods."""
+        job = self.jobs[(namespace, name)]
+        for pod in self.pods_for_job(job):
+            if pod.status.phase == POD_PENDING:
+                pod.status.phase = POD_RUNNING
+            pod.status.ready = True
+        self._enqueue_owner_of(job)
+
+    def fail_node(self, node_name: str) -> list[str]:
+        """Node failure: running pods on the node fail; their jobs get a
+        Failed condition (BackoffLimitExceeded), kicking off gang recovery.
+        Returns the names of the failed jobs."""
+        failed_jobs: list[str] = []
+        for pod in list(self.pods.values()):
+            if pod.spec.node_name == node_name and pod.status.phase in (
+                POD_PENDING,
+                POD_RUNNING,
+            ):
+                job_key = self.jobs_by_uid.get(pod.metadata.owner_uid)
+                if job_key is not None:
+                    finished, _ = self.jobs[job_key].finished()
+                    if not finished:
+                        self.fail_job(*job_key)
+                        failed_jobs.append(job_key[1])
+        return failed_jobs
+
+    # ------------------------------------------------------------------
+    # Introspection helpers for tests
+    # ------------------------------------------------------------------
+
+    def jobset_condition(self, js: JobSet, cond_type: str) -> Optional[Condition]:
+        for c in js.status.conditions:
+            if c.type == cond_type:
+                return c
+        return None
+
+    def jobset_has_condition(
+        self, js: JobSet, cond_type: str, status: str = "True"
+    ) -> bool:
+        c = self.jobset_condition(js, cond_type)
+        return c is not None and c.status == status

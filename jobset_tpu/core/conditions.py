@@ -1,0 +1,165 @@
+"""JobSet status condition machinery.
+
+Mirrors the reference semantics (`jobset_controller.go:877-947`): a condition
+with the same type is updated in place only on a status flip; new conditions
+are appended only when True; mutually-exclusive condition pairs
+(StartupPolicyInProgress <-> StartupPolicyCompleted) demote each other; every
+accepted change enqueues an event that is recorded once the reconcile's
+status update lands.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..api import keys
+from ..api.types import Condition, JobSet
+from . import metrics
+
+
+@dataclass
+class ReconcileCtx:
+    """Per-reconcile accumulation of status changes + deferred events
+    (statusUpdateOpts analog, jobset_controller.go:77-87)."""
+
+    changed: bool = False
+    events: list[tuple[str, str, str]] = field(default_factory=list)  # (type, reason, msg)
+
+    def enqueue_event(self, etype: str, reason: str, message: str) -> None:
+        self.events.append((etype, reason, message))
+
+
+def _exclusive(c1: Condition, c2: Condition) -> bool:
+    pair = {c1.type, c2.type}
+    return pair == {
+        keys.JOBSET_STARTUP_POLICY_IN_PROGRESS,
+        keys.JOBSET_STARTUP_POLICY_COMPLETED,
+    }
+
+
+def update_condition(js: JobSet, new_cond: Condition) -> bool:
+    """Returns True iff the condition list actually changed."""
+    found = False
+    should_update = False
+    for i, curr in enumerate(js.status.conditions):
+        if new_cond.type == curr.type:
+            if new_cond.status != curr.status:
+                js.status.conditions[i] = new_cond
+                should_update = True
+            found = True
+        elif (
+            _exclusive(curr, new_cond)
+            and curr.status == "True"
+            and new_cond.status == "True"
+        ):
+            curr.status = "False"
+            should_update = True
+    if not found and new_cond.status == "True":
+        js.status.conditions.append(new_cond)
+        should_update = True
+    return should_update
+
+
+def set_condition(
+    js: JobSet, cond: Condition, etype: str, ctx: ReconcileCtx, now: float
+) -> None:
+    cond.last_transition_time = now
+    if not update_condition(js, cond):
+        return
+    ctx.changed = True
+    ctx.enqueue_event(etype, cond.reason, cond.message)
+
+
+def set_completed(js: JobSet, ctx: ReconcileCtx, now: float) -> None:
+    set_condition(
+        js,
+        Condition(
+            type=keys.JOBSET_COMPLETED,
+            status="True",
+            reason=keys.ALL_JOBS_COMPLETED_REASON,
+            message=keys.ALL_JOBS_COMPLETED_MESSAGE,
+        ),
+        keys.EVENT_NORMAL,
+        ctx,
+        now,
+    )
+    js.status.terminal_state = keys.JOBSET_COMPLETED
+    metrics.jobset_completed(f"{js.namespace}/{js.name}")
+
+
+def set_failed(js: JobSet, reason: str, message: str, ctx: ReconcileCtx, now: float) -> None:
+    set_condition(
+        js,
+        Condition(
+            type=keys.JOBSET_FAILED, status="True", reason=reason, message=message
+        ),
+        keys.EVENT_WARNING,
+        ctx,
+        now,
+    )
+    js.status.terminal_state = keys.JOBSET_FAILED
+    metrics.jobset_failed(f"{js.namespace}/{js.name}")
+
+
+def set_suspended(js: JobSet, ctx: ReconcileCtx, now: float) -> None:
+    set_condition(
+        js,
+        Condition(
+            type=keys.JOBSET_SUSPENDED,
+            status="True",
+            reason=keys.JOBSET_SUSPENDED_REASON,
+            message=keys.JOBSET_SUSPENDED_MESSAGE,
+        ),
+        keys.EVENT_NORMAL,
+        ctx,
+        now,
+    )
+
+
+def set_resumed(js: JobSet, ctx: ReconcileCtx, now: float) -> None:
+    set_condition(
+        js,
+        Condition(
+            type=keys.JOBSET_SUSPENDED,
+            status="False",
+            reason=keys.JOBSET_RESUMED_REASON,
+            message=keys.JOBSET_RESUMED_MESSAGE,
+        ),
+        keys.EVENT_NORMAL,
+        ctx,
+        now,
+    )
+
+
+def set_startup_in_progress(js: JobSet, ctx: ReconcileCtx, now: float) -> None:
+    set_condition(
+        js,
+        Condition(
+            type=keys.JOBSET_STARTUP_POLICY_IN_PROGRESS,
+            status="True",
+            reason=keys.IN_ORDER_STARTUP_POLICY_IN_PROGRESS_REASON,
+            message=keys.IN_ORDER_STARTUP_POLICY_IN_PROGRESS_MESSAGE,
+        ),
+        keys.EVENT_NORMAL,
+        ctx,
+        now,
+    )
+
+
+def set_startup_completed(js: JobSet, ctx: ReconcileCtx, now: float) -> None:
+    set_condition(
+        js,
+        Condition(
+            type=keys.JOBSET_STARTUP_POLICY_COMPLETED,
+            status="True",
+            reason=keys.IN_ORDER_STARTUP_POLICY_COMPLETED_REASON,
+            message=keys.IN_ORDER_STARTUP_POLICY_COMPLETED_MESSAGE,
+        ),
+        keys.EVENT_NORMAL,
+        ctx,
+        now,
+    )
+
+
+def jobset_finished(js: JobSet) -> bool:
+    return js.status.terminal_state in (keys.JOBSET_COMPLETED, keys.JOBSET_FAILED)

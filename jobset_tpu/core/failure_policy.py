@@ -1,0 +1,157 @@
+"""Failure policy engine (`pkg/controllers/failure_policy.go:40-312`).
+
+On any failed child job: evaluate ordered rules, each matching on
+(job failure reason, parent ReplicatedJob); the earliest-failing job matching
+the first applicable rule selects the action. No matching rule (or no policy)
+falls back to the default action — RestartJobSet without a policy means
+"fail the JobSet" (reference L48-57); with a policy, RestartJobSet bounded by
+MaxRestarts. A restart is just `status.restarts += 1`: the next reconcile
+pass classifies every current job as stale and recreates the gang.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..api import keys
+from ..api.types import FailurePolicyRule, JobSet
+from . import metrics
+from .child_jobs import ChildJobs
+from .conditions import ReconcileCtx, set_failed
+from .objects import Job
+
+DEFAULT_RULE_ACTION = keys.RESTART_JOBSET
+
+
+def _job_failure_condition(job: Job):
+    for c in job.status.conditions:
+        if c.type == keys.JOB_FAILED and c.status == "True":
+            return c
+    return None
+
+
+def find_first_failed_job(failed_jobs: list[Job]) -> Optional[Job]:
+    """Failed job with the oldest failure transition time (L292-307)."""
+    first, first_time = None, None
+    for job in failed_jobs:
+        cond = _job_failure_condition(job)
+        if cond is None:
+            continue
+        if first is None or cond.last_transition_time < first_time:
+            first, first_time = job, cond.last_transition_time
+    return first
+
+
+def _rule_applies(rule: FailurePolicyRule, job: Job, reason: str) -> bool:
+    if rule.on_job_failure_reasons and reason not in rule.on_job_failure_reasons:
+        return False
+    parent = job.labels.get(keys.REPLICATED_JOB_NAME_KEY)
+    if not parent:
+        return False
+    return not rule.target_replicated_jobs or parent in rule.target_replicated_jobs
+
+
+def find_first_failed_policy_rule_and_job(
+    rules: list[FailurePolicyRule], failed_jobs: list[Job]
+) -> tuple[Optional[FailurePolicyRule], Optional[Job]]:
+    """First rule (in order) with a matching failed job; among matches, the
+    earliest failure wins (L82-112)."""
+    for rule in rules:
+        matched, matched_time = None, None
+        for job in failed_jobs:
+            cond = _job_failure_condition(job)
+            if cond is None:
+                continue
+            earlier = matched is None or cond.last_transition_time < matched_time
+            if _rule_applies(rule, job, cond.reason) and earlier:
+                matched, matched_time = job, cond.last_transition_time
+        if matched is not None:
+            return rule, matched
+    return None, None
+
+
+def _message_with_first_failed_job(msg: str, job_name: str) -> str:
+    return f"{msg} (first failed job: {job_name})"
+
+
+def _recreate_all(
+    js: JobSet,
+    counts_towards_max: bool,
+    ctx: ReconcileCtx,
+    event_reason: str,
+    event_message: str,
+) -> None:
+    """Bump the restart counter; next pass recreates the gang (L155-175)."""
+    js.status.restarts += 1
+    if counts_towards_max:
+        js.status.restarts_count_towards_max += 1
+    metrics.jobset_restarts_total.inc(f"{js.namespace}/{js.name}")
+    ctx.changed = True
+    ctx.enqueue_event(keys.EVENT_WARNING, event_reason, event_message)
+
+
+def execute_failure_policy(
+    js: JobSet, owned: ChildJobs, ctx: ReconcileCtx, now: float
+) -> None:
+    policy = js.spec.failure_policy
+
+    if policy is None:
+        first = find_first_failed_job(owned.failed)
+        msg = _message_with_first_failed_job(
+            keys.FAILED_JOBS_MESSAGE, first.metadata.name if first else "<unknown>"
+        )
+        set_failed(js, keys.FAILED_JOBS_REASON, msg, ctx, now)
+        return
+
+    rule, matched_job = find_first_failed_policy_rule_and_job(
+        policy.rules, owned.failed
+    )
+    if rule is None:
+        action = DEFAULT_RULE_ACTION
+        matched_job = find_first_failed_job(owned.failed)
+    else:
+        action = rule.action
+
+    job_name = matched_job.metadata.name if matched_job else "<unknown>"
+
+    if action == keys.FAIL_JOBSET:
+        set_failed(
+            js,
+            keys.FAIL_JOBSET_ACTION_REASON,
+            _message_with_first_failed_job(keys.FAIL_JOBSET_ACTION_MESSAGE, job_name),
+            ctx,
+            now,
+        )
+    elif action == keys.RESTART_JOBSET:
+        if js.status.restarts_count_towards_max >= policy.max_restarts:
+            set_failed(
+                js,
+                keys.REACHED_MAX_RESTARTS_REASON,
+                _message_with_first_failed_job(
+                    keys.REACHED_MAX_RESTARTS_MESSAGE, job_name
+                ),
+                ctx,
+                now,
+            )
+        else:
+            _recreate_all(
+                js,
+                counts_towards_max=True,
+                ctx=ctx,
+                event_reason=keys.RESTART_JOBSET_ACTION_REASON,
+                event_message=_message_with_first_failed_job(
+                    keys.RESTART_JOBSET_ACTION_MESSAGE, job_name
+                ),
+            )
+    elif action == keys.RESTART_JOBSET_AND_IGNORE_MAX_RESTARTS:
+        _recreate_all(
+            js,
+            counts_towards_max=False,
+            ctx=ctx,
+            event_reason=keys.RESTART_JOBSET_AND_IGNORE_MAX_RESTARTS_ACTION_REASON,
+            event_message=_message_with_first_failed_job(
+                keys.RESTART_JOBSET_AND_IGNORE_MAX_RESTARTS_ACTION_MESSAGE, job_name
+            ),
+        )
+    else:
+        raise ValueError(f"unknown failure policy action: {action}")

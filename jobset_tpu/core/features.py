@@ -1,0 +1,55 @@
+"""Feature gates.
+
+Analog of `pkg/features/features.go:50-68` — a mutable gate registry wired to
+configuration. The reference's registry is empty; ours registers the first
+real gate: `TPUPlacementSolver`, which switches exclusive placement from the
+greedy per-pod path to the batched JAX linear-assignment solver
+(BASELINE.json north star: default path untouched, solver opt-in).
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+# Gate name -> default.
+_DEFAULTS: dict[str, bool] = {
+    # Batched linear-assignment placement solver on TPU (greedy is default).
+    "TPUPlacementSolver": False,
+}
+
+_gates: dict[str, bool] = dict(_DEFAULTS)
+
+
+def enabled(name: str) -> bool:
+    if name not in _gates:
+        raise KeyError(f"unknown feature gate: {name}")
+    return _gates[name]
+
+
+def set_gate(name: str, value: bool) -> None:
+    if name not in _gates:
+        raise KeyError(f"unknown feature gate: {name}")
+    _gates[name] = value
+
+
+def set_from_string(spec: str) -> None:
+    """Parse `Gate1=true,Gate2=false` (the --feature-gates flag format)."""
+    for part in filter(None, (p.strip() for p in spec.split(","))):
+        name, _, value = part.partition("=")
+        set_gate(name, value.lower() in ("true", "1", "yes"))
+
+
+def reset() -> None:
+    _gates.clear()
+    _gates.update(_DEFAULTS)
+
+
+@contextmanager
+def gate(name: str, value: bool):
+    """Test helper (features.go:54-68 analog): set a gate for a scope."""
+    old = enabled(name)
+    set_gate(name, value)
+    try:
+        yield
+    finally:
+        set_gate(name, old)

@@ -1,0 +1,143 @@
+"""Simulated batch Job controller.
+
+Stands in for the Kubernetes Job controller the reference delegates to
+(SURVEY.md §5 "failure detection"): creates one pod per completion index for
+Indexed jobs (hostname = `<job>-<podIdx>` so the JobSet DNS contract
+`<jobset>-<rjob>-<jobIdx>-<podIdx>.<subdomain>` holds), retries pod creation
+when the admission webhook rejects followers ("expected, transient error",
+pod_admission_webhook.go:65), deletes pods of suspended jobs, and aggregates
+pod phases into job status counts. Terminal Job conditions (Complete/Failed)
+are driven by the test/bench harness or the workload runtime, exactly like
+envtest-based reference integration tests drive them with jobUpdateFn.
+"""
+
+from __future__ import annotations
+
+from ..api import keys
+from ..api.types import ObjectMeta
+from .cluster import AdmissionError, Cluster
+from .objects import Job, POD_FAILED, POD_PENDING, POD_RUNNING, Pod
+
+
+class JobController:
+    def __init__(self, cluster: Cluster):
+        self.cluster = cluster
+        cluster.job_controller = self
+
+    def sync(self) -> bool:
+        changed = False
+        for job in list(self.cluster.jobs.values()):
+            finished, _ = job.finished()
+            if finished:
+                continue
+            if job.suspended():
+                changed |= self._sync_suspended(job)
+                continue
+            changed |= self._create_missing_pods(job)
+            changed |= self._aggregate_status(job)
+        return changed
+
+    # ------------------------------------------------------------------
+
+    def _sync_suspended(self, job: Job) -> bool:
+        """Suspended jobs have their active pods deleted (k8s semantics)."""
+        changed = False
+        for pod in self.cluster.pods_for_job(job):
+            if pod.status.phase in (POD_PENDING, POD_RUNNING):
+                self.cluster.delete_pod(pod.metadata.namespace, pod.metadata.name)
+                changed = True
+        if job.status.active != 0 or job.status.ready != 0:
+            job.status.active = 0
+            job.status.ready = 0
+            changed = True
+        return changed
+
+    def _desired_indexes(self, job: Job) -> int:
+        if job.spec.completion_mode == keys.COMPLETION_MODE_INDEXED:
+            completions = (
+                job.spec.completions
+                if job.spec.completions is not None
+                else (job.spec.parallelism or 1)
+            )
+            parallelism = (
+                job.spec.parallelism if job.spec.parallelism is not None else 1
+            )
+            return min(completions, parallelism) if parallelism else completions
+        return job.spec.parallelism or 1
+
+    def _create_missing_pods(self, job: Job) -> bool:
+        existing = {
+            pod.completion_index()
+            for pod in self.cluster.pods_for_job(job)
+            if pod.status.phase != POD_FAILED
+        }
+        desired = self._desired_indexes(job)
+        changed = False
+        # Leader (index 0) first: under exclusive placement follower admission
+        # is gated on the leader being scheduled, so creating in index order
+        # minimizes rejected attempts.
+        for idx in range(desired):
+            if idx in existing:
+                continue
+            pod = self._construct_pod(job, idx)
+            try:
+                self.cluster.create_pod(pod, job)
+                changed = True
+            except AdmissionError:
+                # Expected transient rejection (e.g. leader not scheduled yet);
+                # retried on the next sync pass.
+                continue
+        return changed
+
+    def _construct_pod(self, job: Job, index: int) -> Pod:
+        tmpl = job.spec.template
+        base = f"{job.metadata.name}-{index}"
+        pod = Pod(
+            metadata=ObjectMeta(
+                name=f"{base}-{self.cluster.pod_suffix()}",
+                namespace=job.metadata.namespace,
+                labels=dict(tmpl.labels),
+                annotations=dict(tmpl.annotations),
+            ),
+            spec=_clone_pod_spec(tmpl.spec),
+        )
+        pod.metadata.annotations[keys.POD_COMPLETION_INDEX_KEY] = str(index)
+        pod.metadata.labels[keys.POD_COMPLETION_INDEX_KEY] = str(index)
+        # The owner reference is set before admission webhooks ever see the
+        # pod (the same-owner-UID guard depends on this).
+        pod.metadata.owner_uid = job.metadata.uid
+        # k8s sets hostname to `<job>-<idx>` for Indexed jobs with a service.
+        pod.spec.hostname = base
+        return pod
+
+    def _aggregate_status(self, job: Job) -> bool:
+        active = ready = succeeded = failed = 0
+        for pod in self.cluster.pods_for_job(job):
+            if pod.status.phase in (POD_PENDING, POD_RUNNING):
+                active += 1
+                if pod.status.ready:
+                    ready += 1
+            elif pod.status.phase == "Succeeded":
+                succeeded += 1
+            elif pod.status.phase == POD_FAILED:
+                failed += 1
+        new = (active, ready, succeeded, failed)
+        old = (job.status.active, job.status.ready, job.status.succeeded, job.status.failed)
+        if new != old:
+            (
+                job.status.active,
+                job.status.ready,
+                job.status.succeeded,
+                job.status.failed,
+            ) = new
+            if job.status.start_time is None and active:
+                job.status.start_time = self.cluster.clock.now()
+            self.cluster._enqueue_owner_of(job)
+            return True
+        return False
+
+
+def _clone_pod_spec(spec):
+    import copy
+
+    return copy.deepcopy(spec)

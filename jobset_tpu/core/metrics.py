@@ -1,0 +1,102 @@
+"""Metrics registry.
+
+Analog of `pkg/metrics/metrics.go:26-61` (jobset_completed_total /
+jobset_failed_total counters labeled by jobset) plus reconcile-latency
+histograms, which the reference inherits from controller-runtime
+(`site/content/en/docs/reference/metrics.md:20-25`) and the solver-side
+latency metrics that are new in this build.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from collections import defaultdict
+
+
+class Counter:
+    def __init__(self, name: str, help_text: str = ""):
+        self.name = name
+        self.help = help_text
+        self._values: dict[tuple, float] = defaultdict(float)
+        self._lock = threading.Lock()
+
+    def inc(self, *labels, amount: float = 1.0) -> None:
+        with self._lock:
+            self._values[labels] += amount
+
+    def value(self, *labels) -> float:
+        return self._values.get(labels, 0.0)
+
+    def total(self) -> float:
+        return sum(self._values.values())
+
+
+class Histogram:
+    """Fixed-bucket latency histogram (seconds), exp buckets 1ms..~64s."""
+
+    def __init__(self, name: str, help_text: str = "", num_buckets: int = 17):
+        self.name = name
+        self.help = help_text
+        self.buckets = [0.001 * (2**i) for i in range(num_buckets)]
+        self.counts = [0] * (num_buckets + 1)
+        self.sum = 0.0
+        self.n = 0
+
+    def observe(self, seconds: float) -> None:
+        self.sum += seconds
+        self.n += 1
+        for i, b in enumerate(self.buckets):
+            if seconds <= b:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+    def percentile(self, q: float) -> float:
+        """Approximate percentile from bucket counts (upper bucket bound),
+        the way Prometheus histogram_quantile works — bounded memory."""
+        if self.n == 0:
+            return math.nan
+        target = q * self.n
+        cumulative = 0
+        for i, count in enumerate(self.counts):
+            cumulative += count
+            if cumulative >= target:
+                return self.buckets[i] if i < len(self.buckets) else math.inf
+        return math.inf
+
+
+# Registry (one per process, like the controller-runtime registry).
+jobset_completed_total = Counter(
+    "jobset_completed_total", "Number of JobSets completed, per jobset"
+)
+jobset_failed_total = Counter(
+    "jobset_failed_total", "Number of JobSets failed, per jobset"
+)
+jobset_restarts_total = Counter(
+    "jobset_restarts_total", "Number of JobSet gang restarts, per jobset"
+)
+reconcile_time_seconds = Histogram(
+    "jobset_reconcile_time_seconds", "Reconcile latency"
+)
+solver_solve_time_seconds = Histogram(
+    "jobset_placement_solve_time_seconds", "Placement solver latency"
+)
+
+
+def jobset_completed(namespaced_name: str) -> None:
+    jobset_completed_total.inc(namespaced_name)
+
+
+def jobset_failed(namespaced_name: str) -> None:
+    jobset_failed_total.inc(namespaced_name)
+
+
+def reset() -> None:
+    """Test helper: clear all metric state."""
+    for counter in (jobset_completed_total, jobset_failed_total, jobset_restarts_total):
+        counter._values.clear()
+    for hist in (reconcile_time_seconds, solver_solve_time_seconds):
+        hist.counts = [0] * len(hist.counts)
+        hist.sum = 0.0
+        hist.n = 0

@@ -1,0 +1,156 @@
+"""Runtime cluster objects: Node, Pod, Job, Service.
+
+These are the simulated analogs of corev1.Node/Pod/Service and batchv1.Job —
+just enough state for the control plane's observable behavior: jobs aggregate
+pod counts and carry terminal conditions; pods carry identity labels, a bound
+node, a phase and conditions; nodes carry topology labels, taints and a pod
+capacity.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..api.types import Condition, JobSpec, ObjectMeta, PodSpec, Taint
+
+# Pod phases.
+POD_PENDING = "Pending"
+POD_RUNNING = "Running"
+POD_SUCCEEDED = "Succeeded"
+POD_FAILED = "Failed"
+
+
+@dataclass
+class Node:
+    name: str
+    labels: dict[str, str] = field(default_factory=dict)
+    taints: list[Taint] = field(default_factory=list)
+    capacity: int = 110  # default kubelet max pods per node
+
+    # Scheduler bookkeeping (not part of the "API surface").
+    allocated: int = 0
+
+    @property
+    def free(self) -> int:
+        return self.capacity - self.allocated
+
+
+@dataclass
+class PodStatus:
+    phase: str = POD_PENDING
+    ready: bool = False
+    conditions: list[Condition] = field(default_factory=list)
+
+
+@dataclass
+class Pod:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+    status: PodStatus = field(default_factory=PodStatus)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+    @property
+    def labels(self) -> dict:
+        return self.metadata.labels
+
+    @property
+    def annotations(self) -> dict:
+        return self.metadata.annotations
+
+    @property
+    def node_name(self) -> str:
+        return self.spec.node_name
+
+    def completion_index(self) -> Optional[int]:
+        idx = self.metadata.annotations.get(
+            "batch.kubernetes.io/job-completion-index"
+        )
+        return int(idx) if idx is not None else None
+
+
+@dataclass
+class JobStatus:
+    active: int = 0
+    ready: int = 0
+    succeeded: int = 0
+    failed: int = 0
+    start_time: Optional[float] = None
+    completion_time: Optional[float] = None
+    conditions: list[Condition] = field(default_factory=list)
+
+
+@dataclass
+class Job:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: JobSpec = field(default_factory=JobSpec)
+    status: JobStatus = field(default_factory=JobStatus)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+    @property
+    def labels(self) -> dict:
+        return self.metadata.labels
+
+    @property
+    def annotations(self) -> dict:
+        return self.metadata.annotations
+
+    def finished(self) -> tuple[bool, str]:
+        """Terminal condition check (jobset_controller.go:772-779 analog)."""
+        for c in self.status.conditions:
+            if c.type in ("Complete", "Failed") and c.status == "True":
+                return True, c.type
+        return False, ""
+
+    def suspended(self) -> bool:
+        return bool(self.spec.suspend)
+
+    def pods_expected(self) -> int:
+        """min(parallelism, completions): total expected pod count used by the
+        ready math (jobset_controller.go:340-350)."""
+        parallelism = self.spec.parallelism if self.spec.parallelism is not None else 1
+        if self.spec.completions is not None and self.spec.completions < parallelism:
+            return self.spec.completions
+        return parallelism
+
+
+@dataclass
+class Service:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    cluster_ip: str = "None"  # headless
+    selector: dict[str, str] = field(default_factory=dict)
+    publish_not_ready_addresses: bool = True
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+
+@dataclass
+class Event:
+    """Recorded cluster event (k8s Event analog)."""
+
+    object_kind: str
+    object_name: str
+    type: str  # Normal | Warning
+    reason: str
+    message: str
+    time: float = 0.0

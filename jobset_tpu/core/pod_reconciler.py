@@ -1,0 +1,96 @@
+"""Exclusive-placement drift enforcement (`pkg/controllers/pod_controller.go`).
+
+Watches scheduled leader pods of exclusive-placement JobSets (event filter at
+pod_controller.go:63-73). For each, verifies every follower's nodeSelector
+targets the leader's topology domain; on mismatch, stamps the
+`DisruptionTarget` condition (so pod failure policies can ignore
+controller-initiated deletions) and deletes the followers so they reschedule
+next to the leader.
+"""
+
+from __future__ import annotations
+
+from ..api import keys
+from ..api.types import Condition
+from ..placement.naming import is_leader_pod
+from .cluster import Cluster
+from .objects import Pod
+
+
+class PodReconciler:
+    def __init__(self, cluster: Cluster):
+        self.cluster = cluster
+        cluster.pod_reconciler = self
+
+    def _watched(self, pod: Pod) -> bool:
+        """Event-filter analog: scheduled leader pods of exclusive JobSets
+        not using the nodeSelector strategy."""
+        return (
+            is_leader_pod(pod)
+            and keys.EXCLUSIVE_KEY in pod.annotations
+            and keys.NODE_SELECTOR_STRATEGY_KEY not in pod.annotations
+            and bool(pod.spec.node_name)
+        )
+
+    def sync(self) -> bool:
+        changed = False
+        for pod in list(self.cluster.pods.values()):
+            if self._watched(pod):
+                changed |= self.reconcile_leader(pod)
+        return changed
+
+    def reconcile_leader(self, leader: Pod) -> bool:
+        cluster = self.cluster
+        topology_key = leader.annotations[keys.EXCLUSIVE_KEY]
+        node = cluster.nodes.get(leader.spec.node_name)
+        if node is None:
+            return False
+        leader_topology = node.labels.get(topology_key)
+        if leader_topology is None:
+            return False
+
+        job_key = leader.labels.get(keys.JOB_KEY)
+        if not job_key:
+            return False
+        pods = cluster.pods_for_job_key(leader.metadata.namespace, job_key)
+
+        if self._placements_valid(pods, topology_key, leader_topology):
+            return False
+        return self._delete_follower_pods(pods)
+
+    @staticmethod
+    def _placements_valid(
+        pods: list[Pod], topology_key: str, leader_topology: str
+    ) -> bool:
+        """validatePodPlacements analog (pod_controller.go:172-194)."""
+        for pod in pods:
+            if is_leader_pod(pod):
+                continue
+            if pod.spec.node_selector.get(topology_key) != leader_topology:
+                return False
+        return True
+
+    def _delete_follower_pods(self, pods: list[Pod]) -> bool:
+        changed = False
+        for pod in pods:
+            if is_leader_pod(pod):
+                continue
+            pod.status.conditions.append(
+                Condition(
+                    type=keys.POD_CONDITION_DISRUPTION_TARGET,
+                    status="True",
+                    reason=keys.EXCLUSIVE_PLACEMENT_VIOLATION_REASON,
+                    message=keys.EXCLUSIVE_PLACEMENT_VIOLATION_MESSAGE,
+                    last_transition_time=self.cluster.clock.now(),
+                )
+            )
+            self.cluster.record_event(
+                "Pod",
+                pod.metadata.name,
+                keys.EVENT_WARNING,
+                keys.EXCLUSIVE_PLACEMENT_VIOLATION_REASON,
+                keys.EXCLUSIVE_PLACEMENT_VIOLATION_MESSAGE,
+            )
+            self.cluster.delete_pod(pod.metadata.namespace, pod.metadata.name)
+            changed = True
+        return changed

@@ -1,0 +1,148 @@
+"""Topology-aware pod scheduler for the simulated cluster.
+
+Models the slice of kube-scheduler behavior the exclusive-placement feature
+depends on (SURVEY.md §3.4): nodeSelector matching, taints/tolerations, pod
+capacity, and the *symmetric* required pod (anti-)affinity over the
+`jobset.sigs.k8s.io/job-key` label with a configurable topology key — i.e.
+"one child job per topology domain".  Domain occupancy is tracked
+incrementally (`Cluster.domain_job_keys`) so leader admission is O(free
+domains) instead of O(nodes x pods), which is what makes the 15k-node bench
+tractable; the same occupancy structures feed the solver's cost matrix.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..api import keys
+from .cluster import Cluster
+from .objects import Node, POD_PENDING, Pod
+
+
+class Scheduler:
+    def __init__(self, cluster: Cluster):
+        self.cluster = cluster
+        cluster.scheduler = self
+
+    # ------------------------------------------------------------------
+
+    def schedule_pending(self) -> bool:
+        changed = False
+        for pod in list(self.cluster.pods.values()):
+            if pod.status.phase != POD_PENDING or pod.spec.node_name:
+                continue
+            if pod.spec.scheduling_gates:
+                continue
+            node = self.find_node(pod)
+            if node is not None:
+                self.cluster.bind_pod(pod, node)
+                changed = True
+        return changed
+
+    # ------------------------------------------------------------------
+
+    def _tolerates(self, pod: Pod, node: Node) -> bool:
+        for taint in node.taints:
+            if taint.effect != "NoSchedule":
+                continue
+            if not any(t.matches_taint(taint) for t in pod.spec.tolerations):
+                return False
+        return True
+
+    def _node_fits(self, pod: Pod, node: Node) -> bool:
+        if node.free <= 0:
+            return False
+        for k, v in pod.spec.node_selector.items():
+            if node.labels.get(k) != v:
+                return False
+        return self._tolerates(pod, node)
+
+    def find_node(self, pod: Pod) -> Optional[Node]:
+        affinity = pod.spec.affinity
+        topology_key = pod.annotations.get(keys.EXCLUSIVE_KEY)
+        job_key = pod.labels.get(keys.JOB_KEY)
+
+        if affinity and (affinity.pod_affinity or affinity.pod_anti_affinity):
+            return self._find_node_with_affinity(pod)
+
+        # Symmetric anti-affinity: even without own affinity terms, a pod of
+        # an exclusive-placement JobSet may not land in a domain already owned
+        # by a *different* job's key, because that job's leader carries a
+        # required anti-affinity term against other job keys and required
+        # anti-affinity is enforced symmetrically by kube-scheduler.
+        if topology_key and job_key:
+            return self._find_node_in_allowed_domain(pod, topology_key, job_key)
+
+        # Plain pod: first fitting node, deterministic order.
+        for node in self.cluster.nodes.values():
+            if self._node_fits(pod, node):
+                return node
+        return None
+
+    # ------------------------------------------------------------------
+
+    def _find_node_in_allowed_domain(
+        self, pod: Pod, topology_key: str, job_key: str
+    ) -> Optional[Node]:
+        """Follower path: nodeSelector pins the domain; verify ownership."""
+        occupancy = self.cluster.domain_job_keys.get(topology_key, {})
+        selector_value = pod.spec.node_selector.get(topology_key)
+        if selector_value is not None:
+            owners = occupancy.get(selector_value, set())
+            if owners - {job_key}:
+                return None  # domain exclusively owned by another job
+            for node_name in self.cluster.domain_nodes(topology_key).get(
+                selector_value, ()
+            ):
+                node = self.cluster.nodes[node_name]
+                if self._node_fits(pod, node):
+                    return node
+            return None
+        # No domain pinned (e.g. nodeSelector-strategy pods select on the
+        # node label instead): fall back to a scan that still respects
+        # domain ownership.
+        for node in self.cluster.nodes.values():
+            if not self._node_fits(pod, node):
+                continue
+            value = node.labels.get(topology_key)
+            if value is not None and occupancy.get(value, set()) - {job_key}:
+                continue
+            return node
+        return None
+
+    def _find_node_with_affinity(self, pod: Pod) -> Optional[Node]:
+        """Leader path: required affinity to own job-key + anti-affinity to
+        any other job-key, over the term's topology key
+        (pod_mutating_webhook.go:95-135)."""
+        affinity = pod.spec.affinity
+        assert affinity is not None
+        job_key = pod.labels.get(keys.JOB_KEY, "")
+
+        # All injected terms share one topology key; take it from any term.
+        terms = list(affinity.pod_affinity) + list(affinity.pod_anti_affinity)
+        topology_key = terms[0].topology_key if terms else None
+        if topology_key is None:
+            return None
+
+        occupancy = self.cluster.domain_job_keys.get(topology_key, {})
+        domains = self.cluster.domain_nodes(topology_key)
+
+        # Affinity: if pods with our job key are already bound somewhere, we
+        # must join their domain; anti-affinity: domain must hold no other keys.
+        own_domains = [v for v, ks in occupancy.items() if job_key in ks]
+        if own_domains:
+            candidate_values = own_domains
+        else:
+            candidate_values = sorted(
+                v for v in domains if not occupancy.get(v)
+            )
+
+        for value in candidate_values:
+            owners = occupancy.get(value, set())
+            if owners - {job_key}:
+                continue
+            for node_name in domains.get(value, ()):
+                node = self.cluster.nodes[node_name]
+                if self._node_fits(pod, node):
+                    return node
+        return None

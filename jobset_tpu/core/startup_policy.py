@@ -1,0 +1,26 @@
+"""Startup policy helpers (`pkg/controllers/startup_policy.go:27-64`)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..api import keys
+from ..api.types import JobSet, ReplicatedJobStatus
+
+
+def in_order_startup_policy(js: JobSet) -> bool:
+    policy = js.spec.startup_policy
+    return (
+        policy is not None
+        and policy.startup_policy_order == keys.STARTUP_IN_ORDER
+    )
+
+
+def all_replicas_started(
+    replicas: int, status: Optional[ReplicatedJobStatus]
+) -> bool:
+    """A ReplicatedJob counts as started when every replica is ready or
+    already terminal (startup_policy.go:27-29)."""
+    if status is None:
+        return False
+    return status.ready + status.failed + status.succeeded >= replicas

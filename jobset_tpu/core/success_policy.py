@@ -1,0 +1,44 @@
+"""Success policy engine (`pkg/controllers/success_policy.go:26-64`,
+`jobset_controller.go:630-636`): JobSet completes when the number of
+succeeded jobs matching the policy reaches the expected count — 1 for
+operator Any, the sum of targeted replicas for All.
+"""
+
+from __future__ import annotations
+
+from ..api import keys
+from ..api.types import JobSet
+from .child_jobs import ChildJobs
+from .conditions import ReconcileCtx, set_completed
+from .objects import Job
+
+
+def _job_matches(js: JobSet, job: Job) -> bool:
+    targets = js.spec.success_policy.target_replicated_jobs
+    return not targets or job.labels.get(keys.REPLICATED_JOB_NAME_KEY) in targets
+
+
+def num_jobs_matching(js: JobSet, jobs: list[Job]) -> int:
+    return sum(1 for job in jobs if _job_matches(js, job))
+
+
+def num_jobs_expected_to_succeed(js: JobSet) -> int:
+    policy = js.spec.success_policy
+    if policy.operator == keys.OPERATOR_ANY:
+        return 1
+    total = 0
+    targets = policy.target_replicated_jobs
+    for rjob in js.spec.replicated_jobs:
+        if not targets or rjob.name in targets:
+            total += int(rjob.replicas)
+    return total
+
+
+def execute_success_policy(
+    js: JobSet, owned: ChildJobs, ctx: ReconcileCtx, now: float
+) -> bool:
+    """Returns True if the JobSet was marked completed."""
+    if num_jobs_matching(js, owned.successful) >= num_jobs_expected_to_succeed(js):
+        set_completed(js, ctx, now)
+        return True
+    return False

@@ -1,0 +1,34 @@
+"""TTL-after-finished policy (`pkg/controllers/ttl_after_finished.go:22-134`):
+once a JobSet is terminal, requeue until finishTime + TTL, then delete it.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..api import keys
+from ..api.types import JobSet
+
+
+def jobset_finish_time(js: JobSet) -> Optional[float]:
+    for c in js.status.conditions:
+        if c.type in (keys.JOBSET_COMPLETED, keys.JOBSET_FAILED) and c.status == "True":
+            return c.last_transition_time
+    return None
+
+
+def execute_ttl_after_finished(cluster, js: JobSet) -> float:
+    """Returns seconds until requeue (0 = nothing to do). Deletes the JobSet
+    when the TTL has expired."""
+    ttl = js.spec.ttl_seconds_after_finished
+    if ttl is None or js.metadata.deletion_time is not None:
+        return 0.0
+    finish = jobset_finish_time(js)
+    if finish is None:
+        return 0.0
+    now = cluster.clock.now()
+    remaining = finish + float(ttl) - now
+    if remaining <= 0:
+        cluster.delete_jobset(js.namespace, js.name)
+        return 0.0
+    return remaining

@@ -1,0 +1,71 @@
+"""Pluggable placement providers.
+
+The reconcile core calls `provider.assign(cluster, js, jobs)` just before
+creating a batch of child jobs.  The default `GreedyPlacement` does nothing —
+placement then happens through the per-pod webhook cascade exactly like the
+reference (§3.4).  `SolverPlacement` (behind the `TPUPlacementSolver` feature
+gate) solves the whole job -> topology-domain assignment as one batched
+linear-assignment problem on TPU and stamps the resulting nodeSelector plan
+onto each job's pod template, so pods skip the webhook path entirely and the
+scheduler does O(1) work per pod — this is the BASELINE.json north star.
+"""
+
+from __future__ import annotations
+
+from ..api import keys
+from ..core import features
+from .webhooks import PLAN_ANNOTATION
+
+
+class GreedyPlacement:
+    """Default: defer to the webhook + kube-scheduler-style greedy path."""
+
+    def assign(self, cluster, js, jobs) -> None:
+        return None
+
+
+class SolverPlacement:
+    """Batched linear-assignment placement on TPU (feature-gated).
+
+    Falls back to greedy behavior when the gate is off or the JobSet doesn't
+    use exclusive placement.
+    """
+
+    def __init__(self, solver=None):
+        # Lazy import so the control plane doesn't pull in jax unless used.
+        self._solver = solver
+
+    def _get_solver(self):
+        if self._solver is None:
+            from .solver import AssignmentSolver
+
+            self._solver = AssignmentSolver()
+        return self._solver
+
+    def assign(self, cluster, js, jobs) -> None:
+        if not features.enabled("TPUPlacementSolver"):
+            return
+        topology_key = js.metadata.annotations.get(keys.EXCLUSIVE_KEY)
+        if topology_key is None or not jobs:
+            return
+        if keys.NODE_SELECTOR_STRATEGY_KEY in js.metadata.annotations:
+            return
+
+        from .plans import build_plan
+
+        plan = build_plan(cluster, js, jobs, topology_key, self._get_solver())
+        if plan is None:
+            return
+        for job in jobs:
+            domain = plan.get(job.metadata.name)
+            if domain is None:
+                continue  # infeasible for this job; fall through to greedy
+            job.spec.template.spec.node_selector[topology_key] = domain
+            job.spec.template.annotations[PLAN_ANNOTATION] = domain
+            job.metadata.annotations[PLAN_ANNOTATION] = domain
+            # Reserve the domain NOW so later solves in the same reconcile
+            # pass (other ReplicatedJobs, other JobSets this tick) see it as
+            # occupied; released on job deletion or with the last bound pod.
+            cluster.claim_domain(
+                topology_key, domain, job.labels.get(keys.JOB_KEY, "")
+            )

@@ -1,0 +1,145 @@
+"""Pod-level exclusive-placement webhooks — the greedy baseline path.
+
+Mirrors SURVEY.md §3.4 / `pkg/webhooks/pod_mutating_webhook.go` +
+`pod_admission_webhook.go`:
+
+* mutate: leader pods (completion index 0) get required pod affinity on
+  their own job-key + anti-affinity against any other job-key over the
+  exclusive-topology key; follower pods get a nodeSelector copied from the
+  topology domain their (scheduled) leader landed on.
+* validate: follower creation is rejected until the leader exists, is
+  scheduled, and shares the same owning Job UID (the stale-index guard after
+  gang restarts, pod_admission_webhook.go:111-123).
+
+Jobs whose placement was precomputed by the solver plan
+(`PLAN_ANNOTATION`) or that use the nodeSelector strategy skip both hooks.
+"""
+
+from __future__ import annotations
+
+from ..api import keys
+from ..api.types import Affinity, AffinityTerm
+from ..core.cluster import AdmissionError
+from .naming import is_leader_pod, leader_pod_name_for
+
+# Annotation stamped by a PlacementProvider when it has already pinned the
+# pod's topology domain via nodeSelector; webhooks then have nothing to do.
+PLAN_ANNOTATION = keys.PLACEMENT_PLAN_KEY
+
+
+class PodAdmissionError(AdmissionError):
+    """Transient, expected rejection — the Job controller retries."""
+
+
+def _skip(pod) -> bool:
+    if keys.EXCLUSIVE_KEY not in pod.annotations:
+        return True
+    if keys.NODE_SELECTOR_STRATEGY_KEY in pod.annotations:
+        return True
+    if PLAN_ANNOTATION in pod.annotations:
+        return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Mutating webhook (pod_mutating_webhook.go:64-171)
+# ---------------------------------------------------------------------------
+
+
+def mutate_pod(cluster, pod) -> None:
+    if _skip(pod):
+        return
+    if is_leader_pod(pod):
+        set_exclusive_affinities(pod)
+    else:
+        set_follower_node_selector(cluster, pod)
+
+
+def set_exclusive_affinities(pod) -> None:
+    topology_key = pod.annotations[keys.EXCLUSIVE_KEY]
+    job_key = pod.labels.get(keys.JOB_KEY, "")
+    if pod.spec.affinity is None:
+        pod.spec.affinity = Affinity()
+    pod.spec.affinity.pod_affinity.append(
+        AffinityTerm(topology_key=topology_key, job_key_in=[job_key])
+    )
+    pod.spec.affinity.pod_anti_affinity.append(
+        AffinityTerm(
+            topology_key=topology_key,
+            job_key_exists=True,
+            job_key_not_in=[job_key],
+        )
+    )
+
+
+def set_follower_node_selector(cluster, pod) -> None:
+    """Inject nodeSelector[topologyKey] = leader's topology; silently a no-op
+    when the leader isn't ready yet (validation rejects the pod instead,
+    pod_mutating_webhook.go:145-155)."""
+    leader = _leader_pod_for_follower(cluster, pod)
+    if leader is None or not leader.spec.node_name:
+        return
+    topology_key = pod.annotations[keys.EXCLUSIVE_KEY]
+    node = cluster.nodes.get(leader.spec.node_name)
+    if node is None:
+        return
+    topology_value = node.labels.get(topology_key)
+    if topology_value is None:
+        return
+    pod.spec.node_selector[topology_key] = topology_value
+
+
+# ---------------------------------------------------------------------------
+# Validating webhook (pod_admission_webhook.go:24-68)
+# ---------------------------------------------------------------------------
+
+
+def validate_pod_create(cluster, pod) -> None:
+    if keys.JOBSET_NAME_KEY not in pod.annotations:
+        return
+    if keys.NODE_SELECTOR_STRATEGY_KEY in pod.annotations:
+        return
+    if PLAN_ANNOTATION in pod.annotations:
+        return
+    topology_key = pod.annotations.get(keys.EXCLUSIVE_KEY)
+    if topology_key is None:
+        return
+    if is_leader_pod(pod):
+        return
+
+    if topology_key not in pod.spec.node_selector:
+        raise PodAdmissionError(
+            f"follower pod node selector for topology domain not found. "
+            f"missing selector: {topology_key}"
+        )
+    leader = _leader_pod_for_follower(cluster, pod, raise_on_error=True)
+    if not leader.spec.node_name:
+        raise PodAdmissionError(
+            "leader pod not yet scheduled, not creating follower pod. "
+            "this is an expected, transient error"
+        )
+
+
+def _leader_pod_for_follower(cluster, pod, raise_on_error: bool = False):
+    """Leader lookup via the base-name index with the same-owner UID guard
+    (pod_admission_webhook.go:91-124)."""
+    leader_name = leader_pod_name_for(pod)
+    candidates = cluster.pods_with_base_name(pod.metadata.namespace, leader_name)
+    if len(candidates) != 1:
+        if raise_on_error:
+            raise PodAdmissionError(
+                f"expected 1 leader pod ({leader_name}), but got "
+                f"{len(candidates)}. this is an expected, transient error"
+            )
+        return None
+    leader = candidates[0]
+    # Same-owner-UID guard: after a gang restart the index may still hold the
+    # previous run's leader; injecting its topology would be stale.
+    if leader.metadata.owner_uid != pod.metadata.owner_uid:
+        if raise_on_error:
+            raise PodAdmissionError(
+                f"follower pod owner UID ({pod.metadata.owner_uid}) != "
+                f"leader pod owner UID ({leader.metadata.owner_uid})"
+            )
+        return None
+    return leader

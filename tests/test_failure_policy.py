@@ -1,0 +1,181 @@
+"""Failure-policy engine tests (parity with
+pkg/controllers/failure_policy_test.go:80-361: rule matching, ordering,
+max-restarts accounting, restart bucketing)."""
+
+import pytest
+
+from jobset_tpu.api import FailurePolicy, FailurePolicyRule, keys
+from jobset_tpu.core import make_cluster, metrics
+from jobset_tpu.testing import make_jobset, make_replicated_job
+
+
+@pytest.fixture(autouse=True)
+def _reset_metrics():
+    metrics.reset()
+    yield
+
+
+def build(failure_policy, rjobs=("a", "b")):
+    cluster = make_cluster()
+    cluster.add_topology("rack", num_domains=8, nodes_per_domain=4, capacity=16)
+    wrapper = make_jobset("js").failure_policy(failure_policy)
+    for name in rjobs:
+        wrapper = wrapper.replicated_job(
+            make_replicated_job(name).replicas(2).parallelism(1).completions(1).obj()
+        )
+    js = cluster.create_jobset(wrapper.obj())
+    cluster.run_until_stable()
+    return cluster, js
+
+
+def test_restart_recreates_gang_and_bumps_counter():
+    cluster, js = build(FailurePolicy(max_restarts=3))
+    old_uids = {j.metadata.uid for j in cluster.jobs.values()}
+    cluster.fail_job("default", "js-a-0")
+    cluster.run_until_stable()
+    assert js.status.restarts == 1
+    assert js.status.restarts_count_towards_max == 1
+    new_jobs = list(cluster.jobs.values())
+    assert len(new_jobs) == 4
+    assert all(j.labels[keys.RESTARTS_KEY] == "1" for j in new_jobs)
+    assert {j.metadata.uid for j in new_jobs}.isdisjoint(old_uids)
+    assert js.status.terminal_state == ""
+    assert metrics.jobset_restarts_total.value("default/js") == 1
+
+
+def test_max_restarts_exhaustion_fails_jobset():
+    cluster, js = build(FailurePolicy(max_restarts=1))
+    cluster.fail_job("default", "js-a-0")
+    cluster.run_until_stable()
+    assert js.status.restarts == 1
+    cluster.fail_job("default", "js-b-1")
+    cluster.run_until_stable()
+    assert js.status.terminal_state == keys.JOBSET_FAILED
+    cond = cluster.jobset_condition(js, keys.JOBSET_FAILED)
+    assert cond.reason == keys.REACHED_MAX_RESTARTS_REASON
+
+
+def test_fail_jobset_action_fails_immediately():
+    policy = FailurePolicy(
+        max_restarts=5,
+        rules=[FailurePolicyRule(name="r0", action=keys.FAIL_JOBSET)],
+    )
+    cluster, js = build(policy)
+    cluster.fail_job("default", "js-b-0")
+    cluster.run_until_stable()
+    assert js.status.terminal_state == keys.JOBSET_FAILED
+    cond = cluster.jobset_condition(js, keys.JOBSET_FAILED)
+    assert cond.reason == keys.FAIL_JOBSET_ACTION_REASON
+    assert "js-b-0" in cond.message
+    assert js.status.restarts == 0
+
+
+def test_ignore_max_restarts_action():
+    policy = FailurePolicy(
+        max_restarts=1,
+        rules=[
+            FailurePolicyRule(
+                name="host",
+                action=keys.RESTART_JOBSET_AND_IGNORE_MAX_RESTARTS,
+                on_job_failure_reasons=[keys.JOB_REASON_BACKOFF_LIMIT_EXCEEDED],
+            )
+        ],
+    )
+    cluster, js = build(policy)
+    for _ in range(3):
+        cluster.fail_job(
+            "default", "js-a-0", reason=keys.JOB_REASON_BACKOFF_LIMIT_EXCEEDED
+        )
+        cluster.run_until_stable()
+    assert js.status.restarts == 3
+    assert js.status.restarts_count_towards_max == 0
+    assert js.status.terminal_state == ""
+
+
+def test_rule_matching_on_failure_reason():
+    policy = FailurePolicy(
+        max_restarts=2,
+        rules=[
+            FailurePolicyRule(
+                name="deadline",
+                action=keys.FAIL_JOBSET,
+                on_job_failure_reasons=[keys.JOB_REASON_DEADLINE_EXCEEDED],
+            ),
+        ],
+    )
+    cluster, js = build(policy)
+    # BackoffLimitExceeded does not match the rule -> default RestartJobSet.
+    cluster.fail_job("default", "js-a-0", reason=keys.JOB_REASON_BACKOFF_LIMIT_EXCEEDED)
+    cluster.run_until_stable()
+    assert js.status.restarts == 1 and js.status.terminal_state == ""
+    # DeadlineExceeded matches -> FailJobSet.
+    cluster.fail_job("default", "js-a-1", reason=keys.JOB_REASON_DEADLINE_EXCEEDED)
+    cluster.run_until_stable()
+    assert js.status.terminal_state == keys.JOBSET_FAILED
+
+
+def test_rule_matching_on_target_replicated_job():
+    policy = FailurePolicy(
+        max_restarts=2,
+        rules=[
+            FailurePolicyRule(
+                name="only_b",
+                action=keys.FAIL_JOBSET,
+                target_replicated_jobs=["b"],
+            ),
+        ],
+    )
+    cluster, js = build(policy)
+    cluster.fail_job("default", "js-a-0")
+    cluster.run_until_stable()
+    assert js.status.terminal_state == ""  # rule didn't match rjob a
+    cluster.fail_job("default", "js-b-0")
+    cluster.run_until_stable()
+    assert js.status.terminal_state == keys.JOBSET_FAILED
+
+
+def test_first_matching_rule_wins_in_order():
+    policy = FailurePolicy(
+        max_restarts=5,
+        rules=[
+            FailurePolicyRule(
+                name="first",
+                action=keys.RESTART_JOBSET_AND_IGNORE_MAX_RESTARTS,
+                target_replicated_jobs=["a"],
+            ),
+            FailurePolicyRule(
+                name="second",
+                action=keys.FAIL_JOBSET,
+                target_replicated_jobs=["a"],
+            ),
+        ],
+    )
+    cluster, js = build(policy)
+    cluster.fail_job("default", "js-a-0")
+    cluster.run_until_stable()
+    # first rule matched; second (FailJobSet) never evaluated
+    assert js.status.terminal_state == ""
+    assert js.status.restarts == 1
+    assert js.status.restarts_count_towards_max == 0
+
+
+def test_earliest_failure_selects_matched_job():
+    policy = FailurePolicy(max_restarts=0, rules=[])
+    cluster, js = build(policy)
+    # Two failures in the same reconcile window at different virtual times.
+    cluster.fail_job("default", "js-b-1")
+    cluster.clock.advance(10)
+    cluster.fail_job("default", "js-a-0")
+    cluster.run_until_stable()
+    # max_restarts=0 -> ReachedMaxRestarts; message carries earliest failure.
+    cond = cluster.jobset_condition(js, keys.JOBSET_FAILED)
+    assert "js-b-1" in cond.message
+
+
+def test_restart_event_recorded():
+    cluster, js = build(FailurePolicy(max_restarts=3))
+    cluster.fail_job("default", "js-a-1")
+    cluster.run_until_stable()
+    events = cluster.events_with_reason(keys.RESTART_JOBSET_ACTION_REASON)
+    assert len(events) == 1
+    assert events[0].type == keys.EVENT_WARNING

@@ -1,0 +1,270 @@
+"""Core lifecycle integration tests.
+
+Mirrors the reference envtest scenarios
+(test/integration/controller/jobset_controller_test.go): job materialization,
+DNS service, status math, success policies, restart semantics, managedBy skip.
+The cluster simulator plays the role envtest + jobUpdateFn play there.
+"""
+
+import pytest
+
+from jobset_tpu.api import Coordinator, Network, SuccessPolicy, keys
+from jobset_tpu.core import make_cluster
+from jobset_tpu.core import metrics
+from jobset_tpu.testing import make_jobset, make_replicated_job
+
+
+@pytest.fixture(autouse=True)
+def _reset_metrics():
+    metrics.reset()
+    yield
+
+
+def two_rjob_jobset(name="js"):
+    return (
+        make_jobset(name)
+        .replicated_job(
+            make_replicated_job("leader").replicas(1).parallelism(1).completions(1).obj()
+        )
+        .replicated_job(
+            make_replicated_job("workers").replicas(3).parallelism(2).completions(2).obj()
+        )
+        .obj()
+    )
+
+
+def default_cluster():
+    cluster = make_cluster()
+    cluster.add_topology("rack", num_domains=8, nodes_per_domain=4, capacity=16)
+    return cluster
+
+
+def test_jobs_created_with_identity_labels():
+    cluster = default_cluster()
+    js = cluster.create_jobset(two_rjob_jobset())
+    cluster.run_until_stable()
+
+    names = sorted(j.metadata.name for j in cluster.jobs.values())
+    assert names == ["js-leader-0", "js-workers-0", "js-workers-1", "js-workers-2"]
+
+    job = cluster.get_job("default", "js-workers-1")
+    assert job.labels[keys.JOBSET_NAME_KEY] == "js"
+    assert job.labels[keys.REPLICATED_JOB_NAME_KEY] == "workers"
+    assert job.labels[keys.JOB_INDEX_KEY] == "1"
+    assert job.labels[keys.RESTARTS_KEY] == "0"
+    assert job.labels[keys.REPLICATED_JOB_REPLICAS_KEY] == "3"
+    assert job.labels[keys.JOB_GLOBAL_INDEX_KEY] == "2"  # 1 leader + index 1
+    assert len(job.labels[keys.JOB_KEY]) == 64
+    # Pod template carries the same identity.
+    assert job.spec.template.labels[keys.JOB_INDEX_KEY] == "1"
+    # DNS default: subdomain set to jobset name.
+    assert job.spec.template.spec.subdomain == "js"
+
+
+def test_headless_service_created_with_defaults():
+    cluster = default_cluster()
+    cluster.create_jobset(two_rjob_jobset())
+    cluster.run_until_stable()
+    svc = cluster.get_service("default", "js")
+    assert svc is not None
+    assert svc.cluster_ip == "None"
+    assert svc.selector == {keys.JOBSET_NAME_KEY: "js"}
+    assert svc.publish_not_ready_addresses is True
+
+
+def test_custom_subdomain_service():
+    cluster = default_cluster()
+    js = two_rjob_jobset()
+    js.spec.network = Network(subdomain="net")
+    cluster.create_jobset(js)
+    cluster.run_until_stable()
+    assert cluster.get_service("default", "net") is not None
+    job = cluster.get_job("default", "js-leader-0")
+    assert job.spec.template.spec.subdomain == "net"
+
+
+def test_no_service_when_dns_disabled():
+    cluster = default_cluster()
+    js = two_rjob_jobset()
+    js.spec.network = Network(enable_dns_hostnames=False)
+    cluster.create_jobset(js)
+    cluster.run_until_stable()
+    assert cluster.services == {}
+
+
+def test_pod_hostnames_resolve_via_service():
+    """DNS contract: <js>-<rjob>-<jobIdx>-<podIdx>.<subdomain> reaches the pod
+    (e2e ping analog, test/e2e/e2e_test.go:64-110)."""
+    cluster = default_cluster()
+    cluster.create_jobset(two_rjob_jobset())
+    cluster.run_until_stable()
+    pod = cluster.resolve_hostname("default", "js-workers-2-1.js")
+    assert pod is not None
+    assert pod.metadata.labels[keys.JOB_INDEX_KEY] == "2"
+    assert pod.annotations[keys.POD_COMPLETION_INDEX_KEY] == "1"
+    assert cluster.resolve_hostname("default", "js-workers-9-0.js") is None
+
+
+def test_replicated_job_statuses_ready_math():
+    cluster = default_cluster()
+    js = cluster.create_jobset(two_rjob_jobset())
+    cluster.run_until_stable()
+    statuses = {s.name: s for s in js.status.replicated_jobs_status}
+    assert statuses["leader"].ready == 1
+    assert statuses["workers"].ready == 3
+    assert statuses["workers"].active == 3
+
+
+def test_success_policy_all_requires_every_job():
+    cluster = default_cluster()
+    js = cluster.create_jobset(two_rjob_jobset())
+    cluster.run_until_stable()
+    cluster.complete_job("default", "js-leader-0")
+    cluster.run_until_stable()
+    assert js.status.terminal_state == ""
+    cluster.complete_all_jobs(js)
+    cluster.run_until_stable()
+    assert js.status.terminal_state == keys.JOBSET_COMPLETED
+    assert cluster.jobset_has_condition(js, keys.JOBSET_COMPLETED)
+    assert metrics.jobset_completed_total.value("default/js") == 1
+
+
+def test_success_policy_any_targeted():
+    cluster = default_cluster()
+    js = two_rjob_jobset()
+    js.spec.success_policy = SuccessPolicy(
+        operator=keys.OPERATOR_ANY, target_replicated_jobs=["leader"]
+    )
+    cluster.create_jobset(js)
+    cluster.run_until_stable()
+    # a workers job completing does not match the policy
+    cluster.complete_job("default", "js-workers-0")
+    cluster.run_until_stable()
+    assert js.status.terminal_state == ""
+    cluster.complete_job("default", "js-leader-0")
+    cluster.run_until_stable()
+    assert js.status.terminal_state == keys.JOBSET_COMPLETED
+
+
+def test_completed_jobset_deletes_active_jobs():
+    cluster = default_cluster()
+    js = two_rjob_jobset()
+    js.spec.success_policy = SuccessPolicy(operator=keys.OPERATOR_ANY)
+    cluster.create_jobset(js)
+    cluster.run_until_stable()
+    cluster.complete_job("default", "js-leader-0")
+    cluster.run_until_stable()
+    assert js.status.terminal_state == keys.JOBSET_COMPLETED
+    # remaining active jobs were cleaned up
+    assert all(j.finished()[0] for j in cluster.jobs.values())
+
+
+def test_failure_without_policy_fails_jobset():
+    cluster = default_cluster()
+    js = cluster.create_jobset(two_rjob_jobset())
+    cluster.run_until_stable()
+    cluster.fail_job("default", "js-workers-1")
+    cluster.run_until_stable()
+    assert js.status.terminal_state == keys.JOBSET_FAILED
+    cond = cluster.jobset_condition(js, keys.JOBSET_FAILED)
+    assert cond.reason == keys.FAILED_JOBS_REASON
+    assert "js-workers-1" in cond.message
+    assert metrics.jobset_failed_total.value("default/js") == 1
+
+
+def test_managed_by_external_controller_skipped():
+    cluster = default_cluster()
+    js = two_rjob_jobset()
+    js.spec.managed_by = "kueue.x-k8s.io/multikueue"
+    cluster.create_jobset(js)
+    cluster.run_until_stable()
+    assert cluster.jobs == {}
+    assert cluster.services == {}
+
+
+def test_events_emitted_after_status_updates():
+    cluster = default_cluster()
+    js = cluster.create_jobset(two_rjob_jobset())
+    cluster.run_until_stable()
+    cluster.complete_all_jobs(js)
+    cluster.run_until_stable()
+    reasons = [e.reason for e in cluster.events]
+    assert keys.ALL_JOBS_COMPLETED_REASON in reasons
+
+
+def test_coordinator_stamped_on_jobs_and_pods():
+    cluster = default_cluster()
+    js = two_rjob_jobset()
+    js.spec.coordinator = Coordinator(replicated_job="leader", job_index=0, pod_index=0)
+    cluster.create_jobset(js)
+    cluster.run_until_stable()
+    job = cluster.get_job("default", "js-workers-2")
+    assert job.labels[keys.COORDINATOR_KEY] == "js-leader-0-0.js"
+    pod = cluster.resolve_hostname("default", "js-workers-0-0.js")
+    assert pod.annotations[keys.COORDINATOR_KEY] == "js-leader-0-0.js"
+
+
+def test_domain_ownership_released_when_jobset_completes():
+    """Regression (review): finished exclusive JobSets must free their
+    topology domains for subsequent JobSets."""
+    cluster = make_cluster()
+    cluster.add_topology("rack", num_domains=2, nodes_per_domain=2, capacity=8)
+    js_a = (
+        make_jobset("a")
+        .exclusive_placement("rack")
+        .replicated_job(
+            make_replicated_job("w").replicas(2).parallelism(2).completions(2).obj()
+        )
+        .obj()
+    )
+    cluster.create_jobset(js_a)
+    cluster.run_until_stable()
+    cluster.complete_all_jobs(js_a)
+    cluster.run_until_stable()
+    assert js_a.status.terminal_state == keys.JOBSET_COMPLETED
+    occupied = {
+        d for d, owners in cluster.domain_job_keys.get("rack", {}).items() if owners
+    }
+    assert occupied == set()
+
+    js_b = (
+        make_jobset("b")
+        .exclusive_placement("rack")
+        .replicated_job(
+            make_replicated_job("w").replicas(2).parallelism(2).completions(2).obj()
+        )
+        .obj()
+    )
+    cluster.create_jobset(js_b)
+    cluster.run_until_stable()
+    assert all(p.spec.node_name for p in cluster.pods.values() if p.status.phase == "Running")
+    assert sum(1 for p in cluster.pods.values() if p.spec.node_name) == 4
+
+
+def test_update_jobset_preserves_status_and_creation_time():
+    """Regression (review): spec updates must not wipe server-owned fields."""
+    from jobset_tpu.api import FailurePolicy
+
+    cluster = make_cluster()
+    cluster.add_topology("rack", num_domains=4, nodes_per_domain=2, capacity=8)
+    js = (
+        make_jobset("js")
+        .failure_policy(FailurePolicy(max_restarts=5))
+        .replicated_job(
+            make_replicated_job("w").replicas(2).parallelism(1).completions(1).obj()
+        )
+        .obj()
+    )
+    cluster.clock.advance(100)
+    cluster.create_jobset(js)
+    cluster.run_until_stable()
+    cluster.fail_job("default", "js-w-0")
+    cluster.run_until_stable()
+    assert js.status.restarts == 1
+
+    updated = js.clone()
+    updated.spec.suspend = True
+    cluster.update_jobset(updated)
+    stored = cluster.get_jobset("default", "js")
+    assert stored.status.restarts == 1
+    assert stored.metadata.creation_time == 100.0

@@ -1,0 +1,186 @@
+"""Exclusive-placement integration tests: the greedy webhook path, follower
+gating, drift enforcement, and the nodeSelector strategy
+(parity with SURVEY.md §3.4 and pkg/controllers/pod_controller_test.go)."""
+
+from collections import defaultdict
+
+from jobset_tpu.api import FailurePolicy, Taint, keys
+from jobset_tpu.core import make_cluster
+from jobset_tpu.placement.naming import is_leader_pod
+from jobset_tpu.testing import make_jobset, make_replicated_job
+
+TOPOLOGY = "cloud.google.com/gke-nodepool"
+
+
+def exclusive_jobset(replicas=4, pods_per_job=3):
+    return (
+        make_jobset("js")
+        .exclusive_placement(TOPOLOGY)
+        .failure_policy(FailurePolicy(max_restarts=5))
+        .replicated_job(
+            make_replicated_job("w")
+            .replicas(replicas)
+            .parallelism(pods_per_job)
+            .completions(pods_per_job)
+            .obj()
+        )
+        .obj()
+    )
+
+
+def build(replicas=4, pods_per_job=3, domains=6, nodes_per_domain=4):
+    cluster = make_cluster()
+    cluster.add_topology(
+        TOPOLOGY, num_domains=domains, nodes_per_domain=nodes_per_domain, capacity=8
+    )
+    js = cluster.create_jobset(exclusive_jobset(replicas, pods_per_job))
+    cluster.run_until_stable()
+    return cluster, js
+
+
+def domains_used(cluster):
+    mapping = defaultdict(set)
+    for pod in cluster.pods.values():
+        if not pod.spec.node_name:
+            continue
+        node = cluster.nodes[pod.spec.node_name]
+        mapping[node.labels[TOPOLOGY]].add(pod.labels[keys.JOB_INDEX_KEY])
+    return mapping
+
+
+def test_one_job_per_domain():
+    cluster, _ = build()
+    mapping = domains_used(cluster)
+    assert len(mapping) == 4
+    assert all(len(jobs) == 1 for jobs in mapping.values())
+    assert len(cluster.pods) == 12
+
+
+def test_leader_has_affinity_follower_has_node_selector():
+    cluster, _ = build()
+    for pod in cluster.pods.values():
+        if is_leader_pod(pod):
+            assert pod.spec.affinity is not None
+            assert pod.spec.affinity.pod_affinity[0].topology_key == TOPOLOGY
+            anti = pod.spec.affinity.pod_anti_affinity[0]
+            assert anti.job_key_exists and anti.job_key_not_in == [
+                pod.labels[keys.JOB_KEY]
+            ]
+        else:
+            assert pod.spec.node_selector[TOPOLOGY]
+
+
+def test_followers_share_leader_domain():
+    cluster, _ = build()
+    by_job = defaultdict(set)
+    for pod in cluster.pods.values():
+        node = cluster.nodes[pod.spec.node_name]
+        by_job[pod.labels[keys.JOB_KEY]].add(node.labels[TOPOLOGY])
+    assert all(len(doms) == 1 for doms in by_job.values())
+
+
+def test_insufficient_domains_leaves_jobs_partially_placed():
+    cluster, _ = build(replicas=4, domains=2)
+    mapping = domains_used(cluster)
+    assert len(mapping) == 2  # only two jobs could claim a domain
+    # Unplaced leader pods stay Pending.
+    pending = [p for p in cluster.pods.values() if not p.spec.node_name]
+    assert pending
+
+
+def test_gang_restart_replaces_all_pods_in_domains():
+    cluster, js = build()
+    cluster.fail_job("default", "js-w-2")
+    cluster.run_until_stable()
+    assert js.status.restarts == 1
+    assert len(cluster.pods) == 12
+    assert all(p.spec.node_name for p in cluster.pods.values())
+    mapping = domains_used(cluster)
+    assert all(len(jobs) == 1 for jobs in mapping.values())
+
+
+def test_node_failure_triggers_gang_recovery():
+    cluster, js = build()
+    victim = next(iter(cluster.pods.values())).spec.node_name
+    failed = cluster.fail_node(victim)
+    assert failed
+    cluster.run_until_stable()
+    assert js.status.restarts == 1
+    assert len(cluster.pods) == 12
+    assert all(p.spec.node_name for p in cluster.pods.values())
+
+
+def test_drift_enforcement_deletes_mismatched_followers():
+    cluster, _ = build()
+    # Inject drift: rewrite a follower's nodeSelector to another domain.
+    follower = next(p for p in cluster.pods.values() if not is_leader_pod(p))
+    leader_domain = cluster.nodes[follower.spec.node_name].labels[TOPOLOGY]
+    other_domain = next(
+        v for v in cluster.domain_nodes(TOPOLOGY) if v != leader_domain
+    )
+    follower.spec.node_selector[TOPOLOGY] = other_domain
+    name = follower.metadata.name
+
+    cluster.run_until_stable()
+    # The drifted follower was deleted (with a DisruptionTarget event) and
+    # recreated next to its leader.
+    assert cluster.get_pod("default", name) is None
+    assert cluster.events_with_reason(keys.EXCLUSIVE_PLACEMENT_VIOLATION_REASON)
+    assert len(cluster.pods) == 12
+    by_job = defaultdict(set)
+    for pod in cluster.pods.values():
+        by_job[pod.labels[keys.JOB_KEY]].add(
+            cluster.nodes[pod.spec.node_name].labels[TOPOLOGY]
+        )
+    assert all(len(d) == 1 for d in by_job.values())
+
+
+def test_node_selector_strategy_skips_webhooks():
+    cluster = make_cluster()
+    # Pre-labelled nodes: one namespaced-job label per domain + taint
+    # (hack/label_nodes/label_nodes.py analog).
+    for d in range(2):
+        for n in range(4):
+            cluster.add_node(
+                f"d{d}-n{n}",
+                labels={
+                    TOPOLOGY: f"d{d}",
+                    keys.NAMESPACED_JOB_KEY: f"default_js-w-{d}",
+                },
+                taints=[Taint(key=keys.NO_SCHEDULE_TAINT_KEY, effect="NoSchedule")],
+                capacity=8,
+            )
+    js = (
+        make_jobset("js")
+        .exclusive_placement(TOPOLOGY)
+        .node_selector_strategy()
+        .replicated_job(
+            make_replicated_job("w").replicas(2).parallelism(2).completions(2).obj()
+        )
+        .obj()
+    )
+    cluster.create_jobset(js)
+    cluster.run_until_stable()
+    assert len(cluster.pods) == 4
+    for pod in cluster.pods.values():
+        # No affinity injection in this strategy; selector + toleration routing.
+        assert pod.spec.affinity is None
+        job_name = f"js-w-{pod.labels[keys.JOB_INDEX_KEY]}"
+        assert pod.spec.node_selector[keys.NAMESPACED_JOB_KEY] == f"default_{job_name}"
+        node = cluster.nodes[pod.spec.node_name]
+        assert node.labels[keys.NAMESPACED_JOB_KEY] == f"default_{job_name}"
+
+
+def test_stale_leader_uid_guard_blocks_follower():
+    """After a restart, a follower must not follow a leader from the previous
+    run (pod_admission_webhook.go:111-123)."""
+    from jobset_tpu.placement.webhooks import PodAdmissionError, validate_pod_create
+    import pytest
+
+    cluster, js = build(replicas=1, pods_per_job=2)
+    leader = next(p for p in cluster.pods.values() if is_leader_pod(p))
+    follower = next(p for p in cluster.pods.values() if not is_leader_pod(p))
+    # Simulate staleness: follower belongs to a recreated job (new UID).
+    follower.metadata.owner_uid = "uid-new-run"
+    with pytest.raises(PodAdmissionError):
+        validate_pod_create(cluster, follower)

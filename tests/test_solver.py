@@ -1,0 +1,270 @@
+"""Placement solver tests: differential optimality vs scipy's Hungarian
+implementation, feasibility handling, batching, and the end-to-end solver
+placement path behind the TPUPlacementSolver gate (SURVEY.md §7 phase 7)."""
+
+import numpy as np
+import pytest
+from scipy.optimize import linear_sum_assignment
+
+from jobset_tpu.api import FailurePolicy, keys
+from jobset_tpu.core import features, make_cluster
+from jobset_tpu.placement.solver import AssignmentSolver
+from jobset_tpu.testing import make_jobset, make_replicated_job
+
+TOPOLOGY = "tpu-slice"
+
+
+@pytest.fixture(scope="module")
+def solver():
+    return AssignmentSolver()
+
+
+def assignment_cost(cost, assignment):
+    return sum(cost[j, d] for j, d in enumerate(assignment) if d >= 0)
+
+
+# ---------------------------------------------------------------------------
+# Differential tests vs scipy
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("num_jobs,num_domains,seed", [
+    (4, 4, 0),
+    (8, 16, 1),
+    (16, 16, 2),
+    (32, 64, 3),
+    (64, 100, 4),
+    (1, 7, 5),
+])
+def test_auction_matches_hungarian_on_random_costs(solver, num_jobs, num_domains, seed):
+    rng = np.random.default_rng(seed)
+    cost = rng.integers(0, 50, size=(num_jobs, num_domains)).astype(np.float32)
+    ours = solver.solve(cost)
+    assert len(set(ours)) == num_jobs  # all assigned, all distinct
+    rows, cols = linear_sum_assignment(cost)
+    optimal = cost[rows, cols].sum()
+    assert assignment_cost(cost, ours) == pytest.approx(optimal)
+
+
+def test_auction_respects_feasibility_mask(solver):
+    rng = np.random.default_rng(7)
+    cost = rng.integers(0, 20, size=(6, 10)).astype(np.float32)
+    feasible = rng.random((6, 10)) > 0.4
+    ours = solver.solve(cost, feasible)
+    for j, d in enumerate(ours):
+        if d >= 0:
+            assert feasible[j, d]
+    # compare with scipy on the masked problem
+    big = cost.copy()
+    big[~feasible] = 1e6
+    rows, cols = linear_sum_assignment(big)
+    scipy_cost = sum(
+        cost[r, c] for r, c in zip(rows, cols) if feasible[r, c]
+    )
+    assert assignment_cost(cost, ours) <= scipy_cost + 1e-3
+
+
+def test_infeasible_jobs_unassigned(solver):
+    cost = np.zeros((3, 4), np.float32)
+    feasible = np.ones((3, 4), bool)
+    feasible[1, :] = False  # job 1 can go nowhere
+    ours = solver.solve(cost, feasible)
+    assert ours[1] == -1
+    assert ours[0] >= 0 and ours[2] >= 0
+
+
+def test_more_jobs_than_domains_places_subset(solver):
+    cost = np.ones((5, 2), np.float32)
+    ours = solver.solve(cost)
+    placed = [d for d in ours if d >= 0]
+    assert len(placed) == 2
+    assert len(set(placed)) == 2
+
+
+def test_zero_cost_stickiness_preferred(solver):
+    cost = np.ones((3, 8), np.float32)
+    cost[0, 5] = 0.0  # job 0 sticky to domain 5
+    cost[2, 1] = 0.0
+    ours = solver.solve(cost)
+    assert ours[0] == 5
+    assert ours[2] == 1
+
+
+def test_batch_solve_matches_single(solver):
+    rng = np.random.default_rng(11)
+    costs = rng.integers(0, 30, size=(4, 8, 12)).astype(np.float32)
+    batch = solver.solve_batch(costs)
+    for b in range(4):
+        single = solver.solve(costs[b])
+        assert assignment_cost(costs[b], batch[b]) == pytest.approx(
+            assignment_cost(costs[b], single)
+        )
+
+
+# ---------------------------------------------------------------------------
+# End-to-end solver placement path
+# ---------------------------------------------------------------------------
+
+
+def solver_cluster(num_domains=8, nodes_per_domain=4):
+    cluster = make_cluster()
+    cluster.add_topology(
+        TOPOLOGY, num_domains=num_domains, nodes_per_domain=nodes_per_domain, capacity=8
+    )
+    return cluster
+
+
+def exclusive_jobset(replicas=4, pods=3):
+    return (
+        make_jobset("js")
+        .exclusive_placement(TOPOLOGY)
+        .failure_policy(FailurePolicy(max_restarts=5))
+        .replicated_job(
+            make_replicated_job("w").replicas(replicas).parallelism(pods).completions(pods).obj()
+        )
+        .obj()
+    )
+
+
+def test_solver_path_places_one_job_per_domain():
+    with features.gate("TPUPlacementSolver", True):
+        cluster = solver_cluster()
+        js = cluster.create_jobset(exclusive_jobset())
+        cluster.run_until_stable()
+        assert len(cluster.pods) == 12
+        assert all(p.spec.node_name for p in cluster.pods.values())
+        domains = {}
+        for pod in cluster.pods.values():
+            d = cluster.nodes[pod.spec.node_name].labels[TOPOLOGY]
+            domains.setdefault(d, set()).add(pod.labels[keys.JOB_KEY])
+        assert all(len(ks) == 1 for ks in domains.values())
+        # Solver stamped the plan: no affinity objects anywhere, every pod
+        # (leaders included) pinned by nodeSelector.
+        for pod in cluster.pods.values():
+            assert pod.spec.affinity is None
+            assert pod.spec.node_selector[TOPOLOGY]
+
+
+def test_solver_recovery_is_sticky():
+    """After a gang restart with free capacity, jobs return to their previous
+    domains (recovery locality)."""
+    with features.gate("TPUPlacementSolver", True):
+        cluster = solver_cluster()
+        js = cluster.create_jobset(exclusive_jobset())
+        cluster.run_until_stable()
+        before = {}
+        for pod in cluster.pods.values():
+            jk = pod.labels[keys.JOB_KEY]
+            before[jk] = cluster.nodes[pod.spec.node_name].labels[TOPOLOGY]
+
+        cluster.fail_job("default", "js-w-1")
+        cluster.run_until_stable()
+        assert js.status.restarts == 1
+        after = {}
+        for pod in cluster.pods.values():
+            jk = pod.labels[keys.JOB_KEY]
+            after[jk] = cluster.nodes[pod.spec.node_name].labels[TOPOLOGY]
+        assert before == after  # job_key is stable across restarts
+
+
+def test_solver_and_greedy_agree_on_exclusiveness():
+    """Differential test: identical jobset, both paths produce a valid
+    one-job-per-domain placement with all pods bound."""
+    results = {}
+    for gate_on in (False, True):
+        with features.gate("TPUPlacementSolver", gate_on):
+            cluster = solver_cluster()
+            cluster.create_jobset(exclusive_jobset())
+            cluster.run_until_stable()
+            placement = {}
+            for pod in cluster.pods.values():
+                d = cluster.nodes[pod.spec.node_name].labels[TOPOLOGY]
+                placement.setdefault(d, set()).add(pod.labels[keys.JOB_KEY])
+            results[gate_on] = placement
+            assert len(cluster.pods) == 12
+            assert all(len(v) == 1 for v in placement.values())
+    assert len(results[False]) == len(results[True]) == 4
+
+
+def test_solver_falls_back_when_no_capacity():
+    with features.gate("TPUPlacementSolver", True):
+        cluster = solver_cluster(num_domains=2)
+        js = cluster.create_jobset(exclusive_jobset(replicas=4))
+        cluster.run_until_stable()
+        bound_jobs = set()
+        for pod in cluster.pods.values():
+            if pod.spec.node_name:
+                bound_jobs.add(pod.labels[keys.JOB_KEY])
+        assert len(bound_jobs) == 2  # only 2 domains available; no crash
+
+
+def test_solver_does_not_double_book_across_replicated_jobs():
+    """Regression (review): per-rjob solves must see domains planned by
+    earlier batches in the same reconcile pass."""
+    with features.gate("TPUPlacementSolver", True):
+        cluster = solver_cluster(num_domains=4)
+        js = (
+            make_jobset("js")
+            .exclusive_placement(TOPOLOGY)
+            .replicated_job(
+                make_replicated_job("a").replicas(1).parallelism(2).completions(2).obj()
+            )
+            .replicated_job(
+                make_replicated_job("b").replicas(1).parallelism(2).completions(2).obj()
+            )
+            .obj()
+        )
+        cluster.create_jobset(js)
+        cluster.run_until_stable()
+        assert len(cluster.pods) == 4
+        assert all(p.spec.node_name for p in cluster.pods.values())
+        doms = {
+            cluster.nodes[p.spec.node_name].labels[TOPOLOGY]
+            for p in cluster.pods.values()
+        }
+        # two jobs -> two distinct domains
+        domains_per_job = {}
+        for p in cluster.pods.values():
+            domains_per_job.setdefault(
+                p.labels[keys.JOB_KEY],
+                cluster.nodes[p.spec.node_name].labels[TOPOLOGY],
+            )
+        assert len(set(domains_per_job.values())) == 2
+
+
+def test_solver_does_not_double_book_across_jobsets_same_tick():
+    with features.gate("TPUPlacementSolver", True):
+        cluster = solver_cluster(num_domains=4)
+
+        def one_job_jobset(name):
+            return (
+                make_jobset(name)
+                .exclusive_placement(TOPOLOGY)
+                .replicated_job(
+                    make_replicated_job("w").replicas(1).parallelism(2).completions(2).obj()
+                )
+                .obj()
+            )
+
+        cluster.create_jobset(one_job_jobset("x"))
+        cluster.create_jobset(one_job_jobset("y"))
+        cluster.run_until_stable()
+        assert len(cluster.pods) == 4
+        assert all(p.spec.node_name for p in cluster.pods.values())
+        per_job = {}
+        for p in cluster.pods.values():
+            per_job.setdefault(
+                p.labels[keys.JOB_KEY],
+                cluster.nodes[p.spec.node_name].labels[TOPOLOGY],
+            )
+        assert len(set(per_job.values())) == 2
+
+
+def test_planned_domain_claim_released_on_jobset_delete():
+    with features.gate("TPUPlacementSolver", True):
+        cluster = solver_cluster(num_domains=2)
+        cluster.create_jobset(exclusive_jobset(replicas=2))
+        cluster.run_until_stable()
+        cluster.delete_jobset("default", "js")
+        occupancy = cluster.domain_job_keys.get(TOPOLOGY, {})
+        assert all(not owners for owners in occupancy.values())

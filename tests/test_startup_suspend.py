@@ -1,0 +1,164 @@
+"""Startup-policy (InOrder) and suspend/resume integration tests
+(reference: startup_policy.go, jobset_controller.go:382-441 scenarios)."""
+
+from jobset_tpu.api import StartupPolicy, keys
+from jobset_tpu.core import make_cluster
+from jobset_tpu.testing import make_jobset, make_replicated_job
+
+
+def ordered_jobset():
+    return (
+        make_jobset("js")
+        .startup_policy(StartupPolicy(startup_policy_order=keys.STARTUP_IN_ORDER))
+        .replicated_job(
+            make_replicated_job("driver").replicas(1).parallelism(1).completions(1).obj()
+        )
+        .replicated_job(
+            make_replicated_job("workers").replicas(2).parallelism(2).completions(2).obj()
+        )
+        .obj()
+    )
+
+
+def test_in_order_startup_creates_rjobs_sequentially():
+    cluster = make_cluster(auto_ready=False)
+    cluster.add_topology("rack", num_domains=4, nodes_per_domain=4, capacity=16)
+    js = cluster.create_jobset(ordered_jobset())
+    cluster.run_until_stable()
+
+    # Only the driver exists; workers wait for driver readiness.
+    assert sorted(j.metadata.name for j in cluster.jobs.values()) == ["js-driver-0"]
+    assert cluster.jobset_has_condition(js, keys.JOBSET_STARTUP_POLICY_IN_PROGRESS)
+
+    cluster.set_job_ready("default", "js-driver-0")
+    cluster.run_until_stable()
+    assert sorted(j.metadata.name for j in cluster.jobs.values()) == [
+        "js-driver-0",
+        "js-workers-0",
+        "js-workers-1",
+    ]
+    cluster.set_job_ready("default", "js-workers-0")
+    cluster.set_job_ready("default", "js-workers-1")
+    cluster.run_until_stable()
+    assert cluster.jobset_has_condition(js, keys.JOBSET_STARTUP_POLICY_COMPLETED)
+    # InProgress demoted by the mutually-exclusive pair rule.
+    assert not cluster.jobset_has_condition(js, keys.JOBSET_STARTUP_POLICY_IN_PROGRESS)
+
+
+def test_any_order_startup_creates_all_at_once():
+    cluster = make_cluster(auto_ready=False)
+    cluster.add_topology("rack", num_domains=4, nodes_per_domain=4, capacity=16)
+    js = ordered_jobset()
+    js.spec.startup_policy = StartupPolicy(startup_policy_order=keys.STARTUP_ANY_ORDER)
+    cluster.create_jobset(js)
+    cluster.run_until_stable()
+    assert len(cluster.jobs) == 3
+
+
+def test_suspended_jobset_creates_suspended_jobs_without_pods():
+    cluster = make_cluster()
+    cluster.add_topology("rack", num_domains=4, nodes_per_domain=4, capacity=16)
+    js = ordered_jobset()
+    js.spec.startup_policy = StartupPolicy(startup_policy_order=keys.STARTUP_ANY_ORDER)
+    js.spec.suspend = True
+    cluster.create_jobset(js)
+    cluster.run_until_stable()
+    assert len(cluster.jobs) == 3
+    assert all(j.suspended() for j in cluster.jobs.values())
+    assert cluster.pods == {}
+    assert cluster.jobset_has_condition(js, keys.JOBSET_SUSPENDED)
+
+
+def test_resume_unsuspends_jobs_and_flips_condition():
+    cluster = make_cluster()
+    cluster.add_topology("rack", num_domains=4, nodes_per_domain=4, capacity=16)
+    js = ordered_jobset()
+    js.spec.startup_policy = StartupPolicy(startup_policy_order=keys.STARTUP_ANY_ORDER)
+    js.spec.suspend = True
+    cluster.create_jobset(js)
+    cluster.run_until_stable()
+
+    updated = js.clone()
+    updated.spec.suspend = False
+    cluster.update_jobset(updated)
+    cluster.run_until_stable()
+    js = cluster.get_jobset("default", "js")
+    assert all(not j.suspended() for j in cluster.jobs.values())
+    assert len(cluster.pods) == 5  # 1 driver + 2x2 workers
+    assert cluster.jobset_has_condition(js, keys.JOBSET_SUSPENDED, status="False")
+    reasons = [e.reason for e in cluster.events]
+    assert keys.JOBSET_RESUMED_REASON in reasons
+
+
+def test_suspend_running_jobset_deletes_pods():
+    cluster = make_cluster()
+    cluster.add_topology("rack", num_domains=4, nodes_per_domain=4, capacity=16)
+    js = ordered_jobset()
+    js.spec.startup_policy = StartupPolicy(startup_policy_order=keys.STARTUP_ANY_ORDER)
+    cluster.create_jobset(js)
+    cluster.run_until_stable()
+    assert len(cluster.pods) == 5
+
+    updated = js.clone()
+    updated.spec.suspend = True
+    cluster.update_jobset(updated)
+    cluster.run_until_stable()
+    js = cluster.get_jobset("default", "js")
+    assert cluster.pods == {}
+    assert all(j.suspended() for j in cluster.jobs.values())
+    assert cluster.jobset_has_condition(js, keys.JOBSET_SUSPENDED)
+
+
+def test_resume_merges_kueue_mutated_pod_template_fields():
+    """Resume must propagate nodeSelector changes made while suspended into
+    the child jobs (jobset_controller.go:443-485, e2e_test.go:141 analog)."""
+    cluster = make_cluster()
+    cluster.add_topology("rack", num_domains=4, nodes_per_domain=4, capacity=16)
+    for node in cluster.nodes.values():
+        node.labels["pool"] = "reserved" if "domain-1" in node.name else "spot"
+
+    js = ordered_jobset()
+    js.spec.startup_policy = StartupPolicy(startup_policy_order=keys.STARTUP_ANY_ORDER)
+    js.spec.suspend = True
+    cluster.create_jobset(js)
+    cluster.run_until_stable()
+
+    # Kueue-style mutation while suspended: pin to the reserved pool.
+    updated = cluster.get_jobset("default", "js").clone()
+    for rjob in updated.spec.replicated_jobs:
+        rjob.template.spec.template.spec.node_selector["pool"] = "reserved"
+    updated.spec.suspend = False
+    cluster.update_jobset(updated)
+    cluster.run_until_stable()
+
+    job = cluster.get_job("default", "js-workers-0")
+    assert job.spec.template.spec.node_selector["pool"] == "reserved"
+    for pod in cluster.pods.values():
+        node = cluster.nodes[pod.spec.node_name]
+        assert node.labels["pool"] == "reserved"
+
+
+def test_in_order_resume_respects_order():
+    cluster = make_cluster(auto_ready=False)
+    cluster.add_topology("rack", num_domains=4, nodes_per_domain=4, capacity=16)
+    js = ordered_jobset()
+    js.spec.suspend = True
+    cluster.create_jobset(js)
+    cluster.run_until_stable()
+    assert all(j.suspended() for j in cluster.jobs.values())
+
+    updated = cluster.get_jobset("default", "js").clone()
+    updated.spec.suspend = False
+    cluster.update_jobset(updated)
+    cluster.run_until_stable()
+    js = cluster.get_jobset("default", "js")
+
+    driver = cluster.get_job("default", "js-driver-0")
+    assert not driver.suspended()
+    workers = cluster.get_job("default", "js-workers-0")
+    # Workers wait (still suspended) until driver is ready.
+    assert workers is None or workers.suspended()
+    cluster.set_job_ready("default", "js-driver-0")
+    cluster.run_until_stable()
+    workers = cluster.get_job("default", "js-workers-0")
+    assert workers is not None and not workers.suspended()

@@ -1,0 +1,67 @@
+"""TTL-after-finished tests with the fake clock (parity with
+pkg/controllers/ttl_after_finished_test.go:27-340)."""
+
+from jobset_tpu.api import SuccessPolicy, keys
+from jobset_tpu.core import make_cluster
+from jobset_tpu.testing import make_jobset, make_replicated_job
+
+
+def build(ttl=None):
+    cluster = make_cluster()
+    cluster.add_topology("rack", num_domains=2, nodes_per_domain=2, capacity=16)
+    wrapper = (
+        make_jobset("js")
+        .replicated_job(
+            make_replicated_job("w").replicas(1).parallelism(1).completions(1).obj()
+        )
+    )
+    if ttl is not None:
+        wrapper = wrapper.ttl_seconds_after_finished(ttl)
+    js = cluster.create_jobset(wrapper.obj())
+    cluster.run_until_stable()
+    return cluster, js
+
+
+def test_no_ttl_keeps_finished_jobset():
+    cluster, js = build(ttl=None)
+    cluster.complete_all_jobs(js)
+    cluster.run_until_stable()
+    cluster.clock.advance(10_000)
+    cluster.run_until_stable()
+    assert cluster.get_jobset("default", "js") is not None
+
+
+def test_ttl_deletes_after_expiry():
+    cluster, js = build(ttl=60)
+    cluster.complete_all_jobs(js)
+    cluster.run_until_stable()
+    assert cluster.get_jobset("default", "js") is not None
+
+    cluster.clock.advance(59)
+    cluster.run_until_stable()
+    assert cluster.get_jobset("default", "js") is not None
+
+    cluster.clock.advance(2)
+    cluster.run_until_stable()
+    assert cluster.get_jobset("default", "js") is None
+    # Foreground cascade removed children too.
+    assert cluster.jobs == {}
+    assert cluster.pods == {}
+    assert cluster.services == {}
+
+
+def test_ttl_zero_deletes_immediately():
+    cluster, js = build(ttl=0)
+    cluster.complete_all_jobs(js)
+    cluster.run_until_stable()
+    assert cluster.get_jobset("default", "js") is None
+
+
+def test_ttl_applies_to_failed_jobset_too():
+    cluster, js = build(ttl=30)
+    cluster.fail_job("default", "js-w-0")
+    cluster.run_until_stable()
+    assert js.status.terminal_state == keys.JOBSET_FAILED
+    cluster.clock.advance(31)
+    cluster.run_until_stable()
+    assert cluster.get_jobset("default", "js") is None
