@@ -420,8 +420,21 @@ class Cluster:
             value = node.labels.get(topology_key)
             domains = self.domain_job_keys.get(topology_key, {})
             if value in domains:
-                # Only clear the key if no other bound pod of this job
-                # remains in the domain.
+                # A solver-planned job keeps its domain claim for its whole
+                # lifetime while unfinished (its pods carry a pinned
+                # nodeSelector, so losing the claim to another job would
+                # wedge them Pending on suspend/resume or drift recovery);
+                # the claim is released by delete_job or when the job ends.
+                owner_key = self.jobs_by_uid.get(pod.metadata.owner_uid)
+                owner = self.jobs.get(owner_key) if owner_key else None
+                if (
+                    owner is not None
+                    and keys.PLACEMENT_PLAN_KEY in owner.metadata.annotations
+                    and not owner.finished()[0]
+                ):
+                    return
+                # Greedy path: clear the key once no other bound pod of this
+                # job remains in the domain.
                 still_there = any(
                     p.spec.node_name
                     and self.nodes.get(p.spec.node_name) is not None

@@ -53,17 +53,9 @@ class JobController:
         return changed
 
     def _desired_indexes(self, job: Job) -> int:
-        if job.spec.completion_mode == keys.COMPLETION_MODE_INDEXED:
-            completions = (
-                job.spec.completions
-                if job.spec.completions is not None
-                else (job.spec.parallelism or 1)
-            )
-            parallelism = (
-                job.spec.parallelism if job.spec.parallelism is not None else 1
-            )
-            return min(completions, parallelism) if parallelism else completions
-        return job.spec.parallelism or 1
+        # One definition of "expected pod count" shared with the status math
+        # and the solver's capacity feasibility (objects.py pods_expected).
+        return job.pods_expected()
 
     def _create_missing_pods(self, job: Job) -> bool:
         existing = {

@@ -12,6 +12,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Optional
 
+from ..api import keys
 from ..api.types import Condition, JobSpec, ObjectMeta, PodSpec, Taint
 
 # Pod phases.
@@ -70,9 +71,7 @@ class Pod:
         return self.spec.node_name
 
     def completion_index(self) -> Optional[int]:
-        idx = self.metadata.annotations.get(
-            "batch.kubernetes.io/job-completion-index"
-        )
+        idx = self.metadata.annotations.get(keys.POD_COMPLETION_INDEX_KEY)
         return int(idx) if idx is not None else None
 
 

@@ -1,0 +1,17 @@
+"""Model zoo: flagship SPMD transformer (dense + MoE)."""
+
+from .transformer import (
+    TransformerConfig,
+    build_forward,
+    build_train_step,
+    init_params,
+    param_specs,
+)
+
+__all__ = [
+    "TransformerConfig",
+    "build_forward",
+    "build_train_step",
+    "init_params",
+    "param_specs",
+]

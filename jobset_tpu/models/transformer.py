@@ -1,0 +1,444 @@
+"""Flagship decoder-only transformer, written mesh-first.
+
+The whole model is one SPMD program under `shard_map` over the five-axis
+mesh (`jobset_tpu.parallel.mesh`): every collective is explicit, in the
+style of the scaling-book recipe — pick a mesh, place shards, let the
+program say exactly which axis each reduction rides:
+
+* **tp** — Megatron-style column/row parallel projections: QKV and MLP
+  up-projections are column-sharded (no collective), output projections are
+  row-sharded partial sums -> `psum('tp')`; vocab is sharded for both the
+  one-hot embedding lookup and the log-softmax loss (psum-max / psum).
+* **sp** — sequence chunks; attention is exact ring attention
+  (`parallel.ring_attention`) with K/V blocks rotating via `ppermute`.
+* **pp** — layer stages marched by the GPipe transform
+  (`parallel.pipeline`); backward schedule comes from autodiff.
+* **ep** — MoE expert shards with dense (soft) dispatch: every rank runs its
+  local experts on its tokens, gate-weighted partials are `psum('ep')`-ed.
+  (Token-routed all_to_all dispatch is the planned optimization.)
+* **dp** — pure data parallelism; gradients are `psum`-ed over (dp, sp) and
+  any other axis a parameter is replicated on.
+
+Compute dtype defaults to bfloat16 (MXU-native) with float32 parameters and
+f32 softmax/norm statistics; per-layer rematerialization (`jax.checkpoint`)
+trades FLOPs for HBM.
+
+Capability mapping to the reference: JobSet only orchestrates containers
+that run frameworks like this (SURVEY.md §2.2); the model itself is
+greenfield TPU-native work.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.mesh import MeshConfig, axis_size
+from ..parallel.pipeline import pipeline_apply
+from ..parallel.ring_attention import ring_attention
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_heads: int = 8
+    d_ff: int = 2048
+    n_layers: int = 8
+    # MoE: 0 experts = dense MLP in every layer.
+    n_experts: int = 0
+    d_ff_expert: int = 512
+    max_seq_len: int = 2048
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-6
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+    n_microbatches: int = 0  # 0 -> defaults to pp size
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    def validate(self, mesh_config: MeshConfig) -> None:
+        mc = mesh_config
+        if self.d_model % self.n_heads:
+            raise ValueError("d_model must divide evenly into heads")
+        if self.n_layers % mc.pp:
+            raise ValueError(f"n_layers {self.n_layers} not divisible by pp {mc.pp}")
+        if self.n_heads % mc.tp:
+            raise ValueError(f"n_heads {self.n_heads} not divisible by tp {mc.tp}")
+        if self.d_ff % mc.tp or (self.n_experts and self.d_ff_expert % mc.tp):
+            raise ValueError("feed-forward widths must be divisible by tp")
+        if self.vocab_size % mc.tp:
+            raise ValueError(f"vocab {self.vocab_size} not divisible by tp {mc.tp}")
+        if self.n_experts % max(mc.ep, 1):
+            raise ValueError("n_experts must be divisible by ep")
+
+
+# ---------------------------------------------------------------------------
+# Parameters
+# ---------------------------------------------------------------------------
+
+
+def param_specs(config: TransformerConfig) -> dict:
+    """PartitionSpec pytree. Layer leaves are stacked [pp, layers_per_stage,
+    ...]; tensor dims shard over tp, expert dims over ep."""
+    specs = {
+        "embed": P("tp", None),  # vocab-sharded
+        "final_norm": P(None),
+        "unembed": P(None, "tp"),
+        "layers": {
+            "ln1": P("pp", None, None),
+            "ln2": P("pp", None, None),
+            "wq": P("pp", None, None, "tp"),
+            "wk": P("pp", None, None, "tp"),
+            "wv": P("pp", None, None, "tp"),
+            "wo": P("pp", None, "tp", None),
+        },
+    }
+    if config.n_experts:
+        specs["layers"].update(
+            {
+                "wg": P("pp", None, None, None),
+                "we1": P("pp", None, "ep", None, "tp"),
+                "we2": P("pp", None, "ep", "tp", None),
+            }
+        )
+    else:
+        specs["layers"].update(
+            {
+                "w1": P("pp", None, None, "tp"),
+                "w2": P("pp", None, "tp", None),
+            }
+        )
+    return specs
+
+
+def init_params(
+    rng: jax.Array, config: TransformerConfig, mesh: Mesh
+) -> dict:
+    """Initialize global parameter arrays, placed with their NamedShardings."""
+    cfg = config
+    pp = axis_size(mesh, "pp")
+    lps = cfg.n_layers // pp
+    d, h, dh = cfg.d_model, cfg.n_heads, cfg.head_dim
+
+    def dense_init(key, shape, fan_in):
+        return (jax.random.normal(key, shape, cfg.param_dtype) / np.sqrt(fan_in))
+
+    k = jax.random.split(rng, 16)
+    layer_shapes = {
+        "ln1": ((pp, lps, d), None),
+        "ln2": ((pp, lps, d), None),
+        "wq": ((pp, lps, d, h * dh), d),
+        "wk": ((pp, lps, d, h * dh), d),
+        "wv": ((pp, lps, d, h * dh), d),
+        "wo": ((pp, lps, h * dh, d), h * dh),
+    }
+    if cfg.n_experts:
+        layer_shapes.update(
+            {
+                "wg": ((pp, lps, d, cfg.n_experts), d),
+                "we1": ((pp, lps, cfg.n_experts, d, cfg.d_ff_expert), d),
+                "we2": ((pp, lps, cfg.n_experts, cfg.d_ff_expert, d), cfg.d_ff_expert),
+            }
+        )
+    else:
+        layer_shapes.update(
+            {
+                "w1": ((pp, lps, d, cfg.d_ff), d),
+                "w2": ((pp, lps, cfg.d_ff, d), cfg.d_ff),
+            }
+        )
+
+    params = {
+        "embed": dense_init(k[0], (cfg.vocab_size, d), d),
+        "final_norm": jnp.ones((d,), cfg.param_dtype),
+        "unembed": dense_init(k[1], (d, cfg.vocab_size), d),
+        "layers": {},
+    }
+    for i, (name, (shape, fan_in)) in enumerate(layer_shapes.items()):
+        if fan_in is None:
+            params["layers"][name] = jnp.ones(shape, cfg.param_dtype)
+        else:
+            params["layers"][name] = dense_init(k[2 + i], shape, fan_in)
+
+    specs = param_specs(cfg)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
+    )
+
+
+# ---------------------------------------------------------------------------
+# Forward pieces (all run inside shard_map on local shards)
+# ---------------------------------------------------------------------------
+
+
+def rms_norm(x, scale, eps):
+    x32 = x.astype(jnp.float32)
+    normed = x32 * lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (normed * scale.astype(jnp.float32)).astype(x.dtype)
+
+
+def rotary(x, positions, theta):
+    """x: [..., T, H, D]; positions: [T]."""
+    dim = x.shape[-1]
+    half = dim // 2
+    freqs = positions[:, None] / (
+        theta ** (jnp.arange(half, dtype=jnp.float32) / half)
+    )  # [T, half]
+    cos = jnp.cos(freqs)[None, :, None, :].astype(x.dtype)
+    sin = jnp.sin(freqs)[None, :, None, :].astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+
+
+def _attention_block(p, x, cfg: TransformerConfig, t_local: int):
+    """Megatron column/row parallel attention with ring attention over sp."""
+    heads_local = cfg.n_heads // lax.psum(1, "tp")
+    positions = (
+        lax.axis_index("sp") * t_local + jnp.arange(t_local, dtype=jnp.float32)
+    )
+
+    xn = rms_norm(x, p["ln1"], cfg.norm_eps)
+    compute = cfg.dtype
+
+    def proj(w):
+        y = jnp.einsum(
+            "btd,df->btf", xn.astype(compute), w.astype(compute)
+        )
+        return y.reshape(*y.shape[:-1], heads_local, cfg.head_dim)
+
+    q = rotary(proj(p["wq"]), positions, cfg.rope_theta)
+    key = rotary(proj(p["wk"]), positions, cfg.rope_theta)
+    value = proj(p["wv"])
+
+    attn = ring_attention(q, key, value, "sp", causal=True)
+    attn = attn.reshape(*attn.shape[:-2], heads_local * cfg.head_dim)
+    out = jnp.einsum("btf,fd->btd", attn.astype(compute), p["wo"].astype(compute))
+    out = lax.psum(out, "tp")
+    return x + out.astype(x.dtype)
+
+
+def _dense_mlp(p, xn, cfg):
+    compute = cfg.dtype
+    h = jax.nn.silu(
+        jnp.einsum("btd,df->btf", xn.astype(compute), p["w1"].astype(compute))
+    )
+    out = jnp.einsum("btf,fd->btd", h, p["w2"].astype(compute))
+    return lax.psum(out, "tp")
+
+
+def _moe_mlp(p, xn, cfg):
+    """Dense-dispatch MoE: local experts on all local tokens, gate-weighted
+    partial outputs psum'd over ('ep', 'tp')."""
+    compute = cfg.dtype
+    ep = lax.psum(1, "ep")
+    e_local = cfg.n_experts // ep
+    gates = jax.nn.softmax(
+        jnp.einsum(
+            "btd,de->bte", xn.astype(jnp.float32), p["wg"].astype(jnp.float32)
+        ),
+        axis=-1,
+    )  # [B, T, E_global], f32 for routing stability
+    start = lax.axis_index("ep") * e_local
+    gates_local = lax.dynamic_slice_in_dim(gates, start, e_local, axis=2)
+
+    h = jax.nn.silu(
+        jnp.einsum("btd,edf->ebtf", xn.astype(compute), p["we1"].astype(compute))
+    )
+    y = jnp.einsum("ebtf,efd->ebtd", h, p["we2"].astype(compute))
+    out = jnp.einsum("ebtd,bte->btd", y, gates_local.astype(compute))
+    return lax.psum(out, ("ep", "tp"))
+
+
+def _layer(p, x, cfg: TransformerConfig, t_local: int):
+    x = _attention_block(p, x, cfg, t_local)
+    xn = rms_norm(x, p["ln2"], cfg.norm_eps)
+    if "wg" in p:
+        out = _moe_mlp(p, xn, cfg)
+    else:
+        out = _dense_mlp(p, xn, cfg)
+    return x + out.astype(x.dtype)
+
+
+def _stage_fn(stage_params, x, cfg: TransformerConfig):
+    """One pipeline stage: scan over this stage's layers."""
+    t_local = x.shape[-2]
+
+    def body(x, layer_p):
+        fn = partial(_layer, cfg=cfg, t_local=t_local)
+        if cfg.remat:
+            fn = jax.checkpoint(fn)
+        return fn(layer_p, x), None
+
+    x, _ = lax.scan(body, x, stage_params)
+    return x
+
+
+def _embed_tokens(embed, tokens, cfg):
+    """Vocab-sharded embedding lookup: one-hot matmul + psum('tp')."""
+    v_local = embed.shape[0]
+    start = lax.axis_index("tp") * v_local
+    local_ids = tokens - start
+    one_hot = jax.nn.one_hot(local_ids, v_local, dtype=cfg.dtype)
+    x = jnp.einsum("btv,vd->btd", one_hot, embed.astype(cfg.dtype))
+    return lax.psum(x, "tp")
+
+
+def _sharded_softmax_xent(logits, targets, v_start):
+    """Cross-entropy with a vocab-sharded logits tensor.
+
+    logits: [B, T, V_local] (local vocab shard), targets: [B, T] global ids.
+    Returns per-token loss [B, T] (replicated over tp after the psums).
+    """
+    logits = logits.astype(jnp.float32)
+    # The max shift is a numerical constant; stop_gradient keeps pmax out of
+    # the backward graph (it has no differentiation rule, and needs none).
+    local_max = jnp.max(lax.stop_gradient(logits), axis=-1)
+    global_max = lax.pmax(local_max, "tp")
+    sumexp = jnp.sum(jnp.exp(logits - global_max[..., None]), axis=-1)
+    lse = jnp.log(lax.psum(sumexp, "tp")) + global_max
+
+    v_local = logits.shape[-1]
+    local_ids = targets - v_start
+    in_shard = jnp.logical_and(local_ids >= 0, local_ids < v_local)
+    one_hot = jax.nn.one_hot(jnp.where(in_shard, local_ids, 0), v_local)
+    tgt = jnp.sum(logits * one_hot, axis=-1) * in_shard
+    tgt = lax.psum(tgt, "tp")
+    return lse - tgt
+
+
+# ---------------------------------------------------------------------------
+# Top-level programs
+# ---------------------------------------------------------------------------
+
+
+def _local_loss_fn(params, inputs, targets, mask, cfg: TransformerConfig, n_micro):
+    """Runs on each device's shards; returns (loss_sum, token_count)."""
+    pp = lax.psum(1, "pp")
+    x = _embed_tokens(params["embed"], inputs, cfg)  # [B_loc, T_loc, d]
+    b_local = x.shape[0]
+    mb = b_local // n_micro
+    x_mbs = x.reshape(n_micro, mb, *x.shape[1:])
+
+    stage_params = jax.tree.map(lambda a: a[0], params["layers"])
+    out = pipeline_apply(
+        partial(_stage_fn, cfg=cfg), stage_params, x_mbs, "pp"
+    )  # [n_micro, mb, T_loc, d]
+    out = out.reshape(b_local, *out.shape[2:])
+
+    xn = rms_norm(out, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum(
+        "btd,dv->btv", xn.astype(cfg.dtype), params["unembed"].astype(cfg.dtype)
+    )
+    v_local = logits.shape[-1]
+    v_start = lax.axis_index("tp") * v_local
+    per_token = _sharded_softmax_xent(logits, targets, v_start)
+
+    is_last = lax.axis_index("pp") == pp - 1
+    per_token = jnp.where(is_last, per_token * mask, 0.0)
+    count = jnp.where(is_last, jnp.sum(mask), 0.0)
+
+    # Sums reduce over every data-ish axis, 'ep' included: the MoE pipeline
+    # carry is typed ep-varying while the dense path is ep-invariant, so both
+    # values are first pvary'd to a uniform type. The replicated contribution
+    # scales numerator and denominator by ep equally — the mean is unchanged
+    # and the output type becomes fully invariant.
+    def _reduce(x):
+        missing = tuple(
+            {"dp", "sp", "pp", "ep"} - getattr(jax.typeof(x), "vma", frozenset())
+        )
+        x = lax.pvary(x, missing) if missing else x
+        return lax.psum(x, ("dp", "sp", "pp", "ep"))
+
+    return _reduce(jnp.sum(per_token)), _reduce(count)
+
+
+def build_train_step(config: TransformerConfig, mesh: Mesh, optimizer):
+    """Returns jitted train_step(params, opt_state, batch) -> (params,
+    opt_state, loss). Model runs under shard_map with explicit collectives;
+    the elementwise optimizer update runs outside and inherits shardings."""
+    cfg = config
+    specs = param_specs(cfg)
+    n_micro = cfg.n_microbatches or axis_size(mesh, "pp")
+
+    def local_grads(params, inputs, targets, mask):
+        def scalar_loss(p):
+            loss_sum, total = _local_loss_fn(p, inputs, targets, mask, cfg, n_micro)
+            return loss_sum / jnp.maximum(total, 1.0)
+
+        # No manual gradient psum: under shard_map's VMA typing, parameters
+        # enter invariant over their replicated axes, every use inserts a
+        # pvary, and the transpose of pvary IS the psum over those axes — so
+        # AD returns fully-reduced gradients. Adding a manual psum here
+        # would double-count (verified by differential test vs single-device).
+        return jax.value_and_grad(scalar_loss)(params)
+
+    sharded_grads = jax.shard_map(
+        local_grads,
+        mesh=mesh,
+        in_specs=(specs, P("dp", "sp"), P("dp", "sp"), P("dp", "sp")),
+        out_specs=(P(), specs),
+    )
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(params, opt_state, batch):
+        mask = batch.get("mask")
+        if mask is None:
+            mask = jnp.ones_like(batch["targets"], jnp.float32)
+        loss, grads = sharded_grads(
+            params, batch["inputs"], batch["targets"], mask.astype(jnp.float32)
+        )
+        updates, new_opt_state = optimizer.update(grads, opt_state, params)
+        new_params = jax.tree.map(
+            lambda p, u: (p + u).astype(p.dtype), params, updates
+        )
+        return new_params, new_opt_state, loss
+
+    return train_step
+
+
+def build_forward(config: TransformerConfig, mesh: Mesh):
+    """Jitted forward(params, tokens) -> logits [B, T, vocab] (tp-gathered).
+    Used for evaluation and the single-chip entry point."""
+    cfg = config
+    specs = param_specs(cfg)
+    n_micro = cfg.n_microbatches or axis_size(mesh, "pp")
+
+    def local_forward(params, tokens):
+        pp = lax.psum(1, "pp")
+        x = _embed_tokens(params["embed"], tokens, cfg)
+        b_local = x.shape[0]
+        mb_count = min(n_micro, b_local) or 1
+        x_mbs = x.reshape(mb_count, b_local // mb_count, *x.shape[1:])
+        stage_params = jax.tree.map(lambda a: a[0], params["layers"])
+        out = pipeline_apply(partial(_stage_fn, cfg=cfg), stage_params, x_mbs, "pp")
+        out = out.reshape(b_local, *out.shape[2:])
+        # Broadcast the last stage's result to every pp rank.
+        is_last = lax.axis_index("pp") == pp - 1
+        out = lax.psum(jnp.where(is_last, out, 0.0), "pp")
+        xn = rms_norm(out, params["final_norm"], cfg.norm_eps)
+        # Vocab stays sharded; the out_spec concatenates the tp shards into
+        # the global [B, T, vocab] array — no gather collective needed.
+        return jnp.einsum(
+            "btd,dv->btv", xn.astype(cfg.dtype), params["unembed"].astype(cfg.dtype)
+        )
+
+    return jax.jit(
+        jax.shard_map(
+            local_forward,
+            mesh=mesh,
+            in_specs=(specs, P("dp", "sp")),
+            out_specs=P("dp", "sp", "tp"),
+        )
+    )

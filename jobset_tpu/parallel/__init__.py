@@ -1,0 +1,25 @@
+"""Parallelism layer: device mesh, ring attention, pipeline transform."""
+
+from .mesh import (
+    AXIS_NAMES,
+    MeshConfig,
+    axis_size,
+    build_mesh,
+    default_mesh_config,
+    sharding,
+    single_device_mesh,
+)
+from .pipeline import pipeline_apply
+from .ring_attention import ring_attention
+
+__all__ = [
+    "AXIS_NAMES",
+    "MeshConfig",
+    "axis_size",
+    "build_mesh",
+    "default_mesh_config",
+    "sharding",
+    "single_device_mesh",
+    "pipeline_apply",
+    "ring_attention",
+]

@@ -1,0 +1,102 @@
+"""Device mesh construction for the workload plane.
+
+The JobSet control plane maps "one replicated worker group <-> one TPU
+slice" (SURVEY.md §2.3); inside the pods, this module turns the visible
+devices into a named `jax.sharding.Mesh` with the five canonical parallelism
+axes:
+
+    dp  — data parallel (batch)
+    sp  — sequence/context parallel (ring attention dimension)
+    tp  — tensor parallel (heads / hidden shards, highest-bandwidth axis)
+    pp  — pipeline parallel (layer stages)
+    ep  — expert parallel (MoE experts)
+
+Axis order follows the TPU fabric hierarchy: tp innermost (needs ICI
+all-reduce bandwidth), then sp (ring permutes), ep, pp (point-to-point
+only), dp outermost (can ride DCN between slices).  Every axis always
+exists — axes of size 1 make collectives identity ops — so the same
+shard_map'd program runs unchanged from 1 chip to a full pod slice.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+# Outer-to-inner device-mesh order (innermost varies fastest over ICI
+# neighbors, so tp gets the tightest torus links).
+AXIS_NAMES = ("dp", "pp", "ep", "sp", "tp")
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    dp: int = 1
+    pp: int = 1
+    ep: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    @property
+    def shape(self) -> tuple[int, ...]:
+        return (self.dp, self.pp, self.ep, self.sp, self.tp)
+
+    @property
+    def num_devices(self) -> int:
+        return int(np.prod(self.shape))
+
+    def __post_init__(self):
+        for name, size in zip(AXIS_NAMES, self.shape):
+            if size < 1:
+                raise ValueError(f"mesh axis {name} must be >= 1, got {size}")
+
+
+def default_mesh_config(n_devices: int) -> MeshConfig:
+    """Factor a device count into a balanced config, preferring tp, then sp,
+    then pp (dp gets the remainder)."""
+    remaining = n_devices
+    tp = _take_factor(remaining, 2)
+    remaining //= tp
+    sp = _take_factor(remaining, 2)
+    remaining //= sp
+    pp = _take_factor(remaining, 2)
+    remaining //= pp
+    return MeshConfig(dp=remaining, pp=pp, ep=1, sp=sp, tp=tp)
+
+
+def _take_factor(n: int, f: int) -> int:
+    return f if n % f == 0 and n >= f else 1
+
+
+def build_mesh(
+    config: Optional[MeshConfig] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    if config is None:
+        config = default_mesh_config(len(devices))
+    if config.num_devices != len(devices):
+        raise ValueError(
+            f"mesh config {config.shape} needs {config.num_devices} devices, "
+            f"got {len(devices)}"
+        )
+    array = np.asarray(devices).reshape(config.shape)
+    return Mesh(array, AXIS_NAMES)
+
+
+def single_device_mesh(device: Optional[jax.Device] = None) -> Mesh:
+    """All axes present at size 1: the same SPMD program runs on one chip."""
+    device = device if device is not None else jax.devices()[0]
+    return build_mesh(MeshConfig(), [device])
+
+
+def sharding(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def axis_size(mesh: Mesh, name: str) -> int:
+    return mesh.shape[name]

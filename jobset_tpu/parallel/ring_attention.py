@@ -1,0 +1,110 @@
+"""Ring attention: exact causal attention over a sequence-sharded axis.
+
+Long-context support the reference entirely lacks (SURVEY.md §2.2 row
+SP/CP): each `sp` rank holds a contiguous sequence chunk; K/V blocks rotate
+around the ring with `lax.ppermute` while a running online-softmax
+accumulator (max, sum, weighted values — the flash-attention recurrence)
+folds in one block per step.  Peak memory is O(T_local^2) instead of O(T^2),
+communication is sp-1 neighbor permutes riding the ICI torus, and the
+computation is exact (not windowed).
+
+Runs inside `shard_map`; with sp=1 the loop body executes once and the
+permute is the identity, so the same code path serves single-chip runs.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1.0e30
+
+
+def _block_attention(q, k, v, bias):
+    """One (q-block, kv-block) flash step.
+
+    q: [B, Tq, H, D], k/v: [B, Tk, H, D], bias: [Tq, Tk] additive mask.
+    Returns (scores_max [B,H,Tq], exp_sum [B,H,Tq], weighted_v [B,Tq,H,D]).
+    """
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    logits = logits + bias[None, None, :, :]
+    block_max = jnp.max(logits, axis=-1)  # [B,H,Tq]
+    probs = jnp.exp(logits - block_max[..., None])
+    # Fully-masked rows: exp(-inf - -inf)=exp(0)=1 would pollute; zero them.
+    valid = block_max > NEG_INF / 2
+    probs = jnp.where(valid[..., None], probs, 0.0)
+    block_sum = jnp.sum(probs, axis=-1)  # [B,H,Tq]
+    weighted = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    return block_max, block_sum, weighted
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = True):
+    """Exact attention with K/V rotating around `axis_name`.
+
+    q/k/v: [B, T_local, H_local, D] per-rank chunks (already head-sharded by
+    tp outside). Sequence chunks are laid out in ring order: global position
+    of rank r covers [r*T_local, (r+1)*T_local).
+    Returns [B, T_local, H_local, D].
+    """
+    sp = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    out_dtype = q.dtype
+    # Softmax statistics accumulate in f32 regardless of compute dtype
+    # (bf16 accumulators lose the online-softmax recurrence's precision).
+    q, k, v = (x.astype(jnp.float32) for x in (q, k, v))
+    batch, t_local, heads, dim = q.shape
+
+    rel = jnp.arange(t_local)[:, None] - jnp.arange(t_local)[None, :]
+    tri_bias = jnp.where(rel >= 0, 0.0, NEG_INF).astype(q.dtype)  # causal block-diag
+    zero_bias = jnp.zeros((t_local, t_local), q.dtype)
+    full_mask = jnp.full((t_local, t_local), NEG_INF, q.dtype)
+
+    def step(carry, r):
+        k_blk, v_blk, acc_max, acc_sum, acc_out = carry
+        kv_idx = (my_idx - r) % sp  # which global chunk this block holds
+
+        if causal:
+            bias = jnp.where(
+                kv_idx == my_idx,
+                tri_bias,
+                jnp.where(kv_idx < my_idx, zero_bias, full_mask),
+            )
+        else:
+            bias = zero_bias
+
+        blk_max, blk_sum, blk_out = _block_attention(q, k_blk, v_blk, bias)
+
+        new_max = jnp.maximum(acc_max, blk_max)
+        old_scale = jnp.exp(acc_max - new_max)
+        blk_scale = jnp.exp(blk_max - new_max)
+        acc_sum = acc_sum * old_scale + blk_sum * blk_scale
+        acc_out = (
+            acc_out * old_scale.transpose(0, 2, 1)[..., None]
+            + blk_out * blk_scale.transpose(0, 2, 1)[..., None]
+        )
+
+        # Rotate K/V to the next rank (skip after the last fold).
+        perm = [(i, (i + 1) % sp) for i in range(sp)]
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return (k_blk, v_blk, new_max, acc_sum, acc_out), None
+
+    # Initial accumulators are constants, but the scan carry becomes varying
+    # across the mesh axes after one fold — mark them varying up front so the
+    # scan's carry types are stable under shard_map's VMA check.
+    def _varying(x):
+        vma = getattr(jax.typeof(q), "vma", frozenset())
+        missing = tuple(vma - getattr(jax.typeof(x), "vma", frozenset()))
+        return lax.pvary(x, missing) if missing else x
+
+    acc_max0 = _varying(jnp.full((batch, heads, t_local), NEG_INF, q.dtype))
+    acc_sum0 = _varying(jnp.zeros((batch, heads, t_local), q.dtype))
+    acc_out0 = _varying(jnp.zeros_like(q))
+    (_, _, _, acc_sum, acc_out), _ = lax.scan(
+        step, (k, v, acc_max0, acc_sum0, acc_out0), jnp.arange(sp)
+    )
+
+    denom = jnp.maximum(acc_sum, 1e-20).transpose(0, 2, 1)[..., None]
+    return (acc_out / denom).astype(out_dtype)

@@ -1,0 +1,135 @@
+"""In-pod rendezvous: JobSet identity -> jax.distributed.
+
+The control plane guarantees each pod (a) a stable hostname
+`<jobset>-<rjob>-<jobIdx>-<podIdx>.<subdomain>` resolvable before readiness
+(publishNotReadyAddresses, SURVEY.md §2.3), (b) identity labels/annotations
+(job index, global job index, replicas), and (c) the coordinator endpoint
+annotation when `spec.coordinator` is set.  This module is the TPU-side
+counterpart: it reads that contract from the environment the runtime injects
+into containers (the analog of torchrun reading MASTER_ADDR in the
+reference's pytorch example, site/content/en/docs/concepts/_index.md:37-51)
+and boots the JAX distributed runtime, so `jax.devices()` spans every pod in
+the gang and one `Mesh` can be laid over the whole JobSet.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+# Environment contract (injected by the runtime / container spec).
+ENV_JOBSET_NAME = "JOBSET_NAME"
+ENV_REPLICATED_JOB = "JOBSET_REPLICATED_JOB"
+ENV_JOB_INDEX = "JOBSET_JOB_INDEX"
+ENV_JOB_GLOBAL_INDEX = "JOBSET_JOB_GLOBAL_INDEX"
+ENV_POD_INDEX = "JOBSET_POD_INDEX"
+ENV_PODS_PER_JOB = "JOBSET_PODS_PER_JOB"
+ENV_TOTAL_PROCESSES = "JOBSET_TOTAL_PROCESSES"
+ENV_COORDINATOR = "JOBSET_COORDINATOR"  # <hostname>.<subdomain>[:port]
+
+DEFAULT_COORDINATOR_PORT = 8476
+
+
+@dataclass(frozen=True)
+class RankInfo:
+    """Identity of this process within the JobSet gang."""
+
+    jobset_name: str
+    replicated_job: str
+    job_index: int
+    job_global_index: int
+    pod_index: int
+    pods_per_job: int
+    total_processes: int
+    coordinator: str
+
+    @property
+    def process_id(self) -> int:
+        """Global rank: jobs are laid out by global job index, pods within a
+        job by completion index — matching the DNS naming order so rank k's
+        hostname is deterministic."""
+        return self.job_global_index * self.pods_per_job + self.pod_index
+
+    @property
+    def coordinator_address(self) -> str:
+        addr = self.coordinator
+        if ":" not in addr:
+            addr = f"{addr}:{DEFAULT_COORDINATOR_PORT}"
+        return addr
+
+
+def rank_from_env(env: Optional[dict] = None) -> RankInfo:
+    env = env if env is not None else dict(os.environ)
+
+    def need(key):
+        if key not in env:
+            raise KeyError(f"missing JobSet rendezvous env var: {key}")
+        return env[key]
+
+    return RankInfo(
+        jobset_name=need(ENV_JOBSET_NAME),
+        replicated_job=need(ENV_REPLICATED_JOB),
+        job_index=int(need(ENV_JOB_INDEX)),
+        job_global_index=int(need(ENV_JOB_GLOBAL_INDEX)),
+        pod_index=int(env.get(ENV_POD_INDEX, "0")),
+        pods_per_job=int(env.get(ENV_PODS_PER_JOB, "1")),
+        total_processes=int(need(ENV_TOTAL_PROCESSES)),
+        coordinator=need(ENV_COORDINATOR),
+    )
+
+
+def pod_env_for(cluster, pod) -> dict:
+    """Control-plane side: materialize the rendezvous env for a simulated pod
+    (what the real deployment's downward API / container env would inject)."""
+    from ..api import keys
+
+    annotations = pod.annotations
+    labels = pod.labels
+    js = cluster.get_jobset(
+        pod.metadata.namespace, annotations.get(keys.JOBSET_NAME_KEY, "")
+    )
+    total = 0
+    pods_per_job = 1
+    if js is not None:
+        for rjob in js.spec.replicated_jobs:
+            expected = rjob.template.spec.parallelism or 1
+            if rjob.template.spec.completions is not None:
+                expected = min(expected, rjob.template.spec.completions)
+            total += int(rjob.replicas) * expected
+            if rjob.name == labels.get(keys.REPLICATED_JOB_NAME_KEY):
+                pods_per_job = expected
+    coordinator = annotations.get(keys.COORDINATOR_KEY)
+    if not coordinator and js is not None:
+        # Default coordinator: pod 0 of job 0 of the first replicated job.
+        from ..api.types import get_subdomain
+
+        first = js.spec.replicated_jobs[0].name if js.spec.replicated_jobs else ""
+        coordinator = f"{js.name}-{first}-0-0.{get_subdomain(js)}"
+
+    return {
+        ENV_JOBSET_NAME: annotations.get(keys.JOBSET_NAME_KEY, ""),
+        ENV_REPLICATED_JOB: labels.get(keys.REPLICATED_JOB_NAME_KEY, ""),
+        ENV_JOB_INDEX: labels.get(keys.JOB_INDEX_KEY, "0"),
+        ENV_JOB_GLOBAL_INDEX: labels.get(keys.JOB_GLOBAL_INDEX_KEY, "0"),
+        ENV_POD_INDEX: annotations.get(keys.POD_COMPLETION_INDEX_KEY, "0"),
+        ENV_PODS_PER_JOB: str(pods_per_job),
+        ENV_TOTAL_PROCESSES: str(total),
+        ENV_COORDINATOR: coordinator or "",
+    }
+
+
+def initialize(rank: Optional[RankInfo] = None, **kwargs) -> RankInfo:
+    """Boot jax.distributed from the JobSet contract. No-op for single-process
+    gangs (total_processes == 1)."""
+    import jax
+
+    rank = rank if rank is not None else rank_from_env()
+    if rank.total_processes > 1:
+        jax.distributed.initialize(
+            coordinator_address=rank.coordinator_address,
+            num_processes=rank.total_processes,
+            process_id=rank.process_id,
+            **kwargs,
+        )
+    return rank

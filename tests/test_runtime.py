@@ -1,0 +1,84 @@
+"""Runtime rendezvous tests: the env contract between the control plane's
+pods and jax.distributed (DNS/coordinator contract of SURVEY.md §2.3)."""
+
+from jobset_tpu.api import Coordinator, keys
+from jobset_tpu.core import make_cluster
+from jobset_tpu.runtime.distributed import (
+    RankInfo,
+    pod_env_for,
+    rank_from_env,
+)
+from jobset_tpu.testing import make_jobset, make_replicated_job
+
+
+def build_cluster():
+    cluster = make_cluster()
+    cluster.add_topology("rack", num_domains=4, nodes_per_domain=4, capacity=16)
+    js = (
+        make_jobset("train")
+        .coordinator(Coordinator(replicated_job="driver", job_index=0, pod_index=0))
+        .replicated_job(
+            make_replicated_job("driver").replicas(1).parallelism(1).completions(1).obj()
+        )
+        .replicated_job(
+            make_replicated_job("workers").replicas(2).parallelism(2).completions(2).obj()
+        )
+        .obj()
+    )
+    cluster.create_jobset(js)
+    cluster.run_until_stable()
+    return cluster
+
+
+def test_pod_env_round_trips_to_rank_info():
+    cluster = build_cluster()
+    pod = cluster.resolve_hostname("default", "train-workers-1-1.train")
+    env = pod_env_for(cluster, pod)
+    rank = rank_from_env(env)
+    assert rank.jobset_name == "train"
+    assert rank.replicated_job == "workers"
+    assert rank.job_index == 1
+    assert rank.job_global_index == 2  # driver(1 job) + workers job 1
+    assert rank.pod_index == 1
+    assert rank.pods_per_job == 2
+    # driver 1 pod + 2 worker jobs x 2 pods
+    assert rank.total_processes == 5
+    assert rank.coordinator == "train-driver-0-0.train"
+    assert rank.coordinator_address.endswith(":8476")
+
+
+def test_process_ids_are_unique_and_dense_per_group():
+    cluster = build_cluster()
+    ranks = []
+    for job_idx in range(2):
+        for pod_idx in range(2):
+            pod = cluster.resolve_hostname(
+                "default", f"train-workers-{job_idx}-{pod_idx}.train"
+            )
+            ranks.append(rank_from_env(pod_env_for(cluster, pod)).process_id)
+    # workers occupy global jobs 1..2, two pods each -> ids 2..5
+    assert sorted(ranks) == [2, 3, 4, 5]
+
+
+def test_driver_is_process_zero():
+    cluster = build_cluster()
+    pod = cluster.resolve_hostname("default", "train-driver-0-0.train")
+    rank = rank_from_env(pod_env_for(cluster, pod))
+    assert rank.process_id == 0
+
+
+def test_coordinator_defaults_to_first_pod_without_spec():
+    cluster = make_cluster()
+    cluster.add_topology("rack", num_domains=2, nodes_per_domain=2, capacity=8)
+    js = (
+        make_jobset("nc")
+        .replicated_job(
+            make_replicated_job("w").replicas(1).parallelism(1).completions(1).obj()
+        )
+        .obj()
+    )
+    cluster.create_jobset(js)
+    cluster.run_until_stable()
+    pod = cluster.resolve_hostname("default", "nc-w-0-0.nc")
+    env = pod_env_for(cluster, pod)
+    assert env["JOBSET_COORDINATOR"] == "nc-w-0-0.nc"

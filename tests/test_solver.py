@@ -268,3 +268,56 @@ def test_planned_domain_claim_released_on_jobset_delete():
         cluster.delete_jobset("default", "js")
         occupancy = cluster.domain_job_keys.get(TOPOLOGY, {})
         assert all(not owners for owners in occupancy.values())
+
+
+def test_planned_job_survives_suspend_resume_with_competing_jobset():
+    """Regression (review): a suspended solver-planned JobSet must keep its
+    domain claims so resume doesn't wedge on a domain another JobSet took."""
+    with features.gate("TPUPlacementSolver", True):
+        cluster = solver_cluster(num_domains=2)
+
+        def one_job(name):
+            return (
+                make_jobset(name)
+                .exclusive_placement(TOPOLOGY)
+                .replicated_job(
+                    make_replicated_job("w").replicas(1).parallelism(2).completions(2).obj()
+                )
+                .obj()
+            )
+
+        js_a = cluster.create_jobset(one_job("a"))
+        cluster.run_until_stable()
+        a_domain = {
+            cluster.nodes[p.spec.node_name].labels[TOPOLOGY]
+            for p in cluster.pods.values()
+        }
+
+        # Suspend A; create B (must take the OTHER domain); resume A.
+        upd = js_a.clone()
+        upd.spec.suspend = True
+        cluster.update_jobset(upd)
+        cluster.run_until_stable()
+
+        cluster.create_jobset(one_job("b"))
+        cluster.run_until_stable()
+        b_domains = {
+            cluster.nodes[p.spec.node_name].labels[TOPOLOGY]
+            for p in cluster.pods.values()
+            if p.spec.node_name
+        }
+        assert b_domains.isdisjoint(a_domain)
+
+        upd = cluster.get_jobset("default", "a").clone()
+        upd.spec.suspend = False
+        cluster.update_jobset(upd)
+        cluster.run_until_stable()
+        a_pods = [
+            p for p in cluster.pods.values()
+            if p.annotations.get("jobset.sigs.k8s.io/jobset-name") == "a"
+        ]
+        assert len(a_pods) == 2
+        assert all(p.spec.node_name for p in a_pods)
+        assert {
+            cluster.nodes[p.spec.node_name].labels[TOPOLOGY] for p in a_pods
+        } == a_domain

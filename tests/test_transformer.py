@@ -1,0 +1,148 @@
+"""Flagship transformer tests: training convergence on the full 5-axis mesh,
+dense vs MoE, and the decisive differential test — the sharded program must
+produce the same loss as the identical program on a single device."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from jobset_tpu.models import TransformerConfig, build_forward, build_train_step, init_params
+from jobset_tpu.parallel import MeshConfig, build_mesh
+
+MESH_CONFIG = MeshConfig(dp=1, pp=2, ep=1, sp=2, tp=2)
+
+
+def tiny_config(**overrides):
+    defaults = dict(
+        vocab_size=64,
+        d_model=32,
+        n_heads=4,
+        d_ff=64,
+        n_layers=4,
+        max_seq_len=32,
+        dtype=jnp.float32,
+        remat=True,
+    )
+    defaults.update(overrides)
+    return TransformerConfig(**defaults)
+
+
+def make_batch(mesh, vocab, batch=4, seq=16, seed=0):
+    rng = np.random.default_rng(seed)
+    sharding_spec = NamedSharding(mesh, P("dp", "sp"))
+    return {
+        "inputs": jax.device_put(
+            jnp.asarray(rng.integers(0, vocab, (batch, seq))), sharding_spec
+        ),
+        "targets": jax.device_put(
+            jnp.asarray(rng.integers(0, vocab, (batch, seq))), sharding_spec
+        ),
+    }
+
+
+def run_steps(cfg, mesh, batch, steps=6, seed=0):
+    params = init_params(jax.random.key(seed), cfg, mesh)
+    opt = optax.adamw(1e-3)
+    opt_state = opt.init(params)
+    step = build_train_step(cfg, mesh, opt)
+    losses = []
+    for _ in range(steps):
+        params, opt_state, loss = step(params, opt_state, batch)
+        losses.append(float(loss))
+    return params, losses
+
+
+def test_dense_training_loss_decreases():
+    mesh = build_mesh(MESH_CONFIG)
+    cfg = tiny_config()
+    cfg.validate(MESH_CONFIG)
+    _, losses = run_steps(cfg, mesh, make_batch(mesh, cfg.vocab_size))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_moe_training_loss_decreases():
+    mesh = build_mesh(MESH_CONFIG)
+    cfg = tiny_config(n_experts=4, d_ff_expert=32)
+    cfg.validate(MESH_CONFIG)
+    _, losses = run_steps(cfg, mesh, make_batch(mesh, cfg.vocab_size))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_sharded_loss_matches_single_device():
+    """The same initial params + batch must give the same loss trajectory on
+    the (pp=2, sp=2, tp=2) mesh as on one device — the sharding is an
+    implementation detail, not a model change."""
+    cfg = tiny_config(remat=False)
+    mesh_multi = build_mesh(MESH_CONFIG)
+    mesh_single = build_mesh(MeshConfig(), jax.devices()[:1])
+
+    batch_np = {
+        "inputs": np.random.default_rng(5).integers(0, cfg.vocab_size, (4, 16)),
+        "targets": np.random.default_rng(6).integers(0, cfg.vocab_size, (4, 16)),
+    }
+
+    losses = {}
+    for name, mesh in (("multi", mesh_multi), ("single", mesh_single)):
+        params = init_params(jax.random.key(7), cfg, mesh)
+        opt = optax.sgd(1e-2)
+        opt_state = opt.init(params)
+        step = build_train_step(cfg, mesh, opt)
+        sharding_spec = NamedSharding(mesh, P("dp", "sp"))
+        batch = {
+            k: jax.device_put(jnp.asarray(v), sharding_spec)
+            for k, v in batch_np.items()
+        }
+        run = []
+        for _ in range(3):
+            params, opt_state, loss = step(params, opt_state, batch)
+            run.append(float(loss))
+        losses[name] = run
+
+    np.testing.assert_allclose(losses["multi"], losses["single"], rtol=2e-4)
+
+
+def test_forward_shapes_and_determinism():
+    mesh = build_mesh(MESH_CONFIG)
+    cfg = tiny_config()
+    params = init_params(jax.random.key(0), cfg, mesh)
+    fwd = build_forward(cfg, mesh)
+    batch = make_batch(mesh, cfg.vocab_size)
+    out1 = fwd(params, batch["inputs"])
+    out2 = fwd(params, batch["inputs"])
+    assert out1.shape == (4, 16, cfg.vocab_size)
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+
+
+def test_loss_mask_excludes_padding():
+    mesh = build_mesh(MESH_CONFIG)
+    cfg = tiny_config()
+    params = init_params(jax.random.key(0), cfg, mesh)
+    opt = optax.sgd(0.0)  # no updates; just read the loss
+    opt_state = opt.init(params)
+    step = build_train_step(cfg, mesh, opt)
+    batch = make_batch(mesh, cfg.vocab_size)
+
+    full_mask = jnp.ones((4, 16), jnp.float32)
+    half_mask = full_mask.at[:, 8:].set(0.0)
+    spec = NamedSharding(mesh, P("dp", "sp"))
+    _, _, loss_full = step(params, opt_state, {**batch, "mask": jax.device_put(full_mask, spec)})
+    params2 = init_params(jax.random.key(0), cfg, mesh)
+    opt_state2 = opt.init(params2)
+    _, _, loss_half = step(params2, opt_state2, {**batch, "mask": jax.device_put(half_mask, spec)})
+    assert not np.isclose(float(loss_full), float(loss_half))
+    assert np.isfinite(float(loss_half))
+
+
+def test_config_validation_rejects_bad_shapes():
+    with pytest.raises(ValueError):
+        tiny_config(n_layers=3).validate(MESH_CONFIG)  # not divisible by pp
+    with pytest.raises(ValueError):
+        tiny_config(vocab_size=63).validate(MESH_CONFIG)  # vocab % tp
+    with pytest.raises(ValueError):
+        tiny_config(n_heads=3, d_model=33).validate(MESH_CONFIG)
