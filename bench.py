@@ -72,6 +72,7 @@ def run_mode(solver_on: bool, args) -> dict:
 
     topology_key = "tpu-slice"
     total_pods = args.replicas * args.pods_per_job
+    metrics.reset()  # per-mode percentiles, not a blend across modes
 
     with features.gate("TPUPlacementSolver", solver_on):
         cluster = build_cluster(args.domains, args.nodes_per_domain, topology_key)

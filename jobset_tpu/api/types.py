@@ -115,6 +115,15 @@ class JobSpec:
     active_deadline_seconds: Optional[int] = None
     template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
 
+    def pods_expected(self) -> int:
+        """min(parallelism, completions) — the single definition of a job's
+        expected pod count, shared by status math, placement capacity, pod
+        creation and rank assignment (jobset_controller.go:340-350)."""
+        parallelism = self.parallelism if self.parallelism is not None else 1
+        if self.completions is not None and self.completions < parallelism:
+            return self.completions
+        return parallelism
+
 
 @dataclass
 class JobTemplateSpec:

@@ -119,12 +119,7 @@ class Job:
         return bool(self.spec.suspend)
 
     def pods_expected(self) -> int:
-        """min(parallelism, completions): total expected pod count used by the
-        ready math (jobset_controller.go:340-350)."""
-        parallelism = self.spec.parallelism if self.spec.parallelism is not None else 1
-        if self.spec.completions is not None and self.spec.completions < parallelism:
-            return self.spec.completions
-        return parallelism
+        return self.spec.pods_expected()
 
 
 @dataclass

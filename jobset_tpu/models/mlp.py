@@ -1,0 +1,76 @@
+"""Small data-parallel MLP regression model.
+
+The parity target for the reference's simple DDP examples
+(`examples/pytorch/cnn-mnist`, SURVEY.md §2.2 DP row): batch sharded over
+the dp axis, parameters replicated, gradients reduced by shard_map's VMA
+transpose exactly as in the flagship transformer.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class MLPConfig:
+    d_in: int = 32
+    d_hidden: int = 128
+    d_out: int = 1
+    n_layers: int = 2
+
+
+def init_params(rng: jax.Array, config: MLPConfig) -> dict:
+    dims = [config.d_in] + [config.d_hidden] * (config.n_layers - 1) + [config.d_out]
+    ks = jax.random.split(rng, len(dims) - 1)
+    return {
+        f"layer_{i}": {
+            "w": jax.random.normal(ks[i], (dims[i], dims[i + 1])) / jnp.sqrt(dims[i]),
+            "b": jnp.zeros((dims[i + 1],)),
+        }
+        for i in range(len(dims) - 1)
+    }
+
+
+def forward(params: dict, x: jax.Array) -> jax.Array:
+    n = len(params)
+    for i in range(n):
+        layer = params[f"layer_{i}"]
+        x = x @ layer["w"] + layer["b"]
+        if i < n - 1:
+            x = jax.nn.relu(x)
+    return x
+
+
+def build_train_step(config: MLPConfig, mesh: Mesh, optimizer):
+    """MSE regression step, data-parallel over ('dp', 'sp') combined."""
+
+    def local_step(params, x, y):
+        def loss_fn(p):
+            pred = forward(p, x)
+            local = jnp.sum((pred - y) ** 2)
+            count = jnp.asarray(x.shape[0], jnp.float32)
+            return lax.psum(local, ("dp", "sp")) / lax.psum(count, ("dp", "sp"))
+
+        return jax.value_and_grad(loss_fn)(params)
+
+    sharded = jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(P(), P(("dp", "sp")), P(("dp", "sp"))),
+        out_specs=(P(), P()),
+    )
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(params, opt_state, batch):
+        loss, grads = sharded(params, batch["x"], batch["y"])
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree.map(lambda p, u: p + u, params, updates)
+        return params, opt_state, loss
+
+    return train_step

@@ -328,8 +328,12 @@ def _local_loss_fn(params, inputs, targets, mask, cfg: TransformerConfig, n_micr
     pp = lax.psum(1, "pp")
     x = _embed_tokens(params["embed"], inputs, cfg)  # [B_loc, T_loc, d]
     b_local = x.shape[0]
-    mb = b_local // n_micro
-    x_mbs = x.reshape(n_micro, mb, *x.shape[1:])
+    if b_local % n_micro:
+        raise ValueError(
+            f"per-device batch {b_local} must be divisible by "
+            f"n_microbatches {n_micro} (global batch % (dp * n_microbatches) == 0)"
+        )
+    x_mbs = x.reshape(n_micro, b_local // n_micro, *x.shape[1:])
 
     stage_params = jax.tree.map(lambda a: a[0], params["layers"])
     out = pipeline_apply(
@@ -419,7 +423,9 @@ def build_forward(config: TransformerConfig, mesh: Mesh):
         pp = lax.psum(1, "pp")
         x = _embed_tokens(params["embed"], tokens, cfg)
         b_local = x.shape[0]
-        mb_count = min(n_micro, b_local) or 1
+        # Largest microbatch count <= n_micro that divides the local batch
+        # (forward tolerates any batch; training enforces divisibility).
+        mb_count = next(m for m in range(min(n_micro, b_local), 0, -1) if b_local % m == 0)
         x_mbs = x.reshape(mb_count, b_local // mb_count, *x.shape[1:])
         stage_params = jax.tree.map(lambda a: a[0], params["layers"])
         out = pipeline_apply(partial(_stage_fn, cfg=cfg), stage_params, x_mbs, "pp")

@@ -79,12 +79,12 @@ def build_mesh(
     devices = list(devices if devices is not None else jax.devices())
     if config is None:
         config = default_mesh_config(len(devices))
-    if config.num_devices != len(devices):
+    if config.num_devices > len(devices):
         raise ValueError(
             f"mesh config {config.shape} needs {config.num_devices} devices, "
             f"got {len(devices)}"
         )
-    array = np.asarray(devices).reshape(config.shape)
+    array = np.asarray(devices[: config.num_devices]).reshape(config.shape)
     return Mesh(array, AXIS_NAMES)
 
 

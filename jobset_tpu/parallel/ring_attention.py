@@ -61,8 +61,8 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True):
     zero_bias = jnp.zeros((t_local, t_local), q.dtype)
     full_mask = jnp.full((t_local, t_local), NEG_INF, q.dtype)
 
-    def step(carry, r):
-        k_blk, v_blk, acc_max, acc_sum, acc_out = carry
+    def fold(acc, k_blk, v_blk, r):
+        acc_max, acc_sum, acc_out = acc
         kv_idx = (my_idx - r) % sp  # which global chunk this block holds
 
         if causal:
@@ -84,27 +84,33 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True):
             acc_out * old_scale.transpose(0, 2, 1)[..., None]
             + blk_out * blk_scale.transpose(0, 2, 1)[..., None]
         )
+        return new_max, acc_sum, acc_out
 
-        # Rotate K/V to the next rank (skip after the last fold).
-        perm = [(i, (i + 1) % sp) for i in range(sp)]
-        k_blk = lax.ppermute(k_blk, axis_name, perm)
-        v_blk = lax.ppermute(v_blk, axis_name, perm)
-        return (k_blk, v_blk, new_max, acc_sum, acc_out), None
-
-    # Initial accumulators are constants, but the scan carry becomes varying
-    # across the mesh axes after one fold — mark them varying up front so the
-    # scan's carry types are stable under shard_map's VMA check.
-    def _varying(x):
-        vma = getattr(jax.typeof(q), "vma", frozenset())
-        missing = tuple(vma - getattr(jax.typeof(x), "vma", frozenset()))
-        return lax.pvary(x, missing) if missing else x
-
-    acc_max0 = _varying(jnp.full((batch, heads, t_local), NEG_INF, q.dtype))
-    acc_sum0 = _varying(jnp.zeros((batch, heads, t_local), q.dtype))
-    acc_out0 = _varying(jnp.zeros_like(q))
-    (_, _, _, acc_sum, acc_out), _ = lax.scan(
-        step, (k, v, acc_max0, acc_sum0, acc_out0), jnp.arange(sp)
+    # Fold the local block first, then sp-1 rotate-then-fold steps — exactly
+    # sp-1 neighbor permutes total, none discarded.
+    acc = fold(
+        (
+            jnp.full((batch, heads, t_local), NEG_INF, q.dtype),
+            jnp.zeros((batch, heads, t_local), q.dtype),
+            jnp.zeros_like(q),
+        ),
+        k,
+        v,
+        jnp.int32(0),
     )
 
+    if sp > 1:
+        perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+        def step(carry, r):
+            k_blk, v_blk, acc = carry
+            k_blk = lax.ppermute(k_blk, axis_name, perm)
+            v_blk = lax.ppermute(v_blk, axis_name, perm)
+            acc = fold(acc, k_blk, v_blk, r)
+            return (k_blk, v_blk, acc), None
+
+        (_, _, acc), _ = lax.scan(step, (k, v, acc), jnp.arange(1, sp))
+
+    _, acc_sum, acc_out = acc
     denom = jnp.maximum(acc_sum, 1e-20).transpose(0, 2, 1)[..., None]
     return (acc_out / denom).astype(out_dtype)

@@ -1,0 +1,14 @@
+"""Workload runtime: rendezvous, checkpoint/resume, in-process gang runner."""
+
+from .checkpoint import Checkpointer
+from .distributed import RankInfo, initialize, pod_env_for, rank_from_env
+from .runner import WorkloadRunner
+
+__all__ = [
+    "Checkpointer",
+    "RankInfo",
+    "WorkloadRunner",
+    "initialize",
+    "pod_env_for",
+    "rank_from_env",
+]

@@ -1,0 +1,52 @@
+"""Checkpoint/resume for the workload plane (orbax-backed).
+
+Division of labor mirrors the reference (SURVEY.md §5): the control plane
+checkpoints nothing — a gang restart recreates every pod and assumes the
+*workload* resumes from its own checkpoint (`README.md:24` of the
+reference).  This module supplies that workload side: sharded-aware orbax
+save/restore keyed by step, so a training loop restarted by the failure
+policy continues from the last durable step instead of step 0.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import orbax.checkpoint as ocp
+
+
+class Checkpointer:
+    """Thin lifecycle wrapper over ocp.CheckpointManager."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep),
+        )
+
+    def save(self, step: int, state: Any) -> None:
+        self._mgr.save(step, args=ocp.args.StandardSave(state))
+        self._mgr.wait_until_finished()
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore(self, state_template: Any, step: Optional[int] = None) -> Any:
+        """Restore into the structure/shardings of `state_template`; `step`
+        defaults to the latest checkpoint."""
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint found in {self.directory}")
+        return self._mgr.restore(step, args=ocp.args.StandardRestore(state_template))
+
+    def close(self) -> None:
+        self._mgr.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

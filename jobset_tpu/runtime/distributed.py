@@ -25,6 +25,9 @@ ENV_JOB_INDEX = "JOBSET_JOB_INDEX"
 ENV_JOB_GLOBAL_INDEX = "JOBSET_JOB_GLOBAL_INDEX"
 ENV_POD_INDEX = "JOBSET_POD_INDEX"
 ENV_PODS_PER_JOB = "JOBSET_PODS_PER_JOB"
+# Prefix sum of expected pod counts over all jobs preceding this one in
+# global-index order; this job's pods occupy ranks [offset, offset+pods).
+ENV_PROCESS_OFFSET = "JOBSET_PROCESS_OFFSET"
 ENV_TOTAL_PROCESSES = "JOBSET_TOTAL_PROCESSES"
 ENV_COORDINATOR = "JOBSET_COORDINATOR"  # <hostname>.<subdomain>[:port]
 
@@ -41,15 +44,18 @@ class RankInfo:
     job_global_index: int
     pod_index: int
     pods_per_job: int
+    process_offset: int
     total_processes: int
     coordinator: str
 
     @property
     def process_id(self) -> int:
-        """Global rank: jobs are laid out by global job index, pods within a
-        job by completion index — matching the DNS naming order so rank k's
-        hostname is deterministic."""
-        return self.job_global_index * self.pods_per_job + self.pod_index
+        """Global rank: jobs are laid out by global job index with a prefix-
+        sum offset of the preceding jobs' pod counts (heterogeneous
+        ReplicatedJobs have different per-job pod counts, so a flat stride
+        would gap or collide), pods within a job by completion index —
+        matching the DNS naming order so rank k's hostname is deterministic."""
+        return self.process_offset + self.pod_index
 
     @property
     def coordinator_address(self) -> str:
@@ -74,6 +80,7 @@ def rank_from_env(env: Optional[dict] = None) -> RankInfo:
         job_global_index=int(need(ENV_JOB_GLOBAL_INDEX)),
         pod_index=int(env.get(ENV_POD_INDEX, "0")),
         pods_per_job=int(env.get(ENV_PODS_PER_JOB, "1")),
+        process_offset=int(need(ENV_PROCESS_OFFSET)),
         total_processes=int(need(ENV_TOTAL_PROCESSES)),
         coordinator=need(ENV_COORDINATOR),
     )
@@ -91,11 +98,16 @@ def pod_env_for(cluster, pod) -> dict:
     )
     total = 0
     pods_per_job = 1
+    process_offset = 0
+    my_global_index = int(labels.get(keys.JOB_GLOBAL_INDEX_KEY, "0"))
     if js is not None:
+        global_index = 0
         for rjob in js.spec.replicated_jobs:
-            expected = rjob.template.spec.parallelism or 1
-            if rjob.template.spec.completions is not None:
-                expected = min(expected, rjob.template.spec.completions)
+            expected = rjob.template.spec.pods_expected()
+            for _ in range(int(rjob.replicas)):
+                if global_index < my_global_index:
+                    process_offset += expected
+                global_index += 1
             total += int(rjob.replicas) * expected
             if rjob.name == labels.get(keys.REPLICATED_JOB_NAME_KEY):
                 pods_per_job = expected
@@ -114,6 +126,7 @@ def pod_env_for(cluster, pod) -> dict:
         ENV_JOB_GLOBAL_INDEX: labels.get(keys.JOB_GLOBAL_INDEX_KEY, "0"),
         ENV_POD_INDEX: annotations.get(keys.POD_COMPLETION_INDEX_KEY, "0"),
         ENV_PODS_PER_JOB: str(pods_per_job),
+        ENV_PROCESS_OFFSET: str(process_offset),
         ENV_TOTAL_PROCESSES: str(total),
         ENV_COORDINATOR: coordinator or "",
     }

@@ -1,0 +1,102 @@
+"""Real multi-process rendezvous e2e (the reference's DNS-ping analog,
+test/e2e/e2e_test.go:64-110): the simulated control plane produces the
+rendezvous env for each pod; actual OS processes consume it, boot
+jax.distributed against a shared coordinator, and run a cross-process psum.
+The simulator's DNS names map to loopback the way cluster DNS would resolve
+them in a real deployment."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from jobset_tpu.api import keys
+from jobset_tpu.core import make_cluster
+from jobset_tpu.runtime.distributed import ENV_COORDINATOR, pod_env_for
+from jobset_tpu.testing import make_jobset, make_replicated_job
+
+WORKER = textwrap.dedent(
+    """
+    import json, os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from jobset_tpu.runtime.distributed import rank_from_env, initialize
+
+    rank = rank_from_env()
+    initialize(rank)
+    import jax.numpy as jnp
+    total = jax.pmap(lambda x: jax.lax.psum(x, "i"), axis_name="i")(
+        jnp.ones((jax.local_device_count(),)) * (rank.process_id + 1)
+    )
+    out = {
+        "process_id": rank.process_id,
+        "world": jax.process_count(),
+        "devices": jax.device_count(),
+        "psum": float(total[0]),
+    }
+    with open(sys.argv[1], "w") as f:
+        json.dump(out, f)
+    """
+)
+
+
+@pytest.mark.timeout(180)
+def test_two_process_gang_rendezvous(tmp_path):
+    cluster = make_cluster()
+    cluster.add_topology("rack", num_domains=2, nodes_per_domain=2, capacity=8)
+    js = (
+        make_jobset("gang")
+        .replicated_job(
+            make_replicated_job("w").replicas(2).parallelism(1).completions(1).obj()
+        )
+        .obj()
+    )
+    cluster.create_jobset(js)
+    cluster.run_until_stable()
+
+    port = _free_port()
+    procs, outputs = [], []
+    for job_idx in range(2):
+        pod = cluster.resolve_hostname("default", f"gang-w-{job_idx}-0.gang")
+        env = pod_env_for(cluster, pod)
+        # "DNS": the coordinator hostname resolves to loopback in this test
+        # network, keeping the port from the contract's default.
+        env[ENV_COORDINATOR] = f"127.0.0.1:{port}"
+        out_file = tmp_path / f"rank{job_idx}.json"
+        outputs.append(out_file)
+        worker_env = {**os.environ, **env}
+        worker_env.pop("PYTHONPATH", None)  # drop the axon sitecustomize
+        worker_env["JAX_PLATFORMS"] = "cpu"
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-c", WORKER, str(out_file)],
+                env=worker_env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+            )
+        )
+
+    for p in procs:
+        stdout, stderr = p.communicate(timeout=150)
+        assert p.returncode == 0, stderr.decode()[-2000:]
+
+    results = [json.loads(f.read_text()) for f in outputs]
+    assert sorted(r["process_id"] for r in results) == [0, 1]
+    for r in results:
+        assert r["world"] == 2
+        local = r["devices"] // 2  # both processes expose the same count
+        assert r["devices"] == 2 * local
+        # psum spans every device of both processes: rank0 contributes
+        # local*1, rank1 local*2.
+        assert r["psum"] == local * 3.0
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]

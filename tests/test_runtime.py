@@ -43,21 +43,31 @@ def test_pod_env_round_trips_to_rank_info():
     assert rank.pods_per_job == 2
     # driver 1 pod + 2 worker jobs x 2 pods
     assert rank.total_processes == 5
+    # prefix-sum rank: driver pod (1) + workers job 0 (2) + own pod index 1
+    assert rank.process_id == 4
     assert rank.coordinator == "train-driver-0-0.train"
     assert rank.coordinator_address.endswith(":8476")
 
 
-def test_process_ids_are_unique_and_dense_per_group():
+def test_process_ids_are_dense_and_collision_free():
+    """Heterogeneous gangs (1-pod driver + 2-pod workers) must produce the
+    dense rank range 0..total-1 with no gaps (regression: a flat
+    global_index*pods_per_job stride gapped rank 1 and exceeded the world
+    size)."""
     cluster = build_cluster()
-    ranks = []
-    for job_idx in range(2):
-        for pod_idx in range(2):
-            pod = cluster.resolve_hostname(
-                "default", f"train-workers-{job_idx}-{pod_idx}.train"
-            )
-            ranks.append(rank_from_env(pod_env_for(cluster, pod)).process_id)
-    # workers occupy global jobs 1..2, two pods each -> ids 2..5
-    assert sorted(ranks) == [2, 3, 4, 5]
+    ranks = [
+        rank_from_env(
+            pod_env_for(cluster, cluster.resolve_hostname("default", host))
+        ).process_id
+        for host in (
+            "train-driver-0-0.train",
+            "train-workers-0-0.train",
+            "train-workers-0-1.train",
+            "train-workers-1-0.train",
+            "train-workers-1-1.train",
+        )
+    ]
+    assert sorted(ranks) == [0, 1, 2, 3, 4]
 
 
 def test_driver_is_process_zero():
