@@ -1,0 +1,519 @@
+"""JobSet wire format: dict/YAML <-> dataclass conversion.
+
+The wire schema follows the reference CRD's camelCase field names
+(`api/jobset/v1alpha2/jobset_types.go:76-357`), so a manifest written for the
+reference (`apiVersion: jobset.x-k8s.io/v1alpha2, kind: JobSet`) loads
+directly into this framework's `JobSet` dataclasses, and `to_dict`/`to_yaml`
+emit manifests a reference user would recognise.  Unknown fields are ignored
+by default (k8s-style pruning); `strict=True` raises on them instead.
+
+Pod specs carry an opaque `workload` payload on our side; on the wire that is
+round-tripped through the standard `containers` list plus a vendor
+`x-jobset-tpu/workload` annotation-free extension key, so k8s-shaped pod
+templates survive a load/dump cycle.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Optional
+
+import yaml
+
+from . import types as t
+
+API_VERSION = "jobset.x-k8s.io/v1alpha2"
+KIND = "JobSet"
+
+# Wire key for the opaque workload payload (not part of the reference CRD;
+# carries the JAX runtime launch config the way the reference carries
+# container commands).
+WORKLOAD_KEY = "x-jobset-tpu/workload"
+
+
+class SerializationError(ValueError):
+    pass
+
+
+def _check_unknown(d: dict, known: set, where: str, strict: bool) -> None:
+    if not strict:
+        return
+    unknown = set(d) - known
+    if unknown:
+        raise SerializationError(f"unknown field(s) {sorted(unknown)} in {where}")
+
+
+def _as_dict(v, where: str) -> dict:
+    if v is None:
+        return {}
+    if not isinstance(v, dict):
+        raise SerializationError(f"{where} must be a mapping, got {type(v).__name__}")
+    return v
+
+
+def _as_list(v, where: str) -> list:
+    if v is None:
+        return []
+    if not isinstance(v, list):
+        raise SerializationError(f"{where} must be a list, got {type(v).__name__}")
+    return v
+
+
+# ---------------------------------------------------------------------------
+# from_dict
+# ---------------------------------------------------------------------------
+
+
+def _meta_from(d: Optional[dict], strict: bool) -> t.ObjectMeta:
+    d = _as_dict(d, "metadata")
+    _check_unknown(
+        d,
+        {"name", "namespace", "uid", "labels", "annotations",
+         "creationTimestamp", "generateName"},
+        "metadata",
+        strict,
+    )
+    return t.ObjectMeta(
+        name=d.get("name", ""),
+        namespace=d.get("namespace", "default"),
+        uid=str(d.get("uid", "")),
+        labels=dict(d.get("labels") or {}),
+        annotations=dict(d.get("annotations") or {}),
+    )
+
+
+def _toleration_from(d: dict) -> t.Toleration:
+    return t.Toleration(
+        key=d.get("key", ""),
+        operator=d.get("operator", "Equal"),
+        value=d.get("value", ""),
+        effect=d.get("effect", ""),
+    )
+
+
+def _affinity_from(d: Optional[dict]) -> Optional[t.Affinity]:
+    """Parse the reduced job-key affinity form this framework injects
+    (placement/webhooks.py); arbitrary k8s affinity is out of scope."""
+    if not d:
+        return None
+    d = _as_dict(d, "affinity")
+
+    def terms(key):
+        return [
+            t.AffinityTerm(
+                topology_key=x.get("topologyKey", ""),
+                job_key_in=x.get("jobKeyIn"),
+                job_key_exists=bool(x.get("jobKeyExists", False)),
+                job_key_not_in=x.get("jobKeyNotIn"),
+            )
+            for x in _as_list(d.get(key), f"affinity.{key}")
+        ]
+
+    return t.Affinity(
+        pod_affinity=terms("podAffinity"),
+        pod_anti_affinity=terms("podAntiAffinity"),
+    )
+
+
+def _affinity_dict(a: Optional[t.Affinity]) -> Optional[dict]:
+    if a is None:
+        return None
+
+    def terms(lst):
+        return [
+            _prune({
+                "topologyKey": x.topology_key,
+                "jobKeyIn": list(x.job_key_in) if x.job_key_in else None,
+                "jobKeyExists": x.job_key_exists or None,
+                "jobKeyNotIn": list(x.job_key_not_in) if x.job_key_not_in else None,
+            })
+            for x in lst
+        ]
+
+    return _prune({
+        "podAffinity": terms(a.pod_affinity),
+        "podAntiAffinity": terms(a.pod_anti_affinity),
+    }) or None
+
+
+def _pod_spec_from(d: Optional[dict], strict: bool) -> t.PodSpec:
+    d = _as_dict(d, "pod template spec")
+    _check_unknown(
+        d,
+        {"restartPolicy", "nodeSelector", "tolerations", "subdomain", "hostname",
+         "schedulingGates", "nodeName", "affinity", "containers",
+         "initContainers", "volumes", WORKLOAD_KEY},
+        "pod template spec",
+        strict,
+    )
+    gates = []
+    for g in _as_list(d.get("schedulingGates"), "schedulingGates"):
+        gates.append(g["name"] if isinstance(g, dict) else str(g))
+    workload = copy.deepcopy(_as_dict(d.get(WORKLOAD_KEY), WORKLOAD_KEY))
+    # Preserve k8s container lists opaquely: the control plane never looks
+    # inside them, the runtime layer may (runtime/runner.py). Native k8s
+    # fields win over copies embedded in the vendor payload.
+    for k in ("containers", "initContainers", "volumes"):
+        if k in d:
+            if strict and k in workload and workload[k] != d[k]:
+                raise SerializationError(
+                    f"pod spec has conflicting {k!r} both natively and in {WORKLOAD_KEY}"
+                )
+            workload[k] = copy.deepcopy(d[k])
+    return t.PodSpec(
+        restart_policy=d.get("restartPolicy", ""),
+        node_selector=dict(d.get("nodeSelector") or {}),
+        tolerations=[
+            _toleration_from(x) for x in _as_list(d.get("tolerations"), "tolerations")
+        ],
+        affinity=_affinity_from(d.get("affinity")),
+        subdomain=d.get("subdomain", ""),
+        hostname=d.get("hostname", ""),
+        scheduling_gates=gates,
+        node_name=d.get("nodeName", ""),
+        workload=workload,
+    )
+
+
+def _pod_template_from(d: Optional[dict], strict: bool) -> t.PodTemplateSpec:
+    d = _as_dict(d, "pod template")
+    _check_unknown(d, {"metadata", "spec"}, "pod template", strict)
+    meta = _as_dict(d.get("metadata"), "pod template metadata")
+    return t.PodTemplateSpec(
+        labels=dict(meta.get("labels") or {}),
+        annotations=dict(meta.get("annotations") or {}),
+        spec=_pod_spec_from(d.get("spec"), strict),
+    )
+
+
+def _job_spec_from(d: Optional[dict], strict: bool) -> t.JobSpec:
+    d = _as_dict(d, "job spec")
+    _check_unknown(
+        d,
+        {"parallelism", "completions", "completionMode", "backoffLimit",
+         "suspend", "activeDeadlineSeconds", "template"},
+        "job spec",
+        strict,
+    )
+    return t.JobSpec(
+        parallelism=d.get("parallelism"),
+        completions=d.get("completions"),
+        completion_mode=d.get("completionMode"),
+        backoff_limit=d.get("backoffLimit", 6),
+        suspend=d.get("suspend"),
+        active_deadline_seconds=d.get("activeDeadlineSeconds"),
+        template=_pod_template_from(d.get("template"), strict),
+    )
+
+
+def _job_template_from(d: Optional[dict], strict: bool) -> t.JobTemplateSpec:
+    d = _as_dict(d, "job template")
+    _check_unknown(d, {"metadata", "spec"}, "job template", strict)
+    meta = _as_dict(d.get("metadata"), "job template metadata")
+    return t.JobTemplateSpec(
+        labels=dict(meta.get("labels") or {}),
+        annotations=dict(meta.get("annotations") or {}),
+        spec=_job_spec_from(d.get("spec"), strict),
+    )
+
+
+def _replicated_job_from(d, strict: bool) -> t.ReplicatedJob:
+    d = _as_dict(d, "replicatedJobs[] entry")
+    _check_unknown(d, {"name", "template", "replicas"}, "replicatedJobs[]", strict)
+    if "name" not in d:
+        raise SerializationError("replicatedJobs[] entry missing required 'name'")
+    return t.ReplicatedJob(
+        name=d["name"],
+        template=_job_template_from(d.get("template"), strict),
+        replicas=int(d.get("replicas", 1)),
+    )
+
+
+def _spec_from(d: Optional[dict], strict: bool) -> t.JobSetSpec:
+    d = _as_dict(d, "spec")
+    _check_unknown(
+        d,
+        {"replicatedJobs", "network", "successPolicy", "failurePolicy",
+         "startupPolicy", "suspend", "coordinator", "managedBy",
+         "ttlSecondsAfterFinished"},
+        "spec",
+        strict,
+    )
+    spec = t.JobSetSpec(
+        replicated_jobs=[
+            _replicated_job_from(x, strict)
+            for x in _as_list(d.get("replicatedJobs"), "spec.replicatedJobs")
+        ],
+        suspend=d.get("suspend"),
+        managed_by=d.get("managedBy"),
+        ttl_seconds_after_finished=d.get("ttlSecondsAfterFinished"),
+    )
+    if d.get("network") is not None:
+        n = _as_dict(d["network"], "spec.network")
+        _check_unknown(
+            n,
+            {"enableDNSHostnames", "subdomain", "publishNotReadyAddresses"},
+            "spec.network", strict,
+        )
+        spec.network = t.Network(
+            enable_dns_hostnames=n.get("enableDNSHostnames"),
+            subdomain=n.get("subdomain", ""),
+            publish_not_ready_addresses=n.get("publishNotReadyAddresses"),
+        )
+    if d.get("successPolicy") is not None:
+        sp = _as_dict(d["successPolicy"], "spec.successPolicy")
+        _check_unknown(sp, {"operator", "targetReplicatedJobs"},
+                       "spec.successPolicy", strict)
+        spec.success_policy = t.SuccessPolicy(
+            operator=sp.get("operator", "All"),
+            target_replicated_jobs=list(sp.get("targetReplicatedJobs") or []),
+        )
+    if d.get("failurePolicy") is not None:
+        fp = _as_dict(d["failurePolicy"], "spec.failurePolicy")
+        _check_unknown(fp, {"maxRestarts", "rules"}, "spec.failurePolicy", strict)
+        rules = []
+        for r in _as_list(fp.get("rules"), "spec.failurePolicy.rules"):
+            r = _as_dict(r, "failurePolicy rule")
+            _check_unknown(
+                r,
+                {"name", "action", "onJobFailureReasons", "targetReplicatedJobs"},
+                "failurePolicy rule", strict,
+            )
+            rules.append(t.FailurePolicyRule(
+                name=r.get("name", ""),
+                action=r.get("action", "RestartJobSet"),
+                on_job_failure_reasons=list(r.get("onJobFailureReasons") or []),
+                target_replicated_jobs=list(r.get("targetReplicatedJobs") or []),
+            ))
+        spec.failure_policy = t.FailurePolicy(
+            max_restarts=int(fp.get("maxRestarts", 0)), rules=rules
+        )
+    if d.get("startupPolicy") is not None:
+        sp = _as_dict(d["startupPolicy"], "spec.startupPolicy")
+        _check_unknown(sp, {"startupPolicyOrder"}, "spec.startupPolicy", strict)
+        spec.startup_policy = t.StartupPolicy(
+            startup_policy_order=sp.get("startupPolicyOrder", "AnyOrder")
+        )
+    if d.get("coordinator") is not None:
+        c = _as_dict(d["coordinator"], "spec.coordinator")
+        _check_unknown(c, {"replicatedJob", "jobIndex", "podIndex"},
+                       "spec.coordinator", strict)
+        spec.coordinator = t.Coordinator(
+            replicated_job=c.get("replicatedJob", ""),
+            job_index=int(c.get("jobIndex", 0)),
+            pod_index=int(c.get("podIndex", 0)),
+        )
+    return spec
+
+
+def from_dict(d: dict, strict: bool = False) -> t.JobSet:
+    """Build a `JobSet` from a k8s-shaped manifest dict."""
+    if not isinstance(d, dict):
+        raise SerializationError(f"manifest must be a mapping, got {type(d).__name__}")
+    api_version = d.get("apiVersion", API_VERSION)
+    kind = d.get("kind", KIND)
+    if kind != KIND:
+        raise SerializationError(f"kind must be {KIND!r}, got {kind!r}")
+    if strict and api_version != API_VERSION:
+        raise SerializationError(
+            f"apiVersion must be {API_VERSION!r}, got {api_version!r}"
+        )
+    _check_unknown(d, {"apiVersion", "kind", "metadata", "spec", "status"},
+                   "JobSet", strict)
+    return t.JobSet(
+        metadata=_meta_from(d.get("metadata"), strict),
+        spec=_spec_from(d.get("spec"), strict),
+    )
+
+
+def from_yaml(text: str, strict: bool = False) -> t.JobSet:
+    return from_dict(yaml.safe_load(text), strict=strict)
+
+
+def load_all(text: str, strict: bool = False) -> list[t.JobSet]:
+    """Load every JobSet document from a multi-doc YAML stream, skipping
+    non-JobSet documents (k8s manifests commonly interleave kinds)."""
+    out = []
+    for doc in yaml.safe_load_all(text):
+        if isinstance(doc, dict) and doc.get("kind") == KIND:
+            out.append(from_dict(doc, strict=strict))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# to_dict
+# ---------------------------------------------------------------------------
+
+
+def _prune(d: dict) -> dict:
+    """Drop None values and empty containers, k8s omitempty style."""
+    return {k: v for k, v in d.items() if v is not None and v != {} and v != [] and v != ""}
+
+
+def _pod_spec_dict(p: t.PodSpec) -> dict:
+    workload = copy.deepcopy(p.workload)
+    out = _prune({
+        "restartPolicy": p.restart_policy,
+        "nodeSelector": dict(p.node_selector),
+        "tolerations": [
+            _prune({"key": x.key, "operator": x.operator, "value": x.value,
+                    "effect": x.effect})
+            for x in p.tolerations
+        ],
+        "affinity": _affinity_dict(p.affinity),
+        "subdomain": p.subdomain,
+        "hostname": p.hostname,
+        "schedulingGates": [{"name": g} for g in p.scheduling_gates],
+        "nodeName": p.node_name,
+    })
+    # Emit preserved k8s container fields at their native positions...
+    for k in ("containers", "initContainers", "volumes"):
+        if k in workload:
+            out[k] = workload.pop(k)
+    # ...and whatever remains of the opaque payload under the vendor key.
+    if workload:
+        out[WORKLOAD_KEY] = workload
+    return out
+
+
+def _pod_template_dict(pt: t.PodTemplateSpec) -> dict:
+    meta = _prune({"labels": dict(pt.labels), "annotations": dict(pt.annotations)})
+    out = {}
+    if meta:
+        out["metadata"] = meta
+    spec = _pod_spec_dict(pt.spec)
+    if spec:
+        out["spec"] = spec
+    return out
+
+
+def _job_spec_dict(j: t.JobSpec) -> dict:
+    return _prune({
+        "parallelism": j.parallelism,
+        "completions": j.completions,
+        "completionMode": j.completion_mode,
+        "backoffLimit": j.backoff_limit if j.backoff_limit != 6 else None,
+        "suspend": j.suspend,
+        "activeDeadlineSeconds": j.active_deadline_seconds,
+        "template": _pod_template_dict(j.template) or None,
+    })
+
+
+def _job_template_dict(jt: t.JobTemplateSpec) -> dict:
+    meta = _prune({"labels": dict(jt.labels), "annotations": dict(jt.annotations)})
+    out = {}
+    if meta:
+        out["metadata"] = meta
+    spec = _job_spec_dict(jt.spec)
+    if spec:
+        out["spec"] = spec
+    return out
+
+
+def to_dict(js: t.JobSet, include_status: bool = False) -> dict:
+    spec: dict[str, Any] = {
+        "replicatedJobs": [
+            _prune({
+                "name": r.name,
+                "replicas": r.replicas,
+                "template": _job_template_dict(r.template) or None,
+            })
+            for r in js.spec.replicated_jobs
+        ],
+    }
+    if js.spec.network is not None:
+        n = js.spec.network
+        spec["network"] = _prune({
+            "enableDNSHostnames": n.enable_dns_hostnames,
+            "subdomain": n.subdomain,
+            "publishNotReadyAddresses": n.publish_not_ready_addresses,
+        })
+    if js.spec.success_policy is not None:
+        sp = js.spec.success_policy
+        spec["successPolicy"] = _prune({
+            "operator": sp.operator,
+            "targetReplicatedJobs": list(sp.target_replicated_jobs),
+        })
+    if js.spec.failure_policy is not None:
+        fp = js.spec.failure_policy
+        spec["failurePolicy"] = _prune({
+            "maxRestarts": fp.max_restarts or None,
+            "rules": [
+                _prune({
+                    "name": r.name,
+                    "action": r.action,
+                    "onJobFailureReasons": list(r.on_job_failure_reasons),
+                    "targetReplicatedJobs": list(r.target_replicated_jobs),
+                })
+                for r in fp.rules
+            ],
+        })
+        if not spec["failurePolicy"]:
+            spec["failurePolicy"] = {"maxRestarts": 0}
+    if js.spec.startup_policy is not None:
+        spec["startupPolicy"] = {
+            "startupPolicyOrder": js.spec.startup_policy.startup_policy_order
+        }
+    if js.spec.coordinator is not None:
+        c = js.spec.coordinator
+        spec["coordinator"] = _prune({
+            "replicatedJob": c.replicated_job,
+            "jobIndex": c.job_index or None,
+            "podIndex": c.pod_index or None,
+        })
+    if js.spec.suspend is not None:
+        spec["suspend"] = js.spec.suspend
+    if js.spec.managed_by is not None:
+        spec["managedBy"] = js.spec.managed_by
+    if js.spec.ttl_seconds_after_finished is not None:
+        spec["ttlSecondsAfterFinished"] = js.spec.ttl_seconds_after_finished
+
+    out = {
+        "apiVersion": API_VERSION,
+        "kind": KIND,
+        "metadata": _prune({
+            "name": js.metadata.name,
+            "namespace": js.metadata.namespace if js.metadata.namespace != "default" else None,
+            "uid": js.metadata.uid,
+            "labels": dict(js.metadata.labels),
+            "annotations": dict(js.metadata.annotations),
+        }),
+        "spec": spec,
+    }
+    if include_status:
+        out["status"] = status_to_dict(js.status)
+    return out
+
+
+def status_to_dict(s: t.JobSetStatus) -> dict:
+    return _prune({
+        "restarts": s.restarts or None,
+        "restartsCountTowardsMax": s.restarts_count_towards_max or None,
+        "terminalState": s.terminal_state,
+        "conditions": [
+            _prune({
+                "type": c.type,
+                "status": c.status,
+                "reason": c.reason,
+                "message": c.message,
+            })
+            for c in s.conditions
+        ],
+        "replicatedJobsStatus": [
+            {
+                "name": r.name,
+                "ready": r.ready,
+                "succeeded": r.succeeded,
+                "failed": r.failed,
+                "active": r.active,
+                "suspended": r.suspended,
+            }
+            for r in s.replicated_jobs_status
+        ],
+    })
+
+
+def to_yaml(js: t.JobSet, include_status: bool = False) -> str:
+    return yaml.safe_dump(
+        to_dict(js, include_status=include_status), sort_keys=False, default_flow_style=False
+    )

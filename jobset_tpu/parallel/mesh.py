@@ -75,14 +75,21 @@ def _take_factor(n: int, f: int) -> int:
 def build_mesh(
     config: Optional[MeshConfig] = None,
     devices: Optional[Sequence[jax.Device]] = None,
+    allow_submesh: bool = False,
 ) -> Mesh:
+    """Build the 5-axis mesh. The config must use exactly the provided
+    devices; pass `allow_submesh=True` to deliberately run on a prefix of
+    them (otherwise a too-small config is a loud error, not silently idle
+    chips)."""
     devices = list(devices if devices is not None else jax.devices())
     if config is None:
         config = default_mesh_config(len(devices))
-    if config.num_devices > len(devices):
+    if config.num_devices > len(devices) or (
+        config.num_devices < len(devices) and not allow_submesh
+    ):
         raise ValueError(
             f"mesh config {config.shape} needs {config.num_devices} devices, "
-            f"got {len(devices)}"
+            f"got {len(devices)} (pass allow_submesh=True to use a subset)"
         )
     array = np.asarray(devices[: config.num_devices]).reshape(config.shape)
     return Mesh(array, AXIS_NAMES)

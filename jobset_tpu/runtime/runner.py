@@ -62,9 +62,10 @@ class WorkloadRunner:
     def __init__(self, cluster: Cluster, mesh=None):
         self.cluster = cluster
         self._mesh = mesh
-        # (namespace, name) -> restart count at which the workload last ran,
-        # so a jobset's workload runs once per gang incarnation.
-        self._ran_at: dict[tuple[str, str], int] = {}
+        # jobset uid -> restart count at which the workload last ran, so a
+        # jobset's workload runs once per gang incarnation (uid-keyed so a
+        # delete + recreate under the same name runs again).
+        self._ran_at: dict[str, int] = {}
 
     # ------------------------------------------------------------------
 
@@ -106,15 +107,15 @@ class WorkloadRunner:
         """Execute workloads for every gang-ready JobSet that has not run in
         its current incarnation. Returns names of JobSets that ran."""
         ran = []
-        for key_, js in list(self.cluster.jobsets.items()):
+        for js in list(self.cluster.jobsets.values()):
             if js.status.terminal_state:
                 continue
             workload = self._workload_of(js)
             if workload is None or not self.gang_ready(js):
                 continue
-            if self._ran_at.get(key_) == js.status.restarts:
+            if self._ran_at.get(js.metadata.uid) == js.status.restarts:
                 continue  # already ran for this incarnation
-            self._ran_at[key_] = js.status.restarts
+            self._ran_at[js.metadata.uid] = js.status.restarts
             try:
                 self._execute(js, workload)
             except WorkloadFailure:
@@ -191,8 +192,6 @@ class WorkloadRunner:
         import optax
 
         from ..models import mlp
-
-        from jax.sharding import NamedSharding, PartitionSpec as P
 
         cfg = mlp.MLPConfig(**workload.get("config", {}))
         mesh = self.mesh()

@@ -1,0 +1,240 @@
+"""Wire-format tests: dict/YAML round-trips and loading every shipped example."""
+
+import glob
+import os
+
+import pytest
+
+from jobset_tpu import api
+from jobset_tpu.api import serialization
+from jobset_tpu.testing import make_jobset, make_replicated_job
+
+EXAMPLES = sorted(
+    glob.glob(os.path.join(os.path.dirname(__file__), "..", "examples", "**", "*.yaml"),
+              recursive=True)
+)
+
+
+def test_examples_exist():
+    assert len(EXAMPLES) >= 10
+
+
+@pytest.mark.parametrize("path", EXAMPLES, ids=[os.path.basename(p) for p in EXAMPLES])
+def test_example_loads_validates_and_roundtrips(path):
+    with open(path) as f:
+        text = f.read()
+    jobsets = api.load_all(text)
+    assert len(jobsets) == 1
+    js = jobsets[0]
+    assert js.name
+    api.apply_defaults(js)
+    api.validate_create(js)
+
+    # Wire round-trip is lossless after defaulting.
+    redone = api.from_dict(api.to_dict(js))
+    api.apply_defaults(redone)
+    assert api.to_dict(redone) == api.to_dict(js)
+
+
+def test_full_spec_roundtrip():
+    js = (
+        make_jobset("full")
+        .replicated_job(make_replicated_job("driver").replicas(1).obj())
+        .replicated_job(
+            make_replicated_job("workers").replicas(3).parallelism(4).completions(4).obj()
+        )
+        .obj()
+    )
+    js.spec.network = api.Network(
+        enable_dns_hostnames=True, subdomain="sub", publish_not_ready_addresses=True
+    )
+    js.spec.success_policy = api.SuccessPolicy(
+        operator="Any", target_replicated_jobs=["driver"]
+    )
+    js.spec.failure_policy = api.FailurePolicy(
+        max_restarts=3,
+        rules=[
+            api.FailurePolicyRule(
+                name="rule0",
+                action="FailJobSet",
+                on_job_failure_reasons=["PodFailurePolicy"],
+                target_replicated_jobs=["workers"],
+            )
+        ],
+    )
+    js.spec.startup_policy = api.StartupPolicy(startup_policy_order="InOrder")
+    js.spec.coordinator = api.Coordinator(replicated_job="driver", job_index=0, pod_index=0)
+    js.spec.suspend = True
+    js.spec.ttl_seconds_after_finished = 30
+    js.metadata.labels["team"] = "ml"
+    js.metadata.annotations[api.keys.EXCLUSIVE_KEY] = "rack"
+
+    d = api.to_dict(js)
+    back = api.from_dict(d)
+    assert api.to_dict(back) == d
+    assert back.spec.failure_policy.rules[0].action == "FailJobSet"
+    assert back.spec.coordinator.replicated_job == "driver"
+    assert back.spec.network.subdomain == "sub"
+    assert back.spec.ttl_seconds_after_finished == 30
+    assert back.metadata.annotations[api.keys.EXCLUSIVE_KEY] == "rack"
+
+
+def test_yaml_roundtrip():
+    js = make_jobset("y").replicated_job(make_replicated_job("w").replicas(2).obj()).obj()
+    text = api.to_yaml(js)
+    back = api.from_yaml(text)
+    assert api.to_dict(back) == api.to_dict(js)
+
+
+def test_workload_payload_roundtrips():
+    js = make_jobset("wl").replicated_job(make_replicated_job("w").obj()).obj()
+    pod = js.spec.replicated_jobs[0].template.spec.template.spec
+    pod.workload = {"kind": "lm", "steps": 4, "config": {"d_model": 64}}
+    back = api.from_dict(api.to_dict(js))
+    assert back.spec.replicated_jobs[0].template.spec.template.spec.workload == pod.workload
+
+
+def test_containers_preserved_opaquely():
+    text = """
+apiVersion: jobset.x-k8s.io/v1alpha2
+kind: JobSet
+metadata: {name: c}
+spec:
+  replicatedJobs:
+    - name: w
+      template:
+        spec:
+          template:
+            spec:
+              containers:
+                - name: main
+                  image: bash
+                  command: ["sleep", "1"]
+"""
+    js = api.from_yaml(text)
+    wl = js.spec.replicated_jobs[0].template.spec.template.spec.workload
+    assert wl["containers"][0]["image"] == "bash"
+    d = api.to_dict(js)
+    pod = d["spec"]["replicatedJobs"][0]["template"]["spec"]["template"]["spec"]
+    assert pod["containers"][0]["name"] == "main"
+
+
+def test_strict_mode_rejects_unknown_fields():
+    with pytest.raises(serialization.SerializationError):
+        api.from_dict(
+            {"kind": "JobSet", "metadata": {"name": "x"}, "spec": {"bogus": 1}},
+            strict=True,
+        )
+    with pytest.raises(serialization.SerializationError):
+        api.from_dict({"kind": "Deployment", "metadata": {"name": "x"}})
+
+
+def test_strict_mode_rejects_nested_unknown_fields():
+    with pytest.raises(serialization.SerializationError):
+        api.from_dict(
+            {"kind": "JobSet", "metadata": {"name": "x"},
+             "spec": {"replicatedJobs": [
+                 {"name": "w", "template": {"spec": {"paralellism": 4}}}]}},
+            strict=True,
+        )
+
+
+def test_wrong_typed_values_raise_serialization_error():
+    with pytest.raises(serialization.SerializationError):
+        api.from_yaml("kind: JobSet\nspec: oops")
+    with pytest.raises(serialization.SerializationError):
+        api.from_dict({"kind": "JobSet", "spec": {"replicatedJobs": {"name": "w"}}})
+
+
+def test_to_dict_does_not_alias_live_object():
+    js = api.from_yaml("""
+apiVersion: jobset.x-k8s.io/v1alpha2
+kind: JobSet
+metadata: {name: alias}
+spec:
+  replicatedJobs:
+    - name: w
+      template:
+        spec:
+          template:
+            spec:
+              containers: [{name: main, image: bash}]
+""")
+    d = api.to_dict(js)
+    d["spec"]["replicatedJobs"][0]["template"]["spec"]["template"]["spec"][
+        "containers"].append({"name": "evil"})
+    wl = js.spec.replicated_jobs[0].template.spec.template.spec.workload
+    assert len(wl["containers"]) == 1
+
+
+def test_native_containers_win_over_vendor_copy():
+    pod_spec = {
+        "containers": [{"name": "native"}],
+        serialization.WORKLOAD_KEY: {"containers": [{"name": "vendor"}]},
+    }
+    d = {
+        "kind": "JobSet",
+        "metadata": {"name": "c"},
+        "spec": {
+            "replicatedJobs": [
+                {"name": "w",
+                 "template": {"spec": {"template": {"spec": pod_spec}}}}
+            ]
+        },
+    }
+    js = api.from_dict(d)
+    wl = js.spec.replicated_jobs[0].template.spec.template.spec.workload
+    assert wl["containers"][0]["name"] == "native"
+    with pytest.raises(serialization.SerializationError):
+        api.from_dict(d, strict=True)
+
+
+def test_load_all_skips_kindless_documents():
+    docs = api.load_all("""
+replicas: 3
+---
+kind: JobSet
+metadata: {name: real}
+spec: {replicatedJobs: [{name: w}]}
+""")
+    assert [js.name for js in docs] == ["real"]
+
+
+def test_affinity_roundtrips():
+    js = make_jobset("aff").replicated_job(make_replicated_job("w").obj()).obj()
+    pod = js.spec.replicated_jobs[0].template.spec.template.spec
+    pod.affinity = api.Affinity(
+        pod_affinity=[api.AffinityTerm(topology_key="rack", job_key_in=["k1"])],
+        pod_anti_affinity=[
+            api.AffinityTerm(topology_key="rack", job_key_exists=True,
+                             job_key_not_in=["k1"])
+        ],
+    )
+    back = api.from_dict(api.to_dict(js))
+    a = back.spec.replicated_jobs[0].template.spec.template.spec.affinity
+    assert a.pod_affinity[0].job_key_in == ["k1"]
+    assert a.pod_anti_affinity[0].job_key_exists is True
+    assert a.pod_anti_affinity[0].job_key_not_in == ["k1"]
+    assert api.to_dict(back) == api.to_dict(js)
+
+
+def test_missing_replicated_job_name_rejected():
+    with pytest.raises(serialization.SerializationError):
+        api.from_dict({"kind": "JobSet", "spec": {"replicatedJobs": [{"replicas": 2}]}})
+
+
+def test_status_serialization():
+    js = make_jobset("s").replicated_job(make_replicated_job("w").obj()).obj()
+    js.status.restarts = 2
+    js.status.terminal_state = "Completed"
+    js.status.conditions.append(
+        api.Condition(type="Completed", status="True", reason="AllJobsCompleted")
+    )
+    js.status.replicated_jobs_status.append(
+        api.ReplicatedJobStatus(name="w", succeeded=1)
+    )
+    d = api.to_dict(js, include_status=True)
+    assert d["status"]["restarts"] == 2
+    assert d["status"]["terminalState"] == "Completed"
+    assert d["status"]["conditions"][0]["reason"] == "AllJobsCompleted"
+    assert d["status"]["replicatedJobsStatus"][0]["succeeded"] == 1
