@@ -59,6 +59,18 @@ def _as_list(v, where: str) -> list:
     return v
 
 
+def _as_int(d: dict, key: str, default: int, where: str) -> int:
+    """Scalar fetch where an explicit YAML null (`key:` / `key: ~`) means
+    unset, matching apiserver semantics."""
+    v = d.get(key)
+    if v is None:
+        return default
+    try:
+        return int(v)
+    except (TypeError, ValueError):
+        raise SerializationError(f"{where}.{key} must be an integer, got {v!r}")
+
+
 # ---------------------------------------------------------------------------
 # from_dict
 # ---------------------------------------------------------------------------
@@ -225,7 +237,7 @@ def _replicated_job_from(d, strict: bool) -> t.ReplicatedJob:
     return t.ReplicatedJob(
         name=d["name"],
         template=_job_template_from(d.get("template"), strict),
-        replicas=int(d.get("replicas", 1)),
+        replicas=_as_int(d, "replicas", 1, "replicatedJobs[]"),
     )
 
 
@@ -286,7 +298,7 @@ def _spec_from(d: Optional[dict], strict: bool) -> t.JobSetSpec:
                 target_replicated_jobs=list(r.get("targetReplicatedJobs") or []),
             ))
         spec.failure_policy = t.FailurePolicy(
-            max_restarts=int(fp.get("maxRestarts", 0)), rules=rules
+            max_restarts=_as_int(fp, "maxRestarts", 0, "spec.failurePolicy"), rules=rules
         )
     if d.get("startupPolicy") is not None:
         sp = _as_dict(d["startupPolicy"], "spec.startupPolicy")
@@ -300,8 +312,8 @@ def _spec_from(d: Optional[dict], strict: bool) -> t.JobSetSpec:
                        "spec.coordinator", strict)
         spec.coordinator = t.Coordinator(
             replicated_job=c.get("replicatedJob", ""),
-            job_index=int(c.get("jobIndex", 0)),
-            pod_index=int(c.get("podIndex", 0)),
+            job_index=_as_int(c, "jobIndex", 0, "spec.coordinator"),
+            pod_index=_as_int(c, "podIndex", 0, "spec.coordinator"),
         )
     return spec
 

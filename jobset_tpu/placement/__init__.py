@@ -1,0 +1,13 @@
+"""Placement subsystem: naming, webhooks, providers, TPU solver + sidecar.
+
+Layer map (SURVEY.md §3.4): the greedy per-pod webhook path is the default;
+`SolverPlacement` behind the `TPUPlacementSolver` gate batches the whole
+job -> topology-domain assignment into one jitted linear-assignment solve,
+either in-process (`AssignmentSolver` in `.solver`) or over gRPC to a TPU
+sidecar (`RemoteAssignmentSolver` / `SolverServer` in `.service`).
+
+Intentionally no eager re-exports: `api.validation` imports `.naming` for
+the DNS-length math while `.naming` uses the api key constants, so package
+`__init__` imports here would be circular.  Import from the submodules
+directly.
+"""

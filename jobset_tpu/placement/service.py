@@ -1,0 +1,322 @@
+"""gRPC solver sidecar: the controller <-> TPU bridge of the north star.
+
+BASELINE.json: the batched linear-assignment solve runs "in a JAX sidecar on
+TPU and streamed back to the Go controller over gRPC".  This module is that
+bridge, TPU-native style: a grpc server process owns the TPU-backed
+`AssignmentSolver` (jit cache and all), and the control plane talks to it
+through `RemoteAssignmentSolver`, a drop-in replacement for the in-process
+solver that the `SolverPlacement` provider accepts unchanged.
+
+Wire format: cost/feasibility matrices are dense float32/uint8 numpy buffers,
+so messages are framed as a fixed struct header + raw array bytes instead of
+protobuf codegen (grpc_tools is not available in this image; grpcio's generic
+method handlers take arbitrary serializer functions, reference:
+`pkg/controllers` has no analog — this subsystem is new).  A 512x2048
+float32 cost matrix is ~4 MiB; raw framing keeps encode/decode at memcpy
+speed where JSON would dominate the solve itself.
+
+Transport shape:
+
+* ``Solve``       unary  — one [J, D] problem        -> [J] assignment
+* ``SolveBatch``  unary  — one [B, J, D] problem set -> [B, J] assignments
+* ``SolveStream`` bidi   — long-lived stream of problems; the controller
+  holds ONE stream open for its lifetime and pipelines every reconcile's
+  solve over it (no per-call channel setup on the hot recovery path).
+
+Resilience: `RemoteAssignmentSolver` transparently falls back to a local
+in-process solve when the sidecar is unreachable, mirroring how the greedy
+path remains the default when the feature gate is off — the control plane
+never hard-depends on the sidecar being up.
+"""
+
+from __future__ import annotations
+
+import queue
+import struct
+import threading
+from concurrent import futures
+from typing import Iterator, Optional
+
+import numpy as np
+
+SERVICE = "jobset.placement.Solver"
+
+# Header: magic, version, ndim, then up to 3 dims (unused dims = 1).
+_MAGIC = 0x4A53  # "JS"
+_HEADER = struct.Struct("<HBBIII")
+
+
+def pack_problem(cost: np.ndarray, feasible: Optional[np.ndarray]) -> bytes:
+    """Frame one solve problem: header + cost float32 bytes + feasible u8."""
+    cost = np.ascontiguousarray(cost, np.float32)
+    ndim = cost.ndim
+    if ndim not in (2, 3):
+        raise ValueError(f"cost must be [J,D] or [B,J,D], got ndim={ndim}")
+    dims = (1,) * (3 - ndim) + cost.shape
+    if feasible is None:
+        feasible = np.ones(cost.shape, bool)
+    feas = np.ascontiguousarray(feasible, np.uint8)
+    if feas.shape != cost.shape:
+        raise ValueError("feasible shape must match cost shape")
+    return _HEADER.pack(_MAGIC, 1, ndim, *dims) + cost.tobytes() + feas.tobytes()
+
+
+def unpack_problem(data: bytes) -> tuple[np.ndarray, np.ndarray]:
+    """Inverse of `pack_problem`; returns (cost, feasible) with original ndim."""
+    magic, version, ndim, b, j, d = _HEADER.unpack_from(data)
+    if magic != _MAGIC or version != 1:
+        raise ValueError("bad solver frame header")
+    count = b * j * d
+    off = _HEADER.size
+    cost = np.frombuffer(data, np.float32, count, off).reshape(b, j, d)
+    feas = np.frombuffer(data, np.uint8, count, off + 4 * count).reshape(b, j, d)
+    if ndim == 2:
+        cost, feas = cost[0], feas[0]
+    return cost.copy(), feas.astype(bool)
+
+
+def pack_assignment(assignment: np.ndarray) -> bytes:
+    assignment = np.ascontiguousarray(assignment, np.int64)
+    ndim = assignment.ndim
+    if ndim == 1:
+        dims = (1, assignment.shape[0], 1)
+    elif ndim == 2:
+        dims = (assignment.shape[0], assignment.shape[1], 1)
+    else:
+        raise ValueError("assignment must be [J] or [B,J]")
+    return _HEADER.pack(_MAGIC, 1, ndim, *dims) + assignment.tobytes()
+
+
+def unpack_assignment(data: bytes) -> np.ndarray:
+    magic, version, ndim, b, j, _ = _HEADER.unpack_from(data)
+    if magic != _MAGIC or version != 1:
+        raise ValueError("bad assignment frame header")
+    out = np.frombuffer(data, np.int64, b * j, _HEADER.size).reshape(b, j)
+    return out[0].copy() if ndim == 1 else out.copy()
+
+
+def _identity(b: bytes) -> bytes:
+    return b
+
+
+class SolverService:
+    """Server-side handler: owns the TPU solver, services (streamed) solves."""
+
+    def __init__(self, solver=None, max_iters: int = 20000):
+        if solver is None:
+            from .solver import AssignmentSolver
+
+            solver = AssignmentSolver(max_iters=max_iters)
+        self.solver = solver
+
+    def _solve_frame(self, data: bytes) -> bytes:
+        cost, feasible = unpack_problem(data)
+        if cost.ndim == 2:
+            assignment = self.solver.solve(cost, feasible)
+        else:
+            assignment = self.solver.solve_batch(cost, feasible)
+        return pack_assignment(assignment)
+
+    # grpc handler signatures: (request, context) / (request_iterator, context)
+    def solve(self, request: bytes, context) -> bytes:
+        return self._solve_frame(request)
+
+    def solve_stream(self, request_iterator: Iterator[bytes], context) -> Iterator[bytes]:
+        for request in request_iterator:
+            yield self._solve_frame(request)
+
+    def handlers(self):
+        import grpc
+
+        return grpc.method_handlers_generic_handler(
+            SERVICE,
+            {
+                "Solve": grpc.unary_unary_rpc_method_handler(
+                    self.solve, request_deserializer=_identity, response_serializer=_identity
+                ),
+                "SolveBatch": grpc.unary_unary_rpc_method_handler(
+                    self.solve, request_deserializer=_identity, response_serializer=_identity
+                ),
+                "SolveStream": grpc.stream_stream_rpc_method_handler(
+                    self.solve_stream,
+                    request_deserializer=_identity,
+                    response_serializer=_identity,
+                ),
+            },
+        )
+
+
+class SolverServer:
+    """Lifecycle wrapper: bind, serve, drain.  `address` like "127.0.0.1:0"
+    (port 0 -> kernel-assigned; read back from `.port`)."""
+
+    def __init__(self, address: str = "127.0.0.1:0", solver=None, credentials=None):
+        import grpc
+
+        self.service = SolverService(solver=solver)
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=8),
+            options=[
+                ("grpc.max_receive_message_length", 256 * 1024 * 1024),
+                ("grpc.max_send_message_length", 256 * 1024 * 1024),
+            ],
+        )
+        self._server.add_generic_rpc_handlers((self.service.handlers(),))
+        if credentials is not None:
+            self.port = self._server.add_secure_port(address, credentials)
+        else:
+            self.port = self._server.add_insecure_port(address)
+        if self.port == 0:
+            raise RuntimeError(f"solver sidecar failed to bind {address}")
+        host = address.rsplit(":", 1)[0]
+        self.address = f"{host}:{self.port}"
+
+    def start(self) -> "SolverServer":
+        self._server.start()
+        return self
+
+    def wait(self, timeout: Optional[float] = None):
+        self._server.wait_for_termination(timeout)
+
+    def stop(self, grace: float = 1.0):
+        self._server.stop(grace).wait()
+
+
+class RemoteAssignmentSolver:
+    """Client: same `.solve`/`.solve_batch` surface as `AssignmentSolver`,
+    backed by one long-lived SolveStream to the sidecar.
+
+    Solves are serialized under a lock (one in flight at a time — the
+    reconcile loop is single-threaded anyway); the stream buys us dial-once
+    semantics so the recovery hot path pays no per-call channel setup.  A
+    reader thread drains responses into a queue so every solve has a real
+    deadline (`timeout`): on expiry or any transport error the stream is
+    torn down and the call transparently falls back to a local solve, so
+    placement keeps working (degraded to in-process) when the sidecar hangs
+    or restarts; the next call re-dials.
+    """
+
+    def __init__(
+        self,
+        address: str,
+        fallback_local: bool = True,
+        credentials=None,
+        timeout: float = 60.0,
+    ):
+        self.address = address
+        self.timeout = timeout
+        self._credentials = credentials
+        self._fallback_local = fallback_local
+        self._local = None
+        self._lock = threading.Lock()
+        self._channel = None
+        self._requests: Optional[queue.Queue] = None
+        self._replies: Optional[queue.Queue] = None
+        self._reader: Optional[threading.Thread] = None
+        self.remote_solves = 0
+        self.local_fallbacks = 0
+
+    # -- connection management -------------------------------------------
+    def _connect_locked(self):
+        import grpc
+
+        if self._channel is not None:
+            return
+        options = [
+            ("grpc.max_receive_message_length", 256 * 1024 * 1024),
+            ("grpc.max_send_message_length", 256 * 1024 * 1024),
+        ]
+        if self._credentials is not None:
+            self._channel = grpc.secure_channel(self.address, self._credentials, options)
+        else:
+            self._channel = grpc.insecure_channel(self.address, options)
+        stream = self._channel.stream_stream(
+            f"/{SERVICE}/SolveStream",
+            request_serializer=_identity,
+            response_deserializer=_identity,
+        )
+        self._requests = queue.Queue()
+        self._replies = queue.Queue()
+        sentinel = self._sentinel = object()
+        requests, replies = self._requests, self._replies
+
+        def request_iter():
+            while True:
+                item = requests.get()
+                if item is sentinel:
+                    return
+                yield item
+
+        responses = stream(request_iter())
+
+        # Reader thread: lets `_roundtrip` wait with a real deadline instead
+        # of blocking forever in `next()` on a wedged sidecar.
+        def drain():
+            try:
+                for reply in responses:
+                    replies.put(reply)
+            except Exception as exc:  # stream broke; unblock the waiter
+                replies.put(exc)
+
+        self._reader = threading.Thread(target=drain, daemon=True)
+        self._reader.start()
+
+    def _teardown_locked(self):
+        if self._requests is not None:
+            self._requests.put(self._sentinel)
+        if self._channel is not None:
+            try:
+                self._channel.close()
+            except Exception:
+                pass
+        self._channel = None
+        self._requests = None
+        self._replies = None
+        self._reader = None
+
+    def close(self):
+        with self._lock:
+            self._teardown_locked()
+
+    # -- solve surface ----------------------------------------------------
+    def _local_solver(self):
+        if self._local is None:
+            from .solver import AssignmentSolver
+
+            self._local = AssignmentSolver()
+        return self._local
+
+    def _roundtrip(self, frame: bytes) -> bytes:
+        with self._lock:
+            self._connect_locked()
+            try:
+                self._requests.put(frame)
+                reply = self._replies.get(timeout=self.timeout)
+                if isinstance(reply, Exception):
+                    raise reply
+                return reply
+            except Exception:
+                self._teardown_locked()
+                raise
+
+    def _solve_remote_or_local(self, cost, feasible):
+        frame = pack_problem(cost, feasible)
+        try:
+            reply = self._roundtrip(frame)
+            self.remote_solves += 1
+            return unpack_assignment(reply)
+        except Exception:
+            if not self._fallback_local:
+                raise
+            self.local_fallbacks += 1
+            if np.asarray(cost).ndim == 2:
+                return self._local_solver().solve(cost, feasible)
+            return self._local_solver().solve_batch(cost, feasible)
+
+    def solve(self, cost: np.ndarray, feasible: Optional[np.ndarray] = None) -> np.ndarray:
+        return self._solve_remote_or_local(np.asarray(cost, np.float32), feasible)
+
+    def solve_batch(
+        self, costs: np.ndarray, feasibles: Optional[np.ndarray] = None
+    ) -> np.ndarray:
+        return self._solve_remote_or_local(np.asarray(costs, np.float32), feasibles)

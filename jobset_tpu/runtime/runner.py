@@ -107,6 +107,10 @@ class WorkloadRunner:
         """Execute workloads for every gang-ready JobSet that has not run in
         its current incarnation. Returns names of JobSets that ran."""
         ran = []
+        live_uids = {js.metadata.uid for js in self.cluster.jobsets.values()}
+        for uid in list(self._ran_at):
+            if uid not in live_uids:  # TTL-deleted / recreated JobSets
+                del self._ran_at[uid]
         for js in list(self.cluster.jobsets.values()):
             if js.status.terminal_state:
                 continue

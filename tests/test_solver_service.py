@@ -1,0 +1,202 @@
+"""gRPC solver sidecar tests: wire-format roundtrips, remote solves matching
+in-process solves exactly, stream reuse, batch solves, and local fallback
+when the sidecar is unreachable (the north star's controller<->TPU bridge)."""
+
+import numpy as np
+import pytest
+
+from jobset_tpu.placement import service as svc
+from jobset_tpu.placement.solver import AssignmentSolver
+
+
+# ---------------------------------------------------------------------------
+# Wire format
+# ---------------------------------------------------------------------------
+
+
+def test_problem_roundtrip_2d():
+    rng = np.random.default_rng(0)
+    cost = rng.random((5, 9)).astype(np.float32)
+    feasible = rng.random((5, 9)) > 0.3
+    cost2, feas2 = svc.unpack_problem(svc.pack_problem(cost, feasible))
+    np.testing.assert_array_equal(cost, cost2)
+    np.testing.assert_array_equal(feasible, feas2)
+
+
+def test_problem_roundtrip_3d_and_default_feasible():
+    rng = np.random.default_rng(1)
+    cost = rng.random((3, 4, 6)).astype(np.float32)
+    cost2, feas2 = svc.unpack_problem(svc.pack_problem(cost, None))
+    np.testing.assert_array_equal(cost, cost2)
+    assert feas2.all() and feas2.shape == cost.shape
+
+
+def test_assignment_roundtrip():
+    a = np.array([3, -1, 0, 7], np.int64)
+    np.testing.assert_array_equal(a, svc.unpack_assignment(svc.pack_assignment(a)))
+    b = np.array([[1, 2], [-1, 0]], np.int64)
+    np.testing.assert_array_equal(b, svc.unpack_assignment(svc.pack_assignment(b)))
+
+
+def test_yaml_explicit_nulls_mean_unset():
+    """`replicas:` / `maxRestarts: ~` are valid k8s manifests meaning unset;
+    the parser must apply defaults, not crash (apiserver semantics)."""
+    from jobset_tpu.api.serialization import from_yaml
+
+    js = from_yaml(
+        """
+apiVersion: jobset.x-k8s.io/v1alpha2
+kind: JobSet
+metadata:
+  name: nulls
+spec:
+  failurePolicy:
+    maxRestarts: ~
+  coordinator:
+    replicatedJob: w
+    jobIndex:
+  replicatedJobs:
+  - name: w
+    replicas:
+    template:
+      spec:
+        template:
+          spec:
+            containers:
+            - name: c
+              image: i
+"""
+    )
+    assert js.spec.replicated_jobs[0].replicas == 1
+    assert js.spec.failure_policy.max_restarts == 0
+    assert js.spec.coordinator.job_index == 0
+
+
+def test_bad_frames_rejected():
+    with pytest.raises(ValueError):
+        svc.unpack_problem(b"\x00" * 32)
+    with pytest.raises(ValueError):
+        svc.pack_problem(np.zeros(4, np.float32), None)  # 1-D cost
+
+
+# ---------------------------------------------------------------------------
+# Server + remote client
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def server():
+    s = svc.SolverServer("127.0.0.1:0").start()
+    yield s
+    s.stop()
+
+
+@pytest.fixture()
+def remote(server):
+    client = svc.RemoteAssignmentSolver(server.address)
+    yield client
+    client.close()
+
+
+def test_remote_solve_matches_local(server, remote):
+    rng = np.random.default_rng(2)
+    cost = rng.integers(0, 50, size=(12, 20)).astype(np.float32)
+    ours = remote.solve(cost)
+    local = AssignmentSolver().solve(cost)
+    np.testing.assert_array_equal(ours, local)
+    assert remote.remote_solves == 1 and remote.local_fallbacks == 0
+
+
+def test_stream_reused_across_many_solves(remote):
+    rng = np.random.default_rng(3)
+    for i in range(5):
+        cost = rng.integers(0, 30, size=(6, 10)).astype(np.float32)
+        out = remote.solve(cost)
+        assert len(set(out.tolist())) == 6
+    assert remote.remote_solves == 5
+
+
+def test_remote_batch_solve(remote):
+    rng = np.random.default_rng(4)
+    costs = rng.integers(0, 40, size=(3, 8, 12)).astype(np.float32)
+    ours = remote.solve_batch(costs)
+    local = AssignmentSolver().solve_batch(costs)
+    np.testing.assert_array_equal(ours, local)
+
+
+def test_feasibility_respected_over_the_wire(remote):
+    rng = np.random.default_rng(5)
+    cost = rng.integers(0, 20, size=(6, 10)).astype(np.float32)
+    feasible = rng.random((6, 10)) > 0.4
+    out = remote.solve(cost, feasible)
+    for j, d in enumerate(out):
+        if d >= 0:
+            assert feasible[j, d]
+
+
+def test_wedged_sidecar_times_out_and_falls_back():
+    """A sidecar that accepts the stream but never answers must not deadlock
+    the controller: the per-solve deadline expires and the local fallback
+    produces the answer."""
+    import time as _time
+
+    class WedgedSolver:
+        def solve(self, cost, feasible=None):
+            _time.sleep(30)
+
+        solve_batch = solve
+
+    server = svc.SolverServer("127.0.0.1:0", solver=WedgedSolver()).start()
+    client = svc.RemoteAssignmentSolver(server.address, timeout=1.0)
+    cost = np.arange(12, dtype=np.float32).reshape(3, 4)
+    t0 = _time.monotonic()
+    out = client.solve(cost)
+    assert _time.monotonic() - t0 < 10
+    assert client.local_fallbacks == 1
+    np.testing.assert_array_equal(out, AssignmentSolver().solve(cost))
+    client.close()
+    server.stop(grace=0.1)
+
+
+def test_fallback_to_local_when_sidecar_down():
+    client = svc.RemoteAssignmentSolver("127.0.0.1:1", timeout=0.5)
+    cost = np.arange(12, dtype=np.float32).reshape(3, 4)
+    out = client.solve(cost)
+    assert client.local_fallbacks == 1 and client.remote_solves == 0
+    np.testing.assert_array_equal(out, AssignmentSolver().solve(cost))
+    client.close()
+
+
+def test_no_fallback_raises():
+    client = svc.RemoteAssignmentSolver("127.0.0.1:1", fallback_local=False)
+    with pytest.raises(Exception):
+        client.solve(np.ones((2, 3), np.float32))
+    client.close()
+
+
+def test_solver_placement_accepts_remote_solver(server):
+    """SolverPlacement(solver=RemoteAssignmentSolver(...)) is the CLI wiring;
+    prove the provider surface works end-to-end through the sidecar."""
+    from jobset_tpu.core import features, make_cluster
+    from jobset_tpu.placement.provider import SolverPlacement
+    from jobset_tpu.testing import make_jobset, make_replicated_job
+
+    remote = svc.RemoteAssignmentSolver(server.address)
+    cluster = make_cluster(placement=SolverPlacement(solver=remote))
+    cluster.add_topology("tpu-slice", num_domains=4, nodes_per_domain=2, capacity=4)
+    js = (
+        make_jobset("stream-js")
+        .exclusive_placement("tpu-slice")
+        .replicated_job(
+            make_replicated_job("w").replicas(2).parallelism(2).completions(2).obj()
+        )
+        .obj()
+    )
+    with features.gate("TPUPlacementSolver", True):
+        cluster.create_jobset(js)
+        cluster.run_until_stable()
+    pods = list(cluster.pods.values())
+    domains = {p.spec.node_selector.get("tpu-slice") for p in pods}
+    assert len(pods) == 4 and len(domains) == 2
+    assert remote.remote_solves >= 1 and remote.local_fallbacks == 0
+    remote.close()
