@@ -1,0 +1,7 @@
+"""`python -m jobset_tpu` entry point (main.go analog; see cli.py)."""
+
+import sys
+
+from .cli import main
+
+sys.exit(main())

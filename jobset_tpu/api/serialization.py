@@ -332,9 +332,60 @@ def from_dict(d: dict, strict: bool = False) -> t.JobSet:
         )
     _check_unknown(d, {"apiVersion", "kind", "metadata", "spec", "status"},
                    "JobSet", strict)
-    return t.JobSet(
+    js = t.JobSet(
         metadata=_meta_from(d.get("metadata"), strict),
         spec=_spec_from(d.get("spec"), strict),
+    )
+    if d.get("status") is not None:
+        js.status = status_from_dict(_as_dict(d["status"], "status"), strict=strict)
+    return js
+
+
+def status_from_dict(d: dict, strict: bool = False) -> t.JobSetStatus:
+    """Inverse of `status_to_dict` (used by the client SDK to surface the
+    status subresource the server reports)."""
+    _check_unknown(
+        d,
+        {"restarts", "restartsCountTowardsMax", "terminalState", "conditions",
+         "replicatedJobsStatus"},
+        "status", strict,
+    )
+    for c in _as_list(d.get("conditions"), "status.conditions"):
+        _check_unknown(
+            _as_dict(c, "status.conditions[]"),
+            {"type", "status", "reason", "message", "lastTransitionTime"},
+            "status.conditions[]", strict,
+        )
+    for r in _as_list(d.get("replicatedJobsStatus"), "status.replicatedJobsStatus"):
+        _check_unknown(
+            _as_dict(r, "status.replicatedJobsStatus[]"),
+            {"name", "ready", "succeeded", "failed", "active", "suspended"},
+            "status.replicatedJobsStatus[]", strict,
+        )
+    return t.JobSetStatus(
+        restarts=_as_int(d, "restarts", 0, "status"),
+        restarts_count_towards_max=_as_int(d, "restartsCountTowardsMax", 0, "status"),
+        terminal_state=d.get("terminalState") or "",
+        conditions=[
+            t.Condition(
+                type=c.get("type", ""),
+                status=c.get("status", ""),
+                reason=c.get("reason", ""),
+                message=c.get("message", ""),
+            )
+            for c in _as_list(d.get("conditions"), "status.conditions")
+        ],
+        replicated_jobs_status=[
+            t.ReplicatedJobStatus(
+                name=r.get("name", ""),
+                ready=_as_int(r, "ready", 0, "status.replicatedJobsStatus"),
+                succeeded=_as_int(r, "succeeded", 0, "status.replicatedJobsStatus"),
+                failed=_as_int(r, "failed", 0, "status.replicatedJobsStatus"),
+                active=_as_int(r, "active", 0, "status.replicatedJobsStatus"),
+                suspended=_as_int(r, "suspended", 0, "status.replicatedJobsStatus"),
+            )
+            for r in _as_list(d.get("replicatedJobsStatus"), "status.replicatedJobsStatus")
+        ],
     )
 
 

@@ -1,0 +1,292 @@
+"""Command-line entry point: the `main.go` analog.
+
+The reference wires everything in one process entry (`main.go:59-220`):
+flags, feature gates, metrics, manager, webhooks, health endpoints.  Ours is
+a subcommand CLI (`python -m jobset_tpu ...`):
+
+* ``controller``   — run the control plane server (REST API + healthz/readyz
+                     /metrics), optionally wired to a remote solver sidecar.
+* ``solver``       — run the TPU placement-solver sidecar (gRPC).
+* ``apply / get / delete / suspend / resume`` — kubectl-style verbs against
+                     a running controller.
+* ``label-nodes``  — the nodeSelector placement-strategy tool
+                     (`hack/label_nodes/label_nodes.py` analog): labels and
+                     taints every node of each topology domain so JobSets
+                     annotated with the node-selector strategy schedule by
+                     plain selectors instead of affinities.
+
+Workload examples run via ``python examples/run_example.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import time
+
+
+def _add_server_flag(p: argparse.ArgumentParser):
+    p.add_argument(
+        "--server", default="127.0.0.1:8080",
+        help="controller server address (host:port)",
+    )
+    p.add_argument("-n", "--namespace", default="default")
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="jobset-tpu",
+        description="TPU-native JobSet: control plane, solver sidecar, client verbs.",
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    c = sub.add_parser("controller", help="run the control plane server")
+    c.add_argument("--addr", default="127.0.0.1:8080",
+                   help="bind address for the REST API + health/metrics")
+    c.add_argument("--feature-gates", default="",
+                   help="Gate1=true,Gate2=false (main.go:73 analog)")
+    c.add_argument("--solver-addr", default="",
+                   help="gRPC address of a solver sidecar; empty = in-process solver")
+    c.add_argument("--tick-interval", type=float, default=0.2,
+                   help="background reconcile pump cadence in seconds")
+    c.add_argument("--topology", default="",
+                   help="bootstrap a synthetic topology: KEY:DOMAINSxNODESxCAP "
+                        "(e.g. cloud.google.com/gke-nodepool:8x4x16)")
+
+    s = sub.add_parser("solver", help="run the placement solver sidecar (gRPC)")
+    s.add_argument("--addr", default="127.0.0.1:8500")
+    s.add_argument("--max-iters", type=int, default=20000)
+
+    a = sub.add_parser("apply", help="create JobSets from a manifest file")
+    a.add_argument("-f", "--filename", required=True)
+    _add_server_flag(a)
+
+    g = sub.add_parser("get", help="get jobsets / nodes / pods / jobs / events")
+    g.add_argument("resource", choices=["jobsets", "jobset", "nodes", "pods", "jobs",
+                                        "services", "events"])
+    g.add_argument("name", nargs="?")
+    g.add_argument("-o", "--output", choices=["wide", "json", "yaml"], default="wide")
+    _add_server_flag(g)
+
+    d = sub.add_parser("delete", help="delete a jobset")
+    d.add_argument("name")
+    _add_server_flag(d)
+
+    for verb in ("suspend", "resume"):
+        v = sub.add_parser(verb, help=f"{verb} a jobset")
+        v.add_argument("name")
+        _add_server_flag(v)
+
+    ln = sub.add_parser("label-nodes",
+                        help="apply the nodeSelector placement strategy labels/taints")
+    ln.add_argument("--topology-key", required=True,
+                    help="node label whose values define the topology domains")
+    ln.add_argument("--jobset", required=True, help="JobSet name the labels target")
+    ln.add_argument("--replicated-job", required=True)
+    _add_server_flag(ln)
+
+    return parser
+
+
+# ---------------------------------------------------------------------------
+# Subcommand implementations
+# ---------------------------------------------------------------------------
+
+
+def _cmd_controller(args) -> int:
+    from .core import features, make_cluster
+    from .placement.provider import SolverPlacement
+    from .server import ControllerServer
+    from .utils.clock import Clock
+
+    if args.feature_gates:
+        features.set_from_string(args.feature_gates)
+
+    solver = None
+    if args.solver_addr:
+        from .placement.service import RemoteAssignmentSolver
+
+        solver = RemoteAssignmentSolver(args.solver_addr)
+    cluster = make_cluster(clock=Clock(), placement=SolverPlacement(solver=solver))
+
+    if args.topology:
+        key, _, shape = args.topology.partition(":")
+        domains, nodes, cap = (int(x) for x in shape.split("x"))
+        cluster.add_topology(key, num_domains=domains, nodes_per_domain=nodes,
+                             capacity=cap)
+
+    server = ControllerServer(args.addr, cluster=cluster,
+                              tick_interval=args.tick_interval).start()
+    print(f"controller listening on http://{server.address} "
+          f"(solver={'sidecar ' + args.solver_addr if args.solver_addr else 'in-process'})",
+          flush=True)
+    _wait_for_signal()
+    server.stop()
+    return 0
+
+
+def _cmd_solver(args) -> int:
+    from .placement.service import SolverServer
+    from .placement.solver import AssignmentSolver
+
+    server = SolverServer(args.addr,
+                          solver=AssignmentSolver(max_iters=args.max_iters)).start()
+    print(f"solver sidecar listening on {server.address}", flush=True)
+    _wait_for_signal()
+    server.stop()
+    return 0
+
+
+def _wait_for_signal():
+    stopped = []
+    signal.signal(signal.SIGTERM, lambda *a: stopped.append(1))
+    try:
+        while not stopped:
+            time.sleep(0.5)
+    except KeyboardInterrupt:
+        pass
+
+
+def _client(args):
+    from .client import JobSetClient
+
+    return JobSetClient(args.server)
+
+
+def _cmd_apply(args) -> int:
+    with open(args.filename) as f:
+        text = f.read()
+    created = _client(args).apply_yaml(text, namespace=args.namespace)
+    for js in created:
+        print(f"jobset.jobset.x-k8s.io/{js.metadata.name} created")
+    return 0
+
+
+def _cmd_get(args) -> int:
+    import yaml as _yaml
+
+    client = _client(args)
+    resource = "jobsets" if args.resource == "jobset" else args.resource
+
+    if resource == "jobsets" and args.name:
+        raw = client.get_raw(args.name, args.namespace)
+        print(json.dumps(raw, indent=2) if args.output == "json"
+              else _yaml.safe_dump(raw, sort_keys=False) if args.output == "yaml"
+              else _format_jobset_row(raw, header=True))
+        return 0
+
+    if resource == "jobsets":
+        items = client.list_raw(args.namespace)
+        if args.output in ("json", "yaml"):
+            doc = {"items": items}
+            print(json.dumps(doc, indent=2) if args.output == "json"
+                  else _yaml.safe_dump(doc, sort_keys=False))
+            return 0
+        first = True
+        for raw in items:
+            print(_format_jobset_row(raw, header=first))
+            first = False
+        return 0
+
+    items = {
+        "nodes": client.nodes,
+        "pods": lambda: client.pods(args.namespace),
+        "jobs": lambda: client.jobs(args.namespace),
+        "services": lambda: client.services(args.namespace),
+        "events": client.events,
+    }[resource]()
+    if args.output == "json":
+        print(json.dumps({"items": items}, indent=2))
+    elif args.output == "yaml":
+        print(_yaml.safe_dump({"items": items}, sort_keys=False))
+    else:
+        for item in items:
+            meta = item.get("metadata", {})
+            print(meta.get("name") or f"{item.get('reason', '')}: {item.get('message', '')}")
+    return 0
+
+
+def _format_jobset_row(raw: dict, header: bool = False) -> str:
+    """kubectl printcolumn analog (jobset_types.go:195-199: Restarts,
+    TerminalState, Suspended)."""
+    status = raw.get("status") or {}
+    row = (f"{raw['metadata']['name']:<24} "
+           f"{status.get('restarts', 0):<9} "
+           f"{status.get('terminalState') or '-':<10} "
+           f"{raw.get('spec', {}).get('suspend') or False}")
+    if header:
+        return f"{'NAME':<24} {'RESTARTS':<9} {'TERMINAL':<10} SUSPENDED\n{row}"
+    return row
+
+
+def _cmd_delete(args) -> int:
+    _client(args).delete(args.name, args.namespace)
+    print(f"jobset.jobset.x-k8s.io/{args.name} deleted")
+    return 0
+
+
+def _cmd_suspend(args) -> int:
+    _client(args).suspend(args.name, args.namespace)
+    print(f"jobset.jobset.x-k8s.io/{args.name} suspended")
+    return 0
+
+
+def _cmd_resume(args) -> int:
+    _client(args).resume(args.name, args.namespace)
+    print(f"jobset.jobset.x-k8s.io/{args.name} resumed")
+    return 0
+
+
+def _cmd_label_nodes(args) -> int:
+    """hack/label_nodes/label_nodes.py analog: give every node of each
+    topology domain the namespaced-job label + NoSchedule taint so the
+    controller's nodeSelector strategy (jobset_controller.go:674-696) can
+    pin one ReplicatedJob per domain without affinity scheduling."""
+    from .api import keys
+
+    client = _client(args)
+    domains: dict[str, list[str]] = {}
+    for node in client.nodes():
+        value = node["metadata"]["labels"].get(args.topology_key)
+        if value is not None:
+            domains.setdefault(value, []).append(node["metadata"]["name"])
+    # One domain per job index, in sorted-domain order, matching the
+    # controller's injected selector value `<ns>_<jobset>-<rjob>-<idx>`
+    # (reconciler nodeSelector strategy; jobset_controller.go:674-679).
+    for idx, (value, names) in enumerate(sorted(domains.items())):
+        namespaced_job = f"{args.namespace}_{args.jobset}-{args.replicated_job}-{idx}"
+        for name in names:
+            client.patch_node(
+                name,
+                labels={keys.NAMESPACED_JOB_KEY: namespaced_job},
+                taints=[{"key": keys.NO_SCHEDULE_TAINT_KEY, "value": "true",
+                         "effect": "NoSchedule"}],
+            )
+        print(f"labeled domain {value}: {len(names)} nodes -> {namespaced_job}")
+    return 0
+
+
+_COMMANDS = {
+    "controller": _cmd_controller,
+    "solver": _cmd_solver,
+    "apply": _cmd_apply,
+    "get": _cmd_get,
+    "delete": _cmd_delete,
+    "suspend": _cmd_suspend,
+    "resume": _cmd_resume,
+    "label-nodes": _cmd_label_nodes,
+}
+
+
+def main(argv=None) -> int:
+    args = _build_parser().parse_args(argv)
+    try:
+        return _COMMANDS[args.command](args)
+    except BrokenPipeError:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

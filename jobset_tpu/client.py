@@ -1,0 +1,218 @@
+"""Typed Python client for the controller server.
+
+Analog of the reference's generated clients (`client-go/` typed clientset
+and the OpenAPI Python SDK, `sdk/python/README.md:1-10`) — but hand-written
+against the controller's REST surface, returning the same `JobSet` dataclass
+types the rest of the framework uses instead of a parallel generated model
+hierarchy.  stdlib-only (urllib), so user containers need no extra deps to
+talk to the control plane.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+from typing import Optional
+
+from .api import serialization
+from .api.types import JobSet
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(f"HTTP {status}: {message}")
+        self.status = status
+        self.message = message
+
+
+class JobSetClient:
+    """Client bound to one controller server (`http://host:port`)."""
+
+    API = "/apis/jobset.x-k8s.io/v1alpha2"
+
+    def __init__(self, base_url: str, timeout: float = 30.0):
+        if "://" not in base_url:
+            base_url = f"http://{base_url}"
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    # -- transport --------------------------------------------------------
+
+    def _request(self, method: str, path: str, body: Optional[bytes] = None,
+                 content_type: str = "application/json"):
+        req = urllib.request.Request(
+            self.base_url + path,
+            data=body,
+            method=method,
+            headers={"Content-Type": content_type} if body is not None else {},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                data = resp.read()
+                ctype = resp.headers.get("Content-Type", "")
+        except urllib.error.HTTPError as exc:
+            detail = exc.read().decode(errors="replace")
+            try:
+                detail = json.loads(detail).get("error", detail)
+            except (json.JSONDecodeError, AttributeError):
+                pass
+            raise ApiError(exc.code, detail) from None
+        if ctype.startswith("application/json"):
+            return json.loads(data)
+        return data.decode()
+
+    # -- jobsets ----------------------------------------------------------
+
+    def _collection(self, namespace: str) -> str:
+        return f"{self.API}/namespaces/{namespace}/jobsets"
+
+    def create(self, js: JobSet | dict | str, namespace: Optional[str] = None) -> JobSet:
+        """Create from a JobSet object, a manifest dict, or YAML text.
+
+        Namespace resolution mirrors kubectl: an explicit `namespace`
+        argument wins, else the manifest's own namespace, else "default".
+        The server rejects a manifest whose namespace disagrees with the
+        request path.
+        """
+        if isinstance(js, JobSet):
+            manifest_ns = js.metadata.namespace
+            body = serialization.to_yaml(js).encode()
+        elif isinstance(js, dict):
+            manifest_ns = (js.get("metadata") or {}).get("namespace")
+            body = json.dumps(js).encode()
+        else:
+            import yaml as _yaml
+
+            manifest_ns = ((_yaml.safe_load(js) or {}).get("metadata") or {}).get(
+                "namespace"
+            )
+            body = js.encode()
+        ns = namespace or manifest_ns or "default"
+        out = self._request("POST", self._collection(ns), body,
+                            content_type="application/yaml")
+        return serialization.from_dict(out)
+
+    def apply_yaml(self, text: str, namespace: Optional[str] = None) -> list[JobSet]:
+        """Create every document in a (possibly multi-doc) YAML stream; each
+        document's own metadata.namespace wins over the `namespace` arg."""
+        import yaml as _yaml
+
+        created = []
+        for doc in _yaml.safe_load_all(text):
+            if not doc:
+                continue
+            doc_ns = (doc.get("metadata") or {}).get("namespace")
+            created.append(self.create(doc, namespace=doc_ns or namespace))
+        return created
+
+    def get(self, name: str, namespace: str = "default") -> JobSet:
+        out = self._request("GET", f"{self._collection(namespace)}/{name}")
+        return serialization.from_dict(out)
+
+    def get_raw(self, name: str, namespace: str = "default") -> dict:
+        """Manifest dict including status (the wire representation)."""
+        return self._request("GET", f"{self._collection(namespace)}/{name}")
+
+    def list(self, namespace: str = "default") -> list[JobSet]:
+        return [serialization.from_dict(item) for item in self.list_raw(namespace)]
+
+    def list_raw(self, namespace: str = "default") -> list[dict]:
+        """Manifest dicts (status included) in one request — what the
+        collection endpoint already serves; no per-item round-trips."""
+        return self._request("GET", self._collection(namespace))["items"]
+
+    def update(self, js: JobSet, namespace: Optional[str] = None) -> JobSet:
+        ns = namespace or js.metadata.namespace or "default"
+        body = serialization.to_yaml(js).encode()
+        out = self._request("PUT", f"{self._collection(ns)}/{js.metadata.name}", body,
+                            content_type="application/yaml")
+        return serialization.from_dict(out)
+
+    def delete(self, name: str, namespace: str = "default") -> None:
+        self._request("DELETE", f"{self._collection(namespace)}/{name}")
+
+    def suspend(self, name: str, namespace: str = "default") -> JobSet:
+        js = self.get(name, namespace)
+        js.spec.suspend = True
+        return self.update(js, namespace)
+
+    def resume(self, name: str, namespace: str = "default") -> JobSet:
+        js = self.get(name, namespace)
+        js.spec.suspend = False
+        return self.update(js, namespace)
+
+    def wait_for_condition(
+        self,
+        name: str,
+        condition_type: str,
+        namespace: str = "default",
+        timeout: float = 60.0,
+        poll: float = 0.2,
+    ) -> dict:
+        """Poll until the JobSet has `condition_type` with status True;
+        returns the condition dict. The watch analog for a poll-based API."""
+        deadline = time.monotonic() + timeout
+        while True:
+            raw = self.get_raw(name, namespace)
+            for cond in (raw.get("status") or {}).get("conditions") or []:
+                if cond.get("type") == condition_type and cond.get("status") == "True":
+                    return cond
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"jobset {namespace}/{name} never reached condition {condition_type}"
+                )
+            time.sleep(poll)
+
+    # -- core resources ---------------------------------------------------
+
+    def pods(self, namespace: str = "default") -> list[dict]:
+        return self._request("GET", f"/api/v1/namespaces/{namespace}/pods")["items"]
+
+    def jobs(self, namespace: str = "default") -> list[dict]:
+        return self._request("GET", f"/api/v1/namespaces/{namespace}/jobs")["items"]
+
+    def services(self, namespace: str = "default") -> list[dict]:
+        return self._request("GET", f"/api/v1/namespaces/{namespace}/services")["items"]
+
+    def events(self) -> list[dict]:
+        return self._request("GET", "/api/v1/events")["items"]
+
+    def nodes(self) -> list[dict]:
+        return self._request("GET", "/api/v1/nodes")["items"]
+
+    def create_node(self, name: str, labels: Optional[dict] = None,
+                    capacity: int = 110, taints: Optional[list[dict]] = None) -> dict:
+        body = json.dumps({
+            "metadata": {"name": name, "labels": labels or {}},
+            "spec": {"taints": taints or []},
+            "status": {"capacity": capacity},
+        }).encode()
+        return self._request("POST", "/api/v1/nodes", body)
+
+    def patch_node(self, name: str, labels: Optional[dict] = None,
+                   taints: Optional[list[dict]] = None) -> dict:
+        patch: dict = {}
+        if labels is not None:
+            patch.setdefault("metadata", {})["labels"] = labels
+        if taints is not None:
+            patch.setdefault("spec", {})["taints"] = taints
+        return self._request("PATCH", f"/api/v1/nodes/{name}", json.dumps(patch).encode())
+
+    # -- infra ------------------------------------------------------------
+
+    def healthz(self) -> bool:
+        try:
+            return self._request("GET", "/healthz") == "ok"
+        except (ApiError, urllib.error.URLError):
+            return False
+
+    def readyz(self) -> bool:
+        try:
+            return self._request("GET", "/readyz") == "ok"
+        except (ApiError, urllib.error.URLError):
+            return False
+
+    def metrics_text(self) -> str:
+        return self._request("GET", "/metrics")

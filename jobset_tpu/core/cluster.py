@@ -29,7 +29,7 @@ from typing import Callable, Iterable, Optional
 
 from ..api import keys
 from ..api.defaulting import apply_defaults
-from ..api.types import Condition, JobSet, Taint
+from ..api.types import Condition, JobSet, JobSetStatus, Taint
 from ..api.validation import validate_create, validate_update
 from ..utils.clock import Clock, FakeClock
 from .objects import (
@@ -172,6 +172,22 @@ class Cluster:
                     capacity=capacity,
                 )
 
+    def patch_node(
+        self,
+        name: str,
+        labels: Optional[dict] = None,
+        taints: Optional[list[Taint]] = None,
+    ) -> Node:
+        """Mutate a node's labels/taints; owns topology-cache invalidation so
+        the solver never sees a stale domain->nodes map."""
+        node = self.nodes[name]
+        if labels:
+            node.labels.update(labels)
+        if taints is not None:
+            node.taints = list(taints)
+        self._domain_nodes.clear()
+        return node
+
     def domain_nodes(self, topology_key: str) -> dict[str, list[str]]:
         """Lazily-built map of domain value -> node names for a topology key."""
         cached = self._domain_nodes.get(topology_key)
@@ -198,6 +214,10 @@ class Cluster:
             raise AdmissionError("; ".join(errs))
         js.metadata.uid = self.next_uid()
         js.metadata.creation_time = self.clock.now()
+        # Status is a server-owned subresource: a manifest arriving with a
+        # populated status (e.g. round-tripped through the client) starts
+        # fresh, exactly as with a real apiserver.
+        js.status = JobSetStatus()
         self.jobsets[key] = js
         self.enqueue_reconcile(*key)
         return js

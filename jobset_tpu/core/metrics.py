@@ -15,9 +15,10 @@ from collections import defaultdict
 
 
 class Counter:
-    def __init__(self, name: str, help_text: str = ""):
+    def __init__(self, name: str, help_text: str = "", label_names: tuple = ("jobset",)):
         self.name = name
         self.help = help_text
+        self.label_names = label_names
         self._values: dict[tuple, float] = defaultdict(float)
         self._lock = threading.Lock()
 
@@ -42,15 +43,17 @@ class Histogram:
         self.counts = [0] * (num_buckets + 1)
         self.sum = 0.0
         self.n = 0
+        self._lock = threading.Lock()
 
     def observe(self, seconds: float) -> None:
-        self.sum += seconds
-        self.n += 1
-        for i, b in enumerate(self.buckets):
-            if seconds <= b:
-                self.counts[i] += 1
-                return
-        self.counts[-1] += 1
+        with self._lock:
+            self.sum += seconds
+            self.n += 1
+            for i, b in enumerate(self.buckets):
+                if seconds <= b:
+                    self.counts[i] += 1
+                    return
+            self.counts[-1] += 1
 
     def percentile(self, q: float) -> float:
         """Approximate percentile from bucket counts (upper bucket bound),
@@ -82,6 +85,56 @@ reconcile_time_seconds = Histogram(
 solver_solve_time_seconds = Histogram(
     "jobset_placement_solve_time_seconds", "Placement solver latency"
 )
+pump_errors_total = Counter(
+    "jobset_controller_pump_errors_total",
+    "Reconcile pump iterations that raised",
+    label_names=(),
+)
+
+
+ALL_COUNTERS = (
+    jobset_completed_total,
+    jobset_failed_total,
+    jobset_restarts_total,
+    pump_errors_total,
+)
+ALL_HISTOGRAMS = (reconcile_time_seconds, solver_solve_time_seconds)
+
+
+def render_prometheus() -> str:
+    """Prometheus text exposition format for the whole registry — what the
+    reference's /metrics endpoint serves (metrics.go:56-61 registration into
+    the controller-runtime registry + the reconcile histograms).  Snapshots
+    are taken under each metric's lock: /metrics is served concurrently with
+    the reconcile pump's inc()/observe() calls."""
+    lines: list[str] = []
+    for c in ALL_COUNTERS:
+        lines.append(f"# HELP {c.name} {c.help}")
+        lines.append(f"# TYPE {c.name} counter")
+        with c._lock:
+            values = sorted(c._values.items())
+        if not values:
+            lines.append(f"{c.name} 0")
+        for labels, value in values:
+            pairs = ",".join(
+                f'{n}="{v}"' for n, v in zip(c.label_names, labels)
+            )
+            suffix = f"{{{pairs}}}" if pairs else ""
+            lines.append(f"{c.name}{suffix} {value}")
+    for h in ALL_HISTOGRAMS:
+        lines.append(f"# HELP {h.name} {h.help}")
+        lines.append(f"# TYPE {h.name} histogram")
+        with h._lock:
+            counts, total, n = list(h.counts), h.sum, h.n
+        cumulative = 0
+        for bound, count in zip(h.buckets, counts):
+            cumulative += count
+            lines.append(f'{h.name}_bucket{{le="{bound:g}"}} {cumulative}')
+        cumulative += counts[-1]
+        lines.append(f'{h.name}_bucket{{le="+Inf"}} {cumulative}')
+        lines.append(f"{h.name}_sum {total}")
+        lines.append(f"{h.name}_count {n}")
+    return "\n".join(lines) + "\n"
 
 
 def jobset_completed(namespaced_name: str) -> None:
@@ -94,9 +147,9 @@ def jobset_failed(namespaced_name: str) -> None:
 
 def reset() -> None:
     """Test helper: clear all metric state."""
-    for counter in (jobset_completed_total, jobset_failed_total, jobset_restarts_total):
+    for counter in ALL_COUNTERS:
         counter._values.clear()
-    for hist in (reconcile_time_seconds, solver_solve_time_seconds):
+    for hist in ALL_HISTOGRAMS:
         hist.counts = [0] * len(hist.counts)
         hist.sum = 0.0
         hist.n = 0

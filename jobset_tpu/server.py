@@ -1,0 +1,412 @@
+"""Controller server: the process boundary of the control plane.
+
+The reference's control plane is reached over HTTP (kube-apiserver ->
+webhooks -> etcd -> watch -> reconcile, SURVEY.md §3.2); ours exposes the
+same contract directly: a threaded HTTP server in front of the in-memory
+`Cluster`, with the admission chain (defaulting, validation, pod webhooks)
+running inside create/update exactly where the apiserver would call
+webhooks, and the reconcile pump running after every write plus on a
+background cadence for time-driven work (TTL-after-finished requeues).
+
+Endpoints (k8s-shaped paths so the client SDK reads naturally):
+
+* ``POST/GET    /apis/jobset.x-k8s.io/v1alpha2/namespaces/{ns}/jobsets``
+* ``GET/PUT/DELETE  .../jobsets/{name}``   (PUT = spec update, admission-checked)
+* ``GET /api/v1/nodes``, ``POST /api/v1/nodes``, ``PATCH /api/v1/nodes/{name}``
+* ``GET /api/v1/namespaces/{ns}/pods|jobs|services``, ``GET /api/v1/events``
+* ``GET /healthz``, ``GET /readyz``, ``GET /metrics``  (main.go:194-219 analog)
+
+Bodies are JSON or YAML manifests (Content-Type sniffed); responses JSON.
+All cluster access is serialized by one lock — the reconcile core is
+single-threaded by design, like the reference's per-JobSet workqueue.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import yaml
+
+logger = logging.getLogger("jobset_tpu.server")
+
+from .api import keys, serialization
+from .api.types import Taint
+from .core import AdmissionError, Cluster, make_cluster, metrics
+from .utils.clock import Clock
+
+
+def _jobset_summary(js) -> dict:
+    d = serialization.to_dict(js, include_status=True)
+    return d
+
+
+def _pod_dict(pod) -> dict:
+    return {
+        "metadata": {
+            "name": pod.metadata.name,
+            "namespace": pod.metadata.namespace,
+            "uid": pod.metadata.uid,
+            "labels": dict(pod.labels),
+            "annotations": dict(pod.annotations),
+        },
+        "spec": {
+            "nodeName": pod.spec.node_name,
+            "hostname": pod.spec.hostname,
+            "subdomain": pod.spec.subdomain,
+            "nodeSelector": dict(pod.spec.node_selector),
+        },
+        "status": {"phase": pod.status.phase, "ready": pod.status.ready},
+    }
+
+
+def _job_dict(job) -> dict:
+    return {
+        "metadata": {
+            "name": job.metadata.name,
+            "namespace": job.metadata.namespace,
+            "uid": job.metadata.uid,
+            "labels": dict(job.labels),
+            "annotations": dict(job.metadata.annotations),
+        },
+        "spec": {
+            "parallelism": job.spec.parallelism,
+            "completions": job.spec.completions,
+            "suspend": job.spec.suspend,
+        },
+        "status": {
+            "active": job.status.active,
+            "ready": job.status.ready,
+            "succeeded": job.status.succeeded,
+            "failed": job.status.failed,
+        },
+    }
+
+
+def _node_dict(node) -> dict:
+    return {
+        "metadata": {"name": node.name, "labels": dict(node.labels)},
+        "spec": {
+            "taints": [
+                {"key": t.key, "value": t.value, "effect": t.effect}
+                for t in node.taints
+            ]
+        },
+        "status": {"capacity": node.capacity, "allocated": node.allocated},
+    }
+
+
+def _event_dict(e) -> dict:
+    return {
+        "kind": e.object_kind,
+        "name": e.object_name,
+        "type": e.type,
+        "reason": e.reason,
+        "message": e.message,
+        "time": e.time,
+    }
+
+
+class ControllerServer:
+    """Owns a Cluster + HTTP front end + background reconcile pump.
+
+    `tick_interval`: real-time cadence of the background pump that services
+    TTL requeues and any queued reconciles (the workqueue's rate-limited
+    retry analog). Writes also pump synchronously so responses observe the
+    post-reconcile state, like a watch-driven controller that has caught up.
+    """
+
+    API_PREFIX = "/apis/jobset.x-k8s.io/v1alpha2"
+
+    def __init__(
+        self,
+        address: str = "127.0.0.1:0",
+        cluster: Optional[Cluster] = None,
+        tick_interval: float = 0.2,
+    ):
+        if cluster is None:
+            cluster = make_cluster(clock=Clock())
+        self.cluster = cluster
+        self.lock = threading.RLock()
+        self.tick_interval = tick_interval
+        self._ready = threading.Event()
+        self._stop = threading.Event()
+
+        host, _, port = address.rpartition(":")
+        handler = self._make_handler()
+        self._httpd = ThreadingHTTPServer((host or "127.0.0.1", int(port)), handler)
+        self.port = self._httpd.server_port
+        self.address = f"{host or '127.0.0.1'}:{self.port}"
+        self._threads: list[threading.Thread] = []
+
+    # ------------------------------------------------------------------
+
+    def start(self) -> "ControllerServer":
+        serve = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        pump = threading.Thread(target=self._pump_loop, daemon=True)
+        serve.start()
+        pump.start()
+        self._threads = [serve, pump]
+        self._ready.set()  # readyz gated on the listener being up (main.go:209-216)
+        return self
+
+    def stop(self):
+        self._stop.set()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    def pump(self):
+        """Run the control loops to a fixed point (thread-safe)."""
+        with self.lock:
+            self.cluster.run_until_stable()
+
+    def _pump_loop(self):
+        while not self._stop.wait(self.tick_interval):
+            try:
+                self.pump()
+            except Exception:
+                # A wedged reconcile must not kill the pump thread, but it
+                # must be visible: log it and count it so operators see a
+                # stuck control loop (the reference logs reconcile errors
+                # and exports controller_runtime error counters).
+                logger.exception("reconcile pump failed")
+                metrics.pump_errors_total.inc()
+
+    # ------------------------------------------------------------------
+    # Request routing
+    # ------------------------------------------------------------------
+
+    def _route(self, method: str, path: str, body: bytes):
+        """Returns (status_code, payload_dict_or_text)."""
+        if path == "/healthz":
+            return 200, "ok"
+        if path == "/readyz":
+            return (200, "ok") if self._ready.is_set() else (503, "not ready")
+        if path == "/metrics":
+            return 200, metrics.render_prometheus()
+
+        parts = [p for p in path.split("/") if p]
+        with self.lock:
+            if path.startswith(self.API_PREFIX):
+                return self._route_jobsets(method, parts, body)
+            if parts[:2] == ["api", "v1"]:
+                return self._route_core(method, parts, body)
+        return 404, {"error": f"no route for {method} {path}"}
+
+    def _parse_manifest(self, body: bytes, path_ns: str):
+        """Parse a manifest; the URL-path namespace is authoritative.  A
+        manifest that explicitly names a different namespace is rejected
+        (kube-apiserver behavior); an absent namespace inherits the path's.
+        The raw dict is consulted because ObjectMeta.namespace defaults to
+        'default', which is indistinguishable from 'absent' after parsing."""
+        data = yaml.safe_load(body.decode())
+        if not isinstance(data, dict):
+            raise serialization.SerializationError("manifest body must be a mapping")
+        manifest_ns = (data.get("metadata") or {}).get("namespace")
+        if manifest_ns and manifest_ns != path_ns:
+            raise serialization.SerializationError(
+                f"manifest namespace {manifest_ns!r} does not match "
+                f"request namespace {path_ns!r}"
+            )
+        js = serialization.from_dict(data)
+        js.metadata.namespace = path_ns
+        return js
+
+    def _route_jobsets(self, method: str, parts: list[str], body: bytes):
+        # parts: apis, jobset.x-k8s.io, v1alpha2, namespaces, {ns}, jobsets[, name]
+        if len(parts) < 6 or parts[3] != "namespaces" or parts[5] != "jobsets":
+            return 404, {"error": "unknown resource"}
+        ns = parts[4]
+        name = parts[6] if len(parts) > 6 else None
+
+        if method == "POST" and name is None:
+            try:
+                js = self._parse_manifest(body, ns)
+            except Exception as exc:
+                return 400, {"error": f"bad manifest: {exc}"}
+            try:
+                created = self.cluster.create_jobset(js)
+            except AdmissionError as exc:
+                return 409 if "already exists" in str(exc) else 422, {"error": str(exc)}
+            self.cluster.run_until_stable()
+            return 201, _jobset_summary(created)
+
+        if method == "GET" and name is None:
+            items = [
+                _jobset_summary(js)
+                for (jns, _), js in sorted(self.cluster.jobsets.items())
+                if jns == ns
+            ]
+            return 200, {
+                "apiVersion": serialization.API_VERSION,
+                "kind": "JobSetList",
+                "items": items,
+            }
+
+        if name is None:
+            return 405, {"error": f"{method} not allowed on collection"}
+
+        js = self.cluster.get_jobset(ns, name)
+        if method == "GET":
+            if js is None:
+                return 404, {"error": f"jobset {ns}/{name} not found"}
+            return 200, _jobset_summary(js)
+
+        if method == "PUT":
+            try:
+                updated = self._parse_manifest(body, ns)
+            except Exception as exc:
+                return 400, {"error": f"bad manifest: {exc}"}
+            if updated.metadata.name and updated.metadata.name != name:
+                return 400, {"error": (
+                    f"manifest name {updated.metadata.name!r} does not match "
+                    f"request name {name!r}"
+                )}
+            updated.metadata.name = name
+            try:
+                stored = self.cluster.update_jobset(updated)
+            except AdmissionError as exc:
+                return 404 if "not found" in str(exc) else 422, {"error": str(exc)}
+            self.cluster.run_until_stable()
+            return 200, _jobset_summary(stored)
+
+        if method == "DELETE":
+            if js is None:
+                return 404, {"error": f"jobset {ns}/{name} not found"}
+            self.cluster.delete_jobset(ns, name)
+            self.cluster.run_until_stable()
+            return 200, {"deleted": f"{ns}/{name}"}
+
+        return 405, {"error": f"{method} not allowed"}
+
+    def _route_core(self, method: str, parts: list[str], body: bytes):
+        # parts: api, v1, ...
+        rest = parts[2:]
+        if rest[:1] == ["nodes"]:
+            return self._route_nodes(method, rest, body)
+        if rest[:1] == ["events"] and method == "GET":
+            return 200, {"items": [_event_dict(e) for e in self.cluster.events]}
+        if len(rest) >= 3 and rest[0] == "namespaces":
+            ns, resource = rest[1], rest[2]
+            if method != "GET":
+                return 405, {"error": "read-only resource"}
+            if resource == "pods":
+                items = [
+                    _pod_dict(p)
+                    for (pns, _), p in sorted(self.cluster.pods.items())
+                    if pns == ns
+                ]
+                return 200, {"items": items}
+            if resource == "jobs":
+                items = [
+                    _job_dict(j)
+                    for (jns, _), j in sorted(self.cluster.jobs.items())
+                    if jns == ns
+                ]
+                return 200, {"items": items}
+            if resource == "services":
+                items = [
+                    {"metadata": {"name": s.metadata.name, "namespace": s.metadata.namespace},
+                     "selector": dict(s.selector),
+                     "publishNotReadyAddresses": s.publish_not_ready_addresses}
+                    for (sns, _), s in sorted(self.cluster.services.items())
+                    if sns == ns
+                ]
+                return 200, {"items": items}
+        return 404, {"error": "unknown core resource"}
+
+    def _route_nodes(self, method: str, rest: list[str], body: bytes):
+        if method == "GET" and len(rest) == 1:
+            return 200, {"items": [_node_dict(n) for n in self.cluster.nodes.values()]}
+        if method == "POST" and len(rest) == 1:
+            try:
+                spec = json.loads(body or b"{}")
+            except json.JSONDecodeError as exc:
+                return 400, {"error": str(exc)}
+            name = spec.get("metadata", {}).get("name")
+            if not name:
+                return 400, {"error": "node metadata.name required"}
+            if name in self.cluster.nodes:
+                return 409, {"error": f"node {name} already exists"}
+            node = self.cluster.add_node(
+                name,
+                labels=spec.get("metadata", {}).get("labels") or {},
+                capacity=int(spec.get("status", {}).get("capacity", 110)),
+                taints=[
+                    Taint(key=t["key"], value=t.get("value", ""), effect=t.get("effect", "NoSchedule"))
+                    for t in spec.get("spec", {}).get("taints") or []
+                ],
+            )
+            return 201, _node_dict(node)
+        if method == "PATCH" and len(rest) == 2:
+            node = self.cluster.nodes.get(rest[1])
+            if node is None:
+                return 404, {"error": f"node {rest[1]} not found"}
+            try:
+                patch = json.loads(body or b"{}")
+            except json.JSONDecodeError as exc:
+                return 400, {"error": str(exc)}
+            self.cluster.patch_node(
+                node.name,
+                labels=patch.get("metadata", {}).get("labels"),
+                taints=[
+                    Taint(key=t["key"], value=t.get("value", ""),
+                          effect=t.get("effect", "NoSchedule"))
+                    for t in patch.get("spec", {}).get("taints")
+                ] if patch.get("spec", {}).get("taints") is not None else None,
+            )
+            return 200, _node_dict(node)
+        return 405, {"error": f"{method} not allowed on nodes"}
+
+    # ------------------------------------------------------------------
+
+    def _make_handler(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _respond(self, code: int, payload):
+                if isinstance(payload, str):
+                    data = payload.encode()
+                    ctype = "text/plain; charset=utf-8"
+                else:
+                    data = json.dumps(payload).encode()
+                    ctype = "application/json"
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _handle(self, method: str):
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                try:
+                    code, payload = server._route(method, self.path, body)
+                except Exception as exc:  # route bug -> 500, keep serving
+                    code, payload = 500, {"error": f"{type(exc).__name__}: {exc}"}
+                self._respond(code, payload)
+
+            def do_GET(self):
+                self._handle("GET")
+
+            def do_POST(self):
+                self._handle("POST")
+
+            def do_PUT(self):
+                self._handle("PUT")
+
+            def do_DELETE(self):
+                self._handle("DELETE")
+
+            def do_PATCH(self):
+                self._handle("PATCH")
+
+            def log_message(self, fmt, *args):  # quiet by default
+                pass
+
+        return Handler

@@ -1,0 +1,234 @@
+"""Controller server + client SDK tests: the apiserver-shaped REST boundary
+(SURVEY.md L6/L7 analog — main.go wiring + client-go/Python SDK surface).
+
+Covers: create/get/list/update/delete round-trips through real HTTP,
+admission rejection status codes, suspend/resume, condition waiting,
+healthz/readyz/metrics endpoints, node API + the label-nodes CLI strategy
+tool, and the kubectl-style CLI verbs driven through `cli.main`.
+"""
+
+import json
+
+import pytest
+
+from jobset_tpu.api import keys
+from jobset_tpu.client import ApiError, JobSetClient
+from jobset_tpu.server import ControllerServer
+from jobset_tpu.testing import make_jobset, make_replicated_job
+
+
+SIMPLE_YAML = """
+apiVersion: jobset.x-k8s.io/v1alpha2
+kind: JobSet
+metadata:
+  name: {name}
+spec:
+  replicatedJobs:
+  - name: workers
+    replicas: 2
+    template:
+      spec:
+        parallelism: 2
+        completions: 2
+        template:
+          spec:
+            containers:
+            - name: train
+              image: train:latest
+"""
+
+
+@pytest.fixture()
+def server():
+    s = ControllerServer("127.0.0.1:0", tick_interval=0.05).start()
+    yield s
+    s.stop()
+
+
+@pytest.fixture()
+def client(server):
+    return JobSetClient(server.address)
+
+
+def _complete_all(server, name):
+    with server.lock:
+        js = server.cluster.get_jobset("default", name)
+        server.cluster.complete_all_jobs(js)
+        server.cluster.run_until_stable()
+
+
+def test_health_endpoints_and_metrics(client):
+    assert client.healthz() and client.readyz()
+    text = client.metrics_text()
+    assert "jobset_completed_total" in text
+    assert "jobset_reconcile_time_seconds_bucket" in text
+    assert "# TYPE jobset_reconcile_time_seconds histogram" in text
+
+
+def test_create_get_list_delete_roundtrip(client):
+    client.create(SIMPLE_YAML.format(name="alpha"))
+    client.create(SIMPLE_YAML.format(name="beta"))
+    names = sorted(js.metadata.name for js in client.list())
+    assert names == ["alpha", "beta"]
+
+    js = client.get("alpha")
+    assert js.spec.replicated_jobs[0].replicas == 2
+    # Server materialized child jobs + headless service synchronously.
+    assert len(client.jobs()) == 4
+    assert client.services()
+    assert all(p["status"]["phase"] in ("Pending", "Running") for p in client.pods())
+
+    client.delete("alpha")
+    assert [js.metadata.name for js in client.list()] == ["beta"]
+    with pytest.raises(ApiError) as err:
+        client.get("alpha")
+    assert err.value.status == 404
+
+
+def test_admission_errors_map_to_http_codes(client):
+    client.create(SIMPLE_YAML.format(name="dup"))
+    with pytest.raises(ApiError) as err:
+        client.create(SIMPLE_YAML.format(name="dup"))
+    assert err.value.status == 409
+
+    with pytest.raises(ApiError) as err:
+        client.create(SIMPLE_YAML.format(name="Invalid_DNS_Name"))
+    assert err.value.status == 422
+
+    with pytest.raises(ApiError) as err:
+        client.create("kind: NotAJobSet\nmetadata: {name: x}")
+    assert err.value.status == 400
+
+
+def test_status_flows_back_and_wait_for_condition(server, client):
+    client.create(SIMPLE_YAML.format(name="gamma"))
+    _complete_all(server, "gamma")
+    cond = client.wait_for_condition("gamma", "Completed", timeout=10)
+    assert cond["status"] == "True"
+    js = client.get("gamma")
+    assert js.status.terminal_state == "Completed"
+    assert js.status.replicated_jobs_status[0].succeeded == 2
+
+
+def test_client_posted_status_is_ignored(client):
+    """Status is a server-owned subresource: a manifest smuggling status
+    must start fresh (apiserver semantics)."""
+    manifest = json.loads(json.dumps({
+        "apiVersion": "jobset.x-k8s.io/v1alpha2",
+        "kind": "JobSet",
+        "metadata": {"name": "sneaky"},
+        "spec": {"replicatedJobs": [{
+            "name": "w",
+            "template": {"spec": {"template": {"spec": {
+                "containers": [{"name": "c", "image": "i"}]}}}},
+        }]},
+        "status": {"restarts": 99, "terminalState": "Completed"},
+    }))
+    client.create(manifest)
+    raw = client.get_raw("sneaky")
+    assert (raw.get("status") or {}).get("restarts") is None
+    assert (raw.get("status") or {}).get("terminalState") != "Completed"
+
+
+def test_namespace_path_is_authoritative(client):
+    """A namespace-less manifest created via namespace='team-a' must land in
+    team-a (not silently in default), and a manifest whose namespace
+    disagrees with the request path is rejected (apiserver behavior)."""
+    client.create(SIMPLE_YAML.format(name="nsjs"), namespace="team-a")
+    assert client.get("nsjs", "team-a").metadata.name == "nsjs"
+    with pytest.raises(ApiError) as err:
+        client.get("nsjs", "default")
+    assert err.value.status == 404
+
+    mismatched = SIMPLE_YAML.format(name="other").replace(
+        "  name: other", "  name: other\n  namespace: team-b", 1
+    )
+    with pytest.raises(ApiError) as err:
+        client.create(mismatched, namespace="team-a")
+    assert err.value.status == 400
+    # Without an explicit arg, the manifest's own namespace wins.
+    created = client.create(mismatched)
+    assert created.metadata.namespace == "team-b"
+    assert client.get("other", "team-b").metadata.name == "other"
+
+
+def test_suspend_resume_via_client(client):
+    client.create(SIMPLE_YAML.format(name="pausable"))
+    client.suspend("pausable")
+    raw = client.get_raw("pausable")
+    assert raw["spec"]["suspend"] is True
+    assert any(c["type"] == "Suspended" and c["status"] == "True"
+               for c in raw["status"]["conditions"])
+    client.resume("pausable")
+    raw = client.get_raw("pausable")
+    assert raw["spec"]["suspend"] is False
+
+
+def test_node_api_and_label_nodes_tool(server, client):
+    for d in range(3):
+        for n in range(2):
+            client.create_node(f"d{d}-n{n}", labels={"rack": f"rack-{d}"}, capacity=8)
+    assert len(client.nodes()) == 6
+
+    from jobset_tpu.cli import main as cli_main
+
+    rc = cli_main([
+        "label-nodes", "--topology-key", "rack", "--jobset", "train",
+        "--replicated-job", "w", "--server", server.address,
+    ])
+    assert rc == 0
+    by_value = {}
+    for node in client.nodes():
+        nj = node["metadata"]["labels"].get(keys.NAMESPACED_JOB_KEY)
+        assert nj and nj.startswith("default_train-w-")
+        by_value.setdefault(nj, []).append(node["metadata"]["name"])
+        assert node["spec"]["taints"][0]["key"] == keys.NO_SCHEDULE_TAINT_KEY
+    # 3 domains -> 3 distinct job indexes, 2 nodes each.
+    assert len(by_value) == 3
+    assert all(len(v) == 2 for v in by_value.values())
+
+
+def test_cli_apply_get_delete(tmp_path, server, capsys):
+    manifest = tmp_path / "js.yaml"
+    manifest.write_text(SIMPLE_YAML.format(name="cli-js"))
+
+    from jobset_tpu.cli import main as cli_main
+
+    assert cli_main(["apply", "-f", str(manifest), "--server", server.address]) == 0
+    assert "cli-js created" in capsys.readouterr().out
+
+    assert cli_main(["get", "jobsets", "--server", server.address]) == 0
+    out = capsys.readouterr().out
+    assert "cli-js" in out and "RESTARTS" in out
+
+    _complete_all(server, "cli-js")
+    assert cli_main(["get", "jobset", "cli-js", "-o", "json",
+                     "--server", server.address]) == 0
+    raw = json.loads(capsys.readouterr().out)
+    assert raw["status"]["terminalState"] == "Completed"
+
+    assert cli_main(["delete", "cli-js", "--server", server.address]) == 0
+    assert "deleted" in capsys.readouterr().out
+
+
+def test_background_pump_services_ttl(server, client):
+    """TTL-after-finished works end-to-end through the real-time pump."""
+    text = SIMPLE_YAML.format(name="ttl-js").replace(
+        "spec:\n  replicatedJobs:",
+        "spec:\n  ttlSecondsAfterFinished: 1\n  replicatedJobs:", 1
+    )
+    client.create(text)
+    _complete_all(server, "ttl-js")
+    client.wait_for_condition("ttl-js", "Completed", timeout=10)
+
+    import time
+
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        try:
+            client.get("ttl-js")
+        except ApiError as err:
+            assert err.status == 404
+            return
+        time.sleep(0.2)
+    pytest.fail("TTL'd jobset was never cleaned up by the background pump")
