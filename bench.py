@@ -16,10 +16,99 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
 import time
 
 BASELINE_PODS_PER_SEC = 290.0
+
+# Wall-clock deadline for the TPU-backend attempt. The TPU tunnel is flaky
+# enough that device init can block forever — and it can hang at any point
+# (first probe OK, later init wedges), so a one-shot up-front probe is not
+# sufficient. Instead the whole bench body runs in a supervised worker
+# subprocess; on deadline the worker's process group is killed and the bench
+# reruns on CPU, guaranteeing the JSON line is always emitted.
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name) or default)
+    except ValueError:
+        return default
+
+
+TPU_ATTEMPT_DEADLINE_S = _env_float("BENCH_TPU_DEADLINE_S", 420.0)
+CPU_ATTEMPT_DEADLINE_S = _env_float("BENCH_CPU_DEADLINE_S", 900.0)
+
+
+def _cpu_forced() -> bool:
+    platforms = [p.strip() for p in os.environ.get("JAX_PLATFORMS", "").split(",")]
+    return platforms[:1] == ["cpu"]
+
+
+def _force_cpu() -> None:
+    """Must run before jax initializes its backend in this process."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    # The axon sitecustomize force-selects the TPU backend via jax.config,
+    # overriding the env var; push it back to CPU before backend init.
+    jax.config.update("jax_platforms", "cpu")
+    if jax.default_backend() != "cpu":
+        raise RuntimeError(
+            f"CPU fallback failed: backend is {jax.default_backend()}"
+        )
+
+
+def _run_worker(deadline_s: float, force_cpu: bool) -> str | None:
+    """Re-exec this script as a worker under a hard deadline.
+
+    Output goes to a temp file, not a pipe: hung TPU-client helper processes
+    can inherit and hold a pipe open past the kill, wedging the reader.
+    Returns the worker's final JSON line, or None on timeout/failure.
+    """
+    import signal
+    import tempfile
+
+    env = dict(os.environ)
+    if force_cpu:
+        env["JAX_PLATFORMS"] = "cpu"
+    with tempfile.TemporaryFile(mode="w+") as out:
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--_worker"]
+            + sys.argv[1:],
+            stdout=out,
+            stderr=sys.stderr,
+            env=env,
+            start_new_session=True,
+        )
+        try:
+            proc.wait(timeout=deadline_s)
+        except subprocess.TimeoutExpired:
+            pass
+        # Reap the whole group unconditionally: even a cleanly-exited worker
+        # can leave wedged TPU-client helpers holding the device/tunnel.
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        proc.wait()
+        # Salvage a completed result even from a worker that crashed or
+        # wedged in teardown after printing its JSON line.
+        out.seek(0)
+        lines = [ln.strip() for ln in out.read().splitlines() if ln.strip()]
+    for line in reversed(lines):
+        try:
+            if isinstance(parsed := json.loads(line), dict) and "metric" in parsed:
+                return line
+        except ValueError:
+            continue
+    return None
+
+
+def jax_backend_name() -> str:
+    import jax
+
+    return jax.default_backend()
 
 
 def build_cluster(num_domains: int, nodes_per_domain: int, topology_key: str):
@@ -110,16 +199,10 @@ def warm_up_solver(args) -> None:
     solver.solve(cost)
 
 
-def main() -> None:
-    parser = argparse.ArgumentParser(description=__doc__)
-    parser.add_argument("--domains", type=int, default=960)
-    parser.add_argument("--nodes-per-domain", type=int, default=16)  # 15360 nodes
-    parser.add_argument("--replicas", type=int, default=512)
-    parser.add_argument("--pods-per-job", type=int, default=8)  # 4096 pods
-    parser.add_argument(
-        "--mode", choices=["both", "greedy", "solver"], default="both"
-    )
-    args = parser.parse_args()
+def worker_main(args) -> None:
+    """The actual bench body; runs under the supervisor's deadline."""
+    if _cpu_forced():
+        _force_cpu()
 
     results = {}
     if args.mode in ("both", "greedy"):
@@ -130,6 +213,7 @@ def main() -> None:
 
     headline = results.get("solver") or results["greedy"]
     detail = {
+        "backend": jax_backend_name(),
         "nodes": args.domains * args.nodes_per_domain,
         "replicas": args.replicas,
         "pods": args.replicas * args.pods_per_job,
@@ -148,6 +232,41 @@ def main() -> None:
             }
         )
     )
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--domains", type=int, default=960)
+    parser.add_argument("--nodes-per-domain", type=int, default=16)  # 15360 nodes
+    parser.add_argument("--replicas", type=int, default=512)
+    parser.add_argument("--pods-per-job", type=int, default=8)  # 4096 pods
+    parser.add_argument(
+        "--mode", choices=["both", "greedy", "solver"], default="both"
+    )
+    parser.add_argument("--_worker", action="store_true", help=argparse.SUPPRESS)
+    args = parser.parse_args()
+
+    if getattr(args, "_worker"):
+        worker_main(args)
+        return 0
+
+    attempts = []
+    if not _cpu_forced():
+        attempts.append((TPU_ATTEMPT_DEADLINE_S, False))
+    attempts.append((CPU_ATTEMPT_DEADLINE_S, True))
+
+    for deadline_s, force_cpu in attempts:
+        line = _run_worker(deadline_s, force_cpu)
+        if line is not None:
+            print(line)
+            return 0
+        print(
+            f"bench attempt (force_cpu={force_cpu}) missed its "
+            f"{deadline_s:.0f}s deadline or failed; "
+            + ("falling back to CPU" if not force_cpu else "giving up"),
+            file=sys.stderr,
+        )
+    return 1
 
 
 if __name__ == "__main__":
