@@ -41,7 +41,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..parallel.mesh import MeshConfig, axis_size
+from ..parallel.mesh import MeshConfig, axis_size, pvary_to
 from ..parallel.pipeline import pipeline_apply
 from ..parallel.ring_attention import ring_attention
 
@@ -355,14 +355,11 @@ def _local_loss_fn(params, inputs, targets, mask, cfg: TransformerConfig, n_micr
 
     # Sums reduce over every data-ish axis, 'ep' included: the MoE pipeline
     # carry is typed ep-varying while the dense path is ep-invariant, so both
-    # values are first pvary'd to a uniform type. The replicated contribution
-    # scales numerator and denominator by ep equally — the mean is unchanged
-    # and the output type becomes fully invariant.
+    # values are first promoted to a uniform varying type. The replicated
+    # contribution scales numerator and denominator by ep equally — the mean
+    # is unchanged and the output type becomes fully invariant.
     def _reduce(x):
-        missing = tuple(
-            {"dp", "sp", "pp", "ep"} - getattr(jax.typeof(x), "vma", frozenset())
-        )
-        x = lax.pvary(x, missing) if missing else x
+        x = pvary_to(x, frozenset({"dp", "sp", "pp", "ep"}))
         return lax.psum(x, ("dp", "sp", "pp", "ep"))
 
     return _reduce(jnp.sum(per_token)), _reduce(count)

@@ -1,0 +1,13 @@
+"""TPU kernels for the workload plane's hot ops (Pallas).
+
+The reference framework contains no numerical code (SURVEY.md §2 — JobSet
+is a job orchestrator); these kernels are the greenfield TPU-native compute
+the orchestrated workloads actually run.
+"""
+
+from .flash_block import (  # noqa: F401
+    NEG_INF,
+    block_attention,
+    block_attention_reference,
+    force_interpret,
+)

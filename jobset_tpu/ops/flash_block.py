@@ -1,0 +1,289 @@
+"""Fused flash-attention block kernel (Pallas/TPU).
+
+The hot op of the workload plane: one (q-block, kv-block) step of the
+online-softmax recurrence that `parallel.ring_attention` folds around the
+`sp` ring. The reference framework has no numerical kernels at all
+(SURVEY.md §2.2 — JobSet is an orchestrator); this is greenfield TPU work:
+logits (MXU), running max/sum statistics, and the weighted-value matmul
+(MXU) are fused in VMEM so the [Tq, Tk] probability matrix never
+materializes in HBM.
+
+Interface contract (shared with the jnp reference implementation):
+
+    block_attention(q, k, v, bias) ->
+        (block_max [B,H,Tq], block_sum [B,H,Tq], weighted [B,Tq,H,D])
+
+i.e. *unnormalized* statistics, so the caller can fold many blocks (ring
+steps) into one accumulator and divide once at the end.
+
+Differentiation: `block_attention` carries a custom VJP whose backward
+recomputes through the jnp reference — the standard flash-attention
+recompute strategy (activations are cheaper to recompute than to store).
+
+Dispatch: the Pallas kernel runs when jax is on TPU (or when
+`force_interpret()` is active, which is how CPU tests exercise the kernel
+via the Pallas interpreter); anything else uses the jnp reference, which
+XLA fuses well enough off-TPU.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+NEG_INF = -1.0e30
+
+# f32 MXU/VPU tiles: sublane multiple of 8, lane multiple of 128.
+_TILE_Q = 128
+_TILE_K = 128
+_LANE = 128
+
+_INTERPRET = False
+
+
+@contextlib.contextmanager
+def force_interpret():
+    """Run the Pallas kernel via the interpreter (CPU tests).
+
+    Trace-time flag: it is baked into any executable traced while the
+    context is active, and a jit cache populated outside it will NOT
+    re-trace inside it (and vice versa). Build the jitted callables you
+    want interpreted *inside* the context — test-only helper."""
+    global _INTERPRET
+    prev, _INTERPRET = _INTERPRET, True
+    try:
+        yield
+    finally:
+        _INTERPRET = prev
+
+
+def _use_pallas() -> bool:
+    import os
+
+    # Evaluated at trace time: set JOBSET_TPU_NO_PALLAS (escape hatch /
+    # debugging) before building jitted steps; cached executables keep
+    # whichever path they were traced with.
+    if os.environ.get("JOBSET_TPU_NO_PALLAS"):
+        return False
+    return _INTERPRET or jax.default_backend() == "tpu"
+
+
+# ---------------------------------------------------------------------------
+# jnp reference (also the recompute path for the backward pass)
+# ---------------------------------------------------------------------------
+
+
+def block_attention_reference(q, k, v, bias):
+    """One flash step in plain jnp.
+
+    q: [B, Tq, H, D], k/v: [B, Tk, H, D], bias: [Tq, Tk] additive mask.
+    Returns (block_max [B,H,Tq], block_sum [B,H,Tq], weighted [B,Tq,H,D]).
+    """
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    logits = logits + bias[None, None, :, :]
+    block_max = jnp.max(logits, axis=-1)  # [B,H,Tq]
+    probs = jnp.exp(logits - block_max[..., None])
+    # Fully-masked rows: exp(-inf - -inf)=exp(0)=1 would pollute; zero them.
+    valid = block_max > NEG_INF / 2
+    probs = jnp.where(valid[..., None], probs, 0.0)
+    block_sum = jnp.sum(probs, axis=-1)  # [B,H,Tq]
+    weighted = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    return block_max, block_sum, weighted
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel
+# ---------------------------------------------------------------------------
+
+
+def _flash_block_kernel(
+    q_ref, k_ref, v_ref, bias_ref, max_ref, sum_ref, out_ref,
+    m_scr, l_scr, acc_scr, *, scale,
+):
+    """Grid cell = (bh, q_tile, kv_tile); the kv axis is minor-most, so TPU
+    executes kv tiles sequentially per q tile and K/V stream through VMEM
+    one (TILE_K, Dp) block at a time — long contexts never hold the full
+    K/V (or bias row) resident. Blocks:
+
+    q_ref   [1, TILE_Q, Dp]      one q tile of one (batch, head)
+    k_ref   [1, TILE_K, Dp]      one kv tile of that (batch, head)
+    v_ref   [1, TILE_K, Dp]
+    bias_ref[TILE_Q, TILE_K]
+    max_ref [1, TILE_Q]          final running max  m_i
+    sum_ref [1, TILE_Q]          final running sum  l_i (unnormalized)
+    out_ref [1, TILE_Q, Dp]      final weighted values (unnormalized)
+
+    The online-softmax accumulator lives in VMEM scratch, which persists
+    across grid steps of the same (bh, q_tile).
+    """
+    kt = pl.program_id(2)
+
+    @pl.when(kt == 0)
+    def _init():
+        # Stats scratch is lane-width (TQ, 128) for tile alignment; the
+        # value lives broadcast across lanes, column 0 is read back.
+        m_scr[:] = jnp.full(m_scr.shape, NEG_INF, jnp.float32)
+        l_scr[:] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[:] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    q = q_ref[0].astype(jnp.float32) * scale  # [TQ, Dp]
+    k_t = k_ref[0].astype(jnp.float32)  # [TK, Dp]
+    v_t = v_ref[0].astype(jnp.float32)
+    b_t = bias_ref[:].astype(jnp.float32)  # [TQ, TK]
+
+    logits = (
+        lax.dot_general(
+            q, k_t, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        + b_t
+    )  # [TQ, TK]
+
+    m = m_scr[:, 0:1]
+    new_m = jnp.maximum(m, jnp.max(logits, axis=-1, keepdims=True))
+    p = jnp.exp(logits - new_m)
+    # Masked-out entries (bias NEG_INF) must not contribute even when the
+    # whole row is masked (new_m == NEG_INF would make exp(0) == 1).
+    p = jnp.where(logits > NEG_INF / 2, p, 0.0)
+    correction = jnp.exp(m - new_m)
+    new_l = l_scr[:, 0:1] * correction + jnp.sum(p, axis=-1, keepdims=True)
+    m_scr[:] = jnp.broadcast_to(new_m, m_scr.shape)
+    l_scr[:] = jnp.broadcast_to(new_l, l_scr.shape)
+    acc_scr[:] = acc_scr[:] * correction + lax.dot_general(
+        p, v_t, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(kt == pl.num_programs(2) - 1)
+    def _finalize():
+        max_ref[0, :] = m_scr[:, 0]
+        sum_ref[0, :] = l_scr[:, 0]
+        out_ref[0] = acc_scr[:]
+
+
+def _pad_to(x, size, axis, value=0.0):
+    pad = size - x.shape[axis]
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths, constant_values=value)
+
+
+def _round_up(n, m):
+    return -(-n // m) * m
+
+
+def _block_attention_pallas(q, k, v, bias):
+    """Pad to TPU tiles, run the kernel over a (B*H, q_tiles) grid, unpad."""
+    batch, tq, heads, dim = q.shape
+    tk = k.shape[1]
+    scale = dim ** -0.5
+
+    tq_p = _round_up(tq, _TILE_Q)
+    tk_p = _round_up(tk, _TILE_K)
+    d_p = _round_up(dim, _LANE)
+
+    # Layout: [B, T, H, D] -> [B*H, T_pad, D_pad]; padded kv columns are
+    # killed via NEG_INF bias, padded q rows are sliced off afterwards.
+    def to_bh(x, t_p):
+        x = jnp.moveaxis(x, 2, 1).reshape(batch * heads, x.shape[1], dim)
+        return _pad_to(_pad_to(x, t_p, axis=1), d_p, axis=2)
+
+    qp, kp, vp = to_bh(q, tq_p), to_bh(k, tk_p), to_bh(v, tk_p)
+    bias_p = _pad_to(
+        _pad_to(bias.astype(jnp.float32), tk_p, axis=1, value=NEG_INF),
+        tq_p, axis=0,
+    )
+
+    grid = (batch * heads, tq_p // _TILE_Q, tk_p // _TILE_K)
+
+    # Inside shard_map the outputs vary over every axis any input varies
+    # over (shard_map's check_vma requires out_shape to declare this), and
+    # every operand must agree — promote the laggards up to the union.
+    from ..parallel.mesh import pvary_to, vma_union
+
+    vma = vma_union(q, k, v, bias)
+    qp, kp, vp, bias_p = (pvary_to(x, vma) for x in (qp, kp, vp, bias_p))
+
+    def out_struct(shape):
+        return jax.ShapeDtypeStruct(shape, jnp.float32, vma=vma)
+
+    from jax.experimental.pallas import tpu as pltpu
+
+    kernel = functools.partial(_flash_block_kernel, scale=scale)
+    block_max, block_sum, weighted = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, _TILE_Q, d_p), lambda bh, qi, kt: (bh, qi, 0)),
+            pl.BlockSpec((1, _TILE_K, d_p), lambda bh, qi, kt: (bh, kt, 0)),
+            pl.BlockSpec((1, _TILE_K, d_p), lambda bh, qi, kt: (bh, kt, 0)),
+            pl.BlockSpec((_TILE_Q, _TILE_K), lambda bh, qi, kt: (qi, kt)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, _TILE_Q), lambda bh, qi, kt: (bh, qi)),
+            pl.BlockSpec((1, _TILE_Q), lambda bh, qi, kt: (bh, qi)),
+            pl.BlockSpec((1, _TILE_Q, d_p), lambda bh, qi, kt: (bh, qi, 0)),
+        ],
+        out_shape=[
+            out_struct((batch * heads, tq_p)),
+            out_struct((batch * heads, tq_p)),
+            out_struct((batch * heads, tq_p, d_p)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((_TILE_Q, _LANE), jnp.float32),
+            pltpu.VMEM((_TILE_Q, _LANE), jnp.float32),
+            pltpu.VMEM((_TILE_Q, d_p), jnp.float32),
+        ],
+        interpret=_INTERPRET,
+    )(qp, kp, vp, bias_p)
+
+    block_max = block_max.reshape(batch, heads, tq_p)[:, :, :tq]
+    block_sum = block_sum.reshape(batch, heads, tq_p)[:, :, :tq]
+    weighted = weighted.reshape(batch, heads, tq_p, d_p)[:, :, :tq, :dim]
+    weighted = jnp.moveaxis(weighted, 1, 2)  # [B, Tq, H, D]
+    return block_max, block_sum, weighted
+
+
+# ---------------------------------------------------------------------------
+# Public op with flash-style recompute backward
+# ---------------------------------------------------------------------------
+
+
+@jax.custom_vjp
+def block_attention(q, k, v, bias):
+    """Dispatching flash block step; see module docstring for the contract.
+
+    Inputs are normalized to float32 (the online-softmax statistics need f32
+    accumulation anyway) so both dispatch paths return identical f32 outputs
+    regardless of backend."""
+    q, k, v, bias = (x.astype(jnp.float32) for x in (q, k, v, bias))
+    if _use_pallas():
+        return _block_attention_pallas(q, k, v, bias)
+    return block_attention_reference(q, k, v, bias)
+
+
+def _fwd(q, k, v, bias):
+    return block_attention(q, k, v, bias), (q, k, v, bias)
+
+
+def _bwd(residuals, cotangents):
+    # Flash recompute: re-run the cheap jnp reference under jax.vjp instead
+    # of storing the [Tq, Tk] probability matrix as a residual. The f32
+    # normalization of the forward is mirrored here; cotangents come back
+    # in each input's original dtype.
+    f32 = tuple(x.astype(jnp.float32) for x in residuals)
+    _, vjp = jax.vjp(block_attention_reference, *f32)
+    return tuple(
+        g.astype(x.dtype) for g, x in zip(vjp(cotangents), residuals)
+    )
+
+
+block_attention.defvjp(_fwd, _bwd)

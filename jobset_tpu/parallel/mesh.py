@@ -107,3 +107,34 @@ def sharding(mesh: Mesh, *spec) -> NamedSharding:
 
 def axis_size(mesh: Mesh, name: str) -> int:
     return mesh.shape[name]
+
+
+# ---------------------------------------------------------------------------
+# Varying-manual-axes (vma) helpers, shared by every shard_map'd module.
+#
+# shard_map's check_vma types each value with the mesh axes it varies over;
+# mixed-vma operands must be promoted to a common type, and silencing the
+# checker instead (check_vma=False) would mis-transpose psum in backward
+# passes. These helpers centralize the promotion.
+# ---------------------------------------------------------------------------
+
+
+def vma_union(*trees) -> frozenset:
+    """Union of the varying-axes sets over every array leaf."""
+    vma = frozenset()
+    for leaf in jax.tree.leaves(trees):
+        vma = vma | getattr(jax.typeof(leaf), "vma", frozenset())
+    return vma
+
+
+def pvary_to(x, vma) -> jax.Array:
+    """Promote `x` to vary over (at least) the axes in `vma`."""
+    from jax import lax
+
+    missing = tuple(vma - getattr(jax.typeof(x), "vma", frozenset()))
+    if not missing:
+        return x
+    try:  # pvary is deprecated in favor of pcast(..., to='varying')
+        return lax.pcast(x, missing, to="varying")
+    except (AttributeError, TypeError):
+        return lax.pvary(x, missing)

@@ -48,13 +48,12 @@ def pipeline_apply(
     # outputs, or shard_map's VMA checker rejects the loop — and silencing
     # the checker (check_vma=False) would mis-transpose psum in backward
     # passes, double-counting gradients. Type the zeros explicitly instead.
-    vma = frozenset({axis_name})
-    for leaf in jax.tree.leaves(stage_params) + [microbatches]:
-        vma = vma | getattr(jax.typeof(leaf), "vma", frozenset())
+    from .mesh import pvary_to, vma_union
+
+    vma = frozenset({axis_name}) | vma_union(stage_params, microbatches)
 
     def _varying(x):
-        missing = tuple(vma - getattr(jax.typeof(x), "vma", frozenset()))
-        return lax.pvary(x, missing) if missing else x
+        return pvary_to(x, vma)
 
     outputs0 = _varying(jnp.zeros((n_micro, *mb_shape), microbatches.dtype))
     recv0 = _varying(jnp.zeros(mb_shape, microbatches.dtype))

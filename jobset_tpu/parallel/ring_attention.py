@@ -18,26 +18,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-NEG_INF = -1.0e30
-
-
-def _block_attention(q, k, v, bias):
-    """One (q-block, kv-block) flash step.
-
-    q: [B, Tq, H, D], k/v: [B, Tk, H, D], bias: [Tq, Tk] additive mask.
-    Returns (scores_max [B,H,Tq], exp_sum [B,H,Tq], weighted_v [B,Tq,H,D]).
-    """
-    scale = q.shape[-1] ** -0.5
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
-    logits = logits + bias[None, None, :, :]
-    block_max = jnp.max(logits, axis=-1)  # [B,H,Tq]
-    probs = jnp.exp(logits - block_max[..., None])
-    # Fully-masked rows: exp(-inf - -inf)=exp(0)=1 would pollute; zero them.
-    valid = block_max > NEG_INF / 2
-    probs = jnp.where(valid[..., None], probs, 0.0)
-    block_sum = jnp.sum(probs, axis=-1)  # [B,H,Tq]
-    weighted = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
-    return block_max, block_sum, weighted
+from ..ops.flash_block import NEG_INF, block_attention as _block_attention
 
 
 def ring_attention(q, k, v, axis_name: str, causal: bool = True):
