@@ -31,7 +31,21 @@ def main() -> int:
         default=50,
         help="max reconcile/run rounds before giving up",
     )
+    parser.add_argument(
+        "--tpu",
+        action="store_true",
+        help="run workloads on the real TPU backend (default: CPU — TPU "
+        "device init blocks indefinitely when the chip is unreachable)",
+    )
     args = parser.parse_args()
+
+    if not args.tpu:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        # Env var alone is not enough under the axon sitecustomize, which
+        # force-selects the TPU backend via jax.config at interpreter start.
+        jax.config.update("jax_platforms", "cpu")
 
     from jobset_tpu import api
     from jobset_tpu.core import make_cluster
@@ -56,6 +70,10 @@ def main() -> int:
     cluster = make_cluster()
     cluster.add_topology("cloud.google.com/gke-nodepool", num_domains=8,
                          nodes_per_domain=4, capacity=16)
+    # TPU multi-slice examples place one job gang per slice domain.
+    cluster.add_topology("tpu.google.com/slice", num_domains=8,
+                         nodes_per_domain=4, capacity=16,
+                         domain_prefix="slice")
     runner = WorkloadRunner(cluster)
 
     for js in jobsets:
