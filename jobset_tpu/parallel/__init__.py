@@ -5,6 +5,7 @@ from .mesh import (
     MeshConfig,
     axis_size,
     build_mesh,
+    build_multislice_mesh,
     default_mesh_config,
     sharding,
     single_device_mesh,
@@ -17,6 +18,7 @@ __all__ = [
     "MeshConfig",
     "axis_size",
     "build_mesh",
+    "build_multislice_mesh",
     "default_mesh_config",
     "sharding",
     "single_device_mesh",
