@@ -1,5 +1,8 @@
-"""Model zoo: flagship SPMD transformer (dense + MoE)."""
+"""Model zoo: flagship SPMD transformer (dense + MoE), ResNet-style CNN
+(vision family), and the MLP smoke model."""
 
+from . import cnn, mlp  # noqa: F401
+from .cnn import CNNConfig  # noqa: F401
 from .transformer import (
     TransformerConfig,
     build_forward,
@@ -9,9 +12,12 @@ from .transformer import (
 )
 
 __all__ = [
+    "CNNConfig",
     "TransformerConfig",
     "build_forward",
     "build_train_step",
+    "cnn",
     "init_params",
+    "mlp",
     "param_specs",
 ]

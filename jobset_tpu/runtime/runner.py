@@ -14,7 +14,7 @@ jobs), resumes from the latest step — the same contract the reference
 documents for its workloads (restart assumes workload-side resume).
 
 Workload payload (on the pod template's `spec.workload`):
-    {"kind": "lm" | "mlp",            # model family
+    {"kind": "lm" | "mlp" | "cnn",    # model family
      "steps": 20,                      # total train steps
      "checkpoint_every": 5,            # 0 = no checkpointing
      "checkpoint_dir": "/tmp/...",     # required if checkpoint_every > 0
@@ -144,6 +144,8 @@ class WorkloadRunner:
             self._train_mlp(js, workload)
         elif kind == "lm":
             self._train_lm(js, workload)
+        elif kind == "cnn":
+            self._train_cnn(js, workload)
         else:
             raise ValueError(f"unknown workload kind: {kind}")
 
@@ -190,6 +192,17 @@ class WorkloadRunner:
                 ckpt.close()
         return losses
 
+    def _fit(self, js, workload, mesh, params, optimizer, train_step, make_batch) -> None:
+        """Shared training tail: mesh-placed optimizer state (orbax restores
+        onto the template's shardings), the step/checkpoint loop, and loss
+        recording — one place for the state/checkpoint-placement contract."""
+        state = {
+            "params": params,
+            "opt_state": place_on_mesh(optimizer.init(params), mesh),
+        }
+        losses = self._run_loop(js, workload, state, train_step, make_batch)
+        _record_losses(js, losses)
+
     def _train_mlp(self, js, workload: dict) -> None:
         import jax
         import jax.numpy as jnp
@@ -199,14 +212,8 @@ class WorkloadRunner:
 
         cfg = mlp.MLPConfig(**workload.get("config", {}))
         mesh = self.mesh()
-        # Replicate over the mesh so checkpoint restore targets mesh-placed
-        # arrays (orbax restores onto the template's shardings).
         params = place_on_mesh(mlp.init_params(jax.random.key(0), cfg), mesh)
         optimizer = optax.adam(float(workload.get("learning_rate", 1e-2)))
-        state = {
-            "params": params,
-            "opt_state": place_on_mesh(optimizer.init(params), mesh),
-        }
         train_step = mlp.build_train_step(cfg, mesh, optimizer)
 
         batch_size = int(workload.get("batch_size", 32))
@@ -218,8 +225,41 @@ class WorkloadRunner:
             y = (x @ w_true).astype(np.float32)
             return {"x": jnp.asarray(x), "y": jnp.asarray(y)}
 
-        losses = self._run_loop(js, workload, state, train_step, make_batch)
-        _record_losses(js, losses)
+        self._fit(js, workload, mesh, params, optimizer, train_step, make_batch)
+
+    def _train_cnn(self, js, workload: dict) -> None:
+        """Vision family (the reference's pytorch cnn/resnet examples):
+        data-parallel ResNet-style training on synthetic images."""
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ..models import cnn
+
+        mesh = self.mesh()
+        cfg = cnn.CNNConfig(**{
+            k: tuple(v) if k == "widths" else v
+            for k, v in workload.get("config", {}).items()
+        })
+        params = place_on_mesh(cnn.init_params(jax.random.key(0), cfg), mesh)
+        optimizer = optax.adam(float(workload.get("learning_rate", 1e-3)))
+        train_step = cnn.build_train_step(cfg, mesh, optimizer)
+
+        batch_size = int(workload.get("batch_size", 8))
+        image_size = int(workload.get("image_size", 32))
+        rng = np.random.default_rng(0)
+
+        def make_batch(step):
+            images = rng.standard_normal(
+                (batch_size, image_size, image_size, cfg.in_channels)
+            ).astype(np.float32)
+            labels = rng.integers(0, cfg.num_classes, (batch_size,))
+            return {
+                "images": jnp.asarray(images),
+                "labels": jnp.asarray(labels),
+            }
+
+        self._fit(js, workload, mesh, params, optimizer, train_step, make_batch)
 
     def _train_lm(self, js, workload: dict) -> None:
         import jax
@@ -240,10 +280,6 @@ class WorkloadRunner:
 
         params = init_params(jax.random.key(0), cfg, mesh)
         optimizer = optax.adamw(float(workload.get("learning_rate", 1e-3)))
-        state = {
-            "params": params,
-            "opt_state": place_on_mesh(optimizer.init(params), mesh),
-        }
         train_step = build_train_step(cfg, mesh, optimizer)
 
         batch_size = int(workload.get("batch_size", 4))
@@ -258,8 +294,7 @@ class WorkloadRunner:
                 "targets": jax.device_put(jnp.asarray(tokens[:, 1:]), sharding_spec),
             }
 
-        losses = self._run_loop(js, workload, state, train_step, make_batch)
-        _record_losses(js, losses)
+        self._fit(js, workload, mesh, params, optimizer, train_step, make_batch)
 
 
 def _record_losses(js, losses) -> None:

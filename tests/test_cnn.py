@@ -1,0 +1,107 @@
+"""CNN model family: shapes, dp sharding, training progress, runner kind."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from jobset_tpu.models import cnn
+from jobset_tpu.parallel import MeshConfig, build_mesh
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return build_mesh(MeshConfig(dp=4, tp=2))
+
+
+def _cfg():
+    return cnn.CNNConfig(
+        num_classes=10, in_channels=3, widths=(8, 16), blocks_per_stage=1,
+        groups=4, dtype=jnp.float32,
+    )
+
+
+def test_forward_shapes(mesh):
+    cfg = _cfg()
+    params = cnn.init_params(jax.random.key(0), cfg)
+    images = jnp.zeros((4, 16, 16, 3), jnp.float32)
+    logits = cnn.forward(params, images, cfg)
+    assert logits.shape == (4, 10)
+    # Stride-2 stages: 16 -> 8 between the two stages.
+
+
+def test_equal_widths_still_downsample(mesh):
+    """Stage boundaries stride-2 even when consecutive widths are equal
+    (the shortcut then carries a projection for the spatial change)."""
+    cfg = cnn.CNNConfig(
+        num_classes=4, in_channels=3, widths=(8, 8), blocks_per_stage=1,
+        groups=4, dtype=jnp.float32,
+    )
+    params = cnn.init_params(jax.random.key(0), cfg)
+    assert "proj" in params["stages"][1][0]  # spatial projection exists
+    feats = {}
+
+    orig = cnn._block
+
+    def spy(p, x, c, stride):
+        out = orig(p, x, c, stride)
+        feats[len(feats)] = (x.shape, out.shape, stride)
+        return out
+
+    cnn._block, _ = spy, None
+    try:
+        cnn.forward(params, jnp.zeros((2, 16, 16, 3), jnp.float32), cfg)
+    finally:
+        cnn._block = orig
+    # Second stage's block halved the spatial dims.
+    assert feats[1][2] == 2 and feats[1][1][1:3] == (8, 8), feats
+
+
+def test_groups_must_divide_width():
+    with pytest.raises(ValueError):
+        cnn.CNNConfig(widths=(10,), groups=4).validate()
+
+
+def test_train_step_learns_separable_labels(mesh):
+    """Labels derived from mean intensity are learnable in a few steps."""
+    cfg = _cfg()
+    params = cnn.init_params(jax.random.key(1), cfg)
+    opt = optax.adam(3e-3)
+    step = cnn.build_train_step(cfg, mesh, opt)
+    opt_state = opt.init(params)
+    rng = np.random.default_rng(0)
+    images = rng.standard_normal((16, 16, 16, 3)).astype(np.float32)
+    images += images.mean(axis=(1, 2, 3), keepdims=True) * 4.0
+    labels = (images.mean(axis=(1, 2, 3)) > 0).astype(np.int64)
+    batch = {"images": jnp.asarray(images), "labels": jnp.asarray(labels)}
+
+    first = None
+    for _ in range(12):
+        params, opt_state, loss = step(params, opt_state, batch)
+        first = float(loss) if first is None else first
+    assert float(loss) < first, (first, float(loss))
+
+
+def test_runner_cnn_workload_end_to_end():
+    from jobset_tpu import api
+    from jobset_tpu.core import make_cluster
+    from jobset_tpu.runtime.runner import WorkloadRunner
+
+    import os
+
+    path = os.path.join(
+        os.path.dirname(__file__), "..", "examples", "training", "cnn-ddp.yaml"
+    )
+    manifest = open(path).read()
+    js = api.load_all(manifest)[0]
+    cluster = make_cluster()
+    cluster.add_topology("pool", num_domains=4, nodes_per_domain=2, capacity=8)
+    runner = WorkloadRunner(cluster)
+    cluster.create_jobset(js)
+    cluster.run_until_stable()
+    runner.run_pending()
+    cluster.run_until_stable()
+    live = cluster.get_jobset("default", "cnn-ddp")
+    assert live.status.terminal_state == "Completed", live.status
