@@ -158,7 +158,8 @@ class WorkloadRunner:
         directory = workload["checkpoint_dir"]
         return Checkpointer(directory), every
 
-    def _run_loop(self, js, workload, state, train_step, make_batch):
+    def _run_loop(self, js, workload, state, train_step, make_batch,
+                  batch_sharding=None):
         """Shared step loop: restore -> step -> (maybe fail) -> checkpoint."""
         import jax
 
@@ -170,6 +171,16 @@ class WorkloadRunner:
             template = jax.tree.map(lambda x: x, state)
             restored = ckpt.restore({"state": template, "step": 0})
             state, start = restored["state"], int(restored["step"])
+
+        # Keep the next batches' host->device transfers in flight behind
+        # the running step (runtime.data); rebuilt at the resume step.
+        # make_batch returns host arrays; the pipeline device_puts them
+        # directly into their dp sharding (no single-device funnel).
+        from .data import prefetching_fn
+
+        make_batch = prefetching_fn(
+            make_batch, sharding=batch_sharding, start=start, stop=total_steps
+        )
 
         losses = []
         try:
@@ -192,15 +203,20 @@ class WorkloadRunner:
                 ckpt.close()
         return losses
 
-    def _fit(self, js, workload, mesh, params, optimizer, train_step, make_batch) -> None:
+    def _fit(self, js, workload, mesh, params, optimizer, train_step,
+             make_batch, batch_sharding=None) -> None:
         """Shared training tail: mesh-placed optimizer state (orbax restores
-        onto the template's shardings), the step/checkpoint loop, and loss
-        recording — one place for the state/checkpoint-placement contract."""
+        onto the template's shardings), the prefetching step/checkpoint
+        loop, and loss recording — one place for the state/checkpoint-
+        placement contract. `make_batch` returns host arrays;
+        `batch_sharding` is where the pipeline lands them."""
         state = {
             "params": params,
             "opt_state": place_on_mesh(optimizer.init(params), mesh),
         }
-        losses = self._run_loop(js, workload, state, train_step, make_batch)
+        losses = self._run_loop(
+            js, workload, state, train_step, make_batch, batch_sharding
+        )
         _record_losses(js, losses)
 
     def _train_mlp(self, js, workload: dict) -> None:
@@ -223,9 +239,12 @@ class WorkloadRunner:
         def make_batch(step):
             x = rng.standard_normal((batch_size, cfg.d_in)).astype(np.float32)
             y = (x @ w_true).astype(np.float32)
-            return {"x": jnp.asarray(x), "y": jnp.asarray(y)}
+            return {"x": x, "y": y}
 
-        self._fit(js, workload, mesh, params, optimizer, train_step, make_batch)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        self._fit(js, workload, mesh, params, optimizer, train_step,
+                  make_batch, NamedSharding(mesh, P(("dp", "sp"))))
 
     def _train_cnn(self, js, workload: dict) -> None:
         """Vision family (the reference's pytorch cnn/resnet examples):
@@ -254,12 +273,12 @@ class WorkloadRunner:
                 (batch_size, image_size, image_size, cfg.in_channels)
             ).astype(np.float32)
             labels = rng.integers(0, cfg.num_classes, (batch_size,))
-            return {
-                "images": jnp.asarray(images),
-                "labels": jnp.asarray(labels),
-            }
+            return {"images": images, "labels": labels}
 
-        self._fit(js, workload, mesh, params, optimizer, train_step, make_batch)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        self._fit(js, workload, mesh, params, optimizer, train_step,
+                  make_batch, NamedSharding(mesh, P("dp")))
 
     def _train_lm(self, js, workload: dict) -> None:
         import jax
@@ -284,17 +303,17 @@ class WorkloadRunner:
 
         batch_size = int(workload.get("batch_size", 4))
         seq_len = int(workload.get("seq_len", 16))
-        sharding_spec = NamedSharding(mesh, P("dp", "sp"))
         rng = np.random.default_rng(0)
 
         def make_batch(step):
             tokens = rng.integers(0, cfg.vocab_size, (batch_size, seq_len + 1))
             return {
-                "inputs": jax.device_put(jnp.asarray(tokens[:, :-1]), sharding_spec),
-                "targets": jax.device_put(jnp.asarray(tokens[:, 1:]), sharding_spec),
+                "inputs": np.ascontiguousarray(tokens[:, :-1]),
+                "targets": np.ascontiguousarray(tokens[:, 1:]),
             }
 
-        self._fit(js, workload, mesh, params, optimizer, train_step, make_batch)
+        self._fit(js, workload, mesh, params, optimizer, train_step,
+                  make_batch, NamedSharding(mesh, P("dp", "sp")))
 
 
 def _record_losses(js, losses) -> None:

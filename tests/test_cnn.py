@@ -50,7 +50,7 @@ def test_equal_widths_still_downsample(mesh):
         feats[len(feats)] = (x.shape, out.shape, stride)
         return out
 
-    cnn._block, _ = spy, None
+    cnn._block = spy
     try:
         cnn.forward(params, jnp.zeros((2, 16, 16, 3), jnp.float32), cfg)
     finally:
