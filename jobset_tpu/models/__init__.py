@@ -1,8 +1,9 @@
 """Model zoo: flagship SPMD transformer (dense + MoE), ResNet-style CNN
 (vision family), and the MLP smoke model."""
 
-from . import cnn, mlp  # noqa: F401
+from . import cnn, decode, mlp  # noqa: F401
 from .cnn import CNNConfig  # noqa: F401
+from .decode import build_generate  # noqa: F401
 from .transformer import (
     TransformerConfig,
     build_forward,
@@ -13,10 +14,12 @@ from .transformer import (
 
 __all__ = [
     "CNNConfig",
+    "build_generate",
     "TransformerConfig",
     "build_forward",
     "build_train_step",
     "cnn",
+    "decode",
     "init_params",
     "mlp",
     "param_specs",

@@ -1,0 +1,226 @@
+"""Autoregressive decoding for the flagship transformer (serving path).
+
+TPU-idiomatic greedy/sampling decode: a KV cache with static `max_len`
+shapes, one `lax.scan` over decode steps (no Python loop, one compiled
+program), `dynamic_update_slice` cache writes, and position-masked
+attention. Runs under `shard_map` on the same 5-axis mesh as training with
+the serving-shaped axes active — dp for batch throughput, tp for latency
+(column/row-parallel projections with one psum per layer, vocab-sharded
+logits) — while pp/sp/ep must be 1 (pipeline microbatching and ring
+attention are training-shape optimizations; a decode step's sequence
+length is 1, so there is nothing to ring over).
+
+The reference has no inference surface at all (it orchestrates containers);
+this is the serving half of the workload plane.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.mesh import axis_size, pvary_to, vma_union
+from .transformer import (
+    TransformerConfig,
+    _embed_tokens,
+    param_specs,
+    rms_norm,
+    rotary,
+)
+
+NEG_INF = -1.0e30
+
+
+def init_kv_cache(
+    config: TransformerConfig, mesh: Mesh, batch: int, max_len: int
+) -> dict:
+    """Global KV cache arrays [layers, B, max_len, H, D], head-sharded on tp
+    and batch-sharded on dp."""
+    cfg = config
+    shape = (cfg.n_layers, batch, max_len, cfg.n_heads, cfg.head_dim)
+    sharding = NamedSharding(mesh, P(None, "dp", None, "tp", None))
+    # Cache lives in the compute dtype (bf16 for serving configs) — it is
+    # the dominant HBM term; the attention dot upcasts to f32.
+    zeros = jnp.zeros(shape, cfg.dtype)
+    return {
+        "k": jax.device_put(zeros, sharding),
+        "v": jax.device_put(zeros, sharding),
+    }
+
+
+def _decode_layer(p, x, cache_k, cache_v, pos, cfg: TransformerConfig):
+    """One layer, one token: x [B, 1, d]; cache_k/v [B, T_max, H_loc, D].
+    Returns (x, new_cache_k, new_cache_v)."""
+    heads_local = cache_k.shape[2]
+    compute = cfg.dtype
+    positions = jnp.asarray([pos], jnp.float32)
+
+    xn = rms_norm(x, p["ln1"], cfg.norm_eps)
+
+    def proj(w):
+        y = jnp.einsum("btd,df->btf", xn.astype(compute), w.astype(compute))
+        return y.reshape(*y.shape[:-1], heads_local, cfg.head_dim)
+
+    q = rotary(proj(p["wq"]), positions, cfg.rope_theta).astype(jnp.float32)
+    k = rotary(proj(p["wk"]), positions, cfg.rope_theta)
+    v = proj(p["wv"])
+
+    cache_k = lax.dynamic_update_slice(
+        cache_k, k.astype(cache_k.dtype), (0, pos, 0, 0)
+    )
+    cache_v = lax.dynamic_update_slice(
+        cache_v, v.astype(cache_v.dtype), (0, pos, 0, 0)
+    )
+
+    scale = cfg.head_dim ** -0.5
+    logits = (
+        jnp.einsum("bqhd,bkhd->bhqk", q, cache_k.astype(jnp.float32)) * scale
+    )  # [B,H,1,T]
+    t_max = cache_k.shape[1]
+    visible = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, t_max), 3) <= pos
+    logits = jnp.where(visible, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    attn = jnp.einsum("bhqk,bkhd->bqhd", probs, cache_v.astype(jnp.float32))
+    attn = attn.reshape(*attn.shape[:-2], heads_local * cfg.head_dim)
+    out = jnp.einsum(
+        "btf,fd->btd", attn.astype(compute), p["wo"].astype(compute)
+    )
+    x = x + lax.psum(out, "tp").astype(x.dtype)
+
+    xn2 = rms_norm(x, p["ln2"], cfg.norm_eps)
+    h = jax.nn.silu(
+        jnp.einsum("btd,df->btf", xn2.astype(compute), p["w1"].astype(compute))
+    )
+    mlp = jnp.einsum("btf,fd->btd", h, p["w2"].astype(compute))
+    x = x + lax.psum(mlp, "tp").astype(x.dtype)
+    return x, cache_k, cache_v
+
+
+def _token_logits(params, token, cache, pos, cfg):
+    """token [B] -> (logits [B, V_local], new cache). Runs on local shards."""
+    x = _embed_tokens(params["embed"], token[:, None], cfg)  # [B, 1, d]
+    # Stacked layers: [pp=1, lps, ...] -> scan over lps.
+    stage_params = jax.tree.map(lambda a: a[0], params["layers"])
+    # Params shard over the (size-1) pp axis, so layer outputs are typed
+    # pp-varying; the scan carry must enter with the same vma type.
+    vma = vma_union(x, stage_params, cache)
+    x = pvary_to(x, vma)
+
+    def body(carry, inputs):
+        x = carry
+        layer_p, ck, cv = inputs
+        x, ck, cv = _decode_layer(layer_p, x, ck, cv, pos, cfg)
+        return pvary_to(x, vma), (pvary_to(ck, vma), pvary_to(cv, vma))
+
+    x, (new_k, new_v) = lax.scan(
+        body, x, (stage_params, cache["k"], cache["v"])
+    )
+    xn = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum(
+        "btd,dv->btv", xn.astype(cfg.dtype), params["unembed"].astype(cfg.dtype)
+    )
+    return logits[:, 0].astype(jnp.float32), {"k": new_k, "v": new_v}
+
+
+def _global_argmax(logits):
+    """Greedy pick over the tp-sharded vocab: local argmax, then psum-max
+    a (value, global index) pair across tp."""
+    v_local = logits.shape[-1]
+    v_start = lax.axis_index("tp") * v_local
+    local_idx = jnp.argmax(logits, axis=-1)
+    local_val = jnp.max(logits, axis=-1)
+    global_val = lax.pmax(local_val, "tp")
+    mine = local_val >= global_val  # winner shard(s)
+    candidate = jnp.where(mine, v_start + local_idx, jnp.iinfo(jnp.int32).max)
+    return lax.pmin(candidate.astype(jnp.int32), "tp")  # lowest-index tie-break
+
+
+def build_generate(config: TransformerConfig, mesh: Mesh, max_new_tokens: int):
+    """Returns jitted generate(params, prompt [B, T_prompt]) ->
+    tokens [B, T_prompt + max_new_tokens] (greedy).
+
+    Requires pp == sp == ep == 1 on the mesh (serving shape); dp and tp are
+    free. The prompt is consumed token-by-token through the same cached
+    step as decoding (simple, one compiled program; prefill batching is the
+    planned optimization)."""
+    cfg = config
+    for axis in ("pp", "sp", "ep"):
+        if axis_size(mesh, axis) != 1:
+            raise ValueError(
+                f"build_generate needs {axis}=1 (got {axis_size(mesh, axis)}); "
+                "use a dp/tp serving mesh"
+            )
+    if cfg.n_experts:
+        raise NotImplementedError("MoE decode is not implemented yet")
+    specs = param_specs(cfg)
+    cache_spec = P(None, "dp", None, "tp", None)
+
+    def local_generate(params, prompt, cache_k, cache_v):
+        t_prompt = prompt.shape[1]
+        total = t_prompt + max_new_tokens
+        # Scan carries must enter with the types the body produces. Tokens
+        # end up varying over dp plus the params' size-1 pp axis — NOT tp,
+        # which _global_argmax reduces away; promoting tokens to tp-varying
+        # would make the final psum double them across the tp shards. The
+        # cache picks up the params' full vma through the projections.
+        params_vma = vma_union(params)
+        token_vma = vma_union(prompt) | (params_vma - {"tp"})
+        cache_vma = vma_union(cache_k) | params_vma
+        cache = {
+            "k": pvary_to(cache_k, cache_vma),
+            "v": pvary_to(cache_v, cache_vma),
+        }
+
+        def step(carry, pos):
+            token, cache = carry
+            logits, cache = _token_logits(params, token, cache, pos, cfg)
+            picked = _global_argmax(logits)
+            # While still inside the prompt, the "next token" is the given
+            # prompt token, not the model's pick.
+            in_prompt = pos + 1 < t_prompt
+            next_token = jnp.where(
+                in_prompt,
+                lax.dynamic_index_in_dim(
+                    prompt, jnp.minimum(pos + 1, t_prompt - 1), axis=1,
+                    keepdims=False,
+                ),
+                picked,
+            )
+            next_token = pvary_to(next_token, token_vma)
+            cache = jax.tree.map(lambda c: pvary_to(c, cache_vma), cache)
+            return (next_token, cache), next_token
+
+        (_, _), tokens = lax.scan(
+            step,
+            (pvary_to(prompt[:, 0], token_vma), cache),
+            jnp.arange(total - 1),
+        )
+        out = jnp.concatenate(
+            [pvary_to(prompt[:, :1], token_vma), jnp.moveaxis(tokens, 0, 1)],
+            axis=1,
+        )
+        # The output spec is P('dp', None): reduce away the helper axes the
+        # params dragged in — all enforced size-1 (pp/sp/ep), where psum is
+        # the identity.
+        extra = tuple(
+            getattr(jax.typeof(out), "vma", frozenset()) - {"dp"}
+        )
+        return lax.psum(out, extra) if extra else out
+
+    sharded = jax.shard_map(
+        local_generate,
+        mesh=mesh,
+        in_specs=(specs, P("dp", None), cache_spec, cache_spec),
+        out_specs=P("dp", None),
+    )
+
+    @jax.jit
+    def generate(params, prompt):
+        cache = init_kv_cache(
+            cfg, mesh, prompt.shape[0], prompt.shape[1] + max_new_tokens
+        )
+        return sharded(params, prompt, cache["k"], cache["v"])
+
+    return generate
