@@ -24,6 +24,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..parallel.mesh import axis_size, pvary_to, vma_union
 from .transformer import (
     TransformerConfig,
+    _dense_mlp,
     _embed_tokens,
     param_specs,
     rms_norm,
@@ -90,11 +91,7 @@ def _decode_layer(p, x, cache_k, cache_v, pos, cfg: TransformerConfig):
     x = x + lax.psum(out, "tp").astype(x.dtype)
 
     xn2 = rms_norm(x, p["ln2"], cfg.norm_eps)
-    h = jax.nn.silu(
-        jnp.einsum("btd,df->btf", xn2.astype(compute), p["w1"].astype(compute))
-    )
-    mlp = jnp.einsum("btf,fd->btd", h, p["w2"].astype(compute))
-    x = x + lax.psum(mlp, "tp").astype(x.dtype)
+    x = x + _dense_mlp(p, xn2, cfg).astype(x.dtype)
     return x, cache_k, cache_v
 
 

@@ -19,6 +19,7 @@ Workload payload (on the pod template's `spec.workload`):
      "checkpoint_every": 5,            # 0 = no checkpointing
      "checkpoint_dir": "/tmp/...",     # required if checkpoint_every > 0
      "fail_at_step": 7,                # (tests) raise once on first run
+     "profile_dir": "/tmp/...",        # capture a JAX profiler trace
      "config": {...}}                  # model config overrides
 """
 
@@ -182,22 +183,35 @@ class WorkloadRunner:
             make_batch, sharding=batch_sharding, start=start, stop=total_steps
         )
 
+        # Observability (SURVEY.md §5): a JAX profiler trace is the TPU
+        # plane's analog of the reference's reconcile histograms — opens in
+        # TensorBoard/XProf.
+        import contextlib
+
+        profile_dir = workload.get("profile_dir")
+        profiler = (
+            jax.profiler.trace(profile_dir)
+            if profile_dir
+            else contextlib.nullcontext()
+        )
+
         losses = []
         try:
-            for step in range(start, total_steps):
-                if (
-                    fail_at is not None
-                    and js.status.restarts == 0
-                    and step == int(fail_at)
-                ):
-                    raise WorkloadFailure(f"injected failure at step {step}")
-                params, opt_state, loss = train_step(
-                    state["params"], state["opt_state"], make_batch(step)
-                )
-                state = {"params": params, "opt_state": opt_state}
-                losses.append(float(loss))
-                if ckpt is not None and (step + 1) % every == 0:
-                    ckpt.save(step + 1, {"state": state, "step": step + 1})
+            with profiler:
+                for step in range(start, total_steps):
+                    if (
+                        fail_at is not None
+                        and js.status.restarts == 0
+                        and step == int(fail_at)
+                    ):
+                        raise WorkloadFailure(f"injected failure at step {step}")
+                    params, opt_state, loss = train_step(
+                        state["params"], state["opt_state"], make_batch(step)
+                    )
+                    state = {"params": params, "opt_state": opt_state}
+                    losses.append(float(loss))
+                    if ckpt is not None and (step + 1) % every == 0:
+                        ckpt.save(step + 1, {"state": state, "step": step + 1})
         finally:
             if ckpt is not None:
                 ckpt.close()
