@@ -13,9 +13,11 @@ program say exactly which axis each reduction rides:
   (`parallel.ring_attention`) with K/V blocks rotating via `ppermute`.
 * **pp** — layer stages marched by the GPipe transform
   (`parallel.pipeline`); backward schedule comes from autodiff.
-* **ep** — MoE expert shards with dense (soft) dispatch: every rank runs its
-  local experts on its tokens, gate-weighted partials are `psum('ep')`-ed.
-  (Token-routed all_to_all dispatch is the planned optimization.)
+* **ep** — MoE expert shards. Two dispatch modes: dense (soft) dispatch
+  (`moe_top_k=0`): every rank runs its local experts on all tokens,
+  gate-weighted partials `psum('ep')`-ed; token-routed (`moe_top_k>0`):
+  top-k capacity routing with `all_to_all` slot exchange over the ep axis
+  (`_moe_mlp_routed`) — the sparse ICI-native path.
 * **dp** — pure data parallelism; gradients are `psum`-ed over (dp, sp) and
   any other axis a parameter is replicated on.
 
@@ -56,6 +58,11 @@ class TransformerConfig:
     # MoE: 0 experts = dense MLP in every layer.
     n_experts: int = 0
     d_ff_expert: int = 512
+    # 0 = dense soft dispatch (every expert sees every token, gate-weighted
+    # psum); k > 0 = token-choice top-k routing with a capacity buffer and
+    # all_to_all dispatch over the ep axis (the ICI-native sparse path).
+    moe_top_k: int = 0
+    moe_capacity_factor: float = 1.25
     max_seq_len: int = 2048
     rope_theta: float = 10000.0
     norm_eps: float = 1e-6
@@ -82,6 +89,12 @@ class TransformerConfig:
             raise ValueError(f"vocab {self.vocab_size} not divisible by tp {mc.tp}")
         if self.n_experts % max(mc.ep, 1):
             raise ValueError("n_experts must be divisible by ep")
+        if self.moe_top_k and not self.n_experts:
+            raise ValueError("moe_top_k requires n_experts > 0")
+        if self.moe_top_k > self.n_experts > 0:
+            raise ValueError(
+                f"moe_top_k {self.moe_top_k} exceeds n_experts {self.n_experts}"
+            )
 
 
 # ---------------------------------------------------------------------------
@@ -261,10 +274,100 @@ def _moe_mlp(p, xn, cfg):
     return lax.psum(out, ("ep", "tp"))
 
 
+def _moe_mlp_routed(p, xn, cfg):
+    """Token-choice top-k routing with all_to_all expert dispatch — the
+    ICI-native sparse path (SURVEY.md §2.2 EP row: "all-to-all over ICI").
+
+    Tokens enter replicated across `ep` (the batch shards over dp/sp), so
+    the block first splits the token set: each ep rank routes its own
+    1/ep chunk to top-k experts under a static per-expert capacity C
+    (overflow drops, standard switch-style), packs an expert-major
+    [E, C, d] buffer, and one `all_to_all` over `ep` ships every slot to
+    the rank owning its expert — genuinely distinct data in every lane.
+    After the expert FFN (weights column/row split over tp, one psum) a
+    reverse all_to_all returns the slots and a final psum('ep') of the
+    scatter-placed chunks reassembles the full token set, leaving the
+    output ep-invariant exactly like the dense path. Routing compute and
+    expert FLOPs are both 1/ep of the soft dispatch's, scaled by
+    k * capacity_factor / n_experts.
+    """
+    compute = cfg.dtype
+    ep = lax.psum(1, "ep")
+    ep_idx = lax.axis_index("ep")
+    e_local = cfg.n_experts // ep
+    num_experts, k = cfg.n_experts, cfg.moe_top_k
+    b, t, d = xn.shape
+    n_tok = b * t
+    if n_tok % ep:
+        raise ValueError(
+            f"routed MoE needs local tokens ({n_tok}) divisible by ep ({ep})"
+        )
+    n_chunk = n_tok // ep
+    x = xn.reshape(n_tok, d)
+    chunk = lax.dynamic_slice_in_dim(x, ep_idx * n_chunk, n_chunk, axis=0)
+
+    gates = jax.nn.softmax(
+        jnp.einsum(
+            "nd,de->ne", chunk.astype(jnp.float32), p["wg"].astype(jnp.float32)
+        ),
+        axis=-1,
+    )  # [n_chunk, E] f32 routing
+    top_w, top_i = lax.top_k(gates, k)  # [n_chunk, k]
+    top_w = top_w / jnp.maximum(jnp.sum(top_w, -1, keepdims=True), 1e-9)
+
+    # Static capacity: each expert accepts at most C slots per source rank.
+    capacity = max(
+        1, int(np.ceil(k * n_chunk / num_experts * cfg.moe_capacity_factor))
+    )
+
+    # Position of each (slot, token) choice inside its expert's buffer,
+    # slot-major so first choices win capacity over second choices.
+    choice = jax.nn.one_hot(top_i, num_experts, dtype=jnp.float32)
+    flat = choice.transpose(1, 0, 2).reshape(k * n_chunk, num_experts)
+    pos = jnp.cumsum(flat, axis=0) - flat  # [k*n, E]
+    kept = flat * (pos < capacity)
+    slot = jax.nn.one_hot(
+        pos.astype(jnp.int32), capacity, dtype=jnp.float32
+    )  # [k*n, E, C]
+    dispatch = (kept[..., None] * slot).reshape(
+        k, n_chunk, num_experts, capacity
+    )
+    weights = top_w.transpose(1, 0)[..., None, None]  # [k, n, 1, 1]
+    combine = jnp.sum(dispatch * weights, axis=0)  # [n_chunk, E, C]
+    dispatch = jnp.sum(dispatch, axis=0)  # [n_chunk, E, C]
+
+    send = jnp.einsum(
+        "nd,nec->ecd", chunk.astype(compute), dispatch.astype(compute)
+    ).reshape(ep, e_local, capacity, d)
+    recv = lax.all_to_all(send, "ep", split_axis=0, concat_axis=0)
+    # recv[s, e, c, :] = slot c for my expert e from source rank s.
+    tokens_in = recv.transpose(1, 0, 2, 3).reshape(e_local, ep * capacity, d)
+
+    h = jax.nn.silu(
+        jnp.einsum("etd,edf->etf", tokens_in, p["we1"].astype(compute))
+    )
+    y = jnp.einsum("etf,efd->etd", h, p["we2"].astype(compute))
+    y = lax.psum(y, "tp")  # row-parallel reduction, weights split over tp
+
+    back = y.reshape(e_local, ep, capacity, d).transpose(1, 0, 2, 3)
+    ret = lax.all_to_all(back, "ep", split_axis=0, concat_axis=0)
+    ret = ret.reshape(num_experts, capacity, d)
+    out_chunk = jnp.einsum(
+        "ecd,nec->nd", ret.astype(compute), combine.astype(compute)
+    )
+
+    # Reassemble the replicated token set: chunks are disjoint and in ep
+    # rank order, so this is a concatenation (all_gather), not a reduction.
+    full = lax.all_gather(out_chunk, "ep", tiled=True)
+    return full.reshape(b, t, d)
+
+
 def _layer(p, x, cfg: TransformerConfig, t_local: int):
     x = _attention_block(p, x, cfg, t_local)
     xn = rms_norm(x, p["ln2"], cfg.norm_eps)
-    if "wg" in p:
+    if "wg" in p and cfg.moe_top_k > 0:
+        out = _moe_mlp_routed(p, xn, cfg)
+    elif "wg" in p:
         out = _moe_mlp(p, xn, cfg)
     else:
         out = _dense_mlp(p, xn, cfg)
