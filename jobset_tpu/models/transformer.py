@@ -63,6 +63,10 @@ class TransformerConfig:
     # all_to_all dispatch over the ep axis (the ICI-native sparse path).
     moe_top_k: int = 0
     moe_capacity_factor: float = 1.25
+    # Load-balancing auxiliary loss weight (GShard/Switch style), applied
+    # only on the routed path — without it token-choice routing collapses
+    # onto a few experts.
+    moe_aux_coef: float = 0.01
     max_seq_len: int = 2048
     rope_theta: float = 10000.0
     norm_eps: float = 1e-6
@@ -315,6 +319,15 @@ def _moe_mlp_routed(p, xn, cfg):
     top_w, top_i = lax.top_k(gates, k)  # [n_chunk, k]
     top_w = top_w / jnp.maximum(jnp.sum(top_w, -1, keepdims=True), 1e-9)
 
+    # Load-balancing aux (GShard): E * sum_e f_e * P_e, where f_e is the
+    # fraction of routing choices that picked expert e and P_e the mean
+    # gate probability. Minimized by a uniform expert distribution.
+    choice_frac = jnp.mean(
+        jax.nn.one_hot(top_i, num_experts, dtype=jnp.float32), axis=(0, 1)
+    )  # [E]
+    prob_mean = jnp.mean(gates, axis=0)  # [E]
+    aux = num_experts * jnp.sum(choice_frac * prob_mean)
+
     # Static capacity: each expert accepts at most C slots per source rank.
     capacity = max(
         1, int(np.ceil(k * n_chunk / num_experts * cfg.moe_capacity_factor))
@@ -359,33 +372,37 @@ def _moe_mlp_routed(p, xn, cfg):
     # Reassemble the replicated token set: chunks are disjoint and in ep
     # rank order, so this is a concatenation (all_gather), not a reduction.
     full = lax.all_gather(out_chunk, "ep", tiled=True)
-    return full.reshape(b, t, d)
+    return full.reshape(b, t, d), aux
 
 
 def _layer(p, x, cfg: TransformerConfig, t_local: int):
+    """Returns (x, aux): aux is the routed-MoE load-balancing term (0 on
+    the dense and soft-dispatch paths)."""
     x = _attention_block(p, x, cfg, t_local)
     xn = rms_norm(x, p["ln2"], cfg.norm_eps)
+    aux = jnp.zeros((), jnp.float32)
     if "wg" in p and cfg.moe_top_k > 0:
-        out = _moe_mlp_routed(p, xn, cfg)
+        out, aux = _moe_mlp_routed(p, xn, cfg)
     elif "wg" in p:
         out = _moe_mlp(p, xn, cfg)
     else:
         out = _dense_mlp(p, xn, cfg)
-    return x + out.astype(x.dtype)
+    return x + out.astype(x.dtype), aux
 
 
 def _stage_fn(stage_params, x, cfg: TransformerConfig):
-    """One pipeline stage: scan over this stage's layers."""
+    """One pipeline stage: scan over this stage's layers. Returns
+    (x, aux_sum) — the stage's summed auxiliary losses."""
     t_local = x.shape[-2]
 
     def body(x, layer_p):
         fn = partial(_layer, cfg=cfg, t_local=t_local)
         if cfg.remat:
             fn = jax.checkpoint(fn)
-        return fn(layer_p, x), None
+        return fn(layer_p, x)
 
-    x, _ = lax.scan(body, x, stage_params)
-    return x
+    x, aux = lax.scan(body, x, stage_params)
+    return x, jnp.sum(aux)
 
 
 def _embed_tokens(embed, tokens, cfg):
@@ -427,7 +444,8 @@ def _sharded_softmax_xent(logits, targets, v_start):
 
 
 def _local_loss_fn(params, inputs, targets, mask, cfg: TransformerConfig, n_micro):
-    """Runs on each device's shards; returns (loss_sum, token_count)."""
+    """Runs on each device's shards; returns (loss_sum, token_count,
+    aux_mean) — aux_mean is the globally-averaged MoE balancing loss."""
     pp = lax.psum(1, "pp")
     x = _embed_tokens(params["embed"], inputs, cfg)  # [B_loc, T_loc, d]
     b_local = x.shape[0]
@@ -439,8 +457,8 @@ def _local_loss_fn(params, inputs, targets, mask, cfg: TransformerConfig, n_micr
     x_mbs = x.reshape(n_micro, b_local // n_micro, *x.shape[1:])
 
     stage_params = jax.tree.map(lambda a: a[0], params["layers"])
-    out = pipeline_apply(
-        partial(_stage_fn, cfg=cfg), stage_params, x_mbs, "pp"
+    out, aux_sum = pipeline_apply(
+        partial(_stage_fn, cfg=cfg), stage_params, x_mbs, "pp", with_aux=True
     )  # [n_micro, mb, T_loc, d]
     out = out.reshape(b_local, *out.shape[2:])
 
@@ -465,7 +483,14 @@ def _local_loss_fn(params, inputs, targets, mask, cfg: TransformerConfig, n_micr
         x = pvary_to(x, frozenset({"dp", "sp", "pp", "ep"}))
         return lax.psum(x, ("dp", "sp", "pp", "ep"))
 
-    return _reduce(jnp.sum(per_token)), _reduce(count)
+    # Aux: summed over this rank's (stage layers x microbatches x its ep
+    # token chunk); the psum adds the other stages/chunks/shard groups, so
+    # the mean divides by every one of those group counts.
+    groups = (
+        lax.psum(1, "dp") * lax.psum(1, "sp") * lax.psum(1, "ep")
+    )
+    aux_mean = _reduce(aux_sum) / (cfg.n_layers * n_micro * groups)
+    return _reduce(jnp.sum(per_token)), _reduce(count), aux_mean
 
 
 def build_train_step(config: TransformerConfig, mesh: Mesh, optimizer):
@@ -478,8 +503,11 @@ def build_train_step(config: TransformerConfig, mesh: Mesh, optimizer):
 
     def local_grads(params, inputs, targets, mask):
         def scalar_loss(p):
-            loss_sum, total = _local_loss_fn(p, inputs, targets, mask, cfg, n_micro)
-            return loss_sum / jnp.maximum(total, 1.0)
+            loss_sum, total, aux_mean = _local_loss_fn(
+                p, inputs, targets, mask, cfg, n_micro
+            )
+            ce = loss_sum / jnp.maximum(total, 1.0)
+            return ce + cfg.moe_aux_coef * aux_mean
 
         # No manual gradient psum: under shard_map's VMA typing, parameters
         # enter invariant over their replicated axes, every use inserts a
@@ -528,7 +556,9 @@ def build_forward(config: TransformerConfig, mesh: Mesh):
         mb_count = next(m for m in range(min(n_micro, b_local), 0, -1) if b_local % m == 0)
         x_mbs = x.reshape(mb_count, b_local // mb_count, *x.shape[1:])
         stage_params = jax.tree.map(lambda a: a[0], params["layers"])
-        out = pipeline_apply(partial(_stage_fn, cfg=cfg), stage_params, x_mbs, "pp")
+        out, _ = pipeline_apply(
+            partial(_stage_fn, cfg=cfg), stage_params, x_mbs, "pp", with_aux=True
+        )
         out = out.reshape(b_local, *out.shape[2:])
         # Broadcast the last stage's result to every pp rank.
         is_last = lax.axis_index("pp") == pp - 1

@@ -28,6 +28,7 @@ def pipeline_apply(
     stage_params,
     microbatches: jax.Array,
     axis_name: str = "pp",
+    with_aux: bool = False,
 ):
     """Run `microbatches` through the pipeline.
 
@@ -36,6 +37,11 @@ def pipeline_apply(
     microbatches: [n_micro, ...] local inputs (read by stage 0 only).
     Returns [n_micro, ...] outputs (meaningful on the last stage; zeros
     elsewhere — callers typically reduce the loss with a psum over the axis).
+
+    with_aux=True: stage_fn returns (y, aux_scalar) and pipeline_apply
+    returns (outputs, aux_sum) — aux summed over this rank's stage across
+    its active microbatches (auxiliary losses, e.g. MoE load balancing);
+    callers reduce across the axis themselves.
     """
     pp = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
@@ -57,18 +63,23 @@ def pipeline_apply(
 
     outputs0 = _varying(jnp.zeros((n_micro, *mb_shape), microbatches.dtype))
     recv0 = _varying(jnp.zeros(mb_shape, microbatches.dtype))
+    aux0 = _varying(jnp.zeros((), jnp.float32))
 
     shift_perm = [(i, i + 1) for i in range(pp - 1)]  # non-cyclic; rank0 recvs 0
 
     def step(carry, t):
-        recv, outputs = carry
+        recv, outputs, aux_acc = carry
         # Stage 0 feeds from the microbatch queue; other stages from the ring.
         feed_idx = jnp.clip(t, 0, n_micro - 1)
         my_feed = lax.dynamic_index_in_dim(microbatches, feed_idx, 0, keepdims=False)
         x = jnp.where(idx == 0, my_feed, recv)
 
         active = jnp.logical_and(t - idx >= 0, t - idx < n_micro)
-        y = stage_fn(stage_params, x)
+        if with_aux:
+            y, aux = stage_fn(stage_params, x)
+            aux_acc = aux_acc + jnp.where(active, aux, 0.0)
+        else:
+            y = stage_fn(stage_params, x)
         y = jnp.where(active, y, jnp.zeros_like(y))
 
         # Last stage archives its finished microbatch.
@@ -82,7 +93,9 @@ def pipeline_apply(
         # Hand the activation to the next stage (stage pp-1 sends nowhere).
         if pp > 1:
             recv = lax.ppermute(y, axis_name, shift_perm)
-        return (recv, outputs), None
+        return (recv, outputs, _varying(aux_acc)), None
 
-    (_, outputs), _ = lax.scan(step, (recv0, outputs0), jnp.arange(n_steps))
-    return outputs
+    (_, outputs, aux_sum), _ = lax.scan(
+        step, (recv0, outputs0, aux0), jnp.arange(n_steps)
+    )
+    return (outputs, aux_sum) if with_aux else outputs

@@ -177,9 +177,11 @@ def test_routed_topk_equals_dense_dispatch_when_k_is_all_experts():
         "targets": np.random.default_rng(2).integers(0, 64, (4, 16)),
     }
     losses = {}
+    # aux coef 0: the balancing loss exists only on the routed path and
+    # would otherwise (correctly) offset the compared losses.
     for name, extra in (
         ("dense", dict(moe_top_k=0)),
-        ("routed", dict(moe_top_k=4, moe_capacity_factor=8.0)),
+        ("routed", dict(moe_top_k=4, moe_capacity_factor=8.0, moe_aux_coef=0.0)),
     ):
         cfg = tiny_config(**base, **extra)
         cfg.validate(ROUTED_MESH)
@@ -225,3 +227,23 @@ def test_routed_moe_matches_single_device():
             run.append(float(loss))
         losses[name] = run
     np.testing.assert_allclose(losses["multi"], losses["single"], rtol=2e-4)
+
+
+def test_moe_aux_loss_balances_expert_usage():
+    """The aux term is minimized at uniform routing: a uniform gate
+    distribution must score lower than a collapsed one."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    n, E, k = 64, 4, 2
+    uniform = jnp.full((n, E), 1.0 / E)
+    collapsed = jnp.concatenate(
+        [jnp.full((n, 1), 0.97), jnp.full((n, E - 1), 0.01)], axis=1
+    )
+
+    def aux_of(gates):
+        _, top_i = lax.top_k(gates, k)
+        frac = jnp.mean(jax.nn.one_hot(top_i, E), axis=(0, 1))
+        return float(E * jnp.sum(frac * jnp.mean(gates, axis=0)))
+
+    assert aux_of(uniform) < aux_of(collapsed)
