@@ -43,7 +43,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..parallel.mesh import MeshConfig, axis_size, pvary_to
+from ..parallel.mesh import MeshConfig, axis_size, pvary_to, vma_union
 from ..parallel.pipeline import pipeline_apply
 from ..parallel.ring_attention import ring_attention
 
@@ -289,11 +289,14 @@ def _moe_mlp_routed(p, xn, cfg):
     [E, C, d] buffer, and one `all_to_all` over `ep` ships every slot to
     the rank owning its expert — genuinely distinct data in every lane.
     After the expert FFN (weights column/row split over tp, one psum) a
-    reverse all_to_all returns the slots and a final psum('ep') of the
-    scatter-placed chunks reassembles the full token set, leaving the
-    output ep-invariant exactly like the dense path. Routing compute and
-    expert FLOPs are both 1/ep of the soft dispatch's, scaled by
-    k * capacity_factor / n_experts.
+    reverse all_to_all returns the slots and a tiled `all_gather` over `ep`
+    concatenates the rank-ordered disjoint chunks back into the full token
+    set. The gathered output is numerically identical on every ep rank but
+    stays *typed* ep-varying in shard_map's vma system (all_gather, unlike
+    psum, does not erase the axis); the loss reduction normalizes that by
+    psumming over ep and dividing the group product back out. Routing
+    compute and expert FLOPs are both 1/ep of the soft dispatch's, scaled
+    by k * capacity_factor / n_experts.
     """
     compute = cfg.dtype
     ep = lax.psum(1, "ep")
@@ -319,14 +322,19 @@ def _moe_mlp_routed(p, xn, cfg):
     top_w, top_i = lax.top_k(gates, k)  # [n_chunk, k]
     top_w = top_w / jnp.maximum(jnp.sum(top_w, -1, keepdims=True), 1e-9)
 
-    # Load-balancing aux (GShard): E * sum_e f_e * P_e, where f_e is the
-    # fraction of routing choices that picked expert e and P_e the mean
-    # gate probability. Minimized by a uniform expert distribution.
-    choice_frac = jnp.mean(
-        jax.nn.one_hot(top_i, num_experts, dtype=jnp.float32), axis=(0, 1)
-    )  # [E]
-    prob_mean = jnp.mean(gates, axis=0)  # [E]
-    aux = num_experts * jnp.sum(choice_frac * prob_mean)
+    # Per-layer balancing statistics for the GShard aux loss (E*sum f_e*P_e):
+    # raw per-expert choice counts and gate-probability sums over this
+    # rank's chunk. The aux itself is formed in `_local_loss_fn` from the
+    # globally-psummed, microbatch-pooled stats: E*sum(f*P) is nonlinear in
+    # the token chunking, so per-chunk aux values averaged after the fact
+    # would make the training objective depend on the mesh shape and the
+    # microbatch count; pooling the linear stats first makes the objective
+    # the global-batch computation on any mesh, and costs ONE fused psum
+    # per step instead of a latency-bound collective inside every layer.
+    choice_onehot = jax.nn.one_hot(top_i, num_experts, dtype=jnp.float32)
+    stats = jnp.stack(
+        [jnp.sum(choice_onehot, axis=(0, 1)), jnp.sum(gates, axis=0)]
+    )  # [2, E]: choice counts, gate-prob sums
 
     # Static capacity: each expert accepts at most C slots per source rank.
     capacity = max(
@@ -335,8 +343,7 @@ def _moe_mlp_routed(p, xn, cfg):
 
     # Position of each (slot, token) choice inside its expert's buffer,
     # slot-major so first choices win capacity over second choices.
-    choice = jax.nn.one_hot(top_i, num_experts, dtype=jnp.float32)
-    flat = choice.transpose(1, 0, 2).reshape(k * n_chunk, num_experts)
+    flat = choice_onehot.transpose(1, 0, 2).reshape(k * n_chunk, num_experts)
     pos = jnp.cumsum(flat, axis=0) - flat  # [k*n, E]
     kept = flat * (pos < capacity)
     slot = jax.nn.one_hot(
@@ -372,27 +379,36 @@ def _moe_mlp_routed(p, xn, cfg):
     # Reassemble the replicated token set: chunks are disjoint and in ep
     # rank order, so this is a concatenation (all_gather), not a reduction.
     full = lax.all_gather(out_chunk, "ep", tiled=True)
-    return full.reshape(b, t, d), aux
+    return full.reshape(b, t, d), stats
+
+
+def aux_stat_width(cfg: TransformerConfig) -> int:
+    """Trailing dimension of the per-layer aux statistics carried through
+    the pipeline: per-expert choice counts + gate-prob sums on the routed
+    path, a zero placeholder elsewhere (shapes must be config-static)."""
+    return max(cfg.n_experts, 1)
 
 
 def _layer(p, x, cfg: TransformerConfig, t_local: int):
-    """Returns (x, aux): aux is the routed-MoE load-balancing term (0 on
-    the dense and soft-dispatch paths)."""
+    """Returns (x, stats): stats [2, E] are the routed-MoE balancing
+    statistics (zeros on the dense and soft-dispatch paths)."""
     x = _attention_block(p, x, cfg, t_local)
     xn = rms_norm(x, p["ln2"], cfg.norm_eps)
-    aux = jnp.zeros((), jnp.float32)
+    stats = jnp.zeros((2, aux_stat_width(cfg)), jnp.float32)
     if "wg" in p and cfg.moe_top_k > 0:
-        out, aux = _moe_mlp_routed(p, xn, cfg)
+        out, stats = _moe_mlp_routed(p, xn, cfg)
     elif "wg" in p:
         out = _moe_mlp(p, xn, cfg)
     else:
         out = _dense_mlp(p, xn, cfg)
-    return x + out.astype(x.dtype), aux
+    return x + out.astype(x.dtype), stats
 
 
 def _stage_fn(stage_params, x, cfg: TransformerConfig):
     """One pipeline stage: scan over this stage's layers. Returns
-    (x, aux_sum) — the stage's summed auxiliary losses."""
+    (x, stats) — stats [layers_per_stage, 2, E] stacked per layer (the
+    balancing aux is nonlinear in them, so layers stay separate until the
+    loss function forms the per-layer products from global sums)."""
     t_local = x.shape[-2]
 
     def body(x, layer_p):
@@ -401,8 +417,8 @@ def _stage_fn(stage_params, x, cfg: TransformerConfig):
             fn = jax.checkpoint(fn)
         return fn(layer_p, x)
 
-    x, aux = lax.scan(body, x, stage_params)
-    return x, jnp.sum(aux)
+    x, stats = lax.scan(body, x, stage_params)
+    return x, stats
 
 
 def _embed_tokens(embed, tokens, cfg):
@@ -457,9 +473,12 @@ def _local_loss_fn(params, inputs, targets, mask, cfg: TransformerConfig, n_micr
     x_mbs = x.reshape(n_micro, b_local // n_micro, *x.shape[1:])
 
     stage_params = jax.tree.map(lambda a: a[0], params["layers"])
-    out, aux_sum = pipeline_apply(
-        partial(_stage_fn, cfg=cfg), stage_params, x_mbs, "pp", with_aux=True
-    )  # [n_micro, mb, T_loc, d]
+    lps = jax.tree.leaves(stage_params)[0].shape[0]
+    out, aux_stats = pipeline_apply(
+        partial(_stage_fn, cfg=cfg), stage_params, x_mbs, "pp",
+        with_aux=True,
+        aux_init=jnp.zeros((lps, 2, aux_stat_width(cfg)), jnp.float32),
+    )  # out [n_micro, mb, T_loc, d]; aux_stats [lps, 2, E]
     out = out.reshape(b_local, *out.shape[2:])
 
     xn = rms_norm(out, params["final_norm"], cfg.norm_eps)
@@ -474,22 +493,45 @@ def _local_loss_fn(params, inputs, targets, mask, cfg: TransformerConfig, n_micr
     per_token = jnp.where(is_last, per_token * mask, 0.0)
     count = jnp.where(is_last, jnp.sum(mask), 0.0)
 
-    # Sums reduce over every data-ish axis, 'ep' included: the MoE pipeline
-    # carry is typed ep-varying while the dense path is ep-invariant, so both
-    # values are first promoted to a uniform varying type. The replicated
-    # contribution scales numerator and denominator by ep equally — the mean
-    # is unchanged and the output type becomes fully invariant.
+    # Sums reduce over ALL five mesh axes. The pipeline carry is promoted to
+    # the full vma union of the stage weights — which includes 'tp' (and
+    # 'ep' for MoE) — so every value here is typed varying over every axis
+    # regardless of numeric replication; psumming over all of them is the
+    # only way the result can satisfy an invariant (P()) out_spec. Axes the
+    # value is numerically replicated on (tp always, ep on the dense path)
+    # scale numerator and denominator equally, so the means are unchanged.
     def _reduce(x):
-        x = pvary_to(x, frozenset({"dp", "sp", "pp", "ep"}))
-        return lax.psum(x, ("dp", "sp", "pp", "ep"))
+        x = pvary_to(x, frozenset({"dp", "sp", "pp", "ep", "tp"}))
+        return lax.psum(x, ("dp", "sp", "pp", "ep", "tp"))
 
-    # Aux: summed over this rank's (stage layers x microbatches x its ep
-    # token chunk); the psum adds the other stages/chunks/shard groups, so
-    # the mean divides by every one of those group counts.
-    groups = (
-        lax.psum(1, "dp") * lax.psum(1, "sp") * lax.psum(1, "ep")
-    )
-    aux_mean = _reduce(aux_sum) / (cfg.n_layers * n_micro * groups)
+    # Aux (GShard, routed MoE only): each stage carried raw per-layer
+    # [choice-count, gate-prob-sum] stats pooled over its active
+    # microbatches; ONE fused psum over the token-sharding axes yields the
+    # global-batch stats, from which each layer's E*sum(f_e*P_e) is formed
+    # (f_e = fraction of routing choices picking expert e — counts sum to
+    # k*n_tokens; P_e = mean gate probability). Pooling the linear stats
+    # before the nonlinear product makes the objective identical on every
+    # mesh shape AND microbatch count.
+    if cfg.moe_top_k > 0:
+        g = lax.psum(
+            pvary_to(aux_stats, frozenset({"dp", "sp", "ep"})),
+            ("dp", "sp", "ep"),
+        )  # [lps, 2, E] global stats for this stage's layers
+        choices, probs = g[:, 0, :], g[:, 1, :]
+        total = jnp.maximum(jnp.sum(choices, -1, keepdims=True), 1e-9)
+        frac = choices / total
+        pbar = probs / jnp.maximum(total / cfg.moe_top_k, 1e-9)
+        stage_aux = jnp.sum(cfg.n_experts * frac * pbar)
+        # The pp psum in _reduce genuinely sums distinct stages (= all
+        # n_layers layers); the dp/sp/ep/tp psums multiply the replicated
+        # value by their product, divided back out here.
+        groups = (
+            lax.psum(1, "dp") * lax.psum(1, "sp") * lax.psum(1, "ep")
+            * lax.psum(1, "tp")
+        )
+        aux_mean = _reduce(stage_aux) / (cfg.n_layers * groups)
+    else:
+        aux_mean = jnp.zeros((), jnp.float32)
     return _reduce(jnp.sum(per_token)), _reduce(count), aux_mean
 
 
@@ -556,8 +598,11 @@ def build_forward(config: TransformerConfig, mesh: Mesh):
         mb_count = next(m for m in range(min(n_micro, b_local), 0, -1) if b_local % m == 0)
         x_mbs = x.reshape(mb_count, b_local // mb_count, *x.shape[1:])
         stage_params = jax.tree.map(lambda a: a[0], params["layers"])
+        lps = jax.tree.leaves(stage_params)[0].shape[0]
         out, _ = pipeline_apply(
-            partial(_stage_fn, cfg=cfg), stage_params, x_mbs, "pp", with_aux=True
+            partial(_stage_fn, cfg=cfg), stage_params, x_mbs, "pp",
+            with_aux=True,
+            aux_init=jnp.zeros((lps, 2, aux_stat_width(cfg)), jnp.float32),
         )
         out = out.reshape(b_local, *out.shape[2:])
         # Broadcast the last stage's result to every pp rank.
@@ -566,9 +611,18 @@ def build_forward(config: TransformerConfig, mesh: Mesh):
         xn = rms_norm(out, params["final_norm"], cfg.norm_eps)
         # Vocab stays sharded; the out_spec concatenates the tp shards into
         # the global [B, T, vocab] array — no gather collective needed.
-        return jnp.einsum(
+        logits = jnp.einsum(
             "btd,dv->btv", xn.astype(cfg.dtype), params["unembed"].astype(cfg.dtype)
         )
+        # MoE leaves the activations *typed* ep-varying (the routed path's
+        # all_gather replicates values but, unlike psum, keeps the axis in
+        # the vma set), which the P("dp","sp","tp") out_spec rejects. A
+        # pmean over the residual axes is numerically the identity on the
+        # replicated value and retypes it invariant.
+        extra = tuple(vma_union(logits) - frozenset({"dp", "sp", "tp"}))
+        if extra:
+            logits = lax.pmean(logits, extra)
+        return logits
 
     return jax.jit(
         jax.shard_map(

@@ -29,6 +29,7 @@ def pipeline_apply(
     microbatches: jax.Array,
     axis_name: str = "pp",
     with_aux: bool = False,
+    aux_init: jax.Array | None = None,
 ):
     """Run `microbatches` through the pipeline.
 
@@ -38,10 +39,12 @@ def pipeline_apply(
     Returns [n_micro, ...] outputs (meaningful on the last stage; zeros
     elsewhere — callers typically reduce the loss with a psum over the axis).
 
-    with_aux=True: stage_fn returns (y, aux_scalar) and pipeline_apply
-    returns (outputs, aux_sum) — aux summed over this rank's stage across
-    its active microbatches (auxiliary losses, e.g. MoE load balancing);
-    callers reduce across the axis themselves.
+    with_aux=True: stage_fn returns (y, aux) and pipeline_apply returns
+    (outputs, aux_sum) — aux summed elementwise over this rank's stage
+    across its active microbatches (auxiliary losses or statistics, e.g.
+    MoE load-balancing counts); callers reduce across the axis themselves.
+    Non-scalar aux requires `aux_init`, a zeros array of the aux shape
+    (the accumulator's shape must be known before the first stage call).
     """
     pp = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
@@ -63,7 +66,9 @@ def pipeline_apply(
 
     outputs0 = _varying(jnp.zeros((n_micro, *mb_shape), microbatches.dtype))
     recv0 = _varying(jnp.zeros(mb_shape, microbatches.dtype))
-    aux0 = _varying(jnp.zeros((), jnp.float32))
+    aux0 = _varying(
+        jnp.zeros((), jnp.float32) if aux_init is None else aux_init
+    )
 
     shift_perm = [(i, i + 1) for i in range(pp - 1)]  # non-cyclic; rank0 recvs 0
 

@@ -247,3 +247,54 @@ def test_moe_aux_loss_balances_expert_usage():
         return float(E * jnp.sum(frac * jnp.mean(gates, axis=0)))
 
     assert aux_of(uniform) < aux_of(collapsed)
+
+
+def test_routed_moe_aux_invariant_to_microbatching():
+    """The balancing aux is formed from microbatch-pooled global statistics,
+    so the training objective must not depend on n_microbatches (which
+    otherwise changes with pp): identical losses for n_micro 1 vs 2."""
+    batch_np = {
+        "inputs": np.random.default_rng(10).integers(0, 64, (4, 16)),
+        "targets": np.random.default_rng(11).integers(0, 64, (4, 16)),
+    }
+    losses = {}
+    for n_micro in (1, 2):
+        cfg = tiny_config(
+            n_layers=2, n_experts=4, d_ff_expert=32, moe_top_k=2,
+            moe_capacity_factor=8.0, remat=False, n_microbatches=n_micro,
+        )
+        mesh = build_mesh(MeshConfig(), jax.devices()[:1])
+        params = init_params(jax.random.key(7), cfg, mesh)
+        opt = optax.sgd(1e-2)
+        opt_state = opt.init(params)
+        step = build_train_step(cfg, mesh, opt)
+        spec = NamedSharding(mesh, P("dp", "sp"))
+        batch = {k: jax.device_put(jnp.asarray(v), spec) for k, v in batch_np.items()}
+        run = []
+        for _ in range(3):
+            params, opt_state, loss = step(params, opt_state, batch)
+            run.append(float(loss))
+        losses[n_micro] = run
+    np.testing.assert_allclose(losses[1], losses[2], rtol=1e-5)
+
+
+def test_routed_moe_forward_on_ep_mesh():
+    """build_forward must type-check and run on ep>1 meshes: the routed
+    path's all_gather output is ep-varying in vma terms and needs the
+    residual-axis pmean before the P('dp','sp','tp') out_spec."""
+    mc = MeshConfig(dp=1, pp=2, ep=2, sp=2, tp=1)
+    mesh = build_mesh(mc)
+    cfg = tiny_config(
+        n_layers=2, n_experts=4, d_ff_expert=32, moe_top_k=2,
+        moe_capacity_factor=4.0, remat=False,
+    )
+    cfg.validate(mc)
+    params = init_params(jax.random.key(2), cfg, mesh)
+    fwd = build_forward(cfg, mesh)
+    tokens = jax.device_put(
+        jnp.asarray(np.random.default_rng(3).integers(0, 64, (4, 16))),
+        NamedSharding(mesh, P("dp", "sp")),
+    )
+    logits = fwd(params, tokens)
+    assert logits.shape == (4, 16, 64)
+    assert bool(jnp.isfinite(logits).all())
