@@ -78,6 +78,22 @@ class Affinity:
     pod_affinity: list[AffinityTerm] = field(default_factory=list)
     pod_anti_affinity: list[AffinityTerm] = field(default_factory=list)
 
+    def clone(self) -> "Affinity":
+        def term(t: AffinityTerm) -> AffinityTerm:
+            return AffinityTerm(
+                topology_key=t.topology_key,
+                job_key_in=list(t.job_key_in) if t.job_key_in is not None else None,
+                job_key_exists=t.job_key_exists,
+                job_key_not_in=(
+                    list(t.job_key_not_in) if t.job_key_not_in is not None else None
+                ),
+            )
+
+        return Affinity(
+            pod_affinity=[term(t) for t in self.pod_affinity],
+            pod_anti_affinity=[term(t) for t in self.pod_anti_affinity],
+        )
+
 
 @dataclass
 class PodSpec:
@@ -95,12 +111,39 @@ class PodSpec:
     # to launch the JAX worker; ignored by the control plane).
     workload: dict = field(default_factory=dict)
 
+    def clone(self) -> "PodSpec":
+        # Hand-written clone: generic deepcopy of pod specs was the hottest
+        # item in the 15k-node bench profile (the Job controller stamps out
+        # one spec per pod); only `workload` is free-form and needs a real
+        # deep copy.
+        return PodSpec(
+            restart_policy=self.restart_policy,
+            node_selector=dict(self.node_selector),
+            tolerations=[
+                Toleration(key=t.key, operator=t.operator, value=t.value, effect=t.effect)
+                for t in self.tolerations
+            ],
+            affinity=self.affinity.clone() if self.affinity is not None else None,
+            subdomain=self.subdomain,
+            hostname=self.hostname,
+            scheduling_gates=list(self.scheduling_gates),
+            node_name=self.node_name,
+            workload=copy.deepcopy(self.workload) if self.workload else {},
+        )
+
 
 @dataclass
 class PodTemplateSpec:
     labels: dict[str, str] = field(default_factory=dict)
     annotations: dict[str, str] = field(default_factory=dict)
     spec: PodSpec = field(default_factory=PodSpec)
+
+    def clone(self) -> "PodTemplateSpec":
+        return PodTemplateSpec(
+            labels=dict(self.labels),
+            annotations=dict(self.annotations),
+            spec=self.spec.clone(),
+        )
 
 
 @dataclass
@@ -123,6 +166,17 @@ class JobSpec:
         if self.completions is not None and self.completions < parallelism:
             return self.completions
         return parallelism
+
+    def clone(self) -> "JobSpec":
+        return JobSpec(
+            parallelism=self.parallelism,
+            completions=self.completions,
+            completion_mode=self.completion_mode,
+            backoff_limit=self.backoff_limit,
+            suspend=self.suspend,
+            active_deadline_seconds=self.active_deadline_seconds,
+            template=self.template.clone(),
+        )
 
 
 @dataclass

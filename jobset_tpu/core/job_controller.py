@@ -25,16 +25,30 @@ class JobController:
         cluster.job_controller = self
 
     def sync(self) -> bool:
+        """Visit only jobs whose pods/spec changed since the last pass
+        (cluster.dirty_job_uids — the watch-queue analog of the real k8s Job
+        controller); jobs with admission-rejected pods stay queued so the
+        transient-rejection retry loop keeps running."""
         changed = False
-        for job in list(self.cluster.jobs.values()):
+        cluster = self.cluster
+        dirty, cluster.dirty_job_uids = cluster.dirty_job_uids, set()
+        retry: set[str] = set()
+        for uid in sorted(dirty):
+            key = cluster.jobs_by_uid.get(uid)
+            job = cluster.jobs.get(key) if key else None
+            if job is None:
+                continue
             finished, _ = job.finished()
             if finished:
                 continue
             if job.suspended():
                 changed |= self._sync_suspended(job)
                 continue
-            changed |= self._create_missing_pods(job)
-            changed |= self._aggregate_status(job)
+            pods_changed, complete = self._sync_pods(job)
+            changed |= pods_changed
+            if not complete:
+                retry.add(uid)
+        cluster.dirty_job_uids |= retry
         return changed
 
     # ------------------------------------------------------------------
@@ -57,29 +71,52 @@ class JobController:
         # and the solver's capacity feasibility (objects.py pods_expected).
         return job.pods_expected()
 
-    def _create_missing_pods(self, job: Job) -> bool:
-        existing = {
-            pod.completion_index()
-            for pod in self.cluster.pods_for_job(job)
-            if pod.status.phase != POD_FAILED
-        }
+    def _sync_pods(self, job: Job) -> tuple[bool, bool]:
+        """One pass over the job's pod index: aggregate status counts AND
+        create missing pods. Returns (changed, complete) where complete means
+        every desired pod exists (nothing left to retry)."""
+        cluster = self.cluster
         desired = self._desired_indexes(job)
+        active = ready = succeeded = failed = 0
+        existing: set[int] = set()
+        for key in cluster.pods_by_job_uid.get(job.metadata.uid, ()):
+            pod = cluster.pods.get(key)
+            if pod is None:
+                continue
+            phase = pod.status.phase
+            if phase in (POD_PENDING, POD_RUNNING):
+                active += 1
+                if pod.status.ready:
+                    ready += 1
+                existing.add(pod.completion_index())
+            elif phase == "Succeeded":
+                succeeded += 1
+                existing.add(pod.completion_index())
+            elif phase == POD_FAILED:
+                failed += 1
+
         changed = False
+        complete = True
         # Leader (index 0) first: under exclusive placement follower admission
         # is gated on the leader being scheduled, so creating in index order
         # minimizes rejected attempts.
-        for idx in range(desired):
-            if idx in existing:
-                continue
-            pod = self._construct_pod(job, idx)
-            try:
-                self.cluster.create_pod(pod, job)
-                changed = True
-            except AdmissionError:
-                # Expected transient rejection (e.g. leader not scheduled yet);
-                # retried on the next sync pass.
-                continue
-        return changed
+        if len(existing) < desired:
+            for idx in range(desired):
+                if idx in existing:
+                    continue
+                pod = self._construct_pod(job, idx)
+                try:
+                    self.cluster.create_pod(pod, job)
+                    changed = True
+                    active += 1  # created Pending
+                except AdmissionError:
+                    # Expected transient rejection (e.g. leader not scheduled
+                    # yet); retried on the next sync pass.
+                    complete = False
+                    continue
+
+        changed |= self._apply_status(job, active, ready, succeeded, failed)
+        return changed, complete
 
     def _construct_pod(self, job: Job, index: int) -> Pod:
         tmpl = job.spec.template
@@ -102,17 +139,7 @@ class JobController:
         pod.spec.hostname = base
         return pod
 
-    def _aggregate_status(self, job: Job) -> bool:
-        active = ready = succeeded = failed = 0
-        for pod in self.cluster.pods_for_job(job):
-            if pod.status.phase in (POD_PENDING, POD_RUNNING):
-                active += 1
-                if pod.status.ready:
-                    ready += 1
-            elif pod.status.phase == "Succeeded":
-                succeeded += 1
-            elif pod.status.phase == POD_FAILED:
-                failed += 1
+    def _apply_status(self, job: Job, active, ready, succeeded, failed) -> bool:
         new = (active, ready, succeeded, failed)
         old = (job.status.active, job.status.ready, job.status.succeeded, job.status.failed)
         if new != old:
@@ -130,6 +157,4 @@ class JobController:
 
 
 def _clone_pod_spec(spec):
-    import copy
-
-    return copy.deepcopy(spec)
+    return spec.clone()

@@ -34,12 +34,15 @@ class Counter:
 
 
 class Histogram:
-    """Fixed-bucket latency histogram (seconds), exp buckets 1ms..~64s."""
+    """Fixed-bucket latency histogram (seconds), exp buckets 1ms..~64s with
+    half-power-of-two (~1.41x) spacing so percentile quantization error stays
+    under ~41% (a full power-of-two ladder doubles at each edge, which made
+    p99 comparisons between placement modes flip on sub-ms noise)."""
 
-    def __init__(self, name: str, help_text: str = "", num_buckets: int = 17):
+    def __init__(self, name: str, help_text: str = "", num_buckets: int = 33):
         self.name = name
         self.help = help_text
-        self.buckets = [0.001 * (2**i) for i in range(num_buckets)]
+        self.buckets = [0.001 * (2 ** (i / 2)) for i in range(num_buckets)]
         self.counts = [0] * (num_buckets + 1)
         self.sum = 0.0
         self.n = 0

@@ -33,9 +33,14 @@ class PodReconciler:
         )
 
     def sync(self) -> bool:
+        # Index-driven (cluster.leader_pod_keys, maintained on bind/delete):
+        # visits only the watched scheduled leaders instead of scanning the
+        # whole pod store per tick — the event-filter analog of
+        # pod_controller.go:63-73.
         changed = False
-        for pod in list(self.cluster.pods.values()):
-            if self._watched(pod):
+        for key in sorted(self.cluster.leader_pod_keys):
+            pod = self.cluster.pods.get(key)
+            if pod is not None and self._watched(pod):
                 changed |= self.reconcile_leader(pod)
         return changed
 

@@ -92,7 +92,19 @@ class JobSetReconciler:
         self._delete_jobs(owned.delete, ctx)
 
         if owned.failed:
+            restarts_before = js.status.restarts
             execute_failure_policy(js, owned, ctx, now)
+            if (
+                js.status.restarts != restarts_before
+                and self.placement is not None
+                and hasattr(self.placement, "prepare")
+            ):
+                # Gang restart: dispatch the replacement placement solve as
+                # soon as this reconcile returns (deferred to the pump, off
+                # the reconcile latency path) — the device then solves while
+                # the next passes delete the old jobs, so the creation pass
+                # consumes a finished plan instead of blocking on a solve.
+                cluster.defer(lambda: self.placement.prepare(cluster, js))
             return self._finish(js, ctx, t0)
 
         if owned.successful:
@@ -194,9 +206,17 @@ class JobSetReconciler:
             # Placement hook: a provider may precompute a job -> topology
             # domain plan for the whole batch (the TPU solver path) and stamp
             # node selectors before the jobs ever exist, replacing the
-            # per-pod webhook cascade.
+            # per-pod webhook cascade. A provider whose prefetched solve is
+            # still running returns a pending sentinel — defer this batch to
+            # the next pass rather than blocking the reconcile on the device.
             if jobs and self.placement is not None:
-                self.placement.assign(self.cluster, js, jobs)
+                if self.placement.assign(self.cluster, js, jobs) is not None:
+                    # Stop the whole pass (not just this batch): creating a
+                    # later ReplicatedJob before an earlier deferred one
+                    # would break the InOrder startup invariant, and the
+                    # prefetched plan covers every batch anyway.
+                    ctx.changed = True  # requeue: plan lands next pass
+                    return
 
             for job in jobs:
                 self.cluster.create_job(job, js)
@@ -219,7 +239,7 @@ class JobSetReconciler:
                 labels=dict(rjob.template.labels),
                 annotations=dict(rjob.template.annotations),
             ),
-            spec=copy.deepcopy(rjob.template.spec),
+            spec=rjob.template.spec.clone(),
         )
         self._label_and_annotate(job.metadata.labels, job.metadata.annotations, js, rjob, job_idx)
         self._label_and_annotate(

@@ -27,9 +27,12 @@ class Scheduler:
     # ------------------------------------------------------------------
 
     def schedule_pending(self) -> bool:
+        # Index-driven: only pods awaiting a binding decision are visited
+        # (cluster.pending_pod_keys), not the whole pod store per tick.
         changed = False
-        for pod in list(self.cluster.pods.values()):
-            if pod.status.phase != POD_PENDING or pod.spec.node_name:
+        for key in list(self.cluster.pending_pod_keys):
+            pod = self.cluster.pods.get(key)
+            if pod is None or pod.status.phase != POD_PENDING or pod.spec.node_name:
                 continue
             if pod.spec.scheduling_gates:
                 continue

@@ -8,13 +8,32 @@ gate) solves the whole job -> topology-domain assignment as one batched
 linear-assignment problem on TPU and stamps the resulting nodeSelector plan
 onto each job's pod template, so pods skip the webhook path entirely and the
 scheduler does O(1) work per pod — this is the BASELINE.json north star.
+
+Solves are kept OFF the reconcile critical path (SURVEY.md §7 "solver-in-the-
+loop latency"): `prepare()` is called at JobSet admission and at gang-restart
+time, builds the cost matrix, and *dispatches* the auction asynchronously —
+JAX returns before the device finishes, so the solve overlaps the apiserver
+write / child-job deletion work that separates it from the creation pass.
+`assign()` then fetches the finished plan, re-validates it against current
+occupancy/capacity (O(jobs)), and only falls back to a synchronous solve when
+the cached plan is missing or stale.
 """
 
 from __future__ import annotations
 
 from ..api import keys
 from ..core import features
+from .naming import gen_job_name, job_hash_key
 from .webhooks import PLAN_ANNOTATION
+
+# Sentinel returned by `assign` when the prefetched solve is still running on
+# the device: the reconciler skips creating that job batch this pass and
+# requeues — the reconcile loop NEVER blocks on an in-flight solve.
+PLAN_PENDING = object()
+
+# How long assign() tolerates an unfinished prefetch before blocking on it
+# anyway (a wedged device must not wedge job creation forever).
+_PENDING_GRACE_S = 2.0
 
 
 class GreedyPlacement:
@@ -31,9 +50,20 @@ class SolverPlacement:
     use exclusive placement.
     """
 
+    # Plan-cache bound: one entry per live JobSet awaiting creation; evicted
+    # FIFO past this to keep a long-running controller's memory flat even if
+    # forget() is never called for some uid.
+    _MAX_PLANS = 256
+
     def __init__(self, solver=None):
         # Lazy import so the control plane doesn't pull in jax unless used.
         self._solver = solver
+        # jobset uid -> (restarts, specs, domain_values, plan-or-PendingSolve)
+        self._plans: dict[str, tuple] = {}
+
+    def forget(self, jobset_uid: str) -> None:
+        """Drop any cached/in-flight plan for a JobSet (deletion hook)."""
+        self._plans.pop(jobset_uid, None)
 
     def _get_solver(self):
         if self._solver is None:
@@ -42,20 +72,141 @@ class SolverPlacement:
             self._solver = AssignmentSolver()
         return self._solver
 
+    @staticmethod
+    def _topology_key(js):
+        topology_key = js.metadata.annotations.get(keys.EXCLUSIVE_KEY)
+        if topology_key is None:
+            return None
+        if keys.NODE_SELECTOR_STRATEGY_KEY in js.metadata.annotations:
+            return None
+        return topology_key
+
+    # ------------------------------------------------------------------
+    # Async prefetch (admission / restart time)
+    # ------------------------------------------------------------------
+
+    def prepare(self, cluster, js, block: bool = True) -> None:
+        """Solve the whole-JobSet assignment ahead of the creation pass.
+
+        Called off the reconcile latency path — at JobSet admission and (via
+        the pump's deferred queue) right after a gang restart bumps
+        `status.restarts`. With block=False the solve is only dispatched
+        (PendingSolve cached; assign() defers batches until it lands), which
+        suits a real accelerator-backed deployment where the device computes
+        in parallel with the controller's delete passes.
+        """
+        if not features.enabled("TPUPlacementSolver"):
+            return
+        topology_key = self._topology_key(js)
+        if topology_key is None:
+            return
+        solver = self._get_solver()
+        if not hasattr(solver, "solve_async"):
+            return  # e.g. a remote gRPC solver: sync-only, no prefetch
+
+        from .plans import build_cost_matrix_for_specs
+
+        specs = self._expected_job_specs(cluster, js)
+        if not specs:
+            return
+        built = build_cost_matrix_for_specs(
+            cluster,
+            specs,
+            topology_key,
+            pending_release=self._pending_release(cluster, js, topology_key, specs),
+        )
+        if built is None:
+            return
+        cost, feasible, domain_values = built
+        if not feasible.any():
+            return
+        pending = solver.solve_async(cost, feasible)
+        if block:
+            # Complete the solve here, outside any reconcile: on hosts where
+            # the "device" shares cores with the controller (the CPU
+            # fallback), letting the solve run concurrently just steals
+            # cycles from the very reconciles the prefetch is protecting.
+            pending = self._materialize(specs, domain_values, pending.result())
+        while len(self._plans) >= self._MAX_PLANS:
+            self._plans.pop(next(iter(self._plans)))
+        self._plans[js.metadata.uid] = (
+            js.status.restarts,
+            specs,
+            domain_values,
+            pending,
+        )
+
+    @staticmethod
+    def _materialize(specs, domain_values, assignment) -> dict[str, str]:
+        plan = {}
+        for (name, _, _), d in zip(specs, assignment):
+            if d >= 0:
+                plan[name] = domain_values[int(d)]
+        return plan
+
+    @staticmethod
+    def _expected_job_specs(cluster, js) -> list[tuple[str, str, int]]:
+        """(job_name, job_key, pods_needed) for every child the spec implies."""
+        specs = []
+        for rjob in js.spec.replicated_jobs:
+            pods = rjob.template.spec.pods_expected()
+            for idx in range(int(rjob.replicas)):
+                name = gen_job_name(js.metadata.name, rjob.name, idx)
+                specs.append(
+                    (name, job_hash_key(js.metadata.namespace, name), pods)
+                )
+        return specs
+
+    @staticmethod
+    def _pending_release(cluster, js, topology_key, specs) -> dict[str, int]:
+        """Per-domain capacity about to be freed by this JobSet's restart.
+
+        At restart-prepare time the previous attempt's pods are still bound;
+        they are deleted before the replacements are created, so their
+        capacity is free by the time the plan is consumed. Domains owned
+        exclusively by this JobSet's job keys free their entire current
+        allocation — O(occupied domains), not O(pods). The count can
+        overestimate when unrelated plain pods share the domain's nodes;
+        assign()'s fetch-time validation catches the resulting infeasibility
+        and falls back to a fresh solve. Admission-time prepare sees no
+        owned domains and returns {}.
+        """
+        stats = cluster.domain_capacity(topology_key)
+        occupancy = cluster.domain_job_keys.get(topology_key, {})
+        if stats is None or not occupancy:
+            return {}
+        values, free, capacity = stats
+        index = {v: i for i, v in enumerate(values)}
+        own_keys = {jk for _, jk, _ in specs}
+        freed: dict[str, int] = {}
+        for value, owners in occupancy.items():
+            if not owners or not owners <= own_keys:
+                continue
+            i = index.get(value)
+            if i is not None:
+                freed[value] = int(capacity[i] - free[i])
+        return freed
+
+    # ------------------------------------------------------------------
+    # Plan consumption (creation pass)
+    # ------------------------------------------------------------------
+
     def assign(self, cluster, js, jobs) -> None:
         if not features.enabled("TPUPlacementSolver"):
             return
-        topology_key = js.metadata.annotations.get(keys.EXCLUSIVE_KEY)
+        topology_key = self._topology_key(js)
         if topology_key is None or not jobs:
             return
-        if keys.NODE_SELECTOR_STRATEGY_KEY in js.metadata.annotations:
-            return
 
-        from .plans import build_plan
-
-        plan = build_plan(cluster, js, jobs, topology_key, self._get_solver())
+        plan = self._fetch_valid_plan(cluster, js, jobs, topology_key)
+        if plan is PLAN_PENDING:
+            return PLAN_PENDING
         if plan is None:
-            return
+            from .plans import build_plan
+
+            plan = build_plan(cluster, js, jobs, topology_key, self._get_solver())
+            if plan is None:
+                return
         for job in jobs:
             domain = plan.get(job.metadata.name)
             if domain is None:
@@ -69,3 +220,48 @@ class SolverPlacement:
             cluster.claim_domain(
                 topology_key, domain, job.labels.get(keys.JOB_KEY, "")
             )
+
+    def _fetch_valid_plan(self, cluster, js, jobs, topology_key):
+        """Return {job_name: domain} from the prefetched solve if it is still
+        consistent with current cluster state; None forces a fresh solve."""
+        entry = self._plans.get(js.metadata.uid)
+        if entry is None:
+            return None
+        restarts, specs, domain_values, pending = entry
+        if restarts != js.status.restarts:
+            self._plans.pop(js.metadata.uid, None)
+            return None
+
+        if not isinstance(pending, dict):
+            if not pending.is_ready() and pending.age_seconds < _PENDING_GRACE_S:
+                return PLAN_PENDING
+            plan = self._materialize(specs, domain_values, pending.result())
+            self._plans[js.metadata.uid] = (restarts, specs, domain_values, plan)
+        else:
+            plan = pending
+
+        # Re-validate against live state (occupancy may have drifted between
+        # prepare and consumption — another JobSet, a node change, a manual
+        # claim). O(jobs) against the incrementally-maintained domain stats.
+        stats = cluster.domain_capacity(topology_key)
+        if stats is None:
+            return None
+        values, free, _ = stats
+        index = {v: i for i, v in enumerate(values)}
+        occupancy = cluster.domain_job_keys.get(topology_key, {})
+        by_name = {name: (jk, pods) for name, jk, pods in specs}
+        for job in jobs:
+            domain = plan.get(job.metadata.name)
+            if domain is None:
+                continue
+            spec = by_name.get(job.metadata.name)
+            d = index.get(domain)
+            if spec is None or d is None:
+                return None
+            job_key, pods_needed = spec
+            owners = occupancy.get(domain)
+            if owners and owners - {job_key}:
+                return None  # domain got claimed by someone else
+            if free[d] < pods_needed:
+                return None  # capacity drifted under the plan
+        return plan

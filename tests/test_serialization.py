@@ -238,3 +238,74 @@ def test_status_serialization():
     assert d["status"]["terminalState"] == "Completed"
     assert d["status"]["conditions"][0]["reason"] == "AllJobsCompleted"
     assert d["status"]["replicatedJobsStatus"][0]["succeeded"] == 1
+
+
+# ---------------------------------------------------------------------------
+# clone() parity: the hand-written clones replaced deepcopy on the job/pod
+# construction hot path; this guards against a future field being silently
+# dropped (a new dataclass field defaults instead of copying).
+# ---------------------------------------------------------------------------
+
+
+def _fully_populated_pod_spec():
+    from jobset_tpu.api.types import Affinity, AffinityTerm, PodSpec, Toleration
+
+    return PodSpec(
+        restart_policy="OnFailure",
+        node_selector={"pool": "a", "rack": "r1"},
+        tolerations=[Toleration(key="k", operator="Equal", value="v", effect="NoSchedule")],
+        affinity=Affinity(
+            pod_affinity=[AffinityTerm(topology_key="rack", job_key_in=["jk1"])],
+            pod_anti_affinity=[
+                AffinityTerm(topology_key="rack", job_key_exists=True, job_key_not_in=["jk1"])
+            ],
+        ),
+        subdomain="svc",
+        hostname="h-0",
+        scheduling_gates=["gate"],
+        node_name="n1",
+        workload={"kind": "lm", "nested": {"steps": 3}},
+    )
+
+
+def test_pod_spec_clone_matches_deepcopy():
+    import copy
+    import dataclasses
+
+    spec = _fully_populated_pod_spec()
+    assert spec.clone() == copy.deepcopy(spec)
+    # Every declared field must be populated above, so a newly added field
+    # fails this assertion until the fixture (and clone()) cover it.
+    for f in dataclasses.fields(spec):
+        assert getattr(spec, f.name) != f.default or f.default is None, (
+            f"field {f.name} left at its default; extend the fixture and clone()"
+        )
+
+
+def test_job_spec_clone_matches_deepcopy_and_is_deep():
+    import copy
+
+    from jobset_tpu.api.types import JobSpec, PodTemplateSpec
+
+    spec = JobSpec(
+        parallelism=4,
+        completions=4,
+        completion_mode="Indexed",
+        backoff_limit=2,
+        suspend=True,
+        active_deadline_seconds=30,
+        template=PodTemplateSpec(
+            labels={"a": "1"}, annotations={"b": "2"}, spec=_fully_populated_pod_spec()
+        ),
+    )
+    clone = spec.clone()
+    assert clone == copy.deepcopy(spec)
+    # Deep: mutating the clone must not leak into the original.
+    clone.template.spec.node_selector["pool"] = "changed"
+    clone.template.spec.tolerations[0].key = "changed"
+    clone.template.spec.affinity.pod_affinity[0].job_key_in.append("x")
+    clone.template.spec.workload["nested"]["steps"] = 99
+    assert spec.template.spec.node_selector["pool"] == "a"
+    assert spec.template.spec.tolerations[0].key == "k"
+    assert spec.template.spec.affinity.pod_affinity[0].job_key_in == ["jk1"]
+    assert spec.template.spec.workload["nested"]["steps"] == 3
