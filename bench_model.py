@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""Single-chip model benchmark CLI: tokens/s + MFU of the flagship
+transformer (jobset_tpu.runtime.model_bench). Prints ONE JSON line:
+
+    {"metric": "transformer_train_mfu", "value": <mfu %>, "unit": "%", ...}
+
+Run on the real chip by default; pass JAX_PLATFORMS=cpu (honored via the
+same backend-forcing dance as bench.py) for a CPU smoke run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--steps", type=int, default=20)
+    parser.add_argument("--warmup", type=int, default=3)
+    parser.add_argument("--batch", type=int, default=8)
+    parser.add_argument("--seq-len", type=int, default=1024)
+    parser.add_argument("--d-model", type=int, default=1024)
+    parser.add_argument("--n-layers", type=int, default=8)
+    parser.add_argument("--n-heads", type=int, default=16)
+    parser.add_argument("--d-ff", type=int, default=4096)
+    args = parser.parse_args()
+
+    from bench import _cpu_forced, _force_cpu
+
+    if _cpu_forced():
+        _force_cpu()
+
+    from jobset_tpu.models.transformer import TransformerConfig
+    from jobset_tpu.runtime.model_bench import run_model_bench
+
+    cfg = TransformerConfig(
+        d_model=args.d_model,
+        n_layers=args.n_layers,
+        n_heads=args.n_heads,
+        d_ff=args.d_ff,
+        max_seq_len=args.seq_len,
+    )
+    result = run_model_bench(
+        steps=args.steps,
+        warmup=args.warmup,
+        batch=args.batch,
+        seq_len=args.seq_len,
+        config=cfg,
+    )
+    value = result["mfu_pct"] if result["mfu_pct"] is not None else result[
+        "achieved_tflops"
+    ]
+    unit = "%" if result["mfu_pct"] is not None else "TFLOP/s"
+    print(
+        json.dumps(
+            {
+                "metric": "transformer_train_mfu",
+                "value": value,
+                "unit": unit,
+                "detail": result,
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -115,6 +115,7 @@ class Cluster:
 
         self._uid_iter = itertools.count(1)
         self._deferred: deque[Callable[[], None]] = deque()
+        self._next_tick_queue: deque[tuple[str, str]] = deque()
         self.reconcile_queue: deque[tuple[str, str]] = deque()
         self._queued: set[tuple[str, str]] = set()
         # (ns, name) -> virtual time at which to requeue (TTL handling).
@@ -367,16 +368,28 @@ class Cluster:
         if owner_set is not None:
             owner_set.discard(key)
         self.jobs_by_uid.pop(job.metadata.uid, None)
+        # Whole-job deletion: release the job's domain occupancy ONCE after
+        # the pod loop instead of per pod — the per-pod path's "is any
+        # sibling still bound here" scan is O(pods^2) per job, pure waste
+        # when every sibling is going away in the same call.
         for pod_key in list(self.pods_by_job_uid.get(job.metadata.uid, ())):
-            self.delete_pod(*pod_key)
+            self.delete_pod(*pod_key, _release_domain=False)
         self.pods_by_job_uid.pop(job.metadata.uid, None)
-        # Release a plan-time domain claim (all pods are gone at this point,
-        # so per-pod release can no longer cover the never-bound case).
-        planned_domain = job.metadata.annotations.get(keys.PLACEMENT_PLAN_KEY)
         topology_key = job.metadata.annotations.get(keys.EXCLUSIVE_KEY)
         job_key = job.labels.get(keys.JOB_KEY)
-        if planned_domain and topology_key and job_key:
-            self.release_domain_claim(topology_key, planned_domain, job_key)
+        if topology_key and job_key:
+            domains = self.domain_job_keys.get(topology_key, {})
+            # Bound-pod occupancy (bind_pod records the domain in
+            # placement_history on every bind, so under exclusive placement
+            # this is the job's one domain) ...
+            prev = self.placement_history.get(job_key)
+            if prev in domains:
+                domains[prev].discard(job_key)
+            # ... and the plan-time claim, which may exist with no pod ever
+            # bound.
+            planned_domain = job.metadata.annotations.get(keys.PLACEMENT_PLAN_KEY)
+            if planned_domain:
+                self.release_domain_claim(topology_key, planned_domain, job_key)
         self._enqueue_owner_of(job)
 
     def get_job(self, namespace: str, name: str) -> Optional[Job]:
@@ -426,12 +439,16 @@ class Cluster:
         self.dirty_job_uids.add(owner.metadata.uid)
         return pod
 
-    def delete_pod(self, namespace: str, name: str) -> None:
+    def delete_pod(
+        self, namespace: str, name: str, _release_domain: bool = True
+    ) -> None:
+        """_release_domain=False: caller (delete_job) owns the job-level
+        domain-occupancy release; only the node binding is returned here."""
         key = (namespace, name)
         pod = self.pods.pop(key, None)
         if pod is None:
             return
-        self._release_pod_placement(pod)
+        self._release_pod_placement(pod, release_domain=_release_domain)
         job_key = pod.labels.get(keys.JOB_KEY)
         if job_key and job_key in self.pods_by_job_key:
             self.pods_by_job_key[job_key].discard(key)
@@ -516,7 +533,7 @@ class Cluster:
                 ).add(job_key)
                 self.placement_history[job_key] = value
 
-    def _release_pod_placement(self, pod: Pod) -> None:
+    def _release_pod_placement(self, pod: Pod, release_domain: bool = True) -> None:
         if not pod.spec.node_name:
             return
         node = self.nodes.get(pod.spec.node_name)
@@ -526,6 +543,8 @@ class Cluster:
         if node is not None and node.allocated > 0:
             node.allocated -= 1
             self._domain_stats_adjust(node, -1)
+        if not release_domain:
+            return
         topology_key = pod.annotations.get(keys.EXCLUSIVE_KEY)
         job_key = pod.labels.get(keys.JOB_KEY)
         if node is not None and topology_key and job_key:
@@ -614,6 +633,11 @@ class Cluster:
             del self.requeue_after[k]
             self.enqueue_reconcile(*k)
 
+    def enqueue_reconcile_next_tick(self, namespace: str, name: str) -> None:
+        """Requeue for the NEXT tick (not the current tick's queue drain):
+        used while a reconcile is parked on an in-flight placement solve."""
+        self._next_tick_queue.append((namespace, name))
+
     def defer(self, fn: Callable[[], None]) -> None:
         """Queue work to run between reconciles (e.g. dispatching a placement
         prefetch): keeps it off the reconcile latency path while still
@@ -627,6 +651,8 @@ class Cluster:
     def tick(self) -> bool:
         """One control-plane pass; returns True if anything changed."""
         changed = False
+        while self._next_tick_queue:
+            self.enqueue_reconcile(*self._next_tick_queue.popleft())
         self._drain_requeues()
         self._drain_deferred()
 
@@ -688,6 +714,12 @@ class Cluster:
                 self._release_pod_placement(pod)
                 pod.status.phase = phase
                 pod.status.ready = False
+                # No longer schedulable: keep the scheduler's pending index
+                # tight (never-bound pods would otherwise sit in it until
+                # job deletion).
+                self.pending_pod_keys.pop(
+                    (pod.metadata.namespace, pod.metadata.name), None
+                )
 
     def complete_job(self, namespace: str, name: str) -> None:
         job = self.jobs[(namespace, name)]

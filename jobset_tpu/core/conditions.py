@@ -24,6 +24,10 @@ class ReconcileCtx:
 
     changed: bool = False
     events: list[tuple[str, str, str]] = field(default_factory=list)  # (type, reason, msg)
+    # Requeue on the NEXT tick instead of the same-tick queue drain — set
+    # when the reconcile is waiting on an in-flight placement solve, so the
+    # pump doesn't spin reconciles while the device works.
+    requeue_next_tick: bool = False
 
     def enqueue_event(self, etype: str, reason: str, message: str) -> None:
         self.events.append((etype, reason, message))

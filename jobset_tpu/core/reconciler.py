@@ -127,7 +127,11 @@ class JobSetReconciler:
         for etype, reason, message in ctx.events:
             self.cluster.record_event("JobSet", js.name, etype, reason, message)
         metrics.reconcile_time_seconds.observe(_time.perf_counter() - t0)
-        if ctx.changed:
+        if ctx.requeue_next_tick:
+            # Waiting on an in-flight solve: revisit next tick, not in this
+            # tick's queue drain (which would spin reconciles).
+            self.cluster.enqueue_reconcile_next_tick(js.namespace, js.name)
+        elif ctx.changed:
             # A status write retriggers the watch -> requeue until fixpoint.
             self.cluster.enqueue_reconcile(js.namespace, js.name)
         return ctx.changed
@@ -190,6 +194,18 @@ class JobSetReconciler:
         in_order = in_order_startup_policy(js)
         existing = owned.names()
 
+        # Cheap pre-check before constructing any Job objects: if the
+        # provider's prefetched solve is still in flight, revisit next tick —
+        # constructing hundreds of jobs per deferred pass just to throw them
+        # away would burn the very latency the prefetch is hiding.
+        if self.placement is not None and getattr(
+            self.placement, "plan_pending", None
+        ):
+            if self.placement.plan_pending(js):
+                ctx.changed = True
+                ctx.requeue_next_tick = True
+                return
+
         for rjob in js.spec.replicated_jobs:
             status = next((s for s in statuses if s.name == rjob.name), None)
             if not suspended and in_order and all_replicas_started(
@@ -210,12 +226,15 @@ class JobSetReconciler:
             # still running returns a pending sentinel — defer this batch to
             # the next pass rather than blocking the reconcile on the device.
             if jobs and self.placement is not None:
-                if self.placement.assign(self.cluster, js, jobs) is not None:
+                from ..placement.provider import PLAN_PENDING
+
+                if self.placement.assign(self.cluster, js, jobs) is PLAN_PENDING:
                     # Stop the whole pass (not just this batch): creating a
                     # later ReplicatedJob before an earlier deferred one
                     # would break the InOrder startup invariant, and the
                     # prefetched plan covers every batch anyway.
-                    ctx.changed = True  # requeue: plan lands next pass
+                    ctx.changed = True  # plan lands next pass
+                    ctx.requeue_next_tick = True
                     return
 
             for job in jobs:

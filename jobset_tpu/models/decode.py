@@ -26,12 +26,61 @@ from .transformer import (
     TransformerConfig,
     _dense_mlp,
     _embed_tokens,
+    _moe_mlp,
     param_specs,
     rms_norm,
     rotary,
 )
 
 NEG_INF = -1.0e30
+
+
+def _moe_mlp_topk_decode(p, xn, cfg: TransformerConfig):
+    """Token-choice top-k MoE for the decode step (serving shape: ep == 1).
+
+    Dense-all-experts formulation: with one token per step and a small
+    batch, running every expert on every token and weighting by the top-k
+    gates is a single MXU-friendly einsum chain — no capacity buffers, no
+    all_to_all (there is no ep axis to ship over), and no token drops. This
+    is the no-contention limit of the training path
+    (`transformer._moe_mlp_routed`, reference: none — the reference has no
+    inference surface): identical per-token math whenever training capacity
+    admits every choice, which a serving batch trivially satisfies.
+    Expert FFN weights stay column/row split over tp with one psum, exactly
+    like the dense path.
+    """
+    compute = cfg.dtype
+    k = cfg.moe_top_k
+    gates = jax.nn.softmax(
+        jnp.einsum(
+            "btd,de->bte", xn.astype(jnp.float32), p["wg"].astype(jnp.float32)
+        ),
+        axis=-1,
+    )  # [B, T, E] f32 for routing stability (same as training)
+    top_w, top_i = lax.top_k(gates, k)  # [B, T, k]
+    top_w = top_w / jnp.maximum(jnp.sum(top_w, -1, keepdims=True), 1e-9)
+    weights = jnp.sum(
+        jax.nn.one_hot(top_i, cfg.n_experts, dtype=jnp.float32)
+        * top_w[..., None],
+        axis=-2,
+    )  # [B, T, E], nonzero only at the k chosen experts
+
+    h = jax.nn.silu(
+        jnp.einsum("btd,edf->ebtf", xn.astype(compute), p["we1"].astype(compute))
+    )
+    y = jnp.einsum("ebtf,efd->ebtd", h, p["we2"].astype(compute))
+    out = jnp.einsum("ebtd,bte->btd", y, weights.astype(compute))
+    return lax.psum(out, "tp")
+
+
+def _decode_mlp(p, xn, cfg: TransformerConfig):
+    """Feed-forward dispatch for one decode step: dense, soft-dispatch MoE,
+    or top-k routed MoE (dense-all-experts serving formulation)."""
+    if "wg" in p and cfg.moe_top_k > 0:
+        return _moe_mlp_topk_decode(p, xn, cfg)
+    if "wg" in p:
+        return _moe_mlp(p, xn, cfg)
+    return _dense_mlp(p, xn, cfg)
 
 
 def init_kv_cache(
@@ -91,7 +140,7 @@ def _decode_layer(p, x, cache_k, cache_v, pos, cfg: TransformerConfig):
     x = x + lax.psum(out, "tp").astype(x.dtype)
 
     xn2 = rms_norm(x, p["ln2"], cfg.norm_eps)
-    x = x + _dense_mlp(p, xn2, cfg).astype(x.dtype)
+    x = x + _decode_mlp(p, xn2, cfg).astype(x.dtype)
     return x, cache_k, cache_v
 
 
@@ -149,8 +198,6 @@ def build_generate(config: TransformerConfig, mesh: Mesh, max_new_tokens: int):
                 f"build_generate needs {axis}=1 (got {axis_size(mesh, axis)}); "
                 "use a dp/tp serving mesh"
             )
-    if cfg.n_experts:
-        raise NotImplementedError("MoE decode is not implemented yet")
     specs = param_specs(cfg)
     cache_spec = P(None, "dp", None, "tp", None)
 

@@ -31,6 +31,39 @@ from ..api import keys
 from ..api.types import JobSet
 
 
+def _domain_state(cluster, topology_key: str, pending_release):
+    """Shared prep for both cost builders: per-domain (values, index,
+    adjusted free, capacity) plus the sparse key -> [domain indexes]
+    ownership map. One definition so the dense and structured paths cannot
+    drift apart on the capacity/ownership rules."""
+    stats = cluster.domain_capacity(topology_key)
+    if stats is None:
+        return None
+    domain_values, free, capacity = stats
+    occupancy = cluster.domain_job_keys.get(topology_key, {})
+    domain_index = {value: d for d, value in enumerate(domain_values)}
+
+    if pending_release:
+        free = free.copy()
+        for value, freed in pending_release.items():
+            d = domain_index.get(value)
+            if d is not None:
+                free[d] += freed
+
+    key_domains: dict[str, list[int]] = {}
+    occupied_cols: list[int] = []
+    for value, owners in occupancy.items():
+        if not owners:
+            continue
+        d = domain_index.get(value)
+        if d is None:
+            continue
+        occupied_cols.append(d)
+        for jk in owners:
+            key_domains.setdefault(jk, []).append(d)
+    return domain_values, domain_index, free, capacity, key_domains, occupied_cols
+
+
 def build_cost_matrix(
     cluster, js: JobSet, jobs: list, topology_key: str
 ) -> Optional[tuple[np.ndarray, np.ndarray, list[str]]]:
@@ -58,22 +91,15 @@ def build_cost_matrix_for_specs(
     (a restarting JobSet's still-bound pods); added back to free capacity so
     a restart-time solve sees the state the creation pass will see.
     """
-    stats = cluster.domain_capacity(topology_key)
-    if stats is None:
+    state = _domain_state(cluster, topology_key, pending_release)
+    if state is None:
         return None
     # Incrementally-maintained per-domain arrays (cluster.domain_capacity):
     # no per-solve node scan — VERDICT r1 flagged the O(nodes) Python build
     # as a reconcile-latency cost.
-    domain_values, free, capacity = stats
-    occupancy = cluster.domain_job_keys.get(topology_key, {})
+    domain_values, domain_index, free, capacity, key_domains, occupied_cols = state
 
     num_jobs, num_domains = len(specs), len(domain_values)
-    if pending_release:
-        free = free.copy()
-        for d, value in enumerate(domain_values):
-            freed = pending_release.get(value)
-            if freed:
-                free[d] += freed
     load = 1.0 - free / np.maximum(capacity, 1.0)  # [D] in [0, 1]
 
     job_keys = [jk for _, jk, _ in specs]
@@ -83,18 +109,6 @@ def build_cost_matrix_for_specs(
     # (occupied domains only), so build it as "block occupied columns, then
     # re-open each owner's own domains" — O(occupied + jobs), not O(J*D).
     feasible = free[None, :] >= pods_needed[:, None]  # [J, D]
-    domain_index = {value: d for d, value in enumerate(domain_values)}
-    key_domains: dict[str, list[int]] = {}
-    occupied_cols = []
-    for value, owners in occupancy.items():
-        if not owners:
-            continue
-        d = domain_index.get(value)
-        if d is None:
-            continue
-        occupied_cols.append(d)
-        for jk in owners:
-            key_domains.setdefault(jk, []).append(d)
     if occupied_cols:
         feasible[:, occupied_cols] = False
         for j, jk in enumerate(job_keys):
@@ -124,6 +138,57 @@ def build_cost_matrix_for_specs(
         if prev is not None and prev in domain_index:
             cost[j, domain_index[prev]] = 0.0
     return cost, feasible, domain_values
+
+
+def build_cost_params_for_specs(
+    cluster,
+    specs: list[tuple[str, str, int]],
+    topology_key: str,
+    pending_release: Optional[dict[str, int]] = None,
+):
+    """Compact O(J + D) parametrization of the cost model for on-device
+    materialization (`solver._auction_structured`): the host ships per-domain
+    load/free/occupancy vectors and per-job pods/sticky/ownership indices
+    instead of the dense [J, D] matrices — kilobytes, not megabytes, across
+    the (possibly tunneled) host->TPU boundary.
+
+    Returns (params dict, domain_values), or None when the state is not
+    representable (a job key owning multiple domains — the caller falls back
+    to the dense build, whose feasibility is fully general).
+    """
+    state = _domain_state(cluster, topology_key, pending_release)
+    if state is None:
+        return None
+    domain_values, domain_index, free, capacity, key_domains, occupied_cols = state
+
+    occupied = np.zeros(len(domain_values), bool)
+    occupied[occupied_cols] = True
+    key_domain: dict[str, int] = {}
+    for jk, domains in key_domains.items():
+        if len(domains) > 1:
+            return None  # key owns several domains: dense fallback
+        key_domain[jk] = domains[0]
+
+    pods_needed = np.array([pods for _, _, pods in specs], np.float32)
+    own_domain = np.array(
+        [key_domain.get(jk, -1) for _, jk, _ in specs], np.int32
+    )
+    sticky = np.array(
+        [
+            domain_index.get(cluster.placement_history.get(jk, ""), -1)
+            for _, jk, _ in specs
+        ],
+        np.int32,
+    )
+    params = {
+        "load": 1.0 - free / np.maximum(capacity, 1.0),
+        "free": free,
+        "pods_needed": pods_needed,
+        "sticky": sticky,
+        "occupied": occupied,
+        "own_domain": own_domain,
+    }
+    return params, domain_values
 
 
 def build_plan(

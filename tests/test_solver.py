@@ -321,3 +321,99 @@ def test_planned_job_survives_suspend_resume_with_competing_jobset():
         assert {
             cluster.nodes[p.spec.node_name].labels[TOPOLOGY] for p in a_pods
         } == a_domain
+
+
+# ---------------------------------------------------------------------------
+# Structured (on-device-materialized) solve: differential vs the dense path
+# ---------------------------------------------------------------------------
+
+
+def _random_cluster_state(seed, num_jobs, num_domains, nodes_per_domain=2, capacity=8):
+    """Build a cluster with random occupancy/stickiness and matching specs."""
+    rng = np.random.default_rng(seed)
+    cluster = make_cluster()
+    cluster.add_topology(
+        TOPOLOGY, num_domains=num_domains, nodes_per_domain=nodes_per_domain,
+        capacity=capacity,
+    )
+    specs = [
+        (f"js-w-{j}", f"key-{j}", int(rng.integers(1, nodes_per_domain * capacity)))
+        for j in range(num_jobs)
+    ]
+    values = sorted(cluster.domain_nodes(TOPOLOGY))
+    # Random exclusive claims (each key at most one domain, each domain at
+    # most one key) + matching history so stickiness kicks in.
+    claimed = rng.choice(num_domains, size=num_jobs // 2, replace=False)
+    for j, d in enumerate(claimed):
+        cluster.claim_domain(TOPOLOGY, values[d], f"key-{j}")
+    # Random load: bind some allocation onto nodes in a few domains.
+    for d in rng.choice(num_domains, size=num_domains // 3, replace=False):
+        for name in cluster.domain_nodes(TOPOLOGY)[values[d]][:1]:
+            cluster.nodes[name].allocated = int(rng.integers(0, capacity))
+    cluster._domain_stats.clear()  # pick up manual allocation edits
+    return cluster, specs
+
+
+@pytest.mark.parametrize("seed,num_jobs,num_domains", [
+    (0, 6, 8), (1, 12, 16), (2, 20, 24), (3, 32, 40),
+])
+def test_structured_solve_matches_dense(solver, seed, num_jobs, num_domains):
+    from jobset_tpu.placement.plans import (
+        build_cost_matrix_for_specs,
+        build_cost_params_for_specs,
+    )
+
+    cluster, specs = _random_cluster_state(seed, num_jobs, num_domains)
+
+    dense = build_cost_matrix_for_specs(cluster, specs, TOPOLOGY)
+    assert dense is not None
+    cost, feasible, domain_values = dense
+    dense_assignment = solver.solve(cost, feasible)
+
+    structured = build_cost_params_for_specs(cluster, specs, TOPOLOGY)
+    assert structured is not None
+    params, s_values = structured
+    assert s_values == domain_values
+    s_assignment = solver.solve_structured_async(**params).result()
+
+    np.testing.assert_array_equal(s_assignment, dense_assignment)
+
+
+def test_structured_params_fall_back_when_key_owns_two_domains():
+    from jobset_tpu.placement.plans import build_cost_params_for_specs
+
+    cluster = make_cluster()
+    cluster.add_topology(TOPOLOGY, num_domains=4, nodes_per_domain=1, capacity=4)
+    values = sorted(cluster.domain_nodes(TOPOLOGY))
+    cluster.claim_domain(TOPOLOGY, values[0], "key-0")
+    cluster.claim_domain(TOPOLOGY, values[1], "key-0")
+    specs = [("js-w-0", "key-0", 1)]
+    assert build_cost_params_for_specs(cluster, specs, TOPOLOGY) is None
+
+
+def test_structured_solve_respects_pending_release():
+    from jobset_tpu.placement.plans import build_cost_params_for_specs
+
+    cluster = make_cluster()
+    cluster.add_topology(TOPOLOGY, num_domains=2, nodes_per_domain=1, capacity=4)
+    values = sorted(cluster.domain_nodes(TOPOLOGY))
+    # Fill domain 0 completely; without pending release a 4-pod job cannot
+    # land there, with release of its own 4 pods it can (and stickiness
+    # pulls it back).
+    node = cluster.nodes[cluster.domain_nodes(TOPOLOGY)[values[0]][0]]
+    node.allocated = 4
+    cluster.claim_domain(TOPOLOGY, values[0], "key-0")
+    cluster.claim_domain(TOPOLOGY, values[1], "key-other")  # close the alternative
+    specs = [("js-w-0", "key-0", 4)]
+
+    s = AssignmentSolver()
+    built = build_cost_params_for_specs(cluster, specs, TOPOLOGY)
+    assert built is not None
+    params, _ = built
+    assert s.solve_structured_async(**params).result()[0] == -1  # full
+
+    built = build_cost_params_for_specs(
+        cluster, specs, TOPOLOGY, pending_release={values[0]: 4}
+    )
+    params, _ = built
+    assert s.solve_structured_async(**params).result()[0] == 0  # sticky home
