@@ -46,17 +46,14 @@ def _cpu_forced() -> bool:
 
 
 def _force_cpu() -> None:
-    """Must run before jax initializes its backend in this process."""
+    """Must run before jax initializes its backend in this process.
+    (One shared implementation: jobset_tpu.utils.backend; the axon
+    sitecustomize force-selects the TPU backend via jax.config, overriding
+    the env var alone.)"""
     os.environ["JAX_PLATFORMS"] = "cpu"
-    import jax
+    from jobset_tpu.utils.backend import force_cpu_if_requested
 
-    # The axon sitecustomize force-selects the TPU backend via jax.config,
-    # overriding the env var; push it back to CPU before backend init.
-    jax.config.update("jax_platforms", "cpu")
-    if jax.default_backend() != "cpu":
-        raise RuntimeError(
-            f"CPU fallback failed: backend is {jax.default_backend()}"
-        )
+    force_cpu_if_requested()
 
 
 def _run_worker(deadline_s: float, force_cpu: bool) -> str | None:

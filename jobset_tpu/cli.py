@@ -54,6 +54,17 @@ def _build_parser() -> argparse.ArgumentParser:
     c.add_argument("--topology", default="",
                    help="bootstrap a synthetic topology: KEY:DOMAINSxNODESxCAP "
                         "(e.g. cloud.google.com/gke-nodepool:8x4x16)")
+    c.add_argument("--tls-cert", default="",
+                   help="PEM serving certificate; serve HTTPS (with --tls-key)")
+    c.add_argument("--tls-key", default="",
+                   help="PEM private key for --tls-cert")
+    c.add_argument("--tls-self-signed", default="", metavar="DIR",
+                   help="create/reuse a self-signed CA + serving cert under "
+                        "DIR and serve HTTPS (cert.go:43-65 analog); clients "
+                        "trust DIR/ca.crt")
+    c.add_argument("--tls-hosts", default="",
+                   help="extra comma-separated SANs for the self-signed "
+                        "cert (service names / external IPs clients use)")
 
     s = sub.add_parser("solver", help="run the placement solver sidecar (gRPC)")
     s.add_argument("--addr", default="127.0.0.1:8500")
@@ -117,9 +128,37 @@ def _cmd_controller(args) -> int:
         cluster.add_topology(key, num_domains=domains, nodes_per_domain=nodes,
                              capacity=cap)
 
+    tls_cert, tls_key = args.tls_cert or None, args.tls_key or None
+    if args.tls_self_signed:
+        from .utils.certs import ensure_serving_certs
+
+        host = args.addr.rpartition(":")[0] or "127.0.0.1"
+        hosts = ["localhost", "127.0.0.1"]
+        if host == "0.0.0.0":
+            # Bound on all interfaces: clients reach us by machine identity,
+            # so name the host and its primary address in the SANs (plus
+            # anything from --tls-hosts, e.g. a compose service name).
+            import socket
+
+            hostname = socket.gethostname()
+            hosts.append(hostname)
+            try:
+                hosts.append(socket.gethostbyname(hostname))
+            except OSError:
+                pass
+        elif host not in hosts:
+            hosts.append(host)
+        for extra in filter(None, (h.strip() for h in args.tls_hosts.split(","))):
+            if extra not in hosts:
+                hosts.append(extra)
+        _, tls_cert, tls_key = ensure_serving_certs(
+            args.tls_self_signed, hosts=hosts
+        )
     server = ControllerServer(args.addr, cluster=cluster,
-                              tick_interval=args.tick_interval).start()
-    print(f"controller listening on http://{server.address} "
+                              tick_interval=args.tick_interval,
+                              tls_cert=tls_cert, tls_key=tls_key).start()
+    scheme = "https" if server.tls else "http"
+    print(f"controller listening on {scheme}://{server.address} "
           f"(solver={'sidecar ' + args.solver_addr if args.solver_addr else 'in-process'})",
           flush=True)
     _wait_for_signal()
@@ -128,11 +167,18 @@ def _cmd_controller(args) -> int:
 
 
 def _cmd_solver(args) -> int:
+    import numpy as np
+
     from .placement.service import SolverServer
     from .placement.solver import AssignmentSolver
 
-    server = SolverServer(args.addr,
-                          solver=AssignmentSolver(max_iters=args.max_iters)).start()
+    solver = AssignmentSolver(max_iters=args.max_iters)
+    # Pre-warm the jit cache on the smallest padded bucket before
+    # announcing readiness, so a controller's first solve doesn't eat a
+    # cold compile on its admission path (the reference's readyz-gated
+    # startup discipline, main.go:209-216).
+    solver.solve(np.zeros((1, 1), np.float32))
+    server = SolverServer(args.addr, solver=solver).start()
     print(f"solver sidecar listening on {server.address}", flush=True)
     _wait_for_signal()
     server.stop()
@@ -152,7 +198,8 @@ def _wait_for_signal():
 def _client(args):
     from .client import JobSetClient
 
-    return JobSetClient(args.server)
+    # Generous timeout: a create can ride through a cold solver compile.
+    return JobSetClient(args.server, timeout=120.0)
 
 
 def _cmd_apply(args) -> int:
@@ -281,6 +328,12 @@ _COMMANDS = {
 
 
 def main(argv=None) -> int:
+    # Honor JAX_PLATFORMS=cpu before anything can initialize an accelerator
+    # backend (solver warmup would otherwise block on a wedged TPU tunnel
+    # even when the operator asked for cpu).
+    from .utils.backend import force_cpu_if_requested
+
+    force_cpu_if_requested()
     args = _build_parser().parse_args(argv)
     try:
         return _COMMANDS[args.command](args)

@@ -11,6 +11,7 @@ talk to the control plane.
 from __future__ import annotations
 
 import json
+import threading
 import time
 import urllib.error
 import urllib.request
@@ -32,11 +33,27 @@ class JobSetClient:
 
     API = "/apis/jobset.x-k8s.io/v1alpha2"
 
-    def __init__(self, base_url: str, timeout: float = 30.0):
+    def __init__(
+        self,
+        base_url: str,
+        timeout: float = 30.0,
+        ca_cert: Optional[str] = None,
+    ):
+        """ca_cert: path to the PEM CA that signed the controller's serving
+        cert (utils/certs.py writes it as ca.crt) — enables https:// URLs
+        with verification against the self-signed chain."""
         if "://" not in base_url:
-            base_url = f"http://{base_url}"
+            base_url = f"{'https' if ca_cert else 'http'}://{base_url}"
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        self._ssl_context = None
+        if ca_cert is not None:
+            import ssl
+
+            self._ssl_context = ssl.create_default_context(cafile=ca_cert)
+            # The self-signed serving cert names localhost/127.0.0.1; tests
+            # and compose deployments connect by those, so hostname checking
+            # stays ON (the SANs cover it).
 
     # -- transport --------------------------------------------------------
 
@@ -49,7 +66,9 @@ class JobSetClient:
             headers={"Content-Type": content_type} if body is not None else {},
         )
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            with urllib.request.urlopen(
+                req, timeout=self.timeout, context=self._ssl_context
+            ) as resp:
                 data = resp.read()
                 ctype = resp.headers.get("Content-Type", "")
         except urllib.error.HTTPError as exc:
@@ -122,6 +141,39 @@ class JobSetClient:
         """Manifest dicts (status included) in one request — what the
         collection endpoint already serves; no per-item round-trips."""
         return self._request("GET", self._collection(namespace))["items"]
+
+    def list_with_version(self, namespace: str = "default"):
+        """(manifest dicts, resourceVersion) — the list half of
+        list-then-watch."""
+        out = self._request("GET", self._collection(namespace))
+        return out["items"], out.get("resourceVersion", 0)
+
+    def watch(self, namespace="default", resource_version=0, timeout=15.0):
+        """One long-poll against the watch endpoint.
+
+        Returns (events, resource_version): events are
+        {"type": ADDED|MODIFIED|DELETED, "object": manifest,
+        "resourceVersion": n}, possibly empty on timeout; the returned
+        resource_version is the token for the next call. Raises WatchGone
+        when the version is too old.
+        """
+        path = (
+            f"{self._collection(namespace)}?watch=1"
+            f"&resourceVersion={int(resource_version)}"
+            f"&timeoutSeconds={timeout}"
+        )
+        req = urllib.request.Request(self.base_url + path, method="GET")
+        try:
+            with urllib.request.urlopen(
+                req, timeout=timeout + 10.0, context=self._ssl_context
+            ) as resp:
+                out = json.loads(resp.read())
+        except urllib.error.HTTPError as exc:
+            detail = exc.read().decode(errors="replace")
+            if exc.code == 410:
+                raise WatchGone(410, detail) from None
+            raise ApiError(exc.code, detail) from None
+        return out["events"], out["resourceVersion"]
 
     def update(self, js: JobSet, namespace: Optional[str] = None) -> JobSet:
         ns = namespace or js.metadata.namespace or "default"
@@ -216,3 +268,139 @@ class JobSetClient:
 
     def metrics_text(self) -> str:
         return self._request("GET", "/metrics")
+
+
+# ---------------------------------------------------------------------------
+# Watch + informer (client-go informers/listers analog,
+# client-go/informers/externalversions/jobset/v1alpha2/jobset.go)
+# ---------------------------------------------------------------------------
+
+
+class WatchGone(ApiError):
+    """The requested resourceVersion fell out of the server's journal
+    window (HTTP 410): relist and restart the watch."""
+
+
+class JobSetInformer:
+    """Event-driven JobSet cache with handlers and periodic resync.
+
+    The client-go shared-informer pattern over the controller's long-poll
+    watch: `start()` lists (populating the cache and firing on_add), then a
+    background thread watches for ADDED/MODIFIED/DELETED events, keeps
+    `cache` current, and fires the handlers. A 410 from the server (journal
+    window passed) and the `resync_seconds` cadence both trigger a relist
+    that reconciles the cache (firing synthetic add/update/delete for any
+    drift), so handlers converge even across missed events.
+    """
+
+    def __init__(
+        self,
+        client: JobSetClient,
+        namespace: str = "default",
+        resync_seconds: float = 30.0,
+        on_add=None,
+        on_update=None,
+        on_delete=None,
+        poll_timeout: float = 5.0,
+    ):
+        self.client = client
+        self.namespace = namespace
+        self.resync_seconds = resync_seconds
+        self.poll_timeout = poll_timeout
+        self.on_add = on_add
+        self.on_update = on_update
+        self.on_delete = on_delete
+        self.cache: dict[str, dict] = {}
+        self._rv = 0
+        self._stop = threading.Event()
+        self._thread = None
+        self._synced = threading.Event()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "JobSetInformer":
+        self._relist()
+        self._synced.set()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.poll_timeout + 15.0)
+
+    def has_synced(self) -> bool:
+        return self._synced.is_set()
+
+    # -- internals ---------------------------------------------------------
+
+    @staticmethod
+    def _name(obj: dict) -> str:
+        return (obj.get("metadata") or {}).get("name", "")
+
+    def _fire(self, handler, *args) -> None:
+        if handler is None:
+            return
+        try:
+            handler(*args)
+        except Exception:  # a broken handler must not kill the watch loop
+            import logging
+
+            logging.getLogger("jobset_tpu.client").exception(
+                "informer handler failed"
+            )
+
+    def _relist(self) -> None:
+        items, rv = self.client.list_with_version(self.namespace)
+        fresh = {self._name(obj): obj for obj in items}
+        for name, obj in fresh.items():
+            if name not in self.cache:
+                self._fire(self.on_add, obj)
+            elif self.cache[name] != obj:
+                self._fire(self.on_update, self.cache[name], obj)
+        for name, obj in list(self.cache.items()):
+            if name not in fresh:
+                self._fire(self.on_delete, obj)
+        self.cache = fresh
+        self._rv = rv
+
+    def _apply(self, event: dict) -> None:
+        obj = event["object"]
+        name = self._name(obj)
+        etype = event["type"]
+        if etype == "ADDED":
+            self.cache[name] = obj
+            self._fire(self.on_add, obj)
+        elif etype == "MODIFIED":
+            old = self.cache.get(name)
+            self.cache[name] = obj
+            self._fire(self.on_update, old, obj)
+        elif etype == "DELETED":
+            self.cache.pop(name, None)
+            self._fire(self.on_delete, obj)
+
+    def _run(self) -> None:
+        import time as _t
+
+        next_resync = _t.monotonic() + self.resync_seconds
+        while not self._stop.is_set():
+            try:
+                events, rv = self.client.watch(
+                    self.namespace, self._rv, timeout=self.poll_timeout
+                )
+                for event in events:
+                    self._apply(event)
+                self._rv = rv
+            except WatchGone:
+                self._relist()
+            except Exception:
+                # transient transport error: back off briefly, then resume
+                if self._stop.wait(0.5):
+                    return
+            if _t.monotonic() >= next_resync:
+                try:
+                    self._relist()
+                except Exception:
+                    pass
+                next_resync = _t.monotonic() + self.resync_seconds

@@ -32,8 +32,13 @@ from .webhooks import PLAN_ANNOTATION
 PLAN_PENDING = object()
 
 # How long assign() tolerates an unfinished prefetch before blocking on it
-# anyway (a wedged device must not wedge job creation forever).
-_PENDING_GRACE_S = 2.0
+# anyway (a wedged device must not wedge job creation forever). Sized with
+# _PENDING_BACKOFF_S so the grace always expires within a default
+# run_until_stable tick budget (200 ticks x 5 ms > 0.5 s): the pump can
+# never exhaust its ticks while parked on a solve — it degrades to one
+# blocking fetch instead.
+_PENDING_GRACE_S = 0.5
+_PENDING_BACKOFF_S = 0.005
 
 
 class GreedyPlacement:
@@ -85,7 +90,7 @@ class SolverPlacement:
         # budget can drain before a ~100ms tunneled solve lands.
         import time
 
-        time.sleep(0.002)
+        time.sleep(_PENDING_BACKOFF_S)
         return not pending.is_ready()
 
     def _get_solver(self):

@@ -126,6 +126,8 @@ class ControllerServer:
         address: str = "127.0.0.1:0",
         cluster: Optional[Cluster] = None,
         tick_interval: float = 0.2,
+        tls_cert: Optional[str] = None,
+        tls_key: Optional[str] = None,
     ):
         if cluster is None:
             cluster = make_cluster(clock=Clock())
@@ -135,9 +137,35 @@ class ControllerServer:
         self._ready = threading.Event()
         self._stop = threading.Event()
 
+        # Watch journal (client-go informer substrate analog,
+        # client-go/informers/externalversions/jobset/v1alpha2/jobset.go):
+        # a bounded log of {ADDED, MODIFIED, DELETED} JobSet events with
+        # monotonically increasing resourceVersions, produced by diffing
+        # serialized JobSet state after every pump/write. Long-poll watchers
+        # block on the condition until events past their resourceVersion
+        # exist; a resourceVersion older than the retained window gets 410
+        # Gone (k8s semantics) and the client relists.
+        self._watch_cond = threading.Condition()
+        self._watch_events: list[tuple[int, str, dict]] = []
+        self._watch_limit = 2048
+        self._watch_rv = 0
+        self._watch_trimmed_rv = 0  # rv of the newest evicted event
+        self._watch_snapshots: dict[tuple, tuple[str, dict]] = {}
+
         host, _, port = address.rpartition(":")
         handler = self._make_handler()
         self._httpd = ThreadingHTTPServer((host or "127.0.0.1", int(port)), handler)
+        # TLS before serving (cert.go:43-65 + main.go:209-216: nothing is
+        # ready until certs are loaded; a bad cert fails startup loudly).
+        self.tls = bool(tls_cert)
+        if tls_cert:
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(certfile=tls_cert, keyfile=tls_key or tls_cert)
+            self._httpd.socket = ctx.wrap_socket(
+                self._httpd.socket, server_side=True
+            )
         self.port = self._httpd.server_port
         self.address = f"{host or '127.0.0.1'}:{self.port}"
         self._threads: list[threading.Thread] = []
@@ -161,7 +189,79 @@ class ControllerServer:
     def pump(self):
         """Run the control loops to a fixed point (thread-safe)."""
         with self.lock:
-            self.cluster.run_until_stable()
+            ticks = self.cluster.run_until_stable()
+            # run_until_stable returns after one no-op tick when nothing
+            # changed; skip the O(jobsets) serialize-and-diff on those idle
+            # background pump rounds.
+            if ticks > 1:
+                self._refresh_watch_locked()
+
+    # ------------------------------------------------------------------
+    # Watch journal
+    # ------------------------------------------------------------------
+
+    def _refresh_watch_locked(self):
+        """Diff current JobSet state against the last snapshot and append
+        ADDED/MODIFIED/DELETED events. Caller holds self.lock."""
+        current: dict[tuple, tuple[str, dict]] = {}
+        for key, js in self.cluster.jobsets.items():
+            current[key] = (js.metadata.uid, _jobset_summary(js))
+
+        events = []  # (namespace, event) — ns kept out-of-band because the
+        # wire manifest omits a default namespace
+        for key, (uid, obj) in current.items():
+            prev = self._watch_snapshots.get(key)
+            if prev is None or prev[0] != uid:
+                if prev is not None:  # replaced under the same name
+                    events.append((key[0], {"type": "DELETED", "object": prev[1]}))
+                events.append((key[0], {"type": "ADDED", "object": obj}))
+            elif prev[1] != obj:
+                events.append((key[0], {"type": "MODIFIED", "object": obj}))
+        for key, (uid, obj) in self._watch_snapshots.items():
+            if key not in current:
+                events.append((key[0], {"type": "DELETED", "object": obj}))
+        if not events:
+            return
+        self._watch_snapshots = current
+        with self._watch_cond:
+            for ns, event in events:
+                self._watch_rv += 1
+                self._watch_events.append((self._watch_rv, ns, event))
+            if len(self._watch_events) > self._watch_limit:
+                trimmed = self._watch_events[: -self._watch_limit]
+                self._watch_trimmed_rv = trimmed[-1][0]
+                del self._watch_events[: -self._watch_limit]
+            self._watch_cond.notify_all()
+
+    def _watch_jobsets(self, ns: str, resource_version: int, timeout_s: float):
+        """Long-poll: block until events newer than `resource_version` exist
+        for namespace `ns` (or the timeout passes). Runs OUTSIDE self.lock —
+        each request has its own handler thread, and writes proceed while
+        watchers wait."""
+        import time as _t
+
+        deadline = _t.monotonic() + max(0.0, min(timeout_s, 300.0))
+        with self._watch_cond:
+            while True:
+                if resource_version < self._watch_trimmed_rv:
+                    return 410, {
+                        "error": "resourceVersion too old; relist",
+                        "resourceVersion": self._watch_rv,
+                    }
+                batch = [
+                    {"resourceVersion": rv, **event}
+                    for rv, event_ns, event in self._watch_events
+                    if rv > resource_version and event_ns == ns
+                ]
+                if batch:
+                    return 200, {
+                        "events": batch,
+                        "resourceVersion": self._watch_rv,
+                    }
+                remaining = deadline - _t.monotonic()
+                if remaining <= 0:
+                    return 200, {"events": [], "resourceVersion": self._watch_rv}
+                self._watch_cond.wait(remaining)
 
     def _pump_loop(self):
         while not self._stop.wait(self.tick_interval):
@@ -181,6 +281,11 @@ class ControllerServer:
 
     def _route(self, method: str, path: str, body: bytes):
         """Returns (status_code, payload_dict_or_text)."""
+        from urllib.parse import parse_qs
+
+        path, _, query = path.partition("?")
+        params = parse_qs(query)
+
         if path == "/healthz":
             return 200, "ok"
         if path == "/readyz":
@@ -189,12 +294,34 @@ class ControllerServer:
             return 200, metrics.render_prometheus()
 
         parts = [p for p in path.split("/") if p]
+
+        # Watch requests block on the journal OUTSIDE the cluster lock so
+        # writes (and the pump) proceed while watchers wait.
+        if (
+            method == "GET"
+            and params.get("watch")
+            and path.startswith(self.API_PREFIX)
+            and len(parts) == 6
+            and parts[3] == "namespaces"
+            and parts[5] == "jobsets"
+        ):
+            try:
+                rv = int(params.get("resourceVersion", ["0"])[0])
+                timeout_s = float(params.get("timeoutSeconds", ["30"])[0])
+            except ValueError:
+                return 400, {"error": "bad watch parameters"}
+            return self._watch_jobsets(parts[4], rv, timeout_s)
+
         with self.lock:
             if path.startswith(self.API_PREFIX):
-                return self._route_jobsets(method, parts, body)
-            if parts[:2] == ["api", "v1"]:
-                return self._route_core(method, parts, body)
-        return 404, {"error": f"no route for {method} {path}"}
+                result = self._route_jobsets(method, parts, body)
+            elif parts[:2] == ["api", "v1"]:
+                result = self._route_core(method, parts, body)
+            else:
+                return 404, {"error": f"no route for {method} {path}"}
+            if method in ("POST", "PUT", "DELETE", "PATCH"):
+                self._refresh_watch_locked()
+            return result
 
     def _parse_manifest(self, body: bytes, path_ns: str):
         """Parse a manifest; the URL-path namespace is authoritative.  A
@@ -240,10 +367,14 @@ class ControllerServer:
                 for (jns, _), js in sorted(self.cluster.jobsets.items())
                 if jns == ns
             ]
+            # The list carries the journal's resourceVersion so an informer
+            # can list-then-watch without a gap (client-go contract).
+            self._refresh_watch_locked()
             return 200, {
                 "apiVersion": serialization.API_VERSION,
                 "kind": "JobSetList",
                 "items": items,
+                "resourceVersion": self._watch_rv,
             }
 
         if name is None:

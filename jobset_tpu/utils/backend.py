@@ -1,0 +1,31 @@
+"""JAX backend selection honoring the JAX_PLATFORMS environment variable.
+
+On hosts where a sitecustomize force-selects an accelerator backend through
+`jax.config` (overriding the env var), a process that was told
+`JAX_PLATFORMS=cpu` must push the config back BEFORE the backend
+initializes — otherwise first jax use can block on accelerator/tunnel init.
+Every process entry point (CLI, benches) calls this first.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu_if_requested() -> bool:
+    """If JAX_PLATFORMS requests cpu first, make the config agree.
+
+    Returns True when the cpu backend was forced. Must run before any jax
+    computation in the process.
+    """
+    platforms = [p.strip() for p in os.environ.get("JAX_PLATFORMS", "").split(",")]
+    if platforms[:1] != ["cpu"]:
+        return False
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if jax.default_backend() != "cpu":
+        raise RuntimeError(
+            f"JAX_PLATFORMS=cpu requested but backend is {jax.default_backend()}"
+        )
+    return True

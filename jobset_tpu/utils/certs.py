@@ -1,0 +1,159 @@
+"""Self-signed serving certificates for the controller REST server.
+
+Analog of the reference's webhook cert manager
+(`pkg/util/cert/cert.go:43-65` + `main.go:123-127,194-219`): the reference
+creates a self-signed CA, issues the webhook serving cert from it, and
+gates readyz on the certs being ready. Here the controller process does the
+same for its own HTTPS listener: `ensure_serving_certs(dir)` creates (or
+reuses) a CA plus a server certificate under the directory, and the CLI's
+`--tls-self-signed` flag wires them into the server before it starts
+serving — so, like the reference, nothing listens until certs exist.
+
+Rotation: certificates are reissued when within `rotate_before` of expiry
+(the cert-controller rotator's behavior, simplified to process-start-time
+rotation: the controller is restarted by its supervisor, which is when a
+fresh cert matters).
+"""
+
+from __future__ import annotations
+
+import datetime
+import ipaddress
+import os
+from typing import Optional
+
+CA_CERT = "ca.crt"
+CA_KEY = "ca.key"
+TLS_CERT = "tls.crt"
+TLS_KEY = "tls.key"
+
+
+def _write_private(path: str, data: bytes) -> None:
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    with os.fdopen(fd, "wb") as f:
+        f.write(data)
+
+
+def ensure_serving_certs(
+    cert_dir: str,
+    hosts: Optional[list[str]] = None,
+    valid_days: int = 365,
+    rotate_before: datetime.timedelta = datetime.timedelta(days=30),
+) -> tuple[str, str, str]:
+    """Create or reuse a self-signed CA + server cert under `cert_dir`.
+
+    Returns (ca_cert_path, server_cert_path, server_key_path). Existing,
+    still-valid certificates are reused so restarts keep client trust; a
+    cert within `rotate_before` of expiry is reissued from the same CA.
+    """
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    os.makedirs(cert_dir, exist_ok=True)
+    ca_cert_path = os.path.join(cert_dir, CA_CERT)
+    ca_key_path = os.path.join(cert_dir, CA_KEY)
+    crt_path = os.path.join(cert_dir, TLS_CERT)
+    key_path = os.path.join(cert_dir, TLS_KEY)
+    hosts = hosts or ["localhost", "127.0.0.1"]
+    now = datetime.datetime.now(datetime.timezone.utc)
+
+    def _still_valid(path: str, required_hosts: Optional[list[str]] = None) -> bool:
+        if not os.path.exists(path):
+            return False
+        try:
+            cert = x509.load_pem_x509_certificate(open(path, "rb").read())
+        except ValueError:
+            return False
+        if cert.not_valid_after_utc - rotate_before <= now:
+            return False
+        if required_hosts:
+            # Reuse only if the existing leaf already names every requested
+            # host — a controller restarted on a new address must get a
+            # fresh cert, not an 11-month hostname-mismatch.
+            try:
+                sans = cert.extensions.get_extension_for_class(
+                    x509.SubjectAlternativeName
+                ).value
+            except x509.ExtensionNotFound:
+                return False
+            named = {str(v) for v in sans.get_values_for_type(x509.DNSName)}
+            named |= {
+                str(v) for v in sans.get_values_for_type(x509.IPAddress)
+            }
+            if not set(required_hosts) <= named:
+                return False
+        return True
+
+    # CA: reuse while valid, else mint a fresh one (and with it, the chain).
+    if _still_valid(ca_cert_path) and os.path.exists(ca_key_path):
+        ca_key = serialization.load_pem_private_key(
+            open(ca_key_path, "rb").read(), password=None
+        )
+        ca_cert = x509.load_pem_x509_certificate(open(ca_cert_path, "rb").read())
+    else:
+        ca_key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+        ca_name = x509.Name(
+            [x509.NameAttribute(NameOID.COMMON_NAME, "jobset-tpu-ca")]
+        )
+        ca_cert = (
+            x509.CertificateBuilder()
+            .subject_name(ca_name)
+            .issuer_name(ca_name)
+            .public_key(ca_key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(minutes=5))
+            .not_valid_after(now + datetime.timedelta(days=valid_days))
+            .add_extension(x509.BasicConstraints(ca=True, path_length=0), True)
+            .sign(ca_key, hashes.SHA256())
+        )
+        open(ca_cert_path, "wb").write(
+            ca_cert.public_bytes(serialization.Encoding.PEM)
+        )
+        _write_private(
+            ca_key_path,
+            ca_key.private_bytes(
+                serialization.Encoding.PEM,
+                serialization.PrivateFormat.TraditionalOpenSSL,
+                serialization.NoEncryption(),
+            ),
+        )
+        # New CA invalidates any existing leaf.
+        for stale in (crt_path, key_path):
+            if os.path.exists(stale):
+                os.unlink(stale)
+
+    if not _still_valid(crt_path, required_hosts=hosts):
+        key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+        sans = []
+        for host in hosts:
+            try:
+                sans.append(x509.IPAddress(ipaddress.ip_address(host)))
+            except ValueError:
+                sans.append(x509.DNSName(host))
+        cert = (
+            x509.CertificateBuilder()
+            .subject_name(
+                x509.Name(
+                    [x509.NameAttribute(NameOID.COMMON_NAME, "jobset-tpu-controller")]
+                )
+            )
+            .issuer_name(ca_cert.subject)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(minutes=5))
+            .not_valid_after(now + datetime.timedelta(days=valid_days))
+            .add_extension(x509.SubjectAlternativeName(sans), False)
+            .sign(ca_key, hashes.SHA256())
+        )
+        open(crt_path, "wb").write(cert.public_bytes(serialization.Encoding.PEM))
+        _write_private(
+            key_path,
+            key.private_bytes(
+                serialization.Encoding.PEM,
+                serialization.PrivateFormat.TraditionalOpenSSL,
+                serialization.NoEncryption(),
+            ),
+        )
+    return ca_cert_path, crt_path, key_path

@@ -1,0 +1,192 @@
+"""Deployment smoke tests: controller + solver sidecar as REAL processes
+(the compose.yaml shape), driven through the CLI and the TLS client path.
+
+Reference analogs: test/e2e's kind deployment smoke (suite_test.go:68-95
+waits for the controller Deployment to be Available) and the cert-gated
+startup (main.go:123-127, 194-219).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from jobset_tpu.client import JobSetClient
+
+MANIFEST = """
+apiVersion: jobset.x-k8s.io/v1alpha2
+kind: JobSet
+metadata:
+  name: smoke
+  annotations:
+    alpha.jobset.sigs.k8s.io/exclusive-topology: tpu-slice
+spec:
+  replicatedJobs:
+  - name: workers
+    replicas: 2
+    template:
+      spec:
+        parallelism: 2
+        completions: 2
+        template:
+          spec:
+            containers:
+            - name: train
+              image: train:latest
+"""
+
+
+def _spawn(args, env=None):
+    return subprocess.Popen(
+        [sys.executable, "-m", "jobset_tpu", *args],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", **(env or {})},
+        start_new_session=True,
+    )
+
+
+def _read_address(proc, marker: str, timeout: float = 60.0) -> str:
+    """First stdout line contains `... listening on <scheme>://host:port`.
+    select()-driven so a wedged child can't block the test past `timeout`."""
+    import select
+
+    deadline = time.monotonic() + timeout
+    buf = ""
+    while time.monotonic() < deadline:
+        ready, _, _ = select.select([proc.stdout], [], [], 0.2)
+        if not ready:
+            if proc.poll() is not None:
+                break
+            continue
+        line = proc.stdout.readline()
+        buf += line
+        if marker in line:
+            return line.split("listening on", 1)[1].split()[0]
+        if proc.poll() is not None:
+            break
+    raise RuntimeError(f"process never announced itself; output: {buf!r}")
+
+
+def _stop(proc):
+    try:
+        os.killpg(proc.pid, signal.SIGKILL)
+    except OSError:
+        pass
+    proc.wait()
+
+
+@pytest.fixture()
+def free_ports():
+    import socket
+
+    ports = []
+    socks = []
+    for _ in range(2):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        socks.append(s)
+    for s in socks:
+        s.close()
+    return ports
+
+
+def test_controller_and_solver_processes_serve_an_apply(tmp_path, free_ports):
+    api_port, solver_port = free_ports
+    solver = _spawn(["solver", "--addr", f"127.0.0.1:{solver_port}"])
+    controller = None
+    try:
+        _read_address(solver, "solver sidecar listening")
+        controller = _spawn(
+            [
+                "controller",
+                "--addr", f"127.0.0.1:{api_port}",
+                "--solver-addr", f"127.0.0.1:{solver_port}",
+                "--feature-gates", "TPUPlacementSolver=true",
+                "--topology", "tpu-slice:4x2x8",
+                "--tick-interval", "0.05",
+            ]
+        )
+        url = _read_address(controller, "controller listening")
+        assert url.startswith("http://")
+
+        manifest = tmp_path / "smoke.yaml"
+        manifest.write_text(MANIFEST)
+        apply = subprocess.run(
+            [sys.executable, "-m", "jobset_tpu", "apply", "-f", str(manifest),
+             "--server", f"127.0.0.1:{api_port}"],
+            capture_output=True, text=True, timeout=180,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert apply.returncode == 0, apply.stdout + apply.stderr
+
+        client = JobSetClient(f"127.0.0.1:{api_port}", timeout=120.0)
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            pods = client.pods()
+            if len(pods) == 4 and all(p["spec"]["nodeName"] for p in pods):
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError(f"pods never all bound: {client.pods()}")
+
+        # Solver-planned placement: jobs carry the plan annotation, meaning
+        # the gRPC sidecar (not the webhook cascade) placed them.
+        jobs = client.jobs()
+        planned = [
+            j for j in jobs
+            if "tpu.jobset.x-k8s.io/placement-plan" in j["metadata"]["annotations"]
+        ]
+        assert planned, f"no solver-planned jobs: {jobs}"
+    finally:
+        if controller is not None:
+            _stop(controller)
+        _stop(solver)
+
+
+def test_controller_serves_https_with_self_signed_certs(tmp_path, free_ports):
+    api_port, _ = free_ports
+    cert_dir = tmp_path / "certs"
+    controller = _spawn(
+        [
+            "controller",
+            "--addr", f"127.0.0.1:{api_port}",
+            "--tls-self-signed", str(cert_dir),
+            "--tick-interval", "0.05",
+        ]
+    )
+    try:
+        url = _read_address(controller, "controller listening")
+        assert url.startswith("https://")
+        client = JobSetClient(
+            f"127.0.0.1:{api_port}", ca_cert=str(cert_dir / "ca.crt")
+        )
+        assert client.healthz()
+        created = client.create(MANIFEST)
+        assert created.metadata.name == "smoke"
+        assert client.get("smoke").metadata.name == "smoke"
+
+        # Plaintext client against the TLS port must fail, not silently work.
+        with pytest.raises(Exception):
+            JobSetClient(f"http://127.0.0.1:{api_port}", timeout=5).list()
+    finally:
+        _stop(controller)
+
+
+def test_self_signed_certs_are_reused_across_restarts(tmp_path):
+    from jobset_tpu.utils.certs import ensure_serving_certs
+
+    d = str(tmp_path / "certs")
+    first = ensure_serving_certs(d)
+    first_bytes = [open(p, "rb").read() for p in first]
+    second = ensure_serving_certs(d)
+    second_bytes = [open(p, "rb").read() for p in second]
+    assert first == second
+    assert first_bytes == second_bytes  # reuse, not reissue
