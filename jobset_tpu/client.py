@@ -393,7 +393,14 @@ class JobSetInformer:
                     self._apply(event)
                 self._rv = rv
             except WatchGone:
-                self._relist()
+                try:
+                    self._relist()
+                except Exception:
+                    # The catch-up list itself failed (controller restart
+                    # mid-410?): back off and retry — the loop must never
+                    # die silently with a stale cache.
+                    if self._stop.wait(0.5):
+                        return
             except Exception:
                 # transient transport error: back off briefly, then resume
                 if self._stop.wait(0.5):

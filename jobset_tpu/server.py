@@ -154,7 +154,19 @@ class ControllerServer:
 
         host, _, port = address.rpartition(":")
         handler = self._make_handler()
-        self._httpd = ThreadingHTTPServer((host or "127.0.0.1", int(port)), handler)
+
+        class _Server(ThreadingHTTPServer):
+            def handle_error(self, request, client_address):
+                # Aborted TLS handshakes (scanners, silent peers) are
+                # ordinary noise, not bugs worth a traceback.
+                import sys as _sys
+
+                exc = _sys.exception()
+                if isinstance(exc, ConnectionAbortedError):
+                    return
+                super().handle_error(request, client_address)
+
+        self._httpd = _Server((host or "127.0.0.1", int(port)), handler)
         # TLS before serving (cert.go:43-65 + main.go:209-216: nothing is
         # ready until certs are loaded; a bad cert fails startup loudly).
         self.tls = bool(tls_cert)
@@ -163,8 +175,14 @@ class ControllerServer:
 
             ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
             ctx.load_cert_chain(certfile=tls_cert, keyfile=tls_key or tls_cert)
+            # Handshake in each connection's handler thread, NOT in the
+            # accept loop: with eager handshaking a peer that connects and
+            # sends nothing would park the single accept thread and block
+            # every other request.
             self._httpd.socket = ctx.wrap_socket(
-                self._httpd.socket, server_side=True
+                self._httpd.socket,
+                server_side=True,
+                do_handshake_on_connect=False,
             )
         self.port = self._httpd.server_port
         self.address = f"{host or '127.0.0.1'}:{self.port}"
@@ -368,8 +386,12 @@ class ControllerServer:
                 if jns == ns
             ]
             # The list carries the journal's resourceVersion so an informer
-            # can list-then-watch without a gap (client-go contract).
-            self._refresh_watch_locked()
+            # can list-then-watch without a gap (client-go contract). The
+            # journal is already current here: every HTTP write refreshes it
+            # inline and the pump refreshes after any changing tick, so no
+            # per-list O(jobsets) re-serialization is needed. (Test code
+            # driving the cluster directly must refresh itself, as the
+            # _complete_all helper does.)
             return 200, {
                 "apiVersion": serialization.API_VERSION,
                 "kind": "JobSetList",
@@ -499,6 +521,25 @@ class ControllerServer:
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+
+            def setup(self):
+                # Deferred TLS handshake (see wrap_socket above), bounded so
+                # a silent peer releases this handler thread. A failed or
+                # timed-out handshake is an ordinary client misbehavior:
+                # drop the connection quietly instead of tracebacking.
+                conn = self.request
+                if hasattr(conn, "do_handshake"):
+                    import ssl as _ssl
+
+                    conn.settimeout(10.0)
+                    try:
+                        conn.do_handshake()
+                    except (_ssl.SSLError, OSError) as exc:
+                        raise ConnectionAbortedError(
+                            f"tls handshake failed: {exc}"
+                        ) from None
+                    conn.settimeout(None)
+                super().setup()
 
             def _respond(self, code: int, payload):
                 if isinstance(payload, str):

@@ -132,6 +132,12 @@ def ensure_serving_certs(
                 sans.append(x509.IPAddress(ipaddress.ip_address(host)))
             except ValueError:
                 sans.append(x509.DNSName(host))
+        # A leaf must never outlive its CA: a reused late-life CA would
+        # otherwise sign a chain that breaks mid-leaf-validity.
+        leaf_expiry = min(
+            now + datetime.timedelta(days=valid_days),
+            ca_cert.not_valid_after_utc,
+        )
         cert = (
             x509.CertificateBuilder()
             .subject_name(
@@ -143,7 +149,7 @@ def ensure_serving_certs(
             .public_key(key.public_key())
             .serial_number(x509.random_serial_number())
             .not_valid_before(now - datetime.timedelta(minutes=5))
-            .not_valid_after(now + datetime.timedelta(days=valid_days))
+            .not_valid_after(leaf_expiry)
             .add_extension(x509.SubjectAlternativeName(sans), False)
             .sign(ca_key, hashes.SHA256())
         )
