@@ -140,17 +140,30 @@ def build_jobset(replicas: int, pods_per_job: int, topology_key: str):
     )
 
 
-def run_recovery(cluster, js, total_pods: int) -> float:
+def run_recovery(cluster, js, total_pods: int) -> tuple[float, float]:
     """Fail one job -> gang restart -> measure wall time until every
-    replacement pod is bound. Returns pods/s."""
-    cluster.fail_job("default", "bench-workers-0")
-    t0 = time.perf_counter()
-    cluster.run_until_stable(max_ticks=1000)
-    elapsed = time.perf_counter() - t0
-    bound = sum(1 for p in cluster.pods.values() if p.spec.node_name)
-    if bound != total_pods:
-        raise RuntimeError(f"recovery incomplete: {bound}/{total_pods} pods bound")
-    return total_pods / elapsed
+    replacement pod is bound, twice: the first recovery right after initial
+    placement (cold interpreter caches) and a second one (the steady state a
+    long-running controller operates in). The reconcile-latency histogram is
+    reset between the two so the reported p99 reflects steady state, not
+    one-time process warmup landing in a single pass.
+    Returns (cold, steady) pods/s."""
+    from jobset_tpu.core import metrics
+
+    rates = []
+    for _ in range(2):
+        metrics.reset()
+        cluster.fail_job("default", "bench-workers-0")
+        t0 = time.perf_counter()
+        cluster.run_until_stable(max_ticks=1000)
+        elapsed = time.perf_counter() - t0
+        bound = sum(1 for p in cluster.pods.values() if p.spec.node_name)
+        if bound != total_pods:
+            raise RuntimeError(
+                f"recovery incomplete: {bound}/{total_pods} pods bound"
+            )
+        rates.append(total_pods / elapsed)
+    return rates[0], rates[1]
 
 
 def run_mode(solver_on: bool, args) -> dict:
@@ -172,12 +185,13 @@ def run_mode(solver_on: bool, args) -> dict:
         if bound != total_pods:
             raise RuntimeError(f"initial placement incomplete: {bound}/{total_pods}")
 
-        pods_per_sec = run_recovery(cluster, js, total_pods)
+        cold_pods_per_sec, pods_per_sec = run_recovery(cluster, js, total_pods)
 
     return {
         "mode": "solver" if solver_on else "greedy",
         "initial_placement_s": round(initial_s, 3),
         "recovery_pods_per_sec": round(pods_per_sec, 1),
+        "cold_recovery_pods_per_sec": round(cold_pods_per_sec, 1),
         "p99_reconcile_ms": round(
             metrics.reconcile_time_seconds.percentile(0.99) * 1000, 3
         ),
@@ -287,6 +301,11 @@ def worker_main(args) -> None:
         headline = results.get("solver") or results["greedy"]
         detail = {
             "backend": jax_backend_name(),
+            # Headline recovery_pods_per_sec is the STEADY-STATE (second)
+            # recovery — a long-running controller's operating point. The
+            # cold first recovery (the r01 definition, comparable to
+            # BENCH_r01.json) is recorded as *_cold_recovery_pods_per_sec.
+            "recovery_measurement": "steady_state_second_recovery",
             "nodes": args.domains * args.nodes_per_domain,
             "replicas": args.replicas,
             "pods": args.replicas * args.pods_per_job,

@@ -27,9 +27,14 @@ def _clone(obj):
 # ---------------------------------------------------------------------------
 
 
-@dataclass
+@dataclass(frozen=True)
 class Toleration:
-    """Analog of corev1.Toleration (only the fields the framework touches)."""
+    """Analog of corev1.Toleration (only the fields the framework touches).
+
+    Frozen: pod-spec clones on the 15k-node bench's per-pod hot path share
+    Toleration instances and copy only the list containers; immutability is
+    what makes that sharing safe.
+    """
 
     key: str = ""
     operator: str = "Equal"  # "Equal" | "Exists"
@@ -53,7 +58,7 @@ class Taint:
     effect: str = "NoSchedule"
 
 
-@dataclass
+@dataclass(frozen=True)
 class AffinityTerm:
     """One required pod (anti-)affinity term over the job-key label.
 
@@ -62,15 +67,27 @@ class AffinityTerm:
     (`pod_mutating_webhook.go:95-135`), so the schema models exactly that —
     match a topology domain where a pod with (or without) the given job-key
     runs.
+
+    Frozen (with the key lists normalized to tuples): affinity clones on the
+    per-pod hot path share term instances and copy only the term lists;
+    immutability is what makes that sharing safe.
     """
 
     topology_key: str = ""
-    # Pods whose JOB_KEY label is in this list satisfy the selector.
-    job_key_in: Optional[list[str]] = None
+    # Pods whose JOB_KEY label is in this sequence satisfy the selector.
+    job_key_in: Optional[tuple[str, ...]] = None
     # If true, selector matches any pod carrying a JOB_KEY label
     # (combined with job_key_not_in for the anti-affinity term).
     job_key_exists: bool = False
-    job_key_not_in: Optional[list[str]] = None
+    job_key_not_in: Optional[tuple[str, ...]] = None
+
+    def __post_init__(self):
+        # Accept lists at construction (YAML decode, webhooks) but store
+        # tuples so instances are hashable and deeply immutable.
+        for f in ("job_key_in", "job_key_not_in"):
+            v = getattr(self, f)
+            if v is not None and not isinstance(v, tuple):
+                object.__setattr__(self, f, tuple(v))
 
 
 @dataclass
@@ -79,20 +96,14 @@ class Affinity:
     pod_anti_affinity: list[AffinityTerm] = field(default_factory=list)
 
     def clone(self) -> "Affinity":
-        def term(t: AffinityTerm) -> AffinityTerm:
-            return AffinityTerm(
-                topology_key=t.topology_key,
-                job_key_in=list(t.job_key_in) if t.job_key_in is not None else None,
-                job_key_exists=t.job_key_exists,
-                job_key_not_in=(
-                    list(t.job_key_not_in) if t.job_key_not_in is not None else None
-                ),
-            )
-
-        return Affinity(
-            pod_affinity=[term(t) for t in self.pod_affinity],
-            pod_anti_affinity=[term(t) for t in self.pod_anti_affinity],
-        )
+        # Structural sharing: AffinityTerm instances are immutable once built
+        # (webhooks only append new terms to a pod's own lists), so clones
+        # share the term objects and copy only the list containers. This is
+        # on the per-pod hot path of the 15k-node bench.
+        new = object.__new__(Affinity)
+        new.pod_affinity = list(self.pod_affinity)
+        new.pod_anti_affinity = list(self.pod_anti_affinity)
+        return new
 
 
 @dataclass
@@ -114,22 +125,20 @@ class PodSpec:
     def clone(self) -> "PodSpec":
         # Hand-written clone: generic deepcopy of pod specs was the hottest
         # item in the 15k-node bench profile (the Job controller stamps out
-        # one spec per pod); only `workload` is free-form and needs a real
-        # deep copy.
-        return PodSpec(
-            restart_policy=self.restart_policy,
-            node_selector=dict(self.node_selector),
-            tolerations=[
-                Toleration(key=t.key, operator=t.operator, value=t.value, effect=t.effect)
-                for t in self.tolerations
-            ],
-            affinity=self.affinity.clone() if self.affinity is not None else None,
-            subdomain=self.subdomain,
-            hostname=self.hostname,
-            scheduling_gates=list(self.scheduling_gates),
-            node_name=self.node_name,
-            workload=copy.deepcopy(self.workload) if self.workload else {},
-        )
+        # one spec per pod). Bypasses dataclass __init__ and shares immutable
+        # members (Toleration instances are never mutated in place — callers
+        # replace or re-list them); only the mutable containers and the
+        # free-form `workload` get copied.
+        new = object.__new__(PodSpec)
+        d = dict(self.__dict__)
+        d["node_selector"] = dict(d["node_selector"])
+        d["tolerations"] = list(d["tolerations"])
+        d["scheduling_gates"] = list(d["scheduling_gates"])
+        if d["affinity"] is not None:
+            d["affinity"] = d["affinity"].clone()
+        d["workload"] = copy.deepcopy(d["workload"]) if d["workload"] else {}
+        new.__dict__ = d
+        return new
 
 
 @dataclass

@@ -15,7 +15,8 @@ exactly optimal assignment (standard auction optimality bound: within J*eps
 of optimal, and scaled-integer spacing makes that exact).
 
 Shape discipline: problems are padded to power-of-two buckets so recompilation
-is rare, and every job gets a dedicated finite-benefit "sink" column so a
+is rare, and every job has an IMPLICIT dedicated finite-benefit "sink" (a
+constant outside option inside the kernel — no materialized column) so a
 perfect matching always exists and the loop provably terminates; jobs that
 end on their sink are reported unassigned (-1) and fall back to the greedy
 path.
@@ -55,13 +56,24 @@ def _round_up_pow2(n: int, minimum: int = 8) -> int:
 
 @functools.partial(jax.jit, static_argnames=("max_iters",))
 def _auction(benefit: jax.Array, eps: jax.Array, max_iters: int = 20000):
-    """Jacobi auction over a dense benefit matrix.
+    """Jacobi auction over a dense benefit matrix with implicit sinks.
 
-    benefit: [J, D_total] float32 (scaled-integer values; -inf = forbidden).
-    Returns (assignment [J] int32 into D_total, prices [D_total] float32,
-    iterations int32).
+    benefit: [J, D] float32 (scaled-integer values; -inf = forbidden).
+    Every job also has an IMPLICIT dedicated "sink" object of constant
+    benefit SINK_BENEFIT (scaled like the matrix): dedicated means it is
+    never contested, so it needs no column — the sink only participates as
+    (a) each bidder's outside option in the second-best value and (b) the
+    landing spot for jobs whose every real column is worse. Versus
+    materializing a [J, J] diagonal sink block, this keeps the hot per-
+    iteration matrix at [J, D] (the block would dominate at J ~ D) while
+    preserving exact auction semantics: a perfect matching always exists,
+    so the loop provably terminates.
+
+    Returns (assignment [J] int32 into D, with D itself as the "took the
+    sink" sentinel; prices [D] float32; iterations int32).
     """
     num_jobs, num_objects = benefit.shape
+    sink = jnp.asarray(SINK_BENEFIT * (num_jobs + 1), benefit.dtype)
 
     def cond(state):
         assignment, _, _, it = state
@@ -76,20 +88,28 @@ def _auction(benefit: jax.Array, eps: jax.Array, max_iters: int = 20000):
         best_val = jnp.max(values, axis=1)  # [J]
         # Second-best value (mask out the best column). NOTE: lax.top_k(_, 2)
         # looks tempting but is sort-based on CPU and ~8x slower than two
-        # fused max passes.
+        # fused max passes. The sink (price 0, value `sink`) is always an
+        # alternative object, so it floors the second-best value — which
+        # also keeps it finite even when only one real column is feasible.
         masked = values.at[jnp.arange(num_jobs), best_obj].set(-jnp.inf)
-        second_val = jnp.max(masked, axis=1)  # [J]
-        second_val = jnp.where(jnp.isfinite(second_val), second_val, best_val)
+        second_val = jnp.maximum(jnp.max(masked, axis=1), sink)  # [J]
+
+        # A job whose best real option is worse than its sink takes the sink
+        # immediately: the sink is dedicated, so the claim is uncontested
+        # and final (no other bidder can ever evict it).
+        takes_sink = jnp.logical_and(unassigned, sink > best_val)  # [J]
 
         bid = prices[best_obj] + (best_val - second_val) + eps  # [J]
 
         # Conflict resolution: per object, the highest bid wins; ties go to
         # the lowest job index (deterministic).
-        bid_active = jnp.where(unassigned, bid, -jnp.inf)
+        bid_active = jnp.where(
+            jnp.logical_and(unassigned, ~takes_sink), bid, -jnp.inf
+        )
         obj_best_bid = jnp.full((num_objects,), -jnp.inf, benefit.dtype)
         obj_best_bid = obj_best_bid.at[best_obj].max(bid_active)
         is_winner = jnp.logical_and(
-            unassigned, bid_active >= obj_best_bid[best_obj]
+            jnp.isfinite(bid_active), bid_active >= obj_best_bid[best_obj]
         )
         winner_job = jnp.full((num_objects,), num_jobs, jnp.int32)
         winner_job = winner_job.at[best_obj].min(
@@ -110,6 +130,9 @@ def _auction(benefit: jax.Array, eps: jax.Array, max_iters: int = 20000):
             jnp.arange(num_objects, dtype=jnp.int32), mode="drop"
         )
         owner = jnp.where(won_obj_mask, winner_job, owner)
+        # Sink-takers: sentinel D (out of the real-object range; result()
+        # maps anything >= num_domains to "unassigned").
+        assignment = jnp.where(takes_sink, num_objects, assignment)
 
         # Price update on objects that got bids.
         winner_bid = jnp.full((num_objects,), -jnp.inf, benefit.dtype)
@@ -152,7 +175,6 @@ def _auction_structured(
     """
     jobs_p = pods_needed.shape[0]
     domains_p = load.shape[0]
-    total = domains_p + jobs_p
 
     nd = num_domains.astype(jnp.float32)
     jj = jnp.arange(jobs_p, dtype=jnp.float32)[:, None]
@@ -168,15 +190,10 @@ def _auction_structured(
     benefit = jnp.where(
         feasible, COST_CAP - jnp.clip(cost, 0.0, COST_CAP - 1.0), NEG_INF
     )
-    sinks = jnp.where(
-        jnp.arange(domains_p, total)[None, :] - domains_p
-        == jnp.arange(jobs_p, dtype=jnp.int32)[:, None],
-        SINK_BENEFIT,
-        NEG_INF,
-    )
-    full = jnp.concatenate([benefit, sinks], axis=1) * float(jobs_p + 1)
+    # Sinks are implicit in _auction (constant outside option): the hot
+    # matrix stays [J_p, D_p] with no [J_p, J_p] sink block.
     assignment, _, iters = _auction(
-        full, jnp.float32(1.0), max_iters=max_iters
+        benefit * float(jobs_p + 1), jnp.float32(1.0), max_iters=max_iters
     )
     return assignment, iters
 
@@ -245,15 +262,14 @@ class AssignmentSolver:
 
         jobs_p = _round_up_pow2(num_jobs)
         domains_p = _round_up_pow2(num_domains)
-        total = domains_p + jobs_p  # + dedicated sink per (padded) job
 
-        benefit = np.full((jobs_p, total), NEG_INF, np.float32)
+        # Sinks are implicit in _auction (constant outside option), so the
+        # shipped matrix is [J_p, D_p] — no [J_p, J_p] sink block.
+        benefit = np.full((jobs_p, domains_p), NEG_INF, np.float32)
         clipped = np.clip(cost, 0.0, COST_CAP - 1.0)
         benefit[:num_jobs, :num_domains] = np.where(
             feasible, COST_CAP - clipped, NEG_INF
         )
-        # Dedicated sinks: job j may always take column domains_p + j.
-        benefit[np.arange(jobs_p), domains_p + np.arange(jobs_p)] = SINK_BENEFIT
 
         # Scale to integers spaced J+1 apart -> eps=1 yields exact optimum.
         scale = float(jobs_p + 1)
@@ -326,14 +342,13 @@ class AssignmentSolver:
 
         jobs_p = _round_up_pow2(num_jobs)
         domains_p = _round_up_pow2(num_domains)
-        total = domains_p + jobs_p
 
-        benefit = np.full((batch, jobs_p, total), NEG_INF, np.float32)
+        # Sinks are implicit in _auction; no [J_p, J_p] sink block.
+        benefit = np.full((batch, jobs_p, domains_p), NEG_INF, np.float32)
         clipped = np.clip(costs, 0.0, COST_CAP - 1.0)
         benefit[:, :num_jobs, :num_domains] = np.where(
             feasibles, COST_CAP - clipped, NEG_INF
         )
-        benefit[:, np.arange(jobs_p), domains_p + np.arange(jobs_p)] = SINK_BENEFIT
 
         scale = float(jobs_p + 1)
         assignments = np.asarray(

@@ -63,9 +63,9 @@ def test_leader_has_affinity_follower_has_node_selector():
             assert pod.spec.affinity is not None
             assert pod.spec.affinity.pod_affinity[0].topology_key == TOPOLOGY
             anti = pod.spec.affinity.pod_anti_affinity[0]
-            assert anti.job_key_exists and anti.job_key_not_in == [
-                pod.labels[keys.JOB_KEY]
-            ]
+            assert anti.job_key_exists and anti.job_key_not_in == (
+                pod.labels[keys.JOB_KEY],
+            )
         else:
             assert pod.spec.node_selector[TOPOLOGY]
 

@@ -212,9 +212,9 @@ def test_affinity_roundtrips():
     )
     back = api.from_dict(api.to_dict(js))
     a = back.spec.replicated_jobs[0].template.spec.template.spec.affinity
-    assert a.pod_affinity[0].job_key_in == ["k1"]
+    assert a.pod_affinity[0].job_key_in == ("k1",)
     assert a.pod_anti_affinity[0].job_key_exists is True
-    assert a.pod_anti_affinity[0].job_key_not_in == ["k1"]
+    assert a.pod_anti_affinity[0].job_key_not_in == ("k1",)
     assert api.to_dict(back) == api.to_dict(js)
 
 
@@ -284,8 +284,14 @@ def test_pod_spec_clone_matches_deepcopy():
 
 def test_job_spec_clone_matches_deepcopy_and_is_deep():
     import copy
+    import dataclasses
 
-    from jobset_tpu.api.types import JobSpec, PodTemplateSpec
+    from jobset_tpu.api.types import (
+        AffinityTerm,
+        JobSpec,
+        PodTemplateSpec,
+        Toleration,
+    )
 
     spec = JobSpec(
         parallelism=4,
@@ -300,12 +306,23 @@ def test_job_spec_clone_matches_deepcopy_and_is_deep():
     )
     clone = spec.clone()
     assert clone == copy.deepcopy(spec)
-    # Deep: mutating the clone must not leak into the original.
+    # Deep where mutable: container and free-form mutations on the clone
+    # must not leak into the original.
     clone.template.spec.node_selector["pool"] = "changed"
-    clone.template.spec.tolerations[0].key = "changed"
-    clone.template.spec.affinity.pod_affinity[0].job_key_in.append("x")
+    clone.template.spec.tolerations.append(Toleration(key="extra"))
+    clone.template.spec.affinity.pod_affinity.append(
+        AffinityTerm(topology_key="zone")
+    )
     clone.template.spec.workload["nested"]["steps"] = 99
     assert spec.template.spec.node_selector["pool"] == "a"
-    assert spec.template.spec.tolerations[0].key == "k"
-    assert spec.template.spec.affinity.pod_affinity[0].job_key_in == ["jk1"]
+    assert len(spec.template.spec.tolerations) == 1
+    assert len(spec.template.spec.affinity.pod_affinity) == 1
     assert spec.template.spec.workload["nested"]["steps"] == 3
+    # Shared members are safe to share because they are frozen: in-place
+    # mutation is a TypeError, so a clone can never leak through them.
+    with pytest.raises(dataclasses.FrozenInstanceError):
+        clone.template.spec.tolerations[0].key = "changed"
+    with pytest.raises(dataclasses.FrozenInstanceError):
+        clone.template.spec.affinity.pod_affinity[0].topology_key = "changed"
+    # The term's key sequences are tuples — immutable, no append to leak.
+    assert spec.template.spec.affinity.pod_affinity[0].job_key_in == ("jk1",)
