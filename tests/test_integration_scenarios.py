@@ -179,6 +179,149 @@ def test_restart_attempt_labels_reset_ttl_irrelevant_children():
 
 
 # ---------------------------------------------------------------------------
+# Lifecycle scenarios mirroring remaining reference envtest entries
+# (test/integration/controller/jobset_controller_test.go)
+# ---------------------------------------------------------------------------
+
+
+def test_headless_service_recreated_if_deleted():
+    """Reference entry "service deleted" (jobset_controller_test.go:999):
+    the reconciler recreates the headless service on its next pass."""
+    cluster = make_cluster()
+    js = _jobset("svc-js")
+    cluster.create_jobset(js)
+    cluster.run_until_stable()
+    assert ("default", "svc-js") in cluster.services
+
+    del cluster.services[("default", "svc-js")]
+    cluster.enqueue_reconcile("default", "svc-js")
+    cluster.run_until_stable()
+    assert ("default", "svc-js") in cluster.services
+
+
+def test_jobset_succeeds_after_one_failure():
+    """Reference entry "job succeeds after one failure"
+    (jobset_controller_test.go:856): a gang restart is not terminal — the
+    recreated attempt can complete the JobSet, with restarts recorded."""
+    cluster = make_cluster()
+    js = _jobset("phoenix")
+    js.spec.failure_policy = FailurePolicy(max_restarts=2)
+    cluster.create_jobset(js)
+    cluster.run_until_stable()
+
+    cluster.fail_job("default", "phoenix-workers-0")
+    cluster.run_until_stable()
+    stored = cluster.get_jobset("default", "phoenix")
+    assert stored.status.restarts == 1
+    assert not cluster.jobset_has_condition(stored, "Failed")
+
+    cluster.complete_all_jobs(stored)
+    cluster.run_until_stable()
+    stored = cluster.get_jobset("default", "phoenix")
+    assert cluster.jobset_has_condition(stored, "Completed")
+    assert stored.status.restarts == 1
+
+
+def test_failed_jobset_deletes_active_jobs():
+    """Reference entry "active jobs are deleted after jobset fails"
+    (jobset_controller_test.go:1093)."""
+    cluster = make_cluster()
+    js = _jobset("halfdead", replicas=3)
+    cluster.create_jobset(js)  # no failure policy: first failure is terminal
+    cluster.run_until_stable()
+    assert len(cluster.jobs) == 3
+
+    cluster.fail_job("default", "halfdead-workers-1")
+    cluster.run_until_stable()
+    stored = cluster.get_jobset("default", "halfdead")
+    assert cluster.jobset_has_condition(stored, "Failed")
+    # The failed job object remains (evidence); the still-active siblings
+    # are torn down (jobset_controller.go:156-160).
+    remaining = [j.metadata.name for j in cluster.jobs.values()]
+    assert remaining == ["halfdead-workers-1"]
+    assert all(p.status.phase == "Failed" for p in cluster.pods.values())
+
+
+def test_success_policy_all_with_empty_target_list_targets_every_rjob():
+    """Reference entry "success policy 'all' with empty replicated jobs
+    list" (jobset_controller_test.go:260): no targets = all replicated
+    jobs must succeed."""
+    from jobset_tpu.api import SuccessPolicy
+
+    cluster = make_cluster()
+    js = (
+        make_jobset("allof")
+        .success_policy(SuccessPolicy(operator=keys.OPERATOR_ALL))
+        .replicated_job(
+            make_replicated_job("a").replicas(1).parallelism(1).completions(1).obj()
+        )
+        .replicated_job(
+            make_replicated_job("b").replicas(2).parallelism(1).completions(1).obj()
+        )
+        .obj()
+    )
+    cluster.create_jobset(js)
+    cluster.run_until_stable()
+
+    cluster.complete_job("default", "allof-a-0")
+    cluster.complete_job("default", "allof-b-0")
+    cluster.run_until_stable()
+    stored = cluster.get_jobset("default", "allof")
+    assert not cluster.jobset_has_condition(stored, "Completed")  # b-1 open
+
+    cluster.complete_job("default", "allof-b-1")
+    cluster.run_until_stable()
+    assert cluster.jobset_has_condition(
+        cluster.get_jobset("default", "allof"), "Completed"
+    )
+
+
+def test_in_order_startup_reapplied_after_gang_restart():
+    """Reference entry "startupPolicy with InOrder; success policy restart"
+    (jobset_controller_test.go:1408): after a gang restart the InOrder gate
+    applies to the NEW attempt — workers wait for the recreated driver."""
+    from jobset_tpu.api import StartupPolicy
+
+    cluster = make_cluster(auto_ready=False)
+    js = (
+        make_jobset("ordered")
+        .startup_policy(StartupPolicy(startup_policy_order=keys.STARTUP_IN_ORDER))
+        .failure_policy(FailurePolicy(max_restarts=2))
+        .replicated_job(
+            make_replicated_job("driver").replicas(1).parallelism(1).completions(1).obj()
+        )
+        .replicated_job(
+            make_replicated_job("workers").replicas(2).parallelism(1).completions(1).obj()
+        )
+        .obj()
+    )
+    cluster.create_jobset(js)
+    cluster.run_until_stable()
+    cluster.set_job_ready("default", "ordered-driver-0")
+    cluster.run_until_stable()
+    assert len(cluster.jobs) == 3  # driver started -> workers created
+
+    cluster.fail_job("default", "ordered-workers-1")
+    cluster.run_until_stable()
+    stored = cluster.get_jobset("default", "ordered")
+    assert stored.status.restarts == 1
+    # New attempt: only the driver exists until it reports ready again.
+    names = sorted(j.metadata.name for j in cluster.jobs.values())
+    assert names == ["ordered-driver-0"]
+    assert all(
+        j.labels[keys.RESTARTS_KEY] == "1" for j in cluster.jobs.values()
+    )
+
+    cluster.set_job_ready("default", "ordered-driver-0")
+    cluster.run_until_stable()
+    assert sorted(j.metadata.name for j in cluster.jobs.values()) == [
+        "ordered-driver-0",
+        "ordered-workers-0",
+        "ordered-workers-1",
+    ]
+
+
+# ---------------------------------------------------------------------------
 # nodeSelector strategy end-to-end with the label-nodes tool
 # (hack/label_nodes/label_nodes.py + jobset_controller.go:674-696)
 # ---------------------------------------------------------------------------
