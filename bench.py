@@ -108,6 +108,37 @@ def jax_backend_name() -> str:
     return jax.default_backend()
 
 
+def _probe_device(deadline_s: float) -> bool:
+    """Cheaply check whether the accelerator is reachable at all: run
+    `jax.devices()` in a disposable subprocess under a hard deadline. A
+    wedged tunnel hangs exactly there, so a failed probe means the long
+    TPU attempt would just burn its whole budget — skip it instead."""
+    import signal
+
+    with open(os.devnull, "w") as devnull:
+        proc = subprocess.Popen(
+            [
+                sys.executable,
+                "-c",
+                "import jax; jax.devices(); print('ok')",
+            ],
+            stdout=devnull,
+            stderr=devnull,
+            start_new_session=True,
+        )
+        try:
+            rc = proc.wait(timeout=deadline_s)
+            return rc == 0
+        except subprocess.TimeoutExpired:
+            return False
+        finally:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except OSError:
+                pass
+            proc.wait()
+
+
 def build_cluster(num_domains: int, nodes_per_domain: int, topology_key: str):
     from jobset_tpu.core import make_cluster
 
@@ -406,7 +437,23 @@ def main() -> int:
 
     attempts = []
     if not _cpu_forced():
-        attempts.append((TPU_ATTEMPT_DEADLINE_S, False))
+        # Gate the expensive TPU attempt on a cheap reachability probe,
+        # retried once after a pause (the tunnel wedges transiently): a
+        # failed probe means `jax.devices()` itself hangs, so the full
+        # attempt would forfeit its whole 420s budget for nothing.
+        probe_s = _env_float("BENCH_PROBE_DEADLINE_S", 90.0)
+        for attempt in range(2):
+            if _probe_device(probe_s):
+                attempts.append((TPU_ATTEMPT_DEADLINE_S, False))
+                break
+            print(
+                f"device probe {attempt + 1} timed out after {probe_s:.0f}s"
+                + ("; retrying in 30s" if attempt == 0 else
+                   "; skipping the TPU attempt"),
+                file=sys.stderr,
+            )
+            if attempt == 0:
+                time.sleep(30)
     attempts.append((CPU_ATTEMPT_DEADLINE_S, True))
 
     for deadline_s, force_cpu in attempts:
