@@ -171,3 +171,42 @@ def test_multislice_mesh_blocks_and_train_step():
     }
     _, _, loss = step(params, opt.init(params), batch)
     assert jnp.isfinite(loss)
+
+
+def test_multislice_pp_across_dcn_trains():
+    """The other sensible DCN split: pipeline stages across slices (pp=2
+    over DCN; dp=2 x tp=2 inside each slice's ICI). Activations cross the
+    inter-slice link once per microbatch; everything else stays local."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from jobset_tpu.models import TransformerConfig, init_params
+    from jobset_tpu.models.transformer import build_train_step
+    from jobset_tpu.parallel import MeshConfig, build_multislice_mesh
+
+    mesh = build_multislice_mesh(MeshConfig(dp=2, tp=2), MeshConfig(pp=2))
+    assert dict(mesh.shape) == {"dp": 2, "pp": 2, "ep": 1, "sp": 1, "tp": 2}
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=4, d_ff=64, n_layers=2,
+        max_seq_len=16, dtype=jnp.float32, remat=False,
+    )
+    params = init_params(jax.random.key(0), cfg, mesh)
+    opt = optax.sgd(1e-2)
+    step = build_train_step(cfg, mesh, opt)
+    batch = {
+        "inputs": jnp.zeros((4, 16), jnp.int32),
+        "targets": jnp.ones((4, 16), jnp.int32),
+    }
+    _, _, loss = step(params, opt.init(params), batch)
+    assert jnp.isfinite(loss)
+
+
+def test_multislice_mesh_rejects_wrong_device_count():
+    import pytest
+
+    from jobset_tpu.parallel import MeshConfig, build_multislice_mesh
+
+    with pytest.raises(ValueError, match="needs 16 devices"):
+        build_multislice_mesh(MeshConfig(tp=4), MeshConfig(dp=4))
