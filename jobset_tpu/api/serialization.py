@@ -87,6 +87,7 @@ def _meta_from(d: Optional[dict], strict: bool) -> t.ObjectMeta:
     )
     return t.ObjectMeta(
         name=d.get("name", ""),
+        generate_name=d.get("generateName", ""),
         namespace=d.get("namespace", "default"),
         uid=str(d.get("uid", "")),
         labels=dict(d.get("labels") or {}),
@@ -536,6 +537,7 @@ def to_dict(js: t.JobSet, include_status: bool = False) -> dict:
         "kind": KIND,
         "metadata": _prune({
             "name": js.metadata.name,
+            "generateName": js.metadata.generate_name or None,
             "namespace": js.metadata.namespace if js.metadata.namespace != "default" else None,
             "uid": js.metadata.uid,
             "labels": dict(js.metadata.labels),

@@ -328,6 +328,9 @@ class JobSetStatus:
 @dataclass
 class ObjectMeta:
     name: str = ""
+    # apiserver semantics: when name is empty, the server appends a random
+    # 5-char suffix to generate_name at admission (metav1.ObjectMeta).
+    generate_name: str = ""
     namespace: str = "default"
     uid: str = ""
     labels: dict[str, str] = field(default_factory=dict)

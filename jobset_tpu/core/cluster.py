@@ -266,6 +266,11 @@ class Cluster:
     # ------------------------------------------------------------------
 
     def create_jobset(self, js: JobSet) -> JobSet:
+        # apiserver generateName semantics (metav1): with no name set, the
+        # server appends a random suffix; name-length validation then runs
+        # against the generated name (DNS-1035 math includes the suffix).
+        if not js.metadata.name and js.metadata.generate_name:
+            js.metadata.name = f"{js.metadata.generate_name}{self.pod_suffix()}"
         key = (js.metadata.namespace, js.metadata.name)
         if key in self.jobsets:
             raise AdmissionError(f"jobset {key} already exists")

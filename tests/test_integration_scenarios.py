@@ -276,6 +276,31 @@ def test_success_policy_all_with_empty_target_list_targets_every_rjob():
     )
 
 
+def test_generate_name_jobset_gets_service_named_after_generated_name():
+    """Reference entry "jobset using generateName with enableDNSHostnames
+    should have headless service name set to the jobset name"
+    (jobset_controller_test.go:1119): the apiserver-analog generates the
+    name at admission; the headless service follows the generated name."""
+    cluster = make_cluster()
+    js = _jobset("ignored")
+    js.metadata.name = ""
+    js.metadata.generate_name = "gen-"
+    created = cluster.create_jobset(js)
+    assert created.metadata.name.startswith("gen-")
+    assert len(created.metadata.name) > len("gen-")
+    cluster.run_until_stable()
+
+    # Default subdomain (and so the service) = the generated jobset name.
+    assert ("default", created.metadata.name) in cluster.services
+    pod = next(iter(cluster.pods.values()))
+    assert pod.spec.subdomain == created.metadata.name
+    # Round-trips through the wire format.
+    from jobset_tpu import api
+
+    again = api.from_dict(api.to_dict(created))
+    assert again.metadata.name == created.metadata.name
+
+
 def test_in_order_startup_reapplied_after_gang_restart():
     """Reference entry "startupPolicy with InOrder; success policy restart"
     (jobset_controller_test.go:1408): after a gang restart the InOrder gate
