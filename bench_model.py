@@ -25,6 +25,10 @@ def main() -> int:
     parser.add_argument("--n-layers", type=int, default=8)
     parser.add_argument("--n-heads", type=int, default=16)
     parser.add_argument("--d-ff", type=int, default=4096)
+    parser.add_argument(
+        "--decode", action="store_true",
+        help="also measure serving-path KV-cache decode tokens/s",
+    )
     args = parser.parse_args()
 
     from bench import _cpu_forced, _force_cpu
@@ -49,6 +53,10 @@ def main() -> int:
         seq_len=args.seq_len,
         config=cfg,
     )
+    if args.decode:
+        from jobset_tpu.runtime.model_bench import run_decode_bench
+
+        result["decode"] = run_decode_bench(config=cfg)
     value = result["mfu_pct"] if result["mfu_pct"] is not None else result[
         "achieved_tflops"
     ]

@@ -157,3 +157,59 @@ def run_model_bench(
         "mfu_pct": round(100 * achieved / peak, 2) if peak else None,
         "final_loss": float(loss),
     }
+
+
+def run_decode_bench(
+    batch: int = 8,
+    prompt_len: int = 32,
+    max_new_tokens: int = 96,
+    config: Optional[Any] = None,
+) -> dict:
+    """Serving-path benchmark: greedy KV-cache decode throughput.
+
+    Reports generated tokens/s (batch * max_new_tokens / wall time after a
+    compile/warm pass) through `models.decode.build_generate` on a
+    single-chip serving mesh — the latency-bound regime where per-token
+    matmuls are [B, d] x [d, *] and the KV cache is the working set, i.e.
+    the opposite end of the roofline from the training MFU number."""
+    import jax
+
+    from ..models import transformer
+    from ..models.decode import build_generate
+    from ..parallel.mesh import MeshConfig, build_mesh
+
+    devices = jax.devices()
+    mesh = build_mesh(MeshConfig(), devices=devices[:1], allow_submesh=True)
+    cfg = config or transformer.TransformerConfig(
+        vocab_size=32000,
+        d_model=1024,
+        n_heads=16,
+        d_ff=4096,
+        n_layers=8,
+        max_seq_len=prompt_len + max_new_tokens,
+    )
+    params = transformer.init_params(jax.random.key(0), cfg, mesh)
+    generate = build_generate(cfg, mesh, max_new_tokens)
+    prompt = jax.random.randint(
+        jax.random.key(1), (batch, prompt_len), 0, cfg.vocab_size
+    )
+
+    out = generate(params, prompt)  # compile + warm
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    out = generate(params, prompt)
+    jax.block_until_ready(out)
+    elapsed = time.perf_counter() - t0
+
+    new_tokens = batch * max_new_tokens
+    return {
+        "phase": "decode",
+        "backend": jax.default_backend(),
+        "device_kind": devices[0].device_kind,
+        "batch": batch,
+        "prompt_len": prompt_len,
+        "max_new_tokens": max_new_tokens,
+        "params_m": round(matmul_param_count(cfg) / 1e6, 1),
+        "decode_tokens_per_sec": round(new_tokens / elapsed, 1),
+        "per_token_latency_ms": round(1000 * elapsed / (prompt_len + max_new_tokens), 3),
+    }
