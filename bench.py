@@ -173,16 +173,20 @@ def build_jobset(replicas: int, pods_per_job: int, topology_key: str):
 
 def run_recovery(cluster, js, total_pods: int) -> tuple[float, float]:
     """Fail one job -> gang restart -> measure wall time until every
-    replacement pod is bound, twice: the first recovery right after initial
-    placement (cold interpreter caches) and a second one (the steady state a
-    long-running controller operates in). The reconcile-latency histogram is
-    reset between the two so the reported p99 reflects steady state, not
-    one-time process warmup landing in a single pass.
-    Returns (cold, steady) pods/s."""
+    replacement pod is bound: once right after initial placement (cold
+    interpreter caches) and then three steady-state reps (the operating
+    point of a long-running controller), reported as their median so one
+    scheduler hiccup or GC pause doesn't decide the headline. The
+    reconcile-latency histogram is reset after the cold rep so the
+    reported p99 reflects steady state, not one-time process warmup
+    landing in a single pass.
+    Returns (cold, steady-median) pods/s."""
+    import statistics
+
     from jobset_tpu.core import metrics
 
     rates = []
-    for _ in range(2):
+    for _ in range(4):
         metrics.reset()
         cluster.fail_job("default", "bench-workers-0")
         t0 = time.perf_counter()
@@ -194,7 +198,7 @@ def run_recovery(cluster, js, total_pods: int) -> tuple[float, float]:
                 f"recovery incomplete: {bound}/{total_pods} pods bound"
             )
         rates.append(total_pods / elapsed)
-    return rates[0], rates[1]
+    return rates[0], statistics.median(rates[1:])
 
 
 def run_mode(solver_on: bool, args) -> dict:
