@@ -186,8 +186,12 @@ def run_recovery(cluster, js, total_pods: int) -> tuple[float, float]:
     from jobset_tpu.core import metrics
 
     rates = []
-    for _ in range(4):
-        metrics.reset()
+    for rep in range(4):
+        if rep <= 1:
+            # Reset after the cold rep so the reported p99 accumulates
+            # across ALL steady reps (one rep's GC pause can't decide it);
+            # the rep-0 reset just drops initial-placement samples.
+            metrics.reset()
         cluster.fail_job("default", "bench-workers-0")
         t0 = time.perf_counter()
         cluster.run_until_stable(max_ticks=1000)

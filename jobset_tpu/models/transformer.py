@@ -535,10 +535,18 @@ def _local_loss_fn(params, inputs, targets, mask, cfg: TransformerConfig, n_micr
     return _reduce(jnp.sum(per_token)), _reduce(count), aux_mean
 
 
-def build_train_step(config: TransformerConfig, mesh: Mesh, optimizer):
+def build_train_step(
+    config: TransformerConfig, mesh: Mesh, optimizer, opt_shardings=None
+):
     """Returns jitted train_step(params, opt_state, batch) -> (params,
     opt_state, loss). Model runs under shard_map with explicit collectives;
-    the elementwise optimizer update runs outside and inherits shardings."""
+    the elementwise optimizer update runs outside and inherits shardings.
+
+    opt_shardings: optional NamedSharding tree for the optimizer state
+    (see `parallel.zero.init_zero1_opt_state`) — constrains each step's
+    new state onto it so Adam m/v stay physically sharded across `dp`
+    (ZeRO-1) instead of replicated; XLA partitions the update and inserts
+    the gather of the sharded parameter updates."""
     cfg = config
     specs = param_specs(cfg)
     n_micro = cfg.n_microbatches or axis_size(mesh, "pp")
@@ -574,6 +582,10 @@ def build_train_step(config: TransformerConfig, mesh: Mesh, optimizer):
             params, batch["inputs"], batch["targets"], mask.astype(jnp.float32)
         )
         updates, new_opt_state = optimizer.update(grads, opt_state, params)
+        if opt_shardings is not None:
+            new_opt_state = jax.lax.with_sharding_constraint(
+                new_opt_state, opt_shardings
+            )
         new_params = jax.tree.map(
             lambda p, u: (p + u).astype(p.dtype), params, updates
         )

@@ -12,8 +12,11 @@ from .mesh import (
 )
 from .pipeline import pipeline_apply
 from .ring_attention import ring_attention
+from .zero import init_zero1_opt_state, zero1_opt_shardings
 
 __all__ = [
+    "init_zero1_opt_state",
+    "zero1_opt_shardings",
     "AXIS_NAMES",
     "MeshConfig",
     "axis_size",

@@ -1,0 +1,90 @@
+"""ZeRO-1-style distributed optimizer state.
+
+The reference framework has no numerics; this is greenfield TPU-plane
+capability (SURVEY.md §2.2). On a mesh with dp > 1, model parameters are
+replicated across the `dp` axis, and so — by default — is the optimizer
+state (Adam's m/v are 2x the parameter memory). ZeRO-1 shards that state
+across data-parallel ranks.
+
+TPU-idiomatic implementation: the optimizer update already runs under
+`jit` (GSPMD), so sharding the state is purely a *placement* decision —
+assign each state leaf a NamedSharding that spreads one of its
+currently-unsharded dimensions over `dp`, and XLA partitions the update
+computation and inserts the collectives (each dp rank updates its 1/dp
+slice from the already-reduced gradients; the parameter add gathers the
+sharded updates). No hand-written reduce_scatter/all_gather, no change
+to the model's shard_map.
+
+Composes with tp/pp/sp/ep: only dimensions the parameter sharding left
+unsharded are given to dp, so a [d, 4d] weight column-sharded over tp
+gets its d-rows split over dp, etc. Leaves with no dp-divisible free
+dimension stay replicated (they are by construction small).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import axis_size
+
+
+def _widen_spec(spec, shape, dp: int, axis: str):
+    """Add `axis` to the first unsharded dimension divisible by dp."""
+    parts = list(spec) if spec is not None else []
+    parts += [None] * (len(shape) - len(parts))
+    if dp > 1:
+        for i, (part, dim) in enumerate(zip(parts, shape)):
+            if part is None and dim % dp == 0 and dim > 0:
+                parts[i] = axis
+                break
+    return P(*parts)
+
+
+def zero1_opt_shardings(
+    opt_state: Any, params: Any, specs: Any, mesh: Mesh, axis: str = "dp"
+):
+    """NamedSharding tree for `opt_state` with parameter-shaped subtrees
+    (Adam m/v, momentum traces, ...) sharded over `axis`.
+
+    Walks the optimizer state; any subtree whose structure matches the
+    params pytree gets per-leaf shardings derived from the parameter
+    specs widened onto `axis`; everything else (step counters, empty
+    states) stays replicated.
+    """
+    dp = axis_size(mesh, axis)
+    pdef = jax.tree.structure(params)
+    param_shardings = jax.tree.map(
+        lambda sp, p: NamedSharding(mesh, _widen_spec(sp, p.shape, dp, axis)),
+        specs,
+        params,
+    )
+    replicated = NamedSharding(mesh, P())
+
+    def is_param_subtree(node) -> bool:
+        try:
+            return jax.tree.structure(node) == pdef
+        except Exception:  # noqa: BLE001 — unhashable/exotic nodes: not it
+            return False
+
+    def handle(node):
+        if is_param_subtree(node):
+            return param_shardings
+        return jax.tree.map(lambda _: replicated, node)
+
+    return jax.tree.map(handle, opt_state, is_leaf=is_param_subtree)
+
+
+def init_zero1_opt_state(optimizer, params, specs, mesh: Mesh, axis: str = "dp"):
+    """Initialize optimizer state placed with ZeRO-1 shardings.
+
+    Returns (opt_state, shardings); pass the shardings to
+    `build_train_step(..., opt_shardings=...)` so every step's new state
+    is constrained back onto them (and XLA keeps m/v physically sharded
+    across `axis` instead of replicated).
+    """
+    state = optimizer.init(params)
+    shardings = zero1_opt_shardings(state, params, specs, mesh, axis)
+    return jax.device_put(state, shardings), shardings

@@ -77,6 +77,17 @@ class WorkloadRunner:
             self._mesh = build_mesh()
         return self._mesh
 
+    def mesh_for(self, workload: dict):
+        """Mesh for one workload: the payload's `mesh` mapping (axis sizes,
+        docs/workloads.md) builds a dedicated submesh; otherwise the
+        runner's default mesh."""
+        spec = workload.get("mesh")
+        if not spec:
+            return self.mesh()
+        from ..parallel.mesh import MeshConfig, build_mesh
+
+        return build_mesh(MeshConfig(**spec), allow_submesh=True)
+
     def gang_ready(self, js: JobSet) -> bool:
         """All expected pods of every replicated job are Running+Ready."""
         expected = sum(
@@ -218,15 +229,20 @@ class WorkloadRunner:
         return losses
 
     def _fit(self, js, workload, mesh, params, optimizer, train_step,
-             make_batch, batch_sharding=None) -> None:
+             make_batch, batch_sharding=None, opt_state=None) -> None:
         """Shared training tail: mesh-placed optimizer state (orbax restores
         onto the template's shardings), the prefetching step/checkpoint
         loop, and loss recording — one place for the state/checkpoint-
         placement contract. `make_batch` returns host arrays;
-        `batch_sharding` is where the pipeline lands them."""
+        `batch_sharding` is where the pipeline lands them. A pre-placed
+        `opt_state` (e.g. ZeRO-1-sharded) overrides the default
+        mesh-replicated init."""
         state = {
             "params": params,
-            "opt_state": place_on_mesh(optimizer.init(params), mesh),
+            "opt_state": (
+                opt_state if opt_state is not None
+                else place_on_mesh(optimizer.init(params), mesh)
+            ),
         }
         losses = self._run_loop(
             js, workload, state, train_step, make_batch, batch_sharding
@@ -241,7 +257,7 @@ class WorkloadRunner:
         from ..models import mlp
 
         cfg = mlp.MLPConfig(**workload.get("config", {}))
-        mesh = self.mesh()
+        mesh = self.mesh_for(workload)
         params = place_on_mesh(mlp.init_params(jax.random.key(0), cfg), mesh)
         optimizer = optax.adam(float(workload.get("learning_rate", 1e-2)))
         train_step = mlp.build_train_step(cfg, mesh, optimizer)
@@ -269,7 +285,7 @@ class WorkloadRunner:
 
         from ..models import cnn
 
-        mesh = self.mesh()
+        mesh = self.mesh_for(workload)
         cfg = cnn.CNNConfig(**{
             k: tuple(v) if k == "widths" else v
             for k, v in workload.get("config", {}).items()
@@ -303,7 +319,7 @@ class WorkloadRunner:
         from ..models import TransformerConfig, build_train_step, init_params
         from ..parallel.mesh import MeshConfig
 
-        mesh = self.mesh()
+        mesh = self.mesh_for(workload)
         overrides = dict(workload.get("config", {}))
         overrides.setdefault("dtype", jnp.float32)
         cfg = TransformerConfig(**overrides)
@@ -313,7 +329,21 @@ class WorkloadRunner:
 
         params = init_params(jax.random.key(0), cfg, mesh)
         optimizer = optax.adamw(float(workload.get("learning_rate", 1e-3)))
-        train_step = build_train_step(cfg, mesh, optimizer)
+        opt_state = None
+        if workload.get("zero1"):
+            # ZeRO-1: Adam m/v shard over dp instead of replicating
+            # (parallel/zero.py); the train step pins the shardings.
+            from ..models.transformer import param_specs
+            from ..parallel.zero import init_zero1_opt_state
+
+            opt_state, opt_shardings = init_zero1_opt_state(
+                optimizer, params, param_specs(cfg), mesh
+            )
+            train_step = build_train_step(
+                cfg, mesh, optimizer, opt_shardings=opt_shardings
+            )
+        else:
+            train_step = build_train_step(cfg, mesh, optimizer)
 
         batch_size = int(workload.get("batch_size", 4))
         seq_len = int(workload.get("seq_len", 16))
@@ -327,7 +357,8 @@ class WorkloadRunner:
             }
 
         self._fit(js, workload, mesh, params, optimizer, train_step,
-                  make_batch, NamedSharding(mesh, P("dp", "sp")))
+                  make_batch, NamedSharding(mesh, P("dp", "sp")),
+                  opt_state=opt_state)
 
 
 def _record_losses(js, losses) -> None:

@@ -73,6 +73,32 @@ def test_lm_workload_trains_to_completion():
     assert js.status.terminal_state == keys.JOBSET_COMPLETED
 
 
+def test_lm_workload_with_zero1_optimizer_sharding():
+    """`zero1: true` routes through parallel/zero.py: training completes
+    and records losses with the dp-sharded optimizer state."""
+    cluster, js, runner = build(
+        {
+            "kind": "lm",
+            "steps": 2,
+            "batch_size": 4,
+            "seq_len": 16,
+            "zero1": True,
+            "mesh": {"dp": 2, "tp": 2},
+            "config": {
+                "vocab_size": 64,
+                "d_model": 32,
+                "n_heads": 4,
+                "d_ff": 64,
+                "n_layers": 2,
+                "remat": False,
+            },
+        }
+    )
+    runner.run_pending()
+    assert js.status.terminal_state == keys.JOBSET_COMPLETED
+    assert "tpu.jobset.x-k8s.io/final-loss" in js.metadata.annotations
+
+
 def test_workload_runs_once_per_incarnation():
     cluster, js, runner = build({"kind": "mlp", "steps": 3})
     assert runner.run_pending() == ["train"]
