@@ -536,7 +536,11 @@ def _local_loss_fn(params, inputs, targets, mask, cfg: TransformerConfig, n_micr
 
 
 def build_train_step(
-    config: TransformerConfig, mesh: Mesh, optimizer, opt_shardings=None
+    config: TransformerConfig,
+    mesh: Mesh,
+    optimizer,
+    opt_shardings=None,
+    accum_steps: int = 1,
 ):
     """Returns jitted train_step(params, opt_state, batch) -> (params,
     opt_state, loss). Model runs under shard_map with explicit collectives;
@@ -546,7 +550,14 @@ def build_train_step(
     (see `parallel.zero.init_zero1_opt_state`) — constrains each step's
     new state onto it so Adam m/v stay physically sharded across `dp`
     (ZeRO-1) instead of replicated; XLA partitions the update and inserts
-    the gather of the sharded parameter updates."""
+    the gather of the sharded parameter updates.
+
+    accum_steps: gradient accumulation — the batch's leading dimension is
+    split into `accum_steps` equal chunks run sequentially under
+    `lax.scan`, their gradients averaged before ONE optimizer update.
+    With equal-sized, fully-masked chunks this is numerically the
+    full-batch step (differential-tested), at 1/accum_steps the
+    activation memory."""
     cfg = config
     specs = param_specs(cfg)
     n_micro = cfg.n_microbatches or axis_size(mesh, "pp")
@@ -578,9 +589,36 @@ def build_train_step(
         mask = batch.get("mask")
         if mask is None:
             mask = jnp.ones_like(batch["targets"], jnp.float32)
-        loss, grads = sharded_grads(
-            params, batch["inputs"], batch["targets"], mask.astype(jnp.float32)
-        )
+        mask = mask.astype(jnp.float32)
+        if accum_steps > 1:
+            b = batch["inputs"].shape[0]
+            if b % accum_steps:
+                raise ValueError(
+                    f"batch {b} not divisible by accum_steps {accum_steps}"
+                )
+            chunk = lambda a: a.reshape(accum_steps, b // accum_steps, *a.shape[1:])
+            chunks = (chunk(batch["inputs"]), chunk(batch["targets"]), chunk(mask))
+
+            def accum(carry, xs):
+                inp, tgt, msk = xs
+                loss_k, grads_k = sharded_grads(params, inp, tgt, msk)
+                loss_acc, grads_acc = carry
+                return (
+                    loss_acc + loss_k,
+                    jax.tree.map(jnp.add, grads_acc, grads_k),
+                ), None
+
+            zeros = jax.tree.map(jnp.zeros_like, params)
+            (loss, grads), _ = lax.scan(
+                accum, (jnp.zeros((), jnp.float32), zeros), chunks
+            )
+            inv = 1.0 / accum_steps
+            loss = loss * inv
+            grads = jax.tree.map(lambda g: g * inv, grads)
+        else:
+            loss, grads = sharded_grads(
+                params, batch["inputs"], batch["targets"], mask
+            )
         updates, new_opt_state = optimizer.update(grads, opt_state, params)
         if opt_shardings is not None:
             new_opt_state = jax.lax.with_sharding_constraint(

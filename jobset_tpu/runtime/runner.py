@@ -39,6 +39,31 @@ class WorkloadFailure(Exception):
     """Raised by a workload to simulate a training crash."""
 
 
+def make_learning_rate(workload: dict, default_lr: float):
+    """Learning rate (scalar or optax schedule) from workload knobs:
+    `learning_rate`, `lr_schedule` ("constant" | "cosine"), and
+    `warmup_steps` (linear warmup from 0, applied to either schedule)."""
+    import optax
+
+    lr = float(workload.get("learning_rate", default_lr))
+    warmup = int(workload.get("warmup_steps", 0))
+    schedule = workload.get("lr_schedule", "constant")
+    total = int(workload.get("steps", 10))
+    if schedule == "cosine":
+        return optax.warmup_cosine_decay_schedule(
+            init_value=0.0,
+            peak_value=lr,
+            warmup_steps=warmup,
+            decay_steps=max(total, warmup + 1),
+            end_value=0.0,
+        )
+    if schedule != "constant":
+        raise ValueError(f"unknown lr_schedule: {schedule!r}")
+    if warmup:
+        return optax.linear_schedule(0.0, lr, warmup)
+    return lr
+
+
 def place_on_mesh(tree, mesh):
     """Ensure every leaf lives on `mesh` (replicated unless already mesh-
     placed); checkpoint restore targets the template's shardings, so state
@@ -259,7 +284,7 @@ class WorkloadRunner:
         cfg = mlp.MLPConfig(**workload.get("config", {}))
         mesh = self.mesh_for(workload)
         params = place_on_mesh(mlp.init_params(jax.random.key(0), cfg), mesh)
-        optimizer = optax.adam(float(workload.get("learning_rate", 1e-2)))
+        optimizer = optax.adam(make_learning_rate(workload, 1e-2))
         train_step = mlp.build_train_step(cfg, mesh, optimizer)
 
         batch_size = int(workload.get("batch_size", 32))
@@ -291,7 +316,7 @@ class WorkloadRunner:
             for k, v in workload.get("config", {}).items()
         })
         params = place_on_mesh(cnn.init_params(jax.random.key(0), cfg), mesh)
-        optimizer = optax.adam(float(workload.get("learning_rate", 1e-3)))
+        optimizer = optax.adam(make_learning_rate(workload, 1e-3))
         train_step = cnn.build_train_step(cfg, mesh, optimizer)
 
         batch_size = int(workload.get("batch_size", 8))
@@ -328,7 +353,8 @@ class WorkloadRunner:
         cfg.validate(mesh_cfg)
 
         params = init_params(jax.random.key(0), cfg, mesh)
-        optimizer = optax.adamw(float(workload.get("learning_rate", 1e-3)))
+        optimizer = optax.adamw(make_learning_rate(workload, 1e-3))
+        accum = int(workload.get("accum_steps", 1))
         opt_state = None
         if workload.get("zero1"):
             # ZeRO-1: Adam m/v shard over dp instead of replicating
@@ -340,10 +366,11 @@ class WorkloadRunner:
                 optimizer, params, param_specs(cfg), mesh
             )
             train_step = build_train_step(
-                cfg, mesh, optimizer, opt_shardings=opt_shardings
+                cfg, mesh, optimizer, opt_shardings=opt_shardings,
+                accum_steps=accum,
             )
         else:
-            train_step = build_train_step(cfg, mesh, optimizer)
+            train_step = build_train_step(cfg, mesh, optimizer, accum_steps=accum)
 
         batch_size = int(workload.get("batch_size", 4))
         seq_len = int(workload.get("seq_len", 16))

@@ -99,6 +99,31 @@ def test_lm_workload_with_zero1_optimizer_sharding():
     assert "tpu.jobset.x-k8s.io/final-loss" in js.metadata.annotations
 
 
+def test_lm_workload_with_accum_and_cosine_schedule():
+    """accum_steps + lr_schedule/warmup knobs route through the runner."""
+    cluster, js, runner = build(
+        {
+            "kind": "lm",
+            "steps": 3,
+            "batch_size": 4,
+            "seq_len": 16,
+            "accum_steps": 2,
+            "lr_schedule": "cosine",
+            "warmup_steps": 1,
+            "config": {
+                "vocab_size": 64,
+                "d_model": 32,
+                "n_heads": 4,
+                "d_ff": 64,
+                "n_layers": 2,
+                "remat": False,
+            },
+        }
+    )
+    runner.run_pending()
+    assert js.status.terminal_state == keys.JOBSET_COMPLETED
+
+
 def test_workload_runs_once_per_incarnation():
     cluster, js, runner = build({"kind": "mlp", "steps": 3})
     assert runner.run_pending() == ["train"]
