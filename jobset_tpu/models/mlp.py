@@ -14,7 +14,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
 @dataclass(frozen=True)
@@ -71,6 +71,12 @@ def build_train_step(config: MLPConfig, mesh: Mesh, optimizer):
         loss, grads = sharded(params, batch["x"], batch["y"])
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = jax.tree.map(lambda p, u: p + u, params, updates)
+        # Replicate the scalar across the FULL mesh: without the constraint
+        # XLA may place it on one device, leaving other processes of a
+        # multi-host gang without an addressable shard to read.
+        loss = jax.lax.with_sharding_constraint(
+            loss, NamedSharding(mesh, P())
+        )
         return params, opt_state, loss
 
     return train_step

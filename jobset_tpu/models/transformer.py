@@ -627,6 +627,11 @@ def build_train_step(
         new_params = jax.tree.map(
             lambda p, u: (p + u).astype(p.dtype), params, updates
         )
+        # Full-mesh replication so every process of a multi-host gang holds
+        # an addressable shard of the scalar (see mlp.build_train_step).
+        loss = jax.lax.with_sharding_constraint(
+            loss, NamedSharding(mesh, P())
+        )
         return new_params, new_opt_state, loss
 
     return train_step

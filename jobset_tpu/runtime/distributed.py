@@ -119,7 +119,7 @@ def pod_env_for(cluster, pod) -> dict:
         first = js.spec.replicated_jobs[0].name if js.spec.replicated_jobs else ""
         coordinator = f"{js.name}-{first}-0-0.{get_subdomain(js)}"
 
-    return {
+    env = {
         ENV_JOBSET_NAME: annotations.get(keys.JOBSET_NAME_KEY, ""),
         ENV_REPLICATED_JOB: labels.get(keys.REPLICATED_JOB_NAME_KEY, ""),
         ENV_JOB_INDEX: labels.get(keys.JOB_INDEX_KEY, "0"),
@@ -129,7 +129,17 @@ def pod_env_for(cluster, pod) -> dict:
         ENV_PROCESS_OFFSET: str(process_offset),
         ENV_TOTAL_PROCESSES: str(total),
         ENV_COORDINATOR: coordinator or "",
+        # Gang-restart attempt: fault-injection gating + resume semantics
+        # in the worker entrypoint (runtime.worker).
+        "JOBSET_RESTART_ATTEMPT": labels.get(keys.RESTARTS_KEY, "0"),
     }
+    # The workload payload rides the same contract so the container can run
+    # `python -m jobset_tpu.runtime.worker` with no other configuration.
+    if pod.spec.workload:
+        import json
+
+        env["JOBSET_WORKLOAD"] = json.dumps(pod.spec.workload)
+    return env
 
 
 def initialize(rank: Optional[RankInfo] = None, **kwargs) -> RankInfo:

@@ -176,216 +176,230 @@ class WorkloadRunner:
     # ------------------------------------------------------------------
 
     def _execute(self, js: JobSet, workload: dict) -> None:
-        kind = workload.get("kind", "mlp")
-        if kind == "mlp":
-            self._train_mlp(js, workload)
-        elif kind == "lm":
-            self._train_lm(js, workload)
-        elif kind == "cnn":
-            self._train_cnn(js, workload)
-        else:
-            raise ValueError(f"unknown workload kind: {kind}")
-
-    def _checkpointer(self, workload: dict):
-        from .checkpoint import Checkpointer
-
-        every = int(workload.get("checkpoint_every", 0))
-        if every <= 0:
-            return None, 0
-        directory = workload["checkpoint_dir"]
-        return Checkpointer(directory), every
-
-    def _run_loop(self, js, workload, state, train_step, make_batch,
-                  batch_sharding=None):
-        """Shared step loop: restore -> step -> (maybe fail) -> checkpoint."""
-        import jax
-
-        ckpt, every = self._checkpointer(workload)
-        total_steps = int(workload.get("steps", 10))
-        fail_at = workload.get("fail_at_step")
-        start = 0
-        if ckpt is not None and ckpt.latest_step() is not None:
-            template = jax.tree.map(lambda x: x, state)
-            restored = ckpt.restore({"state": template, "step": 0})
-            state, start = restored["state"], int(restored["step"])
-
-        # Keep the next batches' host->device transfers in flight behind
-        # the running step (runtime.data); rebuilt at the resume step.
-        # make_batch returns host arrays; the pipeline device_puts them
-        # directly into their dp sharding (no single-device funnel).
-        from .data import prefetching_fn
-
-        make_batch = prefetching_fn(
-            make_batch, sharding=batch_sharding, start=start, stop=total_steps
-        )
-
-        # Observability (SURVEY.md §5): a JAX profiler trace is the TPU
-        # plane's analog of the reference's reconcile histograms — opens in
-        # TensorBoard/XProf.
-        import contextlib
-
-        profile_dir = workload.get("profile_dir")
-        profiler = (
-            jax.profiler.trace(profile_dir)
-            if profile_dir
-            else contextlib.nullcontext()
-        )
-
-        losses = []
-        try:
-            with profiler:
-                for step in range(start, total_steps):
-                    if (
-                        fail_at is not None
-                        and js.status.restarts == 0
-                        and step == int(fail_at)
-                    ):
-                        raise WorkloadFailure(f"injected failure at step {step}")
-                    params, opt_state, loss = train_step(
-                        state["params"], state["opt_state"], make_batch(step)
-                    )
-                    state = {"params": params, "opt_state": opt_state}
-                    losses.append(float(loss))
-                    if ckpt is not None and (step + 1) % every == 0:
-                        ckpt.save(step + 1, {"state": state, "step": step + 1})
-        finally:
-            if ckpt is not None:
-                ckpt.close()
-        return losses
-
-    def _fit(self, js, workload, mesh, params, optimizer, train_step,
-             make_batch, batch_sharding=None, opt_state=None) -> None:
-        """Shared training tail: mesh-placed optimizer state (orbax restores
-        onto the template's shardings), the prefetching step/checkpoint
-        loop, and loss recording — one place for the state/checkpoint-
-        placement contract. `make_batch` returns host arrays;
-        `batch_sharding` is where the pipeline lands them. A pre-placed
-        `opt_state` (e.g. ZeRO-1-sharded) overrides the default
-        mesh-replicated init."""
-        state = {
-            "params": params,
-            "opt_state": (
-                opt_state if opt_state is not None
-                else place_on_mesh(optimizer.init(params), mesh)
-            ),
-        }
-        losses = self._run_loop(
-            js, workload, state, train_step, make_batch, batch_sharding
-        )
+        mesh = self.mesh_for(workload)
+        losses = train_workload(workload, mesh, restarts=js.status.restarts)
         _record_losses(js, losses)
 
-    def _train_mlp(self, js, workload: dict) -> None:
-        import jax
-        import jax.numpy as jnp
-        import optax
 
-        from ..models import mlp
+# ---------------------------------------------------------------------------
+# Standalone training engine — shared by the in-process runner above and the
+# real per-pod container entrypoint (`jobset_tpu.runtime.worker`).
+# ---------------------------------------------------------------------------
 
-        cfg = mlp.MLPConfig(**workload.get("config", {}))
-        mesh = self.mesh_for(workload)
-        params = place_on_mesh(mlp.init_params(jax.random.key(0), cfg), mesh)
-        optimizer = optax.adam(make_learning_rate(workload, 1e-2))
-        train_step = mlp.build_train_step(cfg, mesh, optimizer)
 
-        batch_size = int(workload.get("batch_size", 32))
-        rng = np.random.default_rng(0)
-        w_true = rng.standard_normal((cfg.d_in, cfg.d_out))
+def _checkpointer(workload: dict):
+    from .checkpoint import Checkpointer
 
-        def make_batch(step):
-            x = rng.standard_normal((batch_size, cfg.d_in)).astype(np.float32)
-            y = (x @ w_true).astype(np.float32)
-            return {"x": x, "y": y}
+    every = int(workload.get("checkpoint_every", 0))
+    if every <= 0:
+        return None, 0
+    return Checkpointer(workload["checkpoint_dir"]), every
 
-        from jax.sharding import NamedSharding, PartitionSpec as P
 
-        self._fit(js, workload, mesh, params, optimizer, train_step,
-                  make_batch, NamedSharding(mesh, P(("dp", "sp"))))
+def _scalar(x) -> float:
+    """Host float from a (replicated) scalar that may span multiple
+    processes: a multi-host global array cannot be fetched whole, but its
+    local shard carries the identical replicated value."""
+    if hasattr(x, "is_fully_addressable") and not x.is_fully_addressable:
+        import numpy as np
 
-    def _train_cnn(self, js, workload: dict) -> None:
-        """Vision family (the reference's pytorch cnn/resnet examples):
-        data-parallel ResNet-style training on synthetic images."""
-        import jax
-        import jax.numpy as jnp
-        import optax
+        return float(np.asarray(x.addressable_data(0)))
+    return float(x)
 
-        from ..models import cnn
 
-        mesh = self.mesh_for(workload)
-        cfg = cnn.CNNConfig(**{
-            k: tuple(v) if k == "widths" else v
-            for k, v in workload.get("config", {}).items()
-        })
-        params = place_on_mesh(cnn.init_params(jax.random.key(0), cfg), mesh)
-        optimizer = optax.adam(make_learning_rate(workload, 1e-3))
-        train_step = cnn.build_train_step(cfg, mesh, optimizer)
+def _run_loop(workload, state, train_step, make_batch,
+              batch_sharding=None, restarts: int = 0):
+    """Shared step loop: restore -> step -> (maybe fail) -> checkpoint."""
+    import jax
 
-        batch_size = int(workload.get("batch_size", 8))
-        image_size = int(workload.get("image_size", 32))
-        rng = np.random.default_rng(0)
+    ckpt, every = _checkpointer(workload)
+    total_steps = int(workload.get("steps", 10))
+    fail_at = workload.get("fail_at_step")
+    start = 0
+    if ckpt is not None and ckpt.latest_step() is not None:
+        template = jax.tree.map(lambda x: x, state)
+        restored = ckpt.restore({"state": template, "step": 0})
+        state, start = restored["state"], int(restored["step"])
 
-        def make_batch(step):
-            images = rng.standard_normal(
-                (batch_size, image_size, image_size, cfg.in_channels)
-            ).astype(np.float32)
-            labels = rng.integers(0, cfg.num_classes, (batch_size,))
-            return {"images": images, "labels": labels}
+    # Keep the next batches' host->device transfers in flight behind
+    # the running step (runtime.data); rebuilt at the resume step.
+    # make_batch returns host arrays; the pipeline device_puts them
+    # directly into their dp sharding (no single-device funnel).
+    from .data import prefetching_fn
 
-        from jax.sharding import NamedSharding, PartitionSpec as P
+    make_batch = prefetching_fn(
+        make_batch, sharding=batch_sharding, start=start, stop=total_steps
+    )
 
-        self._fit(js, workload, mesh, params, optimizer, train_step,
-                  make_batch, NamedSharding(mesh, P("dp")))
+    # Observability (SURVEY.md §5): a JAX profiler trace is the TPU
+    # plane's analog of the reference's reconcile histograms — opens in
+    # TensorBoard/XProf.
+    import contextlib
 
-    def _train_lm(self, js, workload: dict) -> None:
-        import jax
-        import jax.numpy as jnp
-        import optax
-        from jax.sharding import NamedSharding, PartitionSpec as P
+    profile_dir = workload.get("profile_dir")
+    profiler = (
+        jax.profiler.trace(profile_dir)
+        if profile_dir
+        else contextlib.nullcontext()
+    )
 
-        from ..models import TransformerConfig, build_train_step, init_params
-        from ..parallel.mesh import MeshConfig
+    losses = []
+    try:
+        with profiler:
+            for step in range(start, total_steps):
+                if (
+                    fail_at is not None
+                    and restarts == 0
+                    and step == int(fail_at)
+                ):
+                    raise WorkloadFailure(f"injected failure at step {step}")
+                params, opt_state, loss = train_step(
+                    state["params"], state["opt_state"], make_batch(step)
+                )
+                state = {"params": params, "opt_state": opt_state}
+                losses.append(_scalar(loss))
+                if ckpt is not None and (step + 1) % every == 0:
+                    ckpt.save(step + 1, {"state": state, "step": step + 1})
+    finally:
+        if ckpt is not None:
+            ckpt.close()
+    return losses
 
-        mesh = self.mesh_for(workload)
-        overrides = dict(workload.get("config", {}))
-        overrides.setdefault("dtype", jnp.float32)
-        cfg = TransformerConfig(**overrides)
-        # Validate against the mesh actually in use, not a re-factored one.
-        mesh_cfg = MeshConfig(**{name: mesh.shape[name] for name in mesh.axis_names})
-        cfg.validate(mesh_cfg)
 
-        params = init_params(jax.random.key(0), cfg, mesh)
-        optimizer = optax.adamw(make_learning_rate(workload, 1e-3))
-        accum = int(workload.get("accum_steps", 1))
-        opt_state = None
-        if workload.get("zero1"):
-            # ZeRO-1: Adam m/v shard over dp instead of replicating
-            # (parallel/zero.py); the train step pins the shardings.
-            from ..models.transformer import param_specs
-            from ..parallel.zero import init_zero1_opt_state
+def _setup_mlp(workload: dict, mesh):
+    import jax
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
 
-            opt_state, opt_shardings = init_zero1_opt_state(
-                optimizer, params, param_specs(cfg), mesh
-            )
-            train_step = build_train_step(
-                cfg, mesh, optimizer, opt_shardings=opt_shardings,
-                accum_steps=accum,
-            )
-        else:
-            train_step = build_train_step(cfg, mesh, optimizer, accum_steps=accum)
+    from ..models import mlp
 
-        batch_size = int(workload.get("batch_size", 4))
-        seq_len = int(workload.get("seq_len", 16))
-        rng = np.random.default_rng(0)
+    cfg = mlp.MLPConfig(**workload.get("config", {}))
+    params = place_on_mesh(mlp.init_params(jax.random.key(0), cfg), mesh)
+    optimizer = optax.adam(make_learning_rate(workload, 1e-2))
+    train_step = mlp.build_train_step(cfg, mesh, optimizer)
 
-        def make_batch(step):
-            tokens = rng.integers(0, cfg.vocab_size, (batch_size, seq_len + 1))
-            return {
-                "inputs": np.ascontiguousarray(tokens[:, :-1]),
-                "targets": np.ascontiguousarray(tokens[:, 1:]),
-            }
+    batch_size = int(workload.get("batch_size", 32))
+    rng = np.random.default_rng(0)
+    w_true = rng.standard_normal((cfg.d_in, cfg.d_out))
 
-        self._fit(js, workload, mesh, params, optimizer, train_step,
-                  make_batch, NamedSharding(mesh, P("dp", "sp")),
-                  opt_state=opt_state)
+    def make_batch(step):
+        x = rng.standard_normal((batch_size, cfg.d_in)).astype(np.float32)
+        y = (x @ w_true).astype(np.float32)
+        return {"x": x, "y": y}
+
+    return (params, optimizer, train_step, make_batch,
+            NamedSharding(mesh, P(("dp", "sp"))), None)
+
+
+def _setup_cnn(workload: dict, mesh):
+    """Vision family (the reference's pytorch cnn/resnet examples):
+    data-parallel ResNet-style training on synthetic images."""
+    import jax
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..models import cnn
+
+    cfg = cnn.CNNConfig(**{
+        k: tuple(v) if k == "widths" else v
+        for k, v in workload.get("config", {}).items()
+    })
+    params = place_on_mesh(cnn.init_params(jax.random.key(0), cfg), mesh)
+    optimizer = optax.adam(make_learning_rate(workload, 1e-3))
+    train_step = cnn.build_train_step(cfg, mesh, optimizer)
+
+    batch_size = int(workload.get("batch_size", 8))
+    image_size = int(workload.get("image_size", 32))
+    rng = np.random.default_rng(0)
+
+    def make_batch(step):
+        images = rng.standard_normal(
+            (batch_size, image_size, image_size, cfg.in_channels)
+        ).astype(np.float32)
+        labels = rng.integers(0, cfg.num_classes, (batch_size,))
+        return {"images": images, "labels": labels}
+
+    return (params, optimizer, train_step, make_batch,
+            NamedSharding(mesh, P("dp")), None)
+
+
+def _setup_lm(workload: dict, mesh):
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..models import TransformerConfig, build_train_step, init_params
+    from ..parallel.mesh import MeshConfig
+
+    overrides = dict(workload.get("config", {}))
+    overrides.setdefault("dtype", jnp.float32)
+    cfg = TransformerConfig(**overrides)
+    # Validate against the mesh actually in use, not a re-factored one.
+    mesh_cfg = MeshConfig(**{name: mesh.shape[name] for name in mesh.axis_names})
+    cfg.validate(mesh_cfg)
+
+    params = init_params(jax.random.key(0), cfg, mesh)
+    optimizer = optax.adamw(make_learning_rate(workload, 1e-3))
+    accum = int(workload.get("accum_steps", 1))
+    opt_state = None
+    if workload.get("zero1"):
+        # ZeRO-1: Adam m/v shard over dp instead of replicating
+        # (parallel/zero.py); the train step pins the shardings.
+        from ..models.transformer import param_specs
+        from ..parallel.zero import init_zero1_opt_state
+
+        opt_state, opt_shardings = init_zero1_opt_state(
+            optimizer, params, param_specs(cfg), mesh
+        )
+        train_step = build_train_step(
+            cfg, mesh, optimizer, opt_shardings=opt_shardings,
+            accum_steps=accum,
+        )
+    else:
+        train_step = build_train_step(cfg, mesh, optimizer, accum_steps=accum)
+
+    batch_size = int(workload.get("batch_size", 4))
+    seq_len = int(workload.get("seq_len", 16))
+    rng = np.random.default_rng(0)
+
+    def make_batch(step):
+        tokens = rng.integers(0, cfg.vocab_size, (batch_size, seq_len + 1))
+        return {
+            "inputs": np.ascontiguousarray(tokens[:, :-1]),
+            "targets": np.ascontiguousarray(tokens[:, 1:]),
+        }
+
+    return (params, optimizer, train_step, make_batch,
+            NamedSharding(mesh, P("dp", "sp")), opt_state)
+
+
+_SETUPS = {"mlp": _setup_mlp, "cnn": _setup_cnn, "lm": _setup_lm}
+
+
+def train_workload(workload: dict, mesh, restarts: int = 0) -> list:
+    """Run one workload's full training loop on `mesh`; returns per-step
+    losses. The single training engine behind both execution modes: the
+    simulator's WorkloadRunner and the real per-pod entrypoint
+    (`jobset_tpu.runtime.worker`)."""
+    kind = workload.get("kind", "mlp")
+    setup = _SETUPS.get(kind)
+    if setup is None:
+        raise ValueError(f"unknown workload kind: {kind}")
+    params, optimizer, train_step, make_batch, batch_sharding, opt_state = (
+        setup(workload, mesh)
+    )
+    state = {
+        "params": params,
+        "opt_state": (
+            opt_state if opt_state is not None
+            else place_on_mesh(optimizer.init(params), mesh)
+        ),
+    }
+    return _run_loop(
+        workload, state, train_step, make_batch, batch_sharding,
+        restarts=restarts,
+    )
 
 
 def _record_losses(js, losses) -> None:

@@ -1,0 +1,126 @@
+"""Per-pod container entrypoint: `python -m jobset_tpu.runtime.worker`.
+
+The real-deployment half of the execution story (the simulator's
+WorkloadRunner is the other): each pod's container runs this module, which
+
+1. reads the JobSet rendezvous contract from the environment
+   (`runtime.distributed`, the analog of torchrun consuming MASTER_ADDR in
+   the reference's pytorch example) and boots `jax.distributed`, so
+   `jax.devices()` spans every pod in the gang;
+2. reads the workload payload (the pod template's `spec.workload` mapping,
+   docs/workloads.md) from `$JOBSET_WORKLOAD` (JSON) or `--workload-file`;
+3. lays the five-axis mesh over the gang's global devices (the payload's
+   `mesh` mapping, or a default factoring of the device count) and runs
+   the same training engine the simulator uses
+   (`runner.train_workload` — one engine, two execution modes);
+4. prints one JSON result line and exits 0, or exits nonzero on a
+   workload failure so the Job controller records the pod failure and the
+   JobSet failure policy decides fail-vs-gang-restart.
+
+The gang-restart counter reaches the pod as `$JOBSET_RESTART_ATTEMPT`
+(the restart-attempt label): `fail_at_step` style fault injection only
+fires on attempt 0, and checkpoint resume picks up where the previous
+incarnation left off.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# Env var carrying the workload payload JSON (stamped into the container
+# env by the deployment manifest alongside the rendezvous vars).
+ENV_WORKLOAD = "JOBSET_WORKLOAD"
+ENV_RESTART_ATTEMPT = "JOBSET_RESTART_ATTEMPT"
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--workload-file", help="path to a JSON workload payload "
+        f"(default: ${ENV_WORKLOAD})",
+    )
+    parser.add_argument(
+        "--cpu", action="store_true",
+        help="force the CPU backend (tests / laptops)",
+    )
+    args = parser.parse_args(argv)
+
+    if args.cpu:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    if args.workload_file:
+        with open(args.workload_file) as f:
+            workload = json.load(f)
+    else:
+        raw = os.environ.get(ENV_WORKLOAD)
+        if not raw:
+            print(
+                f"no workload: set ${ENV_WORKLOAD} or --workload-file",
+                file=sys.stderr,
+            )
+            return 2
+        workload = json.loads(raw)
+
+    from .distributed import initialize
+
+    rank = initialize()  # no-op for single-process gangs
+
+    import jax
+
+    from ..parallel.mesh import MeshConfig, build_mesh, default_mesh_config
+    from .runner import WorkloadFailure, train_workload
+
+    spec = workload.get("mesh")
+    mesh_cfg = (
+        MeshConfig(**spec) if spec else default_mesh_config(jax.device_count())
+    )
+    if jax.process_count() > 1 and mesh_cfg.num_devices != jax.device_count():
+        # A submesh over devices[:n] would park entire processes outside
+        # the mesh (their pods would idle while still gang-scheduled) —
+        # in a multi-process gang the mesh must cover every device.
+        print(
+            f"workload mesh {dict(spec or {})} covers "
+            f"{mesh_cfg.num_devices} devices but the gang has "
+            f"{jax.device_count()}; size the mesh to the gang",
+            file=sys.stderr,
+        )
+        return 2
+    mesh = build_mesh(mesh_cfg, allow_submesh=True)
+
+    restarts = int(os.environ.get(ENV_RESTART_ATTEMPT, "0"))
+    try:
+        losses = train_workload(workload, mesh, restarts=restarts)
+    except WorkloadFailure as exc:
+        print(
+            json.dumps({
+                "process_id": rank.process_id,
+                "failed": str(exc),
+                "restart_attempt": restarts,
+            }),
+            flush=True,
+        )
+        return 1
+
+    print(
+        json.dumps({
+            "process_id": rank.process_id,
+            "world": jax.process_count(),
+            "devices": jax.device_count(),
+            "mesh": dict(mesh.shape),
+            "steps": len(losses),
+            "initial_loss": losses[0] if losses else None,
+            "final_loss": losses[-1] if losses else None,
+        }),
+        flush=True,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -96,6 +96,73 @@ def test_two_process_gang_rendezvous(tmp_path):
         assert r["psum"] == local * 3.0
 
 
+@pytest.mark.timeout(300)
+def test_worker_entrypoint_trains_gang_across_processes(tmp_path):
+    """The REAL per-pod entrypoint (`python -m jobset_tpu.runtime.worker`):
+    the control plane materializes each pod's env (rendezvous + workload
+    payload); two actual OS processes consume it, rendezvous over
+    jax.distributed, lay one dp=2 mesh over the gang's global devices, and
+    train the SAME workload engine the simulator runs — losses must agree
+    across ranks and decrease."""
+    cluster = make_cluster()
+    cluster.add_topology("rack", num_domains=2, nodes_per_domain=2, capacity=8)
+    js = (
+        make_jobset("gang")
+        .replicated_job(
+            make_replicated_job("w").replicas(2).parallelism(1).completions(1).obj()
+        )
+        .obj()
+    )
+    workload = {
+        "kind": "mlp",
+        "steps": 12,
+        "learning_rate": 5e-3,
+        "batch_size": 8,
+        "mesh": {"dp": 2},
+        "config": {"d_in": 4, "d_hidden": 8, "d_out": 2},
+    }
+    js.spec.replicated_jobs[0].template.spec.template.spec.workload = workload
+    cluster.create_jobset(js)
+    cluster.run_until_stable()
+
+    port = _free_port()
+    procs = []
+    for job_idx in range(2):
+        pod = cluster.resolve_hostname("default", f"gang-w-{job_idx}-0.gang")
+        env = pod_env_for(cluster, pod)
+        assert json.loads(env["JOBSET_WORKLOAD"]) == workload
+        env[ENV_COORDINATOR] = f"127.0.0.1:{port}"
+        worker_env = {**os.environ, **env}
+        worker_env.pop("PYTHONPATH", None)  # drop the axon sitecustomize
+        # Drop the conftest's 8-virtual-device XLA_FLAGS: each pod process
+        # contributes ONE device, like a real per-pod worker.
+        worker_env.pop("XLA_FLAGS", None)
+        worker_env["JAX_PLATFORMS"] = "cpu"
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-m", "jobset_tpu.runtime.worker", "--cpu"],
+                env=worker_env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+            )
+        )
+
+    results = []
+    for p in procs:
+        stdout, stderr = p.communicate(timeout=280)
+        assert p.returncode == 0, stderr.decode()[-2000:]
+        results.append(json.loads(stdout.decode().strip().splitlines()[-1]))
+
+    assert sorted(r["process_id"] for r in results) == [0, 1]
+    for r in results:
+        assert r["world"] == 2
+        assert r["mesh"]["dp"] == 2
+        assert r["steps"] == 12
+        assert r["final_loss"] < r["initial_loss"]
+    # SPMD: every rank computes the identical global loss.
+    assert results[0]["final_loss"] == pytest.approx(results[1]["final_loss"])
+
+
 def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
