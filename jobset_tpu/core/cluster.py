@@ -75,7 +75,9 @@ class Cluster:
         self.pods: dict[tuple[str, str], Pod] = {}
         self.services: dict[tuple[str, str], Service] = {}
         self.nodes: dict[str, Node] = {}
-        self.events: list[Event] = []
+        # Bounded like apiserver event retention (TTL there, count here): a
+        # long-running controller must not grow event memory with churn.
+        self.events: deque[Event] = deque(maxlen=10000)
 
         # Field indexes (jobset_controller.go:231-246, pod_controller.go:75-106).
         self.jobs_by_owner: dict[str, set[tuple[str, str]]] = {}

@@ -268,3 +268,61 @@ def test_update_jobset_preserves_status_and_creation_time():
     stored = cluster.get_jobset("default", "js")
     assert stored.status.restarts == 1
     assert stored.metadata.creation_time == 100.0
+
+
+def test_churn_soak_leaves_no_index_residue():
+    """Long-running-controller story: many JobSets through create ->
+    complete -> TTL delete (with some gang restarts and failures mixed in)
+    must leave every kernel index empty — a leak here grows controller
+    memory forever at real-world churn rates."""
+    from jobset_tpu.api import FailurePolicy
+
+    cluster = make_cluster()
+    cluster.add_topology("rack", num_domains=6, nodes_per_domain=4, capacity=16)
+
+    for i in range(30):
+        js = (
+            make_jobset(f"churn-{i}")
+            .failure_policy(FailurePolicy(max_restarts=2))
+            .replicated_job(
+                make_replicated_job("w")
+                .replicas(2).parallelism(2).completions(2).obj()
+            )
+            .obj()
+        )
+        js.spec.ttl_seconds_after_finished = 5
+        cluster.create_jobset(js)
+        cluster.run_until_stable()
+        if i % 3 == 1:  # a restart before completing
+            cluster.fail_job("default", f"churn-{i}-w-0")
+            cluster.run_until_stable()
+        if i % 5 == 4:  # terminal failure path: fail until restarts exhaust
+            while not cluster.jobset_has_condition(
+                cluster.get_jobset("default", f"churn-{i}"), "Failed"
+            ):
+                cluster.fail_job("default", f"churn-{i}-w-0")
+                cluster.run_until_stable()
+        else:
+            cluster.complete_all_jobs(cluster.get_jobset("default", f"churn-{i}"))
+            cluster.run_until_stable()
+        cluster.clock.advance(6)
+        cluster.run_until_stable()
+        assert cluster.get_jobset("default", f"churn-{i}") is None
+
+    assert not cluster.jobsets
+    assert not cluster.jobs
+    assert not cluster.pods
+    assert not cluster.pending_pod_keys
+    assert not cluster.leader_pod_keys
+    assert not cluster.dirty_job_uids
+    assert not cluster.jobs_by_uid
+    # Secondary indexes may keep empty buckets; they must hold no keys.
+    assert not any(cluster.pods_by_job_key.values())
+    assert not any(cluster.pods_by_base_name.values())
+    assert not any(cluster.pods_by_job_uid.values())
+    assert not any(cluster.jobs_by_owner.values())
+    # Domain occupancy fully released.
+    for domains in cluster.domain_job_keys.values():
+        assert not any(domains.values()), domains
+    # Node capacity fully returned.
+    assert all(n.allocated == 0 for n in cluster.nodes.values())
