@@ -98,6 +98,14 @@ def _build_parser() -> argparse.ArgumentParser:
     ln.add_argument("--replicated-job", required=True)
     _add_server_flag(ln)
 
+    w = sub.add_parser(
+        "worker",
+        help="per-pod workload entrypoint (rendezvous + train; "
+             "see docs/workloads.md)",
+    )
+    w.add_argument("--workload-file")
+    w.add_argument("--cpu", action="store_true")
+
     return parser
 
 
@@ -315,6 +323,17 @@ def _cmd_label_nodes(args) -> int:
     return 0
 
 
+def _cmd_worker(args) -> int:
+    from .runtime.worker import main as worker_main
+
+    argv = []
+    if args.workload_file:
+        argv += ["--workload-file", args.workload_file]
+    if args.cpu:
+        argv.append("--cpu")
+    return worker_main(argv)
+
+
 _COMMANDS = {
     "controller": _cmd_controller,
     "solver": _cmd_solver,
@@ -324,6 +343,7 @@ _COMMANDS = {
     "suspend": _cmd_suspend,
     "resume": _cmd_resume,
     "label-nodes": _cmd_label_nodes,
+    "worker": _cmd_worker,
 }
 
 

@@ -67,9 +67,18 @@ def main(argv=None) -> int:
             return 2
         workload = json.loads(raw)
 
-    from .distributed import initialize
+    from .distributed import RankInfo, initialize, rank_from_env
 
-    rank = initialize()  # no-op for single-process gangs
+    try:
+        rank = initialize(rank_from_env())  # no-op for single-process gangs
+    except KeyError:
+        # No rendezvous contract in the environment: standalone run (dev
+        # box, single-pod JobSet without a coordinator) — one process.
+        rank = RankInfo(
+            jobset_name="", replicated_job="", job_index=0,
+            job_global_index=0, pod_index=0, pods_per_job=1,
+            process_offset=0, total_processes=1, coordinator="",
+        )
 
     import jax
 
