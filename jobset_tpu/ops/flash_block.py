@@ -103,7 +103,7 @@ def block_attention_reference(q, k, v, bias):
 
 
 def _flash_block_kernel(
-    q_ref, k_ref, v_ref, bias_ref, max_ref, sum_ref, out_ref,
+    q_ref, k_ref, v_ref, bias_ref, stats_ref, out_ref,
     m_scr, l_scr, acc_scr, *, scale,
 ):
     """Grid cell = (bh, q_tile, kv_tile); the kv axis is minor-most, so TPU
@@ -115,9 +115,17 @@ def _flash_block_kernel(
     k_ref   [1, TILE_K, Dp]      one kv tile of that (batch, head)
     v_ref   [1, TILE_K, Dp]
     bias_ref[TILE_Q, TILE_K]
-    max_ref [1, TILE_Q]          final running max  m_i
-    sum_ref [1, TILE_Q]          final running sum  l_i (unnormalized)
+    stats_ref[1, TILE_Q, 8]      m_i in lanes 0:4, l_i in lanes 4:8
     out_ref [1, TILE_Q, Dp]      final weighted values (unnormalized)
+
+    The running max m_i and unnormalized sum l_i are packed into ONE
+    narrow output (the caller reads columns 0 and 4): TPU lowering
+    requires the last two dims of every block to be (8k, 128m)-tiled OR
+    equal to the full array dims, so a [1, TILE_Q] 2-D block — whose
+    sublane dim is 1 — is rejected by the real lowering (the interpreter
+    accepts it), while a full [TILE_Q, 128] lane-broadcast block per stat
+    would write 128x the useful bytes to HBM. An 8-lane last dim equal to
+    the array's last dim satisfies the tiling rule at 1/16th the traffic.
 
     The online-softmax accumulator lives in VMEM scratch, which persists
     across grid steps of the same (bh, q_tile).
@@ -162,8 +170,9 @@ def _flash_block_kernel(
 
     @pl.when(kt == pl.num_programs(2) - 1)
     def _finalize():
-        max_ref[0, :] = m_scr[:, 0]
-        sum_ref[0, :] = l_scr[:, 0]
+        stats_ref[0] = jnp.concatenate(
+            [m_scr[:, 0:4], l_scr[:, 0:4]], axis=1
+        )
         out_ref[0] = acc_scr[:]
 
 
@@ -218,7 +227,7 @@ def _block_attention_pallas(q, k, v, bias):
     from jax.experimental.pallas import tpu as pltpu
 
     kernel = functools.partial(_flash_block_kernel, scale=scale)
-    block_max, block_sum, weighted = pl.pallas_call(
+    stats, weighted = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -228,13 +237,11 @@ def _block_attention_pallas(q, k, v, bias):
             pl.BlockSpec((_TILE_Q, _TILE_K), lambda bh, qi, kt: (qi, kt)),
         ],
         out_specs=[
-            pl.BlockSpec((1, _TILE_Q), lambda bh, qi, kt: (bh, qi)),
-            pl.BlockSpec((1, _TILE_Q), lambda bh, qi, kt: (bh, qi)),
+            pl.BlockSpec((1, _TILE_Q, 8), lambda bh, qi, kt: (bh, qi, 0)),
             pl.BlockSpec((1, _TILE_Q, d_p), lambda bh, qi, kt: (bh, qi, 0)),
         ],
         out_shape=[
-            out_struct((batch * heads, tq_p)),
-            out_struct((batch * heads, tq_p)),
+            out_struct((batch * heads, tq_p, 8)),
             out_struct((batch * heads, tq_p, d_p)),
         ],
         scratch_shapes=[
@@ -245,8 +252,8 @@ def _block_attention_pallas(q, k, v, bias):
         interpret=_INTERPRET,
     )(qp, kp, vp, bias_p)
 
-    block_max = block_max.reshape(batch, heads, tq_p)[:, :, :tq]
-    block_sum = block_sum.reshape(batch, heads, tq_p)[:, :, :tq]
+    block_max = stats[:, :, 0].reshape(batch, heads, tq_p)[:, :, :tq]
+    block_sum = stats[:, :, 4].reshape(batch, heads, tq_p)[:, :, :tq]
     weighted = weighted.reshape(batch, heads, tq_p, d_p)[:, :, :tq, :dim]
     weighted = jnp.moveaxis(weighted, 1, 2)  # [B, Tq, H, D]
     return block_max, block_sum, weighted

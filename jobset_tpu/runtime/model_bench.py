@@ -8,7 +8,8 @@ Methodology
 -----------
 * Train the flagship decoder-only transformer for `steps` timed steps on the
   available device(s) after `warmup` untimed compile/warm steps, with a
-  `block_until_ready` fence around the timed region only.
+  device-to-host value-fetch fence around the timed region only (see
+  `_fence`: `block_until_ready` is not trustworthy on tunneled backends).
 * FLOPs use the standard training estimate (PaLM appendix B convention):
   6 FLOPs per parameter per token for every matmul parameter (fwd + bwd),
   plus the attention score/context matmuls 12 * L * T * d, halved for
@@ -80,6 +81,22 @@ def train_flops_per_token(cfg, seq_len: int, active_params: Optional[int] = None
     return 6.0 * p + attention
 
 
+def _fence(x) -> None:
+    """Execution fence for timing: a device->host fetch of (an element of)
+    the result. `jax.block_until_ready` alone is NOT a reliable fence on
+    every backend — the tunneled 'axon' TPU platform has been observed
+    returning before the dispatched steps finish, which once inflated the
+    measured MFU ~1000x. A value fetch cannot lie: the bytes must exist.
+    Every leaf is fenced (leaves can come from different dispatches), and
+    each fetch is a device-side one-element slice so the fence cost is
+    dispatch latency, not a transfer proportional to the result size.
+    """
+    import jax
+
+    for leaf in jax.tree_util.tree_leaves(x):
+        jax.device_get(leaf.ravel()[0:1] if getattr(leaf, "ndim", 0) else leaf)
+
+
 def run_model_bench(
     steps: int = 20,
     warmup: int = 3,
@@ -120,14 +137,22 @@ def run_model_bench(
         "mask": jnp.ones((batch, seq_len), jnp.float32),
     }
 
+    # Fence on the loss AND one leaf of the updated params: XLA materializes
+    # all outputs of an executable together, but a backend with per-buffer
+    # readiness could in principle hand back the (tiny) loss while the
+    # optimizer update is still in flight; touching a param leaf closes that
+    # at the cost of one extra O(1) fetch.
+    def fence_step():
+        _fence((loss, jax.tree_util.tree_leaves(params)[:1]))
+
     for _ in range(max(warmup, 1)):
         params, opt_state, loss = train_step(params, opt_state, batch_data)
-    jax.block_until_ready(loss)
+    fence_step()
 
     t0 = time.perf_counter()
     for _ in range(steps):
         params, opt_state, loss = train_step(params, opt_state, batch_data)
-    jax.block_until_ready(loss)
+    fence_step()
     elapsed = time.perf_counter() - t0
 
     tokens_per_step = batch * seq_len
@@ -195,10 +220,10 @@ def run_decode_bench(
     )
 
     out = generate(params, prompt)  # compile + warm
-    jax.block_until_ready(out)
+    _fence(out)
     t0 = time.perf_counter()
     out = generate(params, prompt)
-    jax.block_until_ready(out)
+    _fence(out)
     elapsed = time.perf_counter() - t0
 
     new_tokens = batch * max_new_tokens
