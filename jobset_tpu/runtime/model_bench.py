@@ -143,7 +143,8 @@ def run_model_bench(
     # optimizer update is still in flight; touching a param leaf closes that
     # at the cost of one extra O(1) fetch.
     def fence_step():
-        _fence((loss, jax.tree_util.tree_leaves(params)[:1]))
+        leaves = jax.tree_util.tree_leaves
+        _fence((loss, leaves(params)[:1], leaves(opt_state)[:1]))
 
     for _ in range(max(warmup, 1)):
         params, opt_state, loss = train_step(params, opt_state, batch_data)

@@ -1,0 +1,152 @@
+"""Real-chip regression tests (skipped when no TPU is reachable).
+
+The rest of the suite runs on a virtual CPU mesh (conftest pins the
+process to the CPU backend), which exercises sharding semantics but NOT
+the real TPU lowering: the Pallas interpreter accepts block shapes the
+real Mosaic lowering rejects (that exact gap shipped a kernel that could
+never run on hardware — see flash_block.py's stats-output docstring). So
+these tests spawn clean subprocesses (the axon sitecustomize selects the
+TPU backend there) under hard deadlines, and skip rather than fail when
+the tunneled chip is wedged or absent — CPU-only CI stays green.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+# One shared verdict per pytest session: the probe is slow when the tunnel
+# is wedged (it times out), so run it once, not per-test.
+_PROBE: dict = {}
+
+_PROBE_DEADLINE_S = float(os.environ.get("TPU_TEST_PROBE_DEADLINE_S", "60"))
+_TEST_DEADLINE_S = float(os.environ.get("TPU_TEST_DEADLINE_S", "420"))
+
+
+def _run_clean(code: str, deadline_s: float) -> subprocess.CompletedProcess:
+    """Run python code in a fresh process without the suite's CPU pinning,
+    in its own session so a wedged TPU client can be killed as a group."""
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    # Drop only the conftest's virtual-device forcing; keep any flags the
+    # operator set themselves.
+    env["XLA_FLAGS"] = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-c", textwrap.dedent(code)],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        env=env,
+        start_new_session=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    try:
+        out, _ = proc.communicate(timeout=deadline_s)
+        return subprocess.CompletedProcess(proc.args, proc.returncode, out, "")
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        proc.wait()
+        return subprocess.CompletedProcess(proc.args, -9, "TIMEOUT", "")
+
+
+def _require_tpu() -> None:
+    if "backend" not in _PROBE:
+        res = _run_clean(
+            "import jax; print('BACKEND=' + jax.default_backend())",
+            _PROBE_DEADLINE_S,
+        )
+        line = next(
+            (l for l in res.stdout.splitlines() if l.startswith("BACKEND=")),
+            "BACKEND=unreachable",
+        )
+        _PROBE["backend"] = line.split("=", 1)[1]
+    if _PROBE["backend"] != "tpu":
+        pytest.skip(f"no reachable TPU (probe: {_PROBE['backend']})")
+
+
+def _run_on_tpu(code: str) -> str:
+    res = _run_clean(code, _TEST_DEADLINE_S)
+    if res.returncode == -9 and res.stdout == "TIMEOUT":
+        # The tunnel can wedge between the probe and the test; that is the
+        # environment failing, not the code — keep CI green.
+        pytest.skip("TPU wedged mid-test (subprocess deadline)")
+    assert res.returncode == 0, f"TPU subprocess failed:\n{res.stdout[-4000:]}"
+    return res.stdout
+
+
+def test_flash_kernel_lowers_and_matches_on_tpu():
+    """The Pallas kernel must pass the real Mosaic lowering and agree with
+    the on-TPU jnp reference (both share the MXU's default matmul
+    precision, so the comparison isolates kernel logic from precision)."""
+    _require_tpu()
+    out = _run_on_tpu(
+        """
+        import jax, jax.numpy as jnp, numpy as np
+        assert jax.default_backend() == 'tpu'
+        from jobset_tpu.ops.flash_block import (
+            block_attention, block_attention_reference)
+        rng = np.random.default_rng(0)
+        B, Tq, Tk, H, D = 2, 200, 320, 4, 64  # ragged: exercises padding
+        q = jnp.asarray(rng.standard_normal((B, Tq, H, D)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((B, Tk, H, D)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((B, Tk, H, D)), jnp.float32)
+        bias = jnp.where(
+            jnp.tril(jnp.ones((Tq, Tk)), k=Tk - Tq) > 0, 0.0, -1e30
+        ).astype(jnp.float32)
+        outs = jax.jit(block_attention)(q, k, v, bias)
+        refs = jax.jit(block_attention_reference)(q, k, v, bias)
+        for name, a, b in zip(('max', 'sum', 'weighted'), outs, refs):
+            err = float(jnp.max(jnp.abs(jax.device_get(a) - jax.device_get(b))))
+            assert err < 5e-2, (name, err)
+        print('KERNEL_OK')
+        """
+    )
+    assert "KERNEL_OK" in out
+
+
+def test_train_step_and_decode_run_on_tpu():
+    """One real-chip train step (loss finite and changing) and a short
+    KV-cache decode — the two serving surfaces bench.py measures."""
+    _require_tpu()
+    out = _run_on_tpu(
+        """
+        import jax, jax.numpy as jnp, optax, numpy as np
+        assert jax.default_backend() == 'tpu'
+        from jobset_tpu.models import transformer
+        from jobset_tpu.models.decode import build_generate
+        from jobset_tpu.parallel.mesh import MeshConfig, build_mesh
+        mesh = build_mesh(MeshConfig(), devices=jax.devices()[:1],
+                          allow_submesh=True)
+        cfg = transformer.TransformerConfig(
+            vocab_size=512, d_model=128, n_heads=4, d_ff=256, n_layers=2,
+            max_seq_len=64)
+        params = transformer.init_params(jax.random.key(0), cfg, mesh)
+        opt = optax.adam(1e-2)
+        opt_state = opt.init(params)
+        step = transformer.build_train_step(cfg, mesh, opt)
+        toks = jax.random.randint(jax.random.key(1), (2, 65), 0, 512)
+        batch = {'inputs': toks[:, :-1], 'targets': toks[:, 1:],
+                 'mask': jnp.ones((2, 64), jnp.float32)}
+        losses = []
+        for _ in range(3):
+            params, opt_state, loss = step(params, opt_state, batch)
+            losses.append(float(jax.device_get(loss)))
+        assert all(np.isfinite(l) for l in losses), losses
+        assert losses[-1] < losses[0], losses
+        gen = build_generate(cfg, mesh, max_new_tokens=4)
+        out = jax.device_get(gen(params, toks[:, :8]))
+        assert out.shape[1] >= 12, out.shape
+        print('TRAIN_DECODE_OK', losses)
+        """
+    )
+    assert "TRAIN_DECODE_OK" in out
