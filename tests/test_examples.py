@@ -83,3 +83,32 @@ def test_control_plane_example_reaches_stable_state(path):
         p.metadata.name for p in cluster.pods.values() if not p.spec.node_name
     ]
     assert not unbound, f"{path}: unbound pods {unbound}"
+
+
+def test_external_controller_example_runs():
+    """The SDK/informer walkthrough (examples/external_controller.py, the
+    client-go example analog) must keep working end-to-end: boot server,
+    create via client, observe add/update/delete through the informer."""
+    import subprocess
+    import sys
+
+    script = os.path.join(EXAMPLES, "external_controller.py")
+    res = subprocess.run(
+        [sys.executable, script],
+        capture_output=True,
+        text=True,
+        timeout=90,
+        env={
+            **os.environ,
+            "PYTHONPATH": os.pathsep.join(
+                filter(
+                    None,
+                    [os.path.join(EXAMPLES, ".."),
+                     os.environ.get("PYTHONPATH")],
+                )
+            ),
+        },
+    )
+    assert res.returncode == 0, res.stdout + res.stderr
+    for marker in ("observed add", "observed update", "observed delete", "done"):
+        assert marker in res.stdout, (marker, res.stdout)
