@@ -29,6 +29,11 @@ def main() -> int:
         "--decode", action="store_true",
         help="also measure serving-path KV-cache decode tokens/s",
     )
+    parser.add_argument(
+        "--remat", action="store_true",
+        help="enable per-layer rematerialization (off by default for the "
+             "bench: activations fit, and recompute FLOPs aren't credited)",
+    )
     args = parser.parse_args()
 
     from bench import _cpu_forced, _force_cpu
@@ -45,6 +50,7 @@ def main() -> int:
         n_heads=args.n_heads,
         d_ff=args.d_ff,
         max_seq_len=args.seq_len,
+        remat=args.remat,
     )
     result = run_model_bench(
         steps=args.steps,

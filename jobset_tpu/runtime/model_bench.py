@@ -130,6 +130,10 @@ def run_model_bench(
         d_ff=4096,
         n_layers=8,
         max_seq_len=seq_len,
+        # No remat at bench scale: activations fit comfortably in HBM, and
+        # per-layer recompute would add ~1/3 more forward FLOPs that the
+        # 6*P accounting (rightly) does not credit — pure MFU loss.
+        remat=False,
     )
 
     params = transformer.init_params(jax.random.key(0), cfg, mesh)
@@ -181,6 +185,7 @@ def run_model_bench(
         "n_heads": cfg.n_heads,
         "d_ff": cfg.d_ff,
         "vocab_size": cfg.vocab_size,
+        "remat": bool(cfg.remat),
         "params_m": round(matmul_param_count(cfg) / 1e6, 1),
         "steps": steps,
         "step_time_ms": round(1000 * elapsed / steps, 2),
