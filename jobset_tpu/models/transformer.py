@@ -46,6 +46,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..parallel.mesh import MeshConfig, axis_size, pvary_to, vma_union
 from ..parallel.pipeline import pipeline_apply
 from ..parallel.ring_attention import ring_attention
+from ..parallel.ulysses_attention import ulysses_attention
 
 
 @dataclass(frozen=True)
@@ -74,6 +75,12 @@ class TransformerConfig:
     param_dtype: Any = jnp.float32
     remat: bool = True
     n_microbatches: int = 0  # 0 -> defaults to pp size
+    # Sequence-parallel attention strategy over the sp axis: "ring" rotates
+    # K/V around the torus (head-count-independent sp, O(T_local) K/V
+    # resident); "ulysses" re-shards heads with two all_to_alls (cheaper
+    # collectives for moderate sp, needs n_heads/(tp*sp) >= 1 integral).
+    # Both are exact; see parallel/ulysses_attention.py for the trade-off.
+    attn_impl: str = "ring"
 
     @property
     def head_dim(self) -> int:
@@ -98,6 +105,13 @@ class TransformerConfig:
         if self.moe_top_k > self.n_experts > 0:
             raise ValueError(
                 f"moe_top_k {self.moe_top_k} exceeds n_experts {self.n_experts}"
+            )
+        if self.attn_impl not in ("ring", "ulysses"):
+            raise ValueError(f"unknown attn_impl {self.attn_impl!r}")
+        if self.attn_impl == "ulysses" and (self.n_heads // mc.tp) % mc.sp:
+            raise ValueError(
+                f"ulysses attention requires heads-per-tp-rank "
+                f"({self.n_heads // mc.tp}) divisible by sp ({mc.sp})"
             )
 
 
@@ -239,7 +253,10 @@ def _attention_block(p, x, cfg: TransformerConfig, t_local: int):
     key = rotary(proj(p["wk"]), positions, cfg.rope_theta)
     value = proj(p["wv"])
 
-    attn = ring_attention(q, key, value, "sp", causal=True)
+    if cfg.attn_impl == "ulysses":
+        attn = ulysses_attention(q, key, value, "sp", causal=True)
+    else:
+        attn = ring_attention(q, key, value, "sp", causal=True)
     attn = attn.reshape(*attn.shape[:-2], heads_local * cfg.head_dim)
     out = jnp.einsum("btf,fd->btd", attn.astype(compute), p["wo"].astype(compute))
     out = lax.psum(out, "tp")

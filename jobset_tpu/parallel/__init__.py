@@ -12,6 +12,7 @@ from .mesh import (
 )
 from .pipeline import pipeline_apply
 from .ring_attention import ring_attention
+from .ulysses_attention import ulysses_attention
 from .zero import init_zero1_opt_state, zero1_opt_shardings
 
 __all__ = [
@@ -27,4 +28,5 @@ __all__ = [
     "single_device_mesh",
     "pipeline_apply",
     "ring_attention",
+    "ulysses_attention",
 ]

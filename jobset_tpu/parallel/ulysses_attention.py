@@ -1,0 +1,117 @@
+"""Ulysses-style sequence parallelism: all-to-all head-sharded attention.
+
+The second long-context strategy (SURVEY.md §5 names ring, blockwise, and
+Ulysses-style head-sharding as the greenfield design space; the reference
+has no sequence handling at all). Where `ring_attention` keeps tokens
+sequence-sharded and rotates K/V around the ring (sp-1 neighbor ppermutes),
+Ulysses re-shards *heads*: one `all_to_all` turns the
+[B, T_local, H_local, D] chunks into [B, T, H_local/sp, D] — every rank
+sees the FULL sequence for a slice of the heads — attention runs locally
+and exactly, and a second `all_to_all` restores sequence sharding.
+
+Trade-off (why both exist): Ulysses does 2 activation all-to-alls total,
+independent of sp, vs ring's sp-1 permutes of K/V — cheaper collectives
+for moderate sp on an ICI torus with fast all-to-all — but it requires
+`heads_local % sp == 0` (head count bounds the sp degree) and holds
+full-sequence Q/K/V per rank, while ring scales to head-count-independent
+sp with only O(T_local) K/V resident.
+
+The local attention is the same blockwise online-softmax fold as the ring
+(per-block step = `ops.flash_block.block_attention`), chunked at T_local
+granularity: causal biases stay [T_local, T_local] constants (never a
+[T, T] materialization) and strictly-future (q-chunk, kv-chunk) pairs are
+skipped entirely — the same half-the-block-pairs saving as the ring's
+rotation-index skip.
+
+Runs inside `shard_map`; with sp=1 both all_to_alls are the identity and
+the fold degenerates to the single local block, so the same code path
+serves single-chip runs.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.flash_block import NEG_INF, block_attention as _block_attention
+
+
+def ulysses_attention(q, k, v, axis_name: str, causal: bool = True):
+    """Exact attention with heads re-sharded over `axis_name`.
+
+    q/k/v: [B, T_local, H_local, D] per-rank chunks in ring layout (global
+    positions of rank r cover [r*T_local, (r+1)*T_local), matching
+    `ring_attention` — rotary must already be applied). Requires
+    H_local % sp == 0. Returns [B, T_local, H_local, D].
+    """
+    sp = lax.psum(1, axis_name)
+    out_dtype = q.dtype
+    batch, t_local, heads_local, dim = q.shape
+    if heads_local % sp:
+        raise ValueError(
+            f"ulysses attention requires heads_local ({heads_local}) "
+            f"divisible by sp ({sp}); lower sp/tp or use ring attention"
+        )
+
+    # Reshard in the input dtype (bf16 in training): casting to f32 first
+    # would double the bytes every all_to_all moves. f32 is only needed for
+    # the local softmax statistics, after the gather.
+    def seq_to_heads(x):
+        # [B, T_local, H_local, D] -> [B, T, H_local/sp, D]: split the head
+        # axis across ranks, gather every rank's sequence chunk. tiled=True
+        # concatenates chunks in axis order = the ring layout's global
+        # position order.
+        return lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    qg = seq_to_heads(q).astype(jnp.float32)
+    kg = seq_to_heads(k).astype(jnp.float32)
+    vg = seq_to_heads(v).astype(jnp.float32)
+    heads_u = heads_local // sp
+
+    # Blockwise local attention at T_local granularity — the ring fold
+    # without the ring: for q chunk i, fold kv chunks j <= i (causal) or
+    # all sp chunks (bidirectional). sp is a static axis size, so these
+    # Python loops trace sp*(sp+1)/2 (or sp^2) kernel calls, each over
+    # [T_local, T_local] blocks with constant biases.
+    rel = jnp.arange(t_local)[:, None] - jnp.arange(t_local)[None, :]
+    tri_bias = jnp.where(rel >= 0, 0.0, NEG_INF).astype(jnp.float32)
+    zero_bias = jnp.zeros((t_local, t_local), jnp.float32)
+
+    def chunk(x, j):
+        return lax.dynamic_slice_in_dim(x, j * t_local, t_local, axis=1)
+
+    out_chunks = []
+    for i in range(sp):
+        q_i = chunk(qg, i)
+        acc_max = jnp.full((batch, heads_u, t_local), NEG_INF, jnp.float32)
+        acc_sum = jnp.zeros((batch, heads_u, t_local), jnp.float32)
+        acc_out = jnp.zeros_like(q_i)
+        for j in range(sp):
+            if causal and j > i:
+                continue  # strictly future: skip the whole block pair
+            bias = tri_bias if (causal and j == i) else zero_bias
+            blk_max, blk_sum, blk_out = _block_attention(
+                q_i, chunk(kg, j), chunk(vg, j), bias
+            )
+            new_max = jnp.maximum(acc_max, blk_max)
+            old_scale = jnp.exp(acc_max - new_max)
+            blk_scale = jnp.exp(blk_max - new_max)
+            acc_max = new_max
+            acc_sum = acc_sum * old_scale + blk_sum * blk_scale
+            acc_out = (
+                acc_out * old_scale.transpose(0, 2, 1)[..., None]
+                + blk_out * blk_scale.transpose(0, 2, 1)[..., None]
+            )
+        denom = jnp.maximum(acc_sum, 1e-20).transpose(0, 2, 1)[..., None]
+        out_chunks.append(acc_out / denom)
+
+    out = jnp.concatenate(out_chunks, axis=1).astype(out_dtype)
+
+    def heads_to_seq(x):
+        return lax.all_to_all(
+            x, axis_name, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    return heads_to_seq(out)

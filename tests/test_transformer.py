@@ -298,3 +298,34 @@ def test_routed_moe_forward_on_ep_mesh():
     logits = fwd(params, tokens)
     assert logits.shape == (4, 16, 64)
     assert bool(jnp.isfinite(logits).all())
+
+
+def test_ulysses_training_matches_single_device():
+    """attn_impl='ulysses' is an implementation detail, like the mesh: the
+    sharded loss trajectory must match one device (which must itself be
+    unaffected by the strategy flag — both all_to_alls are identities at
+    sp=1)."""
+    sharded_mc = MeshConfig(sp=2, tp=2)
+    cfg = tiny_config(remat=False, attn_impl="ulysses")
+    cfg.validate(sharded_mc)
+
+    losses = {}
+    for name, mesh in (
+        ("multi", build_mesh(sharded_mc, jax.devices()[:4])),
+        ("single", build_mesh(MeshConfig(), jax.devices()[:1])),
+    ):
+        batch = make_batch(mesh, cfg.vocab_size, seed=9)
+        _, losses[name] = run_steps(cfg, mesh, batch, steps=3, seed=9)
+    np.testing.assert_allclose(losses["multi"], losses["single"], rtol=2e-4)
+
+    ring_cfg = tiny_config(remat=False)  # default ring on the same mesh
+    ring_mesh = build_mesh(sharded_mc, jax.devices()[:4])
+    batch = make_batch(ring_mesh, ring_cfg.vocab_size, seed=9)
+    _, ring_losses = run_steps(ring_cfg, ring_mesh, batch, steps=3, seed=9)
+    np.testing.assert_allclose(losses["multi"], ring_losses, rtol=2e-4)
+
+
+def test_ulysses_validation_rejects_indivisible_heads():
+    cfg = tiny_config(attn_impl="ulysses")  # 4 heads
+    with pytest.raises(ValueError, match="ulysses"):
+        cfg.validate(MeshConfig(sp=4, tp=2))  # heads/tp = 2, not % 4
