@@ -259,6 +259,34 @@ def _block_attention_pallas(q, k, v, bias):
     return block_max, block_sum, weighted
 
 
+def merge_block_stats(acc, blk):
+    """Online-softmax merge of two unnormalized (max, sum, weighted) triples
+    — THE recurrence both sequence-parallel strategies fold with
+    (ring_attention per ppermute step, ulysses_attention per local chunk),
+    shared so their numerics cannot drift apart.
+
+    max/sum are [B, H, Tq]; weighted is [B, Tq, H, D].
+    """
+    acc_max, acc_sum, acc_out = acc
+    blk_max, blk_sum, blk_out = blk
+    new_max = jnp.maximum(acc_max, blk_max)
+    old_scale = jnp.exp(acc_max - new_max)
+    blk_scale = jnp.exp(blk_max - new_max)
+    new_sum = acc_sum * old_scale + blk_sum * blk_scale
+    new_out = (
+        acc_out * old_scale.transpose(0, 2, 1)[..., None]
+        + blk_out * blk_scale.transpose(0, 2, 1)[..., None]
+    )
+    return new_max, new_sum, new_out
+
+
+def normalize_block_stats(acc_sum, acc_out):
+    """Final division of the folded accumulator; clamped so fully-masked
+    rows yield 0 instead of NaN."""
+    denom = jnp.maximum(acc_sum, 1e-20).transpose(0, 2, 1)[..., None]
+    return acc_out / denom
+
+
 # ---------------------------------------------------------------------------
 # Public op with flash-style recompute backward
 # ---------------------------------------------------------------------------

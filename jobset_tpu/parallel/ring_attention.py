@@ -18,7 +18,12 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..ops.flash_block import NEG_INF, block_attention as _block_attention
+from ..ops.flash_block import (
+    NEG_INF,
+    block_attention as _block_attention,
+    merge_block_stats,
+    normalize_block_stats,
+)
 
 
 def ring_attention(q, k, v, axis_name: str, causal: bool = True):
@@ -43,7 +48,6 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True):
     full_mask = jnp.full((t_local, t_local), NEG_INF, q.dtype)
 
     def fold(acc, k_blk, v_blk, r):
-        acc_max, acc_sum, acc_out = acc
         kv_idx = (my_idx - r) % sp  # which global chunk this block holds
 
         if causal:
@@ -55,17 +59,7 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True):
         else:
             bias = zero_bias
 
-        blk_max, blk_sum, blk_out = _block_attention(q, k_blk, v_blk, bias)
-
-        new_max = jnp.maximum(acc_max, blk_max)
-        old_scale = jnp.exp(acc_max - new_max)
-        blk_scale = jnp.exp(blk_max - new_max)
-        acc_sum = acc_sum * old_scale + blk_sum * blk_scale
-        acc_out = (
-            acc_out * old_scale.transpose(0, 2, 1)[..., None]
-            + blk_out * blk_scale.transpose(0, 2, 1)[..., None]
-        )
-        return new_max, acc_sum, acc_out
+        return merge_block_stats(acc, _block_attention(q, k_blk, v_blk, bias))
 
     # Fold the local block first, then sp-1 rotate-then-fold steps — exactly
     # sp-1 neighbor permutes total, none discarded.
@@ -93,5 +87,4 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True):
         (_, _, acc), _ = lax.scan(step, (k, v, acc), jnp.arange(1, sp))
 
     _, acc_sum, acc_out = acc
-    denom = jnp.maximum(acc_sum, 1e-20).transpose(0, 2, 1)[..., None]
-    return (acc_out / denom).astype(out_dtype)
+    return normalize_block_stats(acc_sum, acc_out).astype(out_dtype)

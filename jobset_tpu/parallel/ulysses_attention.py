@@ -33,7 +33,12 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax import lax
 
-from ..ops.flash_block import NEG_INF, block_attention as _block_attention
+from ..ops.flash_block import (
+    NEG_INF,
+    block_attention as _block_attention,
+    merge_block_stats,
+    normalize_block_stats,
+)
 
 
 def ulysses_attention(q, k, v, axis_name: str, causal: bool = True):
@@ -65,9 +70,10 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = True):
             x, axis_name, split_axis=2, concat_axis=1, tiled=True
         )
 
-    qg = seq_to_heads(q).astype(jnp.float32)
-    kg = seq_to_heads(k).astype(jnp.float32)
-    vg = seq_to_heads(v).astype(jnp.float32)
+    # Gathered tensors stay in the input dtype: block_attention upcasts each
+    # chunk internally, so an upfront f32 cast would only double the peak
+    # residency of three full-sequence tensors.
+    qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
     heads_u = heads_local // sp
 
     # Blockwise local attention at T_local granularity — the ring fold
@@ -85,27 +91,19 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = True):
     out_chunks = []
     for i in range(sp):
         q_i = chunk(qg, i)
-        acc_max = jnp.full((batch, heads_u, t_local), NEG_INF, jnp.float32)
-        acc_sum = jnp.zeros((batch, heads_u, t_local), jnp.float32)
-        acc_out = jnp.zeros_like(q_i)
+        acc = (
+            jnp.full((batch, heads_u, t_local), NEG_INF, jnp.float32),
+            jnp.zeros((batch, heads_u, t_local), jnp.float32),
+            jnp.zeros((batch, t_local, heads_u, dim), jnp.float32),
+        )
         for j in range(sp):
             if causal and j > i:
                 continue  # strictly future: skip the whole block pair
             bias = tri_bias if (causal and j == i) else zero_bias
-            blk_max, blk_sum, blk_out = _block_attention(
-                q_i, chunk(kg, j), chunk(vg, j), bias
+            acc = merge_block_stats(
+                acc, _block_attention(q_i, chunk(kg, j), chunk(vg, j), bias)
             )
-            new_max = jnp.maximum(acc_max, blk_max)
-            old_scale = jnp.exp(acc_max - new_max)
-            blk_scale = jnp.exp(blk_max - new_max)
-            acc_max = new_max
-            acc_sum = acc_sum * old_scale + blk_sum * blk_scale
-            acc_out = (
-                acc_out * old_scale.transpose(0, 2, 1)[..., None]
-                + blk_out * blk_scale.transpose(0, 2, 1)[..., None]
-            )
-        denom = jnp.maximum(acc_sum, 1e-20).transpose(0, 2, 1)[..., None]
-        out_chunks.append(acc_out / denom)
+        out_chunks.append(normalize_block_stats(acc[1], acc[2]))
 
     out = jnp.concatenate(out_chunks, axis=1).astype(out_dtype)
 

@@ -167,3 +167,29 @@ def test_crash_without_restart_budget_fails_jobset(tmp_path):
     )
     runner.run_pending()
     assert js.status.terminal_state == keys.JOBSET_FAILED
+
+
+def test_lm_workload_with_ulysses_attention():
+    """`config.attn_impl: ulysses` selects the head-resharding sequence
+    strategy through the manifest surface and trains to completion on an
+    sp=2 mesh."""
+    cluster, js, runner = build(
+        {
+            "kind": "lm",
+            "steps": 2,
+            "batch_size": 4,
+            "seq_len": 16,
+            "mesh": {"sp": 2, "tp": 2},
+            "config": {
+                "vocab_size": 64,
+                "d_model": 32,
+                "n_heads": 4,
+                "d_ff": 64,
+                "n_layers": 2,
+                "remat": False,
+                "attn_impl": "ulysses",
+            },
+        }
+    )
+    runner.run_pending()
+    assert js.status.terminal_state == keys.JOBSET_COMPLETED
