@@ -204,6 +204,21 @@ def build_generate(config: TransformerConfig, mesh: Mesh, max_new_tokens: int):
     def local_generate(params, prompt, cache_k, cache_v):
         t_prompt = prompt.shape[1]
         total = t_prompt + max_new_tokens
+        # Serving is HBM-bandwidth-bound: every decode step streams the full
+        # parameter set. Cast float params to the compute dtype ONCE here
+        # (outside the scan) so each step reads 2-byte weights instead of
+        # re-reading the 4-byte training copies — roughly halving the
+        # per-token traffic that sets the latency floor. The MoE router gate
+        # `wg` is exempt: routing reads it in f32 for training-identical
+        # expert selection, and pre-rounding it would flip near-tie routes.
+        def _cast(path, x):
+            if any(getattr(k, "key", None) == "wg" for k in path):
+                return x
+            if jnp.issubdtype(x.dtype, jnp.floating):
+                return x.astype(cfg.dtype)
+            return x
+
+        params = jax.tree_util.tree_map_with_path(_cast, params)
         # Scan carries must enter with the types the body produces. Tokens
         # end up varying over dp plus the params' size-1 pp axis — NOT tp,
         # which _global_argmax reduces away; promoting tokens to tp-varying

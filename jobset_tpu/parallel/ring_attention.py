@@ -37,15 +37,17 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True):
     sp = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
     out_dtype = q.dtype
-    # Softmax statistics accumulate in f32 regardless of compute dtype
-    # (bf16 accumulators lose the online-softmax recurrence's precision).
-    q, k, v = (x.astype(jnp.float32) for x in (q, k, v))
+    # K/V ride the ring in the input dtype (bf16 in training — casting
+    # first would double every ppermute's ICI bytes); block_attention
+    # upcasts each block internally, and the softmax statistics accumulate
+    # in explicit f32 regardless (bf16 accumulators lose the online-softmax
+    # recurrence's precision).
     batch, t_local, heads, dim = q.shape
 
     rel = jnp.arange(t_local)[:, None] - jnp.arange(t_local)[None, :]
-    tri_bias = jnp.where(rel >= 0, 0.0, NEG_INF).astype(q.dtype)  # causal block-diag
-    zero_bias = jnp.zeros((t_local, t_local), q.dtype)
-    full_mask = jnp.full((t_local, t_local), NEG_INF, q.dtype)
+    tri_bias = jnp.where(rel >= 0, 0.0, NEG_INF).astype(jnp.float32)
+    zero_bias = jnp.zeros((t_local, t_local), jnp.float32)
+    full_mask = jnp.full((t_local, t_local), NEG_INF, jnp.float32)
 
     def fold(acc, k_blk, v_blk, r):
         kv_idx = (my_idx - r) % sp  # which global chunk this block holds
@@ -65,9 +67,9 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True):
     # sp-1 neighbor permutes total, none discarded.
     acc = fold(
         (
-            jnp.full((batch, heads, t_local), NEG_INF, q.dtype),
-            jnp.zeros((batch, heads, t_local), q.dtype),
-            jnp.zeros_like(q),
+            jnp.full((batch, heads, t_local), NEG_INF, jnp.float32),
+            jnp.zeros((batch, heads, t_local), jnp.float32),
+            jnp.zeros((batch, t_local, heads, dim), jnp.float32),
         ),
         k,
         v,
