@@ -21,6 +21,10 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..ops.flash_block import (
+    block_attention as _block_attention,
+    normalize_block_stats,
+)
 from ..parallel.mesh import axis_size, pvary_to, vma_union
 from .transformer import (
     TransformerConfig,
@@ -36,18 +40,23 @@ NEG_INF = -1.0e30
 
 
 def _moe_mlp_topk_decode(p, xn, cfg: TransformerConfig):
-    """Token-choice top-k MoE for the decode step (serving shape: ep == 1).
+    """Token-choice top-k MoE for the serving path (ep == 1).
 
-    Dense-all-experts formulation: with one token per step and a small
-    batch, running every expert on every token and weighting by the top-k
-    gates is a single MXU-friendly einsum chain — no capacity buffers, no
-    all_to_all (there is no ep axis to ship over), and no token drops. This
-    is the no-contention limit of the training path
+    Dense-all-experts formulation: running every expert on every token and
+    weighting by the top-k gates is a single MXU-friendly einsum chain — no
+    capacity buffers, no all_to_all (there is no ep axis to ship over), and
+    no token drops. This is the no-contention limit of the training path
     (`transformer._moe_mlp_routed`, reference: none — the reference has no
     inference surface): identical per-token math whenever training capacity
     admits every choice, which a serving batch trivially satisfies.
     Expert FFN weights stay column/row split over tp with one psum, exactly
     like the dense path.
+
+    Cost note: exactness is bought with E/k times the routed FFN FLOPs per
+    token. That is negligible for the single-token decode step (bandwidth
+    -bound) and acceptable for prefill at the small expert counts served
+    here; a large-E serving deployment would want a sort-tokens-by-expert
+    sparse prefill instead (future work, not a correctness gap).
     """
     compute = cfg.dtype
     k = cfg.moe_top_k
@@ -104,18 +113,9 @@ def _decode_layer(p, x, cache_k, cache_v, pos, cfg: TransformerConfig):
     """One layer, one token: x [B, 1, d]; cache_k/v [B, T_max, H_loc, D].
     Returns (x, new_cache_k, new_cache_v)."""
     heads_local = cache_k.shape[2]
-    compute = cfg.dtype
-    positions = jnp.asarray([pos], jnp.float32)
 
     xn = rms_norm(x, p["ln1"], cfg.norm_eps)
-
-    def proj(w):
-        y = jnp.einsum("btd,df->btf", xn.astype(compute), w.astype(compute))
-        return y.reshape(*y.shape[:-1], heads_local, cfg.head_dim)
-
-    q = rotary(proj(p["wq"]), positions, cfg.rope_theta).astype(jnp.float32)
-    k = rotary(proj(p["wk"]), positions, cfg.rope_theta)
-    v = proj(p["wv"])
+    q, k, v = _layer_qkv(p, xn, pos, heads_local, cfg)
 
     cache_k = lax.dynamic_update_slice(
         cache_k, k.astype(cache_k.dtype), (0, pos, 0, 0)
@@ -133,15 +133,91 @@ def _decode_layer(p, x, cache_k, cache_v, pos, cfg: TransformerConfig):
     logits = jnp.where(visible, logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
     attn = jnp.einsum("bhqk,bkhd->bqhd", probs, cache_v.astype(jnp.float32))
-    attn = attn.reshape(*attn.shape[:-2], heads_local * cfg.head_dim)
+    return _layer_tail(p, x, attn, cfg), cache_k, cache_v
+
+
+def _layer_qkv(p, xn, base, heads_local, cfg: TransformerConfig):
+    """Shared projection stanza for prefill and decode: q/k/v for the
+    tokens in xn (global positions base..base+T-1), rotary applied."""
+    compute = cfg.dtype
+    positions = base + jnp.arange(xn.shape[1], dtype=jnp.float32)
+
+    def proj(w):
+        y = jnp.einsum("btd,df->btf", xn.astype(compute), w.astype(compute))
+        return y.reshape(*y.shape[:-1], heads_local, cfg.head_dim)
+
+    q = rotary(proj(p["wq"]), positions, cfg.rope_theta).astype(jnp.float32)
+    k = rotary(proj(p["wk"]), positions, cfg.rope_theta)
+    return q, k, proj(p["wv"])
+
+
+def _layer_tail(p, x, attn, cfg: TransformerConfig):
+    """Shared output-projection + MLP stanza: attn [B, T, H_loc, D]."""
+    compute = cfg.dtype
+    attn = attn.reshape(*attn.shape[:-2], attn.shape[-2] * attn.shape[-1])
     out = jnp.einsum(
         "btf,fd->btd", attn.astype(compute), p["wo"].astype(compute)
     )
     x = x + lax.psum(out, "tp").astype(x.dtype)
-
     xn2 = rms_norm(x, p["ln2"], cfg.norm_eps)
-    x = x + _decode_mlp(p, xn2, cfg).astype(x.dtype)
-    return x, cache_k, cache_v
+    return x + _decode_mlp(p, xn2, cfg).astype(x.dtype)
+
+
+def _prefill_layer(p, x, cache_k, cache_v, cfg: TransformerConfig):
+    """One layer over the WHOLE prompt: x [B, Tp, d]; caches
+    [B, T_max, H_loc, D]. Writes K/V for every prompt position in one
+    batched pass (positions 0..Tp-1) and returns (x, cache_k, cache_v).
+
+    Attention goes through the flash block kernel (blockwise online
+    softmax), so no [Tp, Tp] probability matrix ever materializes in HBM —
+    prompt length is bounded by the cache, not by attention scratch."""
+    heads_local = cache_k.shape[2]
+    t_p = x.shape[1]
+
+    xn = rms_norm(x, p["ln1"], cfg.norm_eps)
+    q, k, v = _layer_qkv(p, xn, 0, heads_local, cfg)
+
+    cache_k = lax.dynamic_update_slice(
+        cache_k, k.astype(cache_k.dtype), (0, 0, 0, 0)
+    )
+    cache_v = lax.dynamic_update_slice(
+        cache_v, v.astype(cache_v.dtype), (0, 0, 0, 0)
+    )
+
+    rel = jnp.arange(t_p)[:, None] - jnp.arange(t_p)[None, :]
+    tri_bias = jnp.where(rel >= 0, 0.0, NEG_INF).astype(jnp.float32)
+    _, blk_sum, blk_out = _block_attention(q, k, v, tri_bias)
+    attn = normalize_block_stats(blk_sum, blk_out)  # [B, Tp, H_loc, D]
+    return _layer_tail(p, x, attn, cfg), cache_k, cache_v
+
+
+def _prefill_logits(params, prompt, cache, cfg):
+    """prompt [B, Tp] -> (last-position logits [B, V_local], filled cache).
+
+    The prompt is consumed in ONE batched causal pass per layer (MXU-shaped
+    [Tp, d] matmuls and a single parameter stream) instead of Tp sequential
+    cached steps — prefill is compute-bound where decode is bandwidth-bound,
+    so batching it moves prompt cost from Tp weight-streams to one.
+    """
+    x = _embed_tokens(params["embed"], prompt, cfg)  # [B, Tp, d]
+    stage_params = jax.tree.map(lambda a: a[0], params["layers"])
+    vma = vma_union(x, stage_params, cache)
+    x = pvary_to(x, vma)
+
+    def body(carry, inputs):
+        x = carry
+        layer_p, ck, cv = inputs
+        x, ck, cv = _prefill_layer(layer_p, x, ck, cv, cfg)
+        return pvary_to(x, vma), (pvary_to(ck, vma), pvary_to(cv, vma))
+
+    x, (new_k, new_v) = lax.scan(
+        body, x, (stage_params, cache["k"], cache["v"])
+    )
+    xn = rms_norm(x[:, -1:], params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum(
+        "btd,dv->btv", xn.astype(cfg.dtype), params["unembed"].astype(cfg.dtype)
+    )
+    return logits[:, 0].astype(jnp.float32), {"k": new_k, "v": new_v}
 
 
 def _token_logits(params, token, cache, pos, cfg):
@@ -188,9 +264,10 @@ def build_generate(config: TransformerConfig, mesh: Mesh, max_new_tokens: int):
     tokens [B, T_prompt + max_new_tokens] (greedy).
 
     Requires pp == sp == ep == 1 on the mesh (serving shape); dp and tp are
-    free. The prompt is consumed token-by-token through the same cached
-    step as decoding (simple, one compiled program; prefill batching is the
-    planned optimization)."""
+    free. The prompt is consumed in one batched causal prefill pass (filling
+    the KV cache for all prompt positions with MXU-shaped matmuls and a
+    single parameter stream), then new tokens decode through the cached
+    step — still one compiled program."""
     cfg = config
     for axis in ("pp", "sp", "ep"):
         if axis_size(mesh, axis) != 1:
@@ -203,7 +280,6 @@ def build_generate(config: TransformerConfig, mesh: Mesh, max_new_tokens: int):
 
     def local_generate(params, prompt, cache_k, cache_v):
         t_prompt = prompt.shape[1]
-        total = t_prompt + max_new_tokens
         # Serving is HBM-bandwidth-bound: every decode step streams the full
         # parameter set. Cast float params to the compute dtype ONCE here
         # (outside the scan) so each step reads 2-byte weights instead of
@@ -232,34 +308,33 @@ def build_generate(config: TransformerConfig, mesh: Mesh, max_new_tokens: int):
             "v": pvary_to(cache_v, cache_vma),
         }
 
+        # Phase 1 — prefill: one batched causal pass fills the cache for
+        # every prompt position and yields the first generated token.
+        last_logits, cache = _prefill_logits(params, prompt, cache, cfg)
+        first = pvary_to(_global_argmax(last_logits), token_vma)
+        cache = jax.tree.map(lambda c: pvary_to(c, cache_vma), cache)
+
+        # Phase 2 — decode: scan only the NEW positions, each feeding the
+        # previous pick through the cached step. max_new_tokens is static,
+        # so the zero case (prefill only, return the prompt unchanged —
+        # the documented [B, T_prompt + max_new_tokens] contract) is a
+        # trace-time branch.
         def step(carry, pos):
             token, cache = carry
             logits, cache = _token_logits(params, token, cache, pos, cfg)
-            picked = _global_argmax(logits)
-            # While still inside the prompt, the "next token" is the given
-            # prompt token, not the model's pick.
-            in_prompt = pos + 1 < t_prompt
-            next_token = jnp.where(
-                in_prompt,
-                lax.dynamic_index_in_dim(
-                    prompt, jnp.minimum(pos + 1, t_prompt - 1), axis=1,
-                    keepdims=False,
-                ),
-                picked,
-            )
-            next_token = pvary_to(next_token, token_vma)
+            picked = pvary_to(_global_argmax(logits), token_vma)
             cache = jax.tree.map(lambda c: pvary_to(c, cache_vma), cache)
-            return (next_token, cache), next_token
+            return (picked, cache), picked
 
-        (_, _), tokens = lax.scan(
-            step,
-            (pvary_to(prompt[:, 0], token_vma), cache),
-            jnp.arange(total - 1),
-        )
-        out = jnp.concatenate(
-            [pvary_to(prompt[:, :1], token_vma), jnp.moveaxis(tokens, 0, 1)],
-            axis=1,
-        )
+        parts = [pvary_to(prompt, token_vma)]
+        if max_new_tokens > 0:
+            (_, _), rest = lax.scan(
+                step,
+                (first, cache),
+                t_prompt + jnp.arange(max_new_tokens - 1),
+            )
+            parts += [first[:, None], jnp.moveaxis(rest, 0, 1)]
+        out = jnp.concatenate(parts, axis=1)
         # The output spec is P('dp', None): reduce away the helper axes the
         # params dragged in — all enforced size-1 (pp/sp/ep), where psum is
         # the identity.

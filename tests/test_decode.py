@@ -108,3 +108,16 @@ def test_topk_equals_soft_dispatch_when_k_is_all_experts():
         generate = build_generate(cfg, mesh, 4)
         outs.append(np.asarray(generate(params, prompt)))
     np.testing.assert_array_equal(outs[0], outs[1])
+
+
+def test_zero_new_tokens_returns_prompt_unchanged():
+    """max_new_tokens=0 honors the [B, T_prompt + max_new_tokens] contract:
+    prefill-only, prompt comes back as-is."""
+    cfg = _cfg()
+    mesh = build_mesh(MeshConfig(), jax.devices()[:1])
+    params = init_params(jax.random.key(0), cfg, mesh)
+    prompt = jnp.asarray(
+        np.random.default_rng(1).integers(0, cfg.vocab_size, (2, 6)), jnp.int32
+    )
+    out = np.asarray(build_generate(cfg, mesh, max_new_tokens=0)(params, prompt))
+    np.testing.assert_array_equal(out, np.asarray(prompt))
