@@ -21,10 +21,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.flash_block import (
-    block_attention as _block_attention,
-    normalize_block_stats,
-)
+from ..ops.flash_block import blockwise_causal_attention
 from ..parallel.mesh import axis_size, pvary_to, vma_union
 from .transformer import (
     TransformerConfig,
@@ -168,11 +165,10 @@ def _prefill_layer(p, x, cache_k, cache_v, cfg: TransformerConfig):
     [B, T_max, H_loc, D]. Writes K/V for every prompt position in one
     batched pass (positions 0..Tp-1) and returns (x, cache_k, cache_v).
 
-    Attention goes through the flash block kernel (blockwise online
-    softmax), so no [Tp, Tp] probability matrix ever materializes in HBM —
-    prompt length is bounded by the cache, not by attention scratch."""
+    Attention is the shared blockwise fold over the flash kernel: biases
+    and probability tiles stay chunk-sized constants, so prompt length is
+    bounded by the cache, not by any [Tp, Tp] attention scratch."""
     heads_local = cache_k.shape[2]
-    t_p = x.shape[1]
 
     xn = rms_norm(x, p["ln1"], cfg.norm_eps)
     q, k, v = _layer_qkv(p, xn, 0, heads_local, cfg)
@@ -184,10 +180,7 @@ def _prefill_layer(p, x, cache_k, cache_v, cfg: TransformerConfig):
         cache_v, v.astype(cache_v.dtype), (0, 0, 0, 0)
     )
 
-    rel = jnp.arange(t_p)[:, None] - jnp.arange(t_p)[None, :]
-    tri_bias = jnp.where(rel >= 0, 0.0, NEG_INF).astype(jnp.float32)
-    _, blk_sum, blk_out = _block_attention(q, k, v, tri_bias)
-    attn = normalize_block_stats(blk_sum, blk_out)  # [B, Tp, H_loc, D]
+    attn = blockwise_causal_attention(q, k, v)  # [B, Tp, H_loc, D]
     return _layer_tail(p, x, attn, cfg), cache_k, cache_v
 
 
@@ -200,6 +193,21 @@ def _prefill_logits(params, prompt, cache, cfg):
     so batching it moves prompt cost from Tp weight-streams to one.
     """
     x = _embed_tokens(params["embed"], prompt, cfg)  # [B, Tp, d]
+    return _run_stack(
+        params, x, cache, cfg,
+        lambda p, x, ck, cv: _prefill_layer(p, x, ck, cv, cfg),
+    )
+
+
+def _run_stack(params, x, cache, cfg, layer_fn):
+    """Shared layer-scan + epilogue for prefill and decode: run `layer_fn`
+    over the stacked layers (scan over layers_per_stage; pp == 1 in
+    serving), final-norm the LAST position, unembed it.
+
+    Params shard over the (size-1) pp axis, so layer outputs are typed
+    pp-varying; the scan carry must enter with the same vma type.
+    Returns (last-position logits [B, V_local] f32, new cache).
+    """
     stage_params = jax.tree.map(lambda a: a[0], params["layers"])
     vma = vma_union(x, stage_params, cache)
     x = pvary_to(x, vma)
@@ -207,7 +215,7 @@ def _prefill_logits(params, prompt, cache, cfg):
     def body(carry, inputs):
         x = carry
         layer_p, ck, cv = inputs
-        x, ck, cv = _prefill_layer(layer_p, x, ck, cv, cfg)
+        x, ck, cv = layer_fn(layer_p, x, ck, cv)
         return pvary_to(x, vma), (pvary_to(ck, vma), pvary_to(cv, vma))
 
     x, (new_k, new_v) = lax.scan(
@@ -223,27 +231,10 @@ def _prefill_logits(params, prompt, cache, cfg):
 def _token_logits(params, token, cache, pos, cfg):
     """token [B] -> (logits [B, V_local], new cache). Runs on local shards."""
     x = _embed_tokens(params["embed"], token[:, None], cfg)  # [B, 1, d]
-    # Stacked layers: [pp=1, lps, ...] -> scan over lps.
-    stage_params = jax.tree.map(lambda a: a[0], params["layers"])
-    # Params shard over the (size-1) pp axis, so layer outputs are typed
-    # pp-varying; the scan carry must enter with the same vma type.
-    vma = vma_union(x, stage_params, cache)
-    x = pvary_to(x, vma)
-
-    def body(carry, inputs):
-        x = carry
-        layer_p, ck, cv = inputs
-        x, ck, cv = _decode_layer(layer_p, x, ck, cv, pos, cfg)
-        return pvary_to(x, vma), (pvary_to(ck, vma), pvary_to(cv, vma))
-
-    x, (new_k, new_v) = lax.scan(
-        body, x, (stage_params, cache["k"], cache["v"])
+    return _run_stack(
+        params, x, cache, cfg,
+        lambda p, x, ck, cv: _decode_layer(p, x, ck, cv, pos, cfg),
     )
-    xn = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = jnp.einsum(
-        "btd,dv->btv", xn.astype(cfg.dtype), params["unembed"].astype(cfg.dtype)
-    )
-    return logits[:, 0].astype(jnp.float32), {"k": new_k, "v": new_v}
 
 
 def _global_argmax(logits):
@@ -259,9 +250,57 @@ def _global_argmax(logits):
     return lax.pmin(candidate.astype(jnp.int32), "tp")  # lowest-index tie-break
 
 
-def build_generate(config: TransformerConfig, mesh: Mesh, max_new_tokens: int):
-    """Returns jitted generate(params, prompt [B, T_prompt]) ->
-    tokens [B, T_prompt + max_new_tokens] (greedy).
+def _pick_token(logits, key, pos, temperature: float, top_k: int):
+    """Greedy (temperature == 0) or sampled pick over the tp-sharded vocab.
+
+    Sampling is Gumbel-max: argmax(logits/T + G) is an exact draw from
+    softmax(logits/T), and the argmax is exactly the global-argmax reduction
+    the greedy path already does — so sharded sampling needs no logits
+    gather. Each tp shard draws independent noise for its vocab slice
+    (key folded with the decode position and the shard index).
+
+    top_k > 0 restricts sampling to the k globally-largest logits, computed
+    exactly: every shard's local top-k values are all-gathered over tp
+    (k*tp floats — trivial), the global k-th value is the threshold, and
+    sub-threshold logits are masked before the Gumbel draw.
+    """
+    if temperature <= 0.0:
+        return _global_argmax(logits)
+    z = logits.astype(jnp.float32) / temperature
+    if top_k > 0:
+        local_vals = lax.top_k(logits, min(top_k, logits.shape[-1]))[0]
+        all_vals = lax.all_gather(
+            local_vals, "tp", axis=-1, tiled=True
+        )  # [B, tp*k]
+        # Oversized top_k degrades to full-vocab sampling (clamped on both
+        # the local and the gathered pick).
+        thresh = lax.top_k(all_vals, min(top_k, all_vals.shape[-1]))[0][..., -1:]
+        z = jnp.where(logits >= thresh, z, NEG_INF)
+    step_key = jax.random.fold_in(key, pos)
+    # Decorrelate noise across BOTH sharded axes a batch row can live on:
+    # tp shards hold different vocab slices of the same rows (distinct
+    # slices need distinct noise), dp shards hold different rows (identical
+    # noise would collapse sampled diversity to B/dp).
+    shard_key = jax.random.fold_in(step_key, lax.axis_index("tp"))
+    shard_key = jax.random.fold_in(shard_key, lax.axis_index("dp"))
+    gumbel = jax.random.gumbel(shard_key, z.shape, jnp.float32)
+    return _global_argmax(z + gumbel)
+
+
+def build_generate(
+    config: TransformerConfig,
+    mesh: Mesh,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    top_k: int = 0,
+):
+    """Returns jitted generate(params, prompt [B, T_prompt], key=None) ->
+    tokens [B, T_prompt + max_new_tokens].
+
+    temperature == 0 (default) decodes greedily; temperature > 0 samples
+    from softmax(logits/temperature) via sharded Gumbel-max (`_pick_token`),
+    optionally truncated to the global top_k logits. `key` seeds sampling
+    (defaults to jax.random.key(0)); it is ignored when greedy.
 
     Requires pp == sp == ep == 1 on the mesh (serving shape); dp and tp are
     free. The prompt is consumed in one batched causal prefill pass (filling
@@ -278,7 +317,7 @@ def build_generate(config: TransformerConfig, mesh: Mesh, max_new_tokens: int):
     specs = param_specs(cfg)
     cache_spec = P(None, "dp", None, "tp", None)
 
-    def local_generate(params, prompt, cache_k, cache_v):
+    def local_generate(params, prompt, key, cache_k, cache_v):
         t_prompt = prompt.shape[1]
         # Serving is HBM-bandwidth-bound: every decode step streams the full
         # parameter set. Cast float params to the compute dtype ONCE here
@@ -311,7 +350,10 @@ def build_generate(config: TransformerConfig, mesh: Mesh, max_new_tokens: int):
         # Phase 1 — prefill: one batched causal pass fills the cache for
         # every prompt position and yields the first generated token.
         last_logits, cache = _prefill_logits(params, prompt, cache, cfg)
-        first = pvary_to(_global_argmax(last_logits), token_vma)
+        first = pvary_to(
+            _pick_token(last_logits, key, t_prompt - 1, temperature, top_k),
+            token_vma,
+        )
         cache = jax.tree.map(lambda c: pvary_to(c, cache_vma), cache)
 
         # Phase 2 — decode: scan only the NEW positions, each feeding the
@@ -322,7 +364,9 @@ def build_generate(config: TransformerConfig, mesh: Mesh, max_new_tokens: int):
         def step(carry, pos):
             token, cache = carry
             logits, cache = _token_logits(params, token, cache, pos, cfg)
-            picked = pvary_to(_global_argmax(logits), token_vma)
+            picked = pvary_to(
+                _pick_token(logits, key, pos, temperature, top_k), token_vma
+            )
             cache = jax.tree.map(lambda c: pvary_to(c, cache_vma), cache)
             return (picked, cache), picked
 
@@ -346,15 +390,17 @@ def build_generate(config: TransformerConfig, mesh: Mesh, max_new_tokens: int):
     sharded = jax.shard_map(
         local_generate,
         mesh=mesh,
-        in_specs=(specs, P("dp", None), cache_spec, cache_spec),
+        in_specs=(specs, P("dp", None), P(), cache_spec, cache_spec),
         out_specs=P("dp", None),
     )
 
     @jax.jit
-    def generate(params, prompt):
+    def generate(params, prompt, key=None):
+        if key is None:
+            key = jax.random.key(0)
         cache = init_kv_cache(
             cfg, mesh, prompt.shape[0], prompt.shape[1] + max_new_tokens
         )
-        return sharded(params, prompt, cache["k"], cache["v"])
+        return sharded(params, prompt, key, cache["k"], cache["v"])
 
     return generate

@@ -287,6 +287,52 @@ def normalize_block_stats(acc_sum, acc_out):
     return acc_out / denom
 
 
+def blockwise_causal_attention(q, k, v, chunk: int = 512, causal: bool = True):
+    """Exact attention over contiguous positions, folded blockwise so no
+    [T, T] bias or probability matrix ever materializes: biases are
+    per-chunk-pair constants ([c, c] triangular on the diagonal, zero
+    elsewhere), and with `causal` strictly-future chunk pairs are skipped.
+    Collective-free — the local building block both `ulysses_attention`
+    (after its gather) and the serving prefill fold with.
+
+    q/k/v: [B, T, H, D] covering positions 0..T-1. The final chunk may be
+    ragged; all shapes are static at trace time.
+    """
+    t_total = q.shape[1]
+    batch, _, heads, dim = q.shape
+    starts = list(range(0, t_total, chunk))
+
+    def tri(n):
+        rel = jnp.arange(n)[:, None] - jnp.arange(n)[None, :]
+        return jnp.where(rel >= 0, 0.0, NEG_INF).astype(jnp.float32)
+
+    out_chunks = []
+    for i, qs in enumerate(starts):
+        q_len = min(chunk, t_total - qs)
+        q_i = lax.slice_in_dim(q, qs, qs + q_len, axis=1)
+        acc = (
+            jnp.full((batch, heads, q_len), NEG_INF, jnp.float32),
+            jnp.zeros((batch, heads, q_len), jnp.float32),
+            jnp.zeros((batch, q_len, heads, dim), jnp.float32),
+        )
+        kv_starts = starts[: i + 1] if causal else starts
+        for j, ks in enumerate(kv_starts):
+            k_len = min(chunk, t_total - ks)
+            if causal and j == i:
+                bias = tri(q_len)
+            else:
+                bias = jnp.zeros((q_len, k_len), jnp.float32)
+            blk = block_attention(
+                q_i,
+                lax.slice_in_dim(k, ks, ks + k_len, axis=1),
+                lax.slice_in_dim(v, ks, ks + k_len, axis=1),
+                bias,
+            )
+            acc = merge_block_stats(acc, blk)
+        out_chunks.append(normalize_block_stats(acc[1], acc[2]))
+    return jnp.concatenate(out_chunks, axis=1)
+
+
 # ---------------------------------------------------------------------------
 # Public op with flash-style recompute backward
 # ---------------------------------------------------------------------------

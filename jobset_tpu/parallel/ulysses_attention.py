@@ -33,12 +33,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax import lax
 
-from ..ops.flash_block import (
-    NEG_INF,
-    block_attention as _block_attention,
-    merge_block_stats,
-    normalize_block_stats,
-)
+from ..ops.flash_block import blockwise_causal_attention
 
 
 def ulysses_attention(q, k, v, axis_name: str, causal: bool = True):
@@ -74,38 +69,12 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = True):
     # chunk internally, so an upfront f32 cast would only double the peak
     # residency of three full-sequence tensors.
     qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    heads_u = heads_local // sp
 
-    # Blockwise local attention at T_local granularity — the ring fold
-    # without the ring: for q chunk i, fold kv chunks j <= i (causal) or
-    # all sp chunks (bidirectional). sp is a static axis size, so these
-    # Python loops trace sp*(sp+1)/2 (or sp^2) kernel calls, each over
-    # [T_local, T_local] blocks with constant biases.
-    rel = jnp.arange(t_local)[:, None] - jnp.arange(t_local)[None, :]
-    tri_bias = jnp.where(rel >= 0, 0.0, NEG_INF).astype(jnp.float32)
-    zero_bias = jnp.zeros((t_local, t_local), jnp.float32)
-
-    def chunk(x, j):
-        return lax.dynamic_slice_in_dim(x, j * t_local, t_local, axis=1)
-
-    out_chunks = []
-    for i in range(sp):
-        q_i = chunk(qg, i)
-        acc = (
-            jnp.full((batch, heads_u, t_local), NEG_INF, jnp.float32),
-            jnp.zeros((batch, heads_u, t_local), jnp.float32),
-            jnp.zeros((batch, t_local, heads_u, dim), jnp.float32),
-        )
-        for j in range(sp):
-            if causal and j > i:
-                continue  # strictly future: skip the whole block pair
-            bias = tri_bias if (causal and j == i) else zero_bias
-            acc = merge_block_stats(
-                acc, _block_attention(q_i, chunk(kg, j), chunk(vg, j), bias)
-            )
-        out_chunks.append(normalize_block_stats(acc[1], acc[2]))
-
-    out = jnp.concatenate(out_chunks, axis=1).astype(out_dtype)
+    # Local attention = the shared blockwise fold at T_local granularity
+    # (constant per-chunk-pair biases, strictly-future pairs skipped).
+    out = blockwise_causal_attention(
+        qg, kg, vg, chunk=t_local, causal=causal
+    ).astype(out_dtype)
 
     def heads_to_seq(x):
         return lax.all_to_all(
