@@ -29,6 +29,7 @@ from .transformer import (
     _embed_tokens,
     _moe_mlp,
     param_specs,
+    repeat_kv,
     rms_norm,
     rotary,
 )
@@ -92,10 +93,12 @@ def _decode_mlp(p, xn, cfg: TransformerConfig):
 def init_kv_cache(
     config: TransformerConfig, mesh: Mesh, batch: int, max_len: int
 ) -> dict:
-    """Global KV cache arrays [layers, B, max_len, H, D], head-sharded on tp
-    and batch-sharded on dp."""
+    """Global KV cache arrays [layers, B, max_len, H_kv, D], head-sharded on
+    tp and batch-sharded on dp. With GQA the cache holds only the
+    n_kv_heads K/V heads — the full serving-memory win — and reads are
+    broadcast per query-head group at compute time."""
     cfg = config
-    shape = (cfg.n_layers, batch, max_len, cfg.n_heads, cfg.head_dim)
+    shape = (cfg.n_layers, batch, max_len, cfg.kv_heads, cfg.head_dim)
     sharding = NamedSharding(mesh, P(None, "dp", None, "tp", None))
     # Cache lives in the compute dtype (bf16 for serving configs) — it is
     # the dominant HBM term; the attention dot upcasts to f32.
@@ -109,10 +112,11 @@ def init_kv_cache(
 def _decode_layer(p, x, cache_k, cache_v, pos, cfg: TransformerConfig):
     """One layer, one token: x [B, 1, d]; cache_k/v [B, T_max, H_loc, D].
     Returns (x, new_cache_k, new_cache_v)."""
-    heads_local = cache_k.shape[2]
+    kv_heads_local = cache_k.shape[2]
+    group = cfg.n_heads // cfg.kv_heads
 
     xn = rms_norm(x, p["ln1"], cfg.norm_eps)
-    q, k, v = _layer_qkv(p, xn, pos, heads_local, cfg)
+    q, k, v = _layer_qkv(p, xn, pos, kv_heads_local, cfg)
 
     cache_k = lax.dynamic_update_slice(
         cache_k, k.astype(cache_k.dtype), (0, pos, 0, 0)
@@ -121,31 +125,39 @@ def _decode_layer(p, x, cache_k, cache_v, pos, cfg: TransformerConfig):
         cache_v, v.astype(cache_v.dtype), (0, pos, 0, 0)
     )
 
+    # GQA: the cache is read at its compact kv-head width and broadcast per
+    # query-head group (a fused broadcast, not a copy) — bandwidth, the
+    # decode bottleneck, scales with kv_heads.
+    full_k = repeat_kv(cache_k, group).astype(jnp.float32)
+    full_v = repeat_kv(cache_v, group).astype(jnp.float32)
     scale = cfg.head_dim ** -0.5
-    logits = (
-        jnp.einsum("bqhd,bkhd->bhqk", q, cache_k.astype(jnp.float32)) * scale
-    )  # [B,H,1,T]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, full_k) * scale  # [B,H,1,T]
     t_max = cache_k.shape[1]
     visible = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, t_max), 3) <= pos
     logits = jnp.where(visible, logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
-    attn = jnp.einsum("bhqk,bkhd->bqhd", probs, cache_v.astype(jnp.float32))
+    attn = jnp.einsum("bhqk,bkhd->bqhd", probs, full_v)
     return _layer_tail(p, x, attn, cfg), cache_k, cache_v
 
 
-def _layer_qkv(p, xn, base, heads_local, cfg: TransformerConfig):
+def _layer_qkv(p, xn, base, kv_heads_local, cfg: TransformerConfig):
     """Shared projection stanza for prefill and decode: q/k/v for the
-    tokens in xn (global positions base..base+T-1), rotary applied."""
+    tokens in xn (global positions base..base+T-1), rotary applied. K/V
+    come out with the (possibly smaller, GQA) kv head count — exactly what
+    the cache stores; q with the full local query head count."""
     compute = cfg.dtype
     positions = base + jnp.arange(xn.shape[1], dtype=jnp.float32)
+    group = cfg.n_heads // cfg.kv_heads
 
-    def proj(w):
+    def proj(w, n_heads):
         y = jnp.einsum("btd,df->btf", xn.astype(compute), w.astype(compute))
-        return y.reshape(*y.shape[:-1], heads_local, cfg.head_dim)
+        return y.reshape(*y.shape[:-1], n_heads, cfg.head_dim)
 
-    q = rotary(proj(p["wq"]), positions, cfg.rope_theta).astype(jnp.float32)
-    k = rotary(proj(p["wk"]), positions, cfg.rope_theta)
-    return q, k, proj(p["wv"])
+    q = rotary(
+        proj(p["wq"], kv_heads_local * group), positions, cfg.rope_theta
+    ).astype(jnp.float32)
+    k = rotary(proj(p["wk"], kv_heads_local), positions, cfg.rope_theta)
+    return q, k, proj(p["wv"], kv_heads_local)
 
 
 def _layer_tail(p, x, attn, cfg: TransformerConfig):
@@ -168,10 +180,10 @@ def _prefill_layer(p, x, cache_k, cache_v, cfg: TransformerConfig):
     Attention is the shared blockwise fold over the flash kernel: biases
     and probability tiles stay chunk-sized constants, so prompt length is
     bounded by the cache, not by any [Tp, Tp] attention scratch."""
-    heads_local = cache_k.shape[2]
+    kv_heads_local = cache_k.shape[2]
 
     xn = rms_norm(x, p["ln1"], cfg.norm_eps)
-    q, k, v = _layer_qkv(p, xn, 0, heads_local, cfg)
+    q, k, v = _layer_qkv(p, xn, 0, kv_heads_local, cfg)
 
     cache_k = lax.dynamic_update_slice(
         cache_k, k.astype(cache_k.dtype), (0, 0, 0, 0)
@@ -180,7 +192,7 @@ def _prefill_layer(p, x, cache_k, cache_v, cfg: TransformerConfig):
         cache_v, v.astype(cache_v.dtype), (0, 0, 0, 0)
     )
 
-    attn = blockwise_causal_attention(q, k, v)  # [B, Tp, H_loc, D]
+    attn = blockwise_causal_attention(q, k, v)  # GQA broadcast inside
     return _layer_tail(p, x, attn, cfg), cache_k, cache_v
 
 

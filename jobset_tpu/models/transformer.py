@@ -45,6 +45,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel.mesh import MeshConfig, axis_size, pvary_to, vma_union
 from ..parallel.pipeline import pipeline_apply
+from ..ops.flash_block import _repeat_heads as repeat_kv  # GQA broadcast
 from ..parallel.ring_attention import ring_attention
 from ..parallel.ulysses_attention import ulysses_attention
 
@@ -54,6 +55,12 @@ class TransformerConfig:
     vocab_size: int = 32000
     d_model: int = 512
     n_heads: int = 8
+    # Grouped-query attention: number of K/V heads (0 = n_heads, i.e. MHA).
+    # Each group of n_heads/n_kv_heads query heads shares one K/V head —
+    # the KV cache (the serving working set) and the wk/wv parameters
+    # shrink by the same factor; Q/attention math is unchanged (K/V are
+    # broadcast per group at compute time).
+    n_kv_heads: int = 0
     d_ff: int = 2048
     n_layers: int = 8
     # MoE: 0 experts = dense MLP in every layer.
@@ -86,6 +93,10 @@ class TransformerConfig:
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
 
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
+
     def validate(self, mesh_config: MeshConfig) -> None:
         mc = mesh_config
         if self.d_model % self.n_heads:
@@ -94,6 +105,15 @@ class TransformerConfig:
             raise ValueError(f"n_layers {self.n_layers} not divisible by pp {mc.pp}")
         if self.n_heads % mc.tp:
             raise ValueError(f"n_heads {self.n_heads} not divisible by tp {mc.tp}")
+        if self.n_heads % self.kv_heads:
+            raise ValueError(
+                f"n_heads {self.n_heads} not divisible by "
+                f"n_kv_heads {self.kv_heads}"
+            )
+        if self.kv_heads % mc.tp:
+            raise ValueError(
+                f"n_kv_heads {self.kv_heads} not divisible by tp {mc.tp}"
+            )
         if self.d_ff % mc.tp or (self.n_experts and self.d_ff_expert % mc.tp):
             raise ValueError("feed-forward widths must be divisible by tp")
         if self.vocab_size % mc.tp:
@@ -171,8 +191,8 @@ def init_params(
         "ln1": ((pp, lps, d), None),
         "ln2": ((pp, lps, d), None),
         "wq": ((pp, lps, d, h * dh), d),
-        "wk": ((pp, lps, d, h * dh), d),
-        "wv": ((pp, lps, d, h * dh), d),
+        "wk": ((pp, lps, d, cfg.kv_heads * dh), d),
+        "wv": ((pp, lps, d, cfg.kv_heads * dh), d),
         "wo": ((pp, lps, h * dh, d), h * dh),
     }
     if cfg.n_experts:
@@ -234,8 +254,10 @@ def rotary(x, positions, theta):
 
 
 def _attention_block(p, x, cfg: TransformerConfig, t_local: int):
-    """Megatron column/row parallel attention with ring attention over sp."""
-    heads_local = cfg.n_heads // lax.psum(1, "tp")
+    """Megatron column/row parallel attention (ring or Ulysses over sp)."""
+    tp = lax.psum(1, "tp")
+    heads_local = cfg.n_heads // tp
+    kv_heads_local = cfg.kv_heads // tp
     positions = (
         lax.axis_index("sp") * t_local + jnp.arange(t_local, dtype=jnp.float32)
     )
@@ -243,15 +265,22 @@ def _attention_block(p, x, cfg: TransformerConfig, t_local: int):
     xn = rms_norm(x, p["ln1"], cfg.norm_eps)
     compute = cfg.dtype
 
-    def proj(w):
+    def proj(w, n_heads):
         y = jnp.einsum(
             "btd,df->btf", xn.astype(compute), w.astype(compute)
         )
-        return y.reshape(*y.shape[:-1], heads_local, cfg.head_dim)
+        return y.reshape(*y.shape[:-1], n_heads, cfg.head_dim)
 
-    q = rotary(proj(p["wq"]), positions, cfg.rope_theta)
-    key = rotary(proj(p["wk"]), positions, cfg.rope_theta)
-    value = proj(p["wv"])
+    group = heads_local // kv_heads_local
+    q = rotary(proj(p["wq"], heads_local), positions, cfg.rope_theta)
+    key = rotary(proj(p["wk"], kv_heads_local), positions, cfg.rope_theta)
+    value = proj(p["wv"], kv_heads_local)
+    if cfg.attn_impl == "ulysses":
+        # Ulysses splits the head axis across sp: repeating BEFORE the
+        # all_to_all keeps each rank's q heads aligned with their kv groups
+        # for any (kv_heads, sp) combination. Ring has no such constraint —
+        # compact K/V ride the ppermutes and broadcast per block.
+        key, value = repeat_kv(key, group), repeat_kv(value, group)
 
     if cfg.attn_impl == "ulysses":
         attn = ulysses_attention(q, key, value, "sp", causal=True)

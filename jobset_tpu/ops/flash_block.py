@@ -259,6 +259,17 @@ def _block_attention_pallas(q, k, v, bias):
     return block_max, block_sum, weighted
 
 
+def _repeat_heads(x, group: int):
+    """GQA broadcast [B, T, Hkv, D] -> [B, T, Hkv*group, D]; fuses into the
+    consuming matmul (broadcast+reshape, never a copy)."""
+    if group == 1:
+        return x
+    b, t, hkv, d = x.shape
+    return jnp.broadcast_to(
+        x[:, :, :, None, :], (b, t, hkv, group, d)
+    ).reshape(b, t, hkv * group, d)
+
+
 def merge_block_stats(acc, blk):
     """Online-softmax merge of two unnormalized (max, sum, weighted) triples
     — THE recurrence both sequence-parallel strategies fold with
@@ -295,11 +306,15 @@ def blockwise_causal_attention(q, k, v, chunk: int = 512, causal: bool = True):
     Collective-free — the local building block both `ulysses_attention`
     (after its gather) and the serving prefill fold with.
 
-    q/k/v: [B, T, H, D] covering positions 0..T-1. The final chunk may be
-    ragged; all shapes are static at trace time.
+    q/k/v: [B, T, H, D] covering positions 0..T-1. k/v may carry FEWER
+    heads than q (GQA): each group of H_q/H_kv query heads shares one K/V
+    head, broadcast per block inside the fold — callers ship/hold only the
+    compact K/V. The final chunk may be ragged; all shapes are static at
+    trace time.
     """
     t_total = q.shape[1]
     batch, _, heads, dim = q.shape
+    group = heads // k.shape[2]
     starts = list(range(0, t_total, chunk))
 
     def tri(n):
@@ -324,8 +339,8 @@ def blockwise_causal_attention(q, k, v, chunk: int = 512, causal: bool = True):
                 bias = jnp.zeros((q_len, k_len), jnp.float32)
             blk = block_attention(
                 q_i,
-                lax.slice_in_dim(k, ks, ks + k_len, axis=1),
-                lax.slice_in_dim(v, ks, ks + k_len, axis=1),
+                _repeat_heads(lax.slice_in_dim(k, ks, ks + k_len, axis=1), group),
+                _repeat_heads(lax.slice_in_dim(v, ks, ks + k_len, axis=1), group),
                 bias,
             )
             acc = merge_block_stats(acc, blk)

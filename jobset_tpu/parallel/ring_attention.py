@@ -20,6 +20,7 @@ from jax import lax
 
 from ..ops.flash_block import (
     NEG_INF,
+    _repeat_heads,
     block_attention as _block_attention,
     merge_block_stats,
     normalize_block_stats,
@@ -30,8 +31,10 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True):
     """Exact attention with K/V rotating around `axis_name`.
 
     q/k/v: [B, T_local, H_local, D] per-rank chunks (already head-sharded by
-    tp outside). Sequence chunks are laid out in ring order: global position
-    of rank r covers [r*T_local, (r+1)*T_local).
+    tp outside). k/v may carry FEWER heads than q (GQA): the compact K/V
+    ride the ring's ppermutes — group-times less ICI traffic — and are
+    broadcast per block at the kernel call. Sequence chunks are laid out in
+    ring order: global position of rank r covers [r*T_local, (r+1)*T_local).
     Returns [B, T_local, H_local, D].
     """
     sp = lax.psum(1, axis_name)
@@ -43,6 +46,7 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True):
     # in explicit f32 regardless (bf16 accumulators lose the online-softmax
     # recurrence's precision).
     batch, t_local, heads, dim = q.shape
+    group = heads // k.shape[2]
 
     rel = jnp.arange(t_local)[:, None] - jnp.arange(t_local)[None, :]
     tri_bias = jnp.where(rel >= 0, 0.0, NEG_INF).astype(jnp.float32)
@@ -61,7 +65,13 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True):
         else:
             bias = zero_bias
 
-        return merge_block_stats(acc, _block_attention(q, k_blk, v_blk, bias))
+        return merge_block_stats(
+            acc,
+            _block_attention(
+                q, _repeat_heads(k_blk, group), _repeat_heads(v_blk, group),
+                bias,
+            ),
+        )
 
     # Fold the local block first, then sp-1 rotate-then-fold steps — exactly
     # sp-1 neighbor permutes total, none discarded.

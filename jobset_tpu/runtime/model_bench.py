@@ -61,7 +61,11 @@ def matmul_param_count(cfg) -> int:
     """Parameters that participate in matmuls (excludes norms; includes the
     untied vocab projection and embedding-as-projection only once)."""
     d, L = cfg.d_model, cfg.n_layers
-    per_layer = 4 * d * d  # wq wk wv wo (h * head_dim == d)
+    dh = cfg.head_dim
+    kv = cfg.kv_heads
+    # wq + wo at full head width, wk + wv at the (possibly GQA-reduced)
+    # kv head width; h * head_dim == d.
+    per_layer = 2 * d * cfg.n_heads * dh + 2 * d * kv * dh
     if cfg.n_experts:
         # gate + all expert FFNs (total, not per-token-activated)
         per_layer += d * cfg.n_experts + cfg.n_experts * 2 * d * cfg.d_ff_expert

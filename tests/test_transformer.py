@@ -329,3 +329,29 @@ def test_ulysses_validation_rejects_indivisible_heads():
     cfg = tiny_config(attn_impl="ulysses")  # 4 heads
     with pytest.raises(ValueError, match="ulysses"):
         cfg.validate(MeshConfig(sp=4, tp=2))  # heads/tp = 2, not % 4
+
+
+def test_gqa_training_matches_single_device():
+    """Grouped-query attention (n_kv_heads < n_heads) trains identically on
+    a sharded mesh and one device — GQA composes with tp/sp sharding."""
+    sharded_mc = MeshConfig(sp=2, tp=2)
+    cfg = tiny_config(remat=False, n_kv_heads=2)  # 4 q heads, 2 kv heads
+    cfg.validate(sharded_mc)
+
+    losses = {}
+    for name, mesh in (
+        ("multi", build_mesh(sharded_mc, jax.devices()[:4])),
+        ("single", build_mesh(MeshConfig(), jax.devices()[:1])),
+    ):
+        batch = make_batch(mesh, cfg.vocab_size, seed=11)
+        _, losses[name] = run_steps(cfg, mesh, batch, steps=3, seed=11)
+    np.testing.assert_allclose(losses["multi"], losses["single"], rtol=2e-4)
+    assert all(np.isfinite(losses["single"]))
+    assert losses["single"][-1] < losses["single"][0]
+
+
+def test_gqa_validation():
+    with pytest.raises(ValueError, match="n_kv_heads"):
+        tiny_config(n_kv_heads=3).validate(MeshConfig())  # 4 % 3 != 0
+    with pytest.raises(ValueError, match="n_kv_heads"):
+        tiny_config(n_kv_heads=2).validate(MeshConfig(tp=4))  # kv 2 % tp 4
