@@ -29,12 +29,19 @@ def device_put_batches(
     batches: Iterable[Any],
     sharding: Optional[Any] = None,
     prefetch: int = 2,
+    process_local: bool = False,
 ) -> Iterator[Any]:
     """Yield device-resident batches, keeping `prefetch` transfers in flight.
 
     `batches` yields pytrees of host arrays; each leaf is `device_put` with
     `sharding` (None = default device placement). With prefetch=2 the
     transfer of batch k+1 overlaps the compute consuming batch k.
+
+    `process_local=True`: each process's batches hold only ITS rows of the
+    globally-sharded batch (e.g. TokenDataset with rank/world set) and are
+    assembled into global arrays with
+    `jax.make_array_from_process_local_data` — the multi-host feed path
+    where no host ever materializes the global batch.
     """
     if prefetch < 1:
         raise ValueError(f"prefetch must be >= 1, got {prefetch}")
@@ -42,6 +49,11 @@ def device_put_batches(
     def put(batch):
         if sharding is None:
             return jax.device_put(batch)
+        if process_local:
+            return jax.tree.map(
+                lambda x: jax.make_array_from_process_local_data(sharding, x),
+                batch,
+            )
         return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
 
     queue: collections.deque = collections.deque()
@@ -65,6 +77,7 @@ def prefetching_fn(
     prefetch: int = 2,
     start: int = 0,
     stop: Optional[int] = None,
+    process_local: bool = False,
 ) -> Callable[[int], Any]:
     """Adapt a `make_batch(step) -> host pytree` function into one whose
     returned batches are device-resident and prefetched ahead of the
@@ -75,7 +88,7 @@ def prefetching_fn(
     the final step."""
     steps = itertools.count(start) if stop is None else iter(range(start, stop))
     source = device_put_batches(
-        (make_batch(s) for s in steps), sharding, prefetch
+        (make_batch(s) for s in steps), sharding, prefetch, process_local
     )
     expected = itertools.count(start)
 
@@ -89,3 +102,92 @@ def prefetching_fn(
         return next(source)
 
     return fetch
+
+
+class TokenDataset:
+    """Memory-mapped token corpus -> deterministic [B, seq_len+1] windows.
+
+    The real-data path of the LM workload (`workload.data.path`): a flat
+    binary file of token ids (the layout GPT-2/nanoGPT-style preprocessors
+    emit) is memory-mapped — no load-time copy, the OS pages in only what
+    training touches — and each step draws `batch_size` random windows.
+
+    Determinism is positional, not stateful: batch(step) seeds a fresh RNG
+    from (seed, step), so resuming from a checkpoint at step k reproduces
+    exactly the batches an uninterrupted run would have seen — the property
+    the gang-restart + checkpoint composition relies on (stateful iterators
+    would silently fork the data order on every restart).
+
+    `rank`/`world` restrict the materialized rows to this process's slice
+    of the global batch (row-contiguous split, matching a `P('dp', ...)`
+    batch sharding), so multi-host feeding never funnels the global batch
+    through one host.
+    """
+
+    def __init__(
+        self,
+        path: str,
+        seq_len: int,
+        batch_size: int,
+        dtype: str = "uint16",
+        seed: int = 0,
+        rank: int = 0,
+        world: int = 1,
+        vocab_size: int = 0,
+    ):
+        import numpy as np
+
+        if batch_size % world:
+            raise ValueError(
+                f"batch_size {batch_size} not divisible by world {world}"
+            )
+        self.tokens = np.memmap(path, dtype=np.dtype(dtype), mode="r")
+        if len(self.tokens) < seq_len + 1:
+            raise ValueError(
+                f"corpus {path} has {len(self.tokens)} tokens; need at "
+                f"least seq_len+1 = {seq_len + 1}"
+            )
+        self.seq_len = seq_len
+        self.batch_size = batch_size
+        self.seed = seed
+        self.rank = rank
+        self.world = world
+        self.vocab_size = vocab_size
+
+    def batch(self, step: int) -> dict:
+        """Host batch for `step`: {"inputs", "targets"} of shape
+        [batch_size/world, seq_len], targets shifted one token right."""
+        import numpy as np
+
+        rng = np.random.default_rng((self.seed, step))
+        # Exclusive high bound: the last valid window start is
+        # len - seq_len - 1, covering tokens up to and including the final
+        # one (a window is seq_len + 1 tokens: inputs + shifted targets).
+        starts = rng.integers(
+            0, len(self.tokens) - self.seq_len, size=self.batch_size
+        )
+        local = self.batch_size // self.world
+        starts = starts[self.rank * local : (self.rank + 1) * local]
+        windows = np.stack(
+            [
+                np.asarray(self.tokens[s : s + self.seq_len + 1])
+                for s in starts
+            ]
+        ).astype(np.int32)
+        if self.vocab_size and int(windows.max()) >= self.vocab_size:
+            raise ValueError(
+                f"corpus contains token id {int(windows.max())} >= the "
+                f"model's vocab_size {self.vocab_size} — out-of-vocab ids "
+                "would be silently clamped by the embedding gather"
+            )
+        return {
+            "inputs": np.ascontiguousarray(windows[:, :-1]),
+            "targets": np.ascontiguousarray(windows[:, 1:]),
+        }
+
+
+def write_token_file(path: str, tokens, dtype: str = "uint16") -> None:
+    """Write a flat token-id array in TokenDataset's binary layout."""
+    import numpy as np
+
+    np.asarray(tokens, dtype=np.dtype(dtype)).tofile(path)

@@ -228,7 +228,8 @@ def _run_loop(workload, state, train_step, make_batch,
     from .data import prefetching_fn
 
     make_batch = prefetching_fn(
-        make_batch, sharding=batch_sharding, start=start, stop=total_steps
+        make_batch, sharding=batch_sharding, start=start, stop=total_steps,
+        process_local=getattr(make_batch, "process_local", False),
     )
 
     # Observability (SURVEY.md §5): a JAX profiler trace is the TPU
@@ -361,14 +362,54 @@ def _setup_lm(workload: dict, mesh):
 
     batch_size = int(workload.get("batch_size", 4))
     seq_len = int(workload.get("seq_len", 16))
-    rng = np.random.default_rng(0)
 
-    def make_batch(step):
-        tokens = rng.integers(0, cfg.vocab_size, (batch_size, seq_len + 1))
-        return {
-            "inputs": np.ascontiguousarray(tokens[:, :-1]),
-            "targets": np.ascontiguousarray(tokens[:, 1:]),
-        }
+    # Multi-process gangs feed process-locally when the batch's dp rows
+    # split evenly across processes (build_mesh lays dp process-major, so
+    # each process's contiguous row block IS its addressable dp shard);
+    # otherwise every host materializes the (small) global batch and
+    # device_put slices — correct either way.
+    world = jax.process_count()
+    rank = jax.process_index()
+    process_local = world > 1 and batch_size % world == 0 and (
+        mesh.shape["dp"] % world == 0
+    )
+    if not process_local:
+        rank, world = 0, 1
+
+    data_cfg = workload.get("data") or {}
+    if data_cfg.get("path"):
+        # Real-data path: memmap'd token corpus with positionally
+        # deterministic batches (resume at step k == uninterrupted run).
+        from .data import TokenDataset
+
+        dataset = TokenDataset(
+            data_cfg["path"],
+            seq_len=seq_len,
+            batch_size=batch_size,
+            dtype=data_cfg.get("dtype", "uint16"),
+            seed=int(data_cfg.get("seed", 0)),
+            rank=rank,
+            world=world,
+            vocab_size=cfg.vocab_size,
+        )
+        def make_batch(step):
+            return dataset.batch(step)
+    else:
+        # Synthetic fallback: positionally seeded too, for the same
+        # restart-reproducibility property; same rank-slicing contract.
+        local = batch_size // world
+
+        def make_batch(step):
+            rng = np.random.default_rng((17, step))
+            tokens = rng.integers(0, cfg.vocab_size, (batch_size, seq_len + 1))
+            tokens = tokens[rank * local : (rank + 1) * local]
+            return {
+                "inputs": np.ascontiguousarray(tokens[:, :-1]),
+                "targets": np.ascontiguousarray(tokens[:, 1:]),
+            }
+
+    # Consumed by _run_loop to pick the matching placement path.
+    make_batch.process_local = process_local
 
     return (params, optimizer, train_step, make_batch,
             NamedSharding(mesh, P("dp", "sp")), opt_state)

@@ -61,3 +61,63 @@ def test_prefetching_fn_keeps_existing_device_batches_sharded():
 
     fetch = prefetching_fn(make)
     assert fetch(0).sharding == sharding
+
+
+def test_token_dataset_deterministic_and_sharded(tmp_path):
+    """batch(step) is a pure function of (seed, step) — the property the
+    checkpoint-resume composition relies on — and rank/world slices rows."""
+    import numpy as np
+
+    from jobset_tpu.runtime.data import TokenDataset, write_token_file
+
+    path = str(tmp_path / "corpus.bin")
+    write_token_file(path, np.arange(1000) % 50)
+
+    ds = TokenDataset(path, seq_len=8, batch_size=4, seed=3)
+    a, b = ds.batch(5), ds.batch(5)
+    np.testing.assert_array_equal(a["inputs"], b["inputs"])
+    assert a["inputs"].shape == (4, 8)
+    # Targets are inputs shifted by one.
+    np.testing.assert_array_equal(a["inputs"][:, 1:], a["targets"][:, :-1])
+    # Different steps draw different windows.
+    assert not np.array_equal(ds.batch(6)["inputs"], a["inputs"])
+
+    # rank/world: each rank gets its contiguous row slice of the full batch.
+    full = TokenDataset(path, seq_len=8, batch_size=4, seed=3).batch(5)
+    for rank in range(2):
+        part = TokenDataset(
+            path, seq_len=8, batch_size=4, seed=3, rank=rank, world=2
+        ).batch(5)
+        np.testing.assert_array_equal(
+            part["inputs"], full["inputs"][rank * 2 : (rank + 1) * 2]
+        )
+
+
+def test_lm_workload_trains_on_token_file(tmp_path):
+    """The workload surface reaches TokenDataset via data.path, and a
+    strongly-patterned corpus trains to a fast-dropping loss."""
+    import numpy as np
+
+    from jobset_tpu.runtime.data import write_token_file
+    from jobset_tpu.runtime.runner import train_workload
+    from jobset_tpu.parallel import MeshConfig, build_mesh
+
+    path = str(tmp_path / "corpus.bin")
+    write_token_file(path, np.tile(np.arange(16), 200))  # repeating pattern
+
+    mesh = build_mesh(MeshConfig(), jax.devices()[:1], allow_submesh=True)
+    losses = train_workload(
+        {
+            "kind": "lm",
+            "steps": 8,
+            "batch_size": 4,
+            "seq_len": 16,
+            "data": {"path": path},
+            "config": {
+                "vocab_size": 16, "d_model": 32, "n_heads": 4, "d_ff": 64,
+                "n_layers": 2, "remat": False,
+            },
+        },
+        mesh,
+    )
+    assert losses[-1] < losses[0] * 0.8, losses

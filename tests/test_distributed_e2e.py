@@ -167,3 +167,73 @@ def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
+
+
+@pytest.mark.timeout(300)
+def test_worker_gang_trains_lm_from_token_file_process_locally(tmp_path):
+    """Two real worker processes train the LM from a memmap'd token corpus
+    with PROCESS-LOCAL feeding: batch_size 4 over a dp=2 two-process mesh
+    means each host materializes only its 2 rows and the global batch is
+    assembled via make_array_from_process_local_data. Losses must agree
+    across ranks (SPMD) and drop fast on the repetitive corpus."""
+    import numpy as np
+
+    from jobset_tpu.runtime.data import write_token_file
+
+    corpus = str(tmp_path / "corpus.bin")
+    write_token_file(corpus, np.tile(np.arange(16), 300))
+
+    cluster = make_cluster()
+    cluster.add_topology("rack", num_domains=2, nodes_per_domain=2, capacity=8)
+    js = (
+        make_jobset("lmgang")
+        .replicated_job(
+            make_replicated_job("w").replicas(2).parallelism(1).completions(1).obj()
+        )
+        .obj()
+    )
+    workload = {
+        "kind": "lm",
+        "steps": 8,
+        "batch_size": 4,
+        "seq_len": 16,
+        "mesh": {"dp": 2},
+        "data": {"path": corpus},
+        "config": {
+            "vocab_size": 16, "d_model": 32, "n_heads": 4, "d_ff": 64,
+            "n_layers": 2, "remat": False,
+        },
+    }
+    js.spec.replicated_jobs[0].template.spec.template.spec.workload = workload
+    cluster.create_jobset(js)
+    cluster.run_until_stable()
+
+    port = _free_port()
+    procs = []
+    for job_idx in range(2):
+        pod = cluster.resolve_hostname("default", f"lmgang-w-{job_idx}-0.lmgang")
+        env = pod_env_for(cluster, pod)
+        env[ENV_COORDINATOR] = f"127.0.0.1:{port}"
+        worker_env = {**os.environ, **env}
+        worker_env.pop("PYTHONPATH", None)
+        worker_env.pop("XLA_FLAGS", None)
+        worker_env["JAX_PLATFORMS"] = "cpu"
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-m", "jobset_tpu.runtime.worker", "--cpu"],
+                env=worker_env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+            )
+        )
+
+    results = []
+    for p in procs:
+        stdout, stderr = p.communicate(timeout=280)
+        assert p.returncode == 0, stderr.decode()[-2000:]
+        results.append(json.loads(stdout.decode().strip().splitlines()[-1]))
+
+    for r in results:
+        assert r["world"] == 2
+        assert r["final_loss"] < r["initial_loss"] * 0.8
+    assert results[0]["final_loss"] == pytest.approx(results[1]["final_loss"])
