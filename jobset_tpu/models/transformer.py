@@ -695,6 +695,42 @@ def build_train_step(
     return train_step
 
 
+def build_eval_step(config: TransformerConfig, mesh: Mesh):
+    """Jitted eval_step(params, batch) -> mean per-token cross-entropy,
+    replicated. The loss-only half of `build_train_step` (same
+    `_local_loss_fn`, same batch sharding contract, no grad/update) for
+    held-out evaluation during training."""
+    cfg = config
+    specs = param_specs(cfg)
+    n_micro = cfg.n_microbatches or axis_size(mesh, "pp")
+
+    def local_loss(params, inputs, targets, mask):
+        loss_sum, total, _ = _local_loss_fn(
+            params, inputs, targets, mask, cfg, n_micro
+        )
+        return loss_sum / jnp.maximum(total, 1.0)
+
+    sharded = jax.shard_map(
+        local_loss,
+        mesh=mesh,
+        in_specs=(specs, P("dp", "sp"), P("dp", "sp"), P("dp", "sp")),
+        out_specs=P(),
+    )
+
+    @jax.jit
+    def eval_step(params, batch):
+        mask = batch.get("mask")
+        if mask is None:
+            mask = jnp.ones_like(batch["targets"], jnp.float32)
+        loss = sharded(
+            params, batch["inputs"], batch["targets"],
+            mask.astype(jnp.float32),
+        )
+        return jax.lax.with_sharding_constraint(loss, NamedSharding(mesh, P()))
+
+    return eval_step
+
+
 def build_forward(config: TransformerConfig, mesh: Mesh):
     """Jitted forward(params, tokens) -> logits [B, T, vocab] (tp-gathered).
     Used for evaluation and the single-chip entry point."""

@@ -47,14 +47,7 @@ def device_put_batches(
         raise ValueError(f"prefetch must be >= 1, got {prefetch}")
 
     def put(batch):
-        if sharding is None:
-            return jax.device_put(batch)
-        if process_local:
-            return jax.tree.map(
-                lambda x: jax.make_array_from_process_local_data(sharding, x),
-                batch,
-            )
-        return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+        return place_batch(batch, sharding, process_local)
 
     queue: collections.deque = collections.deque()
     it = iter(batches)
@@ -69,6 +62,22 @@ def device_put_batches(
 
 
 _SENTINEL = object()
+
+
+def place_batch(batch: Any, sharding: Optional[Any], process_local: bool = False) -> Any:
+    """Place one host batch onto devices: `device_put` with `sharding`
+    (None = default placement), or — when `process_local` — assemble a
+    global array from this process's rows via
+    `jax.make_array_from_process_local_data`. The single placement-dispatch
+    used by the prefetching pipeline and the eval path alike."""
+    if sharding is None:
+        return jax.device_put(batch)
+    if process_local:
+        return jax.tree.map(
+            lambda x: jax.make_array_from_process_local_data(sharding, x),
+            batch,
+        )
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
 
 
 def prefetching_fn(

@@ -207,9 +207,19 @@ def _scalar(x) -> float:
     return float(x)
 
 
+class TrainResult(list):
+    """Per-step train losses, plus the held-out eval history as
+    `.val_losses` ([(step, loss), ...]) — a list subclass so every caller
+    that treats the result as the loss list keeps working unchanged."""
+
+    def __init__(self, losses=(), val_losses=()):
+        super().__init__(losses)
+        self.val_losses = list(val_losses)
+
+
 def _run_loop(workload, state, train_step, make_batch,
-              batch_sharding=None, restarts: int = 0):
-    """Shared step loop: restore -> step -> (maybe fail) -> checkpoint."""
+              batch_sharding=None, restarts: int = 0, eval_fn=None):
+    """Shared step loop: restore -> step -> eval cadence -> checkpoint."""
     import jax
 
     ckpt, every = _checkpointer(workload)
@@ -245,6 +255,8 @@ def _run_loop(workload, state, train_step, make_batch,
     )
 
     losses = []
+    val_losses = []
+    eval_every = int(workload.get("eval_every", 0))
     try:
         with profiler:
             for step in range(start, total_steps):
@@ -259,12 +271,18 @@ def _run_loop(workload, state, train_step, make_batch,
                 )
                 state = {"params": params, "opt_state": opt_state}
                 losses.append(_scalar(loss))
+                if (
+                    eval_fn is not None
+                    and eval_every
+                    and (step + 1) % eval_every == 0
+                ):
+                    val_losses.append((step + 1, eval_fn(params, step + 1)))
                 if ckpt is not None and (step + 1) % every == 0:
                     ckpt.save(step + 1, {"state": state, "step": step + 1})
     finally:
         if ckpt is not None:
             ckpt.close()
-    return losses
+    return TrainResult(losses, val_losses)
 
 
 def _setup_mlp(workload: dict, mesh):
@@ -289,7 +307,7 @@ def _setup_mlp(workload: dict, mesh):
         return {"x": x, "y": y}
 
     return (params, optimizer, train_step, make_batch,
-            NamedSharding(mesh, P(("dp", "sp"))), None)
+            NamedSharding(mesh, P(("dp", "sp"))), None, None)
 
 
 def _setup_cnn(workload: dict, mesh):
@@ -321,7 +339,7 @@ def _setup_cnn(workload: dict, mesh):
         return {"images": images, "labels": labels}
 
     return (params, optimizer, train_step, make_batch,
-            NamedSharding(mesh, P("dp")), None)
+            NamedSharding(mesh, P("dp")), None, None)
 
 
 def _setup_lm(workload: dict, mesh):
@@ -376,6 +394,23 @@ def _setup_lm(workload: dict, mesh):
     if not process_local:
         rank, world = 0, 1
 
+    def synthetic_batches(seed: int):
+        """Positionally-seeded synthetic token stream (restart-reproducible),
+        rank-sliced under process-local feeding; one factory serves both the
+        train fallback and the val fallback (distinct seeds)."""
+        local = batch_size // world
+
+        def make(step):
+            rng = np.random.default_rng((seed, step))
+            tokens = rng.integers(0, cfg.vocab_size, (batch_size, seq_len + 1))
+            tokens = tokens[rank * local : (rank + 1) * local]
+            return {
+                "inputs": np.ascontiguousarray(tokens[:, :-1]),
+                "targets": np.ascontiguousarray(tokens[:, 1:]),
+            }
+
+        return make
+
     data_cfg = workload.get("data") or {}
     if data_cfg.get("path"):
         # Real-data path: memmap'd token corpus with positionally
@@ -395,24 +430,52 @@ def _setup_lm(workload: dict, mesh):
         def make_batch(step):
             return dataset.batch(step)
     else:
-        # Synthetic fallback: positionally seeded too, for the same
-        # restart-reproducibility property; same rank-slicing contract.
-        local = batch_size // world
-
-        def make_batch(step):
-            rng = np.random.default_rng((17, step))
-            tokens = rng.integers(0, cfg.vocab_size, (batch_size, seq_len + 1))
-            tokens = tokens[rank * local : (rank + 1) * local]
-            return {
-                "inputs": np.ascontiguousarray(tokens[:, :-1]),
-                "targets": np.ascontiguousarray(tokens[:, 1:]),
-            }
+        make_batch = synthetic_batches(17)
 
     # Consumed by _run_loop to pick the matching placement path.
     make_batch.process_local = process_local
+    batch_sharding = NamedSharding(mesh, P("dp", "sp"))
+
+    # Held-out evaluation (workload.eval_every > 0): the loss-only step on
+    # batches from data.val_path (or a synthetic stream disjoint from the
+    # training seed), averaged over eval_steps draws per evaluation.
+    eval_fn = None
+    if int(workload.get("eval_every", 0)) > 0:
+        from ..models.transformer import build_eval_step
+
+        eval_step = build_eval_step(cfg, mesh)
+        eval_steps = int(workload.get("eval_steps", 2))
+        if data_cfg.get("val_path"):
+            from .data import TokenDataset
+
+            val_ds = TokenDataset(
+                data_cfg["val_path"],
+                seq_len=seq_len,
+                batch_size=batch_size,
+                dtype=data_cfg.get("dtype", "uint16"),
+                seed=int(data_cfg.get("seed", 0)) + 1,
+                rank=rank,
+                world=world,
+                vocab_size=cfg.vocab_size,
+            )
+            make_val = val_ds.batch
+        else:
+            make_val = synthetic_batches(29)
+
+        from .data import place_batch
+
+        def eval_fn(p, at_step):
+            vals = [
+                _scalar(eval_step(p, place_batch(
+                    make_val(at_step * 1000 + i), batch_sharding,
+                    process_local,
+                )))
+                for i in range(eval_steps)
+            ]
+            return sum(vals) / len(vals)
 
     return (params, optimizer, train_step, make_batch,
-            NamedSharding(mesh, P("dp", "sp")), opt_state)
+            batch_sharding, opt_state, eval_fn)
 
 
 _SETUPS = {"mlp": _setup_mlp, "cnn": _setup_cnn, "lm": _setup_lm}
@@ -427,9 +490,8 @@ def train_workload(workload: dict, mesh, restarts: int = 0) -> list:
     setup = _SETUPS.get(kind)
     if setup is None:
         raise ValueError(f"unknown workload kind: {kind}")
-    params, optimizer, train_step, make_batch, batch_sharding, opt_state = (
-        setup(workload, mesh)
-    )
+    (params, optimizer, train_step, make_batch, batch_sharding, opt_state,
+     eval_fn) = setup(workload, mesh)
     state = {
         "params": params,
         "opt_state": (
@@ -439,7 +501,7 @@ def train_workload(workload: dict, mesh, restarts: int = 0) -> list:
     }
     return _run_loop(
         workload, state, train_step, make_batch, batch_sharding,
-        restarts=restarts,
+        restarts=restarts, eval_fn=eval_fn,
     )
 
 
@@ -448,3 +510,6 @@ def _record_losses(js, losses) -> None:
         return
     js.metadata.annotations["tpu.jobset.x-k8s.io/initial-loss"] = f"{losses[0]:.6f}"
     js.metadata.annotations["tpu.jobset.x-k8s.io/final-loss"] = f"{losses[-1]:.6f}"
+    val = getattr(losses, "val_losses", None)
+    if val:
+        js.metadata.annotations["tpu.jobset.x-k8s.io/val-loss"] = f"{val[-1][1]:.6f}"

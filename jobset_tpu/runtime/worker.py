@@ -125,6 +125,8 @@ def main(argv=None) -> int:
             "steps": len(losses),
             "initial_loss": losses[0] if losses else None,
             "final_loss": losses[-1] if losses else None,
+            # Held-out eval history [(step, loss), ...] when eval_every>0.
+            "val_losses": getattr(losses, "val_losses", []),
         }),
         flush=True,
     )

@@ -198,6 +198,7 @@ def test_worker_gang_trains_lm_from_token_file_process_locally(tmp_path):
         "batch_size": 4,
         "seq_len": 16,
         "mesh": {"dp": 2},
+        "eval_every": 4,
         "data": {"path": corpus},
         "config": {
             "vocab_size": 16, "d_model": 32, "n_heads": 4, "d_ff": 64,
@@ -236,4 +237,8 @@ def test_worker_gang_trains_lm_from_token_file_process_locally(tmp_path):
     for r in results:
         assert r["world"] == 2
         assert r["final_loss"] < r["initial_loss"] * 0.8
+        assert len(r["val_losses"]) == 2  # steps 4 and 8
     assert results[0]["final_loss"] == pytest.approx(results[1]["final_loss"])
+    # Held-out eval is SPMD too: identical val history on every rank.
+    for (s0, v0), (s1, v1) in zip(results[0]["val_losses"], results[1]["val_losses"]):
+        assert s0 == s1 and v0 == pytest.approx(v1)

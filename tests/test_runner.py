@@ -193,3 +193,38 @@ def test_lm_workload_with_ulysses_attention():
     )
     runner.run_pending()
     assert js.status.terminal_state == keys.JOBSET_COMPLETED
+
+
+def test_lm_workload_with_held_out_eval(tmp_path):
+    """eval_every runs the loss-only step on held-out data and records the
+    last val loss as an annotation; on a train/val split of the same
+    repetitive corpus, val loss tracks train loss down."""
+    import numpy as np
+
+    from jobset_tpu.runtime.data import write_token_file
+
+    train = str(tmp_path / "train.bin")
+    val = str(tmp_path / "val.bin")
+    write_token_file(train, np.tile(np.arange(16), 300))
+    write_token_file(val, np.tile(np.arange(16), 60))
+
+    cluster, js, runner = build(
+        {
+            "kind": "lm",
+            "steps": 8,
+            "batch_size": 4,
+            "seq_len": 16,
+            "eval_every": 4,
+            "eval_steps": 2,
+            "data": {"path": train, "val_path": val},
+            "config": {
+                "vocab_size": 16, "d_model": 32, "n_heads": 4, "d_ff": 64,
+                "n_layers": 2, "remat": False,
+            },
+        }
+    )
+    runner.run_pending()
+    assert js.status.terminal_state == keys.JOBSET_COMPLETED
+    val_loss = float(js.metadata.annotations["tpu.jobset.x-k8s.io/val-loss"])
+    initial = float(js.metadata.annotations["tpu.jobset.x-k8s.io/initial-loss"])
+    assert np.isfinite(val_loss) and val_loss < initial
