@@ -27,7 +27,7 @@ def _clone(obj):
 # ---------------------------------------------------------------------------
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, slots=True)
 class Toleration:
     """Analog of corev1.Toleration (only the fields the framework touches).
 
@@ -49,7 +49,7 @@ class Toleration:
         return self.key == taint.key and self.value == taint.value
 
 
-@dataclass
+@dataclass(slots=True)
 class Taint:
     """Analog of corev1.Taint."""
 
@@ -58,7 +58,7 @@ class Taint:
     effect: str = "NoSchedule"
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, slots=True)
 class AffinityTerm:
     """One required pod (anti-)affinity term over the job-key label.
 
@@ -90,7 +90,7 @@ class AffinityTerm:
                 object.__setattr__(self, f, tuple(v))
 
 
-@dataclass
+@dataclass(slots=True)
 class Affinity:
     pod_affinity: list[AffinityTerm] = field(default_factory=list)
     pod_anti_affinity: list[AffinityTerm] = field(default_factory=list)
@@ -106,7 +106,7 @@ class Affinity:
         return new
 
 
-@dataclass
+@dataclass(slots=True)
 class PodSpec:
     """Reduced corev1.PodSpec carrying the fields the framework reads/writes."""
 
@@ -130,18 +130,21 @@ class PodSpec:
         # replace or re-list them); only the mutable containers and the
         # free-form `workload` get copied.
         new = object.__new__(PodSpec)
-        d = dict(self.__dict__)
-        d["node_selector"] = dict(d["node_selector"])
-        d["tolerations"] = list(d["tolerations"])
-        d["scheduling_gates"] = list(d["scheduling_gates"])
-        if d["affinity"] is not None:
-            d["affinity"] = d["affinity"].clone()
-        d["workload"] = copy.deepcopy(d["workload"]) if d["workload"] else {}
-        new.__dict__ = d
+        new.restart_policy = self.restart_policy
+        new.node_selector = dict(self.node_selector)
+        new.tolerations = list(self.tolerations)
+        new.affinity = (
+            self.affinity.clone() if self.affinity is not None else None
+        )
+        new.subdomain = self.subdomain
+        new.hostname = self.hostname
+        new.scheduling_gates = list(self.scheduling_gates)
+        new.node_name = self.node_name
+        new.workload = copy.deepcopy(self.workload) if self.workload else {}
         return new
 
 
-@dataclass
+@dataclass(slots=True)
 class PodTemplateSpec:
     labels: dict[str, str] = field(default_factory=dict)
     annotations: dict[str, str] = field(default_factory=dict)
@@ -155,7 +158,7 @@ class PodTemplateSpec:
         )
 
 
-@dataclass
+@dataclass(slots=True)
 class JobSpec:
     """Reduced batchv1.JobSpec."""
 
@@ -188,7 +191,7 @@ class JobSpec:
         )
 
 
-@dataclass
+@dataclass(slots=True)
 class JobTemplateSpec:
     """Analog of batchv1.JobTemplateSpec (metadata + spec)."""
 
@@ -202,7 +205,7 @@ class JobTemplateSpec:
 # ---------------------------------------------------------------------------
 
 
-@dataclass
+@dataclass(slots=True)
 class ReplicatedJob:
     """`replicas` Jobs stamped from one template; job names are
     `<jobset>-<name>-<jobIdx>` (jobset_types.go:217-228)."""
@@ -212,7 +215,7 @@ class ReplicatedJob:
     replicas: int = 1
 
 
-@dataclass
+@dataclass(slots=True)
 class Network:
     """DNS config (jobset_types.go:230-247): pod hostnames are
     `<jobset>-<rjob>-<jobIdx>-<podIdx>.<subdomain>`."""
@@ -222,7 +225,7 @@ class Network:
     publish_not_ready_addresses: Optional[bool] = None
 
 
-@dataclass
+@dataclass(slots=True)
 class SuccessPolicy:
     """Operator All/Any over target replicated jobs (jobset_types.go:312-322)."""
 
@@ -230,7 +233,7 @@ class SuccessPolicy:
     target_replicated_jobs: list[str] = field(default_factory=list)
 
 
-@dataclass
+@dataclass(slots=True)
 class FailurePolicyRule:
     """First-match rule: (failure reason, parent rjob) -> action
     (jobset_types.go:283-310)."""
@@ -241,18 +244,18 @@ class FailurePolicyRule:
     target_replicated_jobs: list[str] = field(default_factory=list)
 
 
-@dataclass
+@dataclass(slots=True)
 class FailurePolicy:
     max_restarts: int = 0
     rules: list[FailurePolicyRule] = field(default_factory=list)
 
 
-@dataclass
+@dataclass(slots=True)
 class StartupPolicy:
     startup_policy_order: str = "AnyOrder"  # "AnyOrder" | "InOrder"
 
 
-@dataclass
+@dataclass(slots=True)
 class Coordinator:
     """Which pod is the coordinator; its stable endpoint is stamped on all
     jobs/pods (jobset_types.go:345-357)."""
@@ -262,7 +265,7 @@ class Coordinator:
     pod_index: int = 0
 
 
-@dataclass
+@dataclass(slots=True)
 class JobSetSpec:
     replicated_jobs: list[ReplicatedJob] = field(default_factory=list)
     network: Optional[Network] = None
@@ -280,7 +283,7 @@ class JobSetSpec:
 # ---------------------------------------------------------------------------
 
 
-@dataclass
+@dataclass(slots=True)
 class Condition:
     """Analog of metav1.Condition."""
 
@@ -291,7 +294,7 @@ class Condition:
     last_transition_time: float = 0.0
 
 
-@dataclass
+@dataclass(slots=True)
 class ReplicatedJobStatus:
     name: str = ""
     ready: int = 0
@@ -311,7 +314,7 @@ class ReplicatedJobStatus:
         )
 
 
-@dataclass
+@dataclass(slots=True)
 class JobSetStatus:
     conditions: list[Condition] = field(default_factory=list)
     restarts: int = 0
@@ -325,7 +328,7 @@ class JobSetStatus:
 # ---------------------------------------------------------------------------
 
 
-@dataclass
+@dataclass(slots=True)
 class ObjectMeta:
     name: str = ""
     # apiserver semantics: when name is empty, the server appends a random
@@ -340,7 +343,7 @@ class ObjectMeta:
     owner_uid: str = ""  # controller owner reference (single-owner model)
 
 
-@dataclass
+@dataclass(slots=True)
 class JobSet:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: JobSetSpec = field(default_factory=JobSetSpec)

@@ -100,6 +100,13 @@ class Cluster:
         self.pending_pod_keys: dict[tuple[str, str], None] = {}
         self._newly_bound: deque[tuple[str, str]] = deque()
         self.leader_pod_keys: set[tuple[str, str]] = set()
+        # Pod-event queue for the PodReconciler (the watch-filter analog of
+        # pod_controller.go:63-73): job-keys whose pod set changed since the
+        # last placement-enforcement pass. Like the real controller — which
+        # reconciles on pod WATCH events, not by scanning — a placement is
+        # only revalidated when one of its pods changes (see touch_pod for
+        # out-of-band spec mutations).
+        self.dirty_placement_job_keys: set[str] = set()
 
         # Domain occupancy for exclusive placement, maintained by the
         # scheduler: topology_key -> domain value -> set of job keys present.
@@ -142,6 +149,28 @@ class Cluster:
     def pod_suffix(self) -> str:
         """Deterministic stand-in for the kubelet's random 5-char pod suffix."""
         return _base36(next(self._uid_iter) * 2654435761 % 36**5)
+
+    @staticmethod
+    def _placement_event(pod: Pod) -> Optional[str]:
+        """job_key to mark for placement enforcement, or None: mirrors the
+        PodReconciler's watch filter — only exclusive-placement pods (not
+        using the nodeSelector strategy) generate enforcement work."""
+        if (
+            keys.EXCLUSIVE_KEY in pod.annotations
+            and keys.NODE_SELECTOR_STRATEGY_KEY not in pod.annotations
+        ):
+            return pod.labels.get(keys.JOB_KEY)
+        return None
+
+    def touch_pod(self, pod: Pod) -> None:
+        """Signal an out-of-band pod mutation (the UPDATE watch event a real
+        apiserver would emit): re-enqueues the pod's owner job and its
+        placement check. Tests that mutate a pod's spec directly must call
+        this — the reconcilers are event-driven, like the reference's."""
+        self.dirty_job_uids.add(pod.metadata.owner_uid)
+        job_key = self._placement_event(pod)
+        if job_key:
+            self.dirty_placement_job_keys.add(job_key)
 
     def record_event(self, kind: str, name: str, etype: str, reason: str, message: str):
         self.events.append(
@@ -444,6 +473,8 @@ class Cluster:
         if not pod.spec.node_name:
             self.pending_pod_keys[key] = None
         self.dirty_job_uids.add(owner.metadata.uid)
+        if (pk := self._placement_event(pod)):
+            self.dirty_placement_job_keys.add(pk)
         return pod
 
     def delete_pod(
@@ -468,6 +499,8 @@ class Cluster:
         self.pending_pod_keys.pop(key, None)
         self.leader_pod_keys.discard(key)
         self.dirty_job_uids.add(pod.metadata.owner_uid)
+        if (pk := self._placement_event(pod)):
+            self.dirty_placement_job_keys.add(pk)
 
     def get_pod(self, namespace: str, name: str) -> Optional[Pod]:
         return self.pods.get((namespace, name))
@@ -526,6 +559,8 @@ class Cluster:
         self._newly_bound.append(key)
         topology_key = pod.annotations.get(keys.EXCLUSIVE_KEY)
         job_key = pod.labels.get(keys.JOB_KEY)
+        if (pk := self._placement_event(pod)):
+            self.dirty_placement_job_keys.add(pk)
         if (
             topology_key
             and keys.NODE_SELECTOR_STRATEGY_KEY not in pod.annotations

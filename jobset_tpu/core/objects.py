@@ -22,7 +22,7 @@ POD_SUCCEEDED = "Succeeded"
 POD_FAILED = "Failed"
 
 
-@dataclass
+@dataclass(slots=True)
 class Node:
     name: str
     labels: dict[str, str] = field(default_factory=dict)
@@ -37,14 +37,14 @@ class Node:
         return self.capacity - self.allocated
 
 
-@dataclass
+@dataclass(slots=True)
 class PodStatus:
     phase: str = POD_PENDING
     ready: bool = False
     conditions: list[Condition] = field(default_factory=list)
 
 
-@dataclass
+@dataclass(slots=True)
 class Pod:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: PodSpec = field(default_factory=PodSpec)
@@ -75,7 +75,7 @@ class Pod:
         return int(idx) if idx is not None else None
 
 
-@dataclass
+@dataclass(slots=True)
 class JobStatus:
     active: int = 0
     ready: int = 0
@@ -86,7 +86,7 @@ class JobStatus:
     conditions: list[Condition] = field(default_factory=list)
 
 
-@dataclass
+@dataclass(slots=True)
 class Job:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: JobSpec = field(default_factory=JobSpec)
@@ -122,7 +122,7 @@ class Job:
         return self.spec.pods_expected()
 
 
-@dataclass
+@dataclass(slots=True)
 class Service:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     cluster_ip: str = "None"  # headless
@@ -138,7 +138,7 @@ class Service:
         return self.metadata.namespace
 
 
-@dataclass
+@dataclass(slots=True)
 class Event:
     """Recorded cluster event (k8s Event analog)."""
 

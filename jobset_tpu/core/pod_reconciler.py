@@ -33,15 +33,28 @@ class PodReconciler:
         )
 
     def sync(self) -> bool:
-        # Index-driven (cluster.leader_pod_keys, maintained on bind/delete):
-        # visits only the watched scheduled leaders instead of scanning the
-        # whole pod store per tick — the event-filter analog of
-        # pod_controller.go:63-73.
+        # Event-driven, like the real controller (pod_controller.go:63-73
+        # reconciles on pod WATCH events, not by scanning): visit only jobs
+        # whose pod set changed since the last pass
+        # (cluster.dirty_placement_job_keys, fed by pod create/bind/delete
+        # and cluster.touch_pod), then check their bound leaders. A
+        # placement that saw no pod events cannot have drifted.
+        cluster = self.cluster
+        dirty, cluster.dirty_placement_job_keys = (
+            cluster.dirty_placement_job_keys, set()
+        )
         changed = False
-        for key in sorted(self.cluster.leader_pod_keys):
-            pod = self.cluster.pods.get(key)
-            if pod is not None and self._watched(pod):
-                changed |= self.reconcile_leader(pod)
+        for job_key in sorted(dirty):
+            leader = next(
+                (
+                    cluster.pods[k]
+                    for k in cluster.pods_by_job_key.get(job_key, ())
+                    if k in cluster.leader_pod_keys
+                ),
+                None,
+            )
+            if leader is not None and self._watched(leader):
+                changed |= self.reconcile_leader(leader)
         return changed
 
     def reconcile_leader(self, leader: Pod) -> bool:

@@ -119,6 +119,7 @@ def test_drift_enforcement_deletes_mismatched_followers():
         v for v in cluster.domain_nodes(TOPOLOGY) if v != leader_domain
     )
     follower.spec.node_selector[TOPOLOGY] = other_domain
+    cluster.touch_pod(follower)  # the UPDATE event a real apiserver emits
     name = follower.metadata.name
 
     cluster.run_until_stable()

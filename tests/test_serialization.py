@@ -326,3 +326,17 @@ def test_job_spec_clone_matches_deepcopy_and_is_deep():
         clone.template.spec.affinity.pod_affinity[0].topology_key = "changed"
     # The term's key sequences are tuples — immutable, no append to leak.
     assert spec.template.spec.affinity.pod_affinity[0].job_key_in == ("jk1",)
+
+
+def test_pod_spec_clone_covers_every_field():
+    """clone() bypasses __init__ (object.__new__ + explicit per-field
+    copies), so with slots a field added to PodSpec but not to clone()
+    would surface as a far-away AttributeError — catch it here instead."""
+    import dataclasses
+
+    from jobset_tpu.api.types import PodSpec
+
+    spec = PodSpec()
+    cloned = spec.clone()
+    for f in dataclasses.fields(PodSpec):
+        assert getattr(cloned, f.name) == getattr(spec, f.name)
