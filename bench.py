@@ -491,22 +491,25 @@ def main() -> int:
     attempts = []
     if not _cpu_forced():
         # Gate the expensive TPU attempt on a cheap reachability probe,
-        # retried once after a pause (the tunnel wedges transiently): a
-        # failed probe means `jax.devices()` itself hangs, so the full
-        # attempt would forfeit its whole 420s budget for nothing.
+        # retried across a few spaced attempts (the tunnel wedges
+        # transiently — observed stretches of minutes — and a failed probe
+        # means `jax.devices()` itself hangs, so the full attempt would
+        # forfeit its whole 420s budget for nothing).
         probe_s = _env_float("BENCH_PROBE_DEADLINE_S", 90.0)
-        for attempt in range(2):
+        probe_tries = max(1, int(_env_float("BENCH_PROBE_TRIES", 3)))
+        for attempt in range(probe_tries):
             if _probe_device(probe_s):
                 attempts.append((TPU_ATTEMPT_DEADLINE_S, False))
                 break
+            last = attempt == probe_tries - 1
             print(
-                f"device probe {attempt + 1} timed out after {probe_s:.0f}s"
-                + ("; retrying in 30s" if attempt == 0 else
-                   "; skipping the TPU attempt"),
+                f"device probe {attempt + 1}/{probe_tries} timed out after "
+                f"{probe_s:.0f}s"
+                + ("; skipping the TPU attempt" if last else "; retrying in 45s"),
                 file=sys.stderr,
             )
-            if attempt == 0:
-                time.sleep(30)
+            if not last:
+                time.sleep(45)
     attempts.append((CPU_ATTEMPT_DEADLINE_S, True))
 
     for deadline_s, force_cpu in attempts:
