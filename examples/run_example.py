@@ -41,6 +41,14 @@ def main() -> int:
 
     if not args.tpu:
         os.environ["JAX_PLATFORMS"] = "cpu"
+        # Virtual multi-device CPU platform (same as the test suite's
+        # conftest): examples with an explicit workload mesh (e.g. sp x tp)
+        # need more than one device. Must be set before jax initializes.
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
         import jax
 
         # Env var alone is not enough under the axon sitecustomize, which
