@@ -32,6 +32,7 @@ from .transformer import (
     repeat_kv,
     rms_norm,
     rotary,
+    unembed_logits,
 )
 
 NEG_INF = -1.0e30
@@ -234,9 +235,7 @@ def _run_stack(params, x, cache, cfg, layer_fn):
         body, x, (stage_params, cache["k"], cache["v"])
     )
     xn = rms_norm(x[:, -1:], params["final_norm"], cfg.norm_eps)
-    logits = jnp.einsum(
-        "btd,dv->btv", xn.astype(cfg.dtype), params["unembed"].astype(cfg.dtype)
-    )
+    logits = unembed_logits(params, xn, cfg)
     return logits[:, 0].astype(jnp.float32), {"k": new_k, "v": new_v}
 
 

@@ -82,6 +82,10 @@ class TransformerConfig:
     param_dtype: Any = jnp.float32
     remat: bool = True
     n_microbatches: int = 0  # 0 -> defaults to pp size
+    # Tie the output projection to the embedding (logits = x @ embed^T):
+    # halves the vocab parameter count; both uses share one vocab-sharded
+    # [V, d] matrix and gradients flow into it from both ends.
+    tie_embeddings: bool = False
     # Sequence-parallel attention strategy over the sp axis: "ring" rotates
     # K/V around the torus (head-count-independent sp, O(T_local) K/V
     # resident); "ulysses" re-shards heads with two all_to_alls (cheaper
@@ -146,7 +150,6 @@ def param_specs(config: TransformerConfig) -> dict:
     specs = {
         "embed": P("tp", None),  # vocab-sharded
         "final_norm": P(None),
-        "unembed": P(None, "tp"),
         "layers": {
             "ln1": P("pp", None, None),
             "ln2": P("pp", None, None),
@@ -156,6 +159,8 @@ def param_specs(config: TransformerConfig) -> dict:
             "wo": P("pp", None, "tp", None),
         },
     }
+    if not config.tie_embeddings:
+        specs["unembed"] = P(None, "tp")
     if config.n_experts:
         specs["layers"].update(
             {
@@ -214,9 +219,10 @@ def init_params(
     params = {
         "embed": dense_init(k[0], (cfg.vocab_size, d), d),
         "final_norm": jnp.ones((d,), cfg.param_dtype),
-        "unembed": dense_init(k[1], (d, cfg.vocab_size), d),
         "layers": {},
     }
+    if not cfg.tie_embeddings:
+        params["unembed"] = dense_init(k[1], (d, cfg.vocab_size), d)
     for i, (name, (shape, fan_in)) in enumerate(layer_shapes.items()):
         if fan_in is None:
             params["layers"][name] = jnp.ones(shape, cfg.param_dtype)
@@ -485,6 +491,20 @@ def _embed_tokens(embed, tokens, cfg):
     return lax.psum(x, "tp")
 
 
+def unembed_logits(params, xn, cfg):
+    """Vocab-sharded logits from the final hidden states: the trained
+    unembedding matrix, or the transposed embedding when tied."""
+    if cfg.tie_embeddings:
+        return jnp.einsum(
+            "btd,vd->btv", xn.astype(cfg.dtype),
+            params["embed"].astype(cfg.dtype),
+        )
+    return jnp.einsum(
+        "btd,dv->btv", xn.astype(cfg.dtype),
+        params["unembed"].astype(cfg.dtype),
+    )
+
+
 def _sharded_softmax_xent(logits, targets, v_start):
     """Cross-entropy with a vocab-sharded logits tensor.
 
@@ -540,9 +560,7 @@ def _local_loss_fn(params, inputs, targets, mask, cfg: TransformerConfig, n_micr
     out = out.reshape(b_local, *out.shape[2:])
 
     xn = rms_norm(out, params["final_norm"], cfg.norm_eps)
-    logits = jnp.einsum(
-        "btd,dv->btv", xn.astype(cfg.dtype), params["unembed"].astype(cfg.dtype)
-    )
+    logits = unembed_logits(params, xn, cfg)
     v_local = logits.shape[-1]
     v_start = lax.axis_index("tp") * v_local
     per_token = _sharded_softmax_xent(logits, targets, v_start)
@@ -760,9 +778,7 @@ def build_forward(config: TransformerConfig, mesh: Mesh):
         xn = rms_norm(out, params["final_norm"], cfg.norm_eps)
         # Vocab stays sharded; the out_spec concatenates the tp shards into
         # the global [B, T, vocab] array — no gather collective needed.
-        logits = jnp.einsum(
-            "btd,dv->btv", xn.astype(cfg.dtype), params["unembed"].astype(cfg.dtype)
-        )
+        logits = unembed_logits(params, xn, cfg)
         # MoE leaves the activations *typed* ep-varying (the routed path's
         # all_gather replicates values but, unlike psum, keeps the axis in
         # the vma set), which the P("dp","sp","tp") out_spec rejects. A

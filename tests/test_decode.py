@@ -188,3 +188,23 @@ def test_gqa_greedy_decode_matches_full_forward():
         logits = np.asarray(fwd(params, jnp.asarray(toks)))
         toks = np.concatenate([toks, logits[:, -1].argmax(-1)[:, None]], axis=1)
     np.testing.assert_array_equal(out, toks)
+
+
+def test_tied_embeddings_decode_matches_full_forward():
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=4, d_ff=64, n_layers=2,
+        max_seq_len=64, dtype=jnp.float32, remat=False, tie_embeddings=True,
+    )
+    mc = MeshConfig(tp=2)
+    mesh = build_mesh(mc, jax.devices()[:2])
+    params = init_params(jax.random.key(0), cfg, mesh)
+    prompt = jnp.asarray(
+        np.random.default_rng(6).integers(0, cfg.vocab_size, (2, 5)), jnp.int32
+    )
+    out = np.asarray(build_generate(cfg, mesh, 5)(params, prompt))
+    fwd = build_forward(cfg, mesh)
+    toks = np.asarray(prompt)
+    for _ in range(5):
+        logits = np.asarray(fwd(params, jnp.asarray(toks)))
+        toks = np.concatenate([toks, logits[:, -1].argmax(-1)[:, None]], axis=1)
+    np.testing.assert_array_equal(out, toks)

@@ -355,3 +355,24 @@ def test_gqa_validation():
         tiny_config(n_kv_heads=3).validate(MeshConfig())  # 4 % 3 != 0
     with pytest.raises(ValueError, match="n_kv_heads"):
         tiny_config(n_kv_heads=2).validate(MeshConfig(tp=4))  # kv 2 % tp 4
+
+
+def test_tied_embeddings_train_and_match_single_device():
+    """tie_embeddings=True drops the unembed parameter, trains (gradients
+    reach the shared matrix from both ends), and remains exactly
+    mesh-invariant."""
+    sharded_mc = MeshConfig(sp=2, tp=2)
+    cfg = tiny_config(remat=False, tie_embeddings=True)
+    cfg.validate(sharded_mc)
+
+    losses = {}
+    for name, mesh in (
+        ("multi", build_mesh(sharded_mc, jax.devices()[:4])),
+        ("single", build_mesh(MeshConfig(), jax.devices()[:1])),
+    ):
+        params = init_params(jax.random.key(3), cfg, mesh)
+        assert "unembed" not in params
+        batch = make_batch(mesh, cfg.vocab_size, seed=13)
+        _, losses[name] = run_steps(cfg, mesh, batch, steps=3, seed=13)
+    np.testing.assert_allclose(losses["multi"], losses["single"], rtol=2e-4)
+    assert losses["single"][-1] < losses["single"][0]
