@@ -82,6 +82,11 @@ class TransformerConfig:
     param_dtype: Any = jnp.float32
     remat: bool = True
     n_microbatches: int = 0  # 0 -> defaults to pp size
+    # Stability knobs (both 0 = off): label smoothing mixes eps/V uniform
+    # mass into the target distribution; z-loss adds coef*log^2(Z) to keep
+    # the softmax partition function near 1 (ST-MoE/PaLM recipe).
+    label_smoothing: float = 0.0
+    z_loss_coef: float = 0.0
     # Tie the output projection to the embedding (logits = x @ embed^T):
     # halves the vocab parameter count; both uses share one vocab-sharded
     # [V, d] matrix and gradients flow into it from both ends.
@@ -130,6 +135,12 @@ class TransformerConfig:
             raise ValueError(
                 f"moe_top_k {self.moe_top_k} exceeds n_experts {self.n_experts}"
             )
+        if not 0.0 <= self.label_smoothing < 1.0:
+            raise ValueError(
+                f"label_smoothing must be in [0, 1), got {self.label_smoothing}"
+            )
+        if self.z_loss_coef < 0.0:
+            raise ValueError(f"z_loss_coef must be >= 0, got {self.z_loss_coef}")
         if self.attn_impl not in ("ring", "ulysses"):
             raise ValueError(f"unknown attn_impl {self.attn_impl!r}")
         if self.attn_impl == "ulysses" and (self.n_heads // mc.tp) % mc.sp:
@@ -505,8 +516,15 @@ def unembed_logits(params, xn, cfg):
     )
 
 
-def _sharded_softmax_xent(logits, targets, v_start):
-    """Cross-entropy with a vocab-sharded logits tensor.
+def _sharded_softmax_xent(logits, targets, v_start, cfg):
+    """Cross-entropy with a vocab-sharded logits tensor, plus the two
+    standard large-model stability knobs:
+
+    * `label_smoothing` eps: target distribution (1-eps)*one_hot + eps/V —
+      the smoothed loss is lse - (1-eps)*tgt - eps*mean_v(logits), with the
+      vocab mean psum'd across the tp shards.
+    * `z_loss_coef`: + coef * lse^2 (ST-MoE/PaLM style), pulling the
+      partition function toward 1 so bf16 logits can't drift.
 
     logits: [B, T, V_local] (local vocab shard), targets: [B, T] global ids.
     Returns per-token loss [B, T] (replicated over tp after the psums).
@@ -529,7 +547,17 @@ def _sharded_softmax_xent(logits, targets, v_start):
         logits, jnp.where(in_shard, local_ids, 0)[..., None], axis=-1
     )[..., 0] * in_shard
     tgt = lax.psum(tgt, "tp")
-    return lse - tgt
+
+    eps = cfg.label_smoothing
+    if eps:
+        vocab_mean = lax.psum(jnp.sum(logits, axis=-1), "tp") / cfg.vocab_size
+        target_term = (1.0 - eps) * tgt + eps * vocab_mean
+    else:
+        target_term = tgt
+    loss = lse - target_term
+    if cfg.z_loss_coef:
+        loss = loss + cfg.z_loss_coef * jnp.square(lse)
+    return loss
 
 
 # ---------------------------------------------------------------------------
@@ -563,7 +591,7 @@ def _local_loss_fn(params, inputs, targets, mask, cfg: TransformerConfig, n_micr
     logits = unembed_logits(params, xn, cfg)
     v_local = logits.shape[-1]
     v_start = lax.axis_index("tp") * v_local
-    per_token = _sharded_softmax_xent(logits, targets, v_start)
+    per_token = _sharded_softmax_xent(logits, targets, v_start, cfg)
 
     is_last = lax.axis_index("pp") == pp - 1
     per_token = jnp.where(is_last, per_token * mask, 0.0)
@@ -717,8 +745,16 @@ def build_eval_step(config: TransformerConfig, mesh: Mesh):
     """Jitted eval_step(params, batch) -> mean per-token cross-entropy,
     replicated. The loss-only half of `build_train_step` (same
     `_local_loss_fn`, same batch sharding contract, no grad/update) for
-    held-out evaluation during training."""
-    cfg = config
+    held-out evaluation during training.
+
+    Training-objective knobs (label smoothing, z-loss) are disabled for
+    eval — standard practice, so exp(eval loss) stays a perplexity and
+    curves are comparable across knob settings."""
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        config, label_smoothing=0.0, z_loss_coef=0.0
+    )
     specs = param_specs(cfg)
     n_micro = cfg.n_microbatches or axis_size(mesh, "pp")
 
