@@ -82,8 +82,19 @@ def _moe_mlp_topk_decode(p, xn, cfg: TransformerConfig):
 
 
 def _decode_mlp(p, xn, cfg: TransformerConfig):
-    """Feed-forward dispatch for one decode step: dense, soft-dispatch MoE,
-    or top-k routed MoE (dense-all-experts serving formulation)."""
+    """Feed-forward dispatch for serving: dense, soft-dispatch MoE, top-k
+    routed MoE (dense-all-experts formulation), or expert-choice.
+
+    Expert-choice routing is not causal — at train time an expert's top-C
+    choice over a token set lets earlier tokens' compute depend on later
+    tokens, which an autoregressive server cannot reproduce. Serving
+    therefore uses the router's FULL-CAPACITY limit (the dense soft
+    dispatch, where every expert weighs every token by its gate): exact
+    whenever training capacity did not bind, and the standard smooth
+    approximation where it did (the EC paper serves with per-token
+    approximations for the same reason)."""
+    if "wg" in p and cfg.moe_router == "expert":
+        return _moe_mlp(p, xn, cfg)
     if "wg" in p and cfg.moe_top_k > 0:
         return _moe_mlp_topk_decode(p, xn, cfg)
     if "wg" in p:

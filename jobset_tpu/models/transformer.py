@@ -13,11 +13,13 @@ program say exactly which axis each reduction rides:
   (`parallel.ring_attention`) with K/V blocks rotating via `ppermute`.
 * **pp** — layer stages marched by the GPipe transform
   (`parallel.pipeline`); backward schedule comes from autodiff.
-* **ep** — MoE expert shards. Two dispatch modes: dense (soft) dispatch
+* **ep** — MoE expert shards. Three dispatch modes: dense (soft) dispatch
   (`moe_top_k=0`): every rank runs its local experts on all tokens,
   gate-weighted partials `psum('ep')`-ed; token-routed (`moe_top_k>0`):
   top-k capacity routing with `all_to_all` slot exchange over the ep axis
-  (`_moe_mlp_routed`) — the sparse ICI-native path.
+  (`_moe_mlp_routed`) — the sparse ICI-native path; expert-choice
+  (`moe_router="expert"`): each expert takes its top-C tokens, perfectly
+  balanced, no aux loss (`_moe_mlp_expert_choice`).
 * **dp** — pure data parallelism; gradients are `psum`-ed over (dp, sp) and
   any other axis a parameter is replicated on.
 
@@ -32,7 +34,7 @@ greenfield TPU-native work.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace as dc_replace
 from functools import partial
 from typing import Any, Optional
 
@@ -71,6 +73,11 @@ class TransformerConfig:
     # all_to_all dispatch over the ep axis (the ICI-native sparse path).
     moe_top_k: int = 0
     moe_capacity_factor: float = 1.25
+    # Router family for n_experts > 0: "token" = token-choice (dense soft
+    # dispatch at moe_top_k=0, switch-style top-k routing otherwise);
+    # "expert" = expert-choice (each expert takes its top-C tokens,
+    # perfectly balanced, no aux loss, moe_top_k ignored).
+    moe_router: str = "token"
     # Load-balancing auxiliary loss weight (GShard/Switch style), applied
     # only on the routed path — without it token-choice routing collapses
     # onto a few experts.
@@ -129,6 +136,10 @@ class TransformerConfig:
             raise ValueError(f"vocab {self.vocab_size} not divisible by tp {mc.tp}")
         if self.n_experts % max(mc.ep, 1):
             raise ValueError("n_experts must be divisible by ep")
+        if self.moe_router not in ("token", "expert"):
+            raise ValueError(f"unknown moe_router {self.moe_router!r}")
+        if self.moe_router == "expert" and not self.n_experts:
+            raise ValueError("moe_router='expert' requires n_experts > 0")
         if self.moe_top_k and not self.n_experts:
             raise ValueError("moe_top_k requires n_experts > 0")
         if self.moe_top_k > self.n_experts > 0:
@@ -361,27 +372,9 @@ def _moe_mlp_routed(p, xn, cfg):
     compute and expert FLOPs are both 1/ep of the soft dispatch's, scaled
     by k * capacity_factor / n_experts.
     """
-    compute = cfg.dtype
-    ep = lax.psum(1, "ep")
-    ep_idx = lax.axis_index("ep")
-    e_local = cfg.n_experts // ep
     num_experts, k = cfg.n_experts, cfg.moe_top_k
     b, t, d = xn.shape
-    n_tok = b * t
-    if n_tok % ep:
-        raise ValueError(
-            f"routed MoE needs local tokens ({n_tok}) divisible by ep ({ep})"
-        )
-    n_chunk = n_tok // ep
-    x = xn.reshape(n_tok, d)
-    chunk = lax.dynamic_slice_in_dim(x, ep_idx * n_chunk, n_chunk, axis=0)
-
-    gates = jax.nn.softmax(
-        jnp.einsum(
-            "nd,de->ne", chunk.astype(jnp.float32), p["wg"].astype(jnp.float32)
-        ),
-        axis=-1,
-    )  # [n_chunk, E] f32 routing
+    chunk, gates, n_chunk = _route_prologue(p, xn, cfg)
     top_w, top_i = lax.top_k(gates, k)  # [n_chunk, k]
     top_w = top_w / jnp.maximum(jnp.sum(top_w, -1, keepdims=True), 1e-9)
 
@@ -419,6 +412,53 @@ def _moe_mlp_routed(p, xn, cfg):
     combine = jnp.sum(dispatch * weights, axis=0)  # [n_chunk, E, C]
     dispatch = jnp.sum(dispatch, axis=0)  # [n_chunk, E, C]
 
+    return (
+        _dispatch_combine_experts(p, chunk, dispatch, combine, cfg).reshape(
+            b, t, d
+        ),
+        stats,
+    )
+
+
+def _route_prologue(p, xn, cfg):
+    """Shared router head: split the replicated token set into this ep
+    rank's chunk and compute its f32 gate distribution. Returns
+    (chunk [n_chunk, d], gates [n_chunk, E], n_chunk)."""
+    ep = lax.psum(1, "ep")
+    ep_idx = lax.axis_index("ep")
+    b, t, d = xn.shape
+    n_tok = b * t
+    if n_tok % ep:
+        raise ValueError(
+            f"routed MoE needs local tokens ({n_tok}) divisible by ep ({ep})"
+        )
+    n_chunk = n_tok // ep
+    x = xn.reshape(n_tok, d)
+    chunk = lax.dynamic_slice_in_dim(x, ep_idx * n_chunk, n_chunk, axis=0)
+    gates = jax.nn.softmax(
+        jnp.einsum(
+            "nd,de->ne", chunk.astype(jnp.float32), p["wg"].astype(jnp.float32)
+        ),
+        axis=-1,
+    )  # [n_chunk, E] f32 routing
+    return chunk, gates, n_chunk
+
+
+def _dispatch_combine_experts(p, chunk, dispatch, combine, cfg):
+    """The all_to_all expert dispatch shared by both routers: pack this ep
+    rank's token chunk into expert-major [E, C, d] slot buffers per the
+    boolean `dispatch` [n, E, C], ship every slot to the rank owning its
+    expert, run the (tp column/row split) expert FFN, ship results back,
+    and weight them into token positions per `combine` [n, E, C]. Returns
+    the reassembled full local token set [n * ep, d] (all_gather over ep —
+    chunks are disjoint in rank order, so it is a concatenation)."""
+    compute = cfg.dtype
+    ep = lax.psum(1, "ep")
+    e_local = cfg.n_experts // ep
+    num_experts = cfg.n_experts
+    capacity = dispatch.shape[-1]
+    d = chunk.shape[-1]
+
     send = jnp.einsum(
         "nd,nec->ecd", chunk.astype(compute), dispatch.astype(compute)
     ).reshape(ep, e_local, capacity, d)
@@ -438,11 +478,41 @@ def _moe_mlp_routed(p, xn, cfg):
     out_chunk = jnp.einsum(
         "ecd,nec->nd", ret.astype(compute), combine.astype(compute)
     )
+    return lax.all_gather(out_chunk, "ep", tiled=True)
 
-    # Reassemble the replicated token set: chunks are disjoint and in ep
-    # rank order, so this is a concatenation (all_gather), not a reduction.
-    full = lax.all_gather(out_chunk, "ep", tiled=True)
-    return full.reshape(b, t, d), stats
+
+def _moe_mlp_expert_choice(p, xn, cfg):
+    """Expert-choice routing (Zhou et al. 2022): each expert picks its
+    top-C tokens by gate score — the transpose of token-choice. Perfectly
+    load-balanced BY CONSTRUCTION (every expert processes exactly C
+    slots), so no balancing aux loss is needed; the trade is that a token
+    may be chosen by zero experts (its MLP output is then 0, the residual
+    carries it) or by many.
+
+    Same chunk-split + all_to_all dispatch fabric as the token-choice
+    router. Choices are made over this ep rank's local token chunk (the
+    standard practice — a per-device decision); consequently routing is
+    NOT invariant to the dp/sp/ep chunking except in the full-capacity
+    limit C >= n_chunk, where every expert takes every token and the
+    output equals the dense soft dispatch exactly (differential-tested).
+    """
+    num_experts = cfg.n_experts
+    b, t, d = xn.shape
+    chunk, gates, n_chunk = _route_prologue(p, xn, cfg)
+
+    capacity = min(
+        n_chunk,
+        max(1, int(np.ceil(n_chunk / num_experts * cfg.moe_capacity_factor))),
+    )
+    # Each expert's top-C tokens: scores transposed to expert-major.
+    top_w, top_i = lax.top_k(gates.T, capacity)  # [E, C]
+    sel = jax.nn.one_hot(top_i, n_chunk, dtype=jnp.float32)  # [E, C, n]
+    dispatch = sel.transpose(2, 0, 1)  # [n, E, C]
+    combine = dispatch * top_w[None, :, :]  # gate weight at the chosen slot
+
+    out = _dispatch_combine_experts(p, chunk, dispatch, combine, cfg)
+    stats = jnp.zeros((2, aux_stat_width(cfg)), jnp.float32)
+    return out.reshape(b, t, d), stats
 
 
 def aux_stat_width(cfg: TransformerConfig) -> int:
@@ -458,7 +528,9 @@ def _layer(p, x, cfg: TransformerConfig, t_local: int):
     x = _attention_block(p, x, cfg, t_local)
     xn = rms_norm(x, p["ln2"], cfg.norm_eps)
     stats = jnp.zeros((2, aux_stat_width(cfg)), jnp.float32)
-    if "wg" in p and cfg.moe_top_k > 0:
+    if "wg" in p and cfg.moe_router == "expert":
+        out, stats = _moe_mlp_expert_choice(p, xn, cfg)
+    elif "wg" in p and cfg.moe_top_k > 0:
         out, stats = _moe_mlp_routed(p, xn, cfg)
     elif "wg" in p:
         out = _moe_mlp(p, xn, cfg)
@@ -750,11 +822,7 @@ def build_eval_step(config: TransformerConfig, mesh: Mesh):
     Training-objective knobs (label smoothing, z-loss) are disabled for
     eval — standard practice, so exp(eval loss) stays a perplexity and
     curves are comparable across knob settings."""
-    import dataclasses
-
-    cfg = dataclasses.replace(
-        config, label_smoothing=0.0, z_loss_coef=0.0
-    )
+    cfg = dc_replace(config, label_smoothing=0.0, z_loss_coef=0.0)
     specs = param_specs(cfg)
     n_micro = cfg.n_microbatches or axis_size(mesh, "pp")
 

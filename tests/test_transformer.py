@@ -429,3 +429,39 @@ def test_label_smoothing_and_z_loss_mesh_invariant():
         tiny_config(label_smoothing=1.1).validate(MeshConfig())
     with _pytest.raises(ValueError, match="z_loss_coef"):
         tiny_config(z_loss_coef=-1e-3).validate(MeshConfig())
+
+
+def test_expert_choice_full_capacity_equals_soft_dispatch():
+    """With capacity >= all local tokens, every expert takes every token
+    and expert-choice equals the dense soft dispatch exactly — the
+    differential anchoring the router's dispatch/combine math."""
+    mesh = build_mesh(MeshConfig(), jax.devices()[:1])
+    losses = {}
+    for name, router, factor in (
+        ("ec", "expert", 1e9),  # capacity clamps to n_chunk = all tokens
+        ("soft", "token", 1.25),
+    ):
+        cfg = tiny_config(
+            remat=False, n_experts=4, d_ff_expert=32,
+            moe_router=router, moe_capacity_factor=factor,
+        )
+        cfg.validate(MeshConfig())
+        batch = make_batch(mesh, cfg.vocab_size, seed=21)
+        _, losses[name] = run_steps(cfg, mesh, batch, steps=2, seed=21)
+    np.testing.assert_allclose(losses["ec"], losses["soft"], rtol=1e-5)
+
+
+def test_expert_choice_trains_on_ep_mesh():
+    """Finite-capacity expert choice trains on an ep-sharded mesh (the
+    all_to_all dispatch fabric) with a decreasing loss."""
+    mc = MeshConfig(ep=2, tp=2)
+    cfg = tiny_config(
+        remat=False, n_experts=4, d_ff_expert=32,
+        moe_router="expert", moe_capacity_factor=2.0,
+    )
+    cfg.validate(mc)
+    mesh = build_mesh(mc, jax.devices()[:4])
+    batch = make_batch(mesh, cfg.vocab_size, seed=22)
+    _, losses = run_steps(cfg, mesh, batch, steps=4, seed=22)
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
