@@ -89,6 +89,13 @@ class TransformerConfig:
     param_dtype: Any = jnp.float32
     remat: bool = True
     n_microbatches: int = 0  # 0 -> defaults to pp size
+    # Chunk the loss over the time axis (0 = off): the unembed projection
+    # and cross-entropy run per chunk under jax.checkpoint inside a scan,
+    # so the [B, T, vocab] logits tensor — often the peak-memory term at
+    # large batch — never materializes; only [B, loss_chunk, vocab] does.
+    # Numerically exact (the loss is a per-token sum); T_local must divide
+    # by the chunk.
+    loss_chunk: int = 0
     # Stability knobs (both 0 = off): label smoothing mixes eps/V uniform
     # mass into the target distribution; z-loss adds coef*log^2(Z) to keep
     # the softmax partition function near 1 (ST-MoE/PaLM recipe).
@@ -146,6 +153,8 @@ class TransformerConfig:
             raise ValueError(
                 f"moe_top_k {self.moe_top_k} exceeds n_experts {self.n_experts}"
             )
+        if self.loss_chunk < 0:
+            raise ValueError(f"loss_chunk must be >= 0, got {self.loss_chunk}")
         if not 0.0 <= self.label_smoothing < 1.0:
             raise ValueError(
                 f"label_smoothing must be in [0, 1), got {self.label_smoothing}"
@@ -660,10 +669,35 @@ def _local_loss_fn(params, inputs, targets, mask, cfg: TransformerConfig, n_micr
     out = out.reshape(b_local, *out.shape[2:])
 
     xn = rms_norm(out, params["final_norm"], cfg.norm_eps)
-    logits = unembed_logits(params, xn, cfg)
-    v_local = logits.shape[-1]
-    v_start = lax.axis_index("tp") * v_local
-    per_token = _sharded_softmax_xent(logits, targets, v_start, cfg)
+
+    def token_losses(xn_c, targets_c):
+        logits = unembed_logits(params, xn_c, cfg)
+        v_start = lax.axis_index("tp") * logits.shape[-1]
+        return _sharded_softmax_xent(logits, targets_c, v_start, cfg)
+
+    t_local = xn.shape[1]
+    if cfg.loss_chunk and cfg.loss_chunk < t_local:
+        # Memory-bounded loss: scan time chunks with recompute-on-backward,
+        # so only [B, chunk, V_local] logits are ever resident.
+        if t_local % cfg.loss_chunk:
+            raise ValueError(
+                f"loss_chunk {cfg.loss_chunk} must divide the local "
+                f"sequence length {t_local}"
+            )
+        nc = t_local // cfg.loss_chunk
+        xn_c = xn.reshape(b_local, nc, cfg.loss_chunk, xn.shape[-1])
+        xn_c = jnp.moveaxis(xn_c, 1, 0)  # [nc, B, chunk, d]
+        tg_c = jnp.moveaxis(
+            targets.reshape(b_local, nc, cfg.loss_chunk), 1, 0
+        )
+
+        def body(_, ct):
+            return None, jax.checkpoint(token_losses)(*ct)
+
+        _, per_chunks = lax.scan(body, None, (xn_c, tg_c))
+        per_token = jnp.moveaxis(per_chunks, 0, 1).reshape(b_local, t_local)
+    else:
+        per_token = token_losses(xn, targets)
 
     is_last = lax.axis_index("pp") == pp - 1
     per_token = jnp.where(is_last, per_token * mask, 0.0)

@@ -465,3 +465,24 @@ def test_expert_choice_trains_on_ep_mesh():
     _, losses = run_steps(cfg, mesh, batch, steps=4, seed=22)
     assert all(np.isfinite(losses))
     assert losses[-1] < losses[0]
+
+
+def test_chunked_loss_is_exact():
+    """loss_chunk changes peak memory, not numerics: identical loss
+    trajectory (fwd AND grads) with and without chunking, on a sharded
+    mesh."""
+    mc = MeshConfig(sp=2, tp=2)
+    losses = {}
+    for name, chunk in (("chunked", 4), ("full", 0)):
+        cfg = tiny_config(remat=False, loss_chunk=chunk)
+        cfg.validate(mc)
+        mesh = build_mesh(mc, jax.devices()[:4])
+        batch = make_batch(mesh, cfg.vocab_size, seed=25)
+        _, losses[name] = run_steps(cfg, mesh, batch, steps=3, seed=25)
+    np.testing.assert_allclose(losses["chunked"], losses["full"], rtol=1e-6)
+
+    with pytest.raises(ValueError, match="loss_chunk"):
+        cfg = tiny_config(remat=False, loss_chunk=5)  # 16 % 5 != 0
+        cfg.validate(MeshConfig())
+        mesh = build_mesh(MeshConfig(), jax.devices()[:1])
+        run_steps(cfg, mesh, make_batch(mesh, cfg.vocab_size), steps=1)
