@@ -330,16 +330,42 @@ def run_model_phase(args, sink: dict) -> None:
     # number is captured right after the first (known-safe) point so a
     # later failure can't cost it either.
     sink["batch_sweep"] = []
+    use_chunk = 0  # sticky after the first OOM: larger batches need it too
     for batch in (8, 16, 32):
         try:
-            r = run_model_bench(steps=10, warmup=2, batch=batch)
-        except Exception as exc:  # noqa: BLE001 — bank what we have
-            sink["batch_sweep"].append(
-                {"batch": batch, "error": f"{type(exc).__name__}: {exc}"[:200]}
+            r = run_model_bench(
+                steps=10, warmup=2, batch=batch, loss_chunk=use_chunk
             )
-            break
+        except Exception as exc:  # noqa: BLE001 — bank what we have
+            if "RESOURCE_EXHAUSTED" in str(exc) and not use_chunk:
+                # Out of HBM at this batch: retry once with the
+                # memory-bounded chunked cross-entropy (exact numerics,
+                # caps the [B, T, vocab] logits term; costs one recomputed
+                # unembed matmul on the backward). The result records
+                # loss_chunk so the two measurement configs are
+                # distinguishable.
+                use_chunk = 256
+                try:
+                    r = run_model_bench(
+                        steps=10, warmup=2, batch=batch, loss_chunk=use_chunk
+                    )
+                except Exception as exc2:  # noqa: BLE001
+                    sink["batch_sweep"].append({
+                        "batch": batch,
+                        "error": f"{type(exc2).__name__}: {exc2}"[:200],
+                    })
+                    break
+            else:
+                sink["batch_sweep"].append(
+                    {"batch": batch,
+                     "error": f"{type(exc).__name__}: {exc}"[:200]}
+                )
+                break
         sink["batch_sweep"].append(
-            {k: r[k] for k in ("batch", "step_time_ms", "tokens_per_sec", "mfu_pct")}
+            {k: r[k] for k in (
+                "batch", "step_time_ms", "tokens_per_sec", "mfu_pct",
+                "loss_chunk",
+            )}
         )
         if r["tokens_per_sec"] >= sink.get("tokens_per_sec", 0):
             sink.update(r)

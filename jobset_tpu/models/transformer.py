@@ -94,7 +94,9 @@ class TransformerConfig:
     # so the [B, T, vocab] logits tensor — often the peak-memory term at
     # large batch — never materializes; only [B, loss_chunk, vocab] does.
     # Numerically exact (the loss is a per-token sum); T_local must divide
-    # by the chunk.
+    # by the chunk. The knob is an UPPER BOUND on resident logits: when it
+    # is >= the local sequence length the unchunked path already satisfies
+    # it, so chunking (and its backward recompute) is skipped.
     loss_chunk: int = 0
     # Stability knobs (both 0 = off): label smoothing mixes eps/V uniform
     # mass into the target distribution; z-loss adds coef*log^2(Z) to keep

@@ -116,6 +116,7 @@ def run_model_bench(
     seq_len: int = 1024,
     config: Optional[Any] = None,
     learning_rate: float = 1e-3,
+    loss_chunk: int = 0,
 ) -> dict:
     """Train the flagship transformer and return tokens/s + MFU as a dict."""
     import jax
@@ -127,6 +128,10 @@ def run_model_bench(
 
     devices = jax.devices()
     mesh = build_mesh(MeshConfig(), devices=devices[:1], allow_submesh=True)
+    if config is not None and loss_chunk:
+        from dataclasses import replace as dc_replace
+
+        config = dc_replace(config, loss_chunk=loss_chunk)
     cfg = config or transformer.TransformerConfig(
         vocab_size=32000,
         d_model=1024,
@@ -138,6 +143,10 @@ def run_model_bench(
         # per-layer recompute would add ~1/3 more forward FLOPs that the
         # 6*P accounting (rightly) does not credit — pure MFU loss.
         remat=False,
+        # 0 unless the caller is retrying after an OOM (bench.py): chunked
+        # loss caps the [B, T, vocab] logits memory at the cost of one
+        # recomputed unembed matmul on the backward.
+        loss_chunk=loss_chunk,
     )
 
     params = transformer.init_params(jax.random.key(0), cfg, mesh)
@@ -190,6 +199,7 @@ def run_model_bench(
         "d_ff": cfg.d_ff,
         "vocab_size": cfg.vocab_size,
         "remat": bool(cfg.remat),
+        "loss_chunk": cfg.loss_chunk,
         "params_m": round(matmul_param_count(cfg) / 1e6, 1),
         "steps": steps,
         "step_time_ms": round(1000 * elapsed / steps, 2),
@@ -222,6 +232,10 @@ def run_decode_bench(
 
     devices = jax.devices()
     mesh = build_mesh(MeshConfig(), devices=devices[:1], allow_submesh=True)
+    if config is not None and loss_chunk:
+        from dataclasses import replace as dc_replace
+
+        config = dc_replace(config, loss_chunk=loss_chunk)
     cfg = config or transformer.TransformerConfig(
         vocab_size=32000,
         d_model=1024,
