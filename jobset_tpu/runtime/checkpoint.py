@@ -28,21 +28,31 @@ class Checkpointer:
         )
 
     def save(self, step: int, state: Any) -> None:
+        """Asynchronous save: orbax copies device state to host BEFORE
+        returning (so the training loop may immediately donate/overwrite
+        the buffers) and persists to disk in the background — checkpoint
+        I/O overlaps the next steps instead of stalling them. Readers
+        (latest_step/restore) and close() barrier on in-flight writes."""
         self._mgr.save(step, args=ocp.args.StandardSave(state))
-        self._mgr.wait_until_finished()
 
     def latest_step(self) -> Optional[int]:
+        self._mgr.wait_until_finished()
         return self._mgr.latest_step()
 
     def restore(self, state_template: Any, step: Optional[int] = None) -> Any:
         """Restore into the structure/shardings of `state_template`; `step`
-        defaults to the latest checkpoint."""
+        defaults to the latest checkpoint. Barriers on in-flight async
+        saves first (an explicit `step` may name one still being written)."""
+        self._mgr.wait_until_finished()
         step = step if step is not None else self.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoint found in {self.directory}")
         return self._mgr.restore(step, args=ocp.args.StandardRestore(state_template))
 
     def close(self) -> None:
+        # Barriers on in-flight async saves before tearing down, so a
+        # workload that crashes through _run_loop's finally still lands
+        # its last accepted checkpoint on disk.
         self._mgr.close()
 
     def __enter__(self):

@@ -232,10 +232,6 @@ def run_decode_bench(
 
     devices = jax.devices()
     mesh = build_mesh(MeshConfig(), devices=devices[:1], allow_submesh=True)
-    if config is not None and loss_chunk:
-        from dataclasses import replace as dc_replace
-
-        config = dc_replace(config, loss_chunk=loss_chunk)
     cfg = config or transformer.TransformerConfig(
         vocab_size=32000,
         d_model=1024,

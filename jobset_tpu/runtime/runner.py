@@ -257,6 +257,7 @@ def _run_loop(workload, state, train_step, make_batch,
     losses = []
     val_losses = []
     eval_every = int(workload.get("eval_every", 0))
+    completed = False
     try:
         with profiler:
             for step in range(start, total_steps):
@@ -279,9 +280,27 @@ def _run_loop(workload, state, train_step, make_batch,
                     val_losses.append((step + 1, eval_fn(params, step + 1)))
                 if ckpt is not None and (step + 1) % every == 0:
                     ckpt.save(step + 1, {"state": state, "step": step + 1})
+        completed = True
     finally:
         if ckpt is not None:
-            ckpt.close()
+            # close() barriers on in-flight async saves, so a deferred
+            # write error can surface here. On the success path it must
+            # propagate (the checkpoint the caller relies on is missing);
+            # while a training exception (e.g. WorkloadFailure feeding the
+            # gang-restart policy) is already in flight, it must NOT
+            # replace that exception — log and let the original through.
+            try:
+                ckpt.close()
+            except Exception as exc:  # noqa: BLE001
+                if completed:
+                    raise
+                import sys
+
+                print(
+                    f"checkpoint finalization failed during error "
+                    f"handling: {type(exc).__name__}: {exc}",
+                    file=sys.stderr,
+                )
     return TrainResult(losses, val_losses)
 
 
