@@ -79,6 +79,15 @@ def _build_parser() -> argparse.ArgumentParser:
                                         "services", "events"])
     g.add_argument("name", nargs="?")
     g.add_argument("-o", "--output", choices=["wide", "json", "yaml"], default="wide")
+    g.add_argument(
+        "-w", "--watch", action="store_true",
+        help="(jobsets) after listing, stream ADDED/MODIFIED/DELETED "
+             "events from the controller's watch endpoint (kubectl get -w)",
+    )
+    g.add_argument(
+        "--watch-timeout", type=float, default=0.0,
+        help="stop watching after N seconds (0 = until interrupted)",
+    )
     _add_server_flag(g)
 
     d = sub.add_parser("delete", help="delete a jobset")
@@ -225,6 +234,12 @@ def _cmd_get(args) -> int:
     client = _client(args)
     resource = "jobsets" if args.resource == "jobset" else args.resource
 
+    if getattr(args, "watch", False):
+        if resource != "jobsets":
+            print("--watch supports jobsets only", file=sys.stderr)
+            return 2
+        return _watch_jobsets(client, args)
+
     if resource == "jobsets" and args.name:
         raw = client.get_raw(args.name, args.namespace)
         print(json.dumps(raw, indent=2) if args.output == "json"
@@ -263,6 +278,75 @@ def _cmd_get(args) -> int:
     return 0
 
 
+def _watch_jobsets(client, args) -> int:
+    """kubectl get -w analog over the controller's long-poll watch journal:
+    print the current list, then stream one event per line until
+    interrupted (or --watch-timeout elapses). -o json/yaml emit one
+    {type, object} document per event; wide prints aligned rows. A 410
+    (journal window passed) or a transient server error triggers a relist,
+    the same recovery the informer uses."""
+    import time as _time
+
+    from .client import ApiError, WatchGone
+
+    def emit(event_type, obj):
+        if args.output == "json":
+            print(json.dumps({"type": event_type, "object": obj}), flush=True)
+        elif args.output == "yaml":
+            import yaml as _yaml
+
+            print("---\n" + _yaml.safe_dump(
+                {"type": event_type, "object": obj}, sort_keys=False
+            ), end="", flush=True)
+        else:
+            print(f"{event_type:<9} {_format_jobset_row(obj)}", flush=True)
+
+    def relist():
+        items, rv = client.list_with_version(args.namespace)
+        return [
+            raw for raw in items
+            if not args.name or raw["metadata"]["name"] == args.name
+        ], rv
+
+    items, rv = relist()
+    if args.output == "wide":
+        print(f"{'EVENT':<9} {_JOBSET_HEADER}", flush=True)
+    for raw in items:
+        emit("LISTED", raw)
+
+    deadline = (
+        _time.monotonic() + args.watch_timeout if args.watch_timeout else None
+    )
+    try:
+        while True:
+            remaining = None if deadline is None else deadline - _time.monotonic()
+            if remaining is not None and remaining <= 0:
+                break
+            poll = 10.0 if remaining is None else min(10.0, remaining)
+            try:
+                events, rv = client.watch(
+                    args.namespace, resource_version=rv, timeout=poll
+                )
+            except WatchGone:
+                _, rv = relist()  # journal window passed: resume from now
+                continue
+            except (ApiError, OSError):
+                _time.sleep(min(1.0, poll))
+                _, rv = relist()
+                continue
+            for ev in events:
+                obj = ev["object"]
+                if args.name and obj["metadata"]["name"] != args.name:
+                    continue
+                emit(ev["type"], obj)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+_JOBSET_HEADER = f"{'NAME':<24} {'RESTARTS':<9} {'TERMINAL':<10} SUSPENDED"
+
+
 def _format_jobset_row(raw: dict, header: bool = False) -> str:
     """kubectl printcolumn analog (jobset_types.go:195-199: Restarts,
     TerminalState, Suspended)."""
@@ -272,7 +356,7 @@ def _format_jobset_row(raw: dict, header: bool = False) -> str:
            f"{status.get('terminalState') or '-':<10} "
            f"{raw.get('spec', {}).get('suspend') or False}")
     if header:
-        return f"{'NAME':<24} {'RESTARTS':<9} {'TERMINAL':<10} SUSPENDED\n{row}"
+        return f"{_JOBSET_HEADER}\n{row}"
     return row
 
 

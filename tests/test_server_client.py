@@ -342,3 +342,36 @@ def test_informer_cache_and_handlers(server, client):
     finally:
         informer.stop()
     assert "inf1" in adds and "inf1" in updates and "inf1" in deletes
+
+
+def test_cli_get_watch_streams_events(tmp_path, server, capsys):
+    """`jobset-tpu get jobsets -w` prints the current list then streams
+    ADDED/MODIFIED events from the watch journal (kubectl get -w analog)."""
+    import threading as _threading
+
+    from jobset_tpu.cli import main as cli_main
+
+    manifest = tmp_path / "js.yaml"
+    manifest.write_text(SIMPLE_YAML.format(name="watch-js"))
+    assert cli_main(["apply", "-f", str(manifest), "--server", server.address]) == 0
+    capsys.readouterr()
+
+    def mutate():
+        # While the watch loop runs: complete the jobset -> MODIFIED events.
+        import time as _t
+
+        _t.sleep(0.4)
+        _complete_all(server, "watch-js")
+
+    t = _threading.Thread(target=mutate)
+    t.start()
+    rc = cli_main([
+        "get", "jobsets", "-w", "--watch-timeout", "3",
+        "--server", server.address,
+    ])
+    t.join()
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "watch-js" in out.splitlines()[1]  # initial listing under header
+    assert "MODIFIED" in out
+    assert "Completed" in out
