@@ -282,9 +282,10 @@ def _watch_jobsets(client, args) -> int:
     """kubectl get -w analog over the controller's long-poll watch journal:
     print the current list, then stream one event per line until
     interrupted (or --watch-timeout elapses). -o json/yaml emit one
-    {type, object} document per event; wide prints aligned rows. A 410
-    (journal window passed) or a transient server error triggers a relist,
-    the same recovery the informer uses."""
+    {type, object} document per event; wide prints aligned rows. Recovery
+    mirrors the informer's: a transient transport error retries the watch
+    with the SAME resourceVersion (the journal preserves the missed
+    events); only a 410 (journal window passed) forces a relist."""
     import time as _time
 
     from .client import ApiError, WatchGone
@@ -308,6 +309,8 @@ def _watch_jobsets(client, args) -> int:
             if not args.name or raw["metadata"]["name"] == args.name
         ], rv
 
+    if args.name:
+        client.get_raw(args.name, args.namespace)  # 404 now, not a silent hang
     items, rv = relist()
     if args.output == "wide":
         print(f"{'EVENT':<9} {_JOBSET_HEADER}", flush=True)
@@ -328,11 +331,18 @@ def _watch_jobsets(client, args) -> int:
                     args.namespace, resource_version=rv, timeout=poll
                 )
             except WatchGone:
-                _, rv = relist()  # journal window passed: resume from now
+                # Journal window passed: events are unrecoverable; resume
+                # from a fresh listing (protected — the server may still
+                # be coming back).
+                try:
+                    _, rv = relist()
+                except (ApiError, OSError):
+                    _time.sleep(min(1.0, poll))
                 continue
             except (ApiError, OSError):
+                # Transient transport error: keep the SAME resourceVersion
+                # and retry — the journal still holds anything we missed.
                 _time.sleep(min(1.0, poll))
-                _, rv = relist()
                 continue
             for ev in events:
                 obj = ev["object"]

@@ -340,3 +340,73 @@ def test_pod_spec_clone_covers_every_field():
     cloned = spec.clone()
     for f in dataclasses.fields(PodSpec):
         assert getattr(cloned, f.name) == getattr(spec, f.name)
+
+
+def test_fuzzed_jobsets_round_trip_and_validate_cleanly():
+    """Robustness sweep: 200 randomized JobSets (valid and invalid field
+    mixes) must (a) survive to_yaml -> load_all round-trips bit-equal when
+    admitted, and (b) make validate_create either pass or raise
+    ValidationError — never any other exception type. Guards the API
+    boundary against crash-on-weird-input regressions."""
+    import random
+
+    from jobset_tpu.api.defaulting import apply_defaults
+    from jobset_tpu.api.serialization import load_all, to_yaml
+    from jobset_tpu.api.types import (
+        Coordinator, FailurePolicy, FailurePolicyRule, Network,
+        StartupPolicy, SuccessPolicy,
+    )
+    from jobset_tpu.api.validation import validate_create
+    from jobset_tpu.testing import make_jobset, make_replicated_job
+
+    rng = random.Random(7)
+    names = ["ok-name", "x" * 40, "UPPER", "end-", "-start", "a", "x" * 70]
+    ops = ["All", "Any", "Bogus"]
+    actions = ["RestartJobSet", "FailJobSet",
+               "RestartJobSetAndIgnoreMaxRestarts", "Nope"]
+
+    admitted = 0
+    for i in range(200):
+        b = make_jobset(rng.choice(names))
+        for j in range(rng.randint(0, 3)):
+            b.replicated_job(
+                make_replicated_job(rng.choice(names))
+                .replicas(rng.choice([0, 1, 3, 1000]))
+                .parallelism(rng.choice([1, 4]))
+                .obj()
+            )
+        js = b.obj()
+        if rng.random() < 0.5:
+            js.spec.success_policy = SuccessPolicy(
+                operator=rng.choice(ops),
+                target_replicated_jobs=[rng.choice(names)] if rng.random() < 0.5 else [],
+            )
+        if rng.random() < 0.5:
+            js.spec.failure_policy = FailurePolicy(
+                max_restarts=rng.choice([-1, 0, 5]),
+                rules=[FailurePolicyRule(
+                    name=rng.choice(["rule1", "bad name!", ""]),
+                    action=rng.choice(actions),
+                )] * rng.randint(0, 2),
+            )
+        if rng.random() < 0.3:
+            js.spec.coordinator = Coordinator(
+                replicated_job=rng.choice(names),
+                job_index=rng.choice([-1, 0, 99]),
+                pod_index=rng.choice([-1, 0, 99]),
+            )
+        if rng.random() < 0.3:
+            js.spec.network = Network(subdomain=rng.choice(names + ["", "sub"]))
+        if rng.random() < 0.3:
+            js.spec.startup_policy = StartupPolicy(
+                startup_policy_order=rng.choice(["InOrder", "AnyOrder", "Chaos"])
+            )
+        apply_defaults(js)          # must never raise
+        if validate_create(js):     # must never raise; errors reject
+            continue
+        admitted += 1
+        text = to_yaml(js)
+        (back,) = load_all(text)
+        assert to_yaml(back) == text, f"round-trip drift at case {i}"
+    # The generator must actually exercise both sides of admission.
+    assert 10 < admitted < 200, admitted

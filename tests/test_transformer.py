@@ -486,3 +486,39 @@ def test_chunked_loss_is_exact():
         cfg.validate(MeshConfig())
         mesh = build_mesh(MeshConfig(), jax.devices()[:1])
         run_steps(cfg, mesh, make_batch(mesh, cfg.vocab_size), steps=1)
+
+
+def test_kitchen_sink_all_features_compose():
+    """Every workload-plane feature at once on the full 8-device mesh:
+    pp=2 pipeline x sp=2 Ulysses x tp=2 Megatron, GQA, routed MoE with
+    aux loss, tied embeddings, label smoothing, z-loss, chunked loss,
+    remat, and gradient accumulation — features must compose, not merely
+    work alone."""
+    mc = MeshConfig(pp=2, sp=2, tp=2)
+    cfg = tiny_config(
+        n_heads=4,
+        n_kv_heads=2,
+        n_experts=4,
+        d_ff_expert=32,
+        moe_top_k=2,
+        attn_impl="ulysses",
+        tie_embeddings=True,
+        label_smoothing=0.05,
+        z_loss_coef=1e-4,
+        loss_chunk=8,
+        remat=True,
+    )
+    cfg.validate(mc)
+    mesh = build_mesh(mc)
+    params = init_params(jax.random.key(42), cfg, mesh)
+    assert "unembed" not in params
+    opt = optax.adamw(1e-3)
+    opt_state = opt.init(params)
+    step = build_train_step(cfg, mesh, opt, accum_steps=2)
+    batch = make_batch(mesh, cfg.vocab_size, seed=42)
+    losses = []
+    for _ in range(3):
+        params, opt_state, loss = step(params, opt_state, batch)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses
