@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Serving-path walkthrough: train briefly, then generate.
+
+The reference has no inference surface (it orchestrates containers); this
+demo shows the workload plane's serving half end-to-end on the simulated
+backend: train the flagship transformer on a tiny repeating corpus, then
+decode from it through `models.decode.build_generate` — batched prefill,
+compact (GQA) KV cache, greedy decoding, and temperature/top-k sampling.
+
+    python examples/serve_demo.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from jobset_tpu.models import TransformerConfig, init_params
+    from jobset_tpu.models.decode import build_generate
+    from jobset_tpu.models.transformer import build_train_step
+    from jobset_tpu.parallel import MeshConfig, build_mesh
+    from jobset_tpu.runtime.data import TokenDataset, write_token_file
+
+    vocab = 16
+    cfg = TransformerConfig(
+        vocab_size=vocab, d_model=64, n_heads=4, n_kv_heads=2, d_ff=128,
+        n_layers=2, max_seq_len=64, dtype=jnp.float32, remat=False,
+    )
+
+    # Train on a repeating 0..15 pattern — trivially learnable, so a few
+    # dozen steps make the continuation predictable.
+    with tempfile.TemporaryDirectory() as d:
+        corpus = os.path.join(d, "corpus.bin")
+        write_token_file(corpus, np.tile(np.arange(vocab), 400))
+        mesh = build_mesh(MeshConfig(tp=2), jax.devices()[:2])
+        cfg.validate(MeshConfig(tp=2))
+        ds = TokenDataset(corpus, seq_len=32, batch_size=8, vocab_size=vocab)
+        params = init_params(jax.random.key(0), cfg, mesh)
+        opt = optax.adamw(3e-3)
+        opt_state = opt.init(params)
+        step = build_train_step(cfg, mesh, opt)
+        for s in range(60):
+            params, opt_state, loss = step(params, opt_state, ds.batch(s))
+        print(f"trained 60 steps, final loss {float(loss):.3f}")
+
+        prompt = jnp.asarray([[4, 5, 6, 7], [11, 12, 13, 14]], jnp.int32)
+
+        greedy = build_generate(cfg, mesh, max_new_tokens=8)
+        out = np.asarray(greedy(params, prompt))
+        print("greedy:")
+        for row in out:
+            print("  ", " ".join(f"{t:2d}" for t in row))
+        # The learned pattern continues each prompt modulo the vocab.
+        expect0 = [(7 + i + 1) % vocab for i in range(8)]
+        if list(out[0, 4:]) != expect0:
+            print(f"unexpected continuation (wanted {expect0})", file=sys.stderr)
+            return 1
+
+        sampler = build_generate(
+            cfg, mesh, max_new_tokens=8, temperature=0.9, top_k=4
+        )
+        print("sampled (temperature 0.9, top_k 4, three seeds):")
+        for seed in range(3):
+            out = np.asarray(sampler(params, prompt, jax.random.key(seed)))
+            print(f"  seed {seed}:", " ".join(f"{t:2d}" for t in out[0]))
+
+    print("done")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

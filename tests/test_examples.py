@@ -85,19 +85,17 @@ def test_control_plane_example_reaches_stable_state(path):
     assert not unbound, f"{path}: unbound pods {unbound}"
 
 
-def test_external_controller_example_runs():
-    """The SDK/informer walkthrough (examples/external_controller.py, the
-    client-go example analog) must keep working end-to-end: boot server,
-    create via client, observe add/update/delete through the informer."""
+def _run_example_script(name: str, timeout: int):
+    """Run an example script as a real subprocess with the repo importable
+    (the shared harness for every script-example test)."""
     import subprocess
     import sys
 
-    script = os.path.join(EXAMPLES, "external_controller.py")
-    res = subprocess.run(
-        [sys.executable, script],
+    return subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, name)],
         capture_output=True,
         text=True,
-        timeout=90,
+        timeout=timeout,
         env={
             **os.environ,
             "PYTHONPATH": os.pathsep.join(
@@ -109,6 +107,21 @@ def test_external_controller_example_runs():
             ),
         },
     )
+
+
+def test_external_controller_example_runs():
+    """The SDK/informer walkthrough (examples/external_controller.py, the
+    client-go example analog) must keep working end-to-end: boot server,
+    create via client, observe add/update/delete through the informer."""
+    res = _run_example_script("external_controller.py", timeout=90)
     assert res.returncode == 0, res.stdout + res.stderr
     for marker in ("observed add", "observed update", "observed delete", "done"):
         assert marker in res.stdout, (marker, res.stdout)
+
+
+def test_serve_demo_example_runs():
+    """The serving walkthrough (train -> greedy + sampled generation) must
+    keep working end-to-end, including its learned-continuation check."""
+    res = _run_example_script("serve_demo.py", timeout=240)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "greedy:" in res.stdout and "done" in res.stdout
