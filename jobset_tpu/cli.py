@@ -331,13 +331,19 @@ def _watch_jobsets(client, args) -> int:
                     args.namespace, resource_version=rv, timeout=poll
                 )
             except WatchGone:
-                # Journal window passed: events are unrecoverable; resume
-                # from a fresh listing (protected — the server may still
-                # be coming back).
+                # Journal window passed: the missed events are gone, so
+                # emit the CURRENT state of every (filtered) object as
+                # synthetic RELISTED rows — the informer's relist-drift
+                # behavior — rather than silently dropping transitions a
+                # consumer is waiting on. (Protected: the server may still
+                # be coming back.)
                 try:
-                    _, rv = relist()
+                    items, rv = relist()
                 except (ApiError, OSError):
                     _time.sleep(min(1.0, poll))
+                    continue
+                for raw in items:
+                    emit("RELISTED", raw)
                 continue
             except (ApiError, OSError):
                 # Transient transport error: keep the SAME resourceVersion

@@ -582,6 +582,13 @@ class Cluster:
         # Clear the binding before the domain-occupancy scan below so the pod
         # being released never counts as "still there".
         pod.spec.node_name = ""
+        # An unbind is a pod event like bind/create/delete: re-enqueue the
+        # placement check so the event-driven PodReconciler stays sound for
+        # any future caller that releases a leader while followers stay
+        # bound (today's callers also delete, but that is their choice, not
+        # this function's contract).
+        if (pk := self._placement_event(pod)):
+            self.dirty_placement_job_keys.add(pk)
         if node is not None and node.allocated > 0:
             node.allocated -= 1
             self._domain_stats_adjust(node, -1)

@@ -315,15 +315,19 @@ def _attention_block(p, x, cfg: TransformerConfig, t_local: int):
     key = rotary(proj(p["wk"], kv_heads_local), positions, cfg.rope_theta)
     value = proj(p["wv"], kv_heads_local)
     if cfg.attn_impl == "ulysses":
-        # Ulysses splits the head axis across sp: repeating BEFORE the
-        # all_to_all keeps each rank's q heads aligned with their kv groups
-        # for any (kv_heads, sp) combination. Ring has no such constraint —
-        # compact K/V ride the ppermutes and broadcast per block.
-        key, value = repeat_kv(key, group), repeat_kv(value, group)
-
-    if cfg.attn_impl == "ulysses":
+        # Ulysses splits the head axis across sp. When the compact kv head
+        # count divides sp, each rank's post-split q heads map exactly onto
+        # its kv heads (both splits are head-major), so compact K/V ride
+        # the all_to_alls and the blockwise fold broadcasts per block —
+        # the same group-times ICI saving the ring path gets. Only the
+        # indivisible corner case must pre-broadcast to keep q/kv groups
+        # rank-aligned.
+        sp = lax.psum(1, "sp")
+        if kv_heads_local % sp:
+            key, value = repeat_kv(key, group), repeat_kv(value, group)
         attn = ulysses_attention(q, key, value, "sp", causal=True)
     else:
+        # Ring has no alignment constraint: compact K/V ride the ppermutes.
         attn = ring_attention(q, key, value, "sp", causal=True)
     attn = attn.reshape(*attn.shape[:-2], heads_local * cfg.head_dim)
     out = jnp.einsum("btf,fd->btd", attn.astype(compute), p["wo"].astype(compute))

@@ -311,10 +311,16 @@ def blockwise_causal_attention(q, k, v, chunk: int = 512, causal: bool = True):
     head, broadcast per block inside the fold — callers ship/hold only the
     compact K/V. The final chunk may be ragged; all shapes are static at
     trace time.
+
+    The Python loops unroll O(n_chunks^2) kernel calls into the trace, so
+    the chunk is floored at T/16: compile size stays bounded for long
+    sequences while per-block bias/probability memory grows only linearly
+    in T (never the [T, T] materialization this fold exists to avoid).
     """
     t_total = q.shape[1]
     batch, _, heads, dim = q.shape
     group = heads // k.shape[2]
+    chunk = max(chunk, -(-t_total // 16))
     starts = list(range(0, t_total, chunk))
 
     def tri(n):

@@ -47,10 +47,11 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = True):
     sp = lax.psum(1, axis_name)
     out_dtype = q.dtype
     batch, t_local, heads_local, dim = q.shape
-    if heads_local % sp:
+    if heads_local % sp or k.shape[2] % sp:
         raise ValueError(
-            f"ulysses attention requires heads_local ({heads_local}) "
-            f"divisible by sp ({sp}); lower sp/tp or use ring attention"
+            f"ulysses attention requires q heads ({heads_local}) and kv "
+            f"heads ({k.shape[2]}) divisible by sp ({sp}); lower sp/tp, "
+            "pre-broadcast K/V, or use ring attention"
         )
 
     # Reshard in the input dtype (bf16 in training): casting to f32 first

@@ -187,7 +187,8 @@ class TokenDataset:
             raise ValueError(
                 f"corpus contains token id {int(windows.max())} >= the "
                 f"model's vocab_size {self.vocab_size} — out-of-vocab ids "
-                "would be silently clamped by the embedding gather"
+                "would silently embed as zeros (and as targets contribute "
+                "a meaningless loss term) instead of failing"
             )
         return {
             "inputs": np.ascontiguousarray(windows[:, :-1]),
