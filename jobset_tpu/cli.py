@@ -314,8 +314,10 @@ def _watch_jobsets(client, args) -> int:
     items, rv = relist()
     if args.output == "wide":
         print(f"{'EVENT':<9} {_JOBSET_HEADER}", flush=True)
+    known: dict = {}
     for raw in items:
         emit("LISTED", raw)
+        known[raw["metadata"]["name"]] = raw
 
     deadline = (
         _time.monotonic() + args.watch_timeout if args.watch_timeout else None
@@ -342,8 +344,14 @@ def _watch_jobsets(client, args) -> int:
                 except (ApiError, OSError):
                     _time.sleep(min(1.0, poll))
                     continue
+                current = {raw["metadata"]["name"]: raw for raw in items}
+                for name, last in list(known.items()):
+                    if name not in current:  # vanished inside the gap
+                        emit("DELETED", last)
+                        known.pop(name)
                 for raw in items:
                     emit("RELISTED", raw)
+                    known[raw["metadata"]["name"]] = raw
                 continue
             except (ApiError, OSError):
                 # Transient transport error: keep the SAME resourceVersion
@@ -355,6 +363,10 @@ def _watch_jobsets(client, args) -> int:
                 if args.name and obj["metadata"]["name"] != args.name:
                     continue
                 emit(ev["type"], obj)
+                if ev["type"] == "DELETED":
+                    known.pop(obj["metadata"]["name"], None)
+                else:
+                    known[obj["metadata"]["name"]] = obj
     except KeyboardInterrupt:
         pass
     return 0

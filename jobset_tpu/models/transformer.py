@@ -315,8 +315,8 @@ def _attention_block(p, x, cfg: TransformerConfig, t_local: int):
     key = rotary(proj(p["wk"], kv_heads_local), positions, cfg.rope_theta)
     value = proj(p["wv"], kv_heads_local)
     if cfg.attn_impl == "ulysses":
-        # Ulysses splits the head axis across sp. When the compact kv head
-        # count divides sp, each rank's post-split q heads map exactly onto
+        # Ulysses splits the head axis across sp. When sp divides the
+        # compact kv head count, each rank's post-split q heads map exactly onto
         # its kv heads (both splits are head-major), so compact K/V ride
         # the all_to_alls and the blockwise fold broadcasts per block —
         # the same group-times ICI saving the ring path gets. Only the

@@ -313,9 +313,12 @@ def blockwise_causal_attention(q, k, v, chunk: int = 512, causal: bool = True):
     trace time.
 
     The Python loops unroll O(n_chunks^2) kernel calls into the trace, so
-    the chunk is floored at T/16: compile size stays bounded for long
-    sequences while per-block bias/probability memory grows only linearly
-    in T (never the [T, T] materialization this fold exists to avoid).
+    the chunk is floored at T/16: at most ~136 kernel calls regardless of
+    sequence length, with per-block bias/scratch of (T/16)^2 — 256x
+    smaller than the [T, T] materialization this fold avoids, though still
+    quadratic in T. (A scan-folded inner loop would make truly-long-prompt
+    memory linear at fixed chunk; at the sequence lengths served today the
+    T/16 tile is the better compile-time/memory trade.)
     """
     t_total = q.shape[1]
     batch, _, heads, dim = q.shape

@@ -71,8 +71,9 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = True):
     # residency of three full-sequence tensors.
     qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
 
-    # Local attention = the shared blockwise fold at T_local granularity
-    # (constant per-chunk-pair biases, strictly-future pairs skipped).
+    # Local attention = the shared blockwise fold (chunked at T_local, or
+    # coarser when the fold's trace-size floor kicks in at sp > 16;
+    # constant per-chunk-pair biases, strictly-future pairs skipped).
     out = blockwise_causal_attention(
         qg, kg, vg, chunk=t_local, causal=causal
     ).astype(out_dtype)

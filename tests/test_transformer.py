@@ -522,3 +522,23 @@ def test_kitchen_sink_all_features_compose():
         losses.append(float(loss))
     assert all(np.isfinite(losses)), losses
     assert losses[-1] < losses[0], losses
+
+
+def test_ulysses_gqa_compact_kv_matches_single_device():
+    """GQA + Ulysses with kv heads divisible by sp: compact K/V ride the
+    all_to_alls (the rank-alignment argument in _attention_block) and the
+    trajectory still matches one device exactly."""
+    mc = MeshConfig(sp=2)  # kv_local = 4, divisible by sp -> compact path
+    cfg = tiny_config(
+        remat=False, n_heads=8, n_kv_heads=4, d_model=64,
+        attn_impl="ulysses",
+    )
+    cfg.validate(mc)
+    losses = {}
+    for name, mesh in (
+        ("multi", build_mesh(mc, jax.devices()[:2])),
+        ("single", build_mesh(MeshConfig(), jax.devices()[:1])),
+    ):
+        batch = make_batch(mesh, cfg.vocab_size, seed=31)
+        _, losses[name] = run_steps(cfg, mesh, batch, steps=3, seed=31)
+    np.testing.assert_allclose(losses["multi"], losses["single"], rtol=2e-4)
