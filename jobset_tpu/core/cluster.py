@@ -91,6 +91,10 @@ class Cluster:
         # last sync. Every pod create/delete/phase transition and job
         # create/update marks the owner; the controller visits only these.
         self.dirty_job_uids: set[str] = set()
+        # activeDeadlineSeconds timers (virtual-clock): job uid -> fire
+        # time, armed when the job first reports active pods, fired by the
+        # tick loop.
+        self.job_deadlines: dict[str, float] = {}
 
         # Scan-avoidance indexes for the tick loop (informer-cache analog of
         # the reference's field indexes): unbound pods awaiting the
@@ -397,6 +401,8 @@ class Cluster:
     def delete_job(self, namespace: str, name: str) -> None:
         """Foreground propagation: pods are deleted with the job."""
         key = (namespace, name)
+        if key in self.jobs:
+            self.job_deadlines.pop(self.jobs[key].metadata.uid, None)
         job = self.jobs.pop(key, None)
         if job is None:
             return
@@ -704,6 +710,35 @@ class Cluster:
             self.enqueue_reconcile(*self._next_tick_queue.popleft())
         self._drain_requeues()
         self._drain_deferred()
+
+        # 0b. activeDeadlineSeconds: fail running jobs whose deadline has
+        # passed on the virtual clock (k8s Job controller semantics; the
+        # DeadlineExceeded reason feeds failure-policy rule matching).
+        if self.job_deadlines:
+            now = self.clock.now()
+            for uid, fire in sorted(self.job_deadlines.items()):
+                if fire > now:
+                    continue
+                del self.job_deadlines[uid]
+                key = self.jobs_by_uid.get(uid)
+                job = self.jobs.get(key) if key else None
+                if job is None or job.finished()[0]:
+                    continue
+                if job.suspended():
+                    # k8s semantics: a suspended job does not enforce its
+                    # deadline. Resume clears start_time, so the timer
+                    # re-arms from the fresh start when pods return.
+                    continue
+                self.fail_job(
+                    job.metadata.namespace,
+                    job.metadata.name,
+                    reason=keys.JOB_REASON_DEADLINE_EXCEEDED,
+                    message=(
+                        f"job exceeded activeDeadlineSeconds="
+                        f"{job.spec.active_deadline_seconds}"
+                    ),
+                )
+                changed = True
 
         # 1. JobSet reconciler drains the work queue.
         while self.reconcile_queue:

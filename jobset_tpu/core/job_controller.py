@@ -151,6 +151,15 @@ class JobController:
             ) = new
             if job.status.start_time is None and active:
                 job.status.start_time = self.cluster.clock.now()
+                # activeDeadlineSeconds (k8s Job semantics, enforced by the
+                # simulated Job controller on the virtual clock): the job
+                # fails with DeadlineExceeded once the deadline passes —
+                # the reason failure-policy rules match on organically.
+                deadline = job.spec.active_deadline_seconds
+                if deadline is not None:
+                    self.cluster.job_deadlines[job.metadata.uid] = (
+                        job.status.start_time + float(deadline)
+                    )
             self.cluster._enqueue_owner_of(job)
             return True
         return False

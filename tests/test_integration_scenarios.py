@@ -440,3 +440,96 @@ spec:
         pool = nodes[p["spec"]["nodeName"]]["metadata"]["labels"][TOPOLOGY]
         by_job.setdefault(p["metadata"]["labels"][keys.JOB_INDEX_KEY], set()).add(pool)
     assert by_job == {"0": {"pool-a"}, "1": {"pool-b"}}
+
+
+# ---------------------------------------------------------------------------
+# activeDeadlineSeconds -> DeadlineExceeded feeding failure-policy rules
+# (k8s Job semantics; failure_policy.go OnJobFailureReasons matching)
+
+
+def _deadline_jobset(name, rules=None, max_restarts=0):
+    b = make_jobset(name).failure_policy(
+        FailurePolicy(max_restarts=max_restarts, rules=rules or [])
+    )
+    rjob = make_replicated_job("workers").replicas(1).parallelism(1).obj()
+    rjob.template.spec.active_deadline_seconds = 30
+    return b.replicated_job(rjob).obj()
+
+
+def test_active_deadline_fails_job_with_deadline_exceeded():
+    """A running job whose activeDeadlineSeconds passes on the virtual
+    clock fails with the DeadlineExceeded reason — organically, not via
+    test injection — and the JobSet (no matching rule, maxRestarts=0)
+    fails."""
+    cluster = make_cluster()
+    cluster.add_topology(TOPOLOGY, num_domains=2, nodes_per_domain=2, capacity=4)
+    cluster.create_jobset(_deadline_jobset("dl"))
+    cluster.run_until_stable()
+    assert not cluster.get_jobset("default", "dl").status.terminal_state
+
+    cluster.clock.advance(29)
+    cluster.run_until_stable()
+    assert not cluster.get_jobset("default", "dl").status.terminal_state
+
+    cluster.clock.advance(2)  # past the 30s deadline
+    cluster.run_until_stable()
+    live = cluster.get_jobset("default", "dl")
+    assert live.status.terminal_state == keys.JOBSET_FAILED
+    job_conds = [
+        c for j in cluster.jobs_for_jobset(live) for c in j.status.conditions
+    ]
+    assert any(
+        c.reason == keys.JOB_REASON_DEADLINE_EXCEEDED for c in job_conds
+    )
+
+
+def test_failure_rule_matches_organic_deadline_exceeded():
+    """A RestartJobSet rule targeting OnJobFailureReasons=[DeadlineExceeded]
+    matches the organically-produced reason and gang-restarts instead of
+    failing."""
+    from jobset_tpu.api.types import FailurePolicyRule
+
+    rule = FailurePolicyRule(
+        name="restartOnDeadline",
+        action="RestartJobSet",
+        on_job_failure_reasons=[keys.JOB_REASON_DEADLINE_EXCEEDED],
+    )
+    cluster = make_cluster()
+    cluster.add_topology(TOPOLOGY, num_domains=2, nodes_per_domain=2, capacity=4)
+    cluster.create_jobset(_deadline_jobset("dl-r", rules=[rule], max_restarts=3))
+    cluster.run_until_stable()
+
+    cluster.clock.advance(31)
+    cluster.run_until_stable()
+    live = cluster.get_jobset("default", "dl-r")
+    assert not live.status.terminal_state
+    assert live.status.restarts == 1  # gang-restarted by the matching rule
+
+
+def test_suspended_job_does_not_enforce_deadline():
+    """k8s semantics: suspension pauses the deadline; resume re-arms it
+    from the fresh start time."""
+    cluster = make_cluster()
+    cluster.add_topology(TOPOLOGY, num_domains=2, nodes_per_domain=2, capacity=4)
+    cluster.create_jobset(_deadline_jobset("dl-s"))
+    cluster.run_until_stable()
+
+    live = cluster.get_jobset("default", "dl-s")
+    live.spec.suspend = True
+    cluster.update_jobset(live)
+    cluster.run_until_stable()
+
+    cluster.clock.advance(120)  # way past the 30s deadline, while suspended
+    cluster.run_until_stable()
+    assert not cluster.get_jobset("default", "dl-s").status.terminal_state
+
+    live = cluster.get_jobset("default", "dl-s")
+    live.spec.suspend = False
+    cluster.update_jobset(live)
+    cluster.run_until_stable()
+    cluster.clock.advance(31)  # new deadline counted from the resume
+    cluster.run_until_stable()
+    assert (
+        cluster.get_jobset("default", "dl-s").status.terminal_state
+        == keys.JOBSET_FAILED
+    )
