@@ -401,11 +401,10 @@ class Cluster:
     def delete_job(self, namespace: str, name: str) -> None:
         """Foreground propagation: pods are deleted with the job."""
         key = (namespace, name)
-        if key in self.jobs:
-            self.job_deadlines.pop(self.jobs[key].metadata.uid, None)
         job = self.jobs.pop(key, None)
         if job is None:
             return
+        self.job_deadlines.pop(job.metadata.uid, None)
         owner_set = self.jobs_by_owner.get(job.metadata.owner_uid)
         if owner_set is not None:
             owner_set.discard(key)
@@ -807,6 +806,7 @@ class Cluster:
 
     def complete_job(self, namespace: str, name: str) -> None:
         job = self.jobs[(namespace, name)]
+        self.job_deadlines.pop(job.metadata.uid, None)
         completions = job.spec.completions if job.spec.completions is not None else (
             job.spec.parallelism or 1
         )
@@ -831,15 +831,32 @@ class Cluster:
             if not finished:
                 self.complete_job(job.metadata.namespace, job.metadata.name)
 
-    def fail_job(
-        self,
-        namespace: str,
-        name: str,
-        reason: str = keys.JOB_REASON_BACKOFF_LIMIT_EXCEEDED,
-        message: str = "simulated failure",
-    ) -> None:
-        job = self.jobs[(namespace, name)]
-        job.status.failed += 1
+    def fail_pod(self, namespace: str, name: str) -> None:
+        """Fail ONE pod (container crash analog): the pod goes Failed, its
+        binding is released, and the owner job re-syncs — the simulated Job
+        controller retries the index until the job's backoffLimit is
+        exceeded, at which point the job fails organically with
+        BackoffLimitExceeded (k8s Job retry semantics)."""
+        pod = self.pods[(namespace, name)]
+        if pod.status.phase not in (POD_PENDING, POD_RUNNING):
+            return
+        self._release_pod_placement(pod)
+        pod.status.phase = POD_FAILED
+        pod.status.ready = False
+        key = (namespace, name)
+        self.pending_pod_keys.pop(key, None)
+        self.leader_pod_keys.discard(key)  # a dead leader is not watched
+        job_key = self.jobs_by_uid.get(pod.metadata.owner_uid)
+        if job_key is not None:
+            self.jobs[job_key].status.pod_failures += 1
+        self.dirty_job_uids.add(pod.metadata.owner_uid)
+        if (pk := self._placement_event(pod)):
+            self.dirty_placement_job_keys.add(pk)
+
+    def mark_job_failed(self, job: Job, reason: str, message: str) -> None:
+        """Record the Failed condition and finish the job's pods (no failed
+        counter bump — the caller owns the accounting)."""
+        self.job_deadlines.pop(job.metadata.uid, None)
         job.status.active = 0
         job.status.ready = 0
         job.status.conditions.append(
@@ -852,6 +869,17 @@ class Cluster:
             )
         )
         self._finish_pods(job, POD_FAILED)
+
+    def fail_job(
+        self,
+        namespace: str,
+        name: str,
+        reason: str = keys.JOB_REASON_BACKOFF_LIMIT_EXCEEDED,
+        message: str = "simulated failure",
+    ) -> None:
+        job = self.jobs[(namespace, name)]
+        job.status.failed += 1
+        self.mark_job_failed(job, reason, message)
         self._enqueue_owner_of(job)
 
     def set_job_ready(self, namespace: str, name: str) -> None:

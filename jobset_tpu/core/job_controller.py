@@ -97,6 +97,24 @@ class JobController:
 
         changed = False
         complete = True
+        # k8s Job retry semantics: failed pods free their index for a retry
+        # (they never enter `existing`), but once the MONOTONIC pod-failure
+        # count exceeds backoffLimit the job fails with
+        # BackoffLimitExceeded instead of retrying forever. (The live
+        # `failed` count below can shrink — drift enforcement may delete
+        # Failed pod records — so the decision uses status.pod_failures,
+        # which only grows, mirroring k8s's finalizer-backed accounting.)
+        if job.status.pod_failures > job.spec.backoff_limit:
+            self._apply_status(job, 0, 0, succeeded, failed)
+            job.status.failed = max(job.status.failed, job.status.pod_failures)
+            cluster.mark_job_failed(
+                job,
+                keys.JOB_REASON_BACKOFF_LIMIT_EXCEEDED,
+                f"pod failures ({job.status.pod_failures}) exceeded "
+                f"backoffLimit ({job.spec.backoff_limit})",
+            )
+            cluster._enqueue_owner_of(job)
+            return True, True
         # Leader (index 0) first: under exclusive placement follower admission
         # is gated on the leader being scheduled, so creating in index order
         # minimizes rejected attempts.

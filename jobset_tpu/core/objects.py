@@ -81,6 +81,11 @@ class JobStatus:
     ready: int = 0
     succeeded: int = 0
     failed: int = 0
+    # Monotonic pod-failure counter for backoffLimit accounting (real k8s
+    # keeps status.failed monotonic via pod finalizers; our `failed` above
+    # is recomputed from live pod records, which drift enforcement may
+    # delete — this one only ever grows).
+    pod_failures: int = 0
     start_time: Optional[float] = None
     completion_time: Optional[float] = None
     conditions: list[Condition] = field(default_factory=list)

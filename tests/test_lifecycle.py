@@ -326,3 +326,53 @@ def test_churn_soak_leaves_no_index_residue():
         assert not any(domains.values()), domains
     # Node capacity fully returned.
     assert all(n.allocated == 0 for n in cluster.nodes.values())
+
+
+def test_pod_failure_retried_within_backoff_limit():
+    """A single pod failure frees its index for a retry (k8s Job
+    semantics): the replacement pod binds and the JobSet still completes."""
+    cluster = default_cluster()
+    js = cluster.create_jobset(two_rjob_jobset("retry-js"))
+    cluster.run_until_stable()
+    victim = next(iter(cluster.pods.values()))
+    cluster.fail_pod(victim.metadata.namespace, victim.metadata.name)
+    cluster.run_until_stable()
+
+    live = cluster.get_jobset("default", js.name)
+    assert not live.status.terminal_state  # retried, not failed
+    bound = sum(1 for p in cluster.pods.values()
+                if p.spec.node_name and p.status.phase != "Failed")
+    assert bound == sum(
+        int(r.replicas) * r.template.spec.pods_expected()
+        for r in live.spec.replicated_jobs
+    )
+    cluster.complete_all_jobs(live)
+    cluster.run_until_stable()
+    assert cluster.get_jobset("default", js.name).status.terminal_state == \
+        keys.JOBSET_COMPLETED
+
+
+def test_backoff_limit_exceeded_fails_job_organically():
+    """Repeated pod failures past backoffLimit fail the job with
+    BackoffLimitExceeded — organically driving the jobset failure path."""
+    from jobset_tpu.testing import make_jobset, make_replicated_job
+
+    cluster = default_cluster()
+    rjob = make_replicated_job("w").replicas(1).parallelism(1).obj()
+    rjob.template.spec.backoff_limit = 1
+    js = make_jobset("bl").replicated_job(rjob).obj()
+    cluster.create_jobset(js)
+    cluster.run_until_stable()
+
+    for _ in range(2):  # failures 1 and 2; limit is 1
+        pod = next(p for p in cluster.pods.values()
+                   if p.status.phase in ("Pending", "Running"))
+        cluster.fail_pod(pod.metadata.namespace, pod.metadata.name)
+        cluster.run_until_stable()
+
+    live = cluster.get_jobset("default", "bl")
+    assert live.status.terminal_state == keys.JOBSET_FAILED
+    conds = [c for j in cluster.jobs_for_jobset(live)
+             for c in j.status.conditions]
+    assert any(c.reason == keys.JOB_REASON_BACKOFF_LIMIT_EXCEEDED
+               for c in conds)
