@@ -804,13 +804,10 @@ class Cluster:
                     (pod.metadata.namespace, pod.metadata.name), None
                 )
 
-    def complete_job(self, namespace: str, name: str) -> None:
-        job = self.jobs[(namespace, name)]
+    def mark_job_complete(self, job: Job) -> None:
+        """Record the Complete condition and finish the job's pods (the
+        caller owns the succeeded-count accounting)."""
         self.job_deadlines.pop(job.metadata.uid, None)
-        completions = job.spec.completions if job.spec.completions is not None else (
-            job.spec.parallelism or 1
-        )
-        job.status.succeeded = completions
         job.status.active = 0
         job.status.ready = 0
         job.status.completion_time = self.clock.now()
@@ -825,11 +822,46 @@ class Cluster:
         self._finish_pods(job, POD_SUCCEEDED)
         self._enqueue_owner_of(job)
 
+    def complete_job(self, namespace: str, name: str) -> None:
+        job = self.jobs[(namespace, name)]
+        job.status.succeeded = job.completions_required()
+        self.mark_job_complete(job)
+
     def complete_all_jobs(self, js: JobSet) -> None:
         for job in self.jobs_for_jobset(js):
             finished, _ = job.finished()
             if not finished:
                 self.complete_job(job.metadata.namespace, job.metadata.name)
+
+    def _terminate_pod(self, pod: Pod, phase: str) -> Optional[Job]:
+        """Shared terminal transition for one pod (crash or exit-0):
+        release the binding, leave pending/leader indexes, mark the owner
+        dirty. Returns the owner job (if still present)."""
+        self._release_pod_placement(pod)
+        pod.status.phase = phase
+        pod.status.ready = False
+        key = (pod.metadata.namespace, pod.metadata.name)
+        self.pending_pod_keys.pop(key, None)
+        self.leader_pod_keys.discard(key)  # a dead leader is not watched
+        self.dirty_job_uids.add(pod.metadata.owner_uid)
+        if (pk := self._placement_event(pod)):
+            self.dirty_placement_job_keys.add(pk)
+        job_key = self.jobs_by_uid.get(pod.metadata.owner_uid)
+        return self.jobs.get(job_key) if job_key else None
+
+    def succeed_pod(self, namespace: str, name: str) -> None:
+        """Succeed ONE pod (container exit-0 analog): the pod goes
+        Succeeded, its completion index is recorded (monotonic, distinct),
+        and the owner job re-syncs — the simulated Job controller marks
+        the job Complete organically once every required index has
+        succeeded (k8s Indexed semantics)."""
+        pod = self.pods[(namespace, name)]
+        if pod.status.phase not in (POD_PENDING, POD_RUNNING):
+            return
+        job = self._terminate_pod(pod, POD_SUCCEEDED)
+        idx = pod.completion_index()
+        if job is not None and idx is not None:
+            job.status.succeeded_indexes.add(idx)
 
     def fail_pod(self, namespace: str, name: str) -> None:
         """Fail ONE pod (container crash analog): the pod goes Failed, its
@@ -840,18 +872,9 @@ class Cluster:
         pod = self.pods[(namespace, name)]
         if pod.status.phase not in (POD_PENDING, POD_RUNNING):
             return
-        self._release_pod_placement(pod)
-        pod.status.phase = POD_FAILED
-        pod.status.ready = False
-        key = (namespace, name)
-        self.pending_pod_keys.pop(key, None)
-        self.leader_pod_keys.discard(key)  # a dead leader is not watched
-        job_key = self.jobs_by_uid.get(pod.metadata.owner_uid)
-        if job_key is not None:
-            self.jobs[job_key].status.pod_failures += 1
-        self.dirty_job_uids.add(pod.metadata.owner_uid)
-        if (pk := self._placement_event(pod)):
-            self.dirty_placement_job_keys.add(pk)
+        job = self._terminate_pod(pod, POD_FAILED)
+        if job is not None:
+            job.status.pod_failures += 1
 
     def mark_job_failed(self, job: Job, reason: str, message: str) -> None:
         """Record the Failed condition and finish the job's pods (no failed

@@ -95,6 +95,20 @@ class JobController:
             elif phase == POD_FAILED:
                 failed += 1
 
+        # k8s completion semantics: the job completes organically once
+        # enough pods have Succeeded (Indexed: one success per index; the
+        # index dedup is implicit — a Succeeded index is never recreated,
+        # so `succeeded` counts distinct indexes).
+        completions = (
+            job.spec.completions
+            if job.spec.completions is not None
+            else (job.spec.parallelism or 1)
+        )
+        if succeeded >= completions:
+            self._apply_status(job, 0, 0, succeeded, failed)
+            cluster.mark_job_complete(job)
+            return True, True
+
         changed = False
         complete = True
         # k8s Job retry semantics: failed pods free their index for a retry

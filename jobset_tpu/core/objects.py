@@ -86,6 +86,11 @@ class JobStatus:
     # is recomputed from live pod records, which drift enforcement may
     # delete — this one only ever grows).
     pod_failures: int = 0
+    # Completion indexes that have succeeded — monotonic AND distinct, the
+    # Indexed-job analog of k8s's finalizer-backed succeeded tracking: a
+    # succeeded index is never recreated and survives its pod record being
+    # deleted (e.g. by drift enforcement).
+    succeeded_indexes: set[int] = field(default_factory=set)
     start_time: Optional[float] = None
     completion_time: Optional[float] = None
     conditions: list[Condition] = field(default_factory=list)
@@ -125,6 +130,14 @@ class Job:
 
     def pods_expected(self) -> int:
         return self.spec.pods_expected()
+
+    def completions_required(self) -> int:
+        """Distinct completion indexes that must succeed — THE definition
+        shared by driven (complete_job) and organic (_sync_pods)
+        completion, so the two paths cannot disagree."""
+        if self.spec.completions is not None:
+            return self.spec.completions
+        return self.spec.parallelism or 1
 
 
 @dataclass(slots=True)

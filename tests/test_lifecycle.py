@@ -376,3 +376,22 @@ def test_backoff_limit_exceeded_fails_job_organically():
              for c in j.status.conditions]
     assert any(c.reason == keys.JOB_REASON_BACKOFF_LIMIT_EXCEEDED
                for c in conds)
+
+
+def test_pods_succeeding_complete_jobset_organically():
+    """Succeeding every pod through succeed_pod (container exit-0 analog)
+    completes each job at its completions count and the success policy
+    marks the JobSet Completed — no complete_job drive involved."""
+    cluster = default_cluster()
+    js = cluster.create_jobset(two_rjob_jobset("organic-js"))
+    cluster.run_until_stable()
+
+    for pod in list(cluster.pods.values()):
+        cluster.succeed_pod(pod.metadata.namespace, pod.metadata.name)
+    cluster.run_until_stable()
+
+    live = cluster.get_jobset("default", "organic-js")
+    assert live.status.terminal_state == keys.JOBSET_COMPLETED
+    for job in cluster.jobs_for_jobset(live):
+        finished, kind = job.finished()
+        assert finished and kind == "Complete"
