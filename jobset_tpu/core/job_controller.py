@@ -77,28 +77,41 @@ class JobController:
         every desired pod exists (nothing left to retry)."""
         cluster = self.cluster
         desired = self._desired_indexes(job)
-        active = ready = succeeded = failed = 0
-        existing: set[int] = set()
+        active = ready = failed = 0
+        # Completion credit is index-based and survives pod-record deletion
+        # (drift enforcement may delete a Succeeded pod's record): the
+        # monotonic status.succeeded_indexes set — written by
+        # Cluster.succeed_pod — is the source of truth, unioned with any
+        # live Succeeded pods, mirroring k8s's finalizer-backed accounting.
+        succeeded_indexes: set[int] = set(job.status.succeeded_indexes)
+        existing: set[int] = set(succeeded_indexes)
         for key in cluster.pods_by_job_uid.get(job.metadata.uid, ()):
             pod = cluster.pods.get(key)
             if pod is None:
                 continue
             phase = pod.status.phase
+            idx = pod.completion_index()
             if phase in (POD_PENDING, POD_RUNNING):
                 active += 1
                 if pod.status.ready:
                     ready += 1
-                existing.add(pod.completion_index())
+                if idx is not None:
+                    existing.add(idx)
             elif phase == "Succeeded":
-                succeeded += 1
-                existing.add(pod.completion_index())
+                if idx is not None:
+                    succeeded_indexes.add(idx)
+                    existing.add(idx)
             elif phase == POD_FAILED:
                 failed += 1
+        # Write the union back so the survival guarantee holds even for a
+        # Succeeded pod whose index was never recorded via succeed_pod.
+        job.status.succeeded_indexes |= succeeded_indexes
+        succeeded = len(succeeded_indexes)
 
         # k8s completion semantics: the job completes organically once
-        # enough pods have Succeeded (Indexed: one success per index; the
-        # index dedup is implicit — a Succeeded index is never recreated,
-        # so `succeeded` counts distinct indexes).
+        # enough pods have Succeeded (Indexed: one success per index;
+        # `succeeded` counts distinct indexes, and a succeeded index is
+        # never recreated because it is seeded into `existing` above).
         completions = (
             job.spec.completions
             if job.spec.completions is not None

@@ -285,6 +285,11 @@ def _pick_token(logits, key, pos, temperature: float, top_k: int):
     exactly: every shard's local top-k values are all-gathered over tp
     (k*tp floats — trivial), the global k-th value is the threshold, and
     sub-threshold logits are masked before the Gumbel draw.
+
+    Tie semantics (documented behavior): the mask keeps every logit equal
+    to the k-th threshold value, so when ties straddle the threshold
+    (plausible with bf16-cast params) slightly more than top_k candidates
+    survive — i.e. this is "top-k by value", not "exactly k by index".
     """
     if temperature <= 0.0:
         return _global_argmax(logits)

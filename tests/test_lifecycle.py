@@ -395,3 +395,48 @@ def test_pods_succeeding_complete_jobset_organically():
     for job in cluster.jobs_for_jobset(live):
         finished, kind = job.finished()
         assert finished and kind == "Complete"
+
+
+def test_succeeded_index_survives_pod_record_deletion():
+    """Completion credit is index-based and survives the Succeeded pod's
+    record being deleted (drift enforcement deletes follower pods in any
+    phase): the index is neither recreated nor its credit lost, and the
+    job still completes once the remaining indexes succeed."""
+    cluster = default_cluster()
+    rjob = (
+        make_replicated_job("w").replicas(1).parallelism(2).completions(2).obj()
+    )
+    js = make_jobset("keep-credit").replicated_job(rjob).obj()
+    cluster.create_jobset(js)
+    cluster.run_until_stable()
+
+    pods = [p for p in cluster.pods.values()
+            if p.status.phase in ("Pending", "Running")]
+    assert len(pods) == 2
+    first = min(pods, key=lambda p: p.completion_index())
+    idx = first.completion_index()
+    cluster.succeed_pod(first.metadata.namespace, first.metadata.name)
+    cluster.run_until_stable()
+
+    # Delete the Succeeded pod's record outright (what drift enforcement
+    # may do) — the monotonic index set must retain the credit.
+    cluster.delete_pod(first.metadata.namespace, first.metadata.name)
+    cluster.run_until_stable()
+
+    job = cluster.get_job("default", "keep-credit-w-0")
+    assert idx in job.status.succeeded_indexes
+    # The succeeded index was NOT recreated as a fresh pod.
+    live_indexes = {p.completion_index() for p in cluster.pods.values()
+                    if p.status.phase in ("Pending", "Running")}
+    assert idx not in live_indexes
+    assert job.status.succeeded == 1
+
+    for pod in [p for p in cluster.pods.values()
+                if p.status.phase in ("Pending", "Running")]:
+        cluster.succeed_pod(pod.metadata.namespace, pod.metadata.name)
+    cluster.run_until_stable()
+
+    finished, kind = cluster.get_job("default", "keep-credit-w-0").finished()
+    assert finished and kind == "Complete"
+    live = cluster.get_jobset("default", "keep-credit")
+    assert live.status.terminal_state == keys.JOBSET_COMPLETED
