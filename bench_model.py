@@ -34,6 +34,11 @@ def main() -> int:
         help="enable per-layer rematerialization (off by default for the "
              "bench: activations fit, and recompute FLOPs aren't credited)",
     )
+    parser.add_argument(
+        "--profile-dir",
+        help="capture a JAX profiler trace of the timed region into this "
+             "directory (open with TensorBoard/XProf)",
+    )
     args = parser.parse_args()
 
     from bench import _cpu_forced, _force_cpu
@@ -58,6 +63,7 @@ def main() -> int:
         batch=args.batch,
         seq_len=args.seq_len,
         config=cfg,
+        profile_dir=args.profile_dir,
     )
     if args.decode:
         from jobset_tpu.runtime.model_bench import run_decode_bench

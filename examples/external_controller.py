@@ -5,8 +5,9 @@ The analog of the reference's `examples/client-go/main.go` (a Go program
 that creates a JobSet through the generated clientset) — extended to show
 the watch/informer machinery an external controller (e.g. a queueing
 system like Kueue/MultiKueue) builds on: create a JobSet through the typed
-client, react to its lifecycle through a `JobSetInformer` without polling,
-and clean up when it completes.
+client, react to its lifecycle through a `JobSetInformer`, and observe the
+CHILD jobs/pods through `JobInformer`/`PodInformer` (the client-go
+generated-informer analog) — fully event-driven, no polling anywhere.
 
 Run it self-contained (it boots an in-process controller server — the
 simulated cluster has no kubelet, so the script also plays the role of
@@ -21,7 +22,12 @@ import argparse
 import sys
 import threading
 
-from jobset_tpu.client import JobSetClient, JobSetInformer
+from jobset_tpu.client import (
+    JobInformer,
+    JobSetClient,
+    JobSetInformer,
+    PodInformer,
+)
 from jobset_tpu.testing import make_jobset, make_replicated_job
 
 
@@ -75,19 +81,38 @@ def main() -> int:
         poll_timeout=1.0,
     ).start()
 
+    # Child watches: an external controller reacts to job/pod state through
+    # events, never by polling GETs.
+    children_ready = threading.Event()
+    child_jobs: set[str] = set()
+
+    def on_child_job(job):
+        child_jobs.add(job["metadata"]["name"])
+        print(f"observed child job: {job['metadata']['name']}")
+        if len(child_jobs) >= 2:  # both replicas materialized
+            children_ready.set()
+
+    job_informer = JobInformer(
+        client, on_add=on_child_job, poll_timeout=1.0
+    ).start()
+    pod_informer = PodInformer(
+        client,
+        on_add=lambda p: print(f"observed child pod: {p['metadata']['name']}"),
+        poll_timeout=1.0,
+    ).start()
+
     js = build_jobset()
     created = client.create(js)
     print(f"created {created.metadata.name} (uid {created.metadata.uid})")
 
-    # The in-process simulator has no kubelet, so drive the child jobs to
-    # completion the way the integration suite does: under the server lock
-    # (the background pump thread reconciles every tick), then refresh the
-    # watch journal so the informer sees the status transition.
-    import time
-
-    deadline = time.monotonic() + 10
-    while not server.cluster.jobs and time.monotonic() < deadline:
-        time.sleep(0.1)
+    # Event-driven rendezvous with the children (the JobInformer fires as
+    # the reconciler materializes them — no polling loop). The in-process
+    # simulator has no kubelet, so once they exist this script drives their
+    # completion under the server lock, then refreshes the watch journal so
+    # the informers see the status transition.
+    if not children_ready.wait(timeout=10):
+        print("child jobs never observed", file=sys.stderr)
+        return 1
     with server.lock:
         js_live = server.cluster.get_jobset("default", "external-demo")
         server.cluster.complete_all_jobs(js_live)
@@ -104,6 +129,8 @@ def main() -> int:
         return 1
 
     informer.stop()
+    job_informer.stop()
+    pod_informer.stop()
     server.stop()
     print("done")
     return 0

@@ -65,6 +65,14 @@ def _build_parser() -> argparse.ArgumentParser:
     c.add_argument("--tls-hosts", default="",
                    help="extra comma-separated SANs for the self-signed "
                         "cert (service names / external IPs clients use)")
+    c.add_argument("--leader-elect", action="store_true",
+                   help="contend for the shared lease; only the holder runs "
+                        "the reconcile loops (main.go:100-117 analog)")
+    c.add_argument("--lease-file", default="/tmp/jobset-tpu-leader.lease",
+                   help="shared lease path for --leader-elect (a shared "
+                        "volume between controller replicas)")
+    c.add_argument("--lease-identity", default="",
+                   help="holder identity (default: hostname_pid)")
 
     s = sub.add_parser("solver", help="run the placement solver sidecar (gRPC)")
     s.add_argument("--addr", default="127.0.0.1:8500")
@@ -114,6 +122,8 @@ def _build_parser() -> argparse.ArgumentParser:
     )
     w.add_argument("--workload-file")
     w.add_argument("--cpu", action="store_true")
+    w.add_argument("--profile-dir",
+                   help="capture a JAX profiler trace of the training run")
 
     return parser
 
@@ -171,12 +181,27 @@ def _cmd_controller(args) -> int:
         _, tls_cert, tls_key = ensure_serving_certs(
             args.tls_self_signed, hosts=hosts
         )
+    elector = None
+    if args.leader_elect:
+        from .core.lease import FileLease, LeaderElector, default_identity
+
+        elector = LeaderElector(
+            FileLease(args.lease_file),
+            args.lease_identity or default_identity(),
+        )
     server = ControllerServer(args.addr, cluster=cluster,
                               tick_interval=args.tick_interval,
-                              tls_cert=tls_cert, tls_key=tls_key).start()
+                              tls_cert=tls_cert, tls_key=tls_key,
+                              elector=elector,
+                              # Separate-process replicas have private
+                              # state: a standby must not accept writes the
+                              # leader would never observe.
+                              standby_accepts_writes=False).start()
     scheme = "https" if server.tls else "http"
     print(f"controller listening on {scheme}://{server.address} "
-          f"(solver={'sidecar ' + args.solver_addr if args.solver_addr else 'in-process'})",
+          f"(solver={'sidecar ' + args.solver_addr if args.solver_addr else 'in-process'}"
+          + (f", leader-elect as {elector.identity}" if elector else "")
+          + ")",
           flush=True)
     _wait_for_signal()
     server.stop()
@@ -443,6 +468,8 @@ def _cmd_worker(args) -> int:
         argv += ["--workload-file", args.workload_file]
     if args.cpu:
         argv.append("--cpu")
+    if args.profile_dir:
+        argv += ["--profile-dir", args.profile_dir]
     return worker_main(argv)
 
 

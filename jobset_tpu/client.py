@@ -148,8 +148,15 @@ class JobSetClient:
         out = self._request("GET", self._collection(namespace))
         return out["items"], out.get("resourceVersion", 0)
 
+    def _resource_path(self, kind: str, namespace: str) -> str:
+        """Collection path for a watchable kind: jobsets live under the
+        group API, child jobs/pods under the core API."""
+        if kind == "jobsets":
+            return self._collection(namespace)
+        return f"/api/v1/namespaces/{namespace}/{kind}"
+
     def watch(self, namespace="default", resource_version=0, timeout=15.0):
-        """One long-poll against the watch endpoint.
+        """One long-poll against the JobSet watch endpoint.
 
         Returns (events, resource_version): events are
         {"type": ADDED|MODIFIED|DELETED, "object": manifest,
@@ -157,8 +164,16 @@ class JobSetClient:
         resource_version is the token for the next call. Raises WatchGone
         when the version is too old.
         """
+        return self.watch_resource("jobsets", namespace, resource_version, timeout)
+
+    def watch_resource(
+        self, kind: str, namespace="default", resource_version=0, timeout=15.0
+    ):
+        """One long-poll watch for any journaled kind ("jobsets", "jobs",
+        "pods") — the client-go generated-informer analog for child
+        resources, so external controllers don't poll for child state."""
         path = (
-            f"{self._collection(namespace)}?watch=1"
+            f"{self._resource_path(kind, namespace)}?watch=1"
             f"&resourceVersion={int(resource_version)}"
             f"&timeoutSeconds={timeout}"
         )
@@ -174,6 +189,12 @@ class JobSetClient:
                 raise WatchGone(410, detail) from None
             raise ApiError(exc.code, detail) from None
         return out["events"], out["resourceVersion"]
+
+    def list_resource_with_version(self, kind: str, namespace: str = "default"):
+        """(manifest dicts, resourceVersion) for any journaled kind — the
+        list half of list-then-watch."""
+        out = self._request("GET", self._resource_path(kind, namespace))
+        return out["items"], out.get("resourceVersion", 0)
 
     def update(self, js: JobSet, namespace: Optional[str] = None) -> JobSet:
         ns = namespace or js.metadata.namespace or "default"
@@ -281,8 +302,9 @@ class WatchGone(ApiError):
     window (HTTP 410): relist and restart the watch."""
 
 
-class JobSetInformer:
-    """Event-driven JobSet cache with handlers and periodic resync.
+class ResourceInformer:
+    """Event-driven object cache with handlers and periodic resync, for any
+    journaled kind ("jobsets", "jobs", "pods").
 
     The client-go shared-informer pattern over the controller's long-poll
     watch: `start()` lists (populating the cache and firing on_add), then a
@@ -293,6 +315,8 @@ class JobSetInformer:
     drift), so handlers converge even across missed events.
     """
 
+    KIND = "jobsets"
+
     def __init__(
         self,
         client: JobSetClient,
@@ -302,8 +326,10 @@ class JobSetInformer:
         on_update=None,
         on_delete=None,
         poll_timeout: float = 5.0,
+        kind: Optional[str] = None,
     ):
         self.client = client
+        self.kind = kind or self.KIND
         self.namespace = namespace
         self.resync_seconds = resync_seconds
         self.poll_timeout = poll_timeout
@@ -318,7 +344,7 @@ class JobSetInformer:
 
     # -- lifecycle ---------------------------------------------------------
 
-    def start(self) -> "JobSetInformer":
+    def start(self) -> "ResourceInformer":
         self._relist()
         self._synced.set()
         self._thread = threading.Thread(target=self._run, daemon=True)
@@ -352,7 +378,9 @@ class JobSetInformer:
             )
 
     def _relist(self) -> None:
-        items, rv = self.client.list_with_version(self.namespace)
+        items, rv = self.client.list_resource_with_version(
+            self.kind, self.namespace
+        )
         fresh = {self._name(obj): obj for obj in items}
         for name, obj in fresh.items():
             if name not in self.cache:
@@ -386,8 +414,9 @@ class JobSetInformer:
         next_resync = _t.monotonic() + self.resync_seconds
         while not self._stop.is_set():
             try:
-                events, rv = self.client.watch(
-                    self.namespace, self._rv, timeout=self.poll_timeout
+                events, rv = self.client.watch_resource(
+                    self.kind, self.namespace, self._rv,
+                    timeout=self.poll_timeout,
                 )
                 for event in events:
                     self._apply(event)
@@ -411,3 +440,21 @@ class JobSetInformer:
                 except Exception:
                     pass
                 next_resync = _t.monotonic() + self.resync_seconds
+
+
+class JobSetInformer(ResourceInformer):
+    """JobSet informer (back-compat name; client-go jobset informer analog)."""
+
+    KIND = "jobsets"
+
+
+class JobInformer(ResourceInformer):
+    """Child-Job informer (client-go batch/v1 Job informer analog)."""
+
+    KIND = "jobs"
+
+
+class PodInformer(ResourceInformer):
+    """Pod informer (client-go core/v1 Pod informer analog)."""
+
+    KIND = "pods"

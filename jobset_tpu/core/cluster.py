@@ -128,6 +128,11 @@ class Cluster:
 
         self._uid_iter = itertools.count(1)
         self._deferred: deque[Callable[[], None]] = deque()
+        # Placement-prefetch requests buffered across the tick's reconcile
+        # drain so a multi-JobSet failure storm coalesces into ONE vmapped
+        # solver dispatch (provider.prepare_batch): (placement, js) pairs,
+        # deduped by JobSet uid at drain time (last request wins).
+        self._prepare_requests: list[tuple] = []
         self._next_tick_queue: deque[tuple[str, str]] = deque()
         self.reconcile_queue: deque[tuple[str, str]] = deque()
         self._queued: set[tuple[str, str]] = set()
@@ -702,6 +707,33 @@ class Cluster:
         while self._deferred:
             self._deferred.popleft()()
 
+    def defer_placement_prepare(self, placement, js) -> None:
+        """Buffer a placement-prefetch request until the tick's reconcile
+        drain completes, so concurrent gang restarts batch into one solver
+        dispatch (still within the same tick — the plan is cached before
+        any creation pass can consume it)."""
+        self._prepare_requests.append((placement, js))
+
+    def _drain_prepare_requests(self) -> None:
+        if not self._prepare_requests:
+            return
+        requests, self._prepare_requests = self._prepare_requests, []
+        # Dedupe by JobSet uid (a jobset re-reconciled within one tick only
+        # needs its latest-epoch solve), group by provider instance.
+        by_provider: dict[int, tuple] = {}
+        for placement, js in requests:
+            key = id(placement)
+            if key not in by_provider:
+                by_provider[key] = (placement, {})
+            by_provider[key][1][js.metadata.uid] = js
+        for placement, by_uid in by_provider.values():
+            jobsets = list(by_uid.values())
+            if hasattr(placement, "prepare_batch"):
+                placement.prepare_batch(self, jobsets)
+            else:
+                for js in jobsets:
+                    placement.prepare(self, js)
+
     def tick(self) -> bool:
         """One control-plane pass; returns True if anything changed."""
         changed = False
@@ -746,6 +778,10 @@ class Cluster:
             if self.jobset_reconciler is not None:
                 changed |= bool(self.jobset_reconciler.reconcile(*key))
             self._drain_deferred()
+        # Placement prefetches buffered during the drain run as ONE batched
+        # solver dispatch (the storm path); plans land before the next
+        # tick's creation passes consume them.
+        self._drain_prepare_requests()
 
         # 2. Simulated Job controller creates pods / aggregates status.
         if self.job_controller is not None:

@@ -1,0 +1,198 @@
+"""Leader election for controller replicas.
+
+Analog of the reference's controller-runtime leader election
+(`main.go:100-117`: `LeaderElection: true, LeaderElectionID:
+"6d4f6a47.x-k8s.io"`), which stores a Lease object in the cluster so only
+one controller-manager replica runs the reconcile loops while the others
+idle as hot standbys and take over when the lease expires.
+
+Our control plane has no etcd, so the lease lives in a shared FILE (the
+deployment analog: a shared volume between controller replicas — the same
+role the Lease object's storage plays for the reference). Semantics mirror
+k8s `leaderelection`:
+
+* a record holds (holder identity, acquire time, renew time);
+* the holder renews every `retry_period`; a non-holder acquires only once
+  `lease_duration` has elapsed since the last renewal (the previous leader
+  is presumed dead);
+* acquisition is write-then-verify on an atomic rename, so when two
+  standbys race exactly one observes itself as the holder.
+
+Timing uses the injectable clock (`utils.clock`) so failover is testable
+on virtual time, exactly like the TTL machinery.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from dataclasses import dataclass
+from typing import Optional
+
+from ..utils.clock import Clock
+
+# k8s client-go leaderelection defaults (LeaseDuration/RenewDeadline/
+# RetryPeriod), which the reference inherits unchanged.
+LEASE_DURATION_S = 15.0
+RETRY_PERIOD_S = 2.0
+
+
+@dataclass
+class LeaseRecord:
+    holder: str
+    acquired_at: float
+    renewed_at: float
+
+    def to_dict(self) -> dict:
+        return {
+            "holderIdentity": self.holder,
+            "acquireTime": self.acquired_at,
+            "renewTime": self.renewed_at,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "LeaseRecord":
+        return cls(
+            holder=str(d["holderIdentity"]),
+            acquired_at=float(d["acquireTime"]),
+            renewed_at=float(d["renewTime"]),
+        )
+
+
+class FileLease:
+    """Lease storage on a shared filesystem path (atomic-rename writes).
+
+    `guard()` takes an exclusive flock on a sibling .lock file so a whole
+    read-modify-write (the elector's ensure()) is atomic across processes —
+    without it, a leader whose own lease expired mid-stall could clobber a
+    standby's fresh acquisition and produce a split-brain window.
+    """
+
+    def __init__(self, path: str):
+        self.path = str(path)
+
+    def guard(self):
+        import contextlib
+        import fcntl
+
+        @contextlib.contextmanager
+        def _locked():
+            with open(self.path + ".lock", "w") as lockf:
+                fcntl.flock(lockf, fcntl.LOCK_EX)
+                try:
+                    yield
+                finally:
+                    fcntl.flock(lockf, fcntl.LOCK_UN)
+
+        return _locked()
+
+    def read(self) -> Optional[LeaseRecord]:
+        try:
+            with open(self.path) as f:
+                return LeaseRecord.from_dict(json.load(f))
+        except (OSError, ValueError, KeyError):
+            # Absent, mid-replace, or corrupt: treated as "no valid lease",
+            # the same way leaderelection treats an unparsable Lease.
+            return None
+
+    def write(self, record: LeaseRecord) -> None:
+        directory = os.path.dirname(os.path.abspath(self.path))
+        fd, tmp = tempfile.mkstemp(dir=directory, prefix=".lease-")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(record.to_dict(), f)
+            os.replace(tmp, self.path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def clear(self, holder: str) -> None:
+        """Best-effort release: delete only if still held by `holder`."""
+        rec = self.read()
+        if rec is not None and rec.holder == holder:
+            try:
+                os.unlink(self.path)
+            except OSError:
+                pass
+
+
+class LeaderElector:
+    """Acquire/renew loop driven by the caller (the server's pump thread).
+
+    `ensure()` is the single entry point: it renews when this identity
+    already holds the lease, acquires when the lease is absent/expired, and
+    returns whether this replica is currently the leader. Verification
+    after every write closes the standby-vs-standby race: both may write,
+    exactly one's record survives the rename ordering, and both re-read.
+    """
+
+    def __init__(
+        self,
+        lease: FileLease,
+        identity: str,
+        lease_duration: float = LEASE_DURATION_S,
+        retry_period: float = RETRY_PERIOD_S,
+        clock: Optional[Clock] = None,
+    ):
+        self.lease = lease
+        self.identity = identity
+        self.lease_duration = float(lease_duration)
+        self.retry_period = float(retry_period)
+        self.clock = clock or Clock()
+        self._leading = False
+        self._last_renew = -float("inf")
+
+    @property
+    def is_leading(self) -> bool:
+        return self._leading
+
+    def ensure(self) -> bool:
+        # The whole read-modify-write runs under the lease's cross-process
+        # guard: a stalled leader resuming with an EXPIRED own lease must
+        # not clobber a standby that just took over (split-brain).
+        with self.lease.guard():
+            now = self.clock.now()
+            rec = self.lease.read()
+            if (
+                rec is not None
+                and rec.holder == self.identity
+                and now - rec.renewed_at < self.lease_duration
+            ):
+                # Still validly ours: renew (rate-limited to retry_period so
+                # a hot pump loop does not rewrite the file every few ms).
+                if now - self._last_renew >= self.retry_period:
+                    self.lease.write(
+                        LeaseRecord(self.identity, rec.acquired_at, now)
+                    )
+                    self._last_renew = now
+                self._leading = True
+                return True
+            if rec is None or now - rec.renewed_at >= self.lease_duration:
+                # Absent or expired (possibly our own, after a stall longer
+                # than the lease — re-acquisition, not renewal).
+                self.lease.write(LeaseRecord(self.identity, now, now))
+                self._leading = True
+                self._last_renew = now
+                return True
+            # Valid lease held by someone else: standby.
+            self._leading = False
+            return False
+
+    def release(self) -> None:
+        """Voluntary hand-off on clean shutdown (leaderelection's
+        ReleaseOnCancel): clears the record so a standby takes over on its
+        next retry instead of waiting out the full lease duration."""
+        if self._leading:
+            with self.lease.guard():
+                self.lease.clear(self.identity)
+            self._leading = False
+
+
+def default_identity() -> str:
+    import socket
+
+    return f"{socket.gethostname()}_{os.getpid()}"

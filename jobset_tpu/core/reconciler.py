@@ -99,12 +99,12 @@ class JobSetReconciler:
                 and self.placement is not None
                 and hasattr(self.placement, "prepare")
             ):
-                # Gang restart: dispatch the replacement placement solve as
-                # soon as this reconcile returns (deferred to the pump, off
-                # the reconcile latency path) — the device then solves while
-                # the next passes delete the old jobs, so the creation pass
-                # consumes a finished plan instead of blocking on a solve.
-                cluster.defer(lambda: self.placement.prepare(cluster, js))
+                # Gang restart: dispatch the replacement placement solve
+                # after this tick's reconcile drain (off the reconcile
+                # latency path) — concurrent restarts coalesce into one
+                # batched solver dispatch (prepare_batch), and the plan is
+                # cached before the creation pass consumes it.
+                cluster.defer_placement_prepare(self.placement, js)
             return self._finish(js, ctx, t0)
 
         if owned.successful:

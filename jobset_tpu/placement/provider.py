@@ -170,13 +170,73 @@ class SolverPlacement:
             # fallback), letting the solve run concurrently just steals
             # cycles from the very reconciles the prefetch is protecting.
             pending = self._materialize(specs, domain_values, pending.result())
+        self._store_plan(js, specs, domain_values, pending)
+
+    def prepare_batch(self, cluster, jobsets) -> None:
+        """Storm path: prefetch plans for MANY JobSets as ONE vmapped solve.
+
+        When a gang failure sweeps several JobSets in the same pump tick
+        (rack loss, maintenance drain), their restart solves coalesce into a
+        single `solve_structured_batch_async` dispatch — one XLA call and
+        one device round-trip for the whole storm, instead of B sequential
+        solves exactly when the controller is busiest. JobSets whose state
+        needs the dense build (multi-domain job keys) fall back to the
+        per-JobSet prepare. Cross-JobSet plan conflicts are possible (each
+        problem is built against the same snapshot) but self-heal: restart
+        stickiness keeps recovering gangs on their own domains, and
+        assign()'s fetch-time revalidation forces a fresh solve on drift.
+        """
+        if not features.enabled("TPUPlacementSolver"):
+            return
+        solver = self._get_solver()
+        if not hasattr(solver, "solve_structured_batch_async"):
+            for js in jobsets:
+                self.prepare(cluster, js)
+            return
+
+        from .plans import build_cost_params_for_specs
+
+        entries = []
+        for js in jobsets:
+            topology_key = self._topology_key(js)
+            if topology_key is None:
+                continue
+            specs = self._expected_job_specs(cluster, js)
+            if not specs:
+                continue
+            pending_release = self._pending_release(
+                cluster, js, topology_key, specs
+            )
+            structured = build_cost_params_for_specs(
+                cluster, specs, topology_key, pending_release=pending_release
+            )
+            if structured is None:
+                self.prepare(cluster, js)
+                continue
+            params, domain_values = structured
+            entries.append((js, specs, domain_values, params))
+        if not entries:
+            return
+        if len(entries) == 1:
+            js, specs, domain_values, params = entries[0]
+            pending = solver.solve_structured_async(**params)
+            plan = self._materialize(specs, domain_values, pending.result())
+            self._store_plan(js, specs, domain_values, plan)
+            return
+        pendings = solver.solve_structured_batch_async(
+            [params for _, _, _, params in entries]
+        )
+        for (js, specs, domain_values, _), pending in zip(entries, pendings):
+            plan = self._materialize(specs, domain_values, pending.result())
+            self._store_plan(js, specs, domain_values, plan)
+
+    def _store_plan(self, js, specs, domain_values, plan_or_pending) -> None:
+        """Cache a materialized plan dict or an in-flight PendingSolve for
+        the JobSet's current restart epoch (bounded by _MAX_PLANS)."""
         while len(self._plans) >= self._MAX_PLANS:
             self._plans.pop(next(iter(self._plans)))
         self._plans[js.metadata.uid] = (
-            js.status.restarts,
-            specs,
-            domain_values,
-            pending,
+            js.status.restarts, specs, domain_values, plan_or_pending
         )
 
     @staticmethod

@@ -117,8 +117,14 @@ def run_model_bench(
     config: Optional[Any] = None,
     learning_rate: float = 1e-3,
     loss_chunk: int = 0,
+    profile_dir: Optional[str] = None,
 ) -> dict:
-    """Train the flagship transformer and return tokens/s + MFU as a dict."""
+    """Train the flagship transformer and return tokens/s + MFU as a dict.
+
+    `profile_dir` wraps the timed region in `jax.profiler.trace` (the
+    TPU-native analog of the reference's reconcile histograms, SURVEY §5):
+    the resulting trace directory opens in TensorBoard/XProf.
+    """
     import jax
     import jax.numpy as jnp
     import optax
@@ -174,11 +180,19 @@ def run_model_bench(
         params, opt_state, loss = train_step(params, opt_state, batch_data)
     fence_step()
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        params, opt_state, loss = train_step(params, opt_state, batch_data)
-    fence_step()
-    elapsed = time.perf_counter() - t0
+    import contextlib
+
+    trace_ctx = (
+        jax.profiler.trace(profile_dir)
+        if profile_dir
+        else contextlib.nullcontext()
+    )
+    with trace_ctx:
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, opt_state, loss = train_step(params, opt_state, batch_data)
+        fence_step()
+        elapsed = time.perf_counter() - t0
 
     tokens_per_step = batch * seq_len
     tokens_per_sec = steps * tokens_per_step / elapsed
@@ -208,6 +222,7 @@ def run_model_bench(
         "peak_tflops": round(peak / 1e12, 1) if peak else None,
         "mfu_pct": round(100 * achieved / peak, 2) if peak else None,
         "final_loss": float(loss),
+        **({"profile_dir": profile_dir} if profile_dir else {}),
     }
 
 

@@ -46,6 +46,12 @@ def main(argv=None) -> int:
         "--cpu", action="store_true",
         help="force the CPU backend (tests / laptops)",
     )
+    parser.add_argument(
+        "--profile-dir",
+        help="capture a JAX profiler trace of the training run into this "
+             "directory (per-process subdir in multi-process gangs; open "
+             "with TensorBoard/XProf)",
+    )
     args = parser.parse_args(argv)
 
     if args.cpu:
@@ -103,6 +109,16 @@ def main(argv=None) -> int:
     mesh = build_mesh(mesh_cfg, allow_submesh=True)
 
     restarts = int(os.environ.get(ENV_RESTART_ATTEMPT, "0"))
+    if args.profile_dir and not workload.get("profile_dir"):
+        # Flag form of the workload's profile_dir key (the runner's step
+        # loop wraps the training region in jax.profiler.trace). Per-process
+        # subdir in gangs: every member traces its own device view (XProf
+        # merges multi-host traces by directory convention).
+        workload["profile_dir"] = (
+            os.path.join(args.profile_dir, f"process_{rank.process_id}")
+            if rank.total_processes > 1
+            else args.profile_dir
+        )
     try:
         losses = train_workload(workload, mesh, restarts=restarts)
     except WorkloadFailure as exc:

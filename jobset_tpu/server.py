@@ -128,29 +128,56 @@ class ControllerServer:
         tick_interval: float = 0.2,
         tls_cert: Optional[str] = None,
         tls_key: Optional[str] = None,
+        elector=None,
+        standby_accepts_writes: bool = True,
     ):
         if cluster is None:
             cluster = make_cluster(clock=Clock())
         self.cluster = cluster
         self.lock = threading.RLock()
         self.tick_interval = tick_interval
+        # Leader election (core.lease.LeaderElector; main.go:100-117
+        # analog): with an elector, only the replica holding the lease runs
+        # the reconcile loops — the standby keeps serving reads (the
+        # reference's webhooks also run on every replica) and defers
+        # reconciliation to the leader's pump.
+        #
+        # standby_accepts_writes distinguishes the two replica topologies:
+        # True (default) for replicas SHARING one Cluster object (in-process
+        # HA pair — the leader's pump observes standby-accepted writes,
+        # like the reference's replicas sharing an apiserver); False for
+        # separate-process replicas with private state (the CLI's
+        # --leader-elect), where a standby-accepted write would be invisible
+        # to the leader forever — the standby answers 503 instead and the
+        # client retries against the leader.
+        self.elector = elector
+        self.standby_accepts_writes = standby_accepts_writes
         self._ready = threading.Event()
         self._stop = threading.Event()
 
         # Watch journal (client-go informer substrate analog,
-        # client-go/informers/externalversions/jobset/v1alpha2/jobset.go):
-        # a bounded log of {ADDED, MODIFIED, DELETED} JobSet events with
-        # monotonically increasing resourceVersions, produced by diffing
-        # serialized JobSet state after every pump/write. Long-poll watchers
-        # block on the condition until events past their resourceVersion
-        # exist; a resourceVersion older than the retained window gets 410
-        # Gone (k8s semantics) and the client relists.
+        # client-go/informers/externalversions/jobset/v1alpha2/jobset.go,
+        # and client-go's generated informers for the child resources):
+        # a bounded log of {ADDED, MODIFIED, DELETED} events for JobSets
+        # AND their child jobs/pods, with monotonically increasing
+        # resourceVersions shared across kinds (like etcd's global rv),
+        # produced by diffing serialized state after every pump/write.
+        # Long-poll watchers block on the condition until events past their
+        # resourceVersion exist; a resourceVersion older than the retained
+        # window gets 410 Gone (k8s semantics) and the client relists.
         self._watch_cond = threading.Condition()
-        self._watch_events: list[tuple[int, str, dict]] = []
-        self._watch_limit = 2048
+        self._watch_events: list[tuple[int, str, str, dict]] = []  # (rv, kind, ns, event)
+        self._watch_limit = 4096
         self._watch_rv = 0
         self._watch_trimmed_rv = 0  # rv of the newest evicted event
-        self._watch_snapshots: dict[tuple, tuple[str, dict]] = {}
+        # kind -> {(ns, name): (uid, obj)}
+        self._watch_snapshots: dict[str, dict[tuple, tuple[str, dict]]] = {}
+        # Child kinds are journaled LAZILY: serializing+diffing every job
+        # and pod on every changing pump would tax controllers that no
+        # child watcher ever subscribes to. A kind activates on its first
+        # list/watch (the list seeds the snapshot and returns the rv the
+        # informer watches from, so no events are missed).
+        self._watch_active: set[str] = {"jobsets"}
 
         host, _, port = address.rpartition(":")
         handler = self._make_handler()
@@ -201,6 +228,10 @@ class ControllerServer:
 
     def stop(self):
         self._stop.set()
+        if self.elector is not None:
+            # Voluntary hand-off so a standby takes over on its next retry
+            # instead of waiting out the full lease duration.
+            self.elector.release()
         self._httpd.shutdown()
         self._httpd.server_close()
 
@@ -214,48 +245,97 @@ class ControllerServer:
             if ticks > 1:
                 self._refresh_watch_locked()
 
+    def pump_if_leader(self) -> bool:
+        """One leader-gated pump round: acquire/renew the lease, reconcile
+        only while leading. Without an elector every replica pumps (the
+        single-replica deployment)."""
+        if self.elector is not None and not self.elector.ensure():
+            return False
+        self.pump()
+        return True
+
+    def _reconcile_after_write(self) -> None:
+        """Writes reconcile synchronously only on the leader; a standby
+        stores the object and leaves reconciliation to the leader's pump
+        (the watch-driven split the reference's replicas have)."""
+        if self.elector is None or self.elector.is_leading:
+            self.cluster.run_until_stable()
+
     # ------------------------------------------------------------------
     # Watch journal
     # ------------------------------------------------------------------
 
     def _refresh_watch_locked(self):
-        """Diff current JobSet state against the last snapshot and append
-        ADDED/MODIFIED/DELETED events. Caller holds self.lock."""
-        current: dict[tuple, tuple[str, dict]] = {}
-        for key, js in self.cluster.jobsets.items():
-            current[key] = (js.metadata.uid, _jobset_summary(js))
-
-        events = []  # (namespace, event) — ns kept out-of-band because the
-        # wire manifest omits a default namespace
-        for key, (uid, obj) in current.items():
-            prev = self._watch_snapshots.get(key)
-            if prev is None or prev[0] != uid:
-                if prev is not None:  # replaced under the same name
-                    events.append((key[0], {"type": "DELETED", "object": prev[1]}))
-                events.append((key[0], {"type": "ADDED", "object": obj}))
-            elif prev[1] != obj:
-                events.append((key[0], {"type": "MODIFIED", "object": obj}))
-        for key, (uid, obj) in self._watch_snapshots.items():
-            if key not in current:
-                events.append((key[0], {"type": "DELETED", "object": obj}))
+        """Diff current JobSet/job/pod state against the last snapshots and
+        append ADDED/MODIFIED/DELETED events per kind. Caller holds
+        self.lock."""
+        collections = (
+            ("jobsets", _jobset_summary, self.cluster.jobsets),
+            ("jobs", _job_dict, self.cluster.jobs),
+            ("pods", _pod_dict, self.cluster.pods),
+        )
+        events = []  # (kind, namespace, event) — ns kept out-of-band
+        # because the wire manifest omits a default namespace
+        for kind, to_dict, live in collections:
+            if kind not in self._watch_active:
+                continue
+            current: dict[tuple, tuple[str, dict]] = {
+                key: (obj.metadata.uid, to_dict(obj))
+                for key, obj in live.items()
+            }
+            snapshots = self._watch_snapshots.get(kind, {})
+            for key, (uid, obj) in current.items():
+                prev = snapshots.get(key)
+                if prev is None or prev[0] != uid:
+                    if prev is not None:  # replaced under the same name
+                        events.append(
+                            (kind, key[0], {"type": "DELETED", "object": prev[1]})
+                        )
+                    events.append((kind, key[0], {"type": "ADDED", "object": obj}))
+                elif prev[1] != obj:
+                    events.append((kind, key[0], {"type": "MODIFIED", "object": obj}))
+            for key, (uid, obj) in snapshots.items():
+                if key not in current:
+                    events.append((kind, key[0], {"type": "DELETED", "object": obj}))
+            self._watch_snapshots[kind] = current
         if not events:
             return
-        self._watch_snapshots = current
         with self._watch_cond:
-            for ns, event in events:
+            for kind, ns, event in events:
                 self._watch_rv += 1
-                self._watch_events.append((self._watch_rv, ns, event))
+                self._watch_events.append((self._watch_rv, kind, ns, event))
             if len(self._watch_events) > self._watch_limit:
                 trimmed = self._watch_events[: -self._watch_limit]
                 self._watch_trimmed_rv = trimmed[-1][0]
                 del self._watch_events[: -self._watch_limit]
             self._watch_cond.notify_all()
 
-    def _watch_jobsets(self, ns: str, resource_version: int, timeout_s: float):
-        """Long-poll: block until events newer than `resource_version` exist
-        for namespace `ns` (or the timeout passes). Runs OUTSIDE self.lock —
-        each request has its own handler thread, and writes proceed while
-        watchers wait."""
+    def _activate_watch_kind(self, kind: str) -> None:
+        """First list/watch of a child kind: seed its snapshot from current
+        state (no synthetic ADDED flood — the caller's list already reflects
+        it) and start journaling its changes."""
+        if kind in self._watch_active:
+            return
+        with self.lock:
+            if kind in self._watch_active:
+                return
+            to_dict, live = {
+                "jobs": (_job_dict, self.cluster.jobs),
+                "pods": (_pod_dict, self.cluster.pods),
+            }[kind]
+            self._watch_snapshots[kind] = {
+                key: (obj.metadata.uid, to_dict(obj))
+                for key, obj in live.items()
+            }
+            self._watch_active.add(kind)
+
+    def _watch_resource(
+        self, kind: str, ns: str, resource_version: int, timeout_s: float
+    ):
+        """Long-poll: block until `kind` events newer than
+        `resource_version` exist for namespace `ns` (or the timeout
+        passes). Runs OUTSIDE self.lock — each request has its own handler
+        thread, and writes proceed while watchers wait."""
         import time as _t
 
         deadline = _t.monotonic() + max(0.0, min(timeout_s, 300.0))
@@ -268,8 +348,10 @@ class ControllerServer:
                     }
                 batch = [
                     {"resourceVersion": rv, **event}
-                    for rv, event_ns, event in self._watch_events
-                    if rv > resource_version and event_ns == ns
+                    for rv, event_kind, event_ns, event in self._watch_events
+                    if rv > resource_version
+                    and event_kind == kind
+                    and event_ns == ns
                 ]
                 if batch:
                     return 200, {
@@ -284,7 +366,7 @@ class ControllerServer:
     def _pump_loop(self):
         while not self._stop.wait(self.tick_interval):
             try:
-                self.pump()
+                self.pump_if_leader()
             except Exception:
                 # A wedged reconcile must not kill the pump thread, but it
                 # must be visible: log it and count it so operators see a
@@ -306,6 +388,14 @@ class ControllerServer:
 
         if path == "/healthz":
             return 200, "ok"
+        if path == "/leaderz":
+            if self.elector is None:
+                return 200, {"leaderElection": False, "leading": True}
+            return 200, {
+                "leaderElection": True,
+                "leading": self.elector.is_leading,
+                "identity": self.elector.identity,
+            }
         if path == "/readyz":
             return (200, "ok") if self._ready.is_set() else (503, "not ready")
         if path == "/metrics":
@@ -314,21 +404,47 @@ class ControllerServer:
         parts = [p for p in path.split("/") if p]
 
         # Watch requests block on the journal OUTSIDE the cluster lock so
-        # writes (and the pump) proceed while watchers wait.
+        # writes (and the pump) proceed while watchers wait. JobSets and
+        # their child jobs/pods are all watchable (client-go generates
+        # informers for every type; external controllers need child watches
+        # to avoid polling).
+        if method == "GET" and params.get("watch"):
+            kind = ns = None
+            if (
+                path.startswith(self.API_PREFIX)
+                and len(parts) == 6
+                and parts[3] == "namespaces"
+                and parts[5] == "jobsets"
+            ):
+                kind, ns = "jobsets", parts[4]
+            elif (
+                parts[:2] == ["api", "v1"]
+                and len(parts) == 5
+                and parts[2] == "namespaces"
+                and parts[4] in ("pods", "jobs")
+            ):
+                kind, ns = parts[4], parts[3]
+            if kind is not None:
+                try:
+                    rv = int(params.get("resourceVersion", ["0"])[0])
+                    timeout_s = float(params.get("timeoutSeconds", ["30"])[0])
+                except ValueError:
+                    return 400, {"error": "bad watch parameters"}
+                if kind != "jobsets":
+                    self._activate_watch_kind(kind)
+                return self._watch_resource(kind, ns, rv, timeout_s)
+
         if (
-            method == "GET"
-            and params.get("watch")
-            and path.startswith(self.API_PREFIX)
-            and len(parts) == 6
-            and parts[3] == "namespaces"
-            and parts[5] == "jobsets"
+            method in ("POST", "PUT", "DELETE", "PATCH")
+            and self.elector is not None
+            and not self.standby_accepts_writes
+            and not self.elector.is_leading
         ):
-            try:
-                rv = int(params.get("resourceVersion", ["0"])[0])
-                timeout_s = float(params.get("timeoutSeconds", ["30"])[0])
-            except ValueError:
-                return 400, {"error": "bad watch parameters"}
-            return self._watch_jobsets(parts[4], rv, timeout_s)
+            return 503, {
+                "error": "this replica is a standby (not the lease holder); "
+                         "retry against the leader",
+                "identity": self.elector.identity,
+            }
 
         with self.lock:
             if path.startswith(self.API_PREFIX):
@@ -376,7 +492,7 @@ class ControllerServer:
                 created = self.cluster.create_jobset(js)
             except AdmissionError as exc:
                 return 409 if "already exists" in str(exc) else 422, {"error": str(exc)}
-            self.cluster.run_until_stable()
+            self._reconcile_after_write()
             return 201, _jobset_summary(created)
 
         if method == "GET" and name is None:
@@ -423,14 +539,14 @@ class ControllerServer:
                 stored = self.cluster.update_jobset(updated)
             except AdmissionError as exc:
                 return 404 if "not found" in str(exc) else 422, {"error": str(exc)}
-            self.cluster.run_until_stable()
+            self._reconcile_after_write()
             return 200, _jobset_summary(stored)
 
         if method == "DELETE":
             if js is None:
                 return 404, {"error": f"jobset {ns}/{name} not found"}
             self.cluster.delete_jobset(ns, name)
-            self.cluster.run_until_stable()
+            self._reconcile_after_write()
             return 200, {"deleted": f"{ns}/{name}"}
 
         return 405, {"error": f"{method} not allowed"}
@@ -447,19 +563,22 @@ class ControllerServer:
             if method != "GET":
                 return 405, {"error": "read-only resource"}
             if resource == "pods":
+                self._activate_watch_kind("pods")
                 items = [
                     _pod_dict(p)
                     for (pns, _), p in sorted(self.cluster.pods.items())
                     if pns == ns
                 ]
-                return 200, {"items": items}
+                # resourceVersion enables list-then-watch (informers).
+                return 200, {"items": items, "resourceVersion": self._watch_rv}
             if resource == "jobs":
+                self._activate_watch_kind("jobs")
                 items = [
                     _job_dict(j)
                     for (jns, _), j in sorted(self.cluster.jobs.items())
                     if jns == ns
                 ]
-                return 200, {"items": items}
+                return 200, {"items": items, "resourceVersion": self._watch_rv}
             if resource == "services":
                 items = [
                     {"metadata": {"name": s.metadata.name, "namespace": s.metadata.namespace},

@@ -242,3 +242,103 @@ def test_worker_gang_trains_lm_from_token_file_process_locally(tmp_path):
     # Held-out eval is SPMD too: identical val history on every rank.
     for (s0, v0), (s1, v1) in zip(results[0]["val_losses"], results[1]["val_losses"]):
         assert s0 == s1 and v0 == pytest.approx(v1)
+
+
+@pytest.mark.timeout(600)
+def test_two_process_four_device_gang_with_checkpointed_restart(tmp_path):
+    """The true TPU-pod shape (VERDICT r2 task 4): 2 worker processes x 4
+    LOCAL devices each, one mesh spanning both (dp=2 across processes,
+    tp=4 within), process-local batch feeding through
+    make_array_from_process_local_data, an injected gang failure, and a
+    checkpointed restart that resumes from the last durable step.
+    """
+    import numpy as np
+
+    from jobset_tpu.runtime.data import write_token_file
+
+    corpus = str(tmp_path / "corpus.bin")
+    write_token_file(corpus, np.tile(np.arange(16), 300))
+
+    cluster = make_cluster()
+    cluster.add_topology("rack", num_domains=2, nodes_per_domain=2, capacity=8)
+    js = (
+        make_jobset("podgang")
+        .replicated_job(
+            make_replicated_job("w").replicas(2).parallelism(1).completions(1).obj()
+        )
+        .obj()
+    )
+    workload = {
+        "kind": "lm",
+        "steps": 8,
+        "batch_size": 4,
+        "seq_len": 16,
+        "mesh": {"dp": 2, "tp": 4},
+        "checkpoint_every": 2,
+        "checkpoint_dir": str(tmp_path / "ckpt"),
+        "fail_at_step": 5,
+        "data": {"path": corpus},
+        "config": {
+            "vocab_size": 16, "d_model": 32, "n_heads": 4, "d_ff": 64,
+            "n_layers": 2, "remat": False,
+        },
+    }
+    js.spec.replicated_jobs[0].template.spec.template.spec.workload = workload
+    cluster.create_jobset(js)
+    cluster.run_until_stable()
+
+    def launch(restart_attempt: int):
+        port = _free_port()
+        procs = []
+        for job_idx in range(2):
+            pod = cluster.resolve_hostname(
+                "default", f"podgang-w-{job_idx}-0.podgang"
+            )
+            env = pod_env_for(cluster, pod)
+            env[ENV_COORDINATOR] = f"127.0.0.1:{port}"
+            worker_env = {**os.environ, **env}
+            worker_env.pop("PYTHONPATH", None)
+            worker_env["JAX_PLATFORMS"] = "cpu"
+            # THE pod shape: each process contributes 4 local devices.
+            worker_env["XLA_FLAGS"] = (
+                "--xla_force_host_platform_device_count=4"
+            )
+            worker_env["JOBSET_RESTART_ATTEMPT"] = str(restart_attempt)
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, "-m", "jobset_tpu.runtime.worker", "--cpu"],
+                    env=worker_env,
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.PIPE,
+                )
+            )
+        results = []
+        for p in procs:
+            stdout, stderr = p.communicate(timeout=560)
+            results.append(
+                (p.returncode,
+                 json.loads(stdout.decode().strip().splitlines()[-1]),
+                 stderr.decode()[-2000:])
+            )
+        return results
+
+    # Attempt 0: checkpoints at steps 2 and 4, injected failure at step 5.
+    first = launch(restart_attempt=0)
+    for rc, out, err in first:
+        assert rc == 1, (rc, out, err)
+        assert "injected failure" in out["failed"], out
+
+    # Attempt 1 (the gang restart): restores step 4, finishes steps 5-8.
+    second = launch(restart_attempt=1)
+    for rc, out, err in second:
+        assert rc == 0, (rc, out, err)
+        assert out["world"] == 2
+        assert out["devices"] == 8
+        assert out["mesh"]["dp"] == 2 and out["mesh"]["tp"] == 4
+        # Resumed from the step-4 checkpoint: only 4 of 8 steps this run.
+        assert out["steps"] == 4, out
+        assert out["final_loss"] < out["initial_loss"]
+    # SPMD: identical global loss on every rank.
+    assert second[0][1]["final_loss"] == pytest.approx(
+        second[1][1]["final_loss"]
+    )

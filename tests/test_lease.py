@@ -1,0 +1,181 @@
+"""Leader-election tests (core/lease.py; main.go:100-117 analog).
+
+The reference runs every controller replica under controller-runtime leader
+election so only one reconciles at a time; these tests prove the same
+contract on the file-lease analog with virtual time: exactly one of two
+ControllerServers reconciles, and the standby takes over on lease expiry
+and on voluntary release.
+"""
+
+from jobset_tpu.core import make_cluster
+from jobset_tpu.core.lease import FileLease, LeaderElector, LeaseRecord
+from jobset_tpu.server import ControllerServer
+from jobset_tpu.testing import make_jobset, make_replicated_job
+from jobset_tpu.utils.clock import FakeClock
+
+
+def _elector(tmp_path, identity, clock, **kw):
+    return LeaderElector(
+        FileLease(str(tmp_path / "leader.lease")), identity, clock=clock, **kw
+    )
+
+
+def test_first_caller_acquires_second_stands_by(tmp_path):
+    clock = FakeClock()
+    a = _elector(tmp_path, "a", clock)
+    b = _elector(tmp_path, "b", clock)
+    assert a.ensure() is True
+    assert b.ensure() is False
+    assert a.is_leading and not b.is_leading
+
+
+def test_renewal_keeps_leadership_past_lease_duration(tmp_path):
+    clock = FakeClock()
+    a = _elector(tmp_path, "a", clock, lease_duration=15.0, retry_period=2.0)
+    b = _elector(tmp_path, "b", clock, lease_duration=15.0, retry_period=2.0)
+    assert a.ensure()
+    for _ in range(10):  # 30s of renewals, well past lease_duration
+        clock.advance(3.0)
+        assert a.ensure() is True
+        assert b.ensure() is False
+
+
+def test_standby_takes_over_after_lease_expires(tmp_path):
+    clock = FakeClock()
+    a = _elector(tmp_path, "a", clock, lease_duration=15.0)
+    b = _elector(tmp_path, "b", clock, lease_duration=15.0)
+    assert a.ensure()
+    # a dies (stops renewing); before expiry b still stands by.
+    clock.advance(14.0)
+    assert b.ensure() is False
+    clock.advance(2.0)  # 16s since last renew > lease_duration
+    assert b.ensure() is True
+    # A resurrected a must observe b's valid lease and stand down.
+    assert a.ensure() is False
+    assert not a.is_leading
+
+
+def test_release_hands_off_without_waiting_out_the_lease(tmp_path):
+    clock = FakeClock()
+    a = _elector(tmp_path, "a", clock)
+    b = _elector(tmp_path, "b", clock)
+    assert a.ensure()
+    a.release()
+    clock.advance(0.001)  # no lease wait needed
+    assert b.ensure() is True
+
+
+def test_corrupt_lease_file_is_treated_as_absent(tmp_path):
+    clock = FakeClock()
+    (tmp_path / "leader.lease").write_text("{not json")
+    a = _elector(tmp_path, "a", clock)
+    assert a.ensure() is True
+
+
+def test_lease_record_round_trip():
+    rec = LeaseRecord("me", 1.0, 2.0)
+    assert LeaseRecord.from_dict(rec.to_dict()) == rec
+
+
+def _two_servers(tmp_path, clock):
+    cluster = make_cluster(clock=clock)
+    cluster.add_topology("rack", num_domains=4, nodes_per_domain=2, capacity=8)
+    a = ControllerServer(
+        cluster=cluster, tick_interval=3600,
+        elector=_elector(tmp_path, "replica-a", clock),
+    )
+    b = ControllerServer(
+        cluster=cluster, tick_interval=3600,
+        elector=_elector(tmp_path, "replica-b", clock),
+    )
+    return cluster, a, b
+
+
+def test_exactly_one_server_reconciles(tmp_path):
+    """Two controller replicas over shared state: the lease holder
+    reconciles, the standby's pump is a no-op."""
+    clock = FakeClock()
+    cluster, a, b = _two_servers(tmp_path, clock)
+    assert a.pump_if_leader() is True  # a takes the lease
+
+    js = (
+        make_jobset("ha")
+        .replicated_job(
+            make_replicated_job("w").replicas(2).parallelism(1).completions(1).obj()
+        )
+        .obj()
+    )
+    cluster.create_jobset(js)
+    assert b.pump_if_leader() is False
+    assert not cluster.jobs  # standby did not reconcile
+    assert a.pump_if_leader() is True
+    assert len(cluster.jobs) == 2  # leader materialized the children
+
+
+def test_server_failover_on_lease_expiry(tmp_path):
+    clock = FakeClock()
+    cluster, a, b = _two_servers(tmp_path, clock)
+    assert a.pump_if_leader() is True
+    assert b.pump_if_leader() is False
+
+    cluster.create_jobset(
+        make_jobset("ha2")
+        .replicated_job(
+            make_replicated_job("w").replicas(1).parallelism(1).completions(1).obj()
+        )
+        .obj()
+    )
+    # Leader dies: no renewals; standby waits out the lease then takes over
+    # and reconciles the backlog.
+    clock.advance(20.0)
+    assert b.pump_if_leader() is True
+    assert len(cluster.jobs) == 1
+    assert b.elector.is_leading
+
+
+def test_private_state_standby_rejects_writes(tmp_path):
+    """Separate-process replicas (standby_accepts_writes=False, the CLI's
+    --leader-elect topology): a standby answers 503 for writes it could
+    never surface to the leader, and keeps serving reads."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    clock = FakeClock()
+    leader_elect = _elector(tmp_path, "lead", clock)
+    standby_elect = _elector(tmp_path, "stand", clock)
+    assert leader_elect.ensure()  # lead takes the lease first
+    standby = ControllerServer(
+        cluster=make_cluster(clock=clock), tick_interval=3600,
+        elector=standby_elect, standby_accepts_writes=False,
+    ).start()
+    try:
+        assert standby.pump_if_leader() is False
+        body = json.dumps({
+            "apiVersion": "jobset.x-k8s.io/v1alpha2", "kind": "JobSet",
+            "metadata": {"name": "x"},
+            "spec": {"replicatedJobs": [{
+                "name": "w", "replicas": 1,
+                "template": {"spec": {"parallelism": 1, "completions": 1,
+                 "template": {"spec": {"containers": [
+                     {"name": "c", "image": "i"}]}}}},
+            }]},
+        }).encode()
+        url = (f"http://{standby.address}/apis/jobset.x-k8s.io/v1alpha2"
+               f"/namespaces/default/jobsets")
+        req = urllib.request.Request(
+            url, data=body, method="POST",
+            headers={"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(req, timeout=10)
+            raise AssertionError("standby accepted a write")
+        except urllib.error.HTTPError as exc:
+            assert exc.code == 503
+            assert "standby" in json.loads(exc.read())["error"]
+        # Reads still served.
+        with urllib.request.urlopen(
+            f"http://{standby.address}/readyz", timeout=10
+        ) as resp:
+            assert resp.read() == b"ok"
+    finally:
+        standby.stop()

@@ -281,3 +281,42 @@ def test_multi_jobset_recovery_storm_solver():
             if keys.PLACEMENT_PLAN_KEY in j.metadata.annotations
         ]
         assert planned, "solver path did not stamp any plan"
+
+
+def test_storm_restart_solves_coalesce_into_one_batched_dispatch():
+    """Concurrent gang restarts in one tick must reach the solver as ONE
+    solve_structured_batch_async call (the storm path's single XLA
+    dispatch), and every gang must still recover onto exclusive domains."""
+    from jobset_tpu.core import features
+    from jobset_tpu.placement.solver import AssignmentSolver
+
+    calls = []
+    real = AssignmentSolver.solve_structured_batch_async
+
+    def spy(self, problems):
+        calls.append(len(problems))
+        return real(self, problems)
+
+    with features.gate("TPUPlacementSolver", True):
+        cluster, names = _storm_cluster()
+        total = 3 * 3 * 2
+        provider = cluster.jobset_reconciler.placement
+        solver = provider._get_solver()
+        solver.solve_structured_batch_async = spy.__get__(solver)
+
+        victims = {
+            next(
+                p.spec.node_name
+                for p in cluster.pods.values()
+                if p.metadata.name.startswith(f"{name}-w-0-") and p.spec.node_name
+            )
+            for name in names
+        }
+        for node in victims:
+            cluster.fail_node(node)
+        cluster.run_until_stable()
+
+        for name in names:
+            assert cluster.get_jobset("default", name).status.restarts == 1
+        _assert_storm_invariants(cluster, names, total)
+    assert calls and max(calls) == len(names), calls

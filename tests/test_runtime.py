@@ -92,3 +92,25 @@ def test_coordinator_defaults_to_first_pod_without_spec():
     pod = cluster.resolve_hostname("default", "nc-w-0-0.nc")
     env = pod_env_for(cluster, pod)
     assert env["JOBSET_COORDINATOR"] == "nc-w-0-0.nc"
+
+
+def test_worker_profile_dir_writes_trace(tmp_path):
+    """`jobset-tpu worker --profile-dir` wraps the training run in
+    jax.profiler.trace and produces a trace directory (the SURVEY §5
+    TPU-native observability analog of the reference's histograms)."""
+    import json
+    import os
+
+    from jobset_tpu.runtime.worker import main as worker_main
+
+    wl = tmp_path / "wl.json"
+    wl.write_text(json.dumps({
+        "kind": "mlp", "steps": 2, "learning_rate": 5e-3, "batch_size": 4,
+        "config": {"d_in": 4, "d_hidden": 8, "d_out": 2},
+    }))
+    prof = tmp_path / "trace"
+    rc = worker_main([
+        "--cpu", "--workload-file", str(wl), "--profile-dir", str(prof),
+    ])
+    assert rc == 0
+    assert prof.is_dir() and os.listdir(prof)
