@@ -295,7 +295,11 @@ def run_storm_mode(solver_on: bool, args, n_jobsets: int = 8) -> dict:
     from jobset_tpu.core import features, metrics
 
     topology_key = "tpu-slice"
-    replicas_each = max(1, args.replicas // n_jobsets)
+    # Clamp to what the configured cluster can host: every replica needs an
+    # exclusive domain, so small --replicas/--domains smoke configs shrink
+    # the storm instead of demanding more domains than exist.
+    n_jobsets = max(2, min(n_jobsets, args.replicas, args.domains // 2))
+    replicas_each = max(1, min(args.replicas, args.domains) // n_jobsets)
     pods_each = replicas_each * args.pods_per_job
     total_pods = n_jobsets * pods_each
     metrics.reset()

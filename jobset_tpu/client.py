@@ -145,8 +145,7 @@ class JobSetClient:
     def list_with_version(self, namespace: str = "default"):
         """(manifest dicts, resourceVersion) — the list half of
         list-then-watch."""
-        out = self._request("GET", self._collection(namespace))
-        return out["items"], out.get("resourceVersion", 0)
+        return self.list_resource_with_version("jobsets", namespace)
 
     def _resource_path(self, kind: str, namespace: str) -> str:
         """Collection path for a watchable kind: jobsets live under the
@@ -205,6 +204,16 @@ class JobSetClient:
 
     def delete(self, name: str, namespace: str = "default") -> None:
         self._request("DELETE", f"{self._collection(namespace)}/{name}")
+
+    def update_status(self, name: str, status: dict,
+                      namespace: str = "default") -> dict:
+        """Write the status subresource (external controllers of managedBy
+        jobsets — the k8s `/status` endpoint analog). `status` is the wire
+        dict (camelCase keys); returns the stored manifest."""
+        body = json.dumps({"status": status}).encode()
+        return self._request(
+            "PUT", f"{self._collection(namespace)}/{name}/status", body
+        )
 
     def suspend(self, name: str, namespace: str = "default") -> JobSet:
         js = self.get(name, namespace)

@@ -354,6 +354,22 @@ class Cluster:
         self.enqueue_reconcile(*key)
         return js
 
+    def update_jobset_status(self, namespace: str, name: str, status) -> JobSet:
+        """Status-subresource write (the k8s `/status` endpoint analog).
+
+        The intended writer is an EXTERNAL controller managing a
+        `spec.managedBy` JobSet (jobset_controller.go skips those, so the
+        written status is preserved verbatim — proven by the reference's
+        "Updates to its status are preserved" scenario). For jobsets managed
+        by the built-in controller the next reconcile recomputes status,
+        exactly as with a real apiserver."""
+        js = self.jobsets.get((namespace, name))
+        if js is None:
+            raise AdmissionError(f"jobset {namespace}/{name} not found")
+        js.status = status
+        self.enqueue_reconcile(namespace, name)
+        return js
+
     def delete_jobset(self, namespace: str, name: str) -> None:
         """Foreground cascade: child jobs (and their pods) + services go too."""
         key = (namespace, name)

@@ -15,8 +15,12 @@ k8s `leaderelection`:
 * the holder renews every `retry_period`; a non-holder acquires only once
   `lease_duration` has elapsed since the last renewal (the previous leader
   is presumed dead);
-* acquisition is write-then-verify on an atomic rename, so when two
-  standbys race exactly one observes itself as the holder.
+* mutual exclusion comes from an exclusive flock on a sibling .lock file
+  held across each elector's whole read-modify-write (FileLease.guard) —
+  racing standbys serialize there, and a stalled leader resuming with an
+  expired lease observes a standby's takeover instead of clobbering it.
+  (A port of FileLease to storage without flock semantics must bring its
+  own compare-and-swap.)
 
 Timing uses the injectable clock (`utils.clock`) so failover is testable
 on virtual time, exactly like the TTL machinery.
@@ -125,9 +129,9 @@ class LeaderElector:
 
     `ensure()` is the single entry point: it renews when this identity
     already holds the lease, acquires when the lease is absent/expired, and
-    returns whether this replica is currently the leader. Verification
-    after every write closes the standby-vs-standby race: both may write,
-    exactly one's record survives the rename ordering, and both re-read.
+    returns whether this replica is currently the leader. The whole
+    read-modify-write runs under the lease's cross-process guard (flock),
+    which is what closes the standby-vs-standby and stalled-leader races.
     """
 
     def __init__(

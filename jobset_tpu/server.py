@@ -130,11 +130,16 @@ class ControllerServer:
         tls_key: Optional[str] = None,
         elector=None,
         standby_accepts_writes: bool = True,
+        lock: Optional[threading.RLock] = None,
     ):
         if cluster is None:
             cluster = make_cluster(clock=Clock())
         self.cluster = cluster
-        self.lock = threading.RLock()
+        # Replicas SHARING one Cluster object (in-process HA pair) must
+        # also share one lock — pass the first server's `lock` to the
+        # second — or a standby-accepted write would race the leader's
+        # pump over the shared dicts.
+        self.lock = lock or threading.RLock()
         self.tick_interval = tick_interval
         # Leader election (core.lease.LeaderElector; main.go:100-117
         # analog): with an elector, only the replica holding the lease runs
@@ -219,7 +224,7 @@ class ControllerServer:
 
     def start(self) -> "ControllerServer":
         serve = threading.Thread(target=self._httpd.serve_forever, daemon=True)
-        pump = threading.Thread(target=self._pump_loop, daemon=True)
+        pump = threading.Thread(target=self._pump_loop, daemon=True, name="pump")
         serve.start()
         pump.start()
         self._threads = [serve, pump]
@@ -229,8 +234,13 @@ class ControllerServer:
     def stop(self):
         self._stop.set()
         if self.elector is not None:
-            # Voluntary hand-off so a standby takes over on its next retry
-            # instead of waiting out the full lease duration.
+            # Join the pump thread BEFORE releasing: an in-flight
+            # pump_if_leader() could otherwise re-acquire the lease right
+            # after release() and make the standby wait out the full lease
+            # duration — the delay the voluntary hand-off exists to avoid.
+            for t in self._threads:
+                if t is not threading.current_thread() and t.name == "pump":
+                    t.join(timeout=10.0)
             self.elector.release()
         self._httpd.shutdown()
         self._httpd.server_close()
@@ -477,11 +487,31 @@ class ControllerServer:
         return js
 
     def _route_jobsets(self, method: str, parts: list[str], body: bytes):
-        # parts: apis, jobset.x-k8s.io, v1alpha2, namespaces, {ns}, jobsets[, name]
+        # parts: apis, jobset.x-k8s.io, v1alpha2, namespaces, {ns},
+        #        jobsets[, name[, status]]
         if len(parts) < 6 or parts[3] != "namespaces" or parts[5] != "jobsets":
             return 404, {"error": "unknown resource"}
         ns = parts[4]
         name = parts[6] if len(parts) > 6 else None
+
+        # Status subresource (the k8s /status endpoint): external
+        # controllers of managedBy jobsets write status here.
+        if len(parts) == 8 and parts[7] == "status" and name is not None:
+            if method != "PUT":
+                return 405, {"error": "status subresource supports PUT only"}
+            try:
+                data = yaml.safe_load(body.decode())
+                status = serialization.status_from_dict(
+                    data.get("status", data) or {}
+                )
+            except Exception as exc:
+                return 400, {"error": f"bad status: {exc}"}
+            try:
+                stored = self.cluster.update_jobset_status(ns, name, status)
+            except AdmissionError as exc:
+                return 404, {"error": str(exc)}
+            self._reconcile_after_write()
+            return 200, _jobset_summary(stored)
 
         if method == "POST" and name is None:
             try:

@@ -533,3 +533,201 @@ def test_suspended_job_does_not_enforce_deadline():
         cluster.get_jobset("default", "dl-s").status.terminal_state
         == keys.JOBSET_FAILED
     )
+
+
+# ---------------------------------------------------------------------------
+# Remaining envtest-scenario parity (jobset_controller_test.go:292-1663):
+# success-policy matrix corners, rules-order 1 and 3, replicatedJobsStatuses
+# after success, and the managedBy contract incl. the status subresource.
+# ---------------------------------------------------------------------------
+
+
+def _two_rjob_cluster(js_name="js", success_policy=None):
+    from jobset_tpu.api import SuccessPolicy  # noqa: F401 (callers build it)
+
+    cluster = make_cluster()
+    cluster.add_topology("rack", num_domains=8, nodes_per_domain=4, capacity=16)
+    js = (
+        make_jobset(js_name)
+        .replicated_job(
+            make_replicated_job("a").replicas(2).parallelism(1).completions(1).obj()
+        )
+        .replicated_job(
+            make_replicated_job("b").replicas(3).parallelism(1).completions(1).obj()
+        )
+    )
+    js = js.obj()
+    if success_policy is not None:
+        js.spec.success_policy = success_policy
+    cluster.create_jobset(js)
+    cluster.run_until_stable()
+    return cluster, js
+
+
+def test_success_policy_all_with_targets_ignores_other_rjobs():
+    """'all' with TargetReplicatedJobs (jobset_controller_test.go:292):
+    completing every job of a NON-targeted rjob keeps the jobset active;
+    only the targeted rjob's full completion completes it."""
+    from jobset_tpu.api import SuccessPolicy
+
+    cluster, js = _two_rjob_cluster(
+        "all-b",
+        SuccessPolicy(operator=keys.OPERATOR_ALL, target_replicated_jobs=["b"]),
+    )
+    for i in range(2):  # all of rjob a — not targeted
+        cluster.complete_job("default", f"all-b-a-{i}")
+    cluster.run_until_stable()
+    assert js.status.terminal_state == ""
+    cluster.complete_job("default", "all-b-b-0")
+    cluster.run_until_stable()
+    assert js.status.terminal_state == ""  # 1 of 3 targeted
+    for i in (1, 2):
+        cluster.complete_job("default", f"all-b-b-{i}")
+    cluster.run_until_stable()
+    assert js.status.terminal_state == keys.JOBSET_COMPLETED
+
+
+def test_success_policy_any_untargeted_completes_on_first_success():
+    """'any' with empty targets (jobset_controller_test.go:357): any one
+    job completing completes the whole jobset."""
+    from jobset_tpu.api import SuccessPolicy
+
+    cluster, js = _two_rjob_cluster(
+        "any-all",
+        SuccessPolicy(operator=keys.OPERATOR_ANY, target_replicated_jobs=[]),
+    )
+    cluster.complete_job("default", "any-all-b-1")
+    cluster.run_until_stable()
+    assert js.status.terminal_state == keys.JOBSET_COMPLETED
+
+
+def _rules_jobset(name, rules, max_restarts=1):
+    from jobset_tpu.api import FailurePolicyRule  # noqa: F401
+
+    cluster = make_cluster()
+    cluster.add_topology("rack", num_domains=8, nodes_per_domain=4, capacity=16)
+    js = (
+        make_jobset(name)
+        .failure_policy(FailurePolicy(max_restarts=max_restarts, rules=rules))
+        .replicated_job(
+            make_replicated_job("a").replicas(2).parallelism(1).completions(1).obj()
+        )
+        .replicated_job(
+            make_replicated_job("b").replicas(1).parallelism(1).completions(1).obj()
+        )
+        .obj()
+    )
+    cluster.create_jobset(js)
+    cluster.run_until_stable()
+    return cluster, js
+
+
+def test_failure_rules_order_fail_jobset_first_wins():
+    """Rules-order test 1 (jobset_controller_test.go:690): FailJobSet
+    listed before RestartJobSet with identical matchers fails the jobset
+    immediately — restarts stays 0."""
+    from jobset_tpu.api import FailurePolicyRule
+
+    cluster, js = _rules_jobset("order1", [
+        FailurePolicyRule(
+            name="fail_first", action=keys.FAIL_JOBSET,
+            on_job_failure_reasons=[keys.JOB_REASON_BACKOFF_LIMIT_EXCEEDED],
+            target_replicated_jobs=["a"],
+        ),
+        FailurePolicyRule(
+            name="restart_second", action=keys.RESTART_JOBSET,
+            on_job_failure_reasons=[keys.JOB_REASON_BACKOFF_LIMIT_EXCEEDED],
+            target_replicated_jobs=["a"],
+        ),
+    ])
+    cluster.fail_job("default", "order1-a-0",
+                     reason=keys.JOB_REASON_BACKOFF_LIMIT_EXCEEDED)
+    cluster.run_until_stable()
+    assert js.status.terminal_state == keys.JOBSET_FAILED
+    assert js.status.restarts == 0
+    assert js.status.restarts_count_towards_max == 0
+
+
+def test_failure_rules_ignore_action_then_catchall_fail():
+    """Rules-order test 3 (jobset_controller_test.go:765): an
+    IgnoreMaxRestarts rule for rjob a plus a catch-all FailJobSet rule
+    (EMPTY matcher lists match everything): repeated a-failures restart
+    past max_restarts without counting, then one b-failure hits the
+    catch-all and fails the jobset."""
+    from jobset_tpu.api import FailurePolicyRule
+
+    cluster, js = _rules_jobset("order3", [
+        FailurePolicyRule(
+            name="ignore_a", action=keys.RESTART_JOBSET_AND_IGNORE_MAX_RESTARTS,
+            on_job_failure_reasons=[keys.JOB_REASON_BACKOFF_LIMIT_EXCEEDED],
+            target_replicated_jobs=["a"],
+        ),
+        FailurePolicyRule(
+            name="catch_all", action=keys.FAIL_JOBSET,
+            on_job_failure_reasons=[], target_replicated_jobs=[],
+        ),
+    ], max_restarts=1)
+    for expect_restarts in (1, 2, 3):  # well past max_restarts=1
+        cluster.fail_job("default", "order3-a-0",
+                         reason=keys.JOB_REASON_BACKOFF_LIMIT_EXCEEDED)
+        cluster.run_until_stable()
+        assert js.status.terminal_state == ""
+        assert js.status.restarts == expect_restarts
+        assert js.status.restarts_count_towards_max == 0
+    cluster.fail_job("default", "order3-b-0")
+    cluster.run_until_stable()
+    assert js.status.terminal_state == keys.JOBSET_FAILED
+    assert js.status.restarts == 3
+
+
+def test_replicated_job_statuses_after_all_succeed():
+    """replicatedJobsStatuses reflect completion (jobset_controller_test.go
+    :1019): after every job succeeds, each rjob status shows
+    succeeded == replicas and zero active/ready."""
+    cluster, js = _two_rjob_cluster("statuses")
+    cluster.complete_all_jobs(js)
+    cluster.run_until_stable()
+    by_name = {s.name: s for s in js.status.replicated_jobs_status}
+    assert by_name["a"].succeeded == 2 and by_name["b"].succeeded == 3
+    for s in by_name.values():
+        assert s.active == 0 and s.ready == 0 and s.failed == 0
+
+
+def test_managed_by_suspend_resume_and_status_preserved():
+    """The managedBy contract (jobset_controller_test.go:1596-1663): the
+    built-in controller creates nothing for an externally-managed JobSet —
+    suspended OR resumed — and status written through the status
+    subresource by the external controller is preserved verbatim."""
+    from jobset_tpu.api.types import ReplicatedJobStatus
+
+    cluster = make_cluster()
+    cluster.add_topology("rack", num_domains=4, nodes_per_domain=2, capacity=8)
+    js = _jobset("mb")
+    js.spec.managed_by = "kueue.x-k8s.io/multikueue"
+    js.spec.suspend = True
+    cluster.create_jobset(js)
+    cluster.run_until_stable()
+    assert cluster.jobs == {} and cluster.services == {}
+
+    live = cluster.get_jobset("default", "mb")
+    live.spec.suspend = False  # unsuspend: STILL externally managed
+    cluster.enqueue_reconcile("default", "mb")
+    cluster.run_until_stable()
+    assert cluster.jobs == {} and cluster.services == {}
+
+    # External controller writes status through the subresource; the
+    # built-in controller must not clobber it.
+    want = live.status.__class__(
+        restarts=1,
+        replicated_jobs_status=[
+            ReplicatedJobStatus(name="workers", ready=2, succeeded=3,
+                                failed=4, active=5, suspended=6),
+        ],
+    )
+    cluster.update_jobset_status("default", "mb", want)
+    cluster.run_until_stable()
+    got = cluster.get_jobset("default", "mb").status
+    assert got.restarts == 1
+    s = got.replicated_jobs_status[0]
+    assert (s.ready, s.succeeded, s.failed, s.active, s.suspended) == \
+        (2, 3, 4, 5, 6)

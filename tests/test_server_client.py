@@ -443,3 +443,28 @@ def test_child_informers_track_jobs_and_pods(server, client):
     finally:
         ji.stop()
         pi.stop()
+
+
+def test_status_subresource_preserved_for_managed_by(server, client):
+    """External controllers of managedBy jobsets write status through the
+    /status subresource (jobset_controller_test.go:1623 'Updates to its
+    status are preserved'): the built-in controller must not clobber it."""
+    manifest = SIMPLE_YAML.format(name="ext-managed") + "  managedBy: kueue.x-k8s.io/multikueue\n"
+    client.create(manifest)
+    assert client.jobs() == []  # externally managed: nothing created
+
+    out = client.update_status("ext-managed", {
+        "restarts": 2,
+        "replicatedJobsStatus": [
+            {"name": "workers", "ready": 1, "succeeded": 2, "failed": 0,
+             "active": 1, "suspended": 0},
+        ],
+    })
+    assert out["status"]["restarts"] == 2
+
+    # Still preserved after background pump rounds.
+    import time
+    time.sleep(0.3)
+    raw = client.get_raw("ext-managed")
+    assert raw["status"]["restarts"] == 2
+    assert raw["status"]["replicatedJobsStatus"][0]["succeeded"] == 2
