@@ -297,8 +297,16 @@ def run_storm_mode(solver_on: bool, args, n_jobsets: int = 8) -> dict:
     topology_key = "tpu-slice"
     # Clamp to what the configured cluster can host: every replica needs an
     # exclusive domain, so small --replicas/--domains smoke configs shrink
-    # the storm instead of demanding more domains than exist.
-    n_jobsets = max(2, min(n_jobsets, args.replicas, args.domains // 2))
+    # the storm instead of demanding more domains than exist. A config that
+    # cannot host even a 2-JobSet storm skips the phase (recorded as the
+    # phase error) rather than over-demanding domains.
+    n_jobsets = min(n_jobsets, args.replicas, args.domains)
+    if n_jobsets < 2:
+        raise RuntimeError(
+            "storm skipped: config cannot host 2 JobSets "
+            f"(replicas={args.replicas}, domains={args.domains})"
+        )
+    # n_jobsets * replicas_each <= domains always holds from here.
     replicas_each = max(1, min(args.replicas, args.domains) // n_jobsets)
     pods_each = replicas_each * args.pods_per_job
     total_pods = n_jobsets * pods_each

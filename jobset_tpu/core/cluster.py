@@ -126,6 +126,14 @@ class Cluster:
         # bind/unbind so the solver's cost matrix never rescans nodes.
         self._domain_stats: dict[str, tuple] = {}
 
+        # One lock per CLUSTER (not per server): every server fronting this
+        # state — e.g. an in-process HA replica pair — serializes on the
+        # same lock automatically, so a standby-accepted write can never
+        # race the leader's pump.
+        import threading
+
+        self.lock = threading.RLock()
+
         self._uid_iter = itertools.count(1)
         self._deferred: deque[Callable[[], None]] = deque()
         # Placement-prefetch requests buffered across the tick's reconcile
@@ -729,6 +737,16 @@ class Cluster:
         dispatch (still within the same tick — the plan is cached before
         any creation pass can consume it)."""
         self._prepare_requests.append((placement, js))
+
+    def flush_placement_prepares(self) -> None:
+        """On-demand drain of buffered prepare requests (one batched solver
+        dispatch). Called by the placement provider when a creation pass
+        arrives before the end-of-tick drain — the same tick's reconcile
+        drain processes a restart's delete AND create passes, so waiting
+        for end-of-tick would hand every creation a stale plan. Because the
+        whole buffer flushes at once, the FIRST creation pass of a storm
+        still solves all of its JobSets in one dispatch."""
+        self._drain_prepare_requests()
 
     def _drain_prepare_requests(self) -> None:
         if not self._prepare_requests:

@@ -328,6 +328,15 @@ class SolverPlacement:
         """Return {job_name: domain} from the prefetched solve if it is still
         consistent with current cluster state; None forces a fresh solve."""
         entry = self._plans.get(js.metadata.uid)
+        if (entry is None or entry[0] != js.status.restarts) and hasattr(
+            cluster, "flush_placement_prepares"
+        ):
+            # The restart's prepare may still be buffered for batching (the
+            # creation pass can run in the same tick as the restart): flush
+            # the whole buffer — ONE batched dispatch for every pending
+            # JobSet — and retry the cache.
+            cluster.flush_placement_prepares()
+            entry = self._plans.get(js.metadata.uid)
         if entry is None:
             return None
         restarts, specs, domain_values, pending = entry

@@ -130,16 +130,15 @@ class ControllerServer:
         tls_key: Optional[str] = None,
         elector=None,
         standby_accepts_writes: bool = True,
-        lock: Optional[threading.RLock] = None,
     ):
         if cluster is None:
             cluster = make_cluster(clock=Clock())
         self.cluster = cluster
-        # Replicas SHARING one Cluster object (in-process HA pair) must
-        # also share one lock — pass the first server's `lock` to the
-        # second — or a standby-accepted write would race the leader's
-        # pump over the shared dicts.
-        self.lock = lock or threading.RLock()
+        # The lock lives on the Cluster: replicas sharing one Cluster
+        # object (in-process HA pair) serialize on the same lock
+        # automatically — a standby-accepted write can never race the
+        # leader's pump over the shared dicts.
+        self.lock = cluster.lock
         self.tick_interval = tick_interval
         # Leader election (core.lease.LeaderElector; main.go:100-117
         # analog): with an elector, only the replica holding the lease runs
@@ -219,6 +218,7 @@ class ControllerServer:
         self.port = self._httpd.server_port
         self.address = f"{host or '127.0.0.1'}:{self.port}"
         self._threads: list[threading.Thread] = []
+        self._pump_thread: Optional[threading.Thread] = None
 
     # ------------------------------------------------------------------
 
@@ -227,6 +227,7 @@ class ControllerServer:
         pump = threading.Thread(target=self._pump_loop, daemon=True, name="pump")
         serve.start()
         pump.start()
+        self._pump_thread = pump
         self._threads = [serve, pump]
         self._ready.set()  # readyz gated on the listener being up (main.go:209-216)
         return self
@@ -238,9 +239,9 @@ class ControllerServer:
             # pump_if_leader() could otherwise re-acquire the lease right
             # after release() and make the standby wait out the full lease
             # duration — the delay the voluntary hand-off exists to avoid.
-            for t in self._threads:
-                if t is not threading.current_thread() and t.name == "pump":
-                    t.join(timeout=10.0)
+            pump = self._pump_thread
+            if pump is not None and pump is not threading.current_thread():
+                pump.join(timeout=10.0)
             self.elector.release()
         self._httpd.shutdown()
         self._httpd.server_close()
@@ -497,8 +498,15 @@ class ControllerServer:
         # Status subresource (the k8s /status endpoint): external
         # controllers of managedBy jobsets write status here.
         if len(parts) == 8 and parts[7] == "status" and name is not None:
+            if method == "GET":
+                # k8s serves the whole object on GET /status (the read half
+                # of client-go's read-modify-write against the subresource).
+                js = self.cluster.get_jobset(ns, name)
+                if js is None:
+                    return 404, {"error": f"jobset {ns}/{name} not found"}
+                return 200, _jobset_summary(js)
             if method != "PUT":
-                return 405, {"error": "status subresource supports PUT only"}
+                return 405, {"error": "status subresource supports GET/PUT only"}
             try:
                 data = yaml.safe_load(body.decode())
                 status = serialization.status_from_dict(

@@ -84,13 +84,12 @@ def _two_servers(tmp_path, clock):
         cluster=cluster, tick_interval=3600,
         elector=_elector(tmp_path, "replica-a", clock),
     )
-    # Shared-cluster replicas must share the lock too (a standby-accepted
-    # write would otherwise race the leader's pump over the shared dicts).
     b = ControllerServer(
         cluster=cluster, tick_interval=3600,
         elector=_elector(tmp_path, "replica-b", clock),
-        lock=a.lock,
     )
+    # Shared-cluster replicas serialize on the CLUSTER's lock.
+    assert a.lock is b.lock is cluster.lock
     return cluster, a, b
 
 

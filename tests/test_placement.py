@@ -320,3 +320,4 @@ def test_storm_restart_solves_coalesce_into_one_batched_dispatch():
             assert cluster.get_jobset("default", name).status.restarts == 1
         _assert_storm_invariants(cluster, names, total)
     assert calls and max(calls) == len(names), calls
+

@@ -478,3 +478,34 @@ def test_structured_batch_matches_sequential(solver):
     for got, p in zip(batch, problems):
         want = solver.solve_structured_async(**p).result()
         assert np.array_equal(got, want), (got, want)
+
+
+def test_gang_restart_consumes_prefetched_plan_without_fresh_solve():
+    """The restart-time prefetch must actually be consumed by the creation
+    pass (it can run in the SAME tick as the restart — the buffered prepare
+    flushes on demand): no fallback to the dense synchronous build_plan."""
+    from jobset_tpu.core import features
+    from jobset_tpu.placement import plans as plans_mod
+
+    fresh_solves = []
+    real = plans_mod.build_plan
+
+    def spy(*a, **kw):
+        fresh_solves.append(1)
+        return real(*a, **kw)
+
+    with features.gate("TPUPlacementSolver", True):
+        cluster = solver_cluster()
+        js = exclusive_jobset()
+        cluster.create_jobset(js)
+        cluster.run_until_stable()
+        plans_mod.build_plan = spy
+        try:
+            cluster.fail_job("default", "js-w-0")
+            cluster.run_until_stable()
+        finally:
+            plans_mod.build_plan = real
+        assert cluster.get_jobset("default", "js").status.restarts == 1
+        bound = [p for p in cluster.pods.values() if p.spec.node_name]
+        assert len(bound) == 4 * 3
+    assert not fresh_solves, "creation pass fell back to a fresh dense solve"
