@@ -1,0 +1,44 @@
+// Native data-loader hot path (the reference's runtime is compiled Go; the
+// feed path here is the analog surface worth compiling — SURVEY.md §5's
+// "IO" bullet). Python-side contract: jobset_tpu/utils/native.py builds
+// this with g++ on first use and falls back to the numpy implementation
+// when no toolchain is available, so the wheel needs no build step.
+//
+// gather_windows_u16_i32: one fused pass over a memory-mapped uint16 token
+// stream producing the LM batch directly —
+//   inputs[i, j]  = tokens[starts[i] + j]      (j < window)
+//   targets[i, j] = tokens[starts[i] + j + 1]
+// widened to int32, returning the max token id seen (the vocab-bounds
+// check rides the same pass). Replaces four numpy passes (per-row window
+// copies + stack, astype, and two ascontiguousarray slice copies).
+
+#include <cstdint>
+
+extern "C" {
+
+int32_t gather_windows_u16_i32(const uint16_t* tokens,
+                               const int64_t* starts,
+                               int64_t n_rows,
+                               int64_t window,
+                               int32_t* inputs,
+                               int32_t* targets) {
+  int32_t max_id = -1;
+  for (int64_t i = 0; i < n_rows; ++i) {
+    const uint16_t* src = tokens + starts[i];
+    int32_t* in_row = inputs + i * window;
+    int32_t* tgt_row = targets + i * window;
+    // First token only feeds inputs; the final (window-th) only targets.
+    int32_t prev = static_cast<int32_t>(src[0]);
+    if (prev > max_id) max_id = prev;
+    for (int64_t j = 0; j < window; ++j) {
+      const int32_t nxt = static_cast<int32_t>(src[j + 1]);
+      if (nxt > max_id) max_id = nxt;
+      in_row[j] = prev;
+      tgt_row[j] = nxt;
+      prev = nxt;
+    }
+  }
+  return max_id;
+}
+
+}  // extern "C"

@@ -165,7 +165,13 @@ class TokenDataset:
 
     def batch(self, step: int) -> dict:
         """Host batch for `step`: {"inputs", "targets"} of shape
-        [batch_size/world, seq_len], targets shifted one token right."""
+        [batch_size/world, seq_len], targets shifted one token right.
+
+        The gather runs through the compiled helper when available
+        (`native/dataloader.cpp`: one fused pass doing window gather,
+        uint16 -> int32 widening, the inputs/targets split, and the
+        vocab-bounds max) with a numpy fallback of identical semantics —
+        differential-tested in tests/test_data.py."""
         import numpy as np
 
         rng = np.random.default_rng((self.seed, step))
@@ -177,23 +183,32 @@ class TokenDataset:
         )
         local = self.batch_size // self.world
         starts = starts[self.rank * local : (self.rank + 1) * local]
-        windows = np.stack(
-            [
-                np.asarray(self.tokens[s : s + self.seq_len + 1])
-                for s in starts
-            ]
-        ).astype(np.int32)
-        if self.vocab_size and int(windows.max()) >= self.vocab_size:
+
+        from ..utils.native import gather_windows
+
+        native = gather_windows(self.tokens, starts, self.seq_len)
+        if native is not None:
+            inputs, targets, max_id = native
+        else:
+            windows = np.stack(
+                [
+                    np.asarray(self.tokens[s : s + self.seq_len + 1])
+                    for s in starts
+                ]
+            ).astype(np.int32)
+            inputs = np.ascontiguousarray(windows[:, :-1])
+            targets = np.ascontiguousarray(windows[:, 1:])
+            # Only pay the max-reduction when the bound is actually checked
+            # (the native path gets the max for free in its single pass).
+            max_id = int(windows.max()) if self.vocab_size else -1
+        if self.vocab_size and max_id >= self.vocab_size:
             raise ValueError(
-                f"corpus contains token id {int(windows.max())} >= the "
+                f"corpus contains token id {max_id} >= the "
                 f"model's vocab_size {self.vocab_size} — out-of-vocab ids "
                 "would silently embed as zeros (and as targets contribute "
                 "a meaningless loss term) instead of failing"
             )
-        return {
-            "inputs": np.ascontiguousarray(windows[:, :-1]),
-            "targets": np.ascontiguousarray(windows[:, 1:]),
-        }
+        return {"inputs": inputs, "targets": targets}
 
 
 def write_token_file(path: str, tokens, dtype: str = "uint16") -> None:

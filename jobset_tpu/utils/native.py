@@ -1,0 +1,127 @@
+"""Build-on-first-use loader for the C++ native helpers.
+
+The reference ships a compiled (Go) runtime; our compiled surface is the
+data-feed hot path (`jobset_tpu/native/*.cpp`). Rather than requiring a
+build step at install time (the environment may have no toolchain), the
+shared object is compiled lazily with g++ into a per-source-hash cache
+under ``$JOBSET_TPU_NATIVE_CACHE`` (default: alongside the source when
+writable, else a temp-dir cache), and every caller degrades gracefully to
+its pure-numpy implementation when compilation or loading fails.
+
+``JOBSET_TPU_NO_NATIVE=1`` disables the native path outright (tests use it
+to pin the fallback).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from typing import Optional
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "native")
+_UNSET = object()
+_DATALOADER: object = _UNSET
+
+
+def _build(src_path: str) -> Optional[str]:
+    """Compile src to a cached .so; returns the path or None."""
+    with open(src_path, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    name = os.path.basename(src_path).rsplit(".", 1)[0]
+    candidates = []
+    env_cache = os.environ.get("JOBSET_TPU_NATIVE_CACHE")
+    if env_cache:
+        candidates.append(env_cache)
+    candidates.append(_NATIVE_DIR)
+    candidates.append(
+        os.path.join(tempfile.gettempdir(), f"jobset_tpu_native_{os.getuid()}")
+    )
+    for cache in candidates:
+        so_path = os.path.join(cache, f"_{name}_{digest}.so")
+        if os.path.exists(so_path):
+            return so_path
+        tmp = so_path + f".tmp{os.getpid()}"
+        try:
+            os.makedirs(cache, exist_ok=True)
+            subprocess.run(
+                ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, src_path],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+            os.replace(tmp, so_path)  # atomic: concurrent builders race safely
+            return so_path
+        except (OSError, subprocess.SubprocessError):
+            try:
+                os.unlink(tmp)  # a failed/timed-out build must not litter
+            except OSError:
+                pass
+            continue
+    return None
+
+
+def dataloader_lib():
+    """The dataloader shared library, or None (numpy fallback)."""
+    global _DATALOADER
+    if _DATALOADER is not _UNSET:
+        return _DATALOADER
+    if os.environ.get("JOBSET_TPU_NO_NATIVE"):
+        _DATALOADER = None
+        return None
+    try:
+        so = _build(os.path.join(_NATIVE_DIR, "dataloader.cpp"))
+        if so is None:
+            _DATALOADER = None
+            return None
+        lib = ctypes.CDLL(so)
+        fn = lib.gather_windows_u16_i32
+        fn.restype = ctypes.c_int32
+        fn.argtypes = [
+            ctypes.c_void_p,  # tokens (u16*)
+            ctypes.c_void_p,  # starts (i64*)
+            ctypes.c_int64,   # n_rows
+            ctypes.c_int64,   # window
+            ctypes.c_void_p,  # inputs out (i32*)
+            ctypes.c_void_p,  # targets out (i32*)
+        ]
+        _DATALOADER = lib
+    except OSError:
+        _DATALOADER = None
+    return _DATALOADER
+
+
+def gather_windows(tokens, starts, seq_len: int):
+    """Fused native gather: (inputs, targets) int32 [n, seq_len] plus the
+    max token id, from a uint16 token array. Returns None when the native
+    library is unavailable or the dtype is not uint16 (callers fall back
+    to numpy)."""
+    import numpy as np
+
+    lib = dataloader_lib()
+    if lib is None or tokens.dtype != np.uint16:
+        return None
+    starts = np.ascontiguousarray(starts, dtype=np.int64)
+    n = int(starts.shape[0])
+    if n == 0:
+        return None
+    # Bounds guard the numpy path gets for free (ragged slices make
+    # np.stack raise): an out-of-range start must never reach the C
+    # function, where it would be a silent OOB read.
+    if int(starts.min()) < 0 or int(starts.max()) + seq_len + 1 > tokens.shape[0]:
+        raise ValueError(
+            f"window start out of range for corpus of {tokens.shape[0]} tokens"
+        )
+    inputs = np.empty((n, seq_len), np.int32)
+    targets = np.empty((n, seq_len), np.int32)
+    max_id = lib.gather_windows_u16_i32(
+        tokens.ctypes.data,
+        starts.ctypes.data,
+        n,
+        seq_len,
+        inputs.ctypes.data,
+        targets.ctypes.data,
+    )
+    return inputs, targets, int(max_id)
