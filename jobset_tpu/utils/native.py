@@ -26,6 +26,17 @@ _UNSET = object()
 _DATALOADER: object = _UNSET
 
 
+def _trusted_dir(path: str) -> bool:
+    """Only load/compile shared objects from a directory we own that is
+    not writable by group/other (a predictable /tmp path could otherwise
+    be pre-created by another local user to plant a library)."""
+    try:
+        st = os.stat(path)
+    except OSError:
+        return False
+    return st.st_uid == os.getuid() and not (st.st_mode & 0o022)
+
+
 def _build(src_path: str) -> Optional[str]:
     """Compile src to a cached .so; returns the path or None."""
     with open(src_path, "rb") as f:
@@ -41,11 +52,16 @@ def _build(src_path: str) -> Optional[str]:
     )
     for cache in candidates:
         so_path = os.path.join(cache, f"_{name}_{digest}.so")
-        if os.path.exists(so_path):
+        if os.path.exists(so_path) and _trusted_dir(cache):
             return so_path
         tmp = so_path + f".tmp{os.getpid()}"
         try:
-            os.makedirs(cache, exist_ok=True)
+            os.makedirs(cache, mode=0o700, exist_ok=True)
+            if not _trusted_dir(cache):
+                # A pre-existing cache dir we don't own (or one writable by
+                # others) could serve a planted .so straight into
+                # ctypes.CDLL — never build into or load from it.
+                continue
             subprocess.run(
                 ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, src_path],
                 check=True,

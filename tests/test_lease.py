@@ -181,3 +181,66 @@ def test_private_state_standby_rejects_writes(tmp_path):
             assert resp.read() == b"ok"
     finally:
         standby.stop()
+
+
+def test_concurrent_standby_writes_race_leader_pump(tmp_path):
+    """Hammer a shared-cluster HA pair from threads: writes land on the
+    STANDBY's real HTTP endpoint while the leader pumps concurrently —
+    serialized by the CLUSTER's lock, nothing corrupts, and every jobset
+    reconciles (via the leader; the standby's write path stores without
+    reconciling)."""
+    import threading
+    import urllib.request
+
+    from jobset_tpu.api import serialization
+
+    clock = FakeClock()
+    cluster, a, b = _two_servers(tmp_path, clock)
+    assert a.pump_if_leader() is True
+    b.start()
+
+    errors = []
+
+    def writer(i):
+        try:
+            js = (
+                make_jobset(f"conc-{i}")
+                .replicated_job(
+                    make_replicated_job("w").replicas(2)
+                    .parallelism(1).completions(1).obj()
+                )
+                .obj()
+            )
+            req = urllib.request.Request(
+                f"http://{b.address}/apis/jobset.x-k8s.io/v1alpha2"
+                f"/namespaces/default/jobsets",
+                data=serialization.to_yaml(js).encode(),
+                method="POST",
+                headers={"Content-Type": "application/yaml"},
+            )
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                assert resp.status == 201
+        except Exception as exc:  # noqa: BLE001
+            errors.append(exc)
+
+    def pumper():
+        try:
+            for _ in range(50):
+                a.pump_if_leader()
+        except Exception as exc:  # noqa: BLE001
+            errors.append(exc)
+
+    threads = [threading.Thread(target=writer, args=(i,)) for i in range(12)]
+    threads.append(threading.Thread(target=pumper))
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors
+
+        a.pump_if_leader()  # drain any stragglers
+        assert len(cluster.jobsets) == 12
+        assert len(cluster.jobs) == 24  # every jobset fully materialized
+    finally:
+        b.stop()
