@@ -73,6 +73,11 @@ def _build_parser() -> argparse.ArgumentParser:
                         "volume between controller replicas)")
     c.add_argument("--lease-identity", default="",
                    help="holder identity (default: hostname_pid)")
+    c.add_argument("--lease-duration", type=float, default=15.0,
+                   help="seconds after the last renewal at which a standby "
+                        "may take the lease (k8s LeaseDuration default)")
+    c.add_argument("--lease-retry-period", type=float, default=2.0,
+                   help="renewal/retry cadence in seconds (k8s RetryPeriod)")
 
     s = sub.add_parser("solver", help="run the placement solver sidecar (gRPC)")
     s.add_argument("--addr", default="127.0.0.1:8500")
@@ -188,6 +193,8 @@ def _cmd_controller(args) -> int:
         elector = LeaderElector(
             FileLease(args.lease_file),
             args.lease_identity or default_identity(),
+            lease_duration=args.lease_duration,
+            retry_period=args.lease_retry_period,
         )
     server = ControllerServer(args.addr, cluster=cluster,
                               tick_interval=args.tick_interval,

@@ -146,6 +146,15 @@ class LeaderElector:
         self.identity = identity
         self.lease_duration = float(lease_duration)
         self.retry_period = float(retry_period)
+        if self.retry_period >= self.lease_duration:
+            # client-go validates LeaseDuration > RenewDeadline > RetryPeriod
+            # for the same reason: a leader that may only renew every
+            # retry_period cannot keep a shorter-lived lease, so leadership
+            # would flap between replicas.
+            raise ValueError(
+                f"retry_period ({self.retry_period}) must be < "
+                f"lease_duration ({self.lease_duration})"
+            )
         self.clock = clock or Clock()
         self._leading = False
         self._last_renew = -float("inf")

@@ -190,3 +190,88 @@ def test_self_signed_certs_are_reused_across_restarts(tmp_path):
     second_bytes = [open(p, "rb").read() for p in second]
     assert first == second
     assert first_bytes == second_bytes  # reuse, not reissue
+
+
+def test_ha_controller_pair_fails_over_on_leader_crash(tmp_path, free_ports):
+    """Two REAL `jobset-tpu controller --leader-elect` processes sharing a
+    lease file: exactly one leads, the standby 503s writes, and after the
+    leader is SIGKILLed (crash — no voluntary release) the standby takes
+    the lease within the lease duration and serves writes."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    lease = tmp_path / "leader.lease"
+    procs = []
+
+    def controller(port, ident):
+        p = _spawn([
+            "controller", "--addr", f"127.0.0.1:{port}",
+            "--tick-interval", "0.1",
+            "--topology", "tpu-slice:4x2x8",
+            "--leader-elect",
+            "--lease-file", str(lease),
+            "--lease-identity", ident,
+            "--lease-duration", "2.0",
+            "--lease-retry-period", "0.3",
+        ])
+        procs.append(p)
+        _read_address(p, "listening on")
+        return p
+
+    def leaderz(port):
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/leaderz", timeout=10
+        ) as resp:
+            return json.loads(resp.read())
+
+    def wait_leading(port, want, timeout=20.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if leaderz(port)["leading"] is want:
+                return True
+            time.sleep(0.1)
+        return False
+
+    try:
+        a = controller(free_ports[0], "replica-a")
+        assert wait_leading(free_ports[0], True)
+        b = controller(free_ports[1], "replica-b")
+        time.sleep(0.5)
+        assert leaderz(free_ports[1])["leading"] is False
+
+        # Standby rejects writes with 503; leader accepts them.
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{free_ports[1]}/apis/jobset.x-k8s.io/"
+            "v1alpha2/namespaces/default/jobsets",
+            data=MANIFEST.encode(), method="POST",
+            headers={"Content-Type": "application/yaml"},
+        )
+        try:
+            urllib.request.urlopen(req, timeout=10)
+            raise AssertionError("standby accepted a write")
+        except urllib.error.HTTPError as exc:
+            assert exc.code == 503
+        client_a = JobSetClient(f"127.0.0.1:{free_ports[0]}")
+        client_a.create(MANIFEST)
+        assert any(j["status"]["active"] or j["spec"]["parallelism"]
+                   for j in client_a.jobs())
+
+        # Crash the leader (no release written); the standby must take
+        # over once the lease expires, then serve writes itself.
+        _stop(a)
+        assert wait_leading(free_ports[1], True, timeout=20.0)
+        client_b = JobSetClient(f"127.0.0.1:{free_ports[1]}")
+        created = client_b.create(MANIFEST.replace("name: smoke",
+                                                   "name: smoke2"))
+        assert created.metadata.name == "smoke2"
+        # The new leader reconciles its write (its own cluster state).
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if len(client_b.jobs()) == 2:
+                break
+            time.sleep(0.2)
+        assert len(client_b.jobs()) == 2
+    finally:
+        for p in procs:
+            _stop(p)

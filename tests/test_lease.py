@@ -244,3 +244,13 @@ def test_concurrent_standby_writes_race_leader_pump(tmp_path):
         assert len(cluster.jobs) == 24  # every jobset fully materialized
     finally:
         b.stop()
+
+
+def test_retry_period_must_be_shorter_than_lease_duration(tmp_path):
+    """client-go's LeaseDuration > RetryPeriod validation analog: a leader
+    that may only renew every retry_period cannot keep a shorter lease."""
+    import pytest
+
+    with pytest.raises(ValueError, match="retry_period"):
+        _elector(tmp_path, "a", FakeClock(),
+                 lease_duration=1.0, retry_period=2.0)
