@@ -463,6 +463,8 @@ def run_model_phase(args, sink: dict, emit=None) -> None:
                 steps=10, warmup=2, batch=batch, loss_chunk=use_chunk
             )
         except Exception as exc:  # noqa: BLE001 — bank what we have
+            if isinstance(exc, _PhaseTimeout):
+                raise  # the phase deadline aborts the whole phase
             if "RESOURCE_EXHAUSTED" in str(exc) and not use_chunk:
                 # Out of HBM at this batch: retry once with the
                 # memory-bounded chunked cross-entropy (exact numerics,
@@ -476,6 +478,8 @@ def run_model_phase(args, sink: dict, emit=None) -> None:
                         steps=10, warmup=2, batch=batch, loss_chunk=use_chunk
                     )
                 except Exception as exc2:  # noqa: BLE001
+                    if isinstance(exc2, _PhaseTimeout):
+                        raise
                     sink["batch_sweep"].append({
                         "batch": batch,
                         "error": f"{type(exc2).__name__}: {exc2}"[:200],
@@ -500,10 +504,34 @@ def run_model_phase(args, sink: dict, emit=None) -> None:
         if "decode" not in sink:
             try:
                 sink["decode"] = run_decode_bench()
+            except _PhaseTimeout:
+                raise
             except Exception as exc:  # noqa: BLE001 — must not cost the MFU
                 sink["decode"] = {"error": f"{type(exc).__name__}: {exc}"[:200]}
             if emit is not None:
                 emit()
+
+    # Long-context point (banked independently like every sweep point):
+    # seq 4096 exercises the blockwise/flash attention path where the
+    # [B, T, T] score materialization would start to hurt; chunked
+    # cross-entropy bounds the [B, T, vocab] logits term regardless of the
+    # earlier sweep's OOM state.
+    try:
+        r = run_model_bench(
+            steps=6, warmup=2, batch=2, seq_len=4096, loss_chunk=512
+        )
+        sink["long_context"] = {
+            k: r[k] for k in (
+                "batch", "seq_len", "step_time_ms", "tokens_per_sec",
+                "mfu_pct", "loss_chunk",
+            )
+        }
+    except _PhaseTimeout:
+        raise
+    except Exception as exc:  # noqa: BLE001 — must not cost banked points
+        sink["long_context"] = {"error": f"{type(exc).__name__}: {exc}"[:200]}
+    if emit is not None:
+        emit()
 
     # Last (so a deadline here costs nothing measured): a short profiled
     # pass capturing a JAX trace — the SURVEY §5 observability promise.
@@ -521,6 +549,8 @@ def run_model_phase(args, sink: dict, emit=None) -> None:
                 profile_dir=profile_dir,
             )
             sink["profile_dir"] = profile_dir
+        except _PhaseTimeout:
+            raise
         except Exception as exc:  # noqa: BLE001
             sink["profile_error"] = f"{type(exc).__name__}: {exc}"[:200]
         if emit is not None:
