@@ -254,3 +254,108 @@ def test_retry_period_must_be_shorter_than_lease_duration(tmp_path):
     with pytest.raises(ValueError, match="retry_period"):
         _elector(tmp_path, "a", FakeClock(),
                  lease_duration=1.0, retry_period=2.0)
+
+
+def test_no_split_brain_across_processes(tmp_path):
+    """Multi-PROCESS contention hammer on the REAL clock: 4 workers spin
+    ensure() on one shared lease file; a sibling "chaos" process SIGKILLs
+    whoever leads at ~2.5s (no release is written). Each leader logs the
+    lease RECORD's acquire/renew clock times read back under the lease's
+    own guard — these were written under the cross-process flock, so they
+    carry the true ordering regardless of scheduler delays. Invariant: a
+    different holder's fresh acquisition comes at least lease_duration
+    after the last renewal observed from the previous holder (missing
+    later renewals only widens the measured gap — no false positives).
+
+    NOTE: the test environment delays a PARENT's view of child file
+    writes until the child exits (sibling processes share a live view),
+    so the leader pick runs in a sibling and the log is read only after
+    every child has exited."""
+    import os
+    import signal  # noqa: F401 (victim killed by the sibling)
+    import subprocess
+    import sys
+    import time
+
+    lease = tmp_path / "contended.lease"
+    log = tmp_path / "leadership.log"
+    DURATION, RETRY = 0.3, 0.05
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    worker_code = f'''
+import os, sys, time
+sys.path.insert(0, {repr(repo_root)})
+from jobset_tpu.core.lease import FileLease, LeaderElector
+ident = sys.argv[1]
+with open(os.path.join({str(tmp_path)!r}, ident + ".pid"), "w") as f:
+    f.write(str(os.getpid()))
+fl = FileLease({str(lease)!r})
+elector = LeaderElector(fl, ident, lease_duration={DURATION},
+                        retry_period={RETRY})
+end = time.monotonic() + 6.0
+with open({str(log)!r}, "a") as logf:
+    while time.monotonic() < end:
+        if elector.ensure():
+            with fl.guard():
+                rec = fl.read()
+            if rec is not None and rec.holder == ident:
+                logf.write(f"{{rec.acquired_at}} {{rec.renewed_at}} {{ident}}\\n")
+                logf.flush()
+        time.sleep(0.02)
+# No voluntary release: workers end like crashes, so every observed
+# handoff must obey the lease-expiry bound (release() handoffs are
+# legitimately immediate and would look like violations).
+'''
+    killer_code = f'''
+import json, os, signal, time
+# Wait for the first acquisition (worker imports can take seconds on a
+# loaded box), THEN give the contest some runtime before the crash.
+deadline = time.monotonic() + 60
+while not os.path.exists({str(lease)!r}) and time.monotonic() < deadline:
+    time.sleep(0.05)
+time.sleep(2.5)
+with open({str(lease)!r}) as f:
+    victim = json.load(f)["holderIdentity"]
+with open(os.path.join({str(tmp_path)!r}, victim + ".pid")) as f:
+    pid = int(f.read())
+os.kill(pid, signal.SIGKILL)
+print(victim)
+'''
+    procs = {
+        f"w{i}": subprocess.Popen(
+            [sys.executable, "-c", worker_code, f"w{i}"],
+            stderr=subprocess.PIPE,
+        )
+        for i in range(4)
+    }
+    killer = subprocess.Popen(
+        [sys.executable, "-c", killer_code],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    k_out, k_err = killer.communicate(timeout=60)
+    assert killer.returncode == 0, k_err[-800:]
+    victim = k_out.strip()
+    assert victim in procs
+
+    for ident, p in procs.items():
+        p.wait(timeout=30)
+        if ident != victim:
+            err = p.stderr.read().decode()[-500:]
+            assert p.returncode == 0, (ident, err)
+
+    entries = []
+    for line in log.read_text().splitlines():
+        acquired, renewed, ident = line.split()
+        entries.append((float(acquired), float(renewed), ident))
+    entries.sort(key=lambda e: e[1])
+    assert entries, "nobody ever led"
+    holders = {ident for _, _, ident in entries}
+    assert len(holders) >= 2, f"no takeover ever happened: {holders}"
+    violations = [
+        (prev, cur)
+        for prev, cur in zip(entries, entries[1:])
+        if prev[2] != cur[2]
+        and cur[0] != prev[0]  # a fresh acquisition by a new holder
+        and cur[0] - prev[1] < DURATION - 1e-3
+    ]
+    assert not violations, f"split-brain windows: {violations[:5]}"
