@@ -504,10 +504,19 @@ def run_model_phase(args, sink: dict, emit=None) -> None:
         if "decode" not in sink:
             try:
                 sink["decode"] = run_decode_bench()
+                # Weight-only int8 serving: decode is HBM-bound, so int8
+                # weights should roughly halve per-token latency on-chip.
+                sink["decode_int8"] = run_decode_bench(quantized=True)
             except _PhaseTimeout:
                 raise
             except Exception as exc:  # noqa: BLE001 — must not cost the MFU
-                sink["decode"] = {"error": f"{type(exc).__name__}: {exc}"[:200]}
+                sink.setdefault(
+                    "decode", {"error": f"{type(exc).__name__}: {exc}"[:200]}
+                )
+                sink.setdefault(
+                    "decode_int8",
+                    {"error": f"{type(exc).__name__}: {exc}"[:200]},
+                )
             if emit is not None:
                 emit()
 

@@ -69,6 +69,9 @@ def main() -> int:
         from jobset_tpu.runtime.model_bench import run_decode_bench
 
         result["decode"] = run_decode_bench(config=cfg)
+        # Weight-only int8 serving variant (models/quant.py): decode is
+        # HBM-bound, so int8 weights target ~2x tokens/s on-chip.
+        result["decode_int8"] = run_decode_bench(config=cfg, quantized=True)
     value = result["mfu_pct"] if result["mfu_pct"] is not None else result[
         "achieved_tflops"
     ]

@@ -23,6 +23,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.flash_block import blockwise_causal_attention
 from ..parallel.mesh import axis_size, pvary_to, vma_union
+from .quant import weight_cast
 from .transformer import (
     TransformerConfig,
     _dense_mlp,
@@ -74,9 +75,10 @@ def _moe_mlp_topk_decode(p, xn, cfg: TransformerConfig):
     )  # [B, T, E], nonzero only at the k chosen experts
 
     h = jax.nn.silu(
-        jnp.einsum("btd,edf->ebtf", xn.astype(compute), p["we1"].astype(compute))
+        jnp.einsum("btd,edf->ebtf", xn.astype(compute),
+                   weight_cast(p["we1"], compute))
     )
-    y = jnp.einsum("ebtf,efd->ebtd", h, p["we2"].astype(compute))
+    y = jnp.einsum("ebtf,efd->ebtd", h, weight_cast(p["we2"], compute))
     out = jnp.einsum("ebtd,bte->btd", y, weights.astype(compute))
     return lax.psum(out, "tp")
 
@@ -162,7 +164,7 @@ def _layer_qkv(p, xn, base, kv_heads_local, cfg: TransformerConfig):
     group = cfg.n_heads // cfg.kv_heads
 
     def proj(w, n_heads):
-        y = jnp.einsum("btd,df->btf", xn.astype(compute), w.astype(compute))
+        y = jnp.einsum("btd,df->btf", xn.astype(compute), weight_cast(w, compute))
         return y.reshape(*y.shape[:-1], n_heads, cfg.head_dim)
 
     q = rotary(
@@ -177,7 +179,7 @@ def _layer_tail(p, x, attn, cfg: TransformerConfig):
     compute = cfg.dtype
     attn = attn.reshape(*attn.shape[:-2], attn.shape[-2] * attn.shape[-1])
     out = jnp.einsum(
-        "btf,fd->btd", attn.astype(compute), p["wo"].astype(compute)
+        "btf,fd->btd", attn.astype(compute), weight_cast(p["wo"], compute)
     )
     x = x + lax.psum(out, "tp").astype(x.dtype)
     xn2 = rms_norm(x, p["ln2"], cfg.norm_eps)
@@ -320,6 +322,7 @@ def build_generate(
     max_new_tokens: int,
     temperature: float = 0.0,
     top_k: int = 0,
+    quantized: bool = False,
 ):
     """Returns jitted generate(params, prompt [B, T_prompt], key=None) ->
     tokens [B, T_prompt + max_new_tokens].
@@ -342,6 +345,13 @@ def build_generate(
                 "use a dp/tp serving mesh"
             )
     specs = param_specs(cfg)
+    if quantized:
+        # Params came through quant.quantize_params_for_serving: every
+        # quantized weight is a (q, scale) pair whose sharding mirrors the
+        # original weight (scales are unsharded on the contraction axis).
+        from .quant import quantize_specs
+
+        specs = quantize_specs(specs)
     cache_spec = P(None, "dp", None, "tp", None)
 
     def local_generate(params, prompt, key, cache_k, cache_v):
@@ -355,6 +365,10 @@ def build_generate(
         # expert selection, and pre-rounding it would flip near-tie routes.
         def _cast(path, x):
             if any(getattr(k, "key", None) == "wg" for k in path):
+                return x
+            # Quantization scales stay f32: they are tiny (one per output
+            # channel) and bf16 rounding would add error on every weight.
+            if any(getattr(k, "name", None) == "scale" for k in path):
                 return x
             if jnp.issubdtype(x.dtype, jnp.floating):
                 return x.astype(cfg.dtype)

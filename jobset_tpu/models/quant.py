@@ -1,0 +1,129 @@
+"""Weight-only int8 quantization for the serving path.
+
+Decode is HBM-bandwidth-bound: every step streams the full parameter set,
+so serving latency is set by weight bytes, not FLOPs. The decode path
+already pre-casts float weights to the bf16 compute dtype once per call
+(`decode.build_generate`); int8 weights halve the traffic again. Scheme:
+
+* per-output-channel symmetric int8 — for every matmul weight the
+  contraction axis is the second-to-last (the layout shared by all of
+  wq/wk/wv/wo/w1/w2/we1/we2/unembed), so scales are the abs-max over
+  axis=-2 divided by 127, kept RANK-PRESERVED ([..., 1, d_out]) so the
+  quantized pair reuses the weight's sharding layout;
+* dequantization happens at the matmul sites (`weight_cast`), where XLA
+  fuses the int8->bf16 convert + scale multiply into the dot's operand
+  read — the weight crosses HBM as 1 byte/element and never
+  materializes in bf16;
+* norms, the MoE router gate (read in f32 for routing stability), and
+  the embedding table (a gather, and the quality-sensitive tied-unembed
+  case) stay in full precision.
+
+The reference has no inference surface (it orchestrates containers); this
+is a serving-plane extension like the rest of `models/decode.py`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+# Weight names quantized for serving; all contract over axis -2.
+QUANTIZED_WEIGHTS = frozenset(
+    {"wq", "wk", "wv", "wo", "w1", "w2", "we1", "we2", "unembed"}
+)
+
+
+@jax.tree_util.register_pytree_with_keys_class
+@dataclass
+class QuantizedTensor:
+    """int8 values + rank-preserved per-output-channel f32 scales.
+
+    A pytree node, so quantized params flow through tree_map/shard_map
+    like plain weights; `weight_cast` dequantizes at the matmul site.
+    """
+
+    q: Any  # int8, the original weight's shape
+    scale: Any  # f32, shape with 1 at the contraction axis (-2)
+
+    def tree_flatten_with_keys(self):
+        return (
+            ((jax.tree_util.GetAttrKey("q"), self.q),
+             (jax.tree_util.GetAttrKey("scale"), self.scale)),
+            None,
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+
+def quantize_int8(w) -> QuantizedTensor:
+    """Symmetric per-output-channel int8 over contraction axis -2."""
+    w = jnp.asarray(w)
+    absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=-2, keepdims=True)
+    scale = jnp.maximum(absmax, 1e-12) / 127.0
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127).astype(
+        jnp.int8
+    )
+    return QuantizedTensor(q=q, scale=scale.astype(jnp.float32))
+
+
+def weight_cast(w, dtype):
+    """Matmul-site weight fetch: plain cast for arrays, fused
+    dequantization for QuantizedTensor (int8 bytes cross HBM; the
+    convert+scale fuses into the dot)."""
+    if isinstance(w, QuantizedTensor):
+        # Dequantize in f32 and round ONCE to the compute dtype (casting
+        # the scale to bf16 first would add ~0.4% rounding on every
+        # weight); XLA fuses the whole convert+scale chain into the dot's
+        # operand read, so only int8 bytes cross HBM either way.
+        return (w.q.astype(jnp.float32) * w.scale).astype(dtype)
+    return w.astype(dtype)
+
+
+def quantize_params_for_serving(params: dict) -> dict:
+    """Quantize every serving-relevant matmul weight in a transformer
+    param tree (QUANTIZED_WEIGHTS by name); everything else passes
+    through unchanged. Works on nested dicts (the layers sub-tree)."""
+
+    def walk(d):
+        out = {}
+        for name, value in d.items():
+            if isinstance(value, dict):
+                out[name] = walk(value)
+            elif name in QUANTIZED_WEIGHTS:
+                out[name] = quantize_int8(value)
+            else:
+                out[name] = value
+        return out
+
+    return walk(params)
+
+
+def quantize_specs(specs: dict) -> dict:
+    """Mirror quantize_params_for_serving on a PartitionSpec tree: each
+    quantized weight's spec becomes QuantizedTensor(q=orig, scale=orig
+    with the contraction axis unsharded — the scale is size-1 there)."""
+
+    def walk(d):
+        out = {}
+        for name, value in d.items():
+            if isinstance(value, dict):
+                out[name] = walk(value)
+            elif name in QUANTIZED_WEIGHTS:
+                entries = list(value)
+                entries[-2] = None
+                out[name] = QuantizedTensor(q=value, scale=P(*entries))
+            else:
+                out[name] = value
+        return out
+
+    return walk(specs)

@@ -49,6 +49,7 @@ from ..parallel.mesh import MeshConfig, axis_size, pvary_to, vma_union
 from ..parallel.pipeline import pipeline_apply
 from ..ops.flash_block import _repeat_heads as repeat_kv  # GQA broadcast
 from ..parallel.ring_attention import ring_attention
+from .quant import weight_cast
 from ..parallel.ulysses_attention import ulysses_attention
 
 
@@ -306,7 +307,7 @@ def _attention_block(p, x, cfg: TransformerConfig, t_local: int):
 
     def proj(w, n_heads):
         y = jnp.einsum(
-            "btd,df->btf", xn.astype(compute), w.astype(compute)
+            "btd,df->btf", xn.astype(compute), weight_cast(w, compute)
         )
         return y.reshape(*y.shape[:-1], n_heads, cfg.head_dim)
 
@@ -330,7 +331,8 @@ def _attention_block(p, x, cfg: TransformerConfig, t_local: int):
         # Ring has no alignment constraint: compact K/V ride the ppermutes.
         attn = ring_attention(q, key, value, "sp", causal=True)
     attn = attn.reshape(*attn.shape[:-2], heads_local * cfg.head_dim)
-    out = jnp.einsum("btf,fd->btd", attn.astype(compute), p["wo"].astype(compute))
+    out = jnp.einsum("btf,fd->btd", attn.astype(compute),
+                     weight_cast(p["wo"], compute))
     out = lax.psum(out, "tp")
     return x + out.astype(x.dtype)
 
@@ -338,9 +340,9 @@ def _attention_block(p, x, cfg: TransformerConfig, t_local: int):
 def _dense_mlp(p, xn, cfg):
     compute = cfg.dtype
     h = jax.nn.silu(
-        jnp.einsum("btd,df->btf", xn.astype(compute), p["w1"].astype(compute))
+        jnp.einsum("btd,df->btf", xn.astype(compute), weight_cast(p["w1"], compute))
     )
-    out = jnp.einsum("btf,fd->btd", h, p["w2"].astype(compute))
+    out = jnp.einsum("btf,fd->btd", h, weight_cast(p["w2"], compute))
     return lax.psum(out, "tp")
 
 
@@ -360,9 +362,10 @@ def _moe_mlp(p, xn, cfg):
     gates_local = lax.dynamic_slice_in_dim(gates, start, e_local, axis=2)
 
     h = jax.nn.silu(
-        jnp.einsum("btd,edf->ebtf", xn.astype(compute), p["we1"].astype(compute))
+        jnp.einsum("btd,edf->ebtf", xn.astype(compute),
+                   weight_cast(p["we1"], compute))
     )
-    y = jnp.einsum("ebtf,efd->ebtd", h, p["we2"].astype(compute))
+    y = jnp.einsum("ebtf,efd->ebtd", h, weight_cast(p["we2"], compute))
     out = jnp.einsum("ebtd,bte->btd", y, gates_local.astype(compute))
     return lax.psum(out, ("ep", "tp"))
 
@@ -599,7 +602,7 @@ def unembed_logits(params, xn, cfg):
         )
     return jnp.einsum(
         "btd,dv->btv", xn.astype(cfg.dtype),
-        params["unembed"].astype(cfg.dtype),
+        weight_cast(params["unembed"], cfg.dtype),
     )
 
 

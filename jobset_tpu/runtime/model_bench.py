@@ -231,6 +231,7 @@ def run_decode_bench(
     prompt_len: int = 32,
     max_new_tokens: int = 96,
     config: Optional[Any] = None,
+    quantized: bool = False,
 ) -> dict:
     """Serving-path benchmark: greedy KV-cache decode throughput.
 
@@ -256,7 +257,13 @@ def run_decode_bench(
         max_seq_len=prompt_len + max_new_tokens,
     )
     params = transformer.init_params(jax.random.key(0), cfg, mesh)
-    generate = build_generate(cfg, mesh, max_new_tokens)
+    if quantized:
+        # Weight-only int8 serving (models/quant.py): decode is HBM-bound,
+        # so halving weight bytes is the dominant latency lever.
+        from ..models.quant import quantize_params_for_serving
+
+        params = quantize_params_for_serving(params)
+    generate = build_generate(cfg, mesh, max_new_tokens, quantized=quantized)
     prompt = jax.random.randint(
         jax.random.key(1), (batch, prompt_len), 0, cfg.vocab_size
     )
@@ -271,6 +278,7 @@ def run_decode_bench(
     new_tokens = batch * max_new_tokens
     return {
         "phase": "decode",
+        "quantized": quantized,
         "backend": jax.default_backend(),
         "device_kind": devices[0].device_kind,
         "batch": batch,

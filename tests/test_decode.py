@@ -208,3 +208,92 @@ def test_tied_embeddings_decode_matches_full_forward():
         logits = np.asarray(fwd(params, jnp.asarray(toks)))
         toks = np.concatenate([toks, logits[:, -1].argmax(-1)[:, None]], axis=1)
     np.testing.assert_array_equal(out, toks)
+
+
+# ---------------------------------------------------------------------------
+# Weight-only int8 serving quantization (models/quant.py)
+# ---------------------------------------------------------------------------
+
+
+def test_quantize_int8_error_bound():
+    """Per-output-channel symmetric int8: reconstruction error per element
+    is bounded by half a quantization step of its channel."""
+    from jobset_tpu.models.quant import quantize_int8, weight_cast
+
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.standard_normal((3, 64, 32)) * 0.07, jnp.float32)
+    qt = quantize_int8(w)
+    assert qt.q.dtype == jnp.int8 and qt.q.shape == w.shape
+    assert qt.scale.shape == (3, 1, 32)
+    back = weight_cast(qt, jnp.float32)
+    step = np.asarray(qt.scale)
+    err = np.abs(np.asarray(back) - np.asarray(w))
+    assert (err <= step / 2 + 1e-7).all(), float(err.max())
+
+
+def test_quantized_forward_logits_close_to_full_precision():
+    """End-to-end logits with int8 weights stay within int8 resolution of
+    the full-precision forward (same bf16 compute path both sides)."""
+    from jobset_tpu.models.quant import quantize_params_for_serving
+
+    cfg = _cfg()
+    mesh = build_mesh(MeshConfig(), jax.devices()[:1])
+    params = init_params(jax.random.key(0), cfg, mesh)
+    params_q = quantize_params_for_serving(params)
+
+    tokens = jnp.asarray(
+        np.random.default_rng(1).integers(0, cfg.vocab_size, (2, 8)), jnp.int32
+    )
+    forward = build_forward(cfg, mesh)
+    fp = np.asarray(forward(params, tokens)).astype(np.float32)
+    q = np.asarray(forward(params_q, tokens)).astype(np.float32)
+    # int8 weight noise is ~0.4% per matmul; a few layers compound to a
+    # small fraction of the logits' dynamic range.
+    scale = np.abs(fp).max()
+    assert np.abs(q - fp).max() <= 0.05 * scale, (
+        float(np.abs(q - fp).max()), float(scale)
+    )
+
+
+def test_quantized_decode_runs_sharded_and_tracks_full_precision():
+    """build_generate(quantized=True) on a dp x tp serving mesh: memory
+    halves (int8 weights), outputs are valid ids, and greedy picks match
+    the full-precision decode wherever the fp logit margin exceeds the
+    quantization noise (ties may legitimately flip)."""
+    from jobset_tpu.models.quant import (
+        QuantizedTensor,
+        quantize_params_for_serving,
+    )
+
+    cfg = _cfg()
+    mc = MeshConfig(dp=1, tp=2)
+    mesh = build_mesh(mc, jax.devices()[: mc.num_devices])
+    params = init_params(jax.random.key(0), cfg, mesh)
+    params_q = quantize_params_for_serving(params)
+
+    def nbytes(tree):
+        return sum(
+            leaf.nbytes for leaf in jax.tree.leaves(tree)
+        )
+
+    assert nbytes(params_q) < 0.6 * nbytes(params)
+    assert any(
+        isinstance(leaf, QuantizedTensor)
+        for leaf in jax.tree.leaves(
+            params_q, is_leaf=lambda x: isinstance(x, QuantizedTensor)
+        )
+    )
+
+    prompt = jnp.asarray(
+        np.random.default_rng(2).integers(0, cfg.vocab_size, (2, 5)), jnp.int32
+    )
+    max_new = 6
+    fp_gen = build_generate(cfg, mesh, max_new)
+    q_gen = build_generate(cfg, mesh, max_new, quantized=True)
+    fp_out = np.asarray(fp_gen(params, prompt))
+    q_out = np.asarray(q_gen(params_q, prompt))
+    assert q_out.shape == fp_out.shape
+    assert ((q_out >= 0) & (q_out < cfg.vocab_size)).all()
+    np.testing.assert_array_equal(q_out[:, :5], np.asarray(prompt))
+    agree = (q_out == fp_out).mean()
+    assert agree >= 0.5, f"quantized decode diverged everywhere ({agree=})"
