@@ -105,15 +105,40 @@ def _decode_mlp(p, xn, cfg: TransformerConfig):
 
 
 def init_kv_cache(
-    config: TransformerConfig, mesh: Mesh, batch: int, max_len: int
+    config: TransformerConfig,
+    mesh: Mesh,
+    batch: int,
+    max_len: int,
+    quantized_kv: bool = False,
 ) -> dict:
     """Global KV cache arrays [layers, B, max_len, H_kv, D], head-sharded on
     tp and batch-sharded on dp. With GQA the cache holds only the
     n_kv_heads K/V heads — the full serving-memory win — and reads are
-    broadcast per query-head group at compute time."""
+    broadcast per query-head group at compute time.
+
+    quantized_kv: store the cache as per-vector int8 (QuantizedTensor with
+    one f32 scale per [layer, batch, position, head]) — the cache is THE
+    memory/bandwidth term at long context, so int8 roughly doubles
+    servable context and halves the cache's share of per-token reads."""
     cfg = config
     shape = (cfg.n_layers, batch, max_len, cfg.kv_heads, cfg.head_dim)
     sharding = NamedSharding(mesh, P(None, "dp", None, "tp", None))
+    if quantized_kv:
+        from .quant import QuantizedTensor
+
+        def part():
+            return QuantizedTensor(
+                q=jax.device_put(jnp.zeros(shape, jnp.int8), sharding),
+                # Unwritten positions dequantize to 0 (q=0) regardless of
+                # scale; 1.0 keeps the math finite. Scale rank mirrors the
+                # cache (size-1 vector axis) so the cache sharding applies
+                # to both leaves as a pytree prefix.
+                scale=jax.device_put(
+                    jnp.ones((*shape[:-1], 1), jnp.float32), sharding
+                ),
+            )
+
+        return {"k": part(), "v": part()}
     # Cache lives in the compute dtype (bf16 for serving configs) — it is
     # the dominant HBM term; the attention dot upcasts to f32.
     zeros = jnp.zeros(shape, cfg.dtype)
@@ -121,6 +146,33 @@ def init_kv_cache(
         "k": jax.device_put(zeros, sharding),
         "v": jax.device_put(zeros, sharding),
     }
+
+
+def _cache_write(cache_part, value, pos: int):
+    """Write `value` [B, T, H, D] into the cache at position `pos`: plain
+    dtype-cast store, or per-vector int8 (scale = absmax over D / 127) for
+    a quantized cache."""
+    from .quant import QuantizedTensor, quantize_int8
+
+    if isinstance(cache_part, QuantizedTensor):
+        qt = quantize_int8(value, axis=-1)  # one scale per cached vector
+        return QuantizedTensor(
+            q=lax.dynamic_update_slice(cache_part.q, qt.q, (0, pos, 0, 0)),
+            scale=lax.dynamic_update_slice(
+                cache_part.scale, qt.scale, (0, pos, 0, 0)
+            ),
+        )
+    return lax.dynamic_update_slice(
+        cache_part, value.astype(cache_part.dtype), (0, pos, 0, 0)
+    )
+
+
+def _cache_read(cache_part):
+    """Full cache view in f32: identity cast for plain caches, fused
+    dequantization for int8 caches (int8 bytes cross HBM; the
+    convert+scale rides the attention matmul's operand read). One
+    dequant definition: quant.weight_cast."""
+    return weight_cast(cache_part, jnp.float32)
 
 
 def _decode_layer(p, x, cache_k, cache_v, pos, cfg: TransformerConfig):
@@ -132,21 +184,17 @@ def _decode_layer(p, x, cache_k, cache_v, pos, cfg: TransformerConfig):
     xn = rms_norm(x, p["ln1"], cfg.norm_eps)
     q, k, v = _layer_qkv(p, xn, pos, kv_heads_local, cfg)
 
-    cache_k = lax.dynamic_update_slice(
-        cache_k, k.astype(cache_k.dtype), (0, pos, 0, 0)
-    )
-    cache_v = lax.dynamic_update_slice(
-        cache_v, v.astype(cache_v.dtype), (0, pos, 0, 0)
-    )
+    cache_k = _cache_write(cache_k, k, pos)
+    cache_v = _cache_write(cache_v, v, pos)
 
     # GQA: the cache is read at its compact kv-head width and broadcast per
     # query-head group (a fused broadcast, not a copy) — bandwidth, the
     # decode bottleneck, scales with kv_heads.
-    full_k = repeat_kv(cache_k, group).astype(jnp.float32)
-    full_v = repeat_kv(cache_v, group).astype(jnp.float32)
+    full_k = repeat_kv(_cache_read(cache_k), group)
+    full_v = repeat_kv(_cache_read(cache_v), group)
     scale = cfg.head_dim ** -0.5
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, full_k) * scale  # [B,H,1,T]
-    t_max = cache_k.shape[1]
+    t_max = full_k.shape[1]
     visible = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, t_max), 3) <= pos
     logits = jnp.where(visible, logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
@@ -199,12 +247,8 @@ def _prefill_layer(p, x, cache_k, cache_v, cfg: TransformerConfig):
     xn = rms_norm(x, p["ln1"], cfg.norm_eps)
     q, k, v = _layer_qkv(p, xn, 0, kv_heads_local, cfg)
 
-    cache_k = lax.dynamic_update_slice(
-        cache_k, k.astype(cache_k.dtype), (0, 0, 0, 0)
-    )
-    cache_v = lax.dynamic_update_slice(
-        cache_v, v.astype(cache_v.dtype), (0, 0, 0, 0)
-    )
+    cache_k = _cache_write(cache_k, k, 0)
+    cache_v = _cache_write(cache_v, v, 0)
 
     attn = blockwise_causal_attention(q, k, v)  # GQA broadcast inside
     return _layer_tail(p, x, attn, cfg), cache_k, cache_v
@@ -238,11 +282,14 @@ def _run_stack(params, x, cache, cfg, layer_fn):
     vma = vma_union(x, stage_params, cache)
     x = pvary_to(x, vma)
 
+    def tree_pvary(t):
+        return jax.tree.map(lambda a: pvary_to(a, vma), t)
+
     def body(carry, inputs):
         x = carry
         layer_p, ck, cv = inputs
         x, ck, cv = layer_fn(layer_p, x, ck, cv)
-        return pvary_to(x, vma), (pvary_to(ck, vma), pvary_to(cv, vma))
+        return pvary_to(x, vma), (tree_pvary(ck), tree_pvary(cv))
 
     x, (new_k, new_v) = lax.scan(
         body, x, (stage_params, cache["k"], cache["v"])
@@ -323,6 +370,7 @@ def build_generate(
     temperature: float = 0.0,
     top_k: int = 0,
     quantized: bool = False,
+    quantized_kv: bool = False,
 ):
     """Returns jitted generate(params, prompt [B, T_prompt], key=None) ->
     tokens [B, T_prompt + max_new_tokens].
@@ -383,10 +431,9 @@ def build_generate(
         params_vma = vma_union(params)
         token_vma = vma_union(prompt) | (params_vma - {"tp"})
         cache_vma = vma_union(cache_k) | params_vma
-        cache = {
-            "k": pvary_to(cache_k, cache_vma),
-            "v": pvary_to(cache_v, cache_vma),
-        }
+        cache = jax.tree.map(
+            lambda a: pvary_to(a, cache_vma), {"k": cache_k, "v": cache_v}
+        )
 
         # Phase 1 — prefill: one batched causal pass fills the cache for
         # every prompt position and yields the first generated token.
@@ -440,7 +487,8 @@ def build_generate(
         if key is None:
             key = jax.random.key(0)
         cache = init_kv_cache(
-            cfg, mesh, prompt.shape[0], prompt.shape[1] + max_new_tokens
+            cfg, mesh, prompt.shape[0], prompt.shape[1] + max_new_tokens,
+            quantized_kv=quantized_kv,
         )
         return sharded(params, prompt, key, cache["k"], cache["v"])
 

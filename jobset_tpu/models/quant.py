@@ -65,10 +65,13 @@ class QuantizedTensor:
         return self.q.shape
 
 
-def quantize_int8(w) -> QuantizedTensor:
-    """Symmetric per-output-channel int8 over contraction axis -2."""
+def quantize_int8(w, axis: int = -2) -> QuantizedTensor:
+    """Symmetric per-channel int8: one scale per slice along `axis`
+    (weights reduce the contraction axis -2; the KV cache reduces the
+    vector axis -1). The single definition of the serving quantization
+    recipe — scale floor, rounding, clip range."""
     w = jnp.asarray(w)
-    absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=-2, keepdims=True)
+    absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=axis, keepdims=True)
     scale = jnp.maximum(absmax, 1e-12) / 127.0
     q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127).astype(
         jnp.int8

@@ -232,6 +232,7 @@ def run_decode_bench(
     max_new_tokens: int = 96,
     config: Optional[Any] = None,
     quantized: bool = False,
+    quantized_kv: Optional[bool] = None,
 ) -> dict:
     """Serving-path benchmark: greedy KV-cache decode throughput.
 
@@ -258,12 +259,18 @@ def run_decode_bench(
     )
     params = transformer.init_params(jax.random.key(0), cfg, mesh)
     if quantized:
-        # Weight-only int8 serving (models/quant.py): decode is HBM-bound,
-        # so halving weight bytes is the dominant latency lever.
+        # Full int8 serving stack (models/quant.py): decode is HBM-bound,
+        # so halving weight bytes is the dominant latency lever, and the
+        # int8 KV cache halves the other (context-proportional) term.
         from ..models.quant import quantize_params_for_serving
 
         params = quantize_params_for_serving(params)
-    generate = build_generate(cfg, mesh, max_new_tokens, quantized=quantized)
+    if quantized_kv is None:
+        quantized_kv = quantized  # the full int8 stack by default
+    generate = build_generate(
+        cfg, mesh, max_new_tokens, quantized=quantized,
+        quantized_kv=quantized_kv,
+    )
     prompt = jax.random.randint(
         jax.random.key(1), (batch, prompt_len), 0, cfg.vocab_size
     )
@@ -279,6 +286,7 @@ def run_decode_bench(
     return {
         "phase": "decode",
         "quantized": quantized,
+        "quantized_kv": quantized_kv,
         "backend": jax.default_backend(),
         "device_kind": devices[0].device_kind,
         "batch": batch,
