@@ -1,9 +1,10 @@
 """Model zoo: flagship SPMD transformer (dense + MoE), ResNet-style CNN
 (vision family), and the MLP smoke model."""
 
-from . import cnn, decode, mlp  # noqa: F401
+from . import cnn, decode, mlp, quant  # noqa: F401
 from .cnn import CNNConfig  # noqa: F401
 from .decode import build_generate  # noqa: F401
+from .quant import quantize_params_for_serving  # noqa: F401
 from .transformer import (
     TransformerConfig,
     build_forward,
@@ -23,4 +24,6 @@ __all__ = [
     "init_params",
     "mlp",
     "param_specs",
+    "quant",
+    "quantize_params_for_serving",
 ]

@@ -23,7 +23,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.flash_block import blockwise_causal_attention
 from ..parallel.mesh import axis_size, pvary_to, vma_union
-from .quant import weight_cast
+from .quant import QuantizedTensor, quantize_int8, weight_cast
 from .transformer import (
     TransformerConfig,
     _dense_mlp,
@@ -124,8 +124,6 @@ def init_kv_cache(
     shape = (cfg.n_layers, batch, max_len, cfg.kv_heads, cfg.head_dim)
     sharding = NamedSharding(mesh, P(None, "dp", None, "tp", None))
     if quantized_kv:
-        from .quant import QuantizedTensor
-
         def part():
             return QuantizedTensor(
                 q=jax.device_put(jnp.zeros(shape, jnp.int8), sharding),
@@ -152,8 +150,6 @@ def _cache_write(cache_part, value, pos: int):
     """Write `value` [B, T, H, D] into the cache at position `pos`: plain
     dtype-cast store, or per-vector int8 (scale = absmax over D / 127) for
     a quantized cache."""
-    from .quant import QuantizedTensor, quantize_int8
-
     if isinstance(cache_part, QuantizedTensor):
         qt = quantize_int8(value, axis=-1)  # one scale per cached vector
         return QuantizedTensor(

@@ -354,3 +354,26 @@ def test_quantized_weights_and_kv_cache_compose():
     assert out.shape == (2, 9)
     assert ((out >= 0) & (out < cfg.vocab_size)).all()
     np.testing.assert_array_equal(out[:, :4], np.asarray(prompt))
+
+
+def test_quantized_kv_cache_with_gqa_tracks_full_precision():
+    """int8 KV cache + GQA (n_kv_heads < n_heads): the compact quantized
+    cache is dequantized then broadcast per query-head group — per-kv-head
+    scales must survive tp sharding and repeat_kv ordering."""
+    cfg = TransformerConfig(
+        vocab_size=128, d_model=64, n_heads=4, n_kv_heads=2, d_ff=128,
+        n_layers=2, max_seq_len=24,
+    )
+    mc = MeshConfig(dp=1, tp=2)
+    mesh = build_mesh(mc, jax.devices()[: mc.num_devices])
+    params = init_params(jax.random.key(0), cfg, mesh)
+    prompt = jnp.asarray(
+        np.random.default_rng(5).integers(0, cfg.vocab_size, (2, 5)), jnp.int32
+    )
+    fp = np.asarray(build_generate(cfg, mesh, 6)(params, prompt))
+    kv8 = np.asarray(
+        build_generate(cfg, mesh, 6, quantized_kv=True)(params, prompt)
+    )
+    assert kv8.shape == fp.shape
+    agree = (kv8 == fp).mean()
+    assert agree >= 0.5, f"GQA kv8 decode diverged everywhere ({agree=})"
