@@ -69,9 +69,16 @@ def main() -> int:
         from jobset_tpu.runtime.model_bench import run_decode_bench
 
         result["decode"] = run_decode_bench(config=cfg)
-        # Weight-only int8 serving variant (models/quant.py): decode is
-        # HBM-bound, so int8 weights target ~2x tokens/s on-chip.
-        result["decode_int8"] = run_decode_bench(config=cfg, quantized=True)
+        # int8 serving variants (models/quant.py): decode is HBM-bound, so
+        # int8 weights target ~2x tokens/s on-chip; the int8 KV cache adds
+        # the context-proportional term. Same keys as bench.py's sink so
+        # the two harnesses stay comparable.
+        result["decode_int8"] = run_decode_bench(
+            config=cfg, quantized=True, quantized_kv=False
+        )
+        result["decode_int8_kv"] = run_decode_bench(
+            config=cfg, quantized=True, quantized_kv=True
+        )
     value = result["mfu_pct"] if result["mfu_pct"] is not None else result[
         "achieved_tflops"
     ]

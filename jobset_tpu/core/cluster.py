@@ -25,7 +25,7 @@ from __future__ import annotations
 
 import itertools
 from collections import deque
-from typing import Callable, Iterable, Optional
+from typing import Callable, Optional
 
 from ..api import keys
 from ..api.defaulting import apply_defaults

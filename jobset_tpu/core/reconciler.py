@@ -16,7 +16,6 @@ so a reconcile pass is a plain function over cluster state.
 
 from __future__ import annotations
 
-import copy
 import time as _time
 
 from ..api import keys

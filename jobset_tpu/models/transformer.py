@@ -34,9 +34,9 @@ greenfield TPU-native work.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field, replace as dc_replace
+from dataclasses import dataclass, replace as dc_replace
 from functools import partial
-from typing import Any, Optional
+from typing import Any
 
 import numpy as np
 

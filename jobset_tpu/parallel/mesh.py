@@ -20,7 +20,7 @@ shard_map'd program runs unchanged from 1 chip to a full pod slice.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Optional, Sequence
 
 import numpy as np

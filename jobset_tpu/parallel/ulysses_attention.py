@@ -30,7 +30,6 @@ serves single-chip runs.
 
 from __future__ import annotations
 
-import jax.numpy as jnp
 from jax import lax
 
 from ..ops.flash_block import blockwise_causal_attention

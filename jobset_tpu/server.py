@@ -33,7 +33,7 @@ import yaml
 
 logger = logging.getLogger("jobset_tpu.server")
 
-from .api import keys, serialization
+from .api import serialization
 from .api.types import Taint
 from .core import AdmissionError, Cluster, make_cluster, metrics
 from .utils.clock import Clock
