@@ -16,9 +16,20 @@ Interface contract (shared with the jnp reference implementation):
 i.e. *unnormalized* statistics, so the caller can fold many blocks (ring
 steps) into one accumulator and divide once at the end.
 
-Differentiation: `block_attention` carries a custom VJP whose backward
-recomputes through the jnp reference — the standard flash-attention
-recompute strategy (activations are cheaper to recompute than to store).
+Differentiation: `block_attention` carries a custom VJP with a
+hand-written recompute backward — the standard flash-attention strategy
+(the probability matrix is cheaper to recompute than to store), with every
+backward matmul's operands cast to the inputs' compute dtype (bf16 on the
+training path) and f32-accumulated.
+
+GRADIENT CONTRACT: no cotangent flows through `block_max` (output 0). The
+(max, sum, weighted) triple is a gauge — shifting max by d while scaling
+sum/weighted by exp(-d) is the same attention state — and every supported
+consumer (`merge_block_stats` folds + the final normalization) is
+gauge-invariant, for which the end-to-end gradient is exact. A consumer
+that reads `block_max` NON-gauge-invariantly (e.g. a max-logit
+regularizer) would get a zero gradient through it; differentiate such a
+statistic from raw logits instead.
 
 Dispatch: the Pallas kernel runs when jax is on TPU (or when
 `force_interpret()` is active, which is how CPU tests exercise the kernel
@@ -78,22 +89,44 @@ def _use_pallas() -> bool:
 # ---------------------------------------------------------------------------
 
 
-def block_attention_reference(q, k, v, bias):
-    """One flash step in plain jnp.
+def _block_probs(q, k, bias):
+    """Shared logits -> masked unnormalized-probabilities pipeline: the ONE
+    definition of the block's softmax numerator, used by the forward
+    reference AND re-run by the hand-written backward's recompute — any
+    edit to masking/scaling here stays fwd/bwd-consistent by construction.
 
-    q: [B, Tq, H, D], k/v: [B, Tk, H, D], bias: [Tq, Tk] additive mask.
-    Returns (block_max [B,H,Tq], block_sum [B,H,Tq], weighted [B,Tq,H,D]).
+    Matmul operands stay in the INPUT dtype (bf16 from the training path —
+    MXU rate; f32 in the differential tests) with f32 accumulation via
+    `preferred_element_type`; statistics are always f32.
+    Returns (block_max [B,H,Tq] f32, probs [B,H,Tq,Tk] f32).
     """
     scale = q.shape[-1] ** -0.5
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
-    logits = logits + bias[None, None, :, :]
+    logits = (
+        jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+        * scale
+    )
+    logits = logits + bias[None, None, :, :].astype(jnp.float32)
     block_max = jnp.max(logits, axis=-1)  # [B,H,Tq]
     probs = jnp.exp(logits - block_max[..., None])
     # Fully-masked rows: exp(-inf - -inf)=exp(0)=1 would pollute; zero them.
     valid = block_max > NEG_INF / 2
     probs = jnp.where(valid[..., None], probs, 0.0)
+    return block_max, probs
+
+
+def block_attention_reference(q, k, v, bias):
+    """One flash step in plain jnp.
+
+    q: [B, Tq, H, D], k/v: [B, Tk, H, D], bias: [Tq, Tk] additive mask.
+    Returns (block_max [B,H,Tq], block_sum [B,H,Tq], weighted [B,Tq,H,D]).
+    Dtype policy: see `_block_probs`.
+    """
+    block_max, probs = _block_probs(q, k, bias)
     block_sum = jnp.sum(probs, axis=-1)  # [B,H,Tq]
-    weighted = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    weighted = jnp.einsum(
+        "bhqk,bkhd->bqhd", probs.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
     return block_max, block_sum, weighted
 
 
@@ -140,9 +173,13 @@ def _flash_block_kernel(
         l_scr[:] = jnp.zeros(l_scr.shape, jnp.float32)
         acc_scr[:] = jnp.zeros(acc_scr.shape, jnp.float32)
 
-    q = q_ref[0].astype(jnp.float32) * scale  # [TQ, Dp]
-    k_t = k_ref[0].astype(jnp.float32)  # [TK, Dp]
-    v_t = v_ref[0].astype(jnp.float32)
+    # Operands stay in their storage dtype (bf16 on the training path) so
+    # the MXU runs at bf16 rate; accumulation and everything after the
+    # matmul is f32. The scale is applied to the f32 logits, not the
+    # (possibly bf16) q, so no precision is lost to the pre-scaling.
+    q = q_ref[0]  # [TQ, Dp]
+    k_t = k_ref[0]  # [TK, Dp]
+    v_t = v_ref[0]
     b_t = bias_ref[:].astype(jnp.float32)  # [TQ, TK]
 
     logits = (
@@ -150,6 +187,7 @@ def _flash_block_kernel(
             q, k_t, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+        * scale
         + b_t
     )  # [TQ, TK]
 
@@ -164,7 +202,7 @@ def _flash_block_kernel(
     m_scr[:] = jnp.broadcast_to(new_m, m_scr.shape)
     l_scr[:] = jnp.broadcast_to(new_l, l_scr.shape)
     acc_scr[:] = acc_scr[:] * correction + lax.dot_general(
-        p, v_t, (((1,), (0,)), ((), ())),
+        p.astype(v_t.dtype), v_t, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
 
@@ -366,10 +404,11 @@ def blockwise_causal_attention(q, k, v, chunk: int = 512, causal: bool = True):
 def block_attention(q, k, v, bias):
     """Dispatching flash block step; see module docstring for the contract.
 
-    Inputs are normalized to float32 (the online-softmax statistics need f32
-    accumulation anyway) so both dispatch paths return identical f32 outputs
-    regardless of backend."""
-    q, k, v, bias = (x.astype(jnp.float32) for x in (q, k, v, bias))
+    q/k/v stay in their incoming dtype — the matmuls run at the MXU's
+    native rate for that dtype (bf16 on the training path) and accumulate
+    in f32; bias and the softmax statistics are always f32, so both
+    dispatch paths return identical f32 outputs regardless of backend."""
+    bias = bias.astype(jnp.float32)
     if _use_pallas():
         return _block_attention_pallas(q, k, v, bias)
     return block_attention_reference(q, k, v, bias)
@@ -380,14 +419,75 @@ def _fwd(q, k, v, bias):
 
 
 def _bwd(residuals, cotangents):
-    # Flash recompute: re-run the cheap jnp reference under jax.vjp instead
-    # of storing the [Tq, Tk] probability matrix as a residual. The f32
-    # normalization of the forward is mirrored here; cotangents come back
-    # in each input's original dtype.
-    f32 = tuple(x.astype(jnp.float32) for x in residuals)
-    _, vjp = jax.vjp(block_attention_reference, *f32)
-    return tuple(
-        g.astype(x.dtype) for g, x in zip(vjp(cotangents), residuals)
+    """Hand-written flash recompute backward.
+
+    Recomputes the block's logits/probabilities (never stored — the
+    standard flash strategy) and forms the five backward matmuls with
+    operands cast to the inputs' compute dtype, f32-accumulated: the f32
+    jax.vjp this replaces ran every backward matmul at the MXU's (much
+    slower) f32 rate, which taxed the hot op's backward ~3x.
+
+    The block max is treated as a constant of the recompute (no cotangent
+    flows through the max): the (max, sum, weighted) triple is a gauge —
+    every downstream consumer (`merge_block_stats` + normalization) is
+    invariant to shifting max by d while scaling sum/weighted by exp(-d) —
+    so the end-to-end gradient is independent of the representative, which
+    is exactly why flash backwards never differentiate the max. Verified
+    against dense-attention autodiff in tests/test_ops.py.
+    """
+    q, k, v, bias = residuals
+    dmax, dsum, dweighted = cotangents
+    compute = q.dtype
+    scale = q.shape[-1] ** -0.5
+
+    # Recompute this block's unnormalized probabilities — the same
+    # `_block_probs` the forward ran, so fwd/bwd cannot drift.
+    _, probs = _block_probs(q, k, bias)
+
+    # d(probs): from block_sum (broadcast) and from weighted = probs @ v.
+    dw_c = dweighted.astype(compute)
+    dprobs = dsum[..., None] + jnp.einsum(
+        "bqhd,bkhd->bhqk", dw_c, v, preferred_element_type=jnp.float32
+    )
+    # Unnormalized probs: d(logits) = probs * d(probs) — no softmax-Jacobian
+    # subtraction here; downstream normalization delivers it via `dsum`.
+    dlogits = probs * dprobs
+    dl_c = dlogits.astype(compute)
+    probs_c = probs.astype(compute)
+
+    dq = jnp.einsum(
+        "bhqk,bkhd->bqhd", dl_c, k, preferred_element_type=jnp.float32
+    ) * scale
+    dk = jnp.einsum(
+        "bhqk,bqhd->bkhd", dl_c, q, preferred_element_type=jnp.float32
+    ) * scale
+    dv = jnp.einsum(
+        "bhqk,bqhd->bkhd", probs_c, dw_c, preferred_element_type=jnp.float32
+    )
+    dbias = jnp.sum(dlogits, axis=(0, 1))
+    del dmax  # gauge direction: no flow through the block max
+
+    def match_input(g, x):
+        """shard_map VMA typing: a cotangent must vary over exactly the
+        axes its primal input does. An input invariant over an axis the
+        cotangent varies over (the constant causal bias inside a dp x sp
+        shard_map, say) takes the psum over those axes — the transpose of
+        the pvary the forward inserted, i.e. the true replicated-input
+        gradient. (jax.vjp inserted these automatically for the old
+        recompute; a hand-written bwd states them explicitly.)"""
+        extra = tuple(
+            getattr(jax.typeof(g), "vma", frozenset())
+            - getattr(jax.typeof(x), "vma", frozenset())
+        )
+        if extra:
+            g = lax.psum(g, extra)
+        return g.astype(x.dtype)
+
+    return (
+        match_input(dq, q),
+        match_input(dk, k),
+        match_input(dv, v),
+        match_input(dbias, bias),
     )
 
 

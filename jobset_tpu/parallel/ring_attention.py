@@ -41,10 +41,10 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True):
     my_idx = lax.axis_index(axis_name)
     out_dtype = q.dtype
     # K/V ride the ring in the input dtype (bf16 in training — casting
-    # first would double every ppermute's ICI bytes); block_attention
-    # upcasts each block internally, and the softmax statistics accumulate
-    # in explicit f32 regardless (bf16 accumulators lose the online-softmax
-    # recurrence's precision).
+    # first would double every ppermute's ICI bytes); block_attention runs
+    # its matmuls at that dtype's MXU rate, and the softmax statistics
+    # accumulate in explicit f32 regardless (bf16 accumulators lose the
+    # online-softmax recurrence's precision).
     batch, t_local, heads, dim = q.shape
     group = heads // k.shape[2]
 

@@ -65,9 +65,10 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = True):
             x, axis_name, split_axis=2, concat_axis=1, tiled=True
         )
 
-    # Gathered tensors stay in the input dtype: block_attention upcasts each
-    # chunk internally, so an upfront f32 cast would only double the peak
-    # residency of three full-sequence tensors.
+    # Gathered tensors stay in the input dtype: block_attention runs its
+    # matmuls at that dtype's MXU rate (f32 statistics internally), so an
+    # upfront f32 cast would only double the peak residency of three
+    # full-sequence tensors and slow the matmuls.
     qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
 
     # Local attention = the shared blockwise fold (chunked at T_local, or

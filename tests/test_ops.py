@@ -86,6 +86,75 @@ def test_gradients_match_reference():
         )
 
 
+def test_bf16_operands_match_f32_reference():
+    """The training path feeds bf16 q/k/v: matmuls run at the input dtype
+    (f32-accumulated), statistics in f32 — results must track the all-f32
+    reference within bf16 mantissa tolerance, in both dispatch paths."""
+    q, k, v = _inputs()
+    bias = _causal_bias(q.shape[1], k.shape[1])
+    ref = block_attention_reference(q, k, v, bias)
+
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    got_ref_path = block_attention_reference(qb, kb, vb, bias)
+    with force_interpret():
+        got_kernel = block_attention(qb, kb, vb, bias)
+
+    for got in (got_ref_path, got_kernel):
+        for r, g, name in zip(ref, got, ["max", "sum", "weighted"]):
+            assert g.dtype == jnp.float32, name  # stats/outputs stay f32
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(r), rtol=5e-2, atol=5e-2,
+                err_msg=name,
+            )
+
+
+def test_gradients_bf16_path_track_f32():
+    """Gradients through the hand-written bf16 backward track full-f32
+    autodiff of the reference (loose bf16 tolerance)."""
+    q, k, v = _inputs(tq=16, tk=16)
+    bias = _causal_bias(16, 16)
+
+    def loss_grads(fn, q, k, v):
+        def f(q, k, v):
+            m, s, w = fn(q, k, v, bias)
+            denom = jnp.maximum(s, 1e-20).transpose(0, 2, 1)[..., None]
+            return jnp.sum((w / denom) ** 2)
+
+        return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+    ref = loss_grads(block_attention_reference, q, k, v)
+    got = loss_grads(
+        block_attention,
+        *(x.astype(jnp.bfloat16) for x in (q, k, v)),
+    )
+    for r, g, name in zip(ref, got, "qkv"):
+        assert g.dtype == jnp.bfloat16, name  # cotangents in input dtype
+        np.testing.assert_allclose(
+            np.asarray(g, dtype=np.float32), np.asarray(r),
+            rtol=1e-1, atol=1e-1, err_msg=name,
+        )
+
+
+def test_gradients_zero_on_fully_masked_block():
+    """Fully-masked block: the backward's valid-row zeroing must kill every
+    gradient (no NaN from exp(-inf - -inf)), including the flow from the
+    block_sum cotangent. (The loss reads s and w directly — a normalized
+    0/0 division on a fully-masked block is the caller's own hazard and
+    never occurs in the causal/ring folds, whose final sums are >= 1.)"""
+    q, k, v = _inputs(tq=16, tk=16)
+    bias = jnp.full((16, 16), NEG_INF, jnp.float32)
+
+    def f(q, k, v):
+        m, s, w = block_attention(q, k, v, bias)
+        return jnp.sum(w * w) + jnp.sum(s)
+
+    grads = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    for g, name in zip(grads, "qkv"):
+        arr = np.asarray(g)
+        assert np.all(np.isfinite(arr)), name
+        np.testing.assert_array_equal(arr, np.zeros_like(arr), err_msg=name)
+
+
 def test_ring_attention_uses_kernel_equivalently():
     """Full ring attention (sp folding) with the kernel interpreted."""
     from jobset_tpu.parallel.ring_attention import ring_attention
