@@ -35,6 +35,13 @@ def main() -> int:
              "bench: activations fit, and recompute FLOPs aren't credited)",
     )
     parser.add_argument(
+        "--remat-policy", choices=["full", "dots"], default="full",
+        help="with --remat: 'full' (default, matches earlier rounds) "
+             "saves layer boundaries only; 'dots' saves matmul + flash "
+             "attention outputs and recomputes only elementwise work "
+             "(the MFU-friendly operating point)",
+    )
+    parser.add_argument(
         "--profile-dir",
         help="capture a JAX profiler trace of the timed region into this "
              "directory (open with TensorBoard/XProf)",
@@ -56,6 +63,7 @@ def main() -> int:
         d_ff=args.d_ff,
         max_seq_len=args.seq_len,
         remat=args.remat,
+        remat_policy=args.remat_policy,
     )
     result = run_model_bench(
         steps=args.steps,

@@ -213,6 +213,7 @@ def run_model_bench(
         "d_ff": cfg.d_ff,
         "vocab_size": cfg.vocab_size,
         "remat": bool(cfg.remat),
+        "remat_policy": cfg.remat_policy if cfg.remat else None,
         "loss_chunk": cfg.loss_chunk,
         "params_m": round(matmul_param_count(cfg) / 1e6, 1),
         "steps": steps,

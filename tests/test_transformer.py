@@ -107,6 +107,36 @@ def test_sharded_loss_matches_single_device():
     np.testing.assert_allclose(losses["multi"], losses["single"], rtol=2e-4)
 
 
+def test_remat_policies_match_no_remat():
+    """Rematerialization is a memory/compute trade, never a numerics
+    change: per-layer 'full' recompute and the 'dots' policy (save matmul
+    outputs, recompute elementwise) must reproduce the no-remat loss
+    trajectory exactly-ish in f32."""
+    mesh = build_mesh(MESH_CONFIG)
+    batch = make_batch(mesh, 64)
+
+    trajectories = {}
+    for name, overrides in (
+        ("off", {"remat": False}),
+        ("full", {"remat": True, "remat_policy": "full"}),
+        ("dots", {"remat": True, "remat_policy": "dots"}),
+    ):
+        cfg = tiny_config(**overrides)
+        _, losses = run_steps(cfg, mesh, batch, steps=4)
+        trajectories[name] = losses
+
+    np.testing.assert_allclose(
+        trajectories["full"], trajectories["off"], rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        trajectories["dots"], trajectories["off"], rtol=1e-5
+    )
+
+    with pytest.raises(ValueError, match="remat_policy"):
+        cfg = tiny_config(remat=True, remat_policy="bogus")
+        run_steps(cfg, mesh, batch, steps=1)
+
+
 def test_forward_shapes_and_determinism():
     mesh = build_mesh(MESH_CONFIG)
     cfg = tiny_config()
