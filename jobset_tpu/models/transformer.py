@@ -601,13 +601,10 @@ def _stage_fn(stage_params, x, cfg: TransformerConfig):
                         policies.save_only_these_names("flash_attn_out"),
                     ),
                 )
-            elif cfg.remat_policy == "full":
-                fn = jax.checkpoint(fn)
             else:
-                raise ValueError(
-                    f"unknown remat_policy {cfg.remat_policy!r} "
-                    "(expected 'full' or 'dots')"
-                )
+                # "full" (validate() rejects anything else): save layer
+                # boundaries only.
+                fn = jax.checkpoint(fn)
         return fn(layer_p, x)
 
     x, stats = lax.scan(body, x, stage_params)

@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import contextlib
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -49,9 +50,23 @@ from jax.experimental import pallas as pl
 
 NEG_INF = -1.0e30
 
-# f32 MXU/VPU tiles: sublane multiple of 8, lane multiple of 128.
-_TILE_Q = 128
-_TILE_K = 128
+
+def _tile_env(name: str, default: int) -> int:
+    """Import-time tile override (JOBSET_TPU_FLASH_TILE_Q/K): an on-chip
+    tuning knob — larger tiles mean fewer grid steps and longer MXU bursts
+    at the cost of VMEM residency. Values must keep TPU tiling legal
+    (multiples of 128 cover both the f32 and bf16 operand layouts)."""
+    v = int(os.environ.get(name, default))
+    if v <= 0 or v % 128:
+        raise ValueError(f"{name} must be a positive multiple of 128, got {v}")
+    return v
+
+
+# MXU/VPU tiles: sublane multiple of 8 (f32) / 16 (bf16), lane multiple
+# of 128; 128x128 is the safe default proven under the real Mosaic
+# lowering (TPUCHECK.json).
+_TILE_Q = _tile_env("JOBSET_TPU_FLASH_TILE_Q", 128)
+_TILE_K = _tile_env("JOBSET_TPU_FLASH_TILE_K", 128)
 _LANE = 128
 
 _INTERPRET = False
@@ -74,8 +89,6 @@ def force_interpret():
 
 
 def _use_pallas() -> bool:
-    import os
-
     # Evaluated at trace time: set JOBSET_TPU_NO_PALLAS (escape hatch /
     # debugging) before building jitted steps; cached executables keep
     # whichever path they were traced with.
