@@ -76,7 +76,7 @@ def main() -> int:
     if args.decode:
         from jobset_tpu.runtime.model_bench import run_decode_bench
 
-        result["decode"] = run_decode_bench(config=cfg)
+        result["decode"] = run_decode_bench(config=cfg, measure_ttft=True)
         # int8 serving variants (models/quant.py): decode is HBM-bound, so
         # int8 weights target ~2x tokens/s on-chip; the int8 KV cache adds
         # the context-proportional term. Same keys as bench.py's sink so

@@ -234,6 +234,7 @@ def run_decode_bench(
     config: Optional[Any] = None,
     quantized: bool = False,
     quantized_kv: Optional[bool] = None,
+    measure_ttft: bool = False,
 ) -> dict:
     """Serving-path benchmark: greedy KV-cache decode throughput.
 
@@ -241,7 +242,13 @@ def run_decode_bench(
     compile/warm pass) through `models.decode.build_generate` on a
     single-chip serving mesh — the latency-bound regime where per-token
     matmuls are [B, d] x [d, *] and the KV cache is the working set, i.e.
-    the opposite end of the roofline from the training MFU number."""
+    the opposite end of the roofline from the training MFU number.
+
+    `measure_ttft` additionally times a max_new_tokens=1 program — batched
+    prefill + first-token pick (the first token comes from the prefill
+    logits; no cached decode step runs), i.e. time-to-first-token — at the
+    cost of one extra compile, so it is off in the budget-tight in-bench
+    phase and on in the standalone CLI."""
     import jax
 
     from ..models import transformer
@@ -283,6 +290,18 @@ def run_decode_bench(
     _fence(out)
     elapsed = time.perf_counter() - t0
 
+    ttft_ms = None
+    if measure_ttft:
+        first = build_generate(
+            cfg, mesh, 1, quantized=quantized, quantized_kv=quantized_kv
+        )
+        out1 = first(params, prompt)  # compile + warm
+        _fence(out1)
+        t1 = time.perf_counter()
+        out1 = first(params, prompt)
+        _fence(out1)
+        ttft_ms = round(1000 * (time.perf_counter() - t1), 3)
+
     new_tokens = batch * max_new_tokens
     return {
         "phase": "decode",
@@ -296,4 +315,5 @@ def run_decode_bench(
         "params_m": round(matmul_param_count(cfg) / 1e6, 1),
         "decode_tokens_per_sec": round(new_tokens / elapsed, 1),
         "per_token_latency_ms": round(1000 * elapsed / (prompt_len + max_new_tokens), 3),
+        **({"ttft_ms": ttft_ms} if ttft_ms is not None else {}),
     }
