@@ -1,0 +1,63 @@
+"""Bench-harness contract tests (CPU): run_model_bench / run_decode_bench
+return the keys bench.py banks and the driver's BENCH artifact records —
+a drifted key here silently turns a captured round result into nulls, so
+the contract is pinned where the suite can see it.
+"""
+
+import jax.numpy as jnp
+
+from jobset_tpu.models.transformer import TransformerConfig
+from jobset_tpu.runtime.model_bench import run_decode_bench, run_model_bench
+
+
+def tiny_config(**overrides):
+    defaults = dict(
+        vocab_size=64, d_model=32, n_heads=4, d_ff=64, n_layers=2,
+        max_seq_len=32, dtype=jnp.float32,
+    )
+    defaults.update(overrides)
+    return TransformerConfig(**defaults)
+
+
+def test_run_model_bench_contract():
+    r = run_model_bench(
+        steps=2, warmup=1, batch=2, seq_len=32,
+        config=tiny_config(remat=True, remat_policy="dots"),
+    )
+    # The exact keys bench.py's sweep/large_model phases copy out.
+    for key in (
+        "batch", "seq_len", "d_model", "n_layers", "d_ff", "params_m",
+        "step_time_ms", "tokens_per_sec", "mfu_pct", "remat",
+        "remat_policy", "loss_chunk", "achieved_tflops", "final_loss",
+    ):
+        assert key in r, key
+    assert r["tokens_per_sec"] > 0
+    assert r["remat"] is True and r["remat_policy"] == "dots"
+    assert jnp.isfinite(r["final_loss"])
+
+
+def test_run_model_bench_remat_policy_none_when_off():
+    r = run_model_bench(
+        steps=1, warmup=1, batch=2, seq_len=32, config=tiny_config(remat=False)
+    )
+    assert r["remat"] is False and r["remat_policy"] is None
+
+
+def test_run_decode_bench_contract_with_ttft():
+    cfg = tiny_config()
+    r = run_decode_bench(
+        batch=2, prompt_len=8, max_new_tokens=4, config=cfg,
+        measure_ttft=True,
+    )
+    assert r["decode_tokens_per_sec"] > 0
+    # ttft_ms presence + positivity is the contract; wall-clock relations
+    # (TTFT vs a full decode pass) are hardware truths, not assertable on a
+    # loaded CPU CI box.
+    assert r["ttft_ms"] > 0
+    assert r["quantized"] is False
+
+    r8 = run_decode_bench(
+        batch=2, prompt_len=8, max_new_tokens=4, config=cfg, quantized=True
+    )
+    assert r8["quantized"] is True and r8["quantized_kv"] is True
+    assert "ttft_ms" not in r8  # off by default: costs an extra compile
