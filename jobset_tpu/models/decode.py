@@ -326,28 +326,46 @@ def _pick_token(logits, key, pos, temperature: float, top_k: int):
     gather. Each tp shard draws independent noise for its vocab slice
     (key folded with the decode position and the shard index).
 
-    top_k > 0 restricts sampling to the k globally-largest logits, computed
-    exactly: every shard's local top-k values are all-gathered over tp
-    (k*tp floats — trivial), the global k-th value is the threshold, and
-    sub-threshold logits are masked before the Gumbel draw.
-
-    Tie semantics (documented behavior): the mask keeps every logit equal
-    to the k-th threshold value, so when ties straddle the threshold
-    (plausible with bf16-cast params) slightly more than top_k candidates
-    survive — i.e. this is "top-k by value", not "exactly k by index".
+    top_k > 0 restricts sampling to EXACTLY the k globally-largest logits,
+    ties broken by lowest vocab index (the conventional "first k" order):
+    every shard's local top-k (values, global indices) are all-gathered
+    over tp (k*tp floats+ints — trivial), a stable value-descending sort
+    of the gathered candidates picks the k winners (the gathered order is
+    global-index-ascending among equal values, both within a shard —
+    lax.top_k puts lower indices first on ties — and across shards, so
+    stability IS the index tie-break), and the mask keeps a tied-at-
+    threshold logit only up to the last selected index. With bf16-cast
+    params producing tied logits this still admits exactly k candidates.
     """
     if temperature <= 0.0:
         return _global_argmax(logits)
     z = logits.astype(jnp.float32) / temperature
     if top_k > 0:
-        local_vals = lax.top_k(logits, min(top_k, logits.shape[-1]))[0]
+        v_local = logits.shape[-1]
+        k_local = min(top_k, v_local)
+        local_vals, local_idx = lax.top_k(logits, k_local)
+        gidx = lax.axis_index("tp") * v_local + local_idx
         all_vals = lax.all_gather(
             local_vals, "tp", axis=-1, tiled=True
         )  # [B, tp*k]
+        all_idx = lax.all_gather(gidx, "tp", axis=-1, tiled=True)
         # Oversized top_k degrades to full-vocab sampling (clamped on both
         # the local and the gathered pick).
-        thresh = lax.top_k(all_vals, min(top_k, all_vals.shape[-1]))[0][..., -1:]
-        z = jnp.where(logits >= thresh, z, NEG_INF)
+        k_glob = min(top_k, all_vals.shape[-1])
+        order = jnp.argsort(-all_vals, axis=-1, stable=True)[..., :k_glob]
+        sel_vals = jnp.take_along_axis(all_vals, order, axis=-1)
+        sel_idx = jnp.take_along_axis(all_idx, order, axis=-1)
+        thresh = sel_vals[..., -1:]
+        # Highest selected index among threshold-valued winners: tied
+        # logits above it did not make the cut.
+        idx_cut = jnp.max(
+            jnp.where(sel_vals == thresh, sel_idx, -1), axis=-1, keepdims=True
+        )
+        my_gidx = lax.axis_index("tp") * v_local + jnp.arange(v_local)
+        keep = (logits > thresh) | (
+            (logits == thresh) & (my_gidx[None, :] <= idx_cut)
+        )
+        z = jnp.where(keep, z, NEG_INF)
     step_key = jax.random.fold_in(key, pos)
     # Decorrelate noise across BOTH sharded axes a batch row can live on:
     # tp shards hold different vocab slices of the same rows (distinct

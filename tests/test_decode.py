@@ -5,6 +5,7 @@ import pytest
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
 from jobset_tpu.models import TransformerConfig, init_params
 from jobset_tpu.models.decode import build_generate
@@ -138,6 +139,40 @@ def test_topk1_sampling_equals_greedy_on_sharded_vocab():
         params, prompt, jax.random.key(7)
     )
     np.testing.assert_array_equal(np.asarray(sampled), np.asarray(greedy))
+
+
+def test_topk_keeps_exactly_k_on_ties():
+    """Tied logits straddling the k-th value must NOT widen the candidate
+    set: exact-k semantics break ties by lowest vocab index, so with
+    logits [9, 9, 9, 9, ...] (all equal) and top_k=2 only tokens 0 and 1
+    are ever sampled, across shards and many draws."""
+    from jobset_tpu.models.decode import _pick_token
+
+    mc = MeshConfig(tp=2)
+    mesh = build_mesh(mc, jax.devices()[:2])
+    v_global = 16
+    logits = jnp.full((3, v_global), 9.0, jnp.float32)  # every logit tied
+
+    # key is a jitted ARGUMENT (not a closure constant) so the program
+    # compiles once across the 40 draws.
+    run = jax.jit(
+        jax.shard_map(
+            lambda lg, key: _pick_token(lg, key, 4, temperature=1.3, top_k=2),
+            mesh=mesh,
+            in_specs=(P(None, "tp"), P()),
+            out_specs=P(None),
+            # the psum'd argmax is tp-invariant but the checker can't
+            # prove replication over the unused axes statically
+            check_vma=False,
+        )
+    )
+
+    seen = set()
+    for seed in range(40):
+        toks = np.asarray(run(logits, jax.random.key(seed)))
+        seen.update(toks.ravel().tolist())
+    assert seen <= {0, 1}, seen  # exact-k: only the two lowest indices
+    assert seen == {0, 1}, seen  # and both genuinely reachable
 
 
 def test_sampling_frequencies_track_softmax():
