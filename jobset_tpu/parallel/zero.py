@@ -51,8 +51,11 @@ def zero1_opt_shardings(
 
     Walks the optimizer state; any subtree whose structure matches the
     params pytree gets per-leaf shardings derived from the parameter
-    specs widened onto `axis`; everything else (step counters, empty
-    states) stays replicated.
+    specs widened onto `axis` — but only for leaves whose SHAPE matches
+    the corresponding parameter (Adam m/v, momentum traces). Leaves that
+    merely share the tree structure with different shapes (adafactor's
+    factored row/col accumulators, already sub-linear in parameter size)
+    and everything else (step counters, empty states) stay replicated.
     """
     dp = axis_size(mesh, axis)
     pdef = jax.tree.structure(params)
@@ -71,7 +74,14 @@ def zero1_opt_shardings(
 
     def handle(node):
         if is_param_subtree(node):
-            return param_shardings
+            return jax.tree.map(
+                lambda leaf, p, sh: sh
+                if getattr(leaf, "shape", None) == p.shape
+                else replicated,
+                node,
+                params,
+                param_shardings,
+            )
         return jax.tree.map(lambda _: replicated, node)
 
     return jax.tree.map(handle, opt_state, is_leaf=is_param_subtree)

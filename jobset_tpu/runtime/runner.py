@@ -64,6 +64,38 @@ def make_learning_rate(workload: dict, default_lr: float):
     return lr
 
 
+def make_optimizer(workload: dict, default: str, default_lr: float):
+    """Optimizer from workload knobs: `optimizer`
+    ("adamw" | "adam" | "sgd" | "adafactor"), `weight_decay` (adamw),
+    `momentum` (sgd) — composing with the learning-rate schedule knobs.
+
+    ZeRO-1 composes with any of them: `zero1_opt_shardings` walks the
+    state generically, dp-sharding every param-shaped subtree (adafactor's
+    factored accumulators have their own shapes and simply stay
+    replicated — they are already sub-linear in parameter size)."""
+    import optax
+
+    lr = make_learning_rate(workload, default_lr)
+    name = workload.get("optimizer", default)
+    if name == "adamw":
+        return optax.adamw(
+            lr, weight_decay=float(workload.get("weight_decay", 1e-4))
+        )
+    if name == "adam":
+        return optax.adam(lr)
+    if name == "sgd":
+        # None (not 0.0) when the knob is absent: momentum=0.0 would
+        # allocate a param-sized trace that is multiplied by zero forever.
+        m = workload.get("momentum")
+        return optax.sgd(lr, momentum=float(m) if m is not None else None)
+    if name == "adafactor":
+        return optax.adafactor(learning_rate=lr)
+    raise ValueError(
+        f"unknown optimizer {name!r} "
+        "(expected adamw | adam | sgd | adafactor)"
+    )
+
+
 def place_on_mesh(tree, mesh):
     """Ensure every leaf lives on `mesh` (replicated unless already mesh-
     placed); checkpoint restore targets the template's shardings, so state
@@ -313,7 +345,7 @@ def _setup_mlp(workload: dict, mesh):
 
     cfg = mlp.MLPConfig(**workload.get("config", {}))
     params = place_on_mesh(mlp.init_params(jax.random.key(0), cfg), mesh)
-    optimizer = optax.adam(make_learning_rate(workload, 1e-2))
+    optimizer = make_optimizer(workload, "adam", 1e-2)
     train_step = mlp.build_train_step(cfg, mesh, optimizer)
 
     batch_size = int(workload.get("batch_size", 32))
@@ -343,7 +375,7 @@ def _setup_cnn(workload: dict, mesh):
         for k, v in workload.get("config", {}).items()
     })
     params = place_on_mesh(cnn.init_params(jax.random.key(0), cfg), mesh)
-    optimizer = optax.adam(make_learning_rate(workload, 1e-3))
+    optimizer = make_optimizer(workload, "adam", 1e-3)
     train_step = cnn.build_train_step(cfg, mesh, optimizer)
 
     batch_size = int(workload.get("batch_size", 8))
@@ -378,7 +410,7 @@ def _setup_lm(workload: dict, mesh):
     cfg.validate(mesh_cfg)
 
     params = init_params(jax.random.key(0), cfg, mesh)
-    optimizer = optax.adamw(make_learning_rate(workload, 1e-3))
+    optimizer = make_optimizer(workload, "adamw", 1e-3)
     accum = int(workload.get("accum_steps", 1))
     opt_state = None
     if workload.get("zero1"):

@@ -124,6 +124,45 @@ def test_lm_workload_with_accum_and_cosine_schedule():
     assert js.status.terminal_state == keys.JOBSET_COMPLETED
 
 
+def test_optimizer_knob_selects_optax_optimizer():
+    """The `optimizer` workload knob routes through every family; unknown
+    names are rejected at construction with the accepted list."""
+    import pytest
+
+    from jobset_tpu.runtime.runner import make_optimizer
+
+    for name in ("adamw", "adam", "sgd", "adafactor"):
+        opt = make_optimizer({"optimizer": name, "steps": 2}, "adamw", 1e-3)
+        assert hasattr(opt, "init") and hasattr(opt, "update"), name
+    with pytest.raises(ValueError, match="adafactor"):
+        make_optimizer({"optimizer": "lion"}, "adamw", 1e-3)
+
+
+def test_lm_workload_with_adafactor_and_zero1():
+    """adafactor via the knob composes with ZeRO-1 state sharding (its
+    factored accumulators are not param-shaped and stay replicated)."""
+    cluster, js, runner = build(
+        {
+            "kind": "lm",
+            "steps": 3,
+            "batch_size": 4,
+            "seq_len": 16,
+            "optimizer": "adafactor",
+            "zero1": True,
+            "config": {
+                "vocab_size": 64,
+                "d_model": 32,
+                "n_heads": 4,
+                "d_ff": 64,
+                "n_layers": 2,
+                "remat": False,
+            },
+        }
+    )
+    runner.run_pending()
+    assert js.status.terminal_state == keys.JOBSET_COMPLETED
+
+
 def test_workload_runs_once_per_incarnation():
     cluster, js, runner = build({"kind": "mlp", "steps": 3})
     assert runner.run_pending() == ["train"]
