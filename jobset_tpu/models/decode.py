@@ -39,35 +39,42 @@ from .transformer import (
 NEG_INF = -1.0e30
 
 
-def _moe_mlp_topk_decode(p, xn, cfg: TransformerConfig):
-    """Token-choice top-k MoE for the serving path (ep == 1).
+def _topk_gates(p, xn, cfg: TransformerConfig):
+    """Shared router stanza for both top-k serving formulations: softmax
+    gates in f32 (routing stability, same as training), top-k pick,
+    renormalized weights. Returns (top_w, top_i), each [B, T, k]."""
+    gates = jax.nn.softmax(
+        jnp.einsum(
+            "btd,de->bte", xn.astype(jnp.float32), p["wg"].astype(jnp.float32)
+        ),
+        axis=-1,
+    )
+    top_w, top_i = lax.top_k(gates, cfg.moe_top_k)  # [B, T, k]
+    top_w = top_w / jnp.maximum(jnp.sum(top_w, -1, keepdims=True), 1e-9)
+    return top_w, top_i
 
-    Dense-all-experts formulation: running every expert on every token and
-    weighting by the top-k gates is a single MXU-friendly einsum chain — no
-    capacity buffers, no all_to_all (there is no ep axis to ship over), and
-    no token drops. This is the no-contention limit of the training path
+
+def _moe_mlp_topk_decode(p, xn, cfg: TransformerConfig):
+    """Token-choice top-k MoE, dense-all-experts formulation (ep == 1).
+
+    Running every expert on every token and weighting by the top-k gates
+    is a single MXU-friendly einsum chain — no capacity buffers, no
+    all_to_all (there is no ep axis to ship over), and no token drops.
+    This is the no-contention limit of the training path
     (`transformer._moe_mlp_routed`, reference: none — the reference has no
     inference surface): identical per-token math whenever training capacity
     admits every choice, which a serving batch trivially satisfies.
     Expert FFN weights stay column/row split over tp with one psum, exactly
     like the dense path.
 
-    Cost note: exactness is bought with E/k times the routed FFN FLOPs per
-    token. That is negligible for the single-token decode step (bandwidth
-    -bound) and acceptable for prefill at the small expert counts served
-    here; a large-E serving deployment would want a sort-tokens-by-expert
-    sparse prefill instead (future work, not a correctness gap).
+    Cost note: exactness here costs E/k times the routed FFN FLOPs per
+    token — negligible for the single-token decode step, whose latency is
+    set by streaming ALL expert weights from HBM either way. Prefill,
+    which is compute-bound, instead uses the sorted ragged formulation
+    (`_moe_mlp_topk_sorted`) at activated-FLOPs cost.
     """
     compute = cfg.dtype
-    k = cfg.moe_top_k
-    gates = jax.nn.softmax(
-        jnp.einsum(
-            "btd,de->bte", xn.astype(jnp.float32), p["wg"].astype(jnp.float32)
-        ),
-        axis=-1,
-    )  # [B, T, E] f32 for routing stability (same as training)
-    top_w, top_i = lax.top_k(gates, k)  # [B, T, k]
-    top_w = top_w / jnp.maximum(jnp.sum(top_w, -1, keepdims=True), 1e-9)
+    top_w, top_i = _topk_gates(p, xn, cfg)
     weights = jnp.sum(
         jax.nn.one_hot(top_i, cfg.n_experts, dtype=jnp.float32)
         * top_w[..., None],
@@ -83,9 +90,62 @@ def _moe_mlp_topk_decode(p, xn, cfg: TransformerConfig):
     return lax.psum(out, "tp")
 
 
+def _moe_mlp_topk_sorted(p, xn, cfg: TransformerConfig):
+    """Token-choice top-k MoE for prefill: exact sorted ragged dispatch.
+
+    The prefill pass is compute-bound, so the dense-all-experts
+    formulation's E/k FLOPs overhead is real money there. This path pays
+    only activated FLOPs with no drops and no capacity buffers: replicate
+    each token's k (token, expert) slots, sort the slots by expert, run
+    the expert FFNs as two grouped matmuls over the contiguous per-expert
+    segments (`lax.ragged_dot` — the TPU-native grouped-GEMM primitive),
+    and scatter-add the gate-weighted results back per token. Identical
+    per-token math to the dense formulation (differential-tested); expert
+    FFN weights stay column/row split over tp with one psum.
+    """
+    compute = cfg.dtype
+    k = cfg.moe_top_k
+    b, t, d = xn.shape
+    n = b * t
+    top_w, top_i = _topk_gates(p, xn, cfg)
+
+    expert_of = top_i.reshape(n * k)  # slot order: token-major
+    tok_of = jnp.repeat(jnp.arange(n), k)
+    order = jnp.argsort(expert_of)  # contiguous per-expert segments
+    sorted_tok = tok_of[order]
+    group_sizes = jnp.bincount(
+        expert_of, length=cfg.n_experts
+    ).astype(jnp.int32)
+
+    xs = xn.reshape(n, d)[sorted_tok].astype(compute)  # [n*k, d]
+    h = jax.nn.silu(
+        lax.ragged_dot(
+            xs, weight_cast(p["we1"], compute), group_sizes,
+            preferred_element_type=compute,
+        )
+    )  # [n*k, f_local]
+    y = lax.ragged_dot(
+        h, weight_cast(p["we2"], compute), group_sizes,
+        preferred_element_type=compute,
+    )  # [n*k, d]
+    # Combine in f32: a bf16 scatter would round each of the k expert
+    # contributions per add, where the dense chain's combining einsum
+    # accumulates over E in f32 on the MXU — near-tied logits could flip
+    # tokens between the two formulations.
+    w_sorted = top_w.reshape(n * k)[order]  # f32 from the router
+    out = (
+        jnp.zeros((n, d), jnp.float32)
+        .at[sorted_tok]
+        .add(y.astype(jnp.float32) * w_sorted[:, None])
+    )
+    return lax.psum(out.reshape(b, t, d).astype(compute), "tp")
+
+
 def _decode_mlp(p, xn, cfg: TransformerConfig):
     """Feed-forward dispatch for serving: dense, soft-dispatch MoE, top-k
-    routed MoE (dense-all-experts formulation), or expert-choice.
+    routed MoE (sorted ragged dispatch for prefill, dense-all-experts for
+    the single-token decode step — see the T > 1 branch below), or
+    expert-choice.
 
     Expert-choice routing is not causal — at train time an expert's top-C
     choice over a token set lets earlier tokens' compute depend on later
@@ -98,6 +158,12 @@ def _decode_mlp(p, xn, cfg: TransformerConfig):
     if "wg" in p and cfg.moe_router == "expert":
         return _moe_mlp(p, xn, cfg)
     if "wg" in p and cfg.moe_top_k > 0:
+        # Prefill (T > 1, compute-bound): sorted ragged dispatch at
+        # activated FLOPs. Single-token decode (bandwidth-bound): the
+        # dense-all-experts chain — all expert weights stream from HBM
+        # either way, and it avoids the sort/scatter overhead per step.
+        if xn.shape[1] > 1:
+            return _moe_mlp_topk_sorted(p, xn, cfg)
         return _moe_mlp_topk_decode(p, xn, cfg)
     if "wg" in p:
         return _moe_mlp(p, xn, cfg)

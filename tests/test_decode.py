@@ -95,6 +95,58 @@ def test_moe_greedy_decode_matches_full_forward(mesh_cfg, top_k):
     np.testing.assert_array_equal(got, np.asarray(seq))
 
 
+def test_sorted_ragged_prefill_matches_dense_formulation():
+    """The sorted ragged top-k dispatch (prefill) and the dense-all-experts
+    chain (decode step) are two formulations of the same per-token math —
+    they must agree on identical inputs, including when tokens concentrate
+    onto few experts (ragged group sizes far from uniform)."""
+    from jobset_tpu.models.decode import (
+        _moe_mlp_topk_decode,
+        _moe_mlp_topk_sorted,
+    )
+
+    import dataclasses
+
+    mesh = build_mesh(MeshConfig(), jax.devices()[:1])
+    rng = np.random.default_rng(3)
+    # bf16 is the real serving dtype — the tolerance covers the two
+    # formulations' different (both f32-accumulated) contraction orders.
+    for dtype, tol in ((jnp.float32, 2e-5), (jnp.bfloat16, 2e-2)):
+        cfg = dataclasses.replace(_moe_cfg(2), dtype=dtype)
+        params = init_params(jax.random.key(0), cfg, mesh)
+        layer0 = jax.tree.map(lambda a: a[0][0], params["layers"])
+
+        for case, x in {
+            "spread": rng.standard_normal((2, 9, cfg.d_model)),
+            # Near-identical tokens: the router sends everything to the
+            # same k experts, making one ragged group hold every slot.
+            "concentrated": np.broadcast_to(
+                rng.standard_normal((1, 1, cfg.d_model)), (2, 9, cfg.d_model)
+            ) + 1e-3 * rng.standard_normal((2, 9, cfg.d_model)),
+        }.items():
+            xn = jnp.asarray(x, jnp.float32)
+
+            def run(fn, xn):
+                return jax.jit(
+                    jax.shard_map(
+                        lambda v: fn(layer0, v, cfg),
+                        mesh=mesh,
+                        in_specs=P(),
+                        out_specs=P(),
+                        check_vma=False,
+                    )
+                )(xn)
+
+            dense = run(_moe_mlp_topk_decode, xn)
+            ragged = run(_moe_mlp_topk_sorted, xn)
+            np.testing.assert_allclose(
+                np.asarray(ragged, np.float32),
+                np.asarray(dense, np.float32),
+                rtol=tol, atol=tol,
+                err_msg=f"{case}/{dtype.__name__}",
+            )
+
+
 def test_topk_equals_soft_dispatch_when_k_is_all_experts():
     """k = n_experts: renormalized top-k weights are exactly the softmax
     gates, so the routed decode must reproduce the soft-dispatch decode."""
