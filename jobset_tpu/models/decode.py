@@ -103,40 +103,15 @@ def _moe_mlp_topk_sorted(p, xn, cfg: TransformerConfig):
     per-token math to the dense formulation (differential-tested); expert
     FFN weights stay column/row split over tp with one psum.
     """
+    from .transformer import sorted_ragged_expert_ffn
+
     compute = cfg.dtype
     k = cfg.moe_top_k
     b, t, d = xn.shape
     n = b * t
     top_w, top_i = _topk_gates(p, xn, cfg)
-
-    expert_of = top_i.reshape(n * k)  # slot order: token-major
-    tok_of = jnp.repeat(jnp.arange(n), k)
-    order = jnp.argsort(expert_of)  # contiguous per-expert segments
-    sorted_tok = tok_of[order]
-    group_sizes = jnp.bincount(
-        expert_of, length=cfg.n_experts
-    ).astype(jnp.int32)
-
-    xs = xn.reshape(n, d)[sorted_tok].astype(compute)  # [n*k, d]
-    h = jax.nn.silu(
-        lax.ragged_dot(
-            xs, weight_cast(p["we1"], compute), group_sizes,
-            preferred_element_type=compute,
-        )
-    )  # [n*k, f_local]
-    y = lax.ragged_dot(
-        h, weight_cast(p["we2"], compute), group_sizes,
-        preferred_element_type=compute,
-    )  # [n*k, d]
-    # Combine in f32: a bf16 scatter would round each of the k expert
-    # contributions per add, where the dense chain's combining einsum
-    # accumulates over E in f32 on the MXU — near-tied logits could flip
-    # tokens between the two formulations.
-    w_sorted = top_w.reshape(n * k)[order]  # f32 from the router
-    out = (
-        jnp.zeros((n, d), jnp.float32)
-        .at[sorted_tok]
-        .add(y.astype(jnp.float32) * w_sorted[:, None])
+    out, _ = sorted_ragged_expert_ffn(
+        p, xn.reshape(n, d), top_w.reshape(n, k), top_i.reshape(n, k), cfg
     )
     return lax.psum(out.reshape(b, t, d).astype(compute), "tp")
 

@@ -13,11 +13,13 @@ program say exactly which axis each reduction rides:
   (`parallel.ring_attention`) with K/V blocks rotating via `ppermute`.
 * **pp** — layer stages marched by the GPipe transform
   (`parallel.pipeline`); backward schedule comes from autodiff.
-* **ep** — MoE expert shards. Three dispatch modes: dense (soft) dispatch
+* **ep** — MoE expert shards. Four dispatch modes: dense (soft) dispatch
   (`moe_top_k=0`): every rank runs its local experts on all tokens,
   gate-weighted partials `psum('ep')`-ed; token-routed (`moe_top_k>0`):
   top-k capacity routing with `all_to_all` slot exchange over the ep axis
-  (`_moe_mlp_routed`) — the sparse ICI-native path; expert-choice
+  (`_moe_mlp_routed`) — the sparse ICI-native path; dropless token-routed
+  (`moe_dispatch="dropless"`, ep=1): exact sorted ragged grouped matmuls,
+  no capacity, no drops (`_moe_mlp_dropless`); expert-choice
   (`moe_router="expert"`): each expert takes its top-C tokens, perfectly
   balanced, no aux loss (`_moe_mlp_expert_choice`).
 * **dp** — pure data parallelism; gradients are `psum`-ed over (dp, sp) and
@@ -75,6 +77,14 @@ class TransformerConfig:
     # all_to_all dispatch over the ep axis (the ICI-native sparse path).
     moe_top_k: int = 0
     moe_capacity_factor: float = 1.25
+    # Token-choice dispatch formulation:
+    #   "capacity" — static per-expert capacity + all_to_all over ep
+    #                (switch-style; overflow drops; the distributed path);
+    #   "dropless" — exact sorted ragged grouped matmuls (MegaBlocks
+    #                -style, lax.ragged_dot): no capacity, no drops, paying
+    #                only activated FLOPs. Requires ep == 1 (the ragged
+    #                segments have no static all_to_all shape).
+    moe_dispatch: str = "capacity"
     # Router family for n_experts > 0: "token" = token-choice (dense soft
     # dispatch at moe_top_k=0, switch-style top-k routing otherwise);
     # "expert" = expert-choice (each expert takes its top-C tokens,
@@ -162,6 +172,25 @@ class TransformerConfig:
             raise ValueError("moe_router='expert' requires n_experts > 0")
         if self.moe_top_k and not self.n_experts:
             raise ValueError("moe_top_k requires n_experts > 0")
+        if self.moe_dispatch not in ("capacity", "dropless"):
+            raise ValueError(
+                f"unknown moe_dispatch {self.moe_dispatch!r} "
+                "(expected 'capacity' or 'dropless')"
+            )
+        if self.moe_dispatch == "dropless" and mc.ep > 1:
+            raise ValueError(
+                "moe_dispatch='dropless' requires ep == 1: ragged expert "
+                "segments have no static all_to_all shape to ship over an "
+                "expert axis (use the capacity path for ep > 1)"
+            )
+        if self.moe_dispatch == "dropless" and (
+            self.moe_top_k == 0 or self.moe_router == "expert"
+        ):
+            raise ValueError(
+                "moe_dispatch='dropless' applies to token-choice top-k "
+                "routing only (set moe_top_k > 0 and moe_router='token'); "
+                "it would be silently ignored here"
+            )
         if self.moe_top_k > self.n_experts > 0:
             raise ValueError(
                 f"moe_top_k {self.moe_top_k} exceeds n_experts {self.n_experts}"
@@ -459,6 +488,85 @@ def _moe_mlp_routed(p, xn, cfg):
     )
 
 
+def _moe_mlp_dropless(p, xn, cfg):
+    """Dropless token-choice top-k routing (MegaBlocks-style) for ep == 1.
+
+    Exact routed math with NO capacity buffers and NO token drops: each
+    token's k (token, expert) slots are sorted by expert and the expert
+    FFNs run as two grouped matmuls over the contiguous per-expert
+    segments (`lax.ragged_dot` — the TPU grouped-GEMM primitive), paying
+    only activated FLOPs. Differentiable end-to-end (sort/gather/ragged
+    matmuls/scatter-add all carry VJPs); the balancing-aux statistics are
+    the same [2, E] (choice counts, gate-prob sums) contract as the
+    capacity path, so the loss-side pooling is identical. Validation
+    restricts this path to ep == 1 — ragged segments have no static
+    all_to_all shape to ship over an expert axis; the capacity path is
+    the distributed formulation.
+
+    Serving note: this is the training-side twin of the serving prefill's
+    `decode._moe_mlp_topk_sorted`; a model trained dropless decodes
+    exactly (all serving top-k formulations are exact).
+    """
+    k = cfg.moe_top_k
+    compute = cfg.dtype
+    b, t, d = xn.shape
+    chunk, gates, n_chunk = _route_prologue(p, xn, cfg)  # ep==1: all tokens
+    top_w, top_i = lax.top_k(gates, k)  # [n, k]
+    top_w = top_w / jnp.maximum(jnp.sum(top_w, -1, keepdims=True), 1e-9)
+
+    out, group_sizes = sorted_ragged_expert_ffn(p, chunk, top_w, top_i, cfg)
+    stats = jnp.stack(
+        [group_sizes.astype(jnp.float32), jnp.sum(gates, axis=0)]
+    )  # [2, E]: choice counts, gate-prob sums — same as _moe_mlp_routed
+    out = lax.psum(out.astype(compute), "tp")
+    return out.reshape(b, t, d), stats
+
+
+def sorted_ragged_expert_ffn(p, x_flat, top_w, top_i, cfg):
+    """THE sorted ragged grouped-matmul core, shared by dropless training
+    (`_moe_mlp_dropless`) and serving prefill (`decode._moe_mlp_topk_sorted`)
+    so the exact train/serve parity both paths promise cannot drift.
+
+    x_flat [n, d] tokens, top_w/top_i [n, k] renormalized gate picks.
+    Replicates each token's k (token, expert) slots, sorts them by expert,
+    runs the expert FFNs as two grouped matmuls over the contiguous
+    per-expert segments (`lax.ragged_dot`), and combines gate-weighted
+    results with an f32 scatter-add (k contributions per token accumulate
+    without per-add bf16 rounding). Returns (out [n, d] f32 — caller
+    psums over tp — and group_sizes [E] int32, the per-expert choice
+    counts)."""
+    num_experts, k = cfg.n_experts, cfg.moe_top_k
+    compute = cfg.dtype
+    n, d = x_flat.shape
+
+    expert_of = top_i.reshape(n * k)  # slot order: token-major
+    tok_of = jnp.repeat(jnp.arange(n), k)
+    order = jnp.argsort(expert_of)  # contiguous per-expert segments
+    sorted_tok = tok_of[order]
+    group_sizes = jnp.bincount(expert_of, length=num_experts).astype(
+        jnp.int32
+    )
+
+    xs = x_flat[sorted_tok].astype(compute)  # [n*k, d]
+    h = jax.nn.silu(
+        lax.ragged_dot(
+            xs, weight_cast(p["we1"], compute), group_sizes,
+            preferred_element_type=compute,
+        )
+    )
+    y = lax.ragged_dot(
+        h, weight_cast(p["we2"], compute), group_sizes,
+        preferred_element_type=compute,
+    )
+    w_sorted = top_w.reshape(n * k)[order]
+    out = (
+        jnp.zeros((n, d), jnp.float32)
+        .at[sorted_tok]
+        .add(y.astype(jnp.float32) * w_sorted[:, None])
+    )
+    return out, group_sizes
+
+
 def _route_prologue(p, xn, cfg):
     """Shared router head: split the replicated token set into this ep
     rank's chunk and compute its f32 gate distribution. Returns
@@ -570,7 +678,10 @@ def _layer(p, x, cfg: TransformerConfig, t_local: int):
     if "wg" in p and cfg.moe_router == "expert":
         out, stats = _moe_mlp_expert_choice(p, xn, cfg)
     elif "wg" in p and cfg.moe_top_k > 0:
-        out, stats = _moe_mlp_routed(p, xn, cfg)
+        if cfg.moe_dispatch == "dropless":
+            out, stats = _moe_mlp_dropless(p, xn, cfg)
+        else:
+            out, stats = _moe_mlp_routed(p, xn, cfg)
     elif "wg" in p:
         out = _moe_mlp(p, xn, cfg)
     else:

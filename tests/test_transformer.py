@@ -258,6 +258,45 @@ def test_routed_moe_matches_single_device():
     np.testing.assert_allclose(losses["multi"], losses["single"], rtol=2e-4)
 
 
+def test_dropless_moe_trains_and_matches_unbound_capacity():
+    """moe_dispatch='dropless' (sorted ragged grouped matmuls) is exact
+    top-k routing; with a capacity factor large enough that the capacity
+    path drops nothing, the two dispatch formulations are the same math —
+    identical loss trajectories on an ep=1 mesh."""
+    mc = MeshConfig(dp=1, pp=2, ep=1, sp=2, tp=2)
+    mesh = build_mesh(mc)
+    batch = make_batch(mesh, 64)
+
+    losses = {}
+    for name, overrides in (
+        # capacity >= k*n/E admits every choice: no drops, exact.
+        ("capacity", {"moe_capacity_factor": 100.0}),
+        ("dropless", {"moe_dispatch": "dropless"}),
+    ):
+        cfg = tiny_config(
+            n_experts=4, d_ff_expert=32, moe_top_k=2, remat=False,
+            **overrides,
+        )
+        cfg.validate(mc)
+        _, losses[name] = run_steps(cfg, mesh, batch, steps=4)
+
+    assert all(np.isfinite(losses["dropless"]))
+    assert losses["dropless"][-1] < losses["dropless"][0]
+    np.testing.assert_allclose(
+        losses["dropless"], losses["capacity"], rtol=1e-4
+    )
+
+
+def test_dropless_moe_validation_rejects_ep():
+    cfg = tiny_config(
+        n_experts=4, d_ff_expert=32, moe_top_k=2, moe_dispatch="dropless"
+    )
+    with pytest.raises(ValueError, match="dropless"):
+        cfg.validate(MeshConfig(ep=2))
+    with pytest.raises(ValueError, match="moe_dispatch"):
+        tiny_config(moe_dispatch="bogus").validate(MeshConfig())
+
+
 def test_moe_aux_loss_balances_expert_usage():
     """The aux term is minimized at uniform routing: a uniform gate
     distribution must score lower than a collapsed one."""
