@@ -30,6 +30,7 @@ from .transformer import (
     _embed_tokens,
     _moe_mlp,
     param_specs,
+    renormalized_topk,
     repeat_kv,
     rms_norm,
     rotary,
@@ -49,9 +50,7 @@ def _topk_gates(p, xn, cfg: TransformerConfig):
         ),
         axis=-1,
     )
-    top_w, top_i = lax.top_k(gates, cfg.moe_top_k)  # [B, T, k]
-    top_w = top_w / jnp.maximum(jnp.sum(top_w, -1, keepdims=True), 1e-9)
-    return top_w, top_i
+    return renormalized_topk(gates, cfg.moe_top_k)  # each [B, T, k]
 
 
 def _moe_mlp_topk_decode(p, xn, cfg: TransformerConfig):

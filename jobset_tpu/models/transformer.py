@@ -443,8 +443,7 @@ def _moe_mlp_routed(p, xn, cfg):
     num_experts, k = cfg.n_experts, cfg.moe_top_k
     b, t, d = xn.shape
     chunk, gates, n_chunk = _route_prologue(p, xn, cfg)
-    top_w, top_i = lax.top_k(gates, k)  # [n_chunk, k]
-    top_w = top_w / jnp.maximum(jnp.sum(top_w, -1, keepdims=True), 1e-9)
+    top_w, top_i = renormalized_topk(gates, k)  # [n_chunk, k]
 
     # Per-layer balancing statistics for the GShard aux loss (E*sum f_e*P_e):
     # raw per-expert choice counts and gate-probability sums over this
@@ -511,8 +510,7 @@ def _moe_mlp_dropless(p, xn, cfg):
     compute = cfg.dtype
     b, t, d = xn.shape
     chunk, gates, n_chunk = _route_prologue(p, xn, cfg)  # ep==1: all tokens
-    top_w, top_i = lax.top_k(gates, k)  # [n, k]
-    top_w = top_w / jnp.maximum(jnp.sum(top_w, -1, keepdims=True), 1e-9)
+    top_w, top_i = renormalized_topk(gates, k)  # [n, k]
 
     out, group_sizes = sorted_ragged_expert_ffn(p, chunk, top_w, top_i, cfg)
     stats = jnp.stack(
@@ -520,6 +518,15 @@ def _moe_mlp_dropless(p, xn, cfg):
     )  # [2, E]: choice counts, gate-prob sums — same as _moe_mlp_routed
     out = lax.psum(out.astype(compute), "tp")
     return out.reshape(b, t, d), stats
+
+
+def renormalized_topk(gates, k: int):
+    """Top-k gate pick + sum-renormalization — THE routing weight
+    definition, shared by every token-choice formulation (capacity,
+    dropless, and the serving paths) so their per-token weights cannot
+    drift. gates [..., E] f32; returns (top_w, top_i), each [..., k]."""
+    top_w, top_i = lax.top_k(gates, k)
+    return top_w / jnp.maximum(jnp.sum(top_w, -1, keepdims=True), 1e-9), top_i
 
 
 def sorted_ragged_expert_ffn(p, x_flat, top_w, top_i, cfg):
