@@ -26,6 +26,20 @@ def main() -> int:
     parser.add_argument("--n-heads", type=int, default=16)
     parser.add_argument("--d-ff", type=int, default=4096)
     parser.add_argument(
+        "--n-experts", type=int, default=0,
+        help="MoE expert count (0 = dense MLP); pairs with --moe-top-k",
+    )
+    parser.add_argument("--d-ff-expert", type=int, default=4096)
+    parser.add_argument(
+        "--moe-top-k", type=int, default=0,
+        help="token-choice top-k routing (0 = dense soft dispatch)",
+    )
+    parser.add_argument(
+        "--moe-dispatch", choices=["capacity", "dropless"],
+        default="capacity",
+        help="top-k dispatch formulation (docs/parallelism.md)",
+    )
+    parser.add_argument(
         "--decode", action="store_true",
         help="also measure serving-path KV-cache decode tokens/s",
     )
@@ -62,6 +76,10 @@ def main() -> int:
         n_heads=args.n_heads,
         d_ff=args.d_ff,
         max_seq_len=args.seq_len,
+        n_experts=args.n_experts,
+        d_ff_expert=args.d_ff_expert,
+        moe_top_k=args.moe_top_k,
+        moe_dispatch=args.moe_dispatch,
         remat=args.remat,
         remat_policy=args.remat_policy,
     )

@@ -57,6 +57,13 @@ def peak_flops_for(device_kind: str) -> Optional[float]:
     return None
 
 
+def expert_ffn_params(cfg) -> int:
+    """Matmul parameters of ONE expert's FFN — the single definition used
+    by both the total count and the activated-FLOPs subtraction, so the
+    two cannot drift if the expert MLP changes shape."""
+    return 2 * cfg.d_model * cfg.d_ff_expert
+
+
 def matmul_param_count(cfg) -> int:
     """Parameters that participate in matmuls (excludes norms; includes the
     untied vocab projection and embedding-as-projection only once)."""
@@ -68,7 +75,7 @@ def matmul_param_count(cfg) -> int:
     per_layer = 2 * d * cfg.n_heads * dh + 2 * d * kv * dh
     if cfg.n_experts:
         # gate + all expert FFNs (total, not per-token-activated)
-        per_layer += d * cfg.n_experts + cfg.n_experts * 2 * d * cfg.d_ff_expert
+        per_layer += d * cfg.n_experts + cfg.n_experts * expert_ffn_params(cfg)
     else:
         per_layer += 2 * d * cfg.d_ff
     return L * per_layer + cfg.vocab_size * d  # + output projection
@@ -196,7 +203,18 @@ def run_model_bench(
 
     tokens_per_step = batch * seq_len
     tokens_per_sec = steps * tokens_per_step / elapsed
-    flops_per_token = train_flops_per_token(cfg, seq_len)
+    # MoE: the conventional activated-FLOPs accounting — a token touches
+    # its k routed experts, not all E (counting all E would overstate MFU
+    # for every sparse dispatch).
+    active_params = None
+    if cfg.n_experts and cfg.moe_top_k:
+        inactive = cfg.n_experts - cfg.moe_top_k
+        active_params = matmul_param_count(cfg) - (
+            cfg.n_layers * inactive * expert_ffn_params(cfg)
+        )
+    flops_per_token = train_flops_per_token(
+        cfg, seq_len, active_params=active_params
+    )
     achieved = tokens_per_sec * flops_per_token
 
     device_kind = devices[0].device_kind
@@ -216,6 +234,14 @@ def run_model_bench(
         "remat_policy": cfg.remat_policy if cfg.remat else None,
         "loss_chunk": cfg.loss_chunk,
         "params_m": round(matmul_param_count(cfg) / 1e6, 1),
+        **(
+            {"active_params_m": round(active_params / 1e6, 1),
+             "n_experts": cfg.n_experts, "moe_top_k": cfg.moe_top_k,
+             "d_ff_expert": cfg.d_ff_expert,
+             "moe_dispatch": cfg.moe_dispatch}
+            if active_params is not None
+            else {}
+        ),
         "steps": steps,
         "step_time_ms": round(1000 * elapsed / steps, 2),
         "tokens_per_sec": round(tokens_per_sec, 1),
