@@ -206,8 +206,11 @@ def run_model_bench(
     # MoE: the conventional activated-FLOPs accounting — a token touches
     # its k routed experts, not all E (counting all E would overstate MFU
     # for every sparse dispatch).
+    # Only token-choice top-k is credited at activated FLOPs; the
+    # expert-choice router ignores moe_top_k (its compute is set by its
+    # own capacity), and soft dispatch genuinely runs every expert.
     active_params = None
-    if cfg.n_experts and cfg.moe_top_k:
+    if cfg.n_experts and cfg.moe_top_k and cfg.moe_router == "token":
         inactive = cfg.n_experts - cfg.moe_top_k
         active_params = matmul_param_count(cfg) - (
             cfg.n_layers * inactive * expert_ffn_params(cfg)
@@ -234,11 +237,19 @@ def run_model_bench(
         "remat_policy": cfg.remat_policy if cfg.remat else None,
         "loss_chunk": cfg.loss_chunk,
         "params_m": round(matmul_param_count(cfg) / 1e6, 1),
+        # Every MoE run records its routed configuration (a soft-dispatch
+        # or expert-choice record must not read as a dense run); the
+        # activated count additionally appears on the top-k path.
         **(
-            {"active_params_m": round(active_params / 1e6, 1),
-             "n_experts": cfg.n_experts, "moe_top_k": cfg.moe_top_k,
+            {"n_experts": cfg.n_experts, "moe_top_k": cfg.moe_top_k,
              "d_ff_expert": cfg.d_ff_expert,
+             "moe_router": cfg.moe_router,
              "moe_dispatch": cfg.moe_dispatch}
+            if cfg.n_experts
+            else {}
+        ),
+        **(
+            {"active_params_m": round(active_params / 1e6, 1)}
             if active_params is not None
             else {}
         ),
