@@ -21,7 +21,7 @@ shard_map'd program runs unchanged from 1 chip to a full pod slice.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Optional, Sequence
+from typing import Any, Optional, Sequence
 
 import numpy as np
 
@@ -184,6 +184,16 @@ def vma_union(*trees) -> frozenset:
     for leaf in jax.tree.leaves(trees):
         vma = vma | getattr(jax.typeof(leaf), "vma", frozenset())
     return vma
+
+
+def pvary_like(target_tree, *source_trees, extra_axes=()) -> Any:
+    """Promote every leaf of `target_tree` to vary over the union of the
+    source trees' varying axes plus `extra_axes` — the recurring shard_map
+    idiom for typing scan carries/accumulators that will hold values
+    produced FROM the sources (a plain `jnp.zeros` enters invariant and
+    the VMA carry check rejects the loop)."""
+    vma = frozenset(extra_axes) | vma_union(*source_trees)
+    return jax.tree.map(lambda x: pvary_to(x, vma), target_tree)
 
 
 def pvary_to(x, vma) -> jax.Array:

@@ -57,12 +57,12 @@ def pipeline_apply(
     # outputs, or shard_map's VMA checker rejects the loop — and silencing
     # the checker (check_vma=False) would mis-transpose psum in backward
     # passes, double-counting gradients. Type the zeros explicitly instead.
-    from .mesh import pvary_to, vma_union
-
-    vma = frozenset({axis_name}) | vma_union(stage_params, microbatches)
+    from .mesh import pvary_like
 
     def _varying(x):
-        return pvary_to(x, vma)
+        return pvary_like(
+            x, stage_params, microbatches, extra_axes=(axis_name,)
+        )
 
     outputs0 = _varying(jnp.zeros((n_micro, *mb_shape), microbatches.dtype))
     recv0 = _varying(jnp.zeros(mb_shape, microbatches.dtype))

@@ -73,30 +73,44 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True):
             ),
         )
 
-    # Fold the local block first, then sp-1 rotate-then-fold steps — exactly
-    # sp-1 neighbor permutes total, none discarded.
-    acc = fold(
+    # The accumulator must enter the scan with the sp-varying type the
+    # fold produces, or shard_map's VMA carry check rejects the loop.
+    from .mesh import pvary_like
+
+    acc0 = pvary_like(
         (
             jnp.full((batch, heads, t_local), NEG_INF, jnp.float32),
             jnp.zeros((batch, heads, t_local), jnp.float32),
             jnp.zeros((batch, t_local, heads, dim), jnp.float32),
         ),
-        k,
-        v,
-        jnp.int32(0),
+        q, k, v,
+        extra_axes=(axis_name,),
     )
 
-    if sp > 1:
+    if sp == 1:
+        acc = fold(acc0, k, v, jnp.int32(0))
+    else:
+        # Communication/compute overlap: each step ISSUES the next block's
+        # ppermute sends BEFORE folding the current block — the fold does
+        # not depend on the permuted values, so XLA's async collectives
+        # (collective-permute-start/-done) hide the ICI hop behind the
+        # flash-kernel compute instead of serializing in front of it.
+        # Still exactly sp-1 neighbor permutes, folded in the same order
+        # (step r folds the block that has rotated r times; the last
+        # arrival folds outside the scan with no trailing permute).
         perm = [(i, (i + 1) % sp) for i in range(sp)]
 
         def step(carry, r):
             k_blk, v_blk, acc = carry
-            k_blk = lax.ppermute(k_blk, axis_name, perm)
-            v_blk = lax.ppermute(v_blk, axis_name, perm)
+            k_next = lax.ppermute(k_blk, axis_name, perm)
+            v_next = lax.ppermute(v_blk, axis_name, perm)
             acc = fold(acc, k_blk, v_blk, r)
-            return (k_blk, v_blk, acc), None
+            return (k_next, v_next, acc), None
 
-        (_, _, acc), _ = lax.scan(step, (k, v, acc), jnp.arange(1, sp))
+        (k_last, v_last, acc), _ = lax.scan(
+            step, (k, v, acc0), jnp.arange(sp - 1)
+        )
+        acc = fold(acc, k_last, v_last, jnp.int32(sp - 1))
 
     _, acc_sum, acc_out = acc
     return normalize_block_stats(acc_sum, acc_out).astype(out_dtype)
