@@ -950,9 +950,12 @@ def main() -> int:
                     )
                 }
             # Top-level backend reports the accelerator-relevant phase: tpu
-            # when the model phase ran on the chip (placement_backend keeps
-            # the simulator's backend honest).
-            if detail.get("model", {}).get("backend") == "tpu":
+            # only when THIS run's model phase ran on the chip
+            # (placement_backend keeps the simulator's backend honest). A
+            # merged sidecar from an earlier capture keeps its own
+            # model.backend/captured_at — the top level must not claim a
+            # chip this run never reached.
+            if model_result is not None and model_result.get("backend") == "tpu":
                 detail["backend"] = "tpu"
             print(json.dumps(obj))
             return 0
