@@ -24,6 +24,12 @@ def main() -> int:
     parser.add_argument("--d-model", type=int, default=1024)
     parser.add_argument("--n-layers", type=int, default=8)
     parser.add_argument("--n-heads", type=int, default=16)
+    parser.add_argument(
+        "--n-kv-heads", type=int, default=0,
+        help="grouped-query attention: K/V head count (0 = MHA); shrinks "
+             "the KV cache and wk/wv by n_heads/n_kv_heads — the serving "
+             "decode bandwidth lever",
+    )
     parser.add_argument("--d-ff", type=int, default=4096)
     parser.add_argument(
         "--n-experts", type=int, default=0,
@@ -75,6 +81,7 @@ def main() -> int:
         n_layers=args.n_layers,
         n_heads=args.n_heads,
         d_ff=args.d_ff,
+        n_kv_heads=args.n_kv_heads,
         max_seq_len=args.seq_len,
         n_experts=args.n_experts,
         d_ff_expert=args.d_ff_expert,
