@@ -161,6 +161,9 @@ def run_model_bench(
         # recomputed unembed matmul on the backward.
         loss_chunk=loss_chunk,
     )
+    # Fail CLI-driven configs with the config's purpose-built errors (e.g.
+    # GQA divisibility) instead of an opaque shape crash mid-compile.
+    cfg.validate(MeshConfig())
 
     params = transformer.init_params(jax.random.key(0), cfg, mesh)
     optimizer = optax.adam(learning_rate)
@@ -302,6 +305,7 @@ def run_decode_bench(
         n_layers=8,
         max_seq_len=prompt_len + max_new_tokens,
     )
+    cfg.validate(MeshConfig())  # clean errors for CLI-driven configs
     params = transformer.init_params(jax.random.key(0), cfg, mesh)
     if quantized:
         # Full int8 serving stack (models/quant.py): decode is HBM-bound,
