@@ -203,12 +203,13 @@ def _cache_write(cache_part, value, pos: int):
     )
 
 
-def _cache_read(cache_part):
-    """Full cache view in f32: identity cast for plain caches, fused
-    dequantization for int8 caches (int8 bytes cross HBM; the
-    convert+scale rides the attention matmul's operand read). One
-    dequant definition: quant.weight_cast."""
-    return weight_cast(cache_part, jnp.float32)
+def _cache_read(cache_part, dtype):
+    """Full cache view in the compute dtype: identity cast for plain
+    (already compute-dtype) caches, fused dequantization for int8 caches
+    (int8 bytes cross HBM; the convert+scale rides the attention matmul's
+    operand read). Softmax statistics stay f32 at the consumer via
+    preferred_element_type. One dequant definition: quant.weight_cast."""
+    return weight_cast(cache_part, dtype)
 
 
 def _decode_layer(p, x, cache_k, cache_v, pos, cfg: TransformerConfig):
@@ -226,15 +227,25 @@ def _decode_layer(p, x, cache_k, cache_v, pos, cfg: TransformerConfig):
     # GQA: the cache is read at its compact kv-head width and broadcast per
     # query-head group (a fused broadcast, not a copy) — bandwidth, the
     # decode bottleneck, scales with kv_heads.
-    full_k = repeat_kv(_cache_read(cache_k), group)
-    full_v = repeat_kv(_cache_read(cache_v), group)
+    full_k = repeat_kv(_cache_read(cache_k, cfg.dtype), group)
+    full_v = repeat_kv(_cache_read(cache_v, cfg.dtype), group)
     scale = cfg.head_dim ** -0.5
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, full_k) * scale  # [B,H,1,T]
+    # Operands stay in the compute dtype; f32 logits/softmax via the
+    # accumulator — same statistics policy as the flash kernel.
+    logits = (
+        jnp.einsum(
+            "bqhd,bkhd->bhqk", q, full_k, preferred_element_type=jnp.float32
+        )
+        * scale
+    )  # [B,H,1,T]
     t_max = full_k.shape[1]
     visible = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, t_max), 3) <= pos
     logits = jnp.where(visible, logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
-    attn = jnp.einsum("bhqk,bkhd->bqhd", probs, full_v)
+    attn = jnp.einsum(
+        "bhqk,bkhd->bqhd", probs.astype(full_v.dtype), full_v,
+        preferred_element_type=jnp.float32,
+    )
     return _layer_tail(p, x, attn, cfg), cache_k, cache_v
 
 
@@ -251,9 +262,14 @@ def _layer_qkv(p, xn, base, kv_heads_local, cfg: TransformerConfig):
         y = jnp.einsum("btd,df->btf", xn.astype(compute), weight_cast(w, compute))
         return y.reshape(*y.shape[:-1], n_heads, cfg.head_dim)
 
+    # q stays in the compute dtype: the attention matmuls run at that
+    # dtype's MXU rate (the compute-bound prefill's dominant cost), and
+    # both consumers keep their softmax statistics in f32 regardless —
+    # block_attention internally, the decode step via its
+    # preferred_element_type=f32 logits einsum.
     q = rotary(
         proj(p["wq"], kv_heads_local * group), positions, cfg.rope_theta
-    ).astype(jnp.float32)
+    )
     k = rotary(proj(p["wk"], kv_heads_local), positions, cfg.rope_theta)
     return q, k, proj(p["wv"], kv_heads_local)
 
