@@ -141,6 +141,10 @@ class Cluster:
         # solver dispatch (provider.prepare_batch): (placement, js) pairs,
         # deduped by JobSet uid at drain time (last request wins).
         self._prepare_requests: list[tuple] = []
+        # One bounded between-tick wait for in-flight placement solves
+        # (reconciles park on PLAN_PENDING instead of sleeping inside the
+        # timed pass; see request_solve_backoff).
+        self._solve_backoff_s: float = 0.0
         self._next_tick_queue: deque[tuple[str, str]] = deque()
         self.reconcile_queue: deque[tuple[str, str]] = deque()
         self._queued: set[tuple[str, str]] = set()
@@ -731,6 +735,16 @@ class Cluster:
         while self._deferred:
             self._deferred.popleft()()
 
+    def request_solve_backoff(self, seconds: float = 0.005) -> None:
+        """Ask the pump for one bounded wait at the END of this tick (outside
+        every timed reconcile) because a reconcile parked on an in-flight
+        placement solve. Replaces per-parked-JobSet sleeps inside reconcile
+        passes — those were the storm-p99 regression — while still
+        guaranteeing a tick budget cannot drain before a ~100 ms tunneled
+        solve lands (the wait makes parked ticks cost wall time, not just
+        queue spins)."""
+        self._solve_backoff_s = max(self._solve_backoff_s, seconds)
+
     def defer_placement_prepare(self, placement, js) -> None:
         """Buffer a placement-prefetch request until the tick's reconcile
         drain completes, so concurrent gang restarts batch into one solver
@@ -745,10 +759,16 @@ class Cluster:
         drain processes a restart's delete AND create passes, so waiting
         for end-of-tick would hand every creation a stale plan. Because the
         whole buffer flushes at once, the FIRST creation pass of a storm
-        still solves all of its JobSets in one dispatch."""
-        self._drain_prepare_requests()
+        still solves all of its JobSets in one dispatch.
 
-    def _drain_prepare_requests(self) -> None:
+        The flush runs INSIDE a timed reconcile pass, so it only dispatches
+        (block=False): the calling creation pass parks on PLAN_PENDING and
+        requeues, the device finishes the auction between ticks, and the
+        next pass fetches the finished plan — the solve's wall time never
+        lands in one reconcile's latency sample (the storm-p99 fix)."""
+        self._drain_prepare_requests(block=False)
+
+    def _drain_prepare_requests(self, block: bool = True) -> None:
         if not self._prepare_requests:
             return
         requests, self._prepare_requests = self._prepare_requests, []
@@ -763,10 +783,10 @@ class Cluster:
         for placement, by_uid in by_provider.values():
             jobsets = list(by_uid.values())
             if hasattr(placement, "prepare_batch"):
-                placement.prepare_batch(self, jobsets)
+                placement.prepare_batch(self, jobsets, block=block)
             else:
                 for js in jobsets:
-                    placement.prepare(self, js)
+                    placement.prepare(self, js, block=block)
 
     def tick(self) -> bool:
         """One control-plane pass; returns True if anything changed."""
@@ -809,6 +829,19 @@ class Cluster:
         while self.reconcile_queue:
             key = self.reconcile_queue.popleft()
             self._queued.discard(key)
+            # If the next item is a JobSet whose placement prepare is still
+            # buffered, dispatch the WHOLE buffer now (async, one batched
+            # XLA call) — here in the pump, between reconciles, so the
+            # dispatch cost (host-side stacking, transfers, trace lookup)
+            # never lands inside the item's timed pass. A storm's failure
+            # reconciles all precede their requeued recreate passes in the
+            # queue, so by the first recreate pass every storm JobSet has
+            # buffered: batching is preserved.
+            if self._prepare_requests and any(
+                (js.metadata.namespace, js.metadata.name) == key
+                for _, js in self._prepare_requests
+            ):
+                self._drain_prepare_requests(block=False)
             if self.jobset_reconciler is not None:
                 changed |= bool(self.jobset_reconciler.reconcile(*key))
             self._drain_deferred()
@@ -846,6 +879,15 @@ class Cluster:
         # 5. Pod reconciler enforces exclusive-placement drift.
         if self.pod_reconciler is not None:
             changed |= self.pod_reconciler.sync()
+
+        # 6. One bounded between-tick wait when a reconcile parked on an
+        # in-flight placement solve this tick: the device makes progress
+        # while the pump (not any timed reconcile pass) absorbs the wait.
+        if self._solve_backoff_s:
+            backoff, self._solve_backoff_s = self._solve_backoff_s, 0.0
+            import time as _time_mod
+
+            _time_mod.sleep(backoff)
 
         return changed
 

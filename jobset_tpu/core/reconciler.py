@@ -203,6 +203,9 @@ class JobSetReconciler:
             if self.placement.plan_pending(js):
                 ctx.changed = True
                 ctx.requeue_next_tick = True
+                # The wait happens in the pump, between ticks — never
+                # inside this (timed) pass.
+                self.cluster.request_solve_backoff()
                 return
 
         for rjob in js.spec.replicated_jobs:
@@ -234,6 +237,7 @@ class JobSetReconciler:
                     # prefetched plan covers every batch anyway.
                     ctx.changed = True  # plan lands next pass
                     ctx.requeue_next_tick = True
+                    self.cluster.request_solve_backoff()
                     return
 
             for job in jobs:

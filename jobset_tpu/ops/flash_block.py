@@ -52,21 +52,49 @@ NEG_INF = -1.0e30
 
 
 def _tile_env(name: str, default: int) -> int:
-    """Import-time tile override (JOBSET_TPU_FLASH_TILE_Q/K): an on-chip
+    """Trace-time tile override (JOBSET_TPU_FLASH_TILE_Q/K): an on-chip
     tuning knob — larger tiles mean fewer grid steps and longer MXU bursts
     at the cost of VMEM residency. Values must keep TPU tiling legal
-    (multiples of 128 cover both the f32 and bf16 operand layouts)."""
-    v = int(os.environ.get(name, default))
-    if v <= 0 or v % 128:
-        raise ValueError(f"{name} must be a positive multiple of 128, got {v}")
+    (multiples of 128 cover both the f32 and bf16 operand layouts).
+
+    Resolved lazily at kernel trace time, not import time: a stale or
+    malformed env var must not make the whole package unimportable for
+    code paths that never touch the flash kernel, and lazy resolution is
+    what lets the bench sweep tiles in-process (rebuild the train step
+    under a different env value -> fresh trace picks it up). The upper
+    bound keeps the three f32 VMEM scratch tiles + operand tiles well
+    inside the ~16 MB/core VMEM budget instead of failing later with an
+    opaque Mosaic allocation error."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        v = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"env {name}={raw!r} is not an integer; unset it or use a "
+            "positive multiple of 128"
+        ) from None
+    if v <= 0 or v % 128 or v > 1024:
+        raise ValueError(
+            f"env {name}={v} must be a positive multiple of 128 and at most "
+            "1024 (VMEM residency: scratch + operand tiles must fit in "
+            "~16 MB/core)"
+        )
     return v
 
 
 # MXU/VPU tiles: sublane multiple of 8 (f32) / 16 (bf16), lane multiple
 # of 128; 128x128 is the safe default proven under the real Mosaic
 # lowering (TPUCHECK.json).
-_TILE_Q = _tile_env("JOBSET_TPU_FLASH_TILE_Q", 128)
-_TILE_K = _tile_env("JOBSET_TPU_FLASH_TILE_K", 128)
+def _tile_q() -> int:
+    return _tile_env("JOBSET_TPU_FLASH_TILE_Q", 128)
+
+
+def _tile_k() -> int:
+    return _tile_env("JOBSET_TPU_FLASH_TILE_K", 128)
+
+
 _LANE = 128
 
 _INTERPRET = False
@@ -246,8 +274,9 @@ def _block_attention_pallas(q, k, v, bias):
     tk = k.shape[1]
     scale = dim ** -0.5
 
-    tq_p = _round_up(tq, _TILE_Q)
-    tk_p = _round_up(tk, _TILE_K)
+    tile_q, tile_k = _tile_q(), _tile_k()
+    tq_p = _round_up(tq, tile_q)
+    tk_p = _round_up(tk, tile_k)
     d_p = _round_up(dim, _LANE)
 
     # Layout: [B, T, H, D] -> [B*H, T_pad, D_pad]; padded kv columns are
@@ -262,7 +291,7 @@ def _block_attention_pallas(q, k, v, bias):
         tq_p, axis=0,
     )
 
-    grid = (batch * heads, tq_p // _TILE_Q, tk_p // _TILE_K)
+    grid = (batch * heads, tq_p // tile_q, tk_p // tile_k)
 
     # Inside shard_map the outputs vary over every axis any input varies
     # over (shard_map's check_vma requires out_shape to declare this), and
@@ -282,23 +311,23 @@ def _block_attention_pallas(q, k, v, bias):
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, _TILE_Q, d_p), lambda bh, qi, kt: (bh, qi, 0)),
-            pl.BlockSpec((1, _TILE_K, d_p), lambda bh, qi, kt: (bh, kt, 0)),
-            pl.BlockSpec((1, _TILE_K, d_p), lambda bh, qi, kt: (bh, kt, 0)),
-            pl.BlockSpec((_TILE_Q, _TILE_K), lambda bh, qi, kt: (qi, kt)),
+            pl.BlockSpec((1, tile_q, d_p), lambda bh, qi, kt: (bh, qi, 0)),
+            pl.BlockSpec((1, tile_k, d_p), lambda bh, qi, kt: (bh, kt, 0)),
+            pl.BlockSpec((1, tile_k, d_p), lambda bh, qi, kt: (bh, kt, 0)),
+            pl.BlockSpec((tile_q, tile_k), lambda bh, qi, kt: (qi, kt)),
         ],
         out_specs=[
-            pl.BlockSpec((1, _TILE_Q, 8), lambda bh, qi, kt: (bh, qi, 0)),
-            pl.BlockSpec((1, _TILE_Q, d_p), lambda bh, qi, kt: (bh, qi, 0)),
+            pl.BlockSpec((1, tile_q, 8), lambda bh, qi, kt: (bh, qi, 0)),
+            pl.BlockSpec((1, tile_q, d_p), lambda bh, qi, kt: (bh, qi, 0)),
         ],
         out_shape=[
             out_struct((batch * heads, tq_p, 8)),
             out_struct((batch * heads, tq_p, d_p)),
         ],
         scratch_shapes=[
-            pltpu.VMEM((_TILE_Q, _LANE), jnp.float32),
-            pltpu.VMEM((_TILE_Q, _LANE), jnp.float32),
-            pltpu.VMEM((_TILE_Q, d_p), jnp.float32),
+            pltpu.VMEM((tile_q, _LANE), jnp.float32),
+            pltpu.VMEM((tile_q, _LANE), jnp.float32),
+            pltpu.VMEM((tile_q, d_p), jnp.float32),
         ],
         interpret=_INTERPRET,
     )(qp, kp, vp, bias_p)

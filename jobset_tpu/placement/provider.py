@@ -33,12 +33,11 @@ PLAN_PENDING = object()
 
 # How long assign() tolerates an unfinished prefetch before blocking on it
 # anyway (a wedged device must not wedge job creation forever). Sized with
-# _PENDING_BACKOFF_S so the grace always expires within a default
-# run_until_stable tick budget (200 ticks x 5 ms > 0.5 s): the pump can
-# never exhaust its ticks while parked on a solve — it degrades to one
-# blocking fetch instead.
+# the pump's per-tick solve backoff (Cluster.request_solve_backoff, 5 ms)
+# so the grace always expires within a default run_until_stable tick budget
+# (200 ticks x 5 ms > 0.5 s): the pump can never exhaust its ticks while
+# parked on a solve — it degrades to one blocking fetch instead.
 _PENDING_GRACE_S = 0.5
-_PENDING_BACKOFF_S = 0.005
 
 
 class GreedyPlacement:
@@ -85,13 +84,13 @@ class SolverPlacement:
             return False
         if pending.is_ready() or pending.age_seconds >= _PENDING_GRACE_S:
             return False
-        # Bounded backoff (the requeue-with-backoff a real controller would
-        # do): without it the pump's wait ticks are so cheap that a tick
-        # budget can drain before a ~100ms tunneled solve lands.
-        import time
-
-        time.sleep(_PENDING_BACKOFF_S)
-        return not pending.is_ready()
+        # No sleep HERE: this runs inside a timed reconcile pass, and a
+        # 5 ms wait per parked JobSet was the storm-p99 regression (8
+        # parked JobSets = 40 ms of sleep landing in reconcile samples).
+        # The pump applies ONE bounded backoff per tick, outside any timed
+        # pass (Cluster.request_solve_backoff), so a tick budget still
+        # cannot drain before a ~100 ms tunneled solve lands.
+        return True
 
     def _get_solver(self):
         if self._solver is None:
@@ -172,7 +171,7 @@ class SolverPlacement:
             pending = self._materialize(specs, domain_values, pending.result())
         self._store_plan(js, specs, domain_values, pending)
 
-    def prepare_batch(self, cluster, jobsets) -> None:
+    def prepare_batch(self, cluster, jobsets, block: bool = True) -> None:
         """Storm path: prefetch plans for MANY JobSets as ONE vmapped solve.
 
         When a gang failure sweeps several JobSets in the same pump tick
@@ -185,13 +184,19 @@ class SolverPlacement:
         problem is built against the same snapshot) but self-heal: restart
         stickiness keeps recovering gangs on their own domains, and
         assign()'s fetch-time revalidation forces a fresh solve on drift.
+
+        block=False only *dispatches* the batch (PendingSolve cached per
+        JobSet): the on-demand flush from inside a creation-pass reconcile
+        uses it so the batched solve's wall time never lands inside a timed
+        reconcile — the pass parks on PLAN_PENDING and the device finishes
+        between ticks (the storm-p99 fix; see docs/benchmarks.md).
         """
         if not features.enabled("TPUPlacementSolver"):
             return
         solver = self._get_solver()
         if not hasattr(solver, "solve_structured_batch_async"):
             for js in jobsets:
-                self.prepare(cluster, js)
+                self.prepare(cluster, js, block=block)
             return
 
         from .plans import build_cost_params_for_specs
@@ -211,7 +216,7 @@ class SolverPlacement:
                 cluster, specs, topology_key, pending_release=pending_release
             )
             if structured is None:
-                self.prepare(cluster, js)
+                self.prepare(cluster, js, block=block)
                 continue
             params, domain_values = structured
             entries.append((js, specs, domain_values, params))
@@ -220,15 +225,17 @@ class SolverPlacement:
         if len(entries) == 1:
             js, specs, domain_values, params = entries[0]
             pending = solver.solve_structured_async(**params)
-            plan = self._materialize(specs, domain_values, pending.result())
-            self._store_plan(js, specs, domain_values, plan)
+            if block:
+                pending = self._materialize(specs, domain_values, pending.result())
+            self._store_plan(js, specs, domain_values, pending)
             return
         pendings = solver.solve_structured_batch_async(
             [params for _, _, _, params in entries]
         )
         for (js, specs, domain_values, _), pending in zip(entries, pendings):
-            plan = self._materialize(specs, domain_values, pending.result())
-            self._store_plan(js, specs, domain_values, plan)
+            if block:
+                pending = self._materialize(specs, domain_values, pending.result())
+            self._store_plan(js, specs, domain_values, pending)
 
     def _store_plan(self, js, specs, domain_values, plan_or_pending) -> None:
         """Cache a materialized plan dict or an in-flight PendingSolve for

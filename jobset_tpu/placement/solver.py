@@ -10,9 +10,16 @@ Algorithm: Bertsekas' auction algorithm, Jacobi (all-bidders-parallel)
 variant — the natural fit for TPU: every iteration is a dense [J, D]
 max/argmax plus scatter-max conflict resolution, all MXU/VPU-friendly
 fixed-shape ops inside `lax.while_loop`; no data-dependent Python control
-flow.  With integer benefits scaled by (J+1) and eps=1, the result is an
-exactly optimal assignment (standard auction optimality bound: within J*eps
-of optimal, and scaled-integer spacing makes that exact).
+flow.  With INTEGER costs, benefits scaled by (J+1) and eps=1 make the
+result exactly optimal (standard auction bound: within J*eps of optimal,
+and scaled-integer spacing makes that exact; all scaled values stay below
+2^24, so f32 kernel arithmetic is exact as well).  The production cost
+model (plans.py) carries continuous load/rotation terms, so those solves
+are eps-OPTIMAL: total suboptimality < J/(J+1) < 1 cost unit — less than
+the cost gap of a single non-sticky placement hop, so it can never flip a
+placement-quality decision.  Both claims are cross-checked against scipy
+at full bench scale (bench.py run_contended_optimality) and at toy scale
+(tests/test_solver.py).
 
 Shape discipline: problems are padded to power-of-two buckets so recompilation
 is rare, and every job has an IMPLICIT dedicated finite-benefit "sink" (a
@@ -54,11 +61,20 @@ def _round_up_pow2(n: int, minimum: int = 8) -> int:
     return size
 
 
+# eps-scaling factor (Bertsekas recommends 4-10): each phase divides eps by
+# theta until the caller's final eps, warm-starting prices from the previous
+# phase. Without scaling, a contended surface (many jobs sharing one
+# preference order — e.g. every job wanting the emptiest domains) degrades
+# to a unit-step price war: measured 6684 iterations (~35 s on CPU) for a
+# 512x960 load-gradient problem that eps-scaling solves in a few hundred.
+_EPS_THETA = 8.0
+
+
 @functools.partial(jax.jit, static_argnames=("max_iters",))
 def _auction(benefit: jax.Array, eps: jax.Array, max_iters: int = 20000):
     """Jacobi auction over a dense benefit matrix with implicit sinks.
 
-    benefit: [J, D] float32 (scaled-integer values; -inf = forbidden).
+    benefit: [J, D] float32 (scaled values; -inf = forbidden).
     Every job also has an IMPLICIT dedicated "sink" object of constant
     benefit SINK_BENEFIT (scaled like the matrix): dedicated means it is
     never contested, so it needs no column — the sink only participates as
@@ -69,18 +85,37 @@ def _auction(benefit: jax.Array, eps: jax.Array, max_iters: int = 20000):
     preserving exact auction semantics: a perfect matching always exists,
     so the loop provably terminates.
 
+    eps-scaling: phases run at eps_k = max(eps, spread/theta^k). Phase
+    transitions REPAIR rather than reset: the previous phase's assignment
+    and prices carry over, jobs whose pair violates the new (tighter)
+    eps_k-CS are unassigned, and their orphaned objects' prices drop to 0.
+    The price-drop is what keeps the optimality proof intact for
+    RECTANGULAR problems (J < D): the auction duality bound needs "price >
+    0 => object owned" at termination — a plain reset-assignments warm
+    start leaves stale coarse-phase prices on unowned objects and silently
+    loses optimality (measured: 58 vs 27 on an integer instance). With the
+    repair, every positively-priced object is owned at every phase
+    boundary (bids preserve this within a phase: a price only rises when
+    its object is won), so the caller's eps=1-on-scaled-integers exactness
+    guarantee is unchanged, and only the FINAL phase's eps enters the
+    J*eps bound. Coarse phases exist purely to move prices in large steps
+    instead of unit bids: a contended 512x960 surface took 6684 unit-bid
+    iterations (~35 s CPU) that scaling cuts by an order of magnitude.
+
     Returns (assignment [J] int32 into D, with D itself as the "took the
-    sink" sentinel; prices [D] float32; iterations int32).
+    sink" sentinel; prices [D] float32; iterations int32 — total inner
+    iterations across all phases).
     """
     num_jobs, num_objects = benefit.shape
     sink = jnp.asarray(SINK_BENEFIT * (num_jobs + 1), benefit.dtype)
+    eps_final = jnp.asarray(eps, benefit.dtype)
 
     def cond(state):
-        assignment, _, _, it = state
+        assignment, _, _, it, _ = state
         return jnp.logical_and(jnp.any(assignment < 0), it < max_iters)
 
     def body(state):
-        assignment, owner, prices, it = state
+        assignment, owner, prices, it, eps_k = state
         unassigned = assignment < 0  # [J]
 
         values = benefit - prices[None, :]  # [J, D]
@@ -99,7 +134,7 @@ def _auction(benefit: jax.Array, eps: jax.Array, max_iters: int = 20000):
         # and final (no other bidder can ever evict it).
         takes_sink = jnp.logical_and(unassigned, sink > best_val)  # [J]
 
-        bid = prices[best_obj] + (best_val - second_val) + eps  # [J]
+        bid = prices[best_obj] + (best_val - second_val) + eps_k  # [J]
 
         # Conflict resolution: per object, the highest bid wins; ties go to
         # the lowest job index (deterministic).
@@ -141,15 +176,137 @@ def _auction(benefit: jax.Array, eps: jax.Array, max_iters: int = 20000):
         )
         prices = jnp.where(won_obj_mask, winner_bid, prices)
 
-        return assignment, owner, prices, it + 1
+        return assignment, owner, prices, it + 1, eps_k
 
-    init = (
-        jnp.full((num_jobs,), -1, jnp.int32),
-        jnp.full((num_objects,), -1, jnp.int32),
-        jnp.zeros((num_objects,), benefit.dtype),
-        jnp.int32(0),
+    # Initial eps from the finite-benefit spread: one coarse phase per
+    # factor of theta between the spread and the final eps.
+    finite = benefit > (NEG_INF / 2.0)
+    bmax = jnp.max(jnp.where(finite, benefit, -jnp.inf))
+    bmin = jnp.min(jnp.where(finite, benefit, jnp.inf))
+    spread = jnp.where(jnp.any(finite), bmax - bmin, jnp.zeros_like(eps_final))
+    theta = jnp.asarray(_EPS_THETA, benefit.dtype)
+    eps0 = jnp.maximum(eps_final, spread / theta)
+
+    def repair(assignment, owner, prices, eps_k):
+        """Phase-start CS repair, run to FIXPOINT: drop pairs violating
+        eps_k-CS and zero every unowned object's price. Restores "price > 0
+        => owned" — the invariant the rectangular duality bound stands on
+        (see docstring) — and must iterate because zeroing an orphaned
+        object's price raises other jobs' outside options, which can induce
+        fresh violations (each pass unassigns >= 1 job, so it terminates in
+        <= J passes; typically 1-3)."""
+
+        def rcond(state):
+            _, _, _, changed = state
+            return changed
+
+        def rbody(state):
+            assignment, owner, prices, _ = state
+            values = benefit - prices[None, :]  # [J, D]
+            vmax = jnp.maximum(jnp.max(values, axis=1), sink)  # [J]
+            idx = jnp.clip(assignment, 0, num_objects - 1)
+            v_assigned = jnp.where(
+                assignment >= num_objects,  # sink sentinel
+                sink,
+                values[jnp.arange(num_jobs), idx],
+            )
+            violates = jnp.logical_and(
+                assignment >= 0, v_assigned < vmax - eps_k
+            )  # [J]
+            assignment = jnp.where(violates, -1, assignment)
+            orphaned = jnp.logical_and(
+                owner >= 0, violates[jnp.clip(owner, 0, num_jobs - 1)]
+            )  # [D]
+            owner = jnp.where(orphaned, -1, owner)
+            prices = jnp.where(owner >= 0, prices, jnp.zeros_like(prices))
+            return assignment, owner, prices, jnp.any(violates)
+
+        assignment, owner, prices, _ = lax.while_loop(
+            rcond, rbody, (assignment, owner, prices, jnp.asarray(True))
+        )
+        return assignment, owner, prices
+
+    def outer_cond(state):
+        _, _, _, it, eps_k, done = state
+        return jnp.logical_and(~done, it < max_iters)
+
+    def outer_body(state):
+        assignment, owner, prices, it, eps_k, _ = state
+        assignment, owner, prices = repair(assignment, owner, prices, eps_k)
+        assignment, owner, prices, it, _ = lax.while_loop(
+            cond, body, (assignment, owner, prices, it, eps_k)
+        )
+        done = eps_k <= eps_final
+        eps_next = jnp.maximum(eps_final, eps_k / theta)
+        return assignment, owner, prices, it, eps_next, done
+
+    # Rank-matched warm start. The Jacobi auction serializes when many
+    # near-identical jobs share one preference order (every round they all
+    # bid the same argmax and ONE wins: a contended 512-gang burned ~6k
+    # rounds placing one job per round). Seed with the closed-form
+    # equilibrium of the identical-jobs case instead: job i takes the
+    # i-th best column (by column score), priced at its score margin over
+    # the first unchosen column — for correlated surfaces that IS the
+    # equilibrium (repair finds nothing to drop and the auction terminates
+    # in a handful of rounds); for heterogeneous surfaces it is just a
+    # guess whose bad pairs (including infeasible ones) the repair drops
+    # before any bidding. Correctness is untouched either way: the final
+    # phase still terminates in eps-CS with the ownership invariant.
+    # Only rows with ANY finite benefit participate (padding rows are all
+    # NEG_INF and belong on sinks): seeding them onto real columns poisons
+    # the warm start — the repair drops them, zeroes their columns, and
+    # those suddenly-free columns then invalidate every real seed pair,
+    # collapsing the whole seed back to the serialized cold start.
+    col_score = jnp.max(benefit, axis=0)  # [D]
+    order = jnp.argsort(-col_score)  # [D] descending
+    row_finite = jnp.max(benefit, axis=1) > (NEG_INF / 2.0)  # [J]
+    seed_rank = jnp.cumsum(row_finite.astype(jnp.int32)) - 1  # [J]
+    num_finite = jnp.sum(row_finite.astype(jnp.int32))
+    can_seed = jnp.logical_and(
+        row_finite, seed_rank < min(num_jobs, num_objects)
     )
-    assignment, _, prices, iters = lax.while_loop(cond, body, init)
+    obj_for_job = order[jnp.clip(seed_rank, 0, num_objects - 1)].astype(
+        jnp.int32
+    )  # [J]
+    # Threshold = score of the first UNSEEDED column (the marginal option):
+    # prices above it are each seeded column's equilibrium gain. Dead
+    # columns (no feasible job; score ~ NEG_INF*scale) must be masked with
+    # the NEG_INF/2 test, NOT jnp.isfinite — the sentinel is IEEE-finite,
+    # and a threshold landing on a dead column (every pow2-padded problem
+    # has them once feasible columns <= jobs) would price every seed at
+    # ~1e12, collapsing the warm start back to the serialized cold start.
+    live_col = col_score > (NEG_INF / 2.0)  # [D]
+    num_live = jnp.sum(live_col.astype(jnp.int32))
+    min_live = jnp.min(jnp.where(live_col, col_score, jnp.inf))
+    thresh_idx = jnp.clip(num_finite, 0, num_objects - 1)
+    s_thresh = jnp.where(
+        num_finite < num_live,
+        col_score[order[thresh_idx]],
+        jnp.where(jnp.isfinite(min_live), min_live, 0.0),
+    )
+    gain = col_score[obj_for_job] - s_thresh
+    gain = jnp.maximum(jnp.where(jnp.isfinite(gain), gain, 0.0), 0.0)
+    scatter_obj = jnp.where(can_seed, obj_for_job, num_objects)
+    seed_prices = jnp.zeros((num_objects,), benefit.dtype)
+    seed_prices = seed_prices.at[scatter_obj].set(gain, mode="drop")
+    seed_assignment = jnp.where(can_seed, obj_for_job, -1)
+    seed_owner = jnp.full((num_objects,), -1, jnp.int32)
+    seed_owner = seed_owner.at[scatter_obj].set(
+        jnp.arange(num_jobs, dtype=jnp.int32), mode="drop"
+    )
+
+    assignment, _, prices, iters, _, _ = lax.while_loop(
+        outer_cond,
+        outer_body,
+        (
+            seed_assignment,
+            seed_owner,
+            seed_prices,
+            jnp.int32(0),
+            eps0,
+            jnp.asarray(False),
+        ),
+    )
     return assignment, prices, iters
 
 
