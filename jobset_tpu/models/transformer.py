@@ -18,8 +18,8 @@ program say exactly which axis each reduction rides:
   gate-weighted partials `psum('ep')`-ed; token-routed (`moe_top_k>0`):
   top-k capacity routing with `all_to_all` slot exchange over the ep axis
   (`_moe_mlp_routed`) — the sparse ICI-native path; dropless token-routed
-  (`moe_dispatch="dropless"`, ep=1): exact sorted ragged grouped matmuls,
-  no capacity, no drops (`_moe_mlp_dropless`); expert-choice
+  (`moe_dispatch="dropless"`): exact sorted ragged grouped matmuls,
+  no capacity, no drops, any ep (`_moe_mlp_dropless`); expert-choice
   (`moe_router="expert"`): each expert takes its top-C tokens, perfectly
   balanced, no aux loss (`_moe_mlp_expert_choice`).
 * **dp** — pure data parallelism; gradients are `psum`-ed over (dp, sp) and
@@ -176,12 +176,6 @@ class TransformerConfig:
             raise ValueError(
                 f"unknown moe_dispatch {self.moe_dispatch!r} "
                 "(expected 'capacity' or 'dropless')"
-            )
-        if self.moe_dispatch == "dropless" and mc.ep > 1:
-            raise ValueError(
-                "moe_dispatch='dropless' requires ep == 1: ragged expert "
-                "segments have no static all_to_all shape to ship over an "
-                "expert axis (use the capacity path for ep > 1)"
             )
         if self.moe_dispatch == "dropless" and (
             self.moe_top_k == 0 or self.moe_router == "expert"
@@ -488,7 +482,7 @@ def _moe_mlp_routed(p, xn, cfg):
 
 
 def _moe_mlp_dropless(p, xn, cfg):
-    """Dropless token-choice top-k routing (MegaBlocks-style) for ep == 1.
+    """Dropless token-choice top-k routing (MegaBlocks-style), any ep.
 
     Exact routed math with NO capacity buffers and NO token drops: each
     token's k (token, expert) slots are sorted by expert and the expert
@@ -497,10 +491,23 @@ def _moe_mlp_dropless(p, xn, cfg):
     only activated FLOPs. Differentiable end-to-end (sort/gather/ragged
     matmuls/scatter-add all carry VJPs); the balancing-aux statistics are
     the same [2, E] (choice counts, gate-prob sums) contract as the
-    capacity path, so the loss-side pooling is identical. Validation
-    restricts this path to ep == 1 — ragged segments have no static
-    all_to_all shape to ship over an expert axis; the capacity path is
-    the distributed formulation.
+    capacity path, so the loss-side pooling is identical.
+
+    Expert parallelism (ep > 1) exploits the fact that the token set is
+    ALREADY replicated over ep (the batch shards over dp/sp): instead of
+    shipping ragged segments — which have no static all_to_all shape —
+    every rank routes the full local token set, runs the grouped matmuls
+    for just the slots of its own e_local expert shard (locality-keyed
+    sort; see `sorted_ragged_expert_ffn`), and ONE `psum` over ('ep','tp')
+    sums the disjoint partial outputs. No dispatch collective, no
+    capacity, no padding: per-rank expert FLOPs stay exactly the
+    activated count, weights stay sharded, and the only comm is the psum
+    the dense-dispatch path already pays. Router compute (gates, top-k,
+    the O(nk log nk) sort) is replicated over ep rather than 1/ep — the
+    router is a [d, E] matmul plus VPU work, negligible next to the
+    expert FFNs this path exists to scale. Exactness vs the ep=1 path
+    and vs the capacity path at no-drop capacity is differential-tested
+    (tests/test_transformer.py).
 
     Serving note: this is the training-side twin of the serving prefill's
     `decode._moe_mlp_topk_sorted`; a model trained dropless decodes
@@ -509,14 +516,30 @@ def _moe_mlp_dropless(p, xn, cfg):
     k = cfg.moe_top_k
     compute = cfg.dtype
     b, t, d = xn.shape
-    chunk, gates, n_chunk = _route_prologue(p, xn, cfg)  # ep==1: all tokens
+    ep = lax.psum(1, "ep")
+    ep_idx = lax.axis_index("ep")
+    e_local = p["we1"].shape[0]  # this rank's expert shard
+    x = xn.reshape(b * t, d)  # FULL local token set — no ep chunk split
+    gates = jax.nn.softmax(
+        jnp.einsum(
+            "nd,de->ne", x.astype(jnp.float32), p["wg"].astype(jnp.float32)
+        ),
+        axis=-1,
+    )  # [n, E] f32 routing
     top_w, top_i = renormalized_topk(gates, k)  # [n, k]
 
-    out, group_sizes = sorted_ragged_expert_ffn(p, chunk, top_w, top_i, cfg)
-    stats = jnp.stack(
-        [group_sizes.astype(jnp.float32), jnp.sum(gates, axis=0)]
-    )  # [2, E]: choice counts, gate-prob sums — same as _moe_mlp_routed
-    out = lax.psum(out.astype(compute), "tp")
+    out, _ = sorted_ragged_expert_ffn(
+        p, x, top_w, top_i, cfg, local_experts=(ep_idx, e_local)
+    )
+    # Stats are computed over the full token set and thus replicated over
+    # ep; the loss pools with a psum over ('dp','sp','ep'), so divide by
+    # ep to keep the pooled global stats identical to the capacity path's
+    # (which sums disjoint per-rank chunks).
+    counts = jnp.bincount(
+        top_i.reshape(-1), length=cfg.n_experts
+    ).astype(jnp.float32)
+    stats = jnp.stack([counts, jnp.sum(gates, axis=0)]) / ep
+    out = lax.psum(out.astype(compute), ("ep", "tp"))
     return out.reshape(b, t, d), stats
 
 
@@ -529,7 +552,7 @@ def renormalized_topk(gates, k: int):
     return top_w / jnp.maximum(jnp.sum(top_w, -1, keepdims=True), 1e-9), top_i
 
 
-def sorted_ragged_expert_ffn(p, x_flat, top_w, top_i, cfg):
+def sorted_ragged_expert_ffn(p, x_flat, top_w, top_i, cfg, local_experts=None):
     """THE sorted ragged grouped-matmul core, shared by dropless training
     (`_moe_mlp_dropless`) and serving prefill (`decode._moe_mlp_topk_sorted`)
     so the exact train/serve parity both paths promise cannot drift.
@@ -540,17 +563,34 @@ def sorted_ragged_expert_ffn(p, x_flat, top_w, top_i, cfg):
     per-expert segments (`lax.ragged_dot`), and combines gate-weighted
     results with an f32 scatter-add (k contributions per token accumulate
     without per-add bf16 rounding). Returns (out [n, d] f32 — caller
-    psums over tp — and group_sizes [E] int32, the per-expert choice
-    counts)."""
+    psums over tp — and group_sizes int32, the per-expert choice counts).
+
+    local_experts=(ep_idx, e_local): the expert-parallel form. p["we1/2"]
+    hold only this rank's e_local-expert shard, so the sort key places
+    slots routed to LOCAL experts first (grouped by local expert id) and
+    every foreign slot under a trailing sentinel group that no weight
+    group covers; foreign slots' gate weights are zeroed so the
+    scatter-add accumulates exactly the local experts' contributions (the
+    caller psums partial outputs over ep). group_sizes is then [e_local]
+    and the grouped matmuls pay only for this rank's activated slots —
+    the segments stay ragged end-to-end; nothing is shipped, because the
+    token set is already replicated over ep (see `_moe_mlp_dropless`)."""
     num_experts, k = cfg.n_experts, cfg.moe_top_k
     compute = cfg.dtype
     n, d = x_flat.shape
 
     expert_of = top_i.reshape(n * k)  # slot order: token-major
     tok_of = jnp.repeat(jnp.arange(n), k)
-    order = jnp.argsort(expert_of)  # contiguous per-expert segments
+    if local_experts is None:
+        key, n_groups, keep = expert_of, num_experts, None
+    else:
+        ep_idx, e_local = local_experts
+        n_groups = e_local
+        keep = (expert_of // e_local) == ep_idx  # slot's expert is mine
+        key = jnp.where(keep, expert_of - ep_idx * e_local, e_local)
+    order = jnp.argsort(key)  # contiguous per-(local-)expert segments
     sorted_tok = tok_of[order]
-    group_sizes = jnp.bincount(expert_of, length=num_experts).astype(
+    group_sizes = jnp.bincount(key, length=n_groups + 1)[:n_groups].astype(
         jnp.int32
     )
 
@@ -566,6 +606,11 @@ def sorted_ragged_expert_ffn(p, x_flat, top_w, top_i, cfg):
         preferred_element_type=compute,
     )
     w_sorted = top_w.reshape(n * k)[order]
+    if keep is not None:
+        # Rows past sum(group_sizes) belong to no weight group; zeroing
+        # their combine weight makes the partial output independent of
+        # whatever ragged_dot leaves in uncovered rows.
+        w_sorted = jnp.where(keep[order], w_sorted, 0.0)
     out = (
         jnp.zeros((n, d), jnp.float32)
         .at[sorted_tok]

@@ -464,3 +464,67 @@ def test_quantized_kv_cache_with_gqa_tracks_full_precision():
     assert kv8.shape == fp.shape
     agree = (kv8 == fp).mean()
     assert agree >= 0.5, f"GQA kv8 decode diverged everywhere ({agree=})"
+
+
+def test_quantized_kv_decode_logits_error_bounded():
+    """Pin the compounded int8+bf16 rounding on the quantized-KV serving
+    path (round-3 advisor): `_cache_read` dequantizes int8 KV straight to
+    the bf16 compute dtype, so each int8*scale product is rounded to 8
+    mantissa bits before the attention matmul. A teacher-forced
+    per-step LOGITS comparison (same params, same token stream, plain
+    bf16 cache vs int8 cache) bounds the accumulated error — tighter
+    evidence than the end-to-end token-agreement test, which tolerates
+    divergence after one near-tie pick."""
+    import dataclasses
+
+    from jobset_tpu.models.decode import (
+        _prefill_logits,
+        _token_logits,
+        init_kv_cache,
+    )
+
+    cfg = dataclasses.replace(_cfg(), dtype=jnp.bfloat16)
+    mesh = build_mesh(MeshConfig(), jax.devices()[:1])
+    params = init_params(jax.random.key(0), cfg, mesh)
+    rng = np.random.default_rng(9)
+    batch, t_prompt, t_total = 2, 5, 12
+    tokens = jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (batch, t_total)), jnp.int32
+    )
+
+    def teacher_forced_logits(quantized_kv):
+        cache0 = init_kv_cache(
+            cfg, mesh, batch, t_total, quantized_kv=quantized_kv
+        )
+
+        def local(params, tokens, cache):
+            outs = []
+            last, cache = _prefill_logits(
+                params, tokens[:, :t_prompt], cache, cfg
+            )
+            outs.append(last)
+            for pos in range(t_prompt, t_total):
+                last, cache = _token_logits(
+                    params, tokens[:, pos], cache, pos, cfg
+                )
+                outs.append(last)
+            return jnp.stack(outs)
+
+        return np.asarray(
+            jax.jit(
+                jax.shard_map(
+                    local, mesh=mesh, in_specs=(P(), P(), P()),
+                    out_specs=P(), check_vma=False,
+                )
+            )(params, tokens, cache0),
+            np.float32,
+        )
+
+    fp = teacher_forced_logits(False)
+    q8 = teacher_forced_logits(True)
+    scale = np.abs(fp).max()
+    rel = np.abs(fp - q8).max() / scale
+    assert rel < 0.1, f"quantized-KV logit error {rel=:.4f} vs scale {scale:.3f}"
+    # Greedy picks must agree at almost every teacher-forced step.
+    agree = (fp.argmax(-1) == q8.argmax(-1)).mean()
+    assert agree >= 0.85, f"teacher-forced argmax agreement {agree=}"

@@ -184,3 +184,31 @@ def test_ring_attention_uses_kernel_equivalently():
     np.testing.assert_allclose(
         np.asarray(interp), np.asarray(base), rtol=1e-5, atol=1e-5
     )
+
+
+def test_block_max_cotangent_dropped_by_contract():
+    """GRADIENT CONTRACT (module docstring; round-3 advisor): the
+    hand-written backward drops the `block_max` cotangent. That is exact
+    for every gauge-invariant consumer in-repo (the flash combine
+    re-normalizes, so the max shift cancels), but a loss that reads
+    block_max NON-gauge-invariantly — a max-logit / z-loss-style
+    regularizer on attention logits — gets a ZERO gradient from the
+    kernel where autodiff through the reference produces a real one.
+    This test pins that asymmetry so a future max-consuming caller hits
+    a failing assertion here instead of silently training with a dead
+    regularizer (the fix would be extending `_bwd` with the dmax term)."""
+    force_interpret()
+    q, k, v = _inputs()
+    bias = jnp.zeros((q.shape[1], k.shape[1]), jnp.float32)
+
+    def max_loss(fn, qq):
+        block_max, _, _ = fn(qq, k, v, bias)
+        return jnp.sum(block_max)
+
+    g_kernel = jax.grad(lambda qq: max_loss(block_attention, qq))(q)
+    g_ref = jax.grad(lambda qq: max_loss(block_attention_reference, qq))(q)
+    assert float(jnp.abs(g_kernel).max()) == 0.0, (
+        "kernel backward now propagates dmax — update the gradient "
+        "contract (module docstring + this test)"
+    )
+    assert float(jnp.abs(g_ref).max()) > 0.0

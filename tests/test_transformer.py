@@ -287,14 +287,123 @@ def test_dropless_moe_trains_and_matches_unbound_capacity():
     )
 
 
-def test_dropless_moe_validation_rejects_ep():
-    cfg = tiny_config(
-        n_experts=4, d_ff_expert=32, moe_top_k=2, moe_dispatch="dropless"
-    )
-    with pytest.raises(ValueError, match="dropless"):
-        cfg.validate(MeshConfig(ep=2))
+def test_dropless_moe_validation_rejects_bogus_dispatch():
     with pytest.raises(ValueError, match="moe_dispatch"):
         tiny_config(moe_dispatch="bogus").validate(MeshConfig())
+
+
+def test_distributed_dropless_moe_matches_single_expert_axis():
+    """Dropless at ep=2 (expert weights sharded, locality-keyed sorted
+    ragged matmuls, partial outputs psum'd over ep) is the SAME exact
+    no-drop math as ep=1 dropless — identical loss trajectories across
+    mesh shapes, the distributed-exactness contract from docs/roadmap.md.
+    Also cross-checked against the capacity path at no-drop capacity on
+    the SAME ep=2 mesh, pinning the aux-stats normalization (replicated
+    stats / ep vs summed disjoint chunks) to the global-batch value."""
+    cfg_kwargs = dict(
+        n_layers=2, n_experts=4, d_ff_expert=32, moe_top_k=2, remat=False,
+    )
+    losses = {}
+    for name, mc, overrides in (
+        ("ep1", MeshConfig(dp=1, pp=1, ep=1, sp=2, tp=2),
+         {"moe_dispatch": "dropless"}),
+        ("ep2", MeshConfig(dp=1, pp=1, ep=2, sp=2, tp=1),
+         {"moe_dispatch": "dropless"}),
+        ("ep2_capacity", MeshConfig(dp=1, pp=1, ep=2, sp=2, tp=1),
+         {"moe_capacity_factor": 100.0}),
+    ):
+        mesh = build_mesh(mc, allow_submesh=True)
+        cfg = tiny_config(**cfg_kwargs, **overrides)
+        cfg.validate(mc)
+        _, losses[name] = run_steps(cfg, mesh, make_batch(mesh, 64), steps=4)
+
+    assert all(np.isfinite(losses["ep2"]))
+    np.testing.assert_allclose(losses["ep2"], losses["ep1"], rtol=2e-4)
+    np.testing.assert_allclose(
+        losses["ep2"], losses["ep2_capacity"], rtol=2e-4
+    )
+
+
+def test_distributed_dropless_moe_with_dp():
+    """ep=2 x dp=2 dropless on a dp-sharded batch: the replicated-router
+    design must stay exact when the batch also shards over dp (the aux
+    stats pool over dp AND ep — the /ep normalization must compose with
+    the dp sum). Imbalanced-routing coverage lives in
+    test_dropless_ep_empty_local_group_exact, where routing is forced."""
+    mc = MeshConfig(dp=2, pp=1, ep=2, sp=1, tp=2)
+    mesh = build_mesh(mc)  # 8 devices: full virtual mesh
+    cfg = tiny_config(
+        n_layers=2, n_experts=2, d_ff_expert=32, moe_top_k=1,
+        moe_dispatch="dropless", moe_aux_coef=0.0, remat=False,
+    )
+    cfg.validate(mc)
+    _, losses = run_steps(cfg, mesh, make_batch(mesh, 64), steps=4)
+    assert all(np.isfinite(losses))
+
+    ref_mc = MeshConfig(dp=1, pp=1, ep=1, sp=1, tp=1)
+    ref_mesh = build_mesh(ref_mc, allow_submesh=True)
+    ref_cfg = tiny_config(
+        n_layers=2, n_experts=2, d_ff_expert=32, moe_top_k=1,
+        moe_dispatch="dropless", moe_aux_coef=0.0, remat=False,
+    )
+    ref_cfg.validate(ref_mc)
+    _, ref_losses = run_steps(ref_cfg, ref_mesh, make_batch(ref_mesh, 64), steps=4)
+    np.testing.assert_allclose(losses, ref_losses, rtol=2e-4)
+
+
+def test_dropless_ep_empty_local_group_exact():
+    """The all-foreign edge of distributed dropless: routing is FORCED
+    (positive activations, wg = [+1 column, -1 column]) so expert 0 takes
+    EVERY top-1 slot — on the ep=2 mesh rank 1's group_sizes are all
+    zero, every one of its slots is foreign (sort key = sentinel,
+    ragged_dot covers no rows), and its entire contribution must be the
+    zero partial. Output must equal the hand-computed dense reference;
+    a foreign-slot handling bug (uncovered-row garbage leaking through
+    nonzero combine weights) surfaces here, not under near-uniform
+    routing."""
+    from jobset_tpu.models.transformer import _moe_mlp_dropless
+
+    d, f, n_tok = 16, 8, 12
+    rng = np.random.default_rng(4)
+    # Positive activations + opposite-sign router columns: logit0 =
+    # sum(x) > 0 > -sum(x) = logit1 for every token, no exceptions.
+    xn = jnp.asarray(np.abs(rng.standard_normal((1, n_tok, d))) + 0.1)
+    wg = jnp.stack([jnp.ones((d,)), -jnp.ones((d,))], axis=1)  # [d, 2]
+    we1 = jnp.asarray(rng.standard_normal((2, d, f)), jnp.float32)
+    we2 = jnp.asarray(rng.standard_normal((2, f, d)), jnp.float32)
+
+    cfg = tiny_config(
+        d_model=d, n_experts=2, d_ff_expert=f, moe_top_k=1,
+        moe_dispatch="dropless",
+    )
+
+    def run(mc):
+        mesh = build_mesh(mc, allow_submesh=True)
+        out, stats = jax.jit(
+            jax.shard_map(
+                lambda p, x: _moe_mlp_dropless(p, x, cfg),
+                mesh=mesh,
+                in_specs=({"wg": P(), "we1": P("ep"), "we2": P("ep")}, P()),
+                out_specs=(P(), P()),
+                check_vma=False,
+            )
+        )({"wg": wg, "we1": we1, "we2": we2}, xn)
+        return np.asarray(out), np.asarray(stats)
+
+    out_ep2, stats_ep2 = run(MeshConfig(ep=2))
+    out_ep1, stats_ep1 = run(MeshConfig(ep=1))
+
+    # Forced skew: every slot on expert 0 (rank 1 exactly empty) — the
+    # pooled (x ep) global counts say so on both meshes.
+    np.testing.assert_allclose(stats_ep2[0] * 2, [n_tok, 0.0], atol=1e-6)
+    np.testing.assert_allclose(stats_ep1[0], [n_tok, 0.0], atol=1e-6)
+
+    # Exact vs the hand-computed dense formulation (top-1, weight 1.0).
+    expected = jax.nn.silu(xn.reshape(n_tok, d) @ we1[0]) @ we2[0]
+    np.testing.assert_allclose(
+        out_ep2.reshape(n_tok, d), np.asarray(expected), rtol=1e-5, atol=1e-5
+    )
+    np.testing.assert_allclose(out_ep2, out_ep1, rtol=1e-5, atol=1e-5)
 
 
 def test_moe_aux_loss_balances_expert_usage():
