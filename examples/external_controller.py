@@ -23,10 +23,12 @@ import sys
 import threading
 
 from jobset_tpu.client import (
+    EventInformer,
     JobInformer,
     JobSetClient,
     JobSetInformer,
     PodInformer,
+    ServiceInformer,
 )
 from jobset_tpu.testing import make_jobset, make_replicated_job
 
@@ -101,6 +103,27 @@ def main() -> int:
         poll_timeout=1.0,
     ).start()
 
+    # Services and cluster events complete the watchable surface (client-go
+    # generates informers for every type): the reconciler's headless
+    # DNS-rendezvous service arrives as a watch event, and the lifecycle
+    # event stream replaces any GET /api/v1/events polling.
+    svc_seen = threading.Event()
+    service_informer = ServiceInformer(
+        client,
+        on_add=lambda s: (
+            print(f"observed headless service: {s['metadata']['name']}"),
+            svc_seen.set(),
+        ),
+        poll_timeout=1.0,
+    ).start()
+    event_informer = EventInformer(
+        client,
+        on_add=lambda e: print(
+            f"observed cluster event: {e['reason']} ({e['type']})"
+        ),
+        poll_timeout=1.0,
+    ).start()
+
     js = build_jobset()
     created = client.create(js)
     print(f"created {created.metadata.name} (uid {created.metadata.uid})")
@@ -112,6 +135,9 @@ def main() -> int:
     # the informers see the status transition.
     if not children_ready.wait(timeout=10):
         print("child jobs never observed", file=sys.stderr)
+        return 1
+    if not svc_seen.wait(timeout=10):
+        print("headless service never observed", file=sys.stderr)
         return 1
     with server.lock:
         js_live = server.cluster.get_jobset("default", "external-demo")
@@ -131,6 +157,8 @@ def main() -> int:
     informer.stop()
     job_informer.stop()
     pod_informer.stop()
+    service_informer.stop()
+    event_informer.stop()
     server.stop()
     print("done")
     return 0

@@ -305,8 +305,12 @@ def _cmd_get(args) -> int:
         print(_yaml.safe_dump({"items": items}, sort_keys=False))
     else:
         for item in items:
-            meta = item.get("metadata", {})
-            print(meta.get("name") or f"{item.get('reason', '')}: {item.get('message', '')}")
+            if resource == "events":
+                # Events carry metadata.name (a journal seq id) for
+                # informer caches, but the human line is reason: message.
+                print(f"{item.get('reason', '')}: {item.get('message', '')}")
+                continue
+            print(item.get("metadata", {}).get("name", ""))
     return 0
 
 

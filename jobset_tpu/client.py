@@ -149,9 +149,12 @@ class JobSetClient:
 
     def _resource_path(self, kind: str, namespace: str) -> str:
         """Collection path for a watchable kind: jobsets live under the
-        group API, child jobs/pods under the core API."""
+        group API, child jobs/pods/services under the core API, and
+        cluster events at the cluster-scoped core path."""
         if kind == "jobsets":
             return self._collection(namespace)
+        if kind == "events":
+            return "/api/v1/events"
         return f"/api/v1/namespaces/{namespace}/{kind}"
 
     def watch(self, namespace="default", resource_version=0, timeout=15.0):
@@ -169,8 +172,9 @@ class JobSetClient:
         self, kind: str, namespace="default", resource_version=0, timeout=15.0
     ):
         """One long-poll watch for any journaled kind ("jobsets", "jobs",
-        "pods") — the client-go generated-informer analog for child
-        resources, so external controllers don't poll for child state."""
+        "pods", "services", "events") — the client-go generated-informer
+        analog covering EVERY type an external controller consumes, so
+        nothing needs polling."""
         path = (
             f"{self._resource_path(kind, namespace)}?watch=1"
             f"&resourceVersion={int(resource_version)}"
@@ -313,7 +317,7 @@ class WatchGone(ApiError):
 
 class ResourceInformer:
     """Event-driven object cache with handlers and periodic resync, for any
-    journaled kind ("jobsets", "jobs", "pods").
+    journaled kind ("jobsets", "jobs", "pods", "services", "events").
 
     The client-go shared-informer pattern over the controller's long-poll
     watch: `start()` lists (populating the cache and firing on_add), then a
@@ -386,6 +390,13 @@ class ResourceInformer:
                 "informer handler failed"
             )
 
+    # Whether a relist reconciles deletions (fires on_delete and evicts
+    # cached objects absent from the list). True for real objects, where
+    # absence means deletion; False for append-only record streams
+    # (events), where absence only means server retention trimmed them —
+    # the watcher owns its own retention.
+    RELIST_DELETES = True
+
     def _relist(self) -> None:
         items, rv = self.client.list_resource_with_version(
             self.kind, self.namespace
@@ -396,10 +407,13 @@ class ResourceInformer:
                 self._fire(self.on_add, obj)
             elif self.cache[name] != obj:
                 self._fire(self.on_update, self.cache[name], obj)
-        for name, obj in list(self.cache.items()):
-            if name not in fresh:
-                self._fire(self.on_delete, obj)
-        self.cache = fresh
+        if self.RELIST_DELETES:
+            for name, obj in list(self.cache.items()):
+                if name not in fresh:
+                    self._fire(self.on_delete, obj)
+            self.cache = fresh
+        else:
+            self.cache.update(fresh)
         self._rv = rv
 
     def _apply(self, event: dict) -> None:
@@ -467,3 +481,23 @@ class PodInformer(ResourceInformer):
     """Pod informer (client-go core/v1 Pod informer analog)."""
 
     KIND = "pods"
+
+
+class ServiceInformer(ResourceInformer):
+    """Headless-Service informer (client-go core/v1 Service informer
+    analog): watches the per-JobSet subdomain services the reconciler
+    materializes for DNS rendezvous."""
+
+    KIND = "services"
+
+
+class EventInformer(ResourceInformer):
+    """Cluster-event informer (client-go core/v1 Event informer analog).
+    Events are append-only records streamed by cursor (never MODIFIED;
+    no DELETED on retention trim), cached under their `evt-{seq}` name.
+    Relists never fire on_delete (RELIST_DELETES=False): an event absent
+    from a fresh list was trimmed by server retention, not deleted —
+    cache retention is this watcher's own concern."""
+
+    KIND = "events"
+    RELIST_DELETES = False

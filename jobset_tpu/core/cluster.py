@@ -77,7 +77,11 @@ class Cluster:
         self.nodes: dict[str, Node] = {}
         # Bounded like apiserver event retention (TTL there, count here): a
         # long-running controller must not grow event memory with churn.
+        # events_total counts every event ever recorded (Event.seq), so
+        # append-only consumers (the server's watch journal) stream by
+        # cursor without diffing the deque.
         self.events: deque[Event] = deque(maxlen=10000)
+        self.events_total = 0
 
         # Field indexes (jobset_controller.go:231-246, pod_controller.go:75-106).
         self.jobs_by_owner: dict[str, set[tuple[str, str]]] = {}
@@ -194,6 +198,7 @@ class Cluster:
             self.dirty_placement_job_keys.add(job_key)
 
     def record_event(self, kind: str, name: str, etype: str, reason: str, message: str):
+        self.events_total += 1
         self.events.append(
             Event(
                 object_kind=kind,
@@ -202,6 +207,7 @@ class Cluster:
                 reason=reason,
                 message=message,
                 time=self.clock.now(),
+                seq=self.events_total,
             )
         )
 

@@ -158,7 +158,10 @@ class Service:
 
 @dataclass(slots=True)
 class Event:
-    """Recorded cluster event (k8s Event analog)."""
+    """Recorded cluster event (k8s Event analog). `seq` is a
+    cluster-lifetime monotonic id — events are append-only, so the watch
+    journal streams them by cursor instead of snapshot-diffing the
+    bounded deque (and `evt-{seq}` gives informer caches a stable key)."""
 
     object_kind: str
     object_name: str
@@ -166,3 +169,4 @@ class Event:
     reason: str
     message: str
     time: float = 0.0
+    seq: int = 0

@@ -14,6 +14,7 @@ Endpoints (k8s-shaped paths so the client SDK reads naturally):
 * ``GET/PUT/DELETE  .../jobsets/{name}``   (PUT = spec update, admission-checked)
 * ``GET /api/v1/nodes``, ``POST /api/v1/nodes``, ``PATCH /api/v1/nodes/{name}``
 * ``GET /api/v1/namespaces/{ns}/pods|jobs|services``, ``GET /api/v1/events``
+  (all five kinds watchable via ``?watch=1`` long-polls on the journal)
 * ``GET /healthz``, ``GET /readyz``, ``GET /metrics``  (main.go:194-219 analog)
 
 Bodies are JSON or YAML manifests (Content-Type sniffed); responses JSON.
@@ -101,12 +102,28 @@ def _node_dict(node) -> dict:
 
 def _event_dict(e) -> dict:
     return {
+        # Stable identity for informer caches (client-go events are
+        # namespaced objects; ours are cluster-scoped records, so the
+        # lifetime-monotonic seq is the name).
+        "metadata": {"name": f"evt-{e.seq}", "namespace": "default"},
         "kind": e.object_kind,
         "name": e.object_name,
         "type": e.type,
         "reason": e.reason,
         "message": e.message,
         "time": e.time,
+    }
+
+
+def _service_dict(s) -> dict:
+    return {
+        "metadata": {
+            "name": s.metadata.name,
+            "namespace": s.metadata.namespace,
+            "uid": s.metadata.uid,
+        },
+        "selector": dict(s.selector),
+        "publishNotReadyAddresses": s.publish_not_ready_addresses,
     }
 
 
@@ -182,6 +199,11 @@ class ControllerServer:
         # list/watch (the list seeds the snapshot and returns the rv the
         # informer watches from, so no events are missed).
         self._watch_active: set[str] = {"jobsets"}
+        # Cluster events are append-only, so their journal entry point is a
+        # cursor over Event.seq, not a snapshot diff (entries the deque
+        # trimmed before a pump are simply never journaled; no DELETED —
+        # retention is the watcher's concern, as with apiserver event TTL).
+        self._events_cursor = 0
 
         host, _, port = address.rpartition(":")
         handler = self._make_handler()
@@ -284,6 +306,7 @@ class ControllerServer:
             ("jobsets", _jobset_summary, self.cluster.jobsets),
             ("jobs", _job_dict, self.cluster.jobs),
             ("pods", _pod_dict, self.cluster.pods),
+            ("services", _service_dict, self.cluster.services),
         )
         events = []  # (kind, namespace, event) — ns kept out-of-band
         # because the wire manifest omits a default namespace
@@ -309,6 +332,17 @@ class ControllerServer:
                 if key not in current:
                     events.append((kind, key[0], {"type": "DELETED", "object": obj}))
             self._watch_snapshots[kind] = current
+        # Cluster events: append-only cursor stream (see __init__ note).
+        if "events" in self._watch_active:
+            new = self.cluster.events_total - self._events_cursor
+            if new > 0:
+                tail = list(self.cluster.events)[-new:]  # deque may have
+                # trimmed past the cursor: only retained events stream
+                events.extend(
+                    ("events", "default", {"type": "ADDED", "object": _event_dict(e)})
+                    for e in tail
+                )
+                self._events_cursor = self.cluster.events_total
         if not events:
             return
         with self._watch_cond:
@@ -330,9 +364,16 @@ class ControllerServer:
         with self.lock:
             if kind in self._watch_active:
                 return
+            if kind == "events":
+                # Append-only: the activation list already returned every
+                # retained event; journal only what comes after.
+                self._events_cursor = self.cluster.events_total
+                self._watch_active.add(kind)
+                return
             to_dict, live = {
                 "jobs": (_job_dict, self.cluster.jobs),
                 "pods": (_pod_dict, self.cluster.pods),
+                "services": (_service_dict, self.cluster.services),
             }[kind]
             self._watch_snapshots[kind] = {
                 key: (obj.metadata.uid, to_dict(obj))
@@ -415,10 +456,10 @@ class ControllerServer:
         parts = [p for p in path.split("/") if p]
 
         # Watch requests block on the journal OUTSIDE the cluster lock so
-        # writes (and the pump) proceed while watchers wait. JobSets and
-        # their child jobs/pods are all watchable (client-go generates
-        # informers for every type; external controllers need child watches
-        # to avoid polling).
+        # writes (and the pump) proceed while watchers wait. JobSets, their
+        # child jobs/pods/services, and cluster events are all watchable
+        # (client-go generates informers for every type; external
+        # controllers need child watches to avoid polling).
         if method == "GET" and params.get("watch"):
             kind = ns = None
             if (
@@ -432,9 +473,13 @@ class ControllerServer:
                 parts[:2] == ["api", "v1"]
                 and len(parts) == 5
                 and parts[2] == "namespaces"
-                and parts[4] in ("pods", "jobs")
+                and parts[4] in ("pods", "jobs", "services")
             ):
                 kind, ns = parts[4], parts[3]
+            elif parts == ["api", "v1", "events"]:
+                # Cluster-scoped event stream; journaled under the default
+                # namespace marker.
+                kind, ns = "events", "default"
             if kind is not None:
                 try:
                     rv = int(params.get("resourceVersion", ["0"])[0])
@@ -595,7 +640,11 @@ class ControllerServer:
         if rest[:1] == ["nodes"]:
             return self._route_nodes(method, rest, body)
         if rest[:1] == ["events"] and method == "GET":
-            return 200, {"items": [_event_dict(e) for e in self.cluster.events]}
+            self._activate_watch_kind("events")
+            return 200, {
+                "items": [_event_dict(e) for e in self.cluster.events],
+                "resourceVersion": self._watch_rv,
+            }
         if len(rest) >= 3 and rest[0] == "namespaces":
             ns, resource = rest[1], rest[2]
             if method != "GET":
@@ -618,14 +667,13 @@ class ControllerServer:
                 ]
                 return 200, {"items": items, "resourceVersion": self._watch_rv}
             if resource == "services":
+                self._activate_watch_kind("services")
                 items = [
-                    {"metadata": {"name": s.metadata.name, "namespace": s.metadata.namespace},
-                     "selector": dict(s.selector),
-                     "publishNotReadyAddresses": s.publish_not_ready_addresses}
+                    _service_dict(s)
                     for (sns, _), s in sorted(self.cluster.services.items())
                     if sns == ns
                 ]
-                return 200, {"items": items}
+                return 200, {"items": items, "resourceVersion": self._watch_rv}
         return 404, {"error": "unknown core resource"}
 
     def _route_nodes(self, method: str, rest: list[str], body: bytes):
