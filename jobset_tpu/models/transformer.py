@@ -49,7 +49,7 @@ from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel.mesh import MeshConfig, axis_size, pvary_to, vma_union
-from ..parallel.pipeline import pipeline_apply
+from ..parallel.pipeline import pipeline_apply, pipeline_apply_interleaved
 from ..ops.flash_block import _repeat_heads as repeat_kv  # GQA broadcast
 from ..parallel.ring_attention import ring_attention
 from .quant import weight_cast
@@ -110,6 +110,18 @@ class TransformerConfig:
     #            off the MXU — the usual MFU-friendly operating point.
     remat_policy: str = "full"
     n_microbatches: int = 0  # 0 -> defaults to pp size
+    # Pipeline schedule over the pp axis:
+    #   "gpipe"       — one contiguous stage per rank; bubble
+    #                   (pp-1)/(n_micro+pp-1).
+    #   "interleaved" — pipeline_virtual chunks per rank (Megatron
+    #                   virtual stages); a microbatch wraps the ring
+    #                   pipeline_virtual times and the bubble shrinks
+    #                   ~pipeline_virtual-fold (parallel.pipeline
+    #                   docstring has the timetable). Same logical model:
+    #                   a GPipe layout converts exactly via
+    #                   `interleave_stage_params`.
+    pipeline_schedule: str = "gpipe"
+    pipeline_virtual: int = 1  # chunks per rank (interleaved only)
     # Chunk the loss over the time axis (0 = off): the unembed projection
     # and cross-entropy run per chunk under jax.checkpoint inside a scan,
     # so the [B, T, vocab] logits tensor — often the peak-memory term at
@@ -204,6 +216,22 @@ class TransformerConfig:
                 f"unknown remat_policy {self.remat_policy!r} "
                 "(expected 'full' or 'dots')"
             )
+        if self.pipeline_schedule not in ("gpipe", "interleaved"):
+            raise ValueError(
+                f"unknown pipeline_schedule {self.pipeline_schedule!r} "
+                "(expected 'gpipe' or 'interleaved')"
+            )
+        if self.pipeline_virtual < 1:
+            raise ValueError("pipeline_virtual must be >= 1")
+        if self.pipeline_schedule == "gpipe" and self.pipeline_virtual != 1:
+            raise ValueError("pipeline_virtual > 1 requires 'interleaved'")
+        if self.pipeline_schedule == "interleaved":
+            lps = self.n_layers // max(mc.pp, 1)
+            if lps % self.pipeline_virtual:
+                raise ValueError(
+                    f"layers per stage ({lps}) not divisible by "
+                    f"pipeline_virtual ({self.pipeline_virtual})"
+                )
         if self.attn_impl == "ulysses" and (self.n_heads // mc.tp) % mc.sp:
             raise ValueError(
                 f"ulysses attention requires heads-per-tp-rank "
@@ -855,6 +883,34 @@ def _sharded_softmax_xent(logits, targets, v_start, cfg):
 # ---------------------------------------------------------------------------
 
 
+def _run_pipeline(layers, x_mbs, cfg: TransformerConfig):
+    """Dispatch the configured pipeline schedule over this rank's stacked
+    layer shard. Returns (out [n_micro, mb, T_loc, d], aux_stats
+    [lps, 2, E]) — the interleaved path's chunk-stacked aux flattens back
+    to the same per-layer contract, so the loss-side pooling is schedule-
+    agnostic (chunk-major slot order matches interleave_stage_params)."""
+    stage_params = jax.tree.map(lambda a: a[0], layers)
+    lps = jax.tree.leaves(stage_params)[0].shape[0]
+    width = aux_stat_width(cfg)
+    if cfg.pipeline_schedule == "interleaved":
+        v = cfg.pipeline_virtual
+        lpc = lps // v
+        chunk_params = jax.tree.map(
+            lambda a: a.reshape(v, lpc, *a.shape[1:]), stage_params
+        )
+        out, aux_stats = pipeline_apply_interleaved(
+            partial(_stage_fn, cfg=cfg), chunk_params, x_mbs, v, "pp",
+            with_aux=True,
+            aux_init=jnp.zeros((lpc, 2, width), jnp.float32),
+        )
+        return out, aux_stats.reshape(lps, 2, width)
+    return pipeline_apply(
+        partial(_stage_fn, cfg=cfg), stage_params, x_mbs, "pp",
+        with_aux=True,
+        aux_init=jnp.zeros((lps, 2, width), jnp.float32),
+    )
+
+
 def _local_loss_fn(params, inputs, targets, mask, cfg: TransformerConfig, n_micro):
     """Runs on each device's shards; returns (loss_sum, token_count,
     aux_mean) — aux_mean is the globally-averaged MoE balancing loss."""
@@ -868,13 +924,8 @@ def _local_loss_fn(params, inputs, targets, mask, cfg: TransformerConfig, n_micr
         )
     x_mbs = x.reshape(n_micro, b_local // n_micro, *x.shape[1:])
 
-    stage_params = jax.tree.map(lambda a: a[0], params["layers"])
-    lps = jax.tree.leaves(stage_params)[0].shape[0]
-    out, aux_stats = pipeline_apply(
-        partial(_stage_fn, cfg=cfg), stage_params, x_mbs, "pp",
-        with_aux=True,
-        aux_init=jnp.zeros((lps, 2, aux_stat_width(cfg)), jnp.float32),
-    )  # out [n_micro, mb, T_loc, d]; aux_stats [lps, 2, E]
+    out, aux_stats = _run_pipeline(params["layers"], x_mbs, cfg)
+    # out [n_micro, mb, T_loc, d]; aux_stats [lps, 2, E]
     out = out.reshape(b_local, *out.shape[2:])
 
     xn = rms_norm(out, params["final_norm"], cfg.norm_eps)
@@ -1111,13 +1162,7 @@ def build_forward(config: TransformerConfig, mesh: Mesh):
         # (forward tolerates any batch; training enforces divisibility).
         mb_count = next(m for m in range(min(n_micro, b_local), 0, -1) if b_local % m == 0)
         x_mbs = x.reshape(mb_count, b_local // mb_count, *x.shape[1:])
-        stage_params = jax.tree.map(lambda a: a[0], params["layers"])
-        lps = jax.tree.leaves(stage_params)[0].shape[0]
-        out, _ = pipeline_apply(
-            partial(_stage_fn, cfg=cfg), stage_params, x_mbs, "pp",
-            with_aux=True,
-            aux_init=jnp.zeros((lps, 2, aux_stat_width(cfg)), jnp.float32),
-        )
+        out, _ = _run_pipeline(params["layers"], x_mbs, cfg)
         out = out.reshape(b_local, *out.shape[2:])
         # Broadcast the last stage's result to every pp rank.
         is_last = lax.axis_index("pp") == pp - 1

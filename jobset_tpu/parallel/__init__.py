@@ -10,7 +10,12 @@ from .mesh import (
     sharding,
     single_device_mesh,
 )
-from .pipeline import pipeline_apply
+from .pipeline import (
+    interleave_stage_params,
+    pipeline_apply,
+    pipeline_apply_interleaved,
+    schedule_steps,
+)
 from .ring_attention import ring_attention
 from .ulysses_attention import ulysses_attention
 from .zero import init_zero1_opt_state, zero1_opt_shardings
@@ -26,7 +31,10 @@ __all__ = [
     "default_mesh_config",
     "sharding",
     "single_device_mesh",
+    "interleave_stage_params",
     "pipeline_apply",
+    "pipeline_apply_interleaved",
+    "schedule_steps",
     "ring_attention",
     "ulysses_attention",
 ]
