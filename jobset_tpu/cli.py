@@ -130,6 +130,13 @@ def _build_parser() -> argparse.ArgumentParser:
     w.add_argument("--profile-dir",
                    help="capture a JAX profiler trace of the training run")
 
+    sub.add_parser(
+        "openapi",
+        help="print the OpenAPI (swagger v2) schema of the JobSet wire "
+             "format (the reference's hack/swagger artifact analog; feed "
+             "to openapi-generator for third-party SDKs)",
+    )
+
     return parser
 
 
@@ -484,8 +491,16 @@ def _cmd_worker(args) -> int:
     return worker_main(argv)
 
 
+def _cmd_openapi(args) -> int:
+    from .api.openapi import openapi_spec
+
+    print(json.dumps(openapi_spec(), indent=2, sort_keys=True))
+    return 0
+
+
 _COMMANDS = {
     "controller": _cmd_controller,
+    "openapi": _cmd_openapi,
     "solver": _cmd_solver,
     "apply": _cmd_apply,
     "get": _cmd_get,

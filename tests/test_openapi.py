@@ -1,0 +1,315 @@
+"""OpenAPI schema fidelity + standalone admission endpoints.
+
+The schema is only worth publishing if it provably matches the serializer
+(the reference generates both from one Go source; we pin the agreement
+with bidirectional tests instead): every manifest the serializer emits
+validates against the schema, and every property the schema declares is
+accepted by the serializer's strict mode.
+"""
+
+import base64
+import json
+
+import pytest
+
+from jobset_tpu.api import defaulting, serialization
+from jobset_tpu.api.openapi import (
+    _PREFIX,
+    _definitions,
+    openapi_spec,
+    validate_manifest,
+)
+
+MAXIMAL_MANIFEST = {
+    "apiVersion": "jobset.x-k8s.io/v1alpha2",
+    "kind": "JobSet",
+    "metadata": {
+        "name": "maximal",
+        "namespace": "default",
+        "labels": {"team": "ml"},
+        "annotations": {"note": "x"},
+        "generateName": "maximal-",
+    },
+    "spec": {
+        "replicatedJobs": [
+            {
+                "name": "workers",
+                "replicas": 2,
+                "template": {
+                    "metadata": {"labels": {"tier": "train"}},
+                    "spec": {
+                        "parallelism": 2,
+                        "completions": 2,
+                        "completionMode": "Indexed",
+                        "backoffLimit": 3,
+                        "suspend": False,
+                        "activeDeadlineSeconds": 600,
+                        "template": {
+                            "metadata": {"annotations": {"a": "b"}},
+                            "spec": {
+                                "restartPolicy": "OnFailure",
+                                "nodeSelector": {"pool": "tpu"},
+                                "tolerations": [
+                                    {"key": "tpu", "operator": "Exists",
+                                     "effect": "NoSchedule"}
+                                ],
+                                "subdomain": "maximal",
+                                "hostname": "w-0",
+                                "schedulingGates": [
+                                    {"name": "placement.gate"}
+                                ],
+                                "containers": [
+                                    {"name": "train", "image": "train:v1"}
+                                ],
+                            },
+                        },
+                    },
+                },
+            }
+        ],
+        "network": {
+            "enableDNSHostnames": True,
+            "subdomain": "maximal",
+            "publishNotReadyAddresses": True,
+        },
+        "successPolicy": {
+            "operator": "All", "targetReplicatedJobs": ["workers"],
+        },
+        "failurePolicy": {
+            "maxRestarts": 3,
+            "rules": [
+                {"name": "host_maint", "action": "RestartJobSet",
+                 "onJobFailureReasons": ["PodFailurePolicy"],
+                 "targetReplicatedJobs": ["workers"]}
+            ],
+        },
+        "startupPolicy": {"startupPolicyOrder": "InOrder"},
+        "suspend": False,
+        "coordinator": {
+            "replicatedJob": "workers", "jobIndex": 0, "podIndex": 0,
+        },
+        "managedBy": "jobset.x-k8s.io/jobset-controller",
+        "ttlSecondsAfterFinished": 300,
+    },
+}
+
+
+def test_serializer_output_validates_against_schema():
+    """serializer ⊆ schema: a maximal JobSet round-tripped through
+    defaulting + to_dict (with status populated) must validate cleanly —
+    anything the controller can emit is describable by the spec."""
+    js = defaulting.apply_defaults(serialization.from_dict(MAXIMAL_MANIFEST))
+    js.status.restarts = 1
+    js.status.terminal_state = ""
+    manifest = serialization.to_dict(js, include_status=True)
+    problems = validate_manifest(manifest)
+    assert problems == [], problems
+
+
+def _sample_for(schema, defs, depth=0):
+    """Generate a value inhabiting a schema node (every property set)."""
+    if "$ref" in schema:
+        return _sample_for(defs[schema["$ref"].rsplit("/", 1)[1]], defs, depth)
+    stype = schema.get("type")
+    if stype == "object":
+        props = schema.get("properties")
+        if props is None:
+            extra = schema.get("additionalProperties")
+            if isinstance(extra, dict):
+                return {"k": _sample_for(extra, defs, depth + 1)}
+            return {}
+        return {
+            k: _sample_for(v, defs, depth + 1) for k, v in props.items()
+        }
+    if stype == "array":
+        return [_sample_for(schema["items"], defs, depth + 1)]
+    if stype == "string":
+        return schema.get("enum", ["sample"])[0]
+    if stype == "integer":
+        return 1
+    if stype == "boolean":
+        return True
+    if stype is None:  # untyped (anything goes): a string inhabits it
+        return "sample"
+    raise AssertionError(f"unhandled schema node {schema}")
+
+
+def test_every_schema_property_accepted_by_serializer():
+    """schema ⊆ serializer: build a manifest with EVERY declared property
+    populated and strict-load it — if the schema invents a field the
+    serializer rejects, this fails with the unknown-field error."""
+    defs = _definitions()
+    sample = _sample_for(defs[f"{_PREFIX}.JobSet"], defs)
+    sample["apiVersion"] = serialization.API_VERSION
+    sample["kind"] = "JobSet"
+    js = serialization.from_dict(sample, strict=True)
+    assert js.spec.replicated_jobs[0].name == "sample"
+
+
+def test_validate_manifest_flags_problems():
+    bad = {
+        "kind": "JobSet",
+        "spec": {
+            "replicatedJobs": [{"replicas": "two"}],
+            "startupPolicy": {"startupPolicyOrder": "Sideways"},
+            "bogusField": 1,
+        },
+    }
+    problems = validate_manifest(bad)
+    text = "\n".join(problems)
+    assert "missing required 'name'" in text
+    assert "'Sideways' not in" in text
+    assert "unknown property 'bogusField'" in text
+    assert "expected integer" in text
+
+
+def test_openapi_spec_shape():
+    spec = openapi_spec()
+    assert spec["swagger"] == "2.0"
+    assert f"{_PREFIX}.JobSet" in spec["definitions"]
+    # Everything referenced resolves.
+    blob = json.dumps(spec)
+    for name in spec["definitions"]:
+        assert blob.count(name) >= 1
+
+
+# ---------------------------------------------------------------------------
+# Standalone admission endpoints (webhook_server_test.go analog)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def server():
+    from jobset_tpu.server import ControllerServer
+
+    s = ControllerServer("127.0.0.1:0", tick_interval=0.05).start()
+    yield s
+    s.stop()
+
+
+def _post_review(server, path, request):
+    import http.client
+
+    host, _, port = server.address.rpartition(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=10)
+    body = json.dumps({
+        "apiVersion": "admission.k8s.io/v1",
+        "kind": "AdmissionReview",
+        "request": request,
+    })
+    conn.request("POST", path, body=body,
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    out = json.loads(resp.read())
+    conn.close()
+    assert resp.status == 200, out
+    return out["response"]
+
+
+def _apply_json_patch(doc, patch):
+    """Tiny RFC 6902 apply (add/remove/replace) for the fidelity check."""
+    import copy
+
+    doc = copy.deepcopy(doc)
+    for op in patch:
+        tokens = [
+            t.replace("~1", "/").replace("~0", "~")
+            for t in op["path"].split("/")[1:]
+        ]
+        if not tokens:
+            doc = copy.deepcopy(op["value"])
+            continue
+        parent = doc
+        for t in tokens[:-1]:
+            parent = parent[int(t) if isinstance(parent, list) else t]
+        leaf = tokens[-1]
+        key = int(leaf) if isinstance(parent, list) else leaf
+        if op["op"] == "remove":
+            del parent[key]
+        else:  # add / replace on objects behave alike for our diff
+            parent[key] = op["value"]
+    return doc
+
+
+def test_mutate_endpoint_returns_defaulting_patch(server):
+    sparse = {
+        "apiVersion": "jobset.x-k8s.io/v1alpha2",
+        "kind": "JobSet",
+        # resourceVersion / serviceAccountName are NOT modeled: a mutating
+        # webhook must leave unrecognized fields untouched (no remove ops),
+        # exactly like the reference's patch-based defaulting.
+        "metadata": {"name": "sparse", "resourceVersion": "42"},
+        "spec": {"replicatedJobs": [{"name": "w", "template": {"spec": {
+            "template": {"spec": {"serviceAccountName": "train-sa"}},
+        }}}]},
+    }
+    resp = _post_review(
+        server, "/mutate-jobset-x-k8s-io-v1alpha2-jobset",
+        {"uid": "u-1", "operation": "CREATE", "object": sparse},
+    )
+    assert resp["allowed"] is True
+    assert resp["uid"] == "u-1"
+    assert resp["patchType"] == "JSONPatch"
+    patch = json.loads(base64.b64decode(resp["patch"]))
+    assert not any(op["op"] == "remove" for op in patch), patch
+    patched = _apply_json_patch(sparse, patch)
+    # Unmodeled fields survive the patch verbatim...
+    assert patched["metadata"]["resourceVersion"] == "42"
+    pod_spec = patched["spec"]["replicatedJobs"][0]["template"]["spec"][
+        "template"]["spec"]
+    assert pod_spec["serviceAccountName"] == "train-sa"
+    # ...and the modeled subset of the patched manifest IS the defaulted
+    # object (round-tripping strips the unmodeled fields again).
+    expected = serialization.to_dict(
+        defaulting.apply_defaults(serialization.from_dict(sparse))
+    )
+    assert serialization.to_dict(serialization.from_dict(patched)) == expected
+    # Defaulting actually did something (e.g. the network block).
+    assert patch, "defaulting produced an empty patch for a sparse manifest"
+
+
+def test_validate_endpoint_allows_and_denies(server):
+    good = dict(MAXIMAL_MANIFEST)
+    resp = _post_review(
+        server, "/validate-jobset-x-k8s-io-v1alpha2-jobset",
+        {"uid": "u-2", "operation": "CREATE", "object": good},
+    )
+    assert resp["allowed"] is True, resp
+
+    bad = json.loads(json.dumps(MAXIMAL_MANIFEST))
+    bad["spec"]["failurePolicy"]["rules"][0]["name"] = "Not A Valid Name!"
+    resp = _post_review(
+        server, "/validate-jobset-x-k8s-io-v1alpha2-jobset",
+        {"uid": "u-3", "operation": "CREATE", "object": bad},
+    )
+    assert resp["allowed"] is False
+    assert resp["status"]["message"]
+
+    # UPDATE: replicas are immutable while unsuspended.
+    old = json.loads(json.dumps(MAXIMAL_MANIFEST))
+    new = json.loads(json.dumps(MAXIMAL_MANIFEST))
+    new["spec"]["replicatedJobs"][0]["replicas"] = 7
+    resp = _post_review(
+        server, "/validate-jobset-x-k8s-io-v1alpha2-jobset",
+        {"uid": "u-4", "operation": "UPDATE", "object": new, "oldObject": old},
+    )
+    assert resp["allowed"] is False
+
+
+def test_openapi_served_and_cli_dump(server, capsys):
+    import http.client
+
+    host, _, port = server.address.rpartition(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=10)
+    conn.request("GET", "/openapi/v2")
+    resp = conn.getresponse()
+    doc = json.loads(resp.read())
+    conn.close()
+    assert resp.status == 200
+    assert f"{_PREFIX}.JobSet" in doc["definitions"]
+
+    from jobset_tpu.cli import main
+
+    assert main(["openapi"]) == 0
+    dumped = json.loads(capsys.readouterr().out)
+    assert dumped["definitions"].keys() == doc["definitions"].keys()
