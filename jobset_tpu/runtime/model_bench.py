@@ -287,8 +287,10 @@ def run_decode_bench(
     `measure_ttft` additionally times a max_new_tokens=1 program — batched
     prefill + first-token pick (the first token comes from the prefill
     logits; no cached decode step runs), i.e. time-to-first-token — at the
-    cost of one extra compile, so it is off in the budget-tight in-bench
-    phase and on in the standalone CLI."""
+    cost of one extra compile. Both the standalone CLI and the in-bench
+    fp decode point measure it (the persistent XLA cache amortizes the
+    compile across repeat captures; the in-bench int8 points skip it to
+    keep the phase inside its deadline)."""
     import jax
 
     from ..models import transformer
