@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Workflow-step JobSet orchestration (examples/argo-workflow analog).
+
+Drives `pipeline.yaml`: each step creates a JobSet through the typed
+client and WATCHES (long-poll, no polling loop) until its
+successCondition or failureCondition — expressions over the JobSet
+status, the same contract Argo's resource template evaluates
+(`successCondition: status.terminalState == Completed`) — holds. Steps
+run strictly in order; a failed condition stops the pipeline.
+
+Run it self-contained (boots an in-process controller; the simulated
+cluster has no kubelet, so the script also plays "the workload
+finishes" by completing each step's jobs):
+
+    python examples/workflow/run_pipeline.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import threading
+
+import yaml
+
+from jobset_tpu.client import JobSetClient, JobSetInformer
+
+# The condition language is the subset the reference example uses:
+# `status.<field> == <value>` (k8s field-selector style).
+def _condition_holds(manifest: dict, expr: str) -> bool:
+    lhs, _, rhs = expr.partition("==")
+    path, value = lhs.strip().split("."), rhs.strip()
+    node = manifest
+    for part in path:
+        node = node.get(part, {}) if isinstance(node, dict) else {}
+    return node == value
+
+
+def run_step(client, server, step: dict, timeout: float = 30.0) -> bool:
+    """Create the step's JobSet; watch until success/failure condition."""
+    manifest = step["manifest"]
+    name = manifest["metadata"]["name"]
+    outcome: dict = {}
+    decided = threading.Event()
+
+    def check(js: dict) -> None:
+        # Gate on THIS step's JobSet only: the informer also fires for
+        # earlier steps' (still present, already Completed) JobSets.
+        if js.get("metadata", {}).get("name") != name:
+            return
+        if _condition_holds(js, step["failureCondition"]):
+            outcome["ok"] = False
+            decided.set()
+        elif _condition_holds(js, step["successCondition"]):
+            outcome["ok"] = True
+            decided.set()
+
+    informer = JobSetInformer(
+        client,
+        on_add=check,
+        on_update=lambda _old, new: check(new),
+        poll_timeout=1.0,
+    ).start()
+    try:
+        created = client.create(yaml.safe_dump(manifest))
+        print(f"step {step['name']}: created JobSet {created.metadata.name}")
+
+        # No kubelet in the simulator: complete the jobs so the JobSet
+        # reaches its terminal state (a real deployment's workloads do
+        # this by finishing).
+        with server.lock:
+            js = server.cluster.get_jobset("default", name)
+            server.cluster.complete_all_jobs(js)
+        server.pump()  # reconcile to terminal state + refresh the journal
+
+        if not decided.wait(timeout):
+            print(f"step {step['name']}: no condition held in time",
+                  file=sys.stderr)
+            return False
+        print(f"step {step['name']}: "
+              f"{'succeeded' if outcome['ok'] else 'FAILED'}")
+        return outcome["ok"]
+    finally:
+        informer.stop()
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "pipeline", nargs="?",
+        default=os.path.join(os.path.dirname(__file__), "pipeline.yaml"),
+    )
+    args = parser.parse_args()
+
+    from jobset_tpu.server import ControllerServer
+
+    with open(args.pipeline) as f:
+        pipeline = yaml.safe_load(f)
+
+    server = ControllerServer("127.0.0.1:0", tick_interval=0.05).start()
+    client = JobSetClient(server.address)
+    print(f"pipeline {pipeline['metadata']['name']}: "
+          f"{len(pipeline['steps'])} steps at {server.address}")
+
+    ok = True
+    for step in pipeline["steps"]:
+        if not run_step(client, server, step):
+            ok = False
+            break
+    server.stop()
+    print("pipeline", "completed" if ok else "failed")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

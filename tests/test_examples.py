@@ -16,7 +16,12 @@ from jobset_tpu.core import make_cluster
 
 EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples")
 ALL_MANIFESTS = sorted(
-    glob.glob(os.path.join(EXAMPLES, "**", "*.yaml"), recursive=True)
+    p
+    for p in glob.glob(os.path.join(EXAMPLES, "**", "*.yaml"), recursive=True)
+    # Not JobSet manifests: the Prometheus scrape config and the workflow
+    # pipeline (kind Pipeline with EMBEDDED JobSet manifests) have their
+    # own dedicated tests below.
+    if "/prometheus/" not in p and not p.endswith("workflow/pipeline.yaml")
 )
 
 # Control-plane-only examples: no training workload, cheap to run to a
@@ -125,3 +130,56 @@ def test_serve_demo_example_runs():
     res = _run_example_script("serve_demo.py", timeout=240)
     assert res.returncode == 0, res.stdout + res.stderr
     assert "greedy:" in res.stdout and "done" in res.stdout
+
+
+def test_workflow_pipeline_example_runs():
+    """The workflow-step orchestration example (argo-workflow analog):
+    each step creates a JobSet and gates on status conditions via the
+    watch; the two-step pipeline must complete."""
+    res = _run_example_script("workflow/run_pipeline.py", timeout=90)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "step train: succeeded" in res.stdout
+    assert "step eval: succeeded" in res.stdout
+    assert "pipeline completed" in res.stdout
+
+
+def test_workflow_pipeline_embedded_manifests_validate():
+    """The pipeline's embedded JobSet manifests are real manifests: they
+    must strict-load and validate like every stand-alone example."""
+    import yaml
+
+    with open(os.path.join(EXAMPLES, "workflow", "pipeline.yaml")) as f:
+        pipeline = yaml.safe_load(f)
+    assert len(pipeline["steps"]) == 2
+    for step in pipeline["steps"]:
+        for expr in (step["successCondition"], step["failureCondition"]):
+            assert "status.terminalState" in expr
+        js = api.from_dict(step["manifest"], strict=True)
+        apply_defaults(js)
+        assert not validate_create(js), step["name"]
+
+
+def test_prometheus_example_config_parses():
+    """The scrape config (prometheus-operator analog) stays valid YAML
+    pointing at the controller's /metrics path, and every metric name the
+    README's example queries reference actually exists in the exposition
+    output (dashboard queries must not rot silently)."""
+    import re
+
+    import yaml
+
+    with open(os.path.join(EXAMPLES, "prometheus", "prometheus.yaml")) as f:
+        cfg = yaml.safe_load(f)
+    (job,) = cfg["scrape_configs"]
+    assert job["metrics_path"] == "/metrics"
+    assert job["static_configs"][0]["targets"]
+
+    from jobset_tpu.core import metrics
+
+    metrics.reset()
+    exposition = metrics.render_prometheus()
+    with open(os.path.join(EXAMPLES, "prometheus", "README.md")) as f:
+        readme = f.read()
+    for name in re.findall(r"`([a-z0-9_]+_total|[a-z0-9_]+_bucket)", readme):
+        base = name.removesuffix("_bucket")
+        assert base in exposition, f"README query metric {name} not exposed"
