@@ -267,3 +267,36 @@ def test_lm_workload_with_held_out_eval(tmp_path):
     val_loss = float(js.metadata.annotations["tpu.jobset.x-k8s.io/val-loss"])
     initial = float(js.metadata.annotations["tpu.jobset.x-k8s.io/initial-loss"])
     assert np.isfinite(val_loss) and val_loss < initial
+
+
+def test_lm_workload_interleaved_pipeline_schedule():
+    """pipeline_schedule/pipeline_virtual flow through the workload
+    manifest as ordinary TransformerConfig fields: training on the
+    interleaved schedule completes through the runner engine (the same
+    pipeline-schedule knobs as examples/training/lm-pp-interleaved.yaml,
+    on tinier shapes)."""
+    cluster, js, runner = build(
+        {
+            "kind": "lm",
+            "steps": 2,
+            "batch_size": 4,
+            "seq_len": 16,
+            "mesh": {"pp": 2, "tp": 2},
+            "config": {
+                "vocab_size": 64,
+                "d_model": 32,
+                "n_heads": 4,
+                "d_ff": 64,
+                "n_layers": 4,
+                "n_microbatches": 4,
+                "pipeline_schedule": "interleaved",
+                "pipeline_virtual": 2,
+                "remat": False,
+            },
+        }
+    )
+    runner.run_pending()
+    assert js.status.terminal_state == keys.JOBSET_COMPLETED
+    initial = float(js.metadata.annotations["tpu.jobset.x-k8s.io/initial-loss"])
+    final = float(js.metadata.annotations["tpu.jobset.x-k8s.io/final-loss"])
+    assert final < initial
