@@ -818,21 +818,27 @@ def run_model_phase(args, sink: dict, emit=None) -> None:
     if emit is not None:
         emit()
 
-    # Flash-kernel tile sweep (roadmap "Flash tile sweep"): 128x128 is the
-    # proven-safe Mosaic default; 256x256 quarters the grid steps for
-    # longer MXU bursts at 4x the VMEM residency per tile. The override is
-    # resolved at trace time (ops/flash_block._tile_env), so setting the
-    # env before rebuilding the train step is sufficient — no re-import.
-    # Both points measured back-to-back with identical steps so the
-    # comparison is not colored by the batch sweep's different step count.
+    # Flash-kernel tile sweep (roadmap "Flash tile sweep"). The override
+    # is resolved at trace time (ops/flash_block._tile_env), so setting
+    # the env before rebuilding the train step suffices — no re-import —
+    # and all points run back-to-back with identical steps so the
+    # comparison is not colored by the batch sweep's different step
+    # count. Point order = likelihood of being the winner (points bank
+    # incrementally, so a phase deadline mid-sweep keeps everything
+    # measured so far): square 128 is the Mosaic-proven default, 256
+    # quarters the grid for longer MXU bursts, then two asymmetric
+    # shapes — a taller q tile amortizes the K/V stream over more rows
+    # per pass, a wider k tile lengthens each row's inner loop. All well
+    # inside VMEM (the f32 scratch is tile_q-bound: 512x128x4x3 < 1 MB).
     sink["tile_sweep"] = []
-    for tile in (128, 256):
+    for tile_q, tile_k in ((128, 128), (256, 256), (512, 256), (256, 512)):
         try:
-            os.environ["JOBSET_TPU_FLASH_TILE_Q"] = str(tile)
-            os.environ["JOBSET_TPU_FLASH_TILE_K"] = str(tile)
+            os.environ["JOBSET_TPU_FLASH_TILE_Q"] = str(tile_q)
+            os.environ["JOBSET_TPU_FLASH_TILE_K"] = str(tile_k)
             r = run_model_bench(steps=8, warmup=2, batch=8, loss_chunk=use_chunk)
             sink["tile_sweep"].append({
-                "tile": tile,
+                "tile_q": tile_q,
+                "tile_k": tile_k,
                 "step_time_ms": r["step_time_ms"],
                 "tokens_per_sec": r["tokens_per_sec"],
                 "mfu_pct": r["mfu_pct"],
@@ -840,9 +846,10 @@ def run_model_phase(args, sink: dict, emit=None) -> None:
         except _PhaseTimeout:
             raise
         except Exception as exc:  # noqa: BLE001 — must not cost banked points
-            sink["tile_sweep"].append(
-                {"tile": tile, "error": f"{type(exc).__name__}: {exc}"[:200]}
-            )
+            sink["tile_sweep"].append({
+                "tile_q": tile_q, "tile_k": tile_k,
+                "error": f"{type(exc).__name__}: {exc}"[:200],
+            })
         finally:
             os.environ.pop("JOBSET_TPU_FLASH_TILE_Q", None)
             os.environ.pop("JOBSET_TPU_FLASH_TILE_K", None)
