@@ -66,6 +66,12 @@ def main() -> int:
         help="capture a JAX profiler trace of the timed region into this "
              "directory (open with TensorBoard/XProf)",
     )
+    parser.add_argument(
+        "--loss-chunk", type=int, default=0,
+        help="memory-bounded cross-entropy chunk (0 = off): caps resident "
+             "logits at [B, chunk, vocab] — required headroom for long "
+             "sequences and the large-model config on one chip",
+    )
     args = parser.parse_args()
 
     from bench import _cpu_forced, _force_cpu
@@ -97,6 +103,7 @@ def main() -> int:
         seq_len=args.seq_len,
         config=cfg,
         profile_dir=args.profile_dir,
+        loss_chunk=args.loss_chunk,
     )
     if args.decode:
         from jobset_tpu.runtime.model_bench import run_decode_bench
