@@ -831,30 +831,43 @@ def run_model_phase(args, sink: dict, emit=None) -> None:
     # per pass, a wider k tile lengthens each row's inner loop. All well
     # inside VMEM (the f32 scratch is tile_q-bound: 512x128x4x3 < 1 MB).
     sink["tile_sweep"] = []
-    for tile_q, tile_k in ((128, 128), (256, 256), (512, 256), (256, 512)):
-        try:
-            os.environ["JOBSET_TPU_FLASH_TILE_Q"] = str(tile_q)
-            os.environ["JOBSET_TPU_FLASH_TILE_K"] = str(tile_k)
-            r = run_model_bench(steps=8, warmup=2, batch=8, loss_chunk=use_chunk)
-            sink["tile_sweep"].append({
-                "tile_q": tile_q,
-                "tile_k": tile_k,
-                "step_time_ms": r["step_time_ms"],
-                "tokens_per_sec": r["tokens_per_sec"],
-                "mfu_pct": r["mfu_pct"],
-            })
-        except _PhaseTimeout:
-            raise
-        except Exception as exc:  # noqa: BLE001 — must not cost banked points
-            sink["tile_sweep"].append({
-                "tile_q": tile_q, "tile_k": tile_k,
-                "error": f"{type(exc).__name__}: {exc}"[:200],
-            })
-        finally:
-            os.environ.pop("JOBSET_TPU_FLASH_TILE_Q", None)
-            os.environ.pop("JOBSET_TPU_FLASH_TILE_K", None)
-        if emit is not None:
-            emit()
+    # Restore (not clear) any operator-set override afterwards: tiles are
+    # resolved lazily per trace, so clearing would silently flip the
+    # later long-context/large-model/profile points back to the default.
+    saved_tiles = {
+        k: os.environ.get(k)
+        for k in ("JOBSET_TPU_FLASH_TILE_Q", "JOBSET_TPU_FLASH_TILE_K")
+    }
+    try:
+        for tile_q, tile_k in ((128, 128), (256, 256), (512, 256), (256, 512)):
+            try:
+                os.environ["JOBSET_TPU_FLASH_TILE_Q"] = str(tile_q)
+                os.environ["JOBSET_TPU_FLASH_TILE_K"] = str(tile_k)
+                r = run_model_bench(
+                    steps=8, warmup=2, batch=8, loss_chunk=use_chunk
+                )
+                sink["tile_sweep"].append({
+                    "tile_q": tile_q,
+                    "tile_k": tile_k,
+                    "step_time_ms": r["step_time_ms"],
+                    "tokens_per_sec": r["tokens_per_sec"],
+                    "mfu_pct": r["mfu_pct"],
+                })
+            except _PhaseTimeout:
+                raise
+            except Exception as exc:  # noqa: BLE001 — must not cost banked points
+                sink["tile_sweep"].append({
+                    "tile_q": tile_q, "tile_k": tile_k,
+                    "error": f"{type(exc).__name__}: {exc}"[:200],
+                })
+            if emit is not None:
+                emit()
+    finally:
+        for k, v in saved_tiles.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
 
     # Long-context point (banked independently like every sweep point):
     # seq 4096 exercises the blockwise/flash attention path where the
