@@ -82,8 +82,10 @@ class TransformerConfig:
     #                (switch-style; overflow drops; the distributed path);
     #   "dropless" — exact sorted ragged grouped matmuls (MegaBlocks
     #                -style, lax.ragged_dot): no capacity, no drops, paying
-    #                only activated FLOPs. Requires ep == 1 (the ragged
-    #                segments have no static all_to_all shape).
+    #                only activated FLOPs. Works at any ep: each ep shard
+    #                runs the ragged path over its locally-owned experts
+    #                (locality-keyed sort, no dispatch collective) and one
+    #                psum combines — see _moe_mlp_dropless.
     moe_dispatch: str = "capacity"
     # Router family for n_experts > 0: "token" = token-choice (dense soft
     # dispatch at moe_top_k=0, switch-style top-k routing otherwise);
