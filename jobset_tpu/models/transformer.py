@@ -49,7 +49,11 @@ from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel.mesh import MeshConfig, axis_size, pvary_to, vma_union
-from ..parallel.pipeline import pipeline_apply, pipeline_apply_interleaved
+from ..parallel.pipeline import (
+    pipeline_1f1b_grads,
+    pipeline_apply,
+    pipeline_apply_interleaved,
+)
 from ..ops.flash_block import _repeat_heads as repeat_kv  # GQA broadcast
 from ..parallel.ring_attention import ring_attention
 from .quant import weight_cast
@@ -114,14 +118,25 @@ class TransformerConfig:
     n_microbatches: int = 0  # 0 -> defaults to pp size
     # Pipeline schedule over the pp axis:
     #   "gpipe"       — one contiguous stage per rank; bubble
-    #                   (pp-1)/(n_micro+pp-1).
+    #                   (pp-1)/(n_micro+pp-1); activation memory grows
+    #                   with n_micro (one autodiff'd scan).
     #   "interleaved" — pipeline_virtual chunks per rank (Megatron
     #                   virtual stages); a microbatch wraps the ring
     #                   pipeline_virtual times and the bubble shrinks
     #                   ~pipeline_virtual-fold (parallel.pipeline
     #                   docstring has the timetable). Same logical model:
     #                   a GPipe layout converts exactly via
-    #                   `interleave_stage_params`.
+    #                   `interleave_stage_params`. Activation memory
+    #                   still grows with n_micro.
+    #   "1f1b"        — memory-capped 1F1B: per-microbatch VJPs driven by
+    #                   a host-built timetable bound in-flight activations
+    #                   to ~pp microbatches regardless of n_micro
+    #                   (pipeline_1f1b_grads). Training-path only (eval /
+    #                   plain forward fall back to the gpipe wavefront);
+    #                   dense models only for now (n_experts == 0 — the
+    #                   routed balancing aux is normalized over the GLOBAL
+    #                   batch, which a schedule that starts backwards
+    #                   before all forwards finish cannot see).
     pipeline_schedule: str = "gpipe"
     pipeline_virtual: int = 1  # chunks per rank (interleaved only)
     # Chunk the loss over the time axis (0 = off): the unembed projection
@@ -218,15 +233,22 @@ class TransformerConfig:
                 f"unknown remat_policy {self.remat_policy!r} "
                 "(expected 'full' or 'dots')"
             )
-        if self.pipeline_schedule not in ("gpipe", "interleaved"):
+        if self.pipeline_schedule not in ("gpipe", "interleaved", "1f1b"):
             raise ValueError(
                 f"unknown pipeline_schedule {self.pipeline_schedule!r} "
-                "(expected 'gpipe' or 'interleaved')"
+                "(expected 'gpipe', 'interleaved' or '1f1b')"
             )
         if self.pipeline_virtual < 1:
             raise ValueError("pipeline_virtual must be >= 1")
-        if self.pipeline_schedule == "gpipe" and self.pipeline_virtual != 1:
+        if self.pipeline_schedule != "interleaved" and self.pipeline_virtual != 1:
             raise ValueError("pipeline_virtual > 1 requires 'interleaved'")
+        if self.pipeline_schedule == "1f1b" and self.n_experts:
+            raise ValueError(
+                "pipeline_schedule='1f1b' supports dense models only for "
+                "now (n_experts == 0): the routed balancing aux is "
+                "normalized over the global batch, which 1F1B cannot see "
+                "before its first backward"
+            )
         if self.pipeline_schedule == "interleaved":
             lps = self.n_layers // max(mc.pp, 1)
             if lps % self.pipeline_virtual:
@@ -913,6 +935,40 @@ def _run_pipeline(layers, x_mbs, cfg: TransformerConfig):
     )
 
 
+def _token_ce(params_view, xn, targets, cfg: TransformerConfig):
+    """Per-token cross-entropy [B, T] from final hidden states, honoring
+    `loss_chunk`: time chunks scan under jax.checkpoint so only
+    [B, chunk, V_local] logits are ever resident (numerically exact — the
+    loss is a per-token sum). `params_view` needs only the unembedding
+    keys (`embed` when tied, else `unembed`) — the train paths pass the
+    full param tree, the 1F1B head passes just its head slice."""
+    b, t_local = xn.shape[0], xn.shape[1]
+
+    def token_losses(xn_c, targets_c):
+        logits = unembed_logits(params_view, xn_c, cfg)
+        v_start = lax.axis_index("tp") * logits.shape[-1]
+        return _sharded_softmax_xent(logits, targets_c, v_start, cfg)
+
+    if cfg.loss_chunk and cfg.loss_chunk < t_local:
+        if t_local % cfg.loss_chunk:
+            raise ValueError(
+                f"loss_chunk {cfg.loss_chunk} must divide the local "
+                f"sequence length {t_local}"
+            )
+        nc = t_local // cfg.loss_chunk
+        xn_c = jnp.moveaxis(
+            xn.reshape(b, nc, cfg.loss_chunk, xn.shape[-1]), 1, 0
+        )
+        tg_c = jnp.moveaxis(targets.reshape(b, nc, cfg.loss_chunk), 1, 0)
+
+        def body(_, ct):
+            return None, jax.checkpoint(token_losses)(*ct)
+
+        _, per_chunks = lax.scan(body, None, (xn_c, tg_c))
+        return jnp.moveaxis(per_chunks, 0, 1).reshape(b, t_local)
+    return token_losses(xn, targets)
+
+
 def _local_loss_fn(params, inputs, targets, mask, cfg: TransformerConfig, n_micro):
     """Runs on each device's shards; returns (loss_sum, token_count,
     aux_mean) — aux_mean is the globally-averaged MoE balancing loss."""
@@ -931,35 +987,7 @@ def _local_loss_fn(params, inputs, targets, mask, cfg: TransformerConfig, n_micr
     out = out.reshape(b_local, *out.shape[2:])
 
     xn = rms_norm(out, params["final_norm"], cfg.norm_eps)
-
-    def token_losses(xn_c, targets_c):
-        logits = unembed_logits(params, xn_c, cfg)
-        v_start = lax.axis_index("tp") * logits.shape[-1]
-        return _sharded_softmax_xent(logits, targets_c, v_start, cfg)
-
-    t_local = xn.shape[1]
-    if cfg.loss_chunk and cfg.loss_chunk < t_local:
-        # Memory-bounded loss: scan time chunks with recompute-on-backward,
-        # so only [B, chunk, V_local] logits are ever resident.
-        if t_local % cfg.loss_chunk:
-            raise ValueError(
-                f"loss_chunk {cfg.loss_chunk} must divide the local "
-                f"sequence length {t_local}"
-            )
-        nc = t_local // cfg.loss_chunk
-        xn_c = xn.reshape(b_local, nc, cfg.loss_chunk, xn.shape[-1])
-        xn_c = jnp.moveaxis(xn_c, 1, 0)  # [nc, B, chunk, d]
-        tg_c = jnp.moveaxis(
-            targets.reshape(b_local, nc, cfg.loss_chunk), 1, 0
-        )
-
-        def body(_, ct):
-            return None, jax.checkpoint(token_losses)(*ct)
-
-        _, per_chunks = lax.scan(body, None, (xn_c, tg_c))
-        per_token = jnp.moveaxis(per_chunks, 0, 1).reshape(b_local, t_local)
-    else:
-        per_token = token_losses(xn, targets)
+    per_token = _token_ce(params, xn, targets, cfg)
 
     is_last = lax.axis_index("pp") == pp - 1
     per_token = jnp.where(is_last, per_token * mask, 0.0)
@@ -1007,6 +1035,117 @@ def _local_loss_fn(params, inputs, targets, mask, cfg: TransformerConfig, n_micr
     return _reduce(jnp.sum(per_token)), _reduce(count), aux_mean
 
 
+def _local_grads_1f1b(params, inputs, targets, mask, cfg: TransformerConfig, n_micro):
+    """1F1B training path: (loss, grads) via `pipeline_1f1b_grads`.
+
+    The memory-capped schedule is not a differentiable forward, so this
+    path cannot go through jax.value_and_grad — it assembles the full
+    gradient tree from the primitive's per-rank pieces:
+
+    * The embedding runs (and is differentiated) OUTSIDE the pipeline:
+      its VJP closes over the fed-microbatch cotangents the primitive
+      returns from rank 0.
+    * The loss head (final norm + unembed + CE) runs INSIDE the last
+      rank's backward phase, per microbatch, with the global 1/token
+      normalization folded in (the token count is data-only, so it is
+      known before the pipeline starts).
+    * Reductions: the primitive promotes params to the loop's varying
+      set, so each gradient leaf comes back UNREDUCED over exactly the
+      axes the promotion added. Each leaf is psummed over (loop vma −
+      its original vma) — the same reduction autodiff's pvary transpose
+      would have inserted, paid once instead of per scan step.
+    """
+    pp = lax.psum(1, "pp")
+    b_local = inputs.shape[0]
+    if b_local % n_micro:
+        raise ValueError(
+            f"per-device batch {b_local} must be divisible by "
+            f"n_microbatches {n_micro} (global batch % (dp * n_microbatches) == 0)"
+        )
+    mb = b_local // n_micro
+
+    # Global (batch-wide) token count: data-only, so the per-microbatch
+    # head can normalize by it up front. Replicated over pp/tp/ep.
+    count = lax.psum(
+        pvary_to(jnp.sum(mask), frozenset({"dp", "sp"})), ("dp", "sp")
+    )
+    scale = 1.0 / jnp.maximum(count, 1.0)
+
+    x, embed_vjp = jax.vjp(
+        lambda e: _embed_tokens(e, inputs, cfg), params["embed"]
+    )
+    x_mbs = x.reshape(n_micro, mb, *x.shape[1:])
+    t_local = x.shape[1]
+    mbt = targets.reshape(n_micro, mb, t_local)
+    mbm = mask.reshape(n_micro, mb, t_local)
+
+    stage_params = jax.tree.map(lambda a: a[0], params["layers"])
+
+    def stage_plain(sp, xx):
+        return _stage_fn(sp, xx, cfg=cfg)[0]
+
+    head_params = {"final_norm": params["final_norm"]}
+    if cfg.tie_embeddings:
+        head_params["embed"] = params["embed"]
+    else:
+        head_params["unembed"] = params["unembed"]
+
+    def head_fn(hp, y, b):
+        xn = rms_norm(y, hp["final_norm"], cfg.norm_eps)
+        tgt = lax.dynamic_index_in_dim(mbt, b, 0, keepdims=False)
+        msk = lax.dynamic_index_in_dim(mbm, b, 0, keepdims=False)
+        per_token = _token_ce(hp, xn, tgt, cfg)
+        return jnp.sum(per_token * msk) * scale
+
+    # tp is a REPLICATION axis for the loss value (every tp shard computes
+    # the same scalar after its internal psums) — the primitive divides
+    # the objective by |tp| so the device-summed objective is the true
+    # loss and the uniform psum reduction below is exact.
+    loss, g_stage, g_head, dmb = pipeline_1f1b_grads(
+        stage_plain, head_fn, stage_params, head_params, x_mbs, "pp",
+        replicated_axes=("tp",),
+    )
+
+    # Per-leaf reduction: psum over exactly the axes the loop promoted
+    # beyond the leaf's own varying set (dp/sp always; tp for leaves not
+    # tp-sharded; pp for the replicated head/embed leaves).
+    loop_vma = vma_union(g_stage, g_head, dmb)
+
+    def _reduce_like(orig_tree, grad_tree):
+        def red(o, g):
+            missing = tuple(loop_vma - vma_union(o))
+            return lax.psum(g, missing) if missing else g
+
+        return jax.tree.map(red, orig_tree, grad_tree)
+
+    g_stage = _reduce_like(stage_params, g_stage)
+    g_head = _reduce_like(
+        {k: params[k] for k in head_params}, g_head
+    )
+
+    # Fed-microbatch cotangents: partial per tp shard (the loop typed the
+    # buffers tp-varying, so no transpose-psum ran) and pp-varying (zeros
+    # off rank 0) — reduce both, then backprop the embedding.
+    dmb = lax.psum(dmb, tuple(loop_vma - vma_union(x)))
+    (g_embed,) = embed_vjp(dmb.reshape(b_local, t_local, x.shape[-1]))
+    if cfg.tie_embeddings:
+        g_embed = g_embed + g_head["embed"]
+
+    grads = {
+        "embed": g_embed,
+        "layers": jax.tree.map(lambda g: g[None], g_stage),
+        "final_norm": g_head["final_norm"],
+    }
+    if not cfg.tie_embeddings:
+        grads["unembed"] = g_head["unembed"]
+
+    # Loss: with the objective made globally consistent (1/|tp| inside
+    # the primitive), one psum over its full varying set is the true
+    # batch-mean loss.
+    loss = lax.psum(loss, tuple(vma_union(loss)))
+    return loss, grads
+
+
 def build_train_step(
     config: TransformerConfig,
     mesh: Mesh,
@@ -1035,6 +1174,11 @@ def build_train_step(
     n_micro = cfg.n_microbatches or axis_size(mesh, "pp")
 
     def local_grads(params, inputs, targets, mask):
+        if cfg.pipeline_schedule == "1f1b":
+            # Memory-capped schedule: grads assembled from per-microbatch
+            # VJPs (not a differentiable forward — see _local_grads_1f1b).
+            return _local_grads_1f1b(params, inputs, targets, mask, cfg, n_micro)
+
         def scalar_loss(p):
             loss_sum, total, aux_mean = _local_loss_fn(
                 p, inputs, targets, mask, cfg, n_micro

@@ -12,6 +12,7 @@ from .mesh import (
 )
 from .pipeline import (
     interleave_stage_params,
+    pipeline_1f1b_grads,
     pipeline_apply,
     pipeline_apply_interleaved,
     schedule_steps,
@@ -32,6 +33,7 @@ __all__ = [
     "sharding",
     "single_device_mesh",
     "interleave_stage_params",
+    "pipeline_1f1b_grads",
     "pipeline_apply",
     "pipeline_apply_interleaved",
     "schedule_steps",

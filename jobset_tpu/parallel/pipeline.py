@@ -273,3 +273,367 @@ def pipeline_apply_interleaved(
         step, (recv0, outputs0, aux0), jnp.arange(n_steps)
     )
     return (outputs, aux_sum) if with_aux else outputs
+
+
+# ---------------------------------------------------------------------------
+# True 1F1B: memory-capped schedule with hand-driven per-microbatch VJPs
+# ---------------------------------------------------------------------------
+
+
+def _schedule_1f1b(n_micro: int, pp: int):
+    """Host-side 1F1B timetable for `pipeline_1f1b_grads`.
+
+    Both existing schedules differentiate ONE big `lax.scan`, so autodiff
+    keeps every microbatch's stage residuals alive until the transposed
+    scan runs — peak activation memory grows with n_micro. 1F1B instead
+    interleaves backward steps with forward steps, bounding in-flight
+    microbatches per rank to 2*(pp - rank) - 1, so activation memory is
+    O(pp) regardless of n_micro (the memory-capped schedule the
+    reference's world gets from Megatron/DeepSpeed; greenfield here —
+    SURVEY.md §2.2 PP row has no numerics). The cap is the synchronous
+    round-trip depth: a microbatch's F-wave takes one iteration per rank
+    down and its B-wave one per rank back, so rank r sees 2*(pp-r)-1
+    in-flight at full streaming rate. Megatron's finer-grained async
+    slots reach pp-r, but only by letting ranks run unsynchronized
+    instruction sequences — which XLA's lockstep collectives (and this
+    design's uniform program) cannot express. A pp-r cap here would
+    halve throughput instead (the F-wave stalls on the cap every other
+    iteration).
+
+    The schedule is phase-alternating and LOCKSTEP-UNIFORM: every scan
+    iteration has an F-phase (all ranks run the stage forward, masked)
+    then a B-phase (all ranks run one per-microbatch VJP, masked). No
+    rank ever takes a different code path — only different microbatch
+    indices — because collectives inside divergent control flow deadlock
+    XLA's rendezvous (all participants of a lowered collective must
+    reach it; a rank idling in another branch never does). Masked
+    uniform execution costs what the existing GPipe path already pays:
+    that path, too, runs every stage and the full loss head on every
+    rank and masks the results (`_local_loss_fn`).
+
+    Dependencies (iteration units; sends travel one phase, arrivals are
+    staged into ring buffers at the consuming phase's start):
+    * F(b, r) at iter k needs F(b, r-1) at iter ≤ k-1 (y sent in that
+      iteration's F-phase, staged at the next B-phase).
+    * B(b, pp-1) at iter k needs F(b, pp-2) at iter ≤ k — the last rank
+      has NO F-units (its VJP recomputes the stage forward, head
+      included, from the staged input).
+    * B(b, r<pp-1) at iter k needs B(b, r+1) at iter ≤ k-1 and its own
+      F(b, r) at iter ≤ k.
+    * Forward may run only while in-flight (F issued minus B done) is
+      under the cap pp - r: that cap IS the memory bound.
+
+    Greedy generation under those constraints yields the classic 1F1B
+    order: warmup forwards, steady one-F-one-B per iteration, drain
+    backwards, total ~n_micro + 2.5*pp iterations.
+
+    Returns (f_mb, b_mb, rxf_mb, rxb_mb, buf_size): [T, pp] int32 tables
+    (-1 = inactive); rxf/rxb are the ring-buffer staging rows (which
+    microbatch's activation/cotangent arrives this iteration), and
+    buf_size the exact max live width of the ring buffers (asserted
+    ≤ 2*pp — n_micro-independent).
+    """
+    import numpy as np
+
+    m = int(n_micro)
+    if m <= 0:
+        raise ValueError(f"n_micro must be positive, got {m}")
+    if pp == 1:
+        f_mb = np.full((m, 1), -1, np.int32)
+        b_mb = np.arange(m, dtype=np.int32).reshape(m, 1)
+        rxf = np.full((m, 1), -1, np.int32)
+        rxb = np.full((m, 1), -1, np.int32)
+        return f_mb, b_mb, rxf, rxb, 1
+
+    NEG = -1
+    f_done = np.full((pp, m), NEG, np.int64)  # iteration of F(b, r)
+    b_done = np.full((pp, m), NEG, np.int64)  # iteration of B(b, r)
+    f_next = [0] * pp
+    b_next = [0] * pp
+    cap = [max(1, 2 * (pp - r) - 1) for r in range(pp)]
+    rows_f, rows_b = [], []
+    k = 0
+    while any(b_next[r] < m for r in range(pp)):
+        # F-phase decisions (state from previous iterations).
+        rowf = [NEG] * pp
+        for r in range(pp - 1):
+            bf = f_next[r]
+            if bf < m and (bf - b_next[r]) < cap[r]:
+                if r == 0 or 0 <= f_done[r - 1][bf] <= k - 1:
+                    rowf[r] = bf
+                    f_done[r][bf] = k
+                    f_next[r] += 1
+        # B-phase decisions (may consume this iteration's F arrivals).
+        rowb = [NEG] * pp
+        for r in range(pp):
+            b = b_next[r]
+            if b < m:
+                if r == pp - 1:
+                    ready = 0 <= f_done[pp - 2][b] <= k
+                else:
+                    ready = (
+                        0 <= b_done[r + 1][b] <= k - 1
+                        and 0 <= f_done[r][b] <= k
+                    )
+                if ready:
+                    rowb[r] = b
+                    b_done[r][b] = k
+                    b_next[r] += 1
+        rows_f.append(rowf)
+        rows_b.append(rowb)
+        k += 1
+        if k > 4 * (m + pp) + 8:
+            raise AssertionError(
+                f"1f1b schedule did not converge (m={m}, pp={pp})"
+            )
+
+    T = k
+    f_mb = np.array(rows_f, np.int32)
+    b_mb = np.array(rows_b, np.int32)
+    # Staging rows. x_buf stages at the B-phase of the SAME iteration the
+    # upstream forward ran (send F-phase 2k -> arrive 2k+1); dy_buf stages
+    # at the F-phase of the NEXT iteration (send B-phase 2k+1 -> arrive
+    # 2k+2).
+    rxf = np.full((T, pp), NEG, np.int32)
+    rxb = np.full((T, pp), NEG, np.int32)
+    rxf[:, 1:] = f_mb[:, :-1]
+    rxb[1:, :-1] = b_mb[:-1, 1:]
+
+    # Exact ring-buffer width from liveness. x_b at rank r lives from its
+    # staging (B-phase of f_done[r-1][b]) until B(b, r) consumes it; dy_b
+    # at rank r from F-phase of b_done[r+1][b]+1 until B(b, r). Live sets
+    # are contiguous-in-b windows, so max width is exact; overlapping b's
+    # must not collide mod buf_size.
+    # Per (rank, b) the live interval is [start_b, end_b] with BOTH edges
+    # nondecreasing in b (forwards and backwards complete in order), so
+    # the max overlap width is a two-pointer sweep — O(pp * m), not the
+    # naive O(pp * T * m) which would stall tracing at large n_micro.
+    def _max_window(starts, ends):
+        nonlocal buf
+        lo = 0
+        for hi in range(m):
+            while ends[lo] < starts[hi]:
+                lo += 1
+            buf = max(buf, hi - lo + 1)
+
+    buf = 1
+    for r in range(1, pp):
+        _max_window(f_done[r - 1], b_done[r])
+    for r in range(pp - 1):
+        _max_window(b_done[r + 1] + 1, b_done[r])
+    if buf > 2 * pp:
+        raise AssertionError(
+            f"1f1b buffer bound violated: width {buf} > 2*pp (m={m}, pp={pp})"
+        )
+    return f_mb, b_mb, rxf, rxb, buf
+
+
+def pipeline_1f1b_grads(
+    stage_fn: Callable,
+    head_fn: Callable,
+    stage_params,
+    head_params,
+    microbatches: jax.Array,
+    axis_name: str = "pp",
+    replicated_axes: tuple = (),
+):
+    """Run the 1F1B schedule and return per-rank gradients directly.
+
+    Unlike `pipeline_apply`, this is NOT a differentiable forward — it IS
+    the backward: a forward-only `lax.scan` whose B-phases call `jax.vjp`
+    per microbatch, so XLA saves no cross-step residuals and peak
+    activation memory is the ring buffers (≤ 2*pp microbatch activations
+    + cotangents) instead of all n_micro.
+
+    stage_fn(stage_params, x) -> y: one stage, same shape AND dtype
+    in/out (apply remat inside if desired — each B-phase VJP recomputes
+    the stage forward from the staged input regardless).
+    head_fn(head_params, y, mb_index) -> scalar: the LAST stage's loss
+    head for one microbatch (index per-microbatch targets by the traced
+    mb_index). Fold any global normalization (1/token-count) in here;
+    the VJP is seeded with 1.0. Like the GPipe path's loss head it runs
+    (masked) on every rank, so it must be finite on all-zero inputs.
+
+    Returns (loss_sum, d_stage, d_head, d_microbatches):
+    * loss_sum — head_fn summed over microbatches; nonzero ONLY on the
+      last rank (psum over `axis_name` to share).
+    * d_stage — this rank's stage-parameter gradients.
+    * d_head — head-parameter gradients (zeros except the last rank).
+    * d_microbatches — [n_micro, ...] cotangents of the fed microbatches
+      (meaningful ONLY on rank 0; backprop the embedding with them).
+
+    replicated_axes: mesh axes over which head_fn's scalar is NUMERICALLY
+    REPLICATED rather than a distinct per-shard contribution (tensor
+    parallelism: every tp shard computes the same loss value after its
+    internal psums). The loop types every value varying over the full
+    promoted set, so the implicit global objective the VJPs differentiate
+    is the SUM of every device's copy — without correction each cotangent
+    comes back scaled by the replication factor. The loop divides the
+    objective by the product of these axis sizes, making the device-sum
+    equal the true loss; batch-sharding axes (dp/sp) and `axis_name`
+    carry genuinely distinct contributions and must NOT be listed.
+
+    Reduction contract: every returned gradient leaf is the device-local
+    cotangent of that consistent global objective — psum each leaf over
+    (its returned varying set − the original param leaf's varying set)
+    and the result is exact (see models/transformer.py); the loss wants
+    a psum over its full varying set.
+    """
+    pp = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    n_micro = microbatches.shape[0]
+    mb_shape = microbatches.shape[1:]
+
+    # psum of a literal over a mesh axis is the static axis size at trace
+    # time (same idiom as pipeline_apply's perm construction).
+    pp_static = int(pp)
+    f_mb, b_mb, rxf_mb, rxb_mb, buf_size = _schedule_1f1b(n_micro, pp_static)
+
+    from .mesh import pvary_to, vma_union
+
+    vma = vma_union(stage_params, head_params, microbatches) | frozenset(
+        {axis_name}
+    )
+
+    def _varying(x):
+        return pvary_to(x, vma)
+
+    def _vtree(tree):
+        return jax.tree.map(_varying, tree)
+
+    # Promote the param trees to the loop's vma BEFORE the scan. Left
+    # invariant, every B-phase VJP would transpose the per-use pvary into
+    # a full param-sized psum over `axis_name` INSIDE the loop (the head
+    # grad is unembed-sized!), and d_head would come back pre-summed on
+    # every rank. Varying params keep each rank's cotangent local — mid
+    # ranks' head cotangents are exactly zero — and the caller reduces
+    # once.
+    stage_params = _vtree(stage_params)
+    head_params = _vtree(head_params)
+
+    dtype = microbatches.dtype
+    x_buf0 = _varying(jnp.zeros((buf_size, *mb_shape), dtype))
+    dy_buf0 = _varying(jnp.zeros((buf_size, *mb_shape), dtype))
+    recv_f0 = _varying(jnp.zeros(mb_shape, dtype))
+    recv_b0 = _varying(jnp.zeros(mb_shape, dtype))
+    g_stage0 = _vtree(jax.tree.map(jnp.zeros_like, stage_params))
+    g_head0 = _vtree(jax.tree.map(jnp.zeros_like, head_params))
+    dmb0 = _varying(jnp.zeros((n_micro, *mb_shape), dtype))
+    loss0 = _varying(jnp.zeros((), jnp.float32))
+
+    fwd_perm = [(i, i + 1) for i in range(pp_static - 1)]
+    bwd_perm = [(i + 1, i) for i in range(pp_static - 1)]
+    is_last = idx == pp - 1
+    is_first = idx == 0
+
+    # 1/∏|replicated axes|: only axes the loop actually promoted matter
+    # (a dense model on a mesh with an unused ep axis never types ep).
+    repl = 1
+    for ax in replicated_axes:
+        if ax in vma:
+            repl *= lax.psum(1, ax)
+    repl_inv = 1.0 / repl
+
+    tables = (
+        jnp.asarray(f_mb), jnp.asarray(b_mb),
+        jnp.asarray(rxf_mb), jnp.asarray(rxb_mb),
+    )
+
+    def _row(row):
+        return lax.dynamic_index_in_dim(row, idx, 0, keepdims=False)
+
+    def _buf_read(buf, b):
+        return lax.dynamic_index_in_dim(
+            buf, jnp.clip(b, 0, n_micro - 1) % buf_size, 0, keepdims=False
+        )
+
+    def _buf_stage(buf, b, value):
+        slot = jnp.clip(b, 0, n_micro - 1) % buf_size
+        current = lax.dynamic_index_in_dim(buf, slot, 0, keepdims=False)
+        return lax.dynamic_update_index_in_dim(
+            buf, jnp.where(b >= 0, value, current), slot, 0
+        )
+
+    def _feed(b):
+        return lax.dynamic_index_in_dim(
+            microbatches, jnp.clip(b, 0, n_micro - 1), 0, keepdims=False
+        )
+
+    def step(carry, xs):
+        recv_f, recv_b, x_buf, dy_buf, g_stage, g_head, dmb, loss = carry
+        fb, bb, rxf, rxb = (_row(r) for r in xs)
+
+        # ---- F-phase: stage last B-phase's cotangent arrivals, run the
+        # (masked) forward, send y down the ring.
+        dy_buf = _buf_stage(dy_buf, rxb, recv_b)
+        xf = jnp.where(is_first, _feed(fb), _buf_read(x_buf, fb))
+        yf = stage_fn(stage_params, xf)
+        yf = jnp.where(fb >= 0, yf, jnp.zeros_like(yf))
+        if pp_static > 1:
+            recv_f = lax.ppermute(yf, axis_name, fwd_perm)
+
+        # ---- B-phase: stage this F-phase's activation arrivals, run ONE
+        # per-microbatch VJP on every rank. The last rank differentiates
+        # stage+head; mid ranks differentiate the stage against the staged
+        # cotangent via a linear surrogate <y, dy>. A scalar select mixes
+        # the two, so the traced program (and its collectives) is
+        # identical on every rank — only the select mask differs.
+        x_buf = _buf_stage(x_buf, rxf, recv_f)
+        b_active = bb >= 0
+        bb_c = jnp.clip(bb, 0, n_micro - 1)
+        xb = jnp.where(is_first, _feed(bb), _buf_read(x_buf, bb))
+        dy_in = _buf_read(dy_buf, bb)
+
+        def objective(sp, hp, x):
+            y = stage_fn(sp, x)
+            # Only the HEAD term is replicated over `replicated_axes`
+            # (every tp shard computes the same scalar): scale it so the
+            # device-sum is the true loss. The surrogate needs no scale —
+            # its dy operand is the upstream device-LOCAL cotangent, so
+            # the per-shard <y, dy> values already sum to <y, dL/dy>.
+            head = head_fn(hp, y, bb_c) * repl_inv
+            surrogate = jnp.sum(
+                (y * dy_in.astype(y.dtype)).astype(jnp.float32)
+            )
+            val = jnp.where(
+                b_active,
+                jnp.where(is_last, head.astype(jnp.float32), surrogate),
+                0.0,
+            )
+            loss_b = jnp.where(
+                jnp.logical_and(b_active, is_last),
+                head.astype(jnp.float32), 0.0,
+            )
+            return val, loss_b
+
+        (val, loss_b), vjp_fn = jax.vjp(
+            objective, stage_params, head_params, xb, has_aux=False
+        )
+        # Seed from the primal outputs so the cotangent carries their
+        # exact varying-axes type (the objective's scalar may be
+        # invariant over tp/ep after internal psums).
+        dsp, dhp, dx = vjp_fn((jnp.ones_like(val), jnp.zeros_like(loss_b)))
+        dx = dx.astype(dtype)
+        g_stage = jax.tree.map(jnp.add, g_stage, _vtree(dsp))
+        g_head = jax.tree.map(jnp.add, g_head, _vtree(dhp))
+        loss = loss + _varying(loss_b)
+
+        # Rank 0's dx is the loss cotangent of the fed microbatch.
+        dmb_cur = lax.dynamic_index_in_dim(dmb, bb_c, 0, keepdims=False)
+        dmb = lax.dynamic_update_index_in_dim(
+            dmb,
+            jnp.where(jnp.logical_and(is_first, b_active), dx, dmb_cur),
+            bb_c, 0,
+        )
+        if pp_static > 1:
+            recv_b = lax.ppermute(dx, axis_name, bwd_perm)
+        return (
+            recv_f, recv_b, x_buf, dy_buf, g_stage, g_head, dmb,
+            _varying(loss),
+        ), None
+
+    carry0 = (recv_f0, recv_b0, x_buf0, dy_buf0, g_stage0, g_head0, dmb0, loss0)
+    (_, _, _, _, g_stage, g_head, dmb, loss), _ = lax.scan(
+        step, carry0, tables
+    )
+    return loss, g_stage, g_head, dmb
