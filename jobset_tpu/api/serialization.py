@@ -192,10 +192,43 @@ def _pod_template_from(d: Optional[dict], strict: bool) -> t.PodTemplateSpec:
     d = _as_dict(d, "pod template")
     _check_unknown(d, {"metadata", "spec"}, "pod template", strict)
     meta = _as_dict(d.get("metadata"), "pod template metadata")
+    annotations = dict(meta.get("annotations") or {})
+    spec = _pod_spec_from(d.get("spec"), strict)
+    # Strict-CRD manifests (to_k8s_dict) carry the workload payload as a
+    # JSON annotation instead of a vendor spec field: absorb it back so
+    # the export round-trips losslessly.
+    packed = annotations.pop(WORKLOAD_KEY, None)
+    opaque = set(spec.workload) - {"containers", "initContainers", "volumes"}
+    if packed and not opaque:
+        import json as _json
+
+        try:
+            restored = _json.loads(packed)
+        except ValueError:
+            raise SerializationError(
+                f"pod template annotation {WORKLOAD_KEY} is not valid JSON"
+            )
+        if not isinstance(restored, dict):
+            raise SerializationError(
+                f"pod template annotation {WORKLOAD_KEY} must encode a JSON "
+                f"object, got {type(restored).__name__}"
+            )
+        # Native container fields already absorbed into workload (e.g. the
+        # synthesized runner container) win over the annotation's copies.
+        native = {
+            k: spec.workload[k]
+            for k in ("containers", "initContainers", "volumes")
+            if k in spec.workload
+        }
+        spec.workload = {**restored, **native}
+    elif packed is not None:
+        # Not absorbed (a native workload also present, or an empty
+        # string): keep the annotation verbatim rather than dropping it.
+        annotations[WORKLOAD_KEY] = packed
     return t.PodTemplateSpec(
         labels=dict(meta.get("labels") or {}),
-        annotations=dict(meta.get("annotations") or {}),
-        spec=_pod_spec_from(d.get("spec"), strict),
+        annotations=annotations,
+        spec=spec,
     )
 
 
@@ -581,4 +614,66 @@ def status_to_dict(s: t.JobSetStatus) -> dict:
 def to_yaml(js: t.JobSet, include_status: bool = False) -> str:
     return yaml.safe_dump(
         to_dict(js, include_status=include_status), sort_keys=False, default_flow_style=False
+    )
+
+
+# ---------------------------------------------------------------------------
+# Strict-CRD export (kubectl-apply interop with the reference operator)
+# ---------------------------------------------------------------------------
+
+# Annotation carrying the JSON-encoded workload payload in strict-CRD
+# manifests (annotations are free-form strings under any CRD schema; a
+# vendor pod-SPEC field would be pruned/rejected by server-side field
+# validation).
+WORKLOAD_ANNOTATION = WORKLOAD_KEY
+
+# The container a strict-CRD manifest runs per pod: this framework's own
+# per-pod worker entrypoint, so the exported JobSet is actually RUNNABLE
+# under the reference operator, not just schema-valid.
+DEFAULT_RUNNER_IMAGE = "ghcr.io/jobset-tpu/runner:latest"
+
+
+def to_k8s_dict(js: t.JobSet, runner_image: str = DEFAULT_RUNNER_IMAGE) -> dict:
+    """Export a manifest that passes the REFERENCE operator's CRD schema
+    under strict (server-side) field validation
+    (reference: config/components/crd/bases/jobset.x-k8s.io_jobsets.yaml):
+
+    * the opaque workload payload moves from the vendor pod-spec key to a
+      pod-template ANNOTATION (JSON-encoded) — `from_dict` transparently
+      restores it, so the export round-trips losslessly;
+    * pod specs without containers get this framework's worker-entrypoint
+      container (`jobset-tpu worker`), satisfying the embedded batch/v1
+      JobSpec schema's required `containers` and making the manifest
+      runnable on a real cluster.
+
+    Validated strictly against the reference CRD in
+    tests/test_crd_interop.py.
+    """
+    import json as _json
+
+    doc = to_dict(js)
+    for rj in doc.get("spec", {}).get("replicatedJobs", []):
+        tmpl = rj.get("template", {}).get("spec", {}).get("template")
+        if tmpl is None:
+            tmpl = rj.setdefault("template", {}).setdefault(
+                "spec", {}
+            ).setdefault("template", {})
+        spec = tmpl.setdefault("spec", {})
+        workload = spec.pop(WORKLOAD_KEY, None)
+        if workload:
+            ann = tmpl.setdefault("metadata", {}).setdefault("annotations", {})
+            ann[WORKLOAD_ANNOTATION] = _json.dumps(workload, sort_keys=True)
+        if not spec.get("containers"):
+            spec["containers"] = [{
+                "name": "worker",
+                "image": runner_image,
+                "command": ["jobset-tpu", "worker"],
+            }]
+    return doc
+
+
+def to_k8s_yaml(js: t.JobSet, runner_image: str = DEFAULT_RUNNER_IMAGE) -> str:
+    return yaml.safe_dump(
+        to_k8s_dict(js, runner_image=runner_image),
+        sort_keys=False, default_flow_style=False,
     )
