@@ -15,6 +15,7 @@ JSON line with the solver-path headline vs the published 290 pods/s.
 from __future__ import annotations
 
 import argparse
+import functools
 import json
 import math
 import os
@@ -1116,7 +1117,11 @@ def placement_tpu_worker_main(args) -> None:
         idx = min(len(times) - 1, max(0, math.ceil(0.99 * len(times)) - 1))
         return (round(statistics.median(times), 3), round(times[idx], 3))
 
-    solver = AssignmentSolver()
+    # backend="default": this worker EXISTS to measure the accelerator
+    # path — the latency-aware auto-routing would (correctly) send these
+    # problem sizes to host JAX over a tunneled link, which is the
+    # production behavior but not the evidence this artifact banks.
+    solver = AssignmentSolver(backend="default")
     with _phase_deadline("BENCH_PLACEMENT_TPU_DEADLINE_S", 360.0, sink):
         # (a) headline-shape structured solve: the amortized dispatch path
         # the recovery bench exercises (rotation tie-breaks, no stickiness).
@@ -1401,6 +1406,12 @@ def worker_main(args) -> None:
     if args.mode == "both":
         stress: dict = {}
         with _phase_deadline("BENCH_AUCTION_STRESS_DEADLINE_S", 300.0, stress):
+            # max_free must exceed the mixed gang's LARGEST class (4p) or
+            # the biggest jobs are infeasible everywhere by construction.
+            stress_preload = functools.partial(
+                preload_random_occupancy,
+                max_free=max(48, 6 * args.pods_per_job),
+            )
             # Greedy may legitimately strand gangs here: the webhook
             # cascade claims domains myopically with no gang-aware
             # backtracking (exactly the reference's nodeSelector
@@ -1410,11 +1421,11 @@ def worker_main(args) -> None:
             # whenever one exists).
             g = run_contended_mode(
                 False, args, jobset_builder=build_mixed_jobset,
-                preload=preload_random_occupancy, allow_partial=True,
+                preload=stress_preload, allow_partial=True,
             )
             s = run_contended_mode(
                 True, args, jobset_builder=build_mixed_jobset,
-                preload=preload_random_occupancy,
+                preload=stress_preload,
             )
             stress.update({
                 "greedy_pods_per_sec": g["placement_pods_per_sec"],
@@ -1481,7 +1492,7 @@ def main() -> int:
         "--mode", choices=["both", "greedy", "solver"], default="both"
     )
     parser.add_argument(
-        "--scale-sweep", type=int, default=2,
+        "--scale-sweep", type=int, default=3,
         help="extra (2x-per-step) scale points measured into detail.sweep: "
              "greedy leader placement is O(replicas * domains log domains) "
              "while the solver stays one batched kernel, so the ratio grows "

@@ -558,3 +558,43 @@ def test_eps_scaling_rectangular_duality(solver):
         optimal = float(cost[linear_sum_assignment(cost)].sum())
         achieved = float(cost[np.arange(j), ours].sum())
         assert achieved == optimal, (j, d, achieved, optimal)
+
+
+def test_backend_routing_policy():
+    """Dispatch-latency-aware routing: high measured RTT sends bench-scale
+    problems to host JAX; a co-located (microsecond) device keeps them;
+    huge problems amortize even a tunnel RTT."""
+    from jobset_tpu.placement.solver import AssignmentSolver
+
+    import jax
+
+    s = AssignmentSolver(backend="auto")
+    bench_cells = 512 * 1024
+    huge_cells = 200_000_000
+
+    if jax.default_backend() == "cpu":
+        # Auto on a CPU default backend is a no-op (None = default).
+        assert s._solve_device(bench_cells) is None
+        # The explicit override still routes (to the same CPU device).
+        s2 = AssignmentSolver(backend="cpu")
+        assert s2._solve_device(bench_cells) is not None
+        return
+
+    s._accel_rtt_s = 0.065  # tunneled accelerator
+    assert s._solve_device(bench_cells) is not None  # -> host JAX
+    assert s._solve_device(huge_cells) is None  # -> accelerator
+
+    s._accel_rtt_s = 1e-4  # co-located accelerator
+    assert s._solve_device(bench_cells) is None
+
+
+def test_backend_cpu_override_solves_correctly():
+    """backend='cpu' produces the same exact-optimal assignment."""
+    from jobset_tpu.placement.solver import AssignmentSolver
+
+    rng = np.random.default_rng(3)
+    cost = rng.integers(0, 64, size=(24, 40)).astype(np.float32)
+    a_default = AssignmentSolver().solve(cost)
+    a_cpu = AssignmentSolver(backend="cpu").solve(cost)
+    idx = np.arange(24)
+    assert cost[idx, a_default].sum() == cost[idx, a_cpu].sum()
