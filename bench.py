@@ -564,6 +564,7 @@ def run_contended_mode(solver_on: bool, args, jobset_builder=None,
     # deque, so earlier phases can push it past maxlen and an index-based
     # slice would silently report [] for the very evidence this phase banks.
     iters_before = list(solver_mod.RECENT_ITERATIONS)
+    algos_before = list(solver_mod.RECENT_ALGORITHMS)
 
     with features.gate("TPUPlacementSolver", solver_on):
         cluster = build_cluster(args.domains, args.nodes_per_domain, topology_key)
@@ -599,8 +600,15 @@ def run_contended_mode(solver_on: bool, args, jobset_builder=None,
             if iters_after[: len(iters_before)] == iters_before
             else iters_after  # deque evicted old entries: best-effort tail
         )
+        algos_after = list(solver_mod.RECENT_ALGORITHMS)
+        new_algos = (
+            algos_after[len(algos_before):]
+            if algos_after[: len(algos_before)] == algos_before
+            else algos_after
+        )
         out.update({
             "auction_iterations": new_iters,
+            "solve_algorithms": new_algos,
             "solve_ms_p50": round(h.exact_percentile(0.50) * 1000, 3)
             if h.n else None,
             "solve_ms_p99": round(h.exact_percentile(0.99) * 1000, 3)
@@ -701,7 +709,10 @@ def run_contended_optimality(args) -> dict:
     js = build_jobset(args.replicas, args.pods_per_job, topology_key)
     specs = SolverPlacement._expected_job_specs(cluster, js)
     cost, feasible, _ = build_cost_matrix_for_specs(cluster, specs, topology_key)
-    solver = AssignmentSolver()
+    # backend="default": this phase's whole point is the AUCTION's
+    # optimality/iteration evidence — the portfolio would route these
+    # sizes to Hungarian and compare scipy against scipy.
+    solver = AssignmentSolver(backend="default")
     out = optimality_verdict(solver, cost, feasible)
 
     # The correlated production surface converges in O(1) bid rounds by
@@ -741,7 +752,9 @@ def warm_up_solver(args) -> None:
 
     from jobset_tpu.placement.solver import AssignmentSolver
 
-    solver = AssignmentSolver()
+    # Pin the auction: this warms the device/auction kernels for the
+    # recovery phases; the Hungarian path needs no warmup.
+    solver = AssignmentSolver(backend="default")
     j, d = args.replicas, args.domains
     jj = np.arange(j, dtype=np.float32)[:, None]
     dd = np.arange(d, dtype=np.float32)[None, :]
@@ -1389,6 +1402,7 @@ def worker_main(args) -> None:
                     2,
                 ),
                 "auction_iterations": s.get("auction_iterations"),
+                "solve_algorithms": s.get("solve_algorithms"),
                 "solve_ms_p50": s.get("solve_ms_p50"),
                 "solve_ms_p99": s.get("solve_ms_p99"),
                 "optimality": run_contended_optimality(args),
@@ -1440,6 +1454,7 @@ def worker_main(args) -> None:
                     2,
                 ),
                 "auction_iterations": s.get("auction_iterations"),
+                "solve_algorithms": s.get("solve_algorithms"),
                 "solve_ms_p50": s.get("solve_ms_p50"),
                 "solve_ms_p99": s.get("solve_ms_p99"),
             })

@@ -395,16 +395,28 @@ def _attention_block(p, x, cfg: TransformerConfig, t_local: int):
     xn = rms_norm(x, p["ln1"], cfg.norm_eps)
     compute = cfg.dtype
 
-    def proj(w, n_heads):
-        y = jnp.einsum(
-            "btd,df->btf", xn.astype(compute), weight_cast(w, compute)
-        )
+    # Fused QKV: one [d, (h + 2*hkv)*dh] GEMM instead of three narrow
+    # ones — same dot products column-for-column (bitwise identical),
+    # but the MXU sees one wide matmul, which matters exactly where the
+    # roofline says the flagship loses MFU (narrow d_model operands).
+    # XLA folds the weight concat into the GEMM's operand read.
+    q_width = heads_local * cfg.head_dim
+    kv_width = kv_heads_local * cfg.head_dim
+    w_qkv = jnp.concatenate([
+        weight_cast(p["wq"], compute),
+        weight_cast(p["wk"], compute),
+        weight_cast(p["wv"], compute),
+    ], axis=1)
+    qkv = jnp.einsum("btd,df->btf", xn.astype(compute), w_qkv)
+    q, key, value = jnp.split(qkv, [q_width, q_width + kv_width], axis=-1)
+
+    def heads(y, n_heads):
         return y.reshape(*y.shape[:-1], n_heads, cfg.head_dim)
 
     group = heads_local // kv_heads_local
-    q = rotary(proj(p["wq"], heads_local), positions, cfg.rope_theta)
-    key = rotary(proj(p["wk"], kv_heads_local), positions, cfg.rope_theta)
-    value = proj(p["wv"], kv_heads_local)
+    q = rotary(heads(q, heads_local), positions, cfg.rope_theta)
+    key = rotary(heads(key, kv_heads_local), positions, cfg.rope_theta)
+    value = heads(value, kv_heads_local)
     if cfg.attn_impl == "ulysses":
         # Ulysses splits the head axis across sp. When sp divides the
         # compact kv head count, each rank's post-split q heads map exactly onto

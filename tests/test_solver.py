@@ -16,7 +16,10 @@ TOPOLOGY = "tpu-slice"
 
 @pytest.fixture(scope="module")
 def solver():
-    return AssignmentSolver()
+    # backend="default" pins the AUCTION kernel: these tests assert the
+    # auction's own semantics (iterations, eps bounds, warm starts) and
+    # must not silently flip to the Hungarian portfolio path.
+    return AssignmentSolver(backend="default")
 
 
 def assignment_cost(cost, assignment):
@@ -598,3 +601,66 @@ def test_backend_cpu_override_solves_correctly():
     a_cpu = AssignmentSolver(backend="cpu").solve(cost)
     idx = np.arange(24)
     assert cost[idx, a_default].sum() == cost[idx, a_cpu].sum()
+
+
+def test_hungarian_portfolio_matches_auction_structured():
+    """The host Hungarian path's numpy cost mirror must agree with the
+    device (auction) construction: same structured problem, same total
+    assignment cost, sticky domains honored."""
+    from jobset_tpu.placement.solver import (
+        AssignmentSolver, _structured_cost_np,
+    )
+
+    rng = np.random.default_rng(11)
+    D, J = 96, 48
+    load = rng.random(D).astype(np.float32)
+    free = rng.integers(0, 40, D).astype(np.float32)
+    pods = rng.integers(1, 24, J).astype(np.float32)
+    sticky = np.full(J, -1, np.int32)
+    sticky[:8] = rng.integers(0, D, 8)
+    occupied = np.zeros(D, bool)
+    occupied[rng.integers(0, D, 10)] = True
+    own = np.full(J, -1, np.int32)
+    params = dict(load=load, free=free, pods_needed=pods, sticky=sticky,
+                  occupied=occupied, own_domain=own)
+
+    auction = AssignmentSolver(backend="default")  # pin the auction leg
+    a1 = auction.solve_structured_async(**params).result()
+
+    hung = AssignmentSolver(backend="cpu")  # explicit host -> Hungarian
+    pending = hung.solve_structured_async(**params)
+    assert pending.is_ready()
+    a2 = pending.result()
+
+    cost, feasible = _structured_cost_np(load, free, pods, sticky,
+                                         occupied, own)
+
+    def total(a):
+        t = 0.0
+        for j, d in enumerate(a):
+            if d >= 0:
+                assert feasible[j, d], (j, d)
+                t += cost[j, d]
+        return t, int((a >= 0).sum())
+
+    t1, n1 = total(a1)
+    t2, n2 = total(a2)
+    assert n1 == n2  # same number of assignable jobs
+    # Hungarian is exact; the auction is eps-optimal within < 1 cost unit.
+    assert t2 <= t1 + 1e-4
+    assert t1 - t2 <= 1.0
+
+
+def test_hungarian_portfolio_dense_and_algorithm_trail():
+    from jobset_tpu.placement import solver as solver_mod
+    from jobset_tpu.placement.solver import AssignmentSolver
+
+    rng = np.random.default_rng(5)
+    cost = rng.integers(0, 64, size=(32, 50)).astype(np.float32)
+    before = len(solver_mod.RECENT_ALGORITHMS)
+    s = AssignmentSolver(backend="cpu")
+    a = s.solve(cost)
+    assert s.last_iterations == 0
+    assert list(solver_mod.RECENT_ALGORITHMS)[before:] == ["hungarian"]
+    ref = cost[linear_sum_assignment(cost)].sum()
+    assert abs(float(cost[np.arange(32), a].sum()) - float(ref)) < 1e-6
