@@ -566,6 +566,11 @@ def run_contended_mode(solver_on: bool, args, jobset_builder=None,
     iters_before = list(solver_mod.RECENT_ITERATIONS)
     algos_before = list(solver_mod.RECENT_ALGORITHMS)
 
+    def _deque_tail(before, after):
+        """New entries since the snapshot; best-effort tail when the
+        bounded deque evicted old entries past the snapshot prefix."""
+        return after[len(before):] if after[: len(before)] == before else after
+
     with features.gate("TPUPlacementSolver", solver_on):
         cluster = build_cluster(args.domains, args.nodes_per_domain, topology_key)
         preload(cluster, topology_key)
@@ -594,21 +599,13 @@ def run_contended_mode(solver_on: bool, args, jobset_builder=None,
     }
     if solver_on:
         h = metrics.solver_solve_time_seconds
-        iters_after = list(solver_mod.RECENT_ITERATIONS)
-        new_iters = (
-            iters_after[len(iters_before):]
-            if iters_after[: len(iters_before)] == iters_before
-            else iters_after  # deque evicted old entries: best-effort tail
-        )
-        algos_after = list(solver_mod.RECENT_ALGORITHMS)
-        new_algos = (
-            algos_after[len(algos_before):]
-            if algos_after[: len(algos_before)] == algos_before
-            else algos_after
-        )
         out.update({
-            "auction_iterations": new_iters,
-            "solve_algorithms": new_algos,
+            "auction_iterations": _deque_tail(
+                iters_before, list(solver_mod.RECENT_ITERATIONS)
+            ),
+            "solve_algorithms": _deque_tail(
+                algos_before, list(solver_mod.RECENT_ALGORITHMS)
+            ),
             "solve_ms_p50": round(h.exact_percentile(0.50) * 1000, 3)
             if h.n else None,
             "solve_ms_p99": round(h.exact_percentile(0.99) * 1000, 3)
@@ -752,22 +749,28 @@ def warm_up_solver(args) -> None:
 
     from jobset_tpu.placement.solver import AssignmentSolver
 
-    # Pin the auction: this warms the device/auction kernels for the
-    # recovery phases; the Hungarian path needs no warmup.
-    solver = AssignmentSolver(backend="default")
     j, d = args.replicas, args.domains
     jj = np.arange(j, dtype=np.float32)[:, None]
     dd = np.arange(d, dtype=np.float32)[None, :]
     cost = 1.0 + 0.1 * ((dd - jj) % d) / d
-    solver.solve(cost)
-    solver.solve_structured_async(
+    structured = dict(
         load=np.zeros(d, np.float32),
         free=np.full(d, float(args.pods_per_job), np.float32),
         pods_needed=np.full(j, float(args.pods_per_job), np.float32),
         sticky=np.full(j, -1, np.int32),
         occupied=np.zeros(d, bool),
         own_domain=np.full(j, -1, np.int32),
-    ).result()
+    )
+    # Two variants share no jit cache entries (max_iters is a static
+    # arg and the device keys the executable): the PINNED solver warms
+    # the full-budget auction the evidence phases measure; the AUTO
+    # solver warms whatever the production path will actually run —
+    # the host-capped variant when routing sends solves to the host.
+    for solver in (
+        AssignmentSolver(backend="default"), AssignmentSolver()
+    ):
+        solver.solve(cost)
+        solver.solve_structured_async(**structured).result()
 
 
 class _PhaseTimeout(Exception):

@@ -130,13 +130,14 @@ class TransformerConfig:
     #                   still grows with n_micro.
     #   "1f1b"        — memory-capped 1F1B: per-microbatch VJPs driven by
     #                   a host-built timetable bound in-flight activations
-    #                   to ~pp microbatches regardless of n_micro
+    #                   to O(pp) microbatches regardless of n_micro
     #                   (pipeline_1f1b_grads). Training-path only (eval /
-    #                   plain forward fall back to the gpipe wavefront);
-    #                   dense models only for now (n_experts == 0 — the
-    #                   routed balancing aux is normalized over the GLOBAL
-    #                   batch, which a schedule that starts backwards
-    #                   before all forwards finish cannot see).
+    #                   plain forward fall back to the gpipe wavefront).
+    #                   Dense, soft-dispatch and expert-choice MoE all
+    #                   work; token-choice top-k routing is excluded (its
+    #                   balancing aux is normalized over the GLOBAL batch,
+    #                   which a schedule that starts backwards before all
+    #                   forwards finish cannot see).
     pipeline_schedule: str = "gpipe"
     pipeline_virtual: int = 1  # chunks per rank (interleaved only)
     # Chunk the loss over the time axis (0 = off): the unembed projection
@@ -242,12 +243,18 @@ class TransformerConfig:
             raise ValueError("pipeline_virtual must be >= 1")
         if self.pipeline_schedule != "interleaved" and self.pipeline_virtual != 1:
             raise ValueError("pipeline_virtual > 1 requires 'interleaved'")
-        if self.pipeline_schedule == "1f1b" and self.n_experts:
+        if (
+            self.pipeline_schedule == "1f1b"
+            and self.moe_top_k > 0
+            and self.moe_router == "token"
+        ):
             raise ValueError(
-                "pipeline_schedule='1f1b' supports dense models only for "
-                "now (n_experts == 0): the routed balancing aux is "
-                "normalized over the global batch, which 1F1B cannot see "
-                "before its first backward"
+                "pipeline_schedule='1f1b' does not support token-choice "
+                "top-k routing (moe_top_k > 0): its balancing aux is "
+                "normalized over the global batch, which a schedule that "
+                "starts backwards before all forwards finish cannot see. "
+                "Dense, soft-dispatch and expert-choice MoE models work "
+                "(none carries a batch-global aux)."
             )
         if self.pipeline_schedule == "interleaved":
             lps = self.n_layers // max(mc.pp, 1)
@@ -1109,13 +1116,15 @@ def _local_grads_1f1b(params, inputs, targets, mask, cfg: TransformerConfig, n_m
         per_token = _token_ce(hp, xn, tgt, cfg)
         return jnp.sum(per_token * msk) * scale
 
-    # tp is a REPLICATION axis for the loss value (every tp shard computes
-    # the same scalar after its internal psums) — the primitive divides
-    # the objective by |tp| so the device-summed objective is the true
-    # loss and the uniform psum reduction below is exact.
+    # tp (and ep, when MoE shards experts) are REPLICATION axes for the
+    # loss value (every shard computes the same scalar after its internal
+    # psums/gathers) — the primitive divides the objective by their sizes
+    # so the device-summed objective is the true loss and the uniform
+    # psum reduction below is exact. Axes absent from the loop's varying
+    # set are ignored inside.
     loss, g_stage, g_head, dmb = pipeline_1f1b_grads(
         stage_plain, head_fn, stage_params, head_params, x_mbs, "pp",
-        replicated_axes=("tp",),
+        replicated_axes=("tp", "ep"),
     )
 
     # Per-leaf reduction: psum over exactly the axes the loop promoted

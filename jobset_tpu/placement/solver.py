@@ -518,6 +518,7 @@ class PendingSolve:
             metrics.solver_solve_time_seconds.observe(end - self._t0)
             RECENT_ITERATIONS.append(int(self._iters))
             RECENT_ALGORITHMS.append("auction")
+            self._observe = False  # observe once, however often fetched
         return out
 
     @property
@@ -549,15 +550,20 @@ class AssignmentSolver:
     # measured wall times on the tunneled chip.
     _CPU_CELLS_PER_S = 2.5e7
     _ACCEL_CELLS_PER_S = 5e9
-    # Algorithm portfolio for HOST-routed single solves: Hungarian
-    # (scipy) is exactly optimal with iteration-count-independent cost —
-    # the auction's eps-scaled bidding can blow up on tight
-    # feasibility-constrained matchings (measured 2514 iterations / ~28 s
-    # on the bench's adversarial mixed-gang surface that scipy solves in
-    # ~40 ms) — but its O(n^3) loses to the auction above roughly this
-    # many matrix cells (~1.2M: bench headline 512x960 is well inside).
-    # Device solves always use the auction (Hungarian doesn't vectorize).
+    # Algorithm portfolio for HOST-executed single solves: try the
+    # auction first under a bounded iteration budget — with the
+    # rank-matched warm start it converges in tens of rounds on
+    # production (correlated) surfaces, beating Hungarian's O(n^3) —
+    # and fall back to scipy's Hungarian (exactly optimal,
+    # iteration-count-independent) only when the budget trips, which is
+    # the tight feasibility-constrained regime where the eps-scaled
+    # bidding blows up (measured 2514 iterations / ~28 s on the bench's
+    # adversarial mixed-gang surface that Hungarian solves in well under
+    # a second). Hungarian eligibility is capped by matrix size (O(n^3)
+    # loses above ~1.2M cells); device solves always use the auction
+    # (Hungarian doesn't vectorize).
     _HUNGARIAN_MAX_CELLS = 1_200_000
+    _HOST_AUCTION_ITER_CAP = 128
 
     def __init__(self, max_iters: int = 20000, backend: str | None = None):
         self.max_iters = max_iters
@@ -607,7 +613,12 @@ class AssignmentSolver:
         if jax.default_backend() == "cpu":
             return None
         rtt = self._ping_default_device()
-        accel_est = rtt + cells / self._ACCEL_CELLS_PER_S
+        # 3x: a solve is several link crossings (operands in, doorbell,
+        # result out) plus server-side queueing — one ping underestimates
+        # it badly (the 8-problem storm batch measured ~585 ms against a
+        # ~65 ms ping). A genuinely local device pings in microseconds,
+        # so the factor changes nothing there.
+        accel_est = 3.0 * rtt + cells / self._ACCEL_CELLS_PER_S
         cpu_est = cells / self._CPU_CELLS_PER_S
         return cpu if cpu_est < accel_est else None
 
@@ -623,8 +634,8 @@ class AssignmentSolver:
     def _host_hungarian(self, cells: int):
         """True when a single solve will execute ON THE HOST (routed
         there, explicitly pinned there, or the default backend IS the
-        host) and is small enough for scipy's Hungarian to beat the
-        auction kernel. backend='default' opts out entirely — the
+        host) and is small enough for the Hungarian fallback to be
+        viable. backend='default' opts out entirely — the
         auction-evidence paths (bench optimality cross-checks, the
         on-chip worker) pin it to measure the auction itself."""
         if self.backend == "default" or cells > self._HUNGARIAN_MAX_CELLS:
@@ -636,6 +647,21 @@ class AssignmentSolver:
             or self._solve_device(cells) is not None
         )
 
+    def _capped_or_hungarian(self, pending: "PendingSolve", fallback):
+        """Auction-first portfolio step: keep the host auction's result
+        when it converged inside the iteration budget; otherwise discard
+        it (its metrics never observe) and run the Hungarian fallback.
+
+        Resolution is EAGER (the iterations fetch blocks): host solves
+        execute on the cores the controller itself runs on, so deferring
+        the decision buys no overlap — the same reason provider.prepare
+        defaults to block=True — and eager resolution keeps the fallback's
+        wall time at dispatch (admission/pump, untimed) instead of at
+        result() inside a timed reconcile pass."""
+        if pending.iterations < self._HOST_AUCTION_ITER_CAP:
+            return pending
+        return fallback()
+
     @staticmethod
     def _hungarian_solve(
         cost: np.ndarray, feasible: np.ndarray, num_jobs: int,
@@ -643,7 +669,14 @@ class AssignmentSolver:
     ) -> "HostSolve":
         from scipy.optimize import linear_sum_assignment  # gated upstream
 
-        big_m = 4.0 * COST_CAP
+        # 5*COST_CAP reproduces the auction's sink tradeoff EXACTLY: the
+        # auction strands a job when its best option is worse than the
+        # sink benefit -4*COST_CAP, i.e. at an effective cost of
+        # COST_CAP - (-4*COST_CAP) = 5*COST_CAP against feasible cells'
+        # (COST_CAP - c). A smaller big-M would strand jobs on tight
+        # augmenting chains the auction arm would still bind, silently
+        # desynchronizing the two portfolio arms' bound fractions.
+        big_m = 5.0 * COST_CAP
         dense = np.where(feasible, np.clip(cost, 0.0, COST_CAP - 1.0), big_m)
         assignment = np.full(num_jobs, -1, np.int64)
         rows, cols = linear_sum_assignment(dense)
@@ -667,13 +700,8 @@ class AssignmentSolver:
 
         jobs_p = _round_up_pow2(num_jobs)
         domains_p = _round_up_pow2(num_domains)
-
-        # Portfolio: host-routed single solves below the Hungarian
-        # threshold skip the auction entirely (see _HUNGARIAN_MAX_CELLS).
-        if self._host_hungarian(jobs_p * domains_p):
-            return self._hungarian_solve(
-                cost, feasible, num_jobs, num_domains, t0
-            )
+        host_small = self._host_hungarian(jobs_p * domains_p)
+        max_iters = self._HOST_AUCTION_ITER_CAP if host_small else self.max_iters
 
         # Sinks are implicit in _auction (constant outside option), so the
         # shipped matrix is [J_p, D_p] — no [J_p, J_p] sink block.
@@ -688,9 +716,17 @@ class AssignmentSolver:
         with self._on_solve_device(jobs_p * domains_p):
             benefit_scaled = jnp.asarray(benefit * scale)
             assignment, _, iters = _auction(
-                benefit_scaled, jnp.float32(1.0), max_iters=self.max_iters
+                benefit_scaled, jnp.float32(1.0), max_iters=max_iters
             )
-        return PendingSolve(assignment, iters, num_jobs, num_domains, t0)
+        pending = PendingSolve(assignment, iters, num_jobs, num_domains, t0)
+        if host_small:
+            return self._capped_or_hungarian(
+                pending,
+                lambda: self._hungarian_solve(
+                    cost, feasible, num_jobs, num_domains, t0
+                ),
+            )
+        return pending
 
     def solve(self, cost: np.ndarray, feasible: Optional[np.ndarray] = None) -> np.ndarray:
         """Solve one assignment problem, blocking until the result is ready.
@@ -723,23 +759,8 @@ class AssignmentSolver:
         jobs_p = _round_up_pow2(num_jobs)
         domains_p = _round_up_pow2(num_domains)
 
-        # Portfolio: a host-routed solve has nothing to ship, so the
-        # structured parametrization's reason to exist (kilobytes over
-        # the link) is moot — materialize the same cost model on host
-        # (numpy mirror of _auction_structured's construction) and run
-        # Hungarian when the size allows.
-        if self._host_hungarian(jobs_p * domains_p):
-            cost, feasible = _structured_cost_np(
-                np.asarray(load, np.float32),
-                np.asarray(free, np.float32),
-                np.asarray(pods_needed, np.float32),
-                np.asarray(sticky, np.int32),
-                np.asarray(occupied, bool),
-                np.asarray(own_domain, np.int32),
-            )
-            return self._hungarian_solve(
-                cost, feasible, num_jobs, num_domains, t0
-            )
+        host_small = self._host_hungarian(jobs_p * domains_p)
+        max_iters = self._HOST_AUCTION_ITER_CAP if host_small else self.max_iters
 
         def pad(a, n, fill):
             out = np.full(n, fill, a.dtype)
@@ -755,9 +776,29 @@ class AssignmentSolver:
                 jnp.asarray(pad(np.asarray(occupied, bool), domains_p, True)),
                 jnp.asarray(pad(np.asarray(own_domain, np.int32), jobs_p, -1)),
                 jnp.int32(num_domains),
-                max_iters=self.max_iters,
+                max_iters=max_iters,
             )
-        return PendingSolve(assignment, iters, num_jobs, num_domains, t0)
+        pending = PendingSolve(assignment, iters, num_jobs, num_domains, t0)
+        if host_small:
+            # The Hungarian fallback has nothing to ship, so the
+            # structured parametrization's reason to exist (kilobytes
+            # over the link) is moot: materialize the same cost model on
+            # host (numpy mirror, differentially pinned by tests).
+            def fallback():
+                cost, feasible = _structured_cost_np(
+                    np.asarray(load, np.float32),
+                    np.asarray(free, np.float32),
+                    np.asarray(pods_needed, np.float32),
+                    np.asarray(sticky, np.int32),
+                    np.asarray(occupied, bool),
+                    np.asarray(own_domain, np.int32),
+                )
+                return self._hungarian_solve(
+                    cost, feasible, num_jobs, num_domains, t0
+                )
+
+            return self._capped_or_hungarian(pending, fallback)
+        return pending
 
     def solve_structured_batch_async(
         self, problems: "list[dict]"

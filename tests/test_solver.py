@@ -627,7 +627,8 @@ def test_hungarian_portfolio_matches_auction_structured():
     auction = AssignmentSolver(backend="default")  # pin the auction leg
     a1 = auction.solve_structured_async(**params).result()
 
-    hung = AssignmentSolver(backend="cpu")  # explicit host -> Hungarian
+    hung = AssignmentSolver(backend="cpu")  # host portfolio path
+    hung._HOST_AUCTION_ITER_CAP = 1  # force the Hungarian fallback arm
     pending = hung.solve_structured_async(**params)
     assert pending.is_ready()
     a2 = pending.result()
@@ -652,15 +653,30 @@ def test_hungarian_portfolio_matches_auction_structured():
 
 
 def test_hungarian_portfolio_dense_and_algorithm_trail():
+    """Auction-first portfolio: a converging surface keeps the (capped)
+    auction; tripping the iteration budget falls back to Hungarian, and
+    the algorithm trail records each."""
     from jobset_tpu.placement import solver as solver_mod
     from jobset_tpu.placement.solver import AssignmentSolver
 
     rng = np.random.default_rng(5)
     cost = rng.integers(0, 64, size=(32, 50)).astype(np.float32)
+    ref = float(cost[linear_sum_assignment(cost)].sum())
+
+    # Converging surface: the warm-started auction finishes inside the
+    # budget and is kept.
     before = len(solver_mod.RECENT_ALGORITHMS)
     s = AssignmentSolver(backend="cpu")
     a = s.solve(cost)
-    assert s.last_iterations == 0
+    assert list(solver_mod.RECENT_ALGORITHMS)[before:] == ["auction"]
+    assert abs(float(cost[np.arange(32), a].sum()) - ref) < 1e-6
+
+    # Force the budget to trip: the Hungarian fallback serves the solve,
+    # still exactly optimal.
+    before = len(solver_mod.RECENT_ALGORITHMS)
+    s2 = AssignmentSolver(backend="cpu")
+    s2._HOST_AUCTION_ITER_CAP = 1
+    a2 = s2.solve(cost)
+    assert s2.last_iterations == 0
     assert list(solver_mod.RECENT_ALGORITHMS)[before:] == ["hungarian"]
-    ref = cost[linear_sum_assignment(cost)].sum()
-    assert abs(float(cost[np.arange(32), a].sum()) - float(ref)) < 1e-6
+    assert abs(float(cost[np.arange(32), a2].sum()) - ref) < 1e-6

@@ -868,7 +868,7 @@ def test_1f1b_gradients_exact_vs_autodiff():
 
 
 def test_1f1b_validation():
-    with pytest.raises(ValueError, match="dense models only"):
+    with pytest.raises(ValueError, match="top-k routing"):
         tiny_config(
             pipeline_schedule="1f1b", n_experts=4, moe_top_k=2,
         ).validate(MESH_CONFIG)
@@ -876,3 +876,55 @@ def test_1f1b_validation():
         tiny_config(
             pipeline_schedule="1f1b", pipeline_virtual=2,
         ).validate(MESH_CONFIG)
+
+
+def test_1f1b_moe_soft_and_expert_choice_exact():
+    """1F1B supports non-routed MoE (soft dispatch, expert choice): no
+    batch-global aux exists there, and ep is declared a replication axis
+    for the loss scalar. Gradients match autodiff to fp32 epsilon on an
+    ep2 x pp2 x tp2 mesh."""
+    from jobset_tpu.models.transformer import (
+        _local_grads_1f1b, _local_loss_fn, param_specs,
+    )
+
+    mc = MeshConfig(pp=2, ep=2, tp=2)
+    mesh = build_mesh(mc, allow_submesh=True)
+    for extra in ({}, {"moe_router": "expert"}):
+        cfg = tiny_config(
+            remat=False, n_microbatches=4, pipeline_schedule="1f1b",
+            n_experts=4, d_ff_expert=32, **extra,
+        )
+        cfg.validate(mc)
+        params = init_params(jax.random.key(0), cfg, mesh)
+        specs = param_specs(cfg)
+        rng = np.random.default_rng(0)
+        B, T = 8, 16
+        inputs = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, T)))
+        targets = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, T)))
+        mask = jnp.ones((B, T), jnp.float32)
+
+        def ref(p, i, t, m):
+            def s(p):
+                ls, tot, _ = _local_loss_fn(p, i, t, m, cfg, 4)
+                return ls / jnp.maximum(tot, 1.0)
+
+            return jax.value_and_grad(s)(p)
+
+        def f1b(p, i, t, m):
+            return _local_grads_1f1b(p, i, t, m, cfg, 4)
+
+        outs = {}
+        for name, fn in (("ref", ref), ("f1b", f1b)):
+            g = jax.jit(jax.shard_map(fn, mesh=mesh,
+                in_specs=(specs, P("dp", "sp"), P("dp", "sp"), P("dp", "sp")),
+                out_specs=(P(), specs)))
+            outs[name] = g(params, inputs, targets, mask)
+        (l0, g0), (l1, g1) = outs["ref"], outs["f1b"]
+        np.testing.assert_allclose(float(l0), float(l1), rtol=1e-5)
+        for (path, a), b in zip(
+            jax.tree_util.tree_flatten_with_path(g0)[0], jax.tree.leaves(g1)
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=5e-7,
+                err_msg=f"{extra}: {jax.tree_util.keystr(path)}",
+            )
