@@ -85,17 +85,29 @@ def _tile_env(name: str, default: int) -> int:
 
 
 # MXU/VPU tiles: sublane multiple of 8 (f32) / 16 (bf16), lane multiple
-# of 128. Defaults come from the on-chip tile sweep (TPUCHECK.json
-# round 5: (256,512) reached 31.51% MFU vs 25.85% at (128,128) on the
-# 133M/1024-seq point — bigger k tiles amortize the per-tile softmax
-# rescale and keep the MXU fed); the dispatch clamps each tile to the
-# padded sequence length, so short sequences never over-pad.
-def _tile_q() -> int:
-    return _tile_env("JOBSET_TPU_FLASH_TILE_Q", 256)
+# of 128. Defaults are SEQUENCE-ADAPTIVE, fit to the on-chip sweeps
+# (TPUCHECK.json round 5): at seq 1024 the best shape was (256, 512) —
+# 31.5-33% MFU vs 25.8% at (128,128) — and at seq 4096 deeper tiles
+# (512, 1024) beat (256, 512) by another ~16% tokens/s; bigger k tiles
+# amortize the per-tile online-softmax rescale (VPU work the MXU waits
+# on) and longer q tiles pay off once the sequence is long enough to
+# fill them. Setting JOBSET_TPU_FLASH_TILE_Q/K pins a shape absolutely
+# (still clamped to the padded sequence so short shapes never over-pad).
+def _tile_q(tq_p: int) -> int:
+    env = _tile_env("JOBSET_TPU_FLASH_TILE_Q", 0)
+    if env:
+        return env
+    # Floor to a 128 multiple: the lane/sublane tiling rule the env path
+    # validates must hold for computed tiles too (tq_p//8 is only a
+    # 128-multiple when tq_p is a 1024-multiple).
+    return min(1024, max(256, (tq_p // 8) // 128 * 128))
 
 
-def _tile_k() -> int:
-    return _tile_env("JOBSET_TPU_FLASH_TILE_K", 512)
+def _tile_k(tk_p: int) -> int:
+    env = _tile_env("JOBSET_TPU_FLASH_TILE_K", 0)
+    if env:
+        return env
+    return min(1024, max(512, (tk_p // 4) // 128 * 128))
 
 
 _LANE = 128
@@ -277,10 +289,12 @@ def _block_attention_pallas(q, k, v, bias):
     tk = k.shape[1]
     scale = dim ** -0.5
 
-    # Clamp tiles to the 128-padded sequence so short sequences (decode
-    # prefill, ragged tests) don't pad to a full large tile.
-    tile_q = min(_tile_q(), _round_up(tq, 128))
-    tile_k = min(_tile_k(), _round_up(tk, 128))
+    # Adaptive tile selection against the 128-padded sequence, clamped so
+    # short sequences (decode prefill, ragged tests) never over-pad.
+    tq_128 = _round_up(tq, 128)
+    tk_128 = _round_up(tk, 128)
+    tile_q = min(_tile_q(tq_128), tq_128)
+    tile_k = min(_tile_k(tk_128), tk_128)
     tq_p = _round_up(tq, tile_q)
     tk_p = _round_up(tk, tile_k)
     d_p = _round_up(dim, _LANE)
