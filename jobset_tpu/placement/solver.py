@@ -599,7 +599,7 @@ class AssignmentSolver:
                 self._accel_rtt_s = float("inf")
         return self._accel_rtt_s
 
-    def _solve_device(self, cells: int):
+    def _solve_device(self, cells: int, is_batched: bool = False):
         """Device to dispatch on: None = default backend; a CpuDevice to
         route the solve to host JAX instead."""
         if self.backend == "default":
@@ -612,19 +612,28 @@ class AssignmentSolver:
             return cpu
         if jax.default_backend() == "cpu":
             return None
+        if is_batched:
+            # The batched (vmapped) kernel never auto-routes off the
+            # accelerator — even for a batch of one: the kernel is the
+            # device's whole point, and compiling the batched while_loop
+            # for the HOST device from inside an accelerator-default
+            # process measured >9 min on the remote-compile toolchain
+            # (effectively wedged) versus seconds for the single-solve
+            # kernels. The tunnel's per-batch cost is bounded and
+            # amortized across the storm.
+            return None
         rtt = self._ping_default_device()
         # 3x: a solve is several link crossings (operands in, doorbell,
         # result out) plus server-side queueing — one ping underestimates
-        # it badly (the 8-problem storm batch measured ~585 ms against a
-        # ~65 ms ping). A genuinely local device pings in microseconds,
-        # so the factor changes nothing there.
+        # it badly. A genuinely local device pings in microseconds, so
+        # the factor changes nothing there.
         accel_est = 3.0 * rtt + cells / self._ACCEL_CELLS_PER_S
         cpu_est = cells / self._CPU_CELLS_PER_S
         return cpu if cpu_est < accel_est else None
 
     @contextlib.contextmanager
-    def _on_solve_device(self, cells: int):
-        dev = self._solve_device(cells)
+    def _on_solve_device(self, cells: int, is_batched: bool = False):
+        dev = self._solve_device(cells, is_batched)
         if dev is None:
             yield
         else:
@@ -837,7 +846,7 @@ class AssignmentSolver:
         num_domains = np.asarray(
             [int(p["load"].shape[0]) for p in problems], np.int32
         )
-        with self._on_solve_device(len(problems) * jobs_p * domains_p):
+        with self._on_solve_device(len(problems) * jobs_p * domains_p, is_batched=True):
             assignment, iters = _auction_structured_batch(
                 *(jnp.asarray(stacked[k]) for k in (
                     "load", "free", "pods_needed", "sticky", "occupied",
@@ -881,7 +890,7 @@ class AssignmentSolver:
         )
 
         scale = float(jobs_p + 1)
-        with self._on_solve_device(batch * jobs_p * domains_p):
+        with self._on_solve_device(batch * jobs_p * domains_p, is_batched=True):
             assignments = np.asarray(
                 _auction_batch(
                     jnp.asarray(benefit * scale), jnp.float32(1.0),
