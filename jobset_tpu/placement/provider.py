@@ -222,12 +222,27 @@ class SolverPlacement:
             entries.append((js, specs, domain_values, params))
         if not entries:
             return
-        if len(entries) == 1:
-            js, specs, domain_values, params = entries[0]
-            pending = solver.solve_structured_async(**params)
-            if block:
-                pending = self._materialize(specs, domain_values, pending.result())
-            self._store_plan(js, specs, domain_values, pending)
+        # A storm whose solves the latency router would HOST-execute is
+        # cheaper as routed singles: the batched dispatch down a
+        # high-latency accelerator link pays ~B link round trips (the
+        # 8-problem storm batch measured ~585 ms on a tunneled TPU) while
+        # B host singles cost a few ms apiece. The solver owns the
+        # decision (prefers_host_singles): auto mode on an accelerator
+        # backend only, and every problem must route to host — pinned
+        # backends, CPU-only processes and mixed-size storms keep the one
+        # vmapped dispatch.
+        prefers = getattr(solver, "prefers_host_singles", None)
+        if len(entries) == 1 or (
+            prefers is not None
+            and prefers([params for _, _, _, params in entries])
+        ):
+            for js, specs, domain_values, params in entries:
+                pending = solver.solve_structured_async(**params)
+                if block:
+                    pending = self._materialize(
+                        specs, domain_values, pending.result()
+                    )
+                self._store_plan(js, specs, domain_values, pending)
             return
         pendings = solver.solve_structured_batch_async(
             [params for _, _, _, params in entries]

@@ -656,6 +656,31 @@ class AssignmentSolver:
             or self._solve_device(cells) is not None
         )
 
+    def prefers_host_singles(self, problems: "list[dict]") -> bool:
+        """True when a storm of structured problems is cheaper as routed
+        SINGLE solves than as one batched accelerator dispatch: only in
+        auto mode with an accelerator default backend (an explicit
+        backend pin, or a CPU-only process, keeps the one vmapped
+        dispatch — B sequential solves when the controller is busiest is
+        exactly what the batch exists to prevent), and only when EVERY
+        problem individually routes to the host — a mixed storm keeps
+        the batch rather than paying one link round trip per large
+        problem. Called by the provider's prepare_batch; sizing and
+        routing knowledge stays in this module."""
+        if self.backend != "auto" or not problems:
+            return False
+        try:
+            if jax.default_backend() == "cpu":
+                return False
+        except Exception:
+            return False
+        for p in problems:
+            jobs_p = _round_up_pow2(int(np.asarray(p["pods_needed"]).shape[0]))
+            domains_p = _round_up_pow2(int(np.asarray(p["load"]).shape[0]))
+            if self._solve_device(jobs_p * domains_p) is None:
+                return False
+        return True
+
     def _capped_or_hungarian(self, pending: "PendingSolve", fallback):
         """Auction-first portfolio step: keep the host auction's result
         when it converged inside the iteration budget; otherwise discard

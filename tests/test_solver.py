@@ -680,3 +680,88 @@ def test_hungarian_portfolio_dense_and_algorithm_trail():
     assert s2.last_iterations == 0
     assert list(solver_mod.RECENT_ALGORITHMS)[before:] == ["hungarian"]
     assert abs(float(cost[np.arange(32), a2].sum()) - ref) < 1e-6
+
+
+def test_storm_batch_splits_when_router_prefers_host():
+    """prepare_batch dispatches per-JobSet singles when the solver's
+    latency router would host-execute the solves (a tunneled-accelerator
+    batch pays ~B link round trips), and keeps ONE batched dispatch when
+    the router keeps solves on the default backend."""
+    from jobset_tpu.placement.provider import SolverPlacement
+    from jobset_tpu.placement.solver import AssignmentSolver
+
+    class Recorder(AssignmentSolver):
+        def __init__(self, route_to_host):
+            super().__init__(backend="default")
+            self.calls = []
+            self._route_to_host = route_to_host
+
+        def prefers_host_singles(self, problems):
+            return self._route_to_host
+
+        def solve_structured_async(self, **kw):
+            self.calls.append("single")
+            return super().solve_structured_async(**kw)
+
+        def solve_structured_batch_async(self, problems):
+            self.calls.append(f"batch:{len(problems)}")
+            return super().solve_structured_batch_async(problems)
+
+    cluster = solver_cluster(num_domains=12, nodes_per_domain=2)
+    jobsets = []
+    with features.gate("TPUPlacementSolver", True):
+        for i in range(3):
+            js = (
+                make_jobset(f"storm-{i}")
+                .exclusive_placement(TOPOLOGY)
+                .replicated_job(
+                    make_replicated_job("w").replicas(2).parallelism(2)
+                    .completions(2).obj()
+                )
+                .obj()
+            )
+            cluster.create_jobset(js)
+            jobsets.append(cluster.jobsets[("default", f"storm-{i}")])
+
+        for route_to_host, expect in ((True, ["single"] * 3), (False, ["batch:3"])):
+            solver = Recorder(route_to_host)
+            placement = SolverPlacement(solver=solver)
+            placement.prepare_batch(cluster, jobsets)
+            assert solver.calls == expect, (route_to_host, solver.calls)
+            for js in jobsets:
+                assert js.metadata.uid in placement._plans
+
+
+def test_prefers_host_singles_policy():
+    """The solver-owned storm-split policy: auto mode on an accelerator
+    backend with EVERY problem routing to host; pinned backends, CPU-only
+    processes and mixed-size storms keep the batch."""
+    from unittest import mock
+
+    from jobset_tpu.placement import solver as solver_mod
+
+    def prob(jobs, domains):
+        return dict(
+            load=np.zeros(domains, np.float32),
+            free=np.full(domains, 8.0, np.float32),
+            pods_needed=np.full(jobs, 2.0, np.float32),
+            sticky=np.full(jobs, -1, np.int32),
+            occupied=np.zeros(domains, bool),
+            own_domain=np.full(jobs, -1, np.int32),
+        )
+
+    small, big = prob(64, 128), prob(4096, 8192)
+
+    # CPU-only process (the test env): never split.
+    assert not AssignmentSolver().prefers_host_singles([small] * 3)
+    # Pinned backends: never split, regardless of routing.
+    assert not AssignmentSolver(backend="cpu").prefers_host_singles([small])
+    assert not AssignmentSolver(backend="default").prefers_host_singles([small])
+
+    # Accelerator default backend behind a slow link (mocked): small
+    # problems split; a storm containing one big problem keeps the batch.
+    s = AssignmentSolver(backend="auto")
+    s._accel_rtt_s = 0.065
+    with mock.patch.object(solver_mod.jax, "default_backend", return_value="tpu"):
+        assert s.prefers_host_singles([small] * 3)
+        assert not s.prefers_host_singles([small, big, small])
