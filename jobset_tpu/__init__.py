@@ -18,6 +18,11 @@ Two cooperating planes:
   (DP/FSDP/TP/PP/EP and ring-attention sequence parallelism), a flagship
   transformer model, and orbax-style checkpoint/resume that composes with the
   control plane's gang-restart semantics.
+
+Cross-cutting: `jobset_tpu.obs` — request-scoped tracing (W3C traceparent
+across the client/server boundary, `GET /debug/traces`), structured JSON
+logging, and the exemplar-carrying metrics registry in
+`jobset_tpu.core.metrics` (see docs/observability.md).
 """
 
 __version__ = "0.1.0"

@@ -78,6 +78,10 @@ def _build_parser() -> argparse.ArgumentParser:
                         "may take the lease (k8s LeaseDuration default)")
     c.add_argument("--lease-retry-period", type=float, default=2.0,
                    help="renewal/retry cadence in seconds (k8s RetryPeriod)")
+    c.add_argument("--log-json", action="store_true",
+                   help="structured JSON logs on stderr, each record stamped "
+                        "with the active trace/span ids (zap-JSON analog; "
+                        "joins with GET /debug/traces on trace_id)")
 
     s = sub.add_parser("solver", help="run the placement solver sidecar (gRPC)")
     s.add_argument("--addr", default="127.0.0.1:8500")
@@ -153,6 +157,11 @@ def _cmd_controller(args) -> int:
 
     if args.feature_gates:
         features.set_from_string(args.feature_gates)
+
+    if args.log_json:
+        from .obs import configure_json_logging
+
+        configure_json_logging()
 
     solver = None
     if args.solver_addr:

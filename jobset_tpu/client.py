@@ -19,6 +19,7 @@ from typing import Optional
 
 from .api import serialization
 from .api.types import JobSet
+from .obs import trace as obs_trace
 
 
 class ApiError(Exception):
@@ -59,11 +60,31 @@ class JobSetClient:
 
     def _request(self, method: str, path: str, body: Optional[bytes] = None,
                  content_type: str = "application/json"):
+        headers = {"Content-Type": content_type} if body is not None else {}
+        # Client span + W3C traceparent injection: the server extracts the
+        # header and parents its apiserver.request span on this one, so a
+        # single trace covers client -> apiserver -> reconcile -> solver.
+        # Standalone GETs (health probes, wait_for_condition polls) are
+        # traced only when they run under an existing span — a poll loop
+        # must not churn the trace ring with one-span root traces.
+        if method == "GET" and obs_trace.current_span() is None:
+            return self._transport(method, path, body, headers)[0]
+        with obs_trace.span(
+            "client.request", {"http.method": method, "http.path": path}
+        ) as client_span:
+            headers["traceparent"] = client_span.context.to_traceparent()
+            try:
+                out, status = self._transport(method, path, body, headers)
+            except ApiError as exc:
+                client_span.set_attribute("http.status", exc.status)
+                raise
+            client_span.set_attribute("http.status", status)
+            return out
+
+    def _transport(self, method: str, path: str, body, headers):
+        """One HTTP round trip; returns (parsed payload, response status)."""
         req = urllib.request.Request(
-            self.base_url + path,
-            data=body,
-            method=method,
-            headers={"Content-Type": content_type} if body is not None else {},
+            self.base_url + path, data=body, method=method, headers=headers
         )
         try:
             with urllib.request.urlopen(
@@ -71,6 +92,7 @@ class JobSetClient:
             ) as resp:
                 data = resp.read()
                 ctype = resp.headers.get("Content-Type", "")
+                status = resp.status
         except urllib.error.HTTPError as exc:
             detail = exc.read().decode(errors="replace")
             try:
@@ -79,8 +101,8 @@ class JobSetClient:
                 pass
             raise ApiError(exc.code, detail) from None
         if ctype.startswith("application/json"):
-            return json.loads(data)
-        return data.decode()
+            return json.loads(data), status
+        return data.decode(), status
 
     # -- jobsets ----------------------------------------------------------
 

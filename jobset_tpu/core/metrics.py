@@ -5,13 +5,23 @@ jobset_failed_total counters labeled by jobset) plus reconcile-latency
 histograms, which the reference inherits from controller-runtime
 (`site/content/en/docs/reference/metrics.md:20-25`) and the solver-side
 latency metrics that are new in this build.
+
+Beyond the reference: `Gauge` (point-in-time values, e.g. solver batch
+occupancy) and histogram *exemplars* — each bucket remembers the most
+recent observation made under an active trace, rendered in OpenMetrics
+exemplar syntax (`... # {trace_id="..."} value timestamp`) so a scrape
+can jump from a latency bucket straight to the trace that landed there
+(`GET /debug/traces`).
 """
 
 from __future__ import annotations
 
 import math
 import threading
+import time
 from collections import defaultdict
+
+from ..obs.trace import current_trace_id
 
 
 class Counter:
@@ -27,10 +37,39 @@ class Counter:
             self._values[labels] += amount
 
     def value(self, *labels) -> float:
-        return self._values.get(labels, 0.0)
+        # Locked like render_prometheus: /metrics (and any reader) runs
+        # concurrently with the reconcile pump's inc() on the same dict.
+        with self._lock:
+            return self._values.get(labels, 0.0)
 
     def total(self) -> float:
-        return sum(self._values.values())
+        with self._lock:
+            return sum(self._values.values())
+
+
+class Gauge:
+    """Point-in-time value (can go up and down) with optional labels —
+    the controller-runtime Gauge analog. Same locked-read discipline as
+    Counter: set()/add() race the concurrent /metrics scrape."""
+
+    def __init__(self, name: str, help_text: str = "", label_names: tuple = ()):
+        self.name = name
+        self.help = help_text
+        self.label_names = label_names
+        self._values: dict[tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def set(self, value: float, *labels) -> None:
+        with self._lock:
+            self._values[labels] = float(value)
+
+    def add(self, amount: float, *labels) -> None:
+        with self._lock:
+            self._values[labels] = self._values.get(labels, 0.0) + amount
+
+    def value(self, *labels) -> float:
+        with self._lock:
+            return self._values.get(labels, 0.0)
 
 
 class Histogram:
@@ -50,6 +89,11 @@ class Histogram:
         # ~41% quantization made bench p99s bit-identical across modes
         # (VERDICT r2 weak #4); benchmarks need exact percentiles.
         self.raw: list[float] | None = None
+        # Per-bucket exemplars: bucket index -> (trace_id, value, unix_ts).
+        # Only observations made under an active trace are recorded, so the
+        # exposition can link a latency bucket to the trace that landed
+        # there (OpenMetrics exemplar semantics).
+        self.exemplars: dict[int, tuple[str, float, float]] = {}
         self._lock = threading.Lock()
 
     def enable_raw(self) -> None:
@@ -58,7 +102,9 @@ class Histogram:
         with self._lock:
             self.raw = []
 
-    def observe(self, seconds: float) -> None:
+    def observe(self, seconds: float, trace_id: str | None = None) -> None:
+        if trace_id is None:
+            trace_id = current_trace_id()
         with self._lock:
             self.sum += seconds
             self.n += 1
@@ -67,8 +113,14 @@ class Histogram:
             for i, b in enumerate(self.buckets):
                 if seconds <= b:
                     self.counts[i] += 1
+                    if trace_id is not None:
+                        self.exemplars[i] = (trace_id, seconds, time.time())
                     return
             self.counts[-1] += 1
+            if trace_id is not None:
+                self.exemplars[len(self.buckets)] = (
+                    trace_id, seconds, time.time()
+                )
 
     def percentile(self, q: float) -> float:
         """Approximate percentile from bucket counts (upper bucket bound),
@@ -116,6 +168,19 @@ pump_errors_total = Counter(
     "Reconcile pump iterations that raised",
     label_names=(),
 )
+solver_batch_occupancy = Gauge(
+    "jobset_placement_solver_batch_occupancy",
+    "Real-problem fraction of the last solver dispatch's padded batch "
+    "(real cells / padded cells; 1.0 = no padding waste)",
+)
+solver_batch_problems = Gauge(
+    "jobset_placement_solver_batch_problems",
+    "Problem count in the last batched solver dispatch",
+)
+api_requests_in_flight = Gauge(
+    "jobset_apiserver_requests_in_flight",
+    "HTTP requests currently being handled by the controller server",
+)
 
 
 ALL_COUNTERS = (
@@ -125,18 +190,48 @@ ALL_COUNTERS = (
     pump_errors_total,
 )
 ALL_HISTOGRAMS = (reconcile_time_seconds, solver_solve_time_seconds)
+ALL_GAUGES = (
+    solver_batch_occupancy,
+    solver_batch_problems,
+    api_requests_in_flight,
+)
 
 
-def render_prometheus() -> str:
-    """Prometheus text exposition format for the whole registry — what the
-    reference's /metrics endpoint serves (metrics.go:56-61 registration into
-    the controller-runtime registry + the reconcile histograms).  Snapshots
-    are taken under each metric's lock: /metrics is served concurrently with
-    the reconcile pump's inc()/observe() calls."""
+def _render_exemplar(exemplar: tuple[str, float, float] | None) -> str:
+    """OpenMetrics exemplar suffix: ` # {trace_id="..."} value timestamp`
+    (openmetrics spec §exemplars); empty when the bucket has none."""
+    if exemplar is None:
+        return ""
+    trace_id, value, ts = exemplar
+    return f' # {{trace_id="{trace_id}"}} {value:.6g} {ts:.3f}'
+
+
+def render_prometheus(openmetrics: bool = False) -> str:
+    """Text exposition for the whole registry — what the reference's
+    /metrics endpoint serves (metrics.go:56-61 registration into the
+    controller-runtime registry + the reconcile histograms). Snapshots are
+    taken under each metric's lock: /metrics is served concurrently with
+    the reconcile pump's inc()/observe() calls.
+
+    ``openmetrics=False`` (default) renders the classic Prometheus text
+    format — NO exemplars, because the legacy parser errors on the ``#``
+    token where it expects an optional timestamp. ``openmetrics=True``
+    (the server selects it when the scraper's Accept header negotiates
+    ``application/openmetrics-text``) adds per-bucket exemplars and the
+    ``# EOF`` terminator the OpenMetrics spec requires."""
     lines: list[str] = []
     for c in ALL_COUNTERS:
-        lines.append(f"# HELP {c.name} {c.help}")
-        lines.append(f"# TYPE {c.name} counter")
+        # OpenMetrics: a counter's MetricFamily name must NOT end in
+        # _total (the suffix belongs to the sample), so the HELP/TYPE
+        # lines drop it there; sample lines keep the full _total name in
+        # both formats. Classic text keeps the full name everywhere.
+        family = (
+            c.name[: -len("_total")]
+            if openmetrics and c.name.endswith("_total")
+            else c.name
+        )
+        lines.append(f"# HELP {family} {c.help}")
+        lines.append(f"# TYPE {family} counter")
         with c._lock:
             values = sorted(c._values.items())
         if not values:
@@ -147,19 +242,42 @@ def render_prometheus() -> str:
             )
             suffix = f"{{{pairs}}}" if pairs else ""
             lines.append(f"{c.name}{suffix} {value}")
+    for g in ALL_GAUGES:
+        lines.append(f"# HELP {g.name} {g.help}")
+        lines.append(f"# TYPE {g.name} gauge")
+        with g._lock:
+            values = sorted(g._values.items())
+        if not values:
+            lines.append(f"{g.name} 0")
+        for labels, value in values:
+            pairs = ",".join(
+                f'{n}="{v}"' for n, v in zip(g.label_names, labels)
+            )
+            suffix = f"{{{pairs}}}" if pairs else ""
+            lines.append(f"{g.name}{suffix} {value}")
     for h in ALL_HISTOGRAMS:
         lines.append(f"# HELP {h.name} {h.help}")
         lines.append(f"# TYPE {h.name} histogram")
         with h._lock:
             counts, total, n = list(h.counts), h.sum, h.n
+            exemplars = dict(h.exemplars)
         cumulative = 0
-        for bound, count in zip(h.buckets, counts):
+        for i, (bound, count) in enumerate(zip(h.buckets, counts)):
             cumulative += count
-            lines.append(f'{h.name}_bucket{{le="{bound:g}"}} {cumulative}')
+            lines.append(
+                f'{h.name}_bucket{{le="{bound:g}"}} {cumulative}'
+                + (_render_exemplar(exemplars.get(i)) if openmetrics else "")
+            )
         cumulative += counts[-1]
-        lines.append(f'{h.name}_bucket{{le="+Inf"}} {cumulative}')
+        lines.append(
+            f'{h.name}_bucket{{le="+Inf"}} {cumulative}'
+            + (_render_exemplar(exemplars.get(len(h.buckets)))
+               if openmetrics else "")
+        )
         lines.append(f"{h.name}_sum {total}")
         lines.append(f"{h.name}_count {n}")
+    if openmetrics:
+        lines.append("# EOF")
     return "\n".join(lines) + "\n"
 
 
@@ -175,9 +293,12 @@ def reset() -> None:
     """Test helper: clear all metric state."""
     for counter in ALL_COUNTERS:
         counter._values.clear()
+    for gauge in ALL_GAUGES:
+        gauge._values.clear()
     for hist in ALL_HISTOGRAMS:
         hist.counts = [0] * len(hist.counts)
         hist.sum = 0.0
         hist.n = 0
+        hist.exemplars.clear()
         if hist.raw is not None:
             hist.raw = []

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 from ..api import keys
 from ..api.types import Condition
+from ..obs.trace import span as obs_span
 from ..placement.naming import is_leader_pod
 from .cluster import Cluster
 from .objects import Pod
@@ -43,18 +44,22 @@ class PodReconciler:
         dirty, cluster.dirty_placement_job_keys = (
             cluster.dirty_placement_job_keys, set()
         )
+        if not dirty:
+            return False  # idle tick: no span, no work
         changed = False
-        for job_key in sorted(dirty):
-            leader = next(
-                (
-                    cluster.pods[k]
-                    for k in cluster.pods_by_job_key.get(job_key, ())
-                    if k in cluster.leader_pod_keys
-                ),
-                None,
-            )
-            if leader is not None and self._watched(leader):
-                changed |= self.reconcile_leader(leader)
+        with obs_span("pod_reconcile", {"dirty_job_keys": len(dirty)}) as s:
+            for job_key in sorted(dirty):
+                leader = next(
+                    (
+                        cluster.pods[k]
+                        for k in cluster.pods_by_job_key.get(job_key, ())
+                        if k in cluster.leader_pod_keys
+                    ),
+                    None,
+                )
+                if leader is not None and self._watched(leader):
+                    changed |= self.reconcile_leader(leader)
+            s.set_attribute("changed", changed)
         return changed
 
     def reconcile_leader(self, leader: Pod) -> bool:

@@ -30,6 +30,7 @@ from ..api.types import (
     global_job_index,
     jobset_suspended,
 )
+from ..obs.trace import span as obs_span
 from ..placement.naming import gen_job_name, job_hash_key
 from ..utils.collections import merge_maps, merge_slices
 from . import metrics
@@ -66,6 +67,19 @@ class JobSetReconciler:
     # ------------------------------------------------------------------
 
     def reconcile(self, namespace: str, name: str) -> bool:
+        # One span per reconcile pass: inside an HTTP write it chains under
+        # the apiserver.request span (synchronous post-write pump); on the
+        # background pump it roots its own trace. Opened before the timer
+        # so the span's duration brackets the observed reconcile latency
+        # and the histogram exemplar carries this trace's id.
+        with obs_span(
+            "reconcile", {"jobset": f"{namespace}/{name}"}
+        ) as reconcile_span:
+            changed = self._reconcile(namespace, name)
+            reconcile_span.set_attribute("changed", changed)
+            return changed
+
+    def _reconcile(self, namespace: str, name: str) -> bool:
         t0 = _time.perf_counter()
         cluster = self.cluster
         js = cluster.get_jobset(namespace, name)

@@ -1,0 +1,105 @@
+"""Structured JSON logging stamped with the active trace context.
+
+The reference controller logs through zap in JSON mode (main.go's
+`zap.Options`); ours mirrors that with stdlib ``logging`` plus one
+formatter that emits a single JSON object per record and joins each
+record to the in-process tracer: any log line emitted while a span is
+active carries ``trace_id``/``span_id``, so `grep trace_id= logs` and
+`GET /debug/traces` meet on the same ids.
+
+Usage::
+
+    configure_json_logging()          # root handler, idempotent
+    log = get_logger("jobset_tpu.server")
+    log.info("jobset created", extra={"jobset": "default/js"})
+
+Arbitrary ``extra`` keys are carried into the JSON object (standard
+LogRecord attributes are excluded), so call sites attach structure
+without string formatting.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from typing import Optional
+
+from .trace import current_span
+
+# LogRecord's own attribute names — everything else on a record came from
+# `extra` and belongs in the JSON payload.
+_RESERVED = frozenset(
+    logging.LogRecord(
+        "", 0, "", 0, "", (), None
+    ).__dict__
+) | {"message", "asctime", "taskName"}
+
+
+class JsonLogFormatter(logging.Formatter):
+    """One JSON object per line: ts, level, logger, message, trace ids
+    (when a span is active), and any `extra` fields."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        out = {
+            "ts": round(record.created, 6),
+            "time": time.strftime(
+                "%Y-%m-%dT%H:%M:%S", time.gmtime(record.created)
+            )
+            + f".{int(record.msecs):03d}Z",
+            "level": record.levelname,
+            "logger": record.name,
+            "message": record.getMessage(),
+        }
+        active = current_span()
+        if active is not None:
+            out["trace_id"] = active.context.trace_id
+            out["span_id"] = active.context.span_id
+        if record.exc_info and record.exc_info[1] is not None:
+            exc = record.exc_info[1]
+            out["error"] = f"{type(exc).__name__}: {exc}"[:400]
+        for key, value in record.__dict__.items():
+            if key in _RESERVED or key.startswith("_"):
+                continue
+            try:
+                json.dumps(value)
+                out[key] = value
+            except (TypeError, ValueError):
+                out[key] = repr(value)[:200]
+        return json.dumps(out)
+
+
+_configured = False
+
+
+def configure_json_logging(
+    level: int = logging.INFO, stream=None, force: bool = False
+) -> logging.Handler:
+    """Install one JSON handler on the ``jobset_tpu`` logger subtree.
+
+    Scoped to the package logger (not root) so embedding applications and
+    the test runner keep their own formatting; idempotent unless
+    ``force``."""
+    global _configured
+    pkg_logger = logging.getLogger("jobset_tpu")
+    if _configured and not force:
+        for h in pkg_logger.handlers:
+            if isinstance(h.formatter, JsonLogFormatter):
+                return h
+    if force:
+        # Replace, don't stack: a second JSON handler would double every
+        # record.
+        for h in list(pkg_logger.handlers):
+            if isinstance(h.formatter, JsonLogFormatter):
+                pkg_logger.removeHandler(h)
+    handler = logging.StreamHandler(stream)
+    handler.setFormatter(JsonLogFormatter())
+    pkg_logger.addHandler(handler)
+    pkg_logger.setLevel(level)
+    pkg_logger.propagate = False
+    _configured = True
+    return handler
+
+
+def get_logger(name: Optional[str] = None) -> logging.Logger:
+    return logging.getLogger(name or "jobset_tpu")

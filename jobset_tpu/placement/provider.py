@@ -23,6 +23,7 @@ from __future__ import annotations
 
 from ..api import keys
 from ..core import features
+from ..obs.trace import span as obs_span
 from .naming import gen_job_name, job_hash_key
 from .webhooks import PLAN_ANNOTATION
 
@@ -138,38 +139,51 @@ class SolverPlacement:
 
         from .plans import build_cost_matrix_for_specs, build_cost_params_for_specs
 
-        specs = self._expected_job_specs(cluster, js)
-        if not specs:
-            return
-        pending_release = self._pending_release(cluster, js, topology_key, specs)
+        with obs_span(
+            "placement.prepare",
+            {"jobset": js.metadata.name, "block": block},
+        ) as prepare_span:
+            specs = self._expected_job_specs(cluster, js)
+            if not specs:
+                return
+            prepare_span.set_attribute("jobs", len(specs))
+            pending_release = self._pending_release(
+                cluster, js, topology_key, specs
+            )
 
-        # Structured path first: ship the O(J + D) parametrization and build
-        # the dense matrix on device (kilobytes over the host->TPU link).
-        structured = None
-        if hasattr(solver, "solve_structured_async"):
-            structured = build_cost_params_for_specs(
-                cluster, specs, topology_key, pending_release=pending_release
-            )
-        if structured is not None:
-            params, domain_values = structured
-            pending = solver.solve_structured_async(**params)
-        else:
-            built = build_cost_matrix_for_specs(
-                cluster, specs, topology_key, pending_release=pending_release
-            )
-            if built is None:
-                return
-            cost, feasible, domain_values = built
-            if not feasible.any():
-                return
-            pending = solver.solve_async(cost, feasible)
-        if block:
-            # Complete the solve here, outside any reconcile: on hosts where
-            # the "device" shares cores with the controller (the CPU
-            # fallback), letting the solve run concurrently just steals
-            # cycles from the very reconciles the prefetch is protecting.
-            pending = self._materialize(specs, domain_values, pending.result())
-        self._store_plan(js, specs, domain_values, pending)
+            # Structured path first: ship the O(J + D) parametrization and
+            # build the dense matrix on device (kilobytes over the
+            # host->TPU link).
+            structured = None
+            if hasattr(solver, "solve_structured_async"):
+                structured = build_cost_params_for_specs(
+                    cluster, specs, topology_key,
+                    pending_release=pending_release,
+                )
+            if structured is not None:
+                params, domain_values = structured
+                pending = solver.solve_structured_async(**params)
+            else:
+                built = build_cost_matrix_for_specs(
+                    cluster, specs, topology_key,
+                    pending_release=pending_release,
+                )
+                if built is None:
+                    return
+                cost, feasible, domain_values = built
+                if not feasible.any():
+                    return
+                pending = solver.solve_async(cost, feasible)
+            if block:
+                # Complete the solve here, outside any reconcile: on hosts
+                # where the "device" shares cores with the controller (the
+                # CPU fallback), letting the solve run concurrently just
+                # steals cycles from the very reconciles the prefetch is
+                # protecting.
+                pending = self._materialize(
+                    specs, domain_values, pending.result()
+                )
+            self._store_plan(js, specs, domain_values, pending)
 
     def prepare_batch(self, cluster, jobsets, block: bool = True) -> None:
         """Storm path: prefetch plans for MANY JobSets as ONE vmapped solve.
@@ -199,6 +213,13 @@ class SolverPlacement:
                 self.prepare(cluster, js, block=block)
             return
 
+        with obs_span(
+            "placement.prepare_batch",
+            {"jobsets": len(jobsets), "block": block},
+        ):
+            self._prepare_batch_body(cluster, jobsets, block, solver)
+
+    def _prepare_batch_body(self, cluster, jobsets, block, solver) -> None:
         from .plans import build_cost_params_for_specs
 
         entries = []
@@ -323,15 +344,28 @@ class SolverPlacement:
         if topology_key is None or not jobs:
             return
 
-        plan = self._fetch_valid_plan(cluster, js, jobs, topology_key)
-        if plan is PLAN_PENDING:
-            return PLAN_PENDING
-        if plan is None:
-            from .plans import build_plan
-
-            plan = build_plan(cluster, js, jobs, topology_key, self._get_solver())
+        with obs_span(
+            "placement.assign",
+            {"jobset": js.metadata.name, "jobs": len(jobs)},
+        ) as assign_span:
+            plan = self._fetch_valid_plan(cluster, js, jobs, topology_key)
+            if plan is PLAN_PENDING:
+                assign_span.set_attribute("outcome", "plan_pending")
+                return PLAN_PENDING
             if plan is None:
-                return
+                from .plans import build_plan
+
+                assign_span.set_attribute("outcome", "fresh_solve")
+                plan = build_plan(
+                    cluster, js, jobs, topology_key, self._get_solver()
+                )
+                if plan is None:
+                    return
+            else:
+                assign_span.set_attribute("outcome", "prefetched_plan")
+            self._stamp_plan(cluster, jobs, plan, topology_key)
+
+    def _stamp_plan(self, cluster, jobs, plan, topology_key) -> None:
         for job in jobs:
             domain = plan.get(job.metadata.name)
             if domain is None:

@@ -300,18 +300,29 @@ class RemoteAssignmentSolver:
                 raise
 
     def _solve_remote_or_local(self, cost, feasible):
-        frame = pack_problem(cost, feasible)
-        try:
-            reply = self._roundtrip(frame)
-            self.remote_solves += 1
-            return unpack_assignment(reply)
-        except Exception:
-            if not self._fallback_local:
-                raise
-            self.local_fallbacks += 1
-            if np.asarray(cost).ndim == 2:
-                return self._local_solver().solve(cost, feasible)
-            return self._local_solver().solve_batch(cost, feasible)
+        from ..obs.trace import span as obs_span
+
+        # The gRPC hop gets its own span so a slow reconcile attributes to
+        # the sidecar round trip rather than the solve itself (the sidecar
+        # runs its own tracer; this side measures wire + queueing + solve).
+        with obs_span(
+            "solver.grpc", {"address": self.address, "bytes": 0}
+        ) as grpc_span:
+            frame = pack_problem(cost, feasible)
+            grpc_span.set_attribute("bytes", len(frame))
+            try:
+                reply = self._roundtrip(frame)
+                self.remote_solves += 1
+                return unpack_assignment(reply)
+            except Exception as exc:
+                if not self._fallback_local:
+                    raise
+                grpc_span.set_attribute("fallback", "local")
+                grpc_span.record_error(exc)
+                self.local_fallbacks += 1
+                if np.asarray(cost).ndim == 2:
+                    return self._local_solver().solve(cost, feasible)
+                return self._local_solver().solve_batch(cost, feasible)
 
     def solve(self, cost: np.ndarray, feasible: Optional[np.ndarray] = None) -> np.ndarray:
         return self._solve_remote_or_local(np.asarray(cost, np.float32), feasible)

@@ -47,6 +47,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..core import metrics
+from ..obs import trace as obs_trace
 
 # Cost scale: costs are small non-negative ints; benefit = (COST_CAP - cost).
 COST_CAP = 1024.0
@@ -425,6 +426,29 @@ from collections import deque as _deque
 
 RECENT_ITERATIONS: "_deque[int]" = _deque(maxlen=256)
 
+# Compile-cache hit/miss attribution for the dispatch spans: jax caches
+# executables by (kernel, static args, shapes, device); this mirror of that
+# key tells the tracer whether a dispatch paid a trace+compile. Process-
+# global like the jit cache itself.
+_COMPILED_KEYS: set[tuple] = set()
+
+
+def _compile_cache_key(kernel: str, *shape) -> tuple:
+    try:
+        device = str(jax.config.jax_default_device or jax.default_backend())
+    except Exception:
+        device = "?"
+    return (kernel, device) + shape
+
+
+def _note_compile(key: tuple) -> str:
+    """'hit' when this bucket shape already compiled in-process, else
+    'miss' (first dispatch pays trace+compile); records the key."""
+    if key in _COMPILED_KEYS:
+        return "hit"
+    _COMPILED_KEYS.add(key)
+    return "miss"
+
 # Which algorithm served each recent solve ("auction" | "hungarian"):
 # the portfolio's evidence trail, mirrored alongside RECENT_ITERATIONS
 # (Hungarian solves report 0 iterations — the count is meaningless there).
@@ -438,7 +462,7 @@ class HostSolve:
 
     def __init__(
         self, assignment: np.ndarray, num_jobs: int, num_domains: int,
-        t0: float, observe: bool = True,
+        t0: float, observe: bool = True, span_parent=None,
     ):
         self._assignment = assignment
         self._num_jobs = num_jobs
@@ -446,6 +470,7 @@ class HostSolve:
         self._t0 = t0
         self._done_at = time.perf_counter()
         self._observe = observe
+        self._span_parent = span_parent
 
     def is_ready(self) -> bool:
         return True
@@ -460,7 +485,20 @@ class HostSolve:
             metrics.solver_solve_time_seconds.observe(self._done_at - self._t0)
             RECENT_ITERATIONS.append(0)
             RECENT_ALGORITHMS.append("hungarian")
+            obs_trace.TRACER.record_span(
+                "solver.solve_loop",
+                self._done_at - self._t0,
+                {"algorithm": "hungarian", "jobs": self._num_jobs,
+                 "domains": self._num_domains},
+                parent=self._result_parent(),
+            )
         return self._assignment
+
+    def _result_parent(self):
+        """Attribution for result-time phase spans: the fetching caller's
+        active span when there is one (the reconcile that paid the wait),
+        else the dispatch-time solver span (late async fetches)."""
+        return None if obs_trace.current_span() else self._span_parent
 
     @property
     def iterations(self) -> int:
@@ -478,7 +516,7 @@ class PendingSolve:
 
     def __init__(
         self, assignment, iters, num_jobs: int, num_domains: int, t0: float,
-        observe: bool = True,
+        observe: bool = True, span_parent=None,
     ):
         self._assignment = assignment
         self._iters = iters
@@ -487,6 +525,7 @@ class PendingSolve:
         self._t0 = t0
         self._observe = observe
         self._ready_at: float | None = None
+        self._span_parent = span_parent
 
     def is_ready(self) -> bool:
         """True once the device has finished the solve (non-blocking)."""
@@ -500,9 +539,24 @@ class PendingSolve:
         return time.perf_counter() - self._t0
 
     def result(self) -> np.ndarray:
+        observe_this_fetch = self._observe
+        parent = self._result_parent() if observe_this_fetch else None
+        # Complete the device wait BEFORE timing the fetch, so the
+        # readback span measures only the host copy: a blocking caller
+        # (solve(), block=True prepare) reaches result() while the device
+        # is still solving, and np.asarray would otherwise absorb the
+        # whole solve into "readback", double-counting solve_loop.
+        if self._ready_at is None and not self.is_ready():
+            try:
+                self._assignment.block_until_ready()
+            except Exception:  # noqa: BLE001 — np.asarray below still works
+                pass
+            self.is_ready()  # stamp _ready_at
+        fetch_t0 = time.perf_counter()
         out = np.asarray(self._assignment)[: self._num_jobs].astype(np.int64)
+        fetch_end = time.perf_counter()
         out[out >= self._num_domains] = -1  # sinks/padding -> unassigned
-        if self._observe:
+        if observe_this_fetch:
             # solve_time measures DEVICE latency (dispatch -> device
             # finished), not fetch time: under the async prepare flow the
             # parked reconcile fetches the plan ticks after the device is
@@ -511,7 +565,6 @@ class PendingSolve:
             # timestamp comes from the plan_pending poll (is_ready per
             # parked pass), so it is quantized by the pump's tick cadence
             # — an upper bound on, never below, the true device time.
-            self.is_ready()  # stamp _ready_at if the device just finished
             end = self._ready_at if self._ready_at is not None else (
                 time.perf_counter()
             )
@@ -519,7 +572,25 @@ class PendingSolve:
             RECENT_ITERATIONS.append(int(self._iters))
             RECENT_ALGORITHMS.append("auction")
             self._observe = False  # observe once, however often fetched
+            # Phase spans at first fetch: the solve loop's device wall time
+            # (dispatch -> ready, the same interval the histogram observes)
+            # and the host readback that materialized the assignment.
+            common = {"jobs": self._num_jobs, "domains": self._num_domains,
+                      "iterations": int(self._iters)}
+            obs_trace.TRACER.record_span(
+                "solver.solve_loop",
+                end - self._t0,
+                {"algorithm": "auction", **common},
+                parent=parent,
+            )
+            obs_trace.TRACER.record_span(
+                "solver.readback", fetch_end - fetch_t0, common, parent=parent
+            )
         return out
+
+    def _result_parent(self):
+        """See HostSolve._result_parent."""
+        return None if obs_trace.current_span() else self._span_parent
 
     @property
     def iterations(self) -> int:
@@ -710,12 +781,18 @@ class AssignmentSolver:
         # (COST_CAP - c). A smaller big-M would strand jobs on tight
         # augmenting chains the auction arm would still bind, silently
         # desynchronizing the two portfolio arms' bound fractions.
-        big_m = 5.0 * COST_CAP
-        dense = np.where(feasible, np.clip(cost, 0.0, COST_CAP - 1.0), big_m)
-        assignment = np.full(num_jobs, -1, np.int64)
-        rows, cols = linear_sum_assignment(dense)
-        ok = dense[rows, cols] < big_m
-        assignment[rows[ok]] = cols[ok]
+        with obs_trace.span(
+            "solver.hungarian_fallback",
+            {"jobs": num_jobs, "domains": num_domains},
+        ):
+            big_m = 5.0 * COST_CAP
+            dense = np.where(
+                feasible, np.clip(cost, 0.0, COST_CAP - 1.0), big_m
+            )
+            assignment = np.full(num_jobs, -1, np.int64)
+            rows, cols = linear_sum_assignment(dense)
+            ok = dense[rows, cols] < big_m
+            assignment[rows[ok]] = cols[ok]
         return HostSolve(assignment, num_jobs, num_domains, t0)
 
     def solve_async(
@@ -737,30 +814,54 @@ class AssignmentSolver:
         host_small = self._host_hungarian(jobs_p * domains_p)
         max_iters = self._HOST_AUCTION_ITER_CAP if host_small else self.max_iters
 
-        # Sinks are implicit in _auction (constant outside option), so the
-        # shipped matrix is [J_p, D_p] — no [J_p, J_p] sink block.
-        benefit = np.full((jobs_p, domains_p), NEG_INF, np.float32)
-        clipped = np.clip(cost, 0.0, COST_CAP - 1.0)
-        benefit[:num_jobs, :num_domains] = np.where(
-            feasible, COST_CAP - clipped, NEG_INF
-        )
-
-        # Scale to integers spaced J+1 apart -> eps=1 yields exact optimum.
-        scale = float(jobs_p + 1)
-        with self._on_solve_device(jobs_p * domains_p):
-            benefit_scaled = jnp.asarray(benefit * scale)
-            assignment, _, iters = _auction(
-                benefit_scaled, jnp.float32(1.0), max_iters=max_iters
+        with obs_trace.span(
+            "solver.solve",
+            {"kind": "dense", "jobs": num_jobs, "domains": num_domains},
+            activate=True,
+        ) as solve_span:
+            # Scale to ints spaced J+1 apart -> eps=1 yields exact optimum.
+            scale = float(jobs_p + 1)
+            metrics.solver_batch_occupancy.set(
+                (num_jobs * num_domains) / (jobs_p * domains_p)
             )
-        pending = PendingSolve(assignment, iters, num_jobs, num_domains, t0)
-        if host_small:
-            return self._capped_or_hungarian(
-                pending,
-                lambda: self._hungarian_solve(
-                    cost, feasible, num_jobs, num_domains, t0
-                ),
+            metrics.solver_batch_problems.set(1)
+            with self._on_solve_device(jobs_p * domains_p):
+                # host_transfer covers matrix build AND the jnp.asarray
+                # device copy (same split as the structured path, so the
+                # two paths' phase names stay comparable). Sinks are
+                # implicit in _auction (constant outside option), so the
+                # shipped matrix is [J_p, D_p] — no [J_p, J_p] sink block.
+                with obs_trace.span(
+                    "solver.host_transfer",
+                    {"matrix_mb": round(jobs_p * domains_p * 4 / 1e6, 3)},
+                ):
+                    benefit = np.full(
+                        (jobs_p, domains_p), NEG_INF, np.float32
+                    )
+                    clipped = np.clip(cost, 0.0, COST_CAP - 1.0)
+                    benefit[:num_jobs, :num_domains] = np.where(
+                        feasible, COST_CAP - clipped, NEG_INF
+                    )
+                    benefit_scaled = jnp.asarray(benefit * scale)
+                cache = _note_compile(
+                    _compile_cache_key("auction", jobs_p, domains_p, max_iters)
+                )
+                with obs_trace.span("solver.dispatch", {"compile_cache": cache}):
+                    assignment, _, iters = _auction(
+                        benefit_scaled, jnp.float32(1.0), max_iters=max_iters
+                    )
+            pending = PendingSolve(
+                assignment, iters, num_jobs, num_domains, t0,
+                span_parent=solve_span.context,
             )
-        return pending
+            if host_small:
+                return self._capped_or_hungarian(
+                    pending,
+                    lambda: self._hungarian_solve(
+                        cost, feasible, num_jobs, num_domains, t0
+                    ),
+                )
+            return pending
 
     def solve(self, cost: np.ndarray, feasible: Optional[np.ndarray] = None) -> np.ndarray:
         """Solve one assignment problem, blocking until the result is ready.
@@ -801,38 +902,61 @@ class AssignmentSolver:
             out[: a.shape[0]] = a
             return out
 
-        with self._on_solve_device(jobs_p * domains_p):
-            assignment, iters = _auction_structured(
-                jnp.asarray(pad(np.asarray(load, np.float32), domains_p, 0.0)),
-                jnp.asarray(pad(np.asarray(free, np.float32), domains_p, -1.0)),
-                jnp.asarray(pad(np.asarray(pods_needed, np.float32), jobs_p, np.inf)),
-                jnp.asarray(pad(np.asarray(sticky, np.int32), jobs_p, -1)),
-                jnp.asarray(pad(np.asarray(occupied, bool), domains_p, True)),
-                jnp.asarray(pad(np.asarray(own_domain, np.int32), jobs_p, -1)),
-                jnp.int32(num_domains),
-                max_iters=max_iters,
+        with obs_trace.span(
+            "solver.solve",
+            {"kind": "structured", "jobs": num_jobs, "domains": num_domains},
+        ) as solve_span:
+            metrics.solver_batch_occupancy.set(
+                (num_jobs * num_domains) / (jobs_p * domains_p)
             )
-        pending = PendingSolve(assignment, iters, num_jobs, num_domains, t0)
-        if host_small:
-            # The Hungarian fallback has nothing to ship, so the
-            # structured parametrization's reason to exist (kilobytes
-            # over the link) is moot: materialize the same cost model on
-            # host (numpy mirror, differentially pinned by tests).
-            def fallback():
-                cost, feasible = _structured_cost_np(
-                    np.asarray(load, np.float32),
-                    np.asarray(free, np.float32),
-                    np.asarray(pods_needed, np.float32),
-                    np.asarray(sticky, np.int32),
-                    np.asarray(occupied, bool),
-                    np.asarray(own_domain, np.int32),
-                )
-                return self._hungarian_solve(
-                    cost, feasible, num_jobs, num_domains, t0
-                )
+            metrics.solver_batch_problems.set(1)
+            with self._on_solve_device(jobs_p * domains_p):
+                with obs_trace.span("solver.host_transfer", {
+                    "params_kb": round(
+                        (3 * jobs_p * 4 + 3 * domains_p * 4) / 1024.0, 3
+                    ),
+                }):
+                    operands = (
+                        jnp.asarray(pad(np.asarray(load, np.float32), domains_p, 0.0)),
+                        jnp.asarray(pad(np.asarray(free, np.float32), domains_p, -1.0)),
+                        jnp.asarray(pad(np.asarray(pods_needed, np.float32), jobs_p, np.inf)),
+                        jnp.asarray(pad(np.asarray(sticky, np.int32), jobs_p, -1)),
+                        jnp.asarray(pad(np.asarray(occupied, bool), domains_p, True)),
+                        jnp.asarray(pad(np.asarray(own_domain, np.int32), jobs_p, -1)),
+                    )
+                cache = _note_compile(_compile_cache_key(
+                    "auction_structured", jobs_p, domains_p, max_iters
+                ))
+                with obs_trace.span("solver.dispatch", {"compile_cache": cache}):
+                    assignment, iters = _auction_structured(
+                        *operands,
+                        jnp.int32(num_domains),
+                        max_iters=max_iters,
+                    )
+            pending = PendingSolve(
+                assignment, iters, num_jobs, num_domains, t0,
+                span_parent=solve_span.context,
+            )
+            if host_small:
+                # The Hungarian fallback has nothing to ship, so the
+                # structured parametrization's reason to exist (kilobytes
+                # over the link) is moot: materialize the same cost model on
+                # host (numpy mirror, differentially pinned by tests).
+                def fallback():
+                    cost, feasible = _structured_cost_np(
+                        np.asarray(load, np.float32),
+                        np.asarray(free, np.float32),
+                        np.asarray(pods_needed, np.float32),
+                        np.asarray(sticky, np.int32),
+                        np.asarray(occupied, bool),
+                        np.asarray(own_domain, np.int32),
+                    )
+                    return self._hungarian_solve(
+                        cost, feasible, num_jobs, num_domains, t0
+                    )
 
-            return self._capped_or_hungarian(pending, fallback)
-        return pending
+                return self._capped_or_hungarian(pending, fallback)
+            return pending
 
     def solve_structured_batch_async(
         self, problems: "list[dict]"
@@ -857,40 +981,77 @@ class AssignmentSolver:
             out[: a.shape[0]] = a
             return out
 
-        stacked = {
-            # Padded domain columns are masked inside _auction_structured by
-            # `dcol < num_domains`; padded job rows get pods_needed=inf so
-            # every real column is infeasible and they land on their sink.
-            "load": np.stack([pad(p["load"], domains_p, 0.0, np.float32) for p in problems]),
-            "free": np.stack([pad(p["free"], domains_p, -1.0, np.float32) for p in problems]),
-            "pods_needed": np.stack([pad(p["pods_needed"], jobs_p, np.inf, np.float32) for p in problems]),
-            "sticky": np.stack([pad(p["sticky"], jobs_p, -1, np.int32) for p in problems]),
-            "occupied": np.stack([pad(p["occupied"], domains_p, True, bool) for p in problems]),
-            "own_domain": np.stack([pad(p["own_domain"], jobs_p, -1, np.int32) for p in problems]),
-        }
-        num_domains = np.asarray(
-            [int(p["load"].shape[0]) for p in problems], np.int32
+        # Batch-occupancy gauge: real problem cells over the padded batch's
+        # cells — how much of the one vmapped dispatch is useful work vs
+        # power-of-two padding waste (a mixed-size storm drags this down).
+        real_cells = sum(
+            int(p["pods_needed"].shape[0]) * int(p["load"].shape[0])
+            for p in problems
         )
-        with self._on_solve_device(len(problems) * jobs_p * domains_p, is_batched=True):
-            assignment, iters = _auction_structured_batch(
-                *(jnp.asarray(stacked[k]) for k in (
-                    "load", "free", "pods_needed", "sticky", "occupied",
-                    "own_domain",
-                )),
-                jnp.asarray(num_domains),
-                max_iters=self.max_iters,
-            )
-        return [
-            PendingSolve(
-                assignment[b],
-                iters[b],
-                int(p["pods_needed"].shape[0]),
-                int(p["load"].shape[0]),
-                t0,
-                observe=(b == 0),
-            )
-            for b, p in enumerate(problems)
-        ]
+        padded_cells = len(problems) * jobs_p * domains_p
+        metrics.solver_batch_occupancy.set(real_cells / max(padded_cells, 1))
+        metrics.solver_batch_problems.set(len(problems))
+
+        with obs_trace.span(
+            "solver.solve",
+            {"kind": "structured_batch", "problems": len(problems),
+             "jobs_padded": jobs_p, "domains_padded": domains_p,
+             "batch_occupancy": round(real_cells / max(padded_cells, 1), 4)},
+        ) as solve_span:
+            with self._on_solve_device(
+                len(problems) * jobs_p * domains_p, is_batched=True
+            ):
+                # host_transfer covers stacking AND the device copies, like
+                # the single-solve paths. Padded domain columns are masked
+                # inside _auction_structured by `dcol < num_domains`;
+                # padded job rows get pods_needed=inf so every real column
+                # is infeasible and they land on their sink.
+                with obs_trace.span("solver.host_transfer", {
+                    "params_kb": round(
+                        len(problems) * (3 * jobs_p + 3 * domains_p) * 4
+                        / 1024.0,
+                        3,
+                    ),
+                }):
+                    stacked = {
+                        "load": np.stack([pad(p["load"], domains_p, 0.0, np.float32) for p in problems]),
+                        "free": np.stack([pad(p["free"], domains_p, -1.0, np.float32) for p in problems]),
+                        "pods_needed": np.stack([pad(p["pods_needed"], jobs_p, np.inf, np.float32) for p in problems]),
+                        "sticky": np.stack([pad(p["sticky"], jobs_p, -1, np.int32) for p in problems]),
+                        "occupied": np.stack([pad(p["occupied"], domains_p, True, bool) for p in problems]),
+                        "own_domain": np.stack([pad(p["own_domain"], jobs_p, -1, np.int32) for p in problems]),
+                    }
+                    operands = [
+                        jnp.asarray(stacked[k]) for k in (
+                            "load", "free", "pods_needed", "sticky",
+                            "occupied", "own_domain",
+                        )
+                    ]
+                    num_domains = jnp.asarray(np.asarray(
+                        [int(p["load"].shape[0]) for p in problems], np.int32
+                    ))
+                cache = _note_compile(_compile_cache_key(
+                    "auction_structured_batch", len(problems), jobs_p,
+                    domains_p, self.max_iters,
+                ))
+                with obs_trace.span("solver.dispatch", {"compile_cache": cache}):
+                    assignment, iters = _auction_structured_batch(
+                        *operands,
+                        num_domains,
+                        max_iters=self.max_iters,
+                    )
+            return [
+                PendingSolve(
+                    assignment[b],
+                    iters[b],
+                    int(p["pods_needed"].shape[0]),
+                    int(p["load"].shape[0]),
+                    t0,
+                    observe=(b == 0),
+                    span_parent=solve_span.context,
+                )
+                for b, p in enumerate(problems)
+            ]
 
     def solve_batch(self, costs: np.ndarray, feasibles: Optional[np.ndarray] = None) -> np.ndarray:
         """Vectorized multi-problem solve: costs [B, J, D] -> [B, J].
@@ -907,21 +1068,47 @@ class AssignmentSolver:
         jobs_p = _round_up_pow2(num_jobs)
         domains_p = _round_up_pow2(num_domains)
 
-        # Sinks are implicit in _auction; no [J_p, J_p] sink block.
-        benefit = np.full((batch, jobs_p, domains_p), NEG_INF, np.float32)
-        clipped = np.clip(costs, 0.0, COST_CAP - 1.0)
-        benefit[:, :num_jobs, :num_domains] = np.where(
-            feasibles, COST_CAP - clipped, NEG_INF
+        metrics.solver_batch_occupancy.set(
+            (batch * num_jobs * num_domains) / (batch * jobs_p * domains_p)
         )
-
-        scale = float(jobs_p + 1)
-        with self._on_solve_device(batch * jobs_p * domains_p, is_batched=True):
-            assignments = np.asarray(
-                _auction_batch(
-                    jnp.asarray(benefit * scale), jnp.float32(1.0),
-                    max_iters=self.max_iters,
-                )
-            )
+        metrics.solver_batch_problems.set(batch)
+        with obs_trace.span(
+            "solver.solve",
+            {"kind": "dense_batch", "problems": batch, "jobs": num_jobs,
+             "domains": num_domains},
+        ):
+            scale = float(jobs_p + 1)
+            with self._on_solve_device(
+                batch * jobs_p * domains_p, is_batched=True
+            ):
+                # host_transfer covers matrix build + device copy (same
+                # split as every other path). Sinks are implicit in
+                # _auction; no [J_p, J_p] sink block.
+                with obs_trace.span("solver.host_transfer", {
+                    "matrix_mb": round(
+                        batch * jobs_p * domains_p * 4 / 1e6, 3
+                    ),
+                }):
+                    benefit = np.full(
+                        (batch, jobs_p, domains_p), NEG_INF, np.float32
+                    )
+                    clipped = np.clip(costs, 0.0, COST_CAP - 1.0)
+                    benefit[:, :num_jobs, :num_domains] = np.where(
+                        feasibles, COST_CAP - clipped, NEG_INF
+                    )
+                    benefit_scaled = jnp.asarray(benefit * scale)
+                cache = _note_compile(_compile_cache_key(
+                    "auction_batch", batch, jobs_p, domains_p, self.max_iters
+                ))
+                with obs_trace.span(
+                    "solver.dispatch", {"compile_cache": cache}
+                ):
+                    assignments = np.asarray(
+                        _auction_batch(
+                            benefit_scaled, jnp.float32(1.0),
+                            max_iters=self.max_iters,
+                        )
+                    )
         out = assignments[:, :num_jobs].astype(np.int64)
         out[out >= num_domains] = -1
         metrics.solver_solve_time_seconds.observe(time.perf_counter() - t0)

@@ -44,6 +44,7 @@ logger = logging.getLogger("jobset_tpu.server")
 from .api import serialization
 from .api.types import Taint
 from .core import AdmissionError, Cluster, make_cluster, metrics
+from .obs import trace as obs_trace
 from .utils.clock import Clock
 
 
@@ -542,8 +543,45 @@ class ControllerServer:
     # Request routing
     # ------------------------------------------------------------------
 
-    def _route(self, method: str, path: str, body: bytes):
-        """Returns (status_code, payload_dict_or_text)."""
+    # Endpoints that are themselves observability surfaces: tracing each
+    # scrape would flood the trace ring with trivial roots.
+    _UNTRACED_PATHS = frozenset(
+        {"/healthz", "/readyz", "/leaderz", "/metrics", "/debug/traces"}
+    )
+
+    def _route(self, method: str, path: str, body: bytes, headers=None):
+        """Returns (status_code, payload_dict_or_text[, content_type])."""
+        headers = headers or {}
+        bare = path.partition("?")[0]
+        parent = obs_trace.extract_traceparent(headers.get("traceparent"))
+        # Trace a request when it carries a caller's traceparent or mutates
+        # state. Parentless GETs are untraced, mirroring the client rule:
+        # poll loops (wait_for_condition, watch long-polls, informer
+        # relists) would otherwise churn the bounded trace ring with
+        # one-span root traces and evict the end-to-end traces this
+        # feature exists to keep.
+        metrics.api_requests_in_flight.add(1)
+        try:
+            if bare in self._UNTRACED_PATHS or (
+                parent is None and method == "GET"
+            ):
+                return self._route_inner(method, path, body, headers)
+            # One span per API request, parented on the caller's W3C
+            # traceparent when present — the apiserver hop of the
+            # end-to-end trace (client -> here -> reconcile -> provider ->
+            # solver).
+            with obs_trace.span(
+                "apiserver.request",
+                {"http.method": method, "http.path": bare},
+                parent=parent,
+            ) as request_span:
+                result = self._route_inner(method, path, body, headers)
+                request_span.set_attribute("http.status", result[0])
+                return result
+        finally:
+            metrics.api_requests_in_flight.add(-1)
+
+    def _route_inner(self, method: str, path: str, body: bytes, headers=None):
         from urllib.parse import parse_qs
 
         path, _, query = path.partition("?")
@@ -562,7 +600,31 @@ class ControllerServer:
         if path == "/readyz":
             return (200, "ok") if self._ready.is_set() else (503, "not ready")
         if path == "/metrics":
+            # Content negotiation (the OpenMetrics contract): exemplars are
+            # only legal in application/openmetrics-text — the classic
+            # Prometheus text parser errors on the '#' exemplar token — so
+            # they render only when the scraper asks for that format.
+            accept = (headers or {}).get("accept") or ""
+            if "application/openmetrics-text" in accept:
+                return (
+                    200,
+                    metrics.render_prometheus(openmetrics=True),
+                    "application/openmetrics-text; version=1.0.0; "
+                    "charset=utf-8",
+                )
             return 200, metrics.render_prometheus()
+        if path == "/debug/traces":
+            # Recent finished traces from the in-process tracer's ring
+            # buffer (newest last). ?limit=N bounds the response; spans
+            # carry name/ids/duration/attributes (obs/trace.py to_dict).
+            try:
+                limit = int(params.get("limit", ["64"])[0])
+            except ValueError:
+                return 400, {"error": "bad limit parameter"}
+            return 200, {
+                "traces": obs_trace.TRACER.finished_traces(limit=limit),
+                "dropped_spans": obs_trace.TRACER.dropped_spans,
+            }
         if path == "/openapi/v2" and method == "GET":
             # Machine-readable schema of the wire format (the reference's
             # hack/swagger artifact analog; generators consume this).
@@ -884,13 +946,13 @@ class ControllerServer:
                     conn.settimeout(None)
                 super().setup()
 
-            def _respond(self, code: int, payload):
+            def _respond(self, code: int, payload, ctype=None):
                 if isinstance(payload, str):
                     data = payload.encode()
-                    ctype = "text/plain; charset=utf-8"
+                    ctype = ctype or "text/plain; charset=utf-8"
                 else:
                     data = json.dumps(payload).encode()
-                    ctype = "application/json"
+                    ctype = ctype or "application/json"
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
@@ -901,10 +963,16 @@ class ControllerServer:
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else b""
                 try:
-                    code, payload = server._route(method, self.path, body)
+                    result = server._route(
+                        method, self.path, body,
+                        headers={
+                            "traceparent": self.headers.get("traceparent"),
+                            "accept": self.headers.get("Accept"),
+                        },
+                    )
                 except Exception as exc:  # route bug -> 500, keep serving
-                    code, payload = 500, {"error": f"{type(exc).__name__}: {exc}"}
-                self._respond(code, payload)
+                    result = 500, {"error": f"{type(exc).__name__}: {exc}"}
+                self._respond(*result)
 
             def do_GET(self):
                 self._handle("GET")

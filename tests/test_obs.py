@@ -1,0 +1,568 @@
+"""Observability-plane tests: tracer semantics, W3C traceparent round-trip
+through the real HTTP server, strict Prometheus/OpenMetrics exposition
+format (bucket monotonicity, _sum/_count consistency, exemplar syntax),
+structured JSON logging, and the endpoint smoke scrape.
+"""
+
+import json
+import logging
+import re
+import threading
+import urllib.request
+
+import pytest
+
+from jobset_tpu.client import JobSetClient
+from jobset_tpu.core import features, metrics
+from jobset_tpu.obs import (
+    JsonLogFormatter,
+    TRACER,
+    Tracer,
+    current_span,
+    current_traceparent,
+    extract_traceparent,
+    span,
+)
+from jobset_tpu.server import ControllerServer
+from jobset_tpu.testing import make_jobset, make_replicated_job
+
+TOPOLOGY = "cloud.google.com/gke-tpu-topology"
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs_state():
+    TRACER.reset()
+    metrics.reset()
+    yield
+    TRACER.reset()
+    metrics.reset()
+
+
+@pytest.fixture()
+def server():
+    s = ControllerServer("127.0.0.1:0", tick_interval=0.05).start()
+    yield s
+    s.stop()
+
+
+@pytest.fixture()
+def client(server):
+    return JobSetClient(server.address)
+
+
+# ---------------------------------------------------------------------------
+# Tracer unit semantics
+# ---------------------------------------------------------------------------
+
+
+def test_span_parenting_and_ring():
+    tracer = Tracer(max_traces=4)
+    with tracer.start_span("root", {"k": "v"}) as root:
+        with tracer.start_span("child") as child:
+            assert child.context.trace_id == root.context.trace_id
+            assert child.parent_id == root.context.span_id
+    traces = tracer.finished_traces()
+    assert len(traces) == 1
+    names = [s["name"] for s in traces[0]["spans"]]
+    assert names == ["child", "root"]  # children end first
+    spans = {s["name"]: s for s in traces[0]["spans"]}
+    assert spans["root"]["parent_span_id"] is None
+    assert spans["root"]["attributes"] == {"k": "v"}
+    assert spans["child"]["parent_span_id"] == spans["root"]["span_id"]
+    assert spans["child"]["duration_ms"] >= 0
+
+
+def test_trace_ring_is_bounded():
+    tracer = Tracer(max_traces=4)
+    for i in range(10):
+        with tracer.start_span(f"t{i}"):
+            pass
+    traces = tracer.finished_traces()
+    assert len(traces) == 4
+    assert [t["spans"][0]["name"] for t in traces] == ["t6", "t7", "t8", "t9"]
+
+
+def test_late_span_attaches_to_finished_trace():
+    """An async tail (solver readback fetched ticks later) must land in the
+    already-finished trace, not a fresh one."""
+    tracer = Tracer()
+    with tracer.start_span("root") as root:
+        ctx = root.context
+    tracer.record_span("late.readback", 0.01, parent=ctx)
+    traces = tracer.finished_traces()
+    assert len(traces) == 1
+    assert {s["name"] for s in traces[0]["spans"]} == {"root", "late.readback"}
+
+
+def test_duration_log_survives_ring_eviction():
+    """The bench's phase percentiles must cover EVERY span of a run, not
+    just the ones whose traces survived the bounded ring."""
+    tracer = Tracer(max_traces=4)
+    for _ in range(20):
+        with tracer.start_span("phase.x"):
+            pass
+    # Ring path: only the surviving window is visible.
+    assert len(tracer.span_durations_s()["phase.x"]) == 4
+    tracer.enable_duration_log()
+    for _ in range(20):
+        with tracer.start_span("phase.x"):
+            pass
+    assert len(tracer.span_durations_s()["phase.x"]) == 20
+    # reset() empties the log but keeps it enabled.
+    tracer.reset()
+    with tracer.start_span("phase.x"):
+        pass
+    assert len(tracer.span_durations_s()["phase.x"]) == 1
+
+
+def test_error_span_records_status():
+    tracer = Tracer()
+    with pytest.raises(ValueError):
+        with tracer.start_span("boom"):
+            raise ValueError("nope")
+    s = tracer.finished_traces()[0]["spans"][0]
+    assert s["status"] == "error"
+    assert "ValueError" in s["attributes"]["error"]
+
+
+def test_context_isolated_across_threads():
+    seen = {}
+
+    def worker():
+        seen["other_thread"] = current_span()
+
+    with span("main-thread-root"):
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+        assert current_span() is not None
+    assert seen["other_thread"] is None
+
+
+# ---------------------------------------------------------------------------
+# W3C traceparent
+# ---------------------------------------------------------------------------
+
+
+def test_traceparent_inject_extract_roundtrip():
+    with span("outbound") as s:
+        header = current_traceparent()
+        assert re.fullmatch(r"00-[0-9a-f]{32}-[0-9a-f]{16}-01", header)
+        ctx = extract_traceparent(header)
+        assert ctx.trace_id == s.context.trace_id
+        assert ctx.span_id == s.context.span_id
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        None,
+        "",
+        "garbage",
+        "00-short-id-01",
+        "99-" + "a" * 32 + "-" + "b" * 16 + "-01",  # unknown version
+        "00-" + "0" * 32 + "-" + "b" * 16 + "-01",  # all-zero trace id
+        "00-" + "a" * 32 + "-" + "0" * 16 + "-01",  # all-zero span id
+        "00-" + "g" * 32 + "-" + "b" * 16 + "-01",  # non-hex
+        "00-" + "a" * 32 + "-" + "b" * 16 + "-01-extra",  # v00 is 4 fields
+        "00-" + "a" * 32 + "-" + "b" * 16 + "-banana",  # bad flags field
+        "00-" + "a" * 32 + "-" + "b" * 16 + "-0",  # flags not 2 chars
+    ],
+)
+def test_traceparent_rejects_malformed(bad):
+    assert extract_traceparent(bad) is None
+
+
+# ---------------------------------------------------------------------------
+# Counter/Gauge concurrency + semantics (the unlocked-read race fix)
+# ---------------------------------------------------------------------------
+
+
+def test_counter_reads_are_locked_and_consistent():
+    c = metrics.Counter("test_total", "t", label_names=())
+    stop = threading.Event()
+
+    def hammer():
+        while not stop.is_set():
+            c.inc()
+
+    threads = [threading.Thread(target=hammer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(200):
+            # value()/total() take the lock now; under the old unlocked
+            # read this raced inc()'s read-modify-write on the shared
+            # dict. Two separate locked reads with incs in between are
+            # only ordered (monotonic), not equal.
+            v = c.value()
+            assert v <= c.total()
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    assert c.total() > 0
+
+
+def test_gauge_set_add_value():
+    g = metrics.Gauge("test_gauge", "t")
+    assert g.value() == 0.0
+    g.set(2.5)
+    assert g.value() == 2.5
+    g.add(-1.0)
+    assert g.value() == 1.5
+    labeled = metrics.Gauge("test_gauge2", "t", label_names=("shard",))
+    labeled.set(3.0, "a")
+    assert labeled.value("a") == 3.0
+    assert labeled.value("b") == 0.0
+
+
+# ---------------------------------------------------------------------------
+# Strict exposition-format check
+# ---------------------------------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?"
+    r" (?P<value>[0-9.eE+-]+|\+Inf|NaN)"
+    r"(?P<exemplar> # \{trace_id=\"[0-9a-f]{32}\"\} [0-9.eE+-]+ [0-9.]+)?$"
+)
+
+
+def _parse_exposition(text: str, openmetrics: bool = False):
+    """Line-by-line strict parse: returns {metric_name: {"type": ...,
+    "samples": [(name, labels, value, has_exemplar)]}}."""
+    families = {}
+    current = None
+    assert text.endswith("\n"), "exposition must end with a newline"
+    lines = text.splitlines()
+    if openmetrics:
+        assert lines[-1] == "# EOF", "OpenMetrics exposition must end # EOF"
+        lines = lines[:-1]
+    for line in lines:
+        assert line == line.strip(), f"stray whitespace: {line!r}"
+        assert line != "# EOF", "# EOF must not appear in classic format"
+        if line.startswith("# HELP "):
+            name = line.split(" ", 3)[2]
+            current = families.setdefault(
+                name, {"type": None, "samples": [], "help": True}
+            )
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, mtype = line.split(" ", 3)
+            assert name in families, f"TYPE before HELP for {name}"
+            assert mtype in ("counter", "gauge", "histogram")
+            families[name]["type"] = mtype
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"malformed sample line: {line!r}"
+        sample_name = m.group("name")
+        base = re.sub(r"_(bucket|sum|count)$", "", sample_name)
+        family = families.get(sample_name) or families.get(base)
+        if family is None and openmetrics and sample_name.endswith("_total"):
+            # OpenMetrics counters: family declared WITHOUT _total, samples
+            # carry it.
+            family = families.get(sample_name[: -len("_total")])
+        assert family is not None, f"sample for undeclared family: {line!r}"
+        family["samples"].append(
+            (
+                sample_name,
+                m.group("labels") or "",
+                m.group("value"),
+                bool(m.group("exemplar")),
+            )
+        )
+    return families
+
+
+@pytest.mark.parametrize("openmetrics", [False, True])
+def test_exposition_strict_format_and_histogram_invariants(openmetrics):
+    metrics.jobset_completed_total.inc("default/js")
+    metrics.solver_batch_occupancy.set(0.75)
+    for v in (0.002, 0.004, 0.1, 7.0, 200.0):
+        metrics.reconcile_time_seconds.observe(v)
+
+    families = _parse_exposition(
+        metrics.render_prometheus(openmetrics=openmetrics), openmetrics
+    )
+
+    for h in metrics.ALL_HISTOGRAMS:
+        family = families[h.name]
+        assert family["type"] == "histogram"
+        buckets = [s for s in family["samples"] if s[0] == f"{h.name}_bucket"]
+        sums = [s for s in family["samples"] if s[0] == f"{h.name}_sum"]
+        counts = [s for s in family["samples"] if s[0] == f"{h.name}_count"]
+        assert len(sums) == 1 and len(counts) == 1
+        # le labels parse, strictly increase, and end at +Inf.
+        les = []
+        for _, labels, value, _ in buckets:
+            m = re.fullmatch(r'le="([^"]+)"', labels)
+            assert m, f"bucket labels malformed: {labels!r}"
+            les.append(m.group(1))
+        assert les[-1] == "+Inf"
+        bounds = [float(le) for le in les[:-1]]
+        assert bounds == sorted(bounds)
+        assert len(set(bounds)) == len(bounds)
+        # Cumulative counts are monotonically non-decreasing; +Inf == _count.
+        values = [int(float(s[2])) for s in buckets]
+        assert values == sorted(values)
+        assert values[-1] == int(float(counts[0][2])) == h.n
+        assert float(sums[0][2]) == pytest.approx(h.sum)
+
+    # Counters and gauges declare their types and emit one default sample
+    # even when empty. In OpenMetrics the counter FAMILY drops the _total
+    # suffix (it belongs to the sample); classic text keeps it everywhere.
+    counter_family = (
+        "jobset_completed" if openmetrics else "jobset_completed_total"
+    )
+    assert families[counter_family]["type"] == "counter"
+    assert families[counter_family]["samples"][0][0] == "jobset_completed_total"
+    assert families[metrics.solver_batch_occupancy.name]["type"] == "gauge"
+    occ = families[metrics.solver_batch_occupancy.name]["samples"]
+    assert occ[0][2] == "0.75"
+
+
+def test_histogram_exemplars_carry_trace_ids():
+    with span("observed-op") as s:
+        metrics.reconcile_time_seconds.observe(0.005)
+        trace_id = s.context.trace_id
+    # Exemplars render ONLY in the negotiated OpenMetrics format: the
+    # classic Prometheus text parser errors on the '#' exemplar token.
+    assert "# {" not in metrics.render_prometheus()
+    text = metrics.render_prometheus(openmetrics=True)
+    exemplar_lines = [
+        line for line in text.splitlines() if f'trace_id="{trace_id}"' in line
+    ]
+    assert exemplar_lines, "observation under a span must emit an exemplar"
+    line = exemplar_lines[0]
+    assert re.search(
+        r'# \{trace_id="[0-9a-f]{32}"\} 0\.005', line
+    ), f"bad exemplar syntax: {line!r}"
+    # The strict parser accepts the exemplar syntax too.
+    families = _parse_exposition(text, openmetrics=True)
+    bucket_samples = families["jobset_reconcile_time_seconds"]["samples"]
+    assert any(has_ex for _, _, _, has_ex in bucket_samples)
+    # Observations with NO active span leave buckets exemplar-free.
+    metrics.reset()
+    metrics.reconcile_time_seconds.observe(0.005)
+    assert "# {" not in metrics.render_prometheus(openmetrics=True)
+
+
+# ---------------------------------------------------------------------------
+# Structured JSON logging
+# ---------------------------------------------------------------------------
+
+
+def test_json_log_stamps_active_span_and_extra():
+    formatter = JsonLogFormatter()
+    logger = logging.getLogger("jobset_tpu.test_obs")
+    with span("logging-op") as s:
+        record = logger.makeRecord(
+            logger.name, logging.INFO, __file__, 1, "created %s", ("js",),
+            None, extra={"jobset": "default/js"},
+        )
+        out = json.loads(formatter.format(record))
+        assert out["message"] == "created js"
+        assert out["level"] == "INFO"
+        assert out["trace_id"] == s.context.trace_id
+        assert out["span_id"] == s.context.span_id
+        assert out["jobset"] == "default/js"
+    # Outside any span: no trace fields, still valid JSON.
+    record = logger.makeRecord(
+        logger.name, logging.WARNING, __file__, 1, "plain", (), None
+    )
+    out = json.loads(formatter.format(record))
+    assert "trace_id" not in out
+    assert out["level"] == "WARNING"
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: client -> apiserver -> reconcile -> provider -> solver
+# ---------------------------------------------------------------------------
+
+
+def _exclusive_jobset(name: str):
+    return (
+        make_jobset(name)
+        .exclusive_placement(TOPOLOGY)
+        .replicated_job(
+            make_replicated_job("w")
+            .replicas(2)
+            .parallelism(2)
+            .completions(2)
+            .obj()
+        )
+        .obj()
+    )
+
+
+def test_traceparent_roundtrip_one_trace_covers_all_layers(server, client):
+    """Satellite acceptance: a single client-initiated create yields ONE
+    trace containing apiserver, reconcile, provider, and solver-phase
+    spans, served by /debug/traces."""
+    with server.lock:
+        server.cluster.add_topology(
+            TOPOLOGY, num_domains=4, nodes_per_domain=2, capacity=8
+        )
+    with features.gate("TPUPlacementSolver", True):
+        created = client.create(_exclusive_jobset("traced"))
+    assert created.metadata.name == "traced"
+
+    out = json.loads(
+        urllib.request.urlopen(
+            f"http://{server.address}/debug/traces", timeout=10
+        ).read()
+    )
+    assert "traces" in out
+    by_trace = {
+        t["trace_id"]: {s["name"] for s in t["spans"]} for t in out["traces"]
+    }
+    full = [
+        tid
+        for tid, names in by_trace.items()
+        if {
+            "client.request",
+            "apiserver.request",
+            "reconcile",
+            "placement.prepare",
+            "placement.assign",
+            "solver.solve",
+            "solver.solve_loop",
+        } <= names
+    ]
+    assert full, f"no end-to-end trace; saw: {by_trace}"
+    # Parent chain: apiserver.request's parent is the client span, and the
+    # reconcile span sits under the apiserver span (synchronous post-write
+    # pump).
+    trace = next(
+        t for t in out["traces"] if t["trace_id"] == full[0]
+    )
+    spans = {s["name"]: s for s in trace["spans"]}
+    assert (
+        spans["apiserver.request"]["parent_span_id"]
+        == spans["client.request"]["span_id"]
+    )
+    reconciles = [s for s in trace["spans"] if s["name"] == "reconcile"]
+    assert any(
+        r["parent_span_id"] == spans["apiserver.request"]["span_id"]
+        for r in reconciles
+    )
+    assert spans["apiserver.request"]["attributes"]["http.status"] == 201
+
+
+def test_parentless_get_polls_do_not_churn_trace_ring(server, client):
+    """Status-poll GETs (wait_for_condition, informer relists) carry no
+    traceparent and must not create one-span root traces that evict the
+    end-to-end traces from the bounded ring."""
+    client.create(
+        make_jobset("polled")
+        .replicated_job(
+            make_replicated_job("w").replicas(1).parallelism(1)
+            .completions(1).obj()
+        )
+        .obj()
+    )
+    before = len(TRACER.finished_traces())
+    for _ in range(20):
+        client.get_raw("polled")
+        client.nodes()
+    assert len(TRACER.finished_traces()) == before
+
+
+def test_metrics_content_negotiation(server, client):
+    with span("negotiated"):
+        metrics.reconcile_time_seconds.observe(0.004)
+    # Classic scrape: text/plain, no exemplars, no # EOF.
+    plain = client.metrics_text()
+    assert "# {" not in plain and "# EOF" not in plain
+    # OpenMetrics scrape: negotiated content type, exemplars, # EOF last.
+    req = urllib.request.Request(
+        f"http://{server.address}/metrics",
+        headers={"Accept": "application/openmetrics-text; version=1.0.0"},
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        assert resp.headers["Content-Type"].startswith(
+            "application/openmetrics-text"
+        )
+        text = resp.read().decode()
+    assert text.rstrip("\n").endswith("# EOF")
+    assert 'trace_id="' in text
+    _parse_exposition(text, openmetrics=True)
+
+
+def test_server_extracts_external_traceparent(server):
+    """A caller-minted traceparent (no client SDK involved) becomes the
+    server trace's parent: same trace id, remote span as parent."""
+    trace_id = "ab" * 16
+    parent_span = "cd" * 8
+    req = urllib.request.Request(
+        f"http://{server.address}/api/v1/nodes",
+        headers={"traceparent": f"00-{trace_id}-{parent_span}-01"},
+    )
+    urllib.request.urlopen(req, timeout=10).read()
+    traces = TRACER.finished_traces()
+    match = [t for t in traces if t["trace_id"] == trace_id]
+    assert match, f"no trace with propagated id; got {[t['trace_id'] for t in traces]}"
+    api_span = next(
+        s for s in match[0]["spans"] if s["name"] == "apiserver.request"
+    )
+    assert api_span["parent_span_id"] == parent_span
+
+
+def test_solver_phase_spans_present(server, client):
+    with server.lock:
+        server.cluster.add_topology(
+            TOPOLOGY, num_domains=4, nodes_per_domain=2, capacity=8
+        )
+    with features.gate("TPUPlacementSolver", True):
+        client.create(_exclusive_jobset("phases"))
+    durations = TRACER.span_durations_s()
+    for phase in ("solver.solve", "solver.host_transfer", "solver.dispatch",
+                  "solver.solve_loop", "solver.readback"):
+        assert phase in durations, f"missing phase span {phase}"
+    # The batch-occupancy gauge moved off its default.
+    assert 0.0 < metrics.solver_batch_occupancy.value() <= 1.0
+    assert metrics.solver_batch_problems.value() >= 1
+
+
+# ---------------------------------------------------------------------------
+# CI smoke: every observability endpoint serves a well-formed payload
+# ---------------------------------------------------------------------------
+
+
+def test_observability_endpoint_smoke(server, client):
+    assert client.healthz()
+    assert client.readyz()
+
+    metrics_text = client.metrics_text()
+    assert metrics_text.strip(), "/metrics must be non-empty"
+    families = _parse_exposition(metrics_text)
+    # Every registered metric family is exposed.
+    for metric in (
+        metrics.ALL_COUNTERS + metrics.ALL_GAUGES + metrics.ALL_HISTOGRAMS
+    ):
+        assert metric.name in families, f"{metric.name} missing from /metrics"
+
+    # A write makes at least one trace, and /debug/traces serves it.
+    client.create(
+        make_jobset("smoke")
+        .replicated_job(
+            make_replicated_job("w").replicas(1).parallelism(1)
+            .completions(1).obj()
+        )
+        .obj()
+    )
+    out = json.loads(
+        urllib.request.urlopen(
+            f"http://{server.address}/debug/traces?limit=8", timeout=10
+        ).read()
+    )
+    assert isinstance(out["traces"], list) and out["traces"]
+    for trace in out["traces"]:
+        assert re.fullmatch(r"[0-9a-f]{32}", trace["trace_id"])
+        for s in trace["spans"]:
+            assert s["trace_id"] == trace["trace_id"]
+            assert re.fullmatch(r"[0-9a-f]{16}", s["span_id"])
+            assert s["duration_ms"] >= 0
+            assert "name" in s and "attributes" in s
+    assert isinstance(out["dropped_spans"], int)
