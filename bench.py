@@ -509,6 +509,126 @@ def run_api_mode(solver_on: bool, args) -> dict:
     }
 
 
+def run_api_chaos_mode(solver_on: bool, args, rate: float, seed: int = 4,
+                       splits: int = 64) -> dict:
+    """Apiserver-inclusive placement under injected faults (bench --inject).
+
+    The cold gang arrival is split into `splits` JobSet creates so the
+    injected 503 stream has a request population to land on; the SAME
+    split shape is measured clean first, so the reported ratio isolates
+    what the faults cost (app-level create retries + client GET retries +
+    the extra admission work) rather than the split itself. Fault
+    injection is deterministic under `seed` (chaos.FaultInjector), so the
+    faulted figure is reproducible run-to-run.
+    """
+    from jobset_tpu.api import FailurePolicy
+    from jobset_tpu.chaos import FaultInjector
+    from jobset_tpu.client import ApiError, JobSetClient
+    from jobset_tpu.core import features, metrics
+    from jobset_tpu.server import ControllerServer
+    from jobset_tpu.testing import make_jobset, make_replicated_job
+
+    topology_key = "tpu-slice"
+    splits = max(1, min(splits, args.replicas))
+    per = max(1, args.replicas // splits)
+    total_pods = splits * per * args.pods_per_job
+
+    def one_pass(injector) -> float:
+        metrics.reset()
+        with features.gate("TPUPlacementSolver", solver_on):
+            cluster = build_cluster(
+                args.domains, args.nodes_per_domain, topology_key
+            )
+            server = ControllerServer(
+                cluster=cluster, tick_interval=30.0, injector=injector
+            ).start()
+            try:
+                client = JobSetClient(
+                    f"http://{server.address}", timeout=900.0,
+                    retries=5, retry_seed=seed,
+                )
+                t0 = time.perf_counter()
+                for i in range(splits):
+                    js = (
+                        make_jobset(f"chaos-{i}")
+                        .exclusive_placement(topology_key)
+                        .failure_policy(FailurePolicy(max_restarts=10))
+                        .replicated_job(
+                            make_replicated_job("workers")
+                            .replicas(per)
+                            .parallelism(args.pods_per_job)
+                            .completions(args.pods_per_job)
+                            .obj()
+                        )
+                        .obj()
+                    )
+                    for _ in range(50):
+                        # App-level create retry: injected 503s fire before
+                        # routing, so a 503'd create never landed and is
+                        # safe to resubmit (the client itself never
+                        # retries mutations).
+                        try:
+                            client.create(js)
+                            break
+                        except ApiError as exc:
+                            if exc.status != 503:
+                                raise
+                    else:
+                        raise RuntimeError("chaos create retries exhausted")
+                elapsed = time.perf_counter() - t0
+                with server.lock:
+                    bound = sum(
+                        1 for p in cluster.pods.values() if p.spec.node_name
+                    )
+                if bound != total_pods:
+                    raise RuntimeError(
+                        f"chaos api placement incomplete: {bound}/{total_pods}"
+                    )
+            finally:
+                server.stop()
+        return elapsed
+
+    one_pass(None)  # untimed warm pass: the per-split solve shape compiles
+    # here, so the clean-vs-faulted comparison below is warm on both sides
+    clean_s = one_pass(None)
+    injector = FaultInjector(seed=seed)
+    injector.add_rule("apiserver.request", "error", status=503, rate=rate)
+    faulted_s = one_pass(injector)
+    return {
+        "mode": "solver" if solver_on else "greedy",
+        "splits": splits,
+        "pods": total_pods,
+        "fault_rate": rate,
+        "fault_seed": seed,
+        "clean_api_pods_per_sec": round(total_pods / clean_s, 1),
+        "faulted_api_pods_per_sec": round(total_pods / faulted_s, 1),
+        "faults_injected": injector.injected_total(),
+        "fault_overhead_pct": round(
+            100.0 * (faulted_s / clean_s - 1.0), 1
+        ),
+    }
+
+
+def _bank_apiserver_inject(result: dict) -> None:
+    """Merge the faulted-vs-clean apiserver figures into the banked
+    placement artifact (BENCH_PLACEMENT_TPU_LAST.json) so the resilience
+    number rides alongside the on-chip captures it contextualizes."""
+    try:
+        try:
+            with open(PLACEMENT_SIDECAR) as f:
+                detail = json.load(f)
+        except (OSError, ValueError):
+            detail = {}
+        detail["apiserver_inject"] = dict(result)
+        detail["apiserver_inject"]["captured_at"] = time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+        )
+        with open(PLACEMENT_SIDECAR, "w") as f:
+            json.dump(detail, f, indent=1)
+    except OSError:
+        pass
+
+
 def preload_domain_gradient(cluster, topology_key: str, max_frac: float = 0.9):
     """Synthetic background occupancy with a load gradient: domain i has
     ~(i/D)*max_frac of its capacity consumed. Every incoming job then
@@ -1446,7 +1566,10 @@ def worker_main(args) -> None:
             if r:
                 s[f"{mode}_recovery_pods_per_sec"] = r["recovery_pods_per_sec"]
                 s[f"{mode}_p99_reconcile_ms"] = r["p99_reconcile_ms"]
-        for phase in ("storm", "contended", "auction_stress", "apiserver"):
+        for phase in (
+            "storm", "contended", "auction_stress", "apiserver",
+            "apiserver_inject",
+        ):
             r = results.get(phase)
             if not r:
                 continue
@@ -1647,6 +1770,21 @@ def worker_main(args) -> None:
         results["apiserver"] = {"mode": "apiserver", **api}
         emit([], model)
 
+    # Phase 3.8 (opt-in, --inject): the apiserver path under deterministic
+    # fault injection — pods/s with RATE injected 503s alongside the clean
+    # number at the same split shape, banked into the placement artifact.
+    if args.inject > 0 and args.mode in ("both", "solver"):
+        inj: dict = {}
+        with _phase_deadline("BENCH_INJECT_DEADLINE_S", 240.0, inj):
+            inj.update(
+                run_api_chaos_mode(
+                    True, args, rate=args.inject, seed=args.inject_seed
+                )
+            )
+            _bank_apiserver_inject(inj)
+        results["apiserver_inject"] = {"mode": "apiserver_inject", **inj}
+        emit([], model)
+
     # Phase 4: scale sweep — the asymptotic story. Each step doubles
     # replicas and domains; greedy's per-leader domain scan grows
     # O(replicas * domains log domains) while the solver path stays one
@@ -1699,6 +1837,21 @@ def main() -> int:
              "while the solver stays one batched kernel, so the ratio grows "
              "with scale; 0 disables; only runs with --mode=both (it "
              "measures the greedy-vs-solver ratio)",
+    )
+    parser.add_argument(
+        "--inject", type=float, nargs="?", const=0.05, default=0.0,
+        metavar="RATE",
+        help="measure the apiserver-inclusive placement phase under "
+             "deterministically injected 503 faults at RATE (bare flag = "
+             "0.05) alongside the clean number; banked into "
+             "BENCH_PLACEMENT_TPU_LAST.json under apiserver_inject",
+    )
+    parser.add_argument(
+        "--inject-seed", type=int, default=4,
+        help="seed for --inject fault determinism (default 4: its realized "
+             "fault density over the phase's 64 creates sits at the "
+             "nominal rate; the artifact records faults_injected either "
+             "way)",
     )
     parser.add_argument(
         "--model-only", action="store_true",

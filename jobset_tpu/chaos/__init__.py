@@ -1,0 +1,43 @@
+"""Chaos plane: deterministic fault injection for resilience proofs.
+
+`injector` holds the seeded rule engine and the process-global accessor
+(the CLI's ``--inject`` installs one; instrumented boundaries consult it);
+`scenarios` drives cluster-side faults (pod crash bursts, node drains)
+through the simulation kernel. See ``docs/troubleshooting.md`` §
+"Degradation modes" for how the hardened paths behave under these faults.
+"""
+
+from .injector import (
+    Fault,
+    FaultInjector,
+    KIND_BREAK,
+    KIND_CRASH,
+    KIND_DRAIN,
+    KIND_ERROR,
+    KIND_LATENCY,
+    KIND_REFUSE,
+    KIND_SLOW,
+    Rule,
+    configure,
+    disable,
+    get_injector,
+)
+from .scenarios import node_drain, pod_crash_burst
+
+__all__ = [
+    "Fault",
+    "FaultInjector",
+    "KIND_BREAK",
+    "KIND_CRASH",
+    "KIND_DRAIN",
+    "KIND_ERROR",
+    "KIND_LATENCY",
+    "KIND_REFUSE",
+    "KIND_SLOW",
+    "Rule",
+    "configure",
+    "disable",
+    "get_injector",
+    "node_drain",
+    "pod_crash_burst",
+]

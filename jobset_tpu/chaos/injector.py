@@ -1,0 +1,299 @@
+"""Deterministic, seedable fault injector — the chaos plane's core.
+
+The control plane's whole value proposition is gang lifecycle *under
+failure*, yet nothing in the build proved recovery behavior at the process
+boundaries (apiserver HTTP, solver gRPC stream, cluster nodes/pods) until
+this module existed. It provides named **injection points** that the real
+code paths consult, and **rules** that decide — deterministically, from a
+seed — whether a given arrival at a point suffers a fault.
+
+Design constraints:
+
+1. **Deterministic.** Every injection point owns its own
+   ``random.Random`` seeded from ``(seed, point)``, so the decision stream
+   at one point is a pure function of (seed, arrival index at that point)
+   — interleavings *across* points (e.g. a solver solve between two
+   apiserver requests) cannot perturb each other's draws. Two runs that
+   present the same per-point arrival sequences produce byte-identical
+   injection logs; ``tests/test_chaos.py`` asserts this.
+2. **Near-zero cost when off.** The module-level accessor returns ``None``
+   when chaos is unconfigured; call sites guard with one attribute read.
+   No rule registered at a point means no RNG draw for arrivals there.
+3. **Observable.** Every injected fault lands in a bounded in-memory log
+   (seq, point, arrival index, fault kind, detail) and bumps the
+   ``jobset_chaos_injected_faults_total`` counter, so a soak run can prove
+   both that faults actually fired and that two seeded runs fired
+   identically.
+
+Injection points used by the build (callers may invent more — points are
+just names):
+
+================== ======================================================
+``apiserver.request``  controller HTTP handler: error codes + added latency
+``solver.connect``     gRPC channel dial: connect refusal
+``solver.stream``      solver bidi stream: mid-stream breaks, slow frames
+``cluster.pod``        simulated kubelet: pod crash bursts
+``cluster.node``       simulated cloud: node drain
+================== ======================================================
+
+Spec grammar (CLI ``--inject`` / ``FaultInjector.from_spec``)::
+
+    spec    := clause (";" clause)*
+    clause  := point ":" kind ["," arg]* "@" rate
+    arg     := key "=" value        (status=503, ms=20, times=4)
+
+Examples::
+
+    apiserver.request:error,status=503@0.05
+    apiserver.request:latency,ms=20@0.1
+    solver.stream:break@0.02;solver.connect:refuse@1.0
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+# Fault kinds understood by the shipped call sites. Points/kinds are open
+# vocabulary — the injector matches strings, the call site interprets them.
+KIND_ERROR = "error"      # apiserver: answer `status` instead of routing
+KIND_LATENCY = "latency"  # apiserver: sleep `ms` before routing
+KIND_REFUSE = "refuse"    # solver.connect: refuse the dial
+KIND_BREAK = "break"      # solver.stream: break the stream mid-flight
+KIND_SLOW = "slow"        # solver.stream: delay the reply frame by `ms`
+KIND_CRASH = "crash"      # cluster.pod: crash the pod
+KIND_DRAIN = "drain"      # cluster.node: drain the node
+
+
+@dataclass
+class Fault:
+    """One injected fault, as returned to the call site."""
+
+    point: str
+    kind: str
+    status: int = 503
+    delay_s: float = 0.0
+    seq: int = 0  # global injection sequence number (log join key)
+
+
+@dataclass
+class Rule:
+    """One fault rule at one injection point.
+
+    ``rate`` is the per-arrival injection probability; ``times`` bounds how
+    many faults the rule may inject in total (0 = unlimited) — tests use
+    ``times`` to script exact failure counts ("503 the first two requests,
+    then recover")."""
+
+    point: str
+    kind: str
+    rate: float = 1.0
+    status: int = 503
+    delay_s: float = 0.0
+    times: int = 0
+    injected: int = field(default=0, compare=False)
+
+    def exhausted(self) -> bool:
+        return self.times > 0 and self.injected >= self.times
+
+
+class FaultInjector:
+    """Seeded rule engine consulted by the instrumented boundaries.
+
+    Thread-safe: the apiserver handler pool and the reconcile pump may
+    consult concurrently. Determinism holds per point — each point's
+    decision stream depends only on its own arrival order.
+    """
+
+    MAX_LOG = 100_000  # bounded, but big enough to diff a whole soak run
+
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+        self._lock = threading.Lock()
+        self._rules: dict[str, list[Rule]] = {}
+        self._rngs: dict[str, random.Random] = {}
+        self._arrivals: dict[str, int] = {}
+        self._injected_by_point: dict[str, int] = {}
+        self.log: list[dict] = []
+        self._seq = 0
+
+    # -- configuration ----------------------------------------------------
+
+    def add_rule(
+        self,
+        point: str,
+        kind: str,
+        rate: float = 1.0,
+        status: int = 503,
+        delay_s: float = 0.0,
+        times: int = 0,
+    ) -> Rule:
+        rule = Rule(point=point, kind=kind, rate=rate, status=status,
+                    delay_s=delay_s, times=times)
+        with self._lock:
+            self._rules.setdefault(point, []).append(rule)
+        return rule
+
+    def remove_rule(self, rule: Rule) -> None:
+        """Unregister one rule (transient scenario rules); the point's RNG
+        stream and the log remain — removal must not rewind determinism."""
+        with self._lock:
+            rules = self._rules.get(rule.point)
+            if rules is not None:
+                # Identity, not dataclass equality: two rules with the same
+                # parameters must stay independently removable.
+                remaining = [r for r in rules if r is not rule]
+                if remaining:
+                    self._rules[rule.point] = remaining
+                else:
+                    self._rules.pop(rule.point, None)
+
+    def clear(self, point: Optional[str] = None) -> None:
+        """Drop rules (one point, or all); the log and RNG streams remain —
+        clearing mid-scenario must not rewind determinism."""
+        with self._lock:
+            if point is None:
+                self._rules.clear()
+            else:
+                self._rules.pop(point, None)
+
+    @classmethod
+    def from_spec(cls, spec: str, seed: int = 0) -> "FaultInjector":
+        """Build an injector from the CLI spec grammar (module docstring)."""
+        injector = cls(seed=seed)
+        for clause in filter(None, (c.strip() for c in spec.split(";"))):
+            body, _, rate_s = clause.rpartition("@")
+            if not body:
+                raise ValueError(
+                    f"bad chaos clause {clause!r}: missing '@rate'"
+                )
+            point, _, kind_args = body.partition(":")
+            if not point or not kind_args:
+                raise ValueError(
+                    f"bad chaos clause {clause!r}: want point:kind[,k=v]@rate"
+                )
+            kind, *args = (a.strip() for a in kind_args.split(","))
+            kwargs: dict = {"rate": float(rate_s)}
+            for arg in args:
+                key, _, value = arg.partition("=")
+                if key == "status":
+                    kwargs["status"] = int(value)
+                elif key == "ms":
+                    kwargs["delay_s"] = float(value) / 1000.0
+                elif key == "times":
+                    kwargs["times"] = int(value)
+                else:
+                    raise ValueError(
+                        f"bad chaos arg {arg!r} in clause {clause!r}"
+                    )
+            injector.add_rule(point, kind, **kwargs)
+        return injector
+
+    # -- decision ---------------------------------------------------------
+
+    def _rng_for_locked(self, point: str) -> random.Random:
+        rng = self._rngs.get(point)
+        if rng is None:
+            # Stable derivation, independent of registration or first-use
+            # order across points: (seed, point) -> stream.
+            rng = random.Random(f"{self.seed}/{point}")
+            self._rngs[point] = rng
+        return rng
+
+    def check(self, point: str, detail: str = "") -> Optional[Fault]:
+        """One arrival at `point`: returns the injected Fault or None.
+
+        Exactly ONE rng draw per arrival at a point with rules (drawn even
+        when every rule is exhausted, so `times=`-scripted scenarios keep
+        later arrivals aligned with an unscripted run). The single draw is
+        partitioned across the point's rules as a categorical: rule i owns
+        the interval [sum(rates[:i]), sum(rates[:i]) + rate_i), so two 5%
+        rules at one point EACH fire at 5% instead of the second being
+        shadowed by the first. Rates summing past 1.0 clip the tail rules.
+        An exhausted rule's interval stays reserved (no fault fires in it)
+        so exhaustion never shifts the other rules' streams."""
+        with self._lock:
+            rules = self._rules.get(point)
+            if not rules:
+                return None
+            arrival = self._arrivals.get(point, 0)
+            self._arrivals[point] = arrival + 1
+            u = self._rng_for_locked(point).random()
+            cum = 0.0
+            hit = None
+            for rule in rules:
+                if cum <= u < cum + rule.rate:
+                    hit = rule
+                    break
+                cum += rule.rate
+            if hit is None or hit.exhausted():
+                return None
+            hit.injected += 1
+            self._seq += 1
+            self._injected_by_point[point] = (
+                self._injected_by_point.get(point, 0) + 1
+            )
+            fault = Fault(
+                point=point,
+                kind=hit.kind,
+                status=hit.status,
+                delay_s=hit.delay_s,
+                seq=self._seq,
+            )
+            if len(self.log) < self.MAX_LOG:
+                self.log.append({
+                    "seq": self._seq,
+                    "point": point,
+                    "arrival": arrival,
+                    "kind": hit.kind,
+                    "detail": detail,
+                })
+        # Outside the lock: metrics must not serialize the handler pool.
+        from ..core import metrics
+
+        metrics.chaos_injected_faults_total.inc(point)
+        return fault
+
+    # -- introspection ----------------------------------------------------
+
+    def injected_total(self, point: Optional[str] = None) -> int:
+        """Faults injected so far (counters, not the bounded log — the
+        counts stay exact past MAX_LOG truncation)."""
+        with self._lock:
+            if point is None:
+                return self._seq
+            return self._injected_by_point.get(point, 0)
+
+    def log_snapshot(self) -> list[dict]:
+        with self._lock:
+            return [dict(e) for e in self.log]
+
+
+# ---------------------------------------------------------------------------
+# Process-global injector (what the CLI configures and the default call
+# sites consult). Tests construct private injectors and pass them
+# explicitly instead.
+# ---------------------------------------------------------------------------
+
+_GLOBAL: Optional[FaultInjector] = None
+
+
+def configure(spec: str = "", seed: int = 0,
+              injector: Optional[FaultInjector] = None) -> FaultInjector:
+    """Install the process-global injector (CLI --inject path)."""
+    global _GLOBAL
+    _GLOBAL = injector if injector is not None else FaultInjector.from_spec(
+        spec, seed=seed
+    )
+    return _GLOBAL
+
+
+def get_injector() -> Optional[FaultInjector]:
+    return _GLOBAL
+
+
+def disable() -> None:
+    global _GLOBAL
+    _GLOBAL = None

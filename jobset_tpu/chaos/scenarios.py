@@ -1,0 +1,88 @@
+"""Cluster-side chaos scenarios: deterministic pod crash bursts and node
+drains driven through the simulation kernel's own fault helpers.
+
+These are the `cluster.*` injection points of the chaos plane. Unlike the
+apiserver/solver points — which sit inline on real request paths — cluster
+faults are *applied* by calling one of these helpers between pump rounds,
+the way the failure-recovery bench applies `fail_node`. The injector still
+owns every random choice (which pods crash, which node drains), so a
+seeded run selects identical victims every time.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .injector import FaultInjector, KIND_CRASH, KIND_DRAIN
+
+# Pod phases considered "live" for victim selection (mirrors
+# core/objects.py constants without importing the whole core package at
+# module load).
+_LIVE_PHASES = ("Pending", "Running")
+
+
+def pod_crash_burst(
+    cluster,
+    injector: FaultInjector,
+    rate: Optional[float] = None,
+    detail: str = "",
+) -> list[str]:
+    """Crash a deterministic subset of live pods (container-crash analog).
+
+    Every live pod is one arrival at the ``cluster.pod`` point, visited in
+    sorted (namespace, name) order so the victim set is a pure function of
+    the seed and the pod population. With ``rate`` given, a transient rule
+    at that rate is installed for exactly this sweep; otherwise whatever
+    ``cluster.pod`` rules the injector already carries decide.
+
+    Returns the crashed pod names. The owning jobs observe the failures on
+    the next pump round exactly like real crashes (backoffLimit accounting,
+    failure policy, gang restart).
+    """
+    rule = None
+    if rate is not None:
+        rule = injector.add_rule("cluster.pod", KIND_CRASH, rate=rate)
+    crashed: list[str] = []
+    try:
+        for key in sorted(cluster.pods):
+            pod = cluster.pods.get(key)
+            if pod is None or pod.status.phase not in _LIVE_PHASES:
+                continue
+            fault = injector.check(
+                "cluster.pod", detail or f"{key[0]}/{key[1]}"
+            )
+            if fault is not None and fault.kind == KIND_CRASH:
+                cluster.fail_pod(*key)
+                crashed.append(key[1])
+    finally:
+        if rule is not None:
+            injector.remove_rule(rule)
+    return crashed
+
+
+def node_drain(
+    cluster,
+    injector: FaultInjector,
+    rate: Optional[float] = None,
+) -> list[str]:
+    """Drain a deterministic subset of nodes (maintenance-event analog).
+
+    Each node is one arrival at ``cluster.node`` in sorted-name order;
+    a drained node fails every live pod bound to it via the kernel's
+    `fail_node` (jobs get Failed conditions -> failure policy -> gang
+    recovery). Returns the drained node names.
+    """
+    rule = None
+    if rate is not None:
+        rule = injector.add_rule("cluster.node", KIND_DRAIN, rate=rate)
+    drained: list[str] = []
+    try:
+        for name in sorted(cluster.nodes):
+            fault = injector.check("cluster.node", name)
+            if fault is not None and fault.kind == KIND_DRAIN:
+                cluster.fail_node(name)
+                drained.append(name)
+    finally:
+        if rule is not None:
+            injector.remove_rule(rule)
+    return drained

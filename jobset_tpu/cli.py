@@ -82,6 +82,20 @@ def _build_parser() -> argparse.ArgumentParser:
                    help="structured JSON logs on stderr, each record stamped "
                         "with the active trace/span ids (zap-JSON analog; "
                         "joins with GET /debug/traces on trace_id)")
+    c.add_argument("--inject", default="", metavar="SPEC",
+                   help="chaos fault-injection spec, e.g. "
+                        "'apiserver.request:error,status=503@0.05;"
+                        "solver.stream:break@0.02' — deterministic under "
+                        "--inject-seed (bench/e2e resilience drills; see "
+                        "jobset_tpu/chaos)")
+    c.add_argument("--inject-seed", type=int, default=0,
+                   help="seed for --inject (two runs with the same seed "
+                        "inject identical fault sequences)")
+    c.add_argument("--solve-budget", type=float, default=0.0,
+                   help="per-solve deadline budget in seconds: a placement "
+                        "solve (remote or local) exceeding it degrades the "
+                        "provider to the greedy path for a cool-off window "
+                        "(0 = unlimited)")
 
     s = sub.add_parser("solver", help="run the placement solver sidecar (gRPC)")
     s.add_argument("--addr", default="127.0.0.1:8500")
@@ -163,12 +177,23 @@ def _cmd_controller(args) -> int:
 
         configure_json_logging()
 
+    if args.inject:
+        from . import chaos
+
+        chaos.configure(args.inject, seed=args.inject_seed)
+
     solver = None
     if args.solver_addr:
         from .placement.service import RemoteAssignmentSolver
 
         solver = RemoteAssignmentSolver(args.solver_addr)
-    cluster = make_cluster(clock=Clock(), placement=SolverPlacement(solver=solver))
+    cluster = make_cluster(
+        clock=Clock(),
+        placement=SolverPlacement(
+            solver=solver,
+            solve_budget_s=args.solve_budget or None,
+        ),
+    )
 
     if args.topology:
         key, _, shape = args.topology.partition(":")

@@ -11,6 +11,7 @@ talk to the control plane.
 from __future__ import annotations
 
 import json
+import random
 import threading
 import time
 import urllib.error
@@ -29,8 +30,22 @@ class ApiError(Exception):
         self.message = message
 
 
+# Statuses a GET may safely retry: the request was never processed (503
+# standby/overload, 502/504 proxy hops, 429 throttles) or failed opaquely
+# server-side (500). Mutations are NOT retried — an apiserver 500 may have
+# landed the write, and the caller owns that ambiguity.
+_RETRYABLE_STATUSES = frozenset({429, 500, 502, 503, 504})
+
+
 class JobSetClient:
-    """Client bound to one controller server (`http://host:port`)."""
+    """Client bound to one controller server (`http://host:port`).
+
+    Idempotent requests (GETs: reads, lists, health probes) ride through
+    transient server trouble with `retries` attempts of exponential
+    backoff + full jitter (the AWS-architecture-blog discipline: sleep
+    U(0, min(cap, base * 2^attempt)) so a thundering herd of recovering
+    clients decorrelates). Mutations are never retried here.
+    """
 
     API = "/apis/jobset.x-k8s.io/v1alpha2"
 
@@ -39,14 +54,25 @@ class JobSetClient:
         base_url: str,
         timeout: float = 30.0,
         ca_cert: Optional[str] = None,
+        retries: int = 4,
+        backoff_base_s: float = 0.05,
+        backoff_cap_s: float = 2.0,
+        retry_seed: Optional[int] = None,
     ):
         """ca_cert: path to the PEM CA that signed the controller's serving
         cert (utils/certs.py writes it as ca.crt) — enables https:// URLs
-        with verification against the self-signed chain."""
+        with verification against the self-signed chain.
+        retries: extra attempts for idempotent (GET) requests on 429/5xx
+        and transport errors; retry_seed makes the jitter reproducible."""
         if "://" not in base_url:
             base_url = f"{'https' if ca_cert else 'http'}://{base_url}"
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        self.retries = max(0, retries)
+        self.backoff_base_s = backoff_base_s
+        self.backoff_cap_s = backoff_cap_s
+        self._retry_rng = random.Random(retry_seed)
+        self.retried_requests = 0
         self._ssl_context = None
         if ca_cert is not None:
             import ssl
@@ -81,7 +107,35 @@ class JobSetClient:
             client_span.set_attribute("http.status", status)
             return out
 
+    def _backoff_sleep(self, attempt: int) -> None:
+        """Full-jitter exponential backoff: U(0, min(cap, base * 2^n))."""
+        cap = min(self.backoff_cap_s, self.backoff_base_s * (2 ** attempt))
+        time.sleep(self._retry_rng.uniform(0.0, cap))
+
     def _transport(self, method: str, path: str, body, headers):
+        """One logical HTTP round trip; returns (payload, status).
+
+        GETs retry `self.retries` times on retryable statuses and raw
+        transport errors (connection refused/reset — the server may be
+        mid-restart) with exponential backoff + full jitter; every other
+        method gets exactly one attempt."""
+        attempts = 1 + (self.retries if method == "GET" else 0)
+        for attempt in range(attempts):
+            try:
+                return self._transport_once(method, path, body, headers)
+            except ApiError as exc:
+                if (
+                    attempt + 1 >= attempts
+                    or exc.status not in _RETRYABLE_STATUSES
+                ):
+                    raise
+            except urllib.error.URLError:
+                if attempt + 1 >= attempts:
+                    raise
+            self.retried_requests += 1
+            self._backoff_sleep(attempt)
+
+    def _transport_once(self, method: str, path: str, body, headers):
         """One HTTP round trip; returns (parsed payload, response status)."""
         req = urllib.request.Request(
             self.base_url + path, data=body, method=method, headers=headers
@@ -453,10 +507,18 @@ class ResourceInformer:
             self.cache.pop(name, None)
             self._fire(self.on_delete, obj)
 
+    # Watch-retry backoff bounds: persistent errors (controller down for
+    # minutes) must neither tight-loop the thread nor grow the sleep
+    # unboundedly — exponential from MIN, capped at MAX, reset by the
+    # first successful poll.
+    WATCH_BACKOFF_MIN_S = 0.2
+    WATCH_BACKOFF_MAX_S = 5.0
+
     def _run(self) -> None:
         import time as _t
 
         next_resync = _t.monotonic() + self.resync_seconds
+        backoff = self.WATCH_BACKOFF_MIN_S
         while not self._stop.is_set():
             try:
                 events, rv = self.client.watch_resource(
@@ -466,19 +528,25 @@ class ResourceInformer:
                 for event in events:
                     self._apply(event)
                 self._rv = rv
+                backoff = self.WATCH_BACKOFF_MIN_S  # healthy again
             except WatchGone:
                 try:
                     self._relist()
+                    backoff = self.WATCH_BACKOFF_MIN_S
                 except Exception:
                     # The catch-up list itself failed (controller restart
                     # mid-410?): back off and retry — the loop must never
                     # die silently with a stale cache.
-                    if self._stop.wait(0.5):
+                    if self._stop.wait(backoff):
                         return
+                    backoff = min(backoff * 2, self.WATCH_BACKOFF_MAX_S)
             except Exception:
-                # transient transport error: back off briefly, then resume
-                if self._stop.wait(0.5):
+                # Transient transport error: back off (bounded, growing)
+                # then resume with the SAME resourceVersion — the journal
+                # still holds anything missed inside the gap.
+                if self._stop.wait(backoff):
                     return
+                backoff = min(backoff * 2, self.WATCH_BACKOFF_MAX_S)
             if _t.monotonic() >= next_resync:
                 try:
                     self._relist()

@@ -154,6 +154,11 @@ class Cluster:
         self._queued: set[tuple[str, str]] = set()
         # (ns, name) -> virtual time at which to requeue (TTL handling).
         self.requeue_after: dict[tuple[str, str], float] = {}
+        # Exception containment for the reconcile pump: per-JobSet count of
+        # consecutive reconcile raises. A poisoned JobSet gets a
+        # rate-limited requeue (exponential, capped) instead of wedging the
+        # whole drain loop; reset by the first clean pass.
+        self.reconcile_failures: dict[tuple[str, str], int] = {}
 
         # Wired by controllers module to avoid import cycles.
         self.jobset_reconciler = None
@@ -406,6 +411,9 @@ class Cluster:
             if svc.selector.get(keys.JOBSET_NAME_KEY) == name and svc_key[0] == namespace:
                 del self.services[svc_key]
         self.requeue_after.pop(key, None)
+        # A recreated JobSet under the same name starts with a clean
+        # containment slate (and the per-key failure map stays bounded).
+        self.reconcile_failures.pop(key, None)
 
     def get_jobset(self, namespace: str, name: str) -> Optional[JobSet]:
         return self.jobsets.get((namespace, name))
@@ -794,6 +802,43 @@ class Cluster:
                 for js in jobsets:
                     placement.prepare(self, js, block=block)
 
+    # Rate-limited requeue for contained reconcile exceptions (workqueue
+    # ItemExponentialFailureRateLimiter analog): base * 2^(n-1), capped.
+    RECONCILE_BACKOFF_BASE_S = 1.0
+    RECONCILE_BACKOFF_CAP_S = 60.0
+
+    def _contain_reconcile_error(self, key: tuple[str, str]) -> bool:
+        """Handle one raised reconcile: log/count/event it and schedule the
+        rate-limited retry. Returns True (state changed: a retry is now
+        pending)."""
+        import logging
+
+        from . import metrics
+
+        failures = self.reconcile_failures.get(key, 0) + 1
+        self.reconcile_failures[key] = failures
+        backoff = min(
+            self.RECONCILE_BACKOFF_BASE_S * (2 ** (failures - 1)),
+            self.RECONCILE_BACKOFF_CAP_S,
+        )
+        namespaced = f"{key[0]}/{key[1]}"
+        logging.getLogger("jobset_tpu.cluster").exception(
+            "reconcile of %s raised (failure %d); requeued in %.1fs",
+            namespaced, failures, backoff,
+        )
+        metrics.reconcile_panics_total.inc(namespaced)
+        self.record_event(
+            "JobSet", key[1], "Warning", "ReconcileError",
+            f"reconcile raised (consecutive failure {failures}); "
+            f"requeued in {backoff:.1f}s",
+        )
+        # Later of any existing requeue and this backoff: the TTL requeue
+        # path shares the map, and a sooner retry must not defeat the rate
+        # limit.
+        fire = self.clock.now() + backoff
+        self.requeue_after[key] = max(self.requeue_after.get(key, 0.0), fire)
+        return True
+
     def tick(self) -> bool:
         """One control-plane pass; returns True if anything changed."""
         changed = False
@@ -849,7 +894,17 @@ class Cluster:
             ):
                 self._drain_prepare_requests(block=False)
             if self.jobset_reconciler is not None:
-                changed |= bool(self.jobset_reconciler.reconcile(*key))
+                try:
+                    changed |= bool(self.jobset_reconciler.reconcile(*key))
+                    self.reconcile_failures.pop(key, None)
+                except Exception:
+                    # Containment: ONE poisoned JobSet (bad annotation, a
+                    # provider bug, a half-written object) must not wedge
+                    # the drain loop for every other JobSet. Count it,
+                    # surface it (log + event + metric), and requeue with
+                    # rate-limited exponential backoff on the virtual
+                    # clock — the workqueue-rate-limiter analog.
+                    changed = self._contain_reconcile_error(key) or changed
             self._drain_deferred()
         # Placement prefetches buffered during the drain run as ONE batched
         # solver dispatch (the storm path); plans land before the next

@@ -31,14 +31,20 @@ def _job_failure_condition(job: Job):
 
 
 def find_first_failed_job(failed_jobs: list[Job]) -> Optional[Job]:
-    """Failed job with the oldest failure transition time (L292-307)."""
-    first, first_time = None, None
+    """Failed job with the oldest failure transition time (L292-307).
+
+    Ties on the transition time (two jobs swept by the same node failure
+    in one virtual-clock instant) break on job name, so the selected job —
+    and with it the rule match, event message, and restart attribution —
+    is deterministic rather than an artifact of set-iteration order."""
+    first, first_key = None, None
     for job in failed_jobs:
         cond = _job_failure_condition(job)
         if cond is None:
             continue
-        if first is None or cond.last_transition_time < first_time:
-            first, first_time = job, cond.last_transition_time
+        key = (cond.last_transition_time, job.metadata.name)
+        if first is None or key < first_key:
+            first, first_key = job, key
     return first
 
 
@@ -55,16 +61,18 @@ def find_first_failed_policy_rule_and_job(
     rules: list[FailurePolicyRule], failed_jobs: list[Job]
 ) -> tuple[Optional[FailurePolicyRule], Optional[Job]]:
     """First rule (in order) with a matching failed job; among matches, the
-    earliest failure wins (L82-112)."""
+    earliest failure wins (L82-112), ties broken on job name (the
+    find_first_failed_job determinism contract)."""
     for rule in rules:
-        matched, matched_time = None, None
+        matched, matched_key = None, None
         for job in failed_jobs:
             cond = _job_failure_condition(job)
             if cond is None:
                 continue
-            earlier = matched is None or cond.last_transition_time < matched_time
+            key = (cond.last_transition_time, job.metadata.name)
+            earlier = matched is None or key < matched_key
             if _rule_applies(rule, job, cond.reason) and earlier:
-                matched, matched_time = job, cond.last_transition_time
+                matched, matched_key = job, key
         if matched is not None:
             return rule, matched
     return None, None

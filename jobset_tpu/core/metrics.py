@@ -181,6 +181,41 @@ api_requests_in_flight = Gauge(
     "jobset_apiserver_requests_in_flight",
     "HTTP requests currently being handled by the controller server",
 )
+# Circuit breaker around the remote solver sidecar (placement/service.py):
+# 0=closed (remote in use), 1=open (sidecar presumed dead; local solves,
+# no dial attempts), 2=half_open (one probe in flight).
+BREAKER_CLOSED, BREAKER_OPEN, BREAKER_HALF_OPEN = 0, 1, 2
+solver_breaker_state = Gauge(
+    "jobset_placement_solver_breaker_state",
+    "Remote-solver circuit breaker state (0=closed, 1=open, 2=half_open)",
+)
+solver_fallbacks_total = Counter(
+    "jobset_placement_solver_fallbacks_total",
+    "Remote-solver calls answered by the local fallback, by last "
+    "transport-error class",
+    label_names=("solver_fallback_reason",),
+)
+placement_degraded = Gauge(
+    "jobset_placement_degraded",
+    "1 while the placement provider is degraded to the greedy path "
+    "(per-solve budget blown); 0 when solver placement is active",
+)
+placement_budget_exceeded_total = Counter(
+    "jobset_placement_solve_budget_exceeded_total",
+    "Placement solves (remote or local) that blew the per-solve deadline "
+    "budget and triggered greedy degradation",
+    label_names=(),
+)
+reconcile_panics_total = Counter(
+    "jobset_reconcile_panics_total",
+    "Reconcile passes that raised and were contained by the pump "
+    "(the poisoned JobSet is requeued with rate-limited backoff)",
+)
+chaos_injected_faults_total = Counter(
+    "jobset_chaos_injected_faults_total",
+    "Faults injected by the chaos plane, per injection point",
+    label_names=("point",),
+)
 
 
 ALL_COUNTERS = (
@@ -188,12 +223,18 @@ ALL_COUNTERS = (
     jobset_failed_total,
     jobset_restarts_total,
     pump_errors_total,
+    solver_fallbacks_total,
+    placement_budget_exceeded_total,
+    reconcile_panics_total,
+    chaos_injected_faults_total,
 )
 ALL_HISTOGRAMS = (reconcile_time_seconds, solver_solve_time_seconds)
 ALL_GAUGES = (
     solver_batch_occupancy,
     solver_batch_problems,
     api_requests_in_flight,
+    solver_breaker_state,
+    placement_degraded,
 )
 
 

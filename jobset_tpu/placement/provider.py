@@ -21,8 +21,10 @@ the cached plan is missing or stale.
 
 from __future__ import annotations
 
+import time as _time
+
 from ..api import keys
-from ..core import features
+from ..core import features, metrics
 from ..obs.trace import span as obs_span
 from .naming import gen_job_name, job_hash_key
 from .webhooks import PLAN_ANNOTATION
@@ -62,11 +64,63 @@ class SolverPlacement:
     # forget() is never called for some uid.
     _MAX_PLANS = 256
 
-    def __init__(self, solver=None):
+    def __init__(
+        self,
+        solver=None,
+        solve_budget_s: float | None = None,
+        degrade_cooloff_s: float = 30.0,
+    ):
         # Lazy import so the control plane doesn't pull in jax unless used.
         self._solver = solver
         # jobset uid -> (restarts, specs, domain_values, plan-or-PendingSolve)
         self._plans: dict[str, tuple] = {}
+        # Per-solve deadline budget (chaos-plane hardening): when a solve —
+        # remote round trip OR local/compile-stalled in-process — takes
+        # longer than `solve_budget_s`, the provider degrades to the greedy
+        # webhook path for `degrade_cooloff_s` of wall time: gangs keep
+        # placing (without optimal packing) while the solver is sick,
+        # instead of every creation pass eating the stall. None = no
+        # budget (the default; in-sim callers own their own pacing).
+        self.solve_budget_s = solve_budget_s
+        self.degrade_cooloff_s = degrade_cooloff_s
+        self._degraded_until = 0.0
+        self.budget_blows = 0
+
+    # -- degradation (per-solve budget) --------------------------------
+
+    def degraded(self) -> bool:
+        """True while inside the greedy-degrade cool-off window."""
+        if self.solve_budget_s is None:
+            return False
+        if _time.monotonic() < self._degraded_until:
+            return True
+        if self._degraded_until:
+            self._degraded_until = 0.0
+            metrics.placement_degraded.set(0)
+        return False
+
+    def _charge_budget(self, elapsed_s: float, span=None) -> None:
+        if self.solve_budget_s is None or elapsed_s <= self.solve_budget_s:
+            return
+        self.budget_blows += 1
+        self._degraded_until = _time.monotonic() + self.degrade_cooloff_s
+        metrics.placement_budget_exceeded_total.inc()
+        metrics.placement_degraded.set(1)
+        if span is not None:
+            span.set_attribute(
+                "budget_blown_ms", round(elapsed_s * 1000.0, 1)
+            )
+
+    def _timed_result(self, pending, span=None):
+        """Materialize an async solve, charging the per-solve budget for
+        the wall time spent blocked on the device — the prefetch path's
+        equivalent of the timed synchronous build_plan, so a wedged device
+        or compile stall trips greedy degradation from EVERY
+        materialization site."""
+        t0 = _time.perf_counter()
+        result = pending.result()
+        self._charge_budget(_time.perf_counter() - t0, span)
+        return result
 
     def forget(self, jobset_uid: str) -> None:
         """Drop any cached/in-flight plan for a JobSet (deletion hook)."""
@@ -130,6 +184,8 @@ class SolverPlacement:
         """
         if not features.enabled("TPUPlacementSolver"):
             return
+        if self.degraded():
+            return  # budget blown: no prefetch while degraded to greedy
         topology_key = self._topology_key(js)
         if topology_key is None:
             return
@@ -181,7 +237,8 @@ class SolverPlacement:
                 # steals cycles from the very reconciles the prefetch is
                 # protecting.
                 pending = self._materialize(
-                    specs, domain_values, pending.result()
+                    specs, domain_values,
+                    self._timed_result(pending, prepare_span),
                 )
             self._store_plan(js, specs, domain_values, pending)
 
@@ -206,6 +263,8 @@ class SolverPlacement:
         between ticks (the storm-p99 fix; see docs/benchmarks.md).
         """
         if not features.enabled("TPUPlacementSolver"):
+            return
+        if self.degraded():
             return
         solver = self._get_solver()
         if not hasattr(solver, "solve_structured_batch_async"):
@@ -261,7 +320,7 @@ class SolverPlacement:
                 pending = solver.solve_structured_async(**params)
                 if block:
                     pending = self._materialize(
-                        specs, domain_values, pending.result()
+                        specs, domain_values, self._timed_result(pending)
                     )
                 self._store_plan(js, specs, domain_values, pending)
             return
@@ -270,7 +329,9 @@ class SolverPlacement:
         )
         for (js, specs, domain_values, _), pending in zip(entries, pendings):
             if block:
-                pending = self._materialize(specs, domain_values, pending.result())
+                pending = self._materialize(
+                    specs, domain_values, self._timed_result(pending)
+                )
             self._store_plan(js, specs, domain_values, pending)
 
     def _store_plan(self, js, specs, domain_values, plan_or_pending) -> None:
@@ -348,6 +409,12 @@ class SolverPlacement:
             "placement.assign",
             {"jobset": js.metadata.name, "jobs": len(jobs)},
         ) as assign_span:
+            if self.degraded():
+                # Budget blown recently: place THIS batch greedily (webhook
+                # cascade) instead of risking another blown solve on the
+                # creation path; the cool-off expiring re-promotes solves.
+                assign_span.set_attribute("outcome", "degraded_greedy")
+                return
             plan = self._fetch_valid_plan(cluster, js, jobs, topology_key)
             if plan is PLAN_PENDING:
                 assign_span.set_attribute("outcome", "plan_pending")
@@ -356,9 +423,11 @@ class SolverPlacement:
                 from .plans import build_plan
 
                 assign_span.set_attribute("outcome", "fresh_solve")
+                t0 = _time.perf_counter()
                 plan = build_plan(
                     cluster, js, jobs, topology_key, self._get_solver()
                 )
+                self._charge_budget(_time.perf_counter() - t0, assign_span)
                 if plan is None:
                     return
             else:
@@ -403,7 +472,13 @@ class SolverPlacement:
         if not isinstance(pending, dict):
             if not pending.is_ready() and pending.age_seconds < _PENDING_GRACE_S:
                 return PLAN_PENDING
-            plan = self._materialize(specs, domain_values, pending.result())
+            # Past the grace window this fetch BLOCKS on the device — the
+            # prefetch path's solve wall time lands here, so the per-solve
+            # budget is charged here too (a wedged device must degrade to
+            # greedy, not stall every creation pass).
+            plan = self._materialize(
+                specs, domain_values, self._timed_result(pending)
+            )
             self._plans[js.metadata.uid] = (restarts, specs, domain_values, plan)
         else:
             plan = pending

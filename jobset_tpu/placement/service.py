@@ -34,8 +34,9 @@ from __future__ import annotations
 import queue
 import struct
 import threading
+import time
 from concurrent import futures
-from typing import Iterator, Optional
+from typing import Callable, Iterator, Optional
 
 import numpy as np
 
@@ -182,6 +183,98 @@ class SolverServer:
         self._server.stop(grace).wait()
 
 
+class CircuitBreaker:
+    """Transport circuit breaker for the solver sidecar.
+
+    closed --(N consecutive failures)--> open --(reset_timeout)-->
+    half_open --(probe success)--> closed / --(probe failure)--> open.
+
+    While OPEN the caller skips the remote entirely (no dial, no per-call
+    connect latency against a dead sidecar — the reconnect-per-call
+    behavior this class replaces); after `reset_timeout_s` the next call
+    is admitted as a half-open probe, and a successful probe re-promotes
+    to remote. State is exported on every transition via the
+    `jobset_placement_solver_breaker_state` Gauge (0/1/2) and remembered
+    in `transitions` so tests can assert the full open -> half_open ->
+    closed recovery arc. Not thread-safe on its own: the owning solver
+    serializes calls under its stream lock.
+    """
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+    def __init__(
+        self,
+        failure_threshold: int = 3,
+        reset_timeout_s: float = 5.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.failure_threshold = max(1, failure_threshold)
+        self.reset_timeout_s = reset_timeout_s
+        self._clock = clock
+        self.state = self.CLOSED
+        self.consecutive_failures = 0
+        self._open_until = 0.0
+        self.transitions: list[tuple[str, str]] = []
+        self._export()
+
+    def _export(self) -> None:
+        from ..core import metrics
+
+        metrics.solver_breaker_state.set(
+            {self.CLOSED: metrics.BREAKER_CLOSED,
+             self.OPEN: metrics.BREAKER_OPEN,
+             self.HALF_OPEN: metrics.BREAKER_HALF_OPEN}[self.state]
+        )
+
+    def _transition(self, new_state: str) -> None:
+        if new_state == self.state:
+            return
+        self.transitions.append((self.state, new_state))
+        self.state = new_state
+        self._export()
+
+    def allow(self) -> bool:
+        """Admission decision for one remote attempt. OPEN answers False
+        until the reset timeout passes, then admits ONE probe
+        (HALF_OPEN)."""
+        if self.state == self.OPEN:
+            if self._clock() < self._open_until:
+                return False
+            self._transition(self.HALF_OPEN)
+        return True
+
+    def record_success(self) -> None:
+        self.consecutive_failures = 0
+        if self.state != self.CLOSED:
+            self._transition(self.CLOSED)
+
+    def record_failure(self) -> None:
+        self.consecutive_failures += 1
+        if (
+            self.state == self.HALF_OPEN
+            or self.consecutive_failures >= self.failure_threshold
+        ):
+            self._open_until = self._clock() + self.reset_timeout_s
+            self._transition(self.OPEN)
+
+
+def _error_reason(exc: BaseException) -> str:
+    """Stable low-cardinality class of a transport error, for the
+    `solver_fallback_reason` metric label and the fallback span
+    attribute."""
+    if isinstance(exc, queue.Empty):
+        return "deadline"
+    if isinstance(exc, ConnectionRefusedError):
+        return "connect_refused"
+    code = getattr(exc, "code", None)
+    if callable(code):  # grpc.RpcError carries a StatusCode
+        try:
+            return f"grpc_{code().name.lower()}"
+        except Exception:
+            pass
+    return type(exc).__name__.lower()
+
+
 class RemoteAssignmentSolver:
     """Client: same `.solve`/`.solve_batch` surface as `AssignmentSolver`,
     backed by one long-lived SolveStream to the sidecar.
@@ -193,7 +286,21 @@ class RemoteAssignmentSolver:
     deadline (`timeout`): on expiry or any transport error the stream is
     torn down and the call transparently falls back to a local solve, so
     placement keeps working (degraded to in-process) when the sidecar hangs
-    or restarts; the next call re-dials.
+    or restarts.
+
+    Re-dial policy is owned by a `CircuitBreaker`: after
+    `failure_threshold` consecutive transport failures the breaker opens
+    and solves go straight to the local fallback with NO dial attempt (a
+    dead sidecar must not tax every recovery solve with connect latency);
+    after `reset_timeout_s` one probe call is admitted (half-open), and a
+    successful probe re-promotes the remote path. The last transport error
+    is kept on `last_error` / `last_error_reason` and stamped onto the
+    fallback span + the `solver_fallback_reason` metric label so every
+    fallback is attributable.
+
+    `injector`: optional chaos `FaultInjector` consulted at the
+    `solver.connect` (refuse) and `solver.stream` (break / slow frame)
+    injection points; defaults to the process-global injector.
     """
 
     def __init__(
@@ -202,6 +309,8 @@ class RemoteAssignmentSolver:
         fallback_local: bool = True,
         credentials=None,
         timeout: float = 60.0,
+        breaker: Optional[CircuitBreaker] = None,
+        injector=None,
     ):
         self.address = address
         self.timeout = timeout
@@ -213,15 +322,33 @@ class RemoteAssignmentSolver:
         self._requests: Optional[queue.Queue] = None
         self._replies: Optional[queue.Queue] = None
         self._reader: Optional[threading.Thread] = None
+        self.breaker = breaker if breaker is not None else CircuitBreaker()
+        self._injector = injector
         self.remote_solves = 0
         self.local_fallbacks = 0
+        self.last_error: Optional[BaseException] = None
+        self.last_error_reason: str = ""
 
     # -- connection management -------------------------------------------
+    def _chaos(self):
+        if self._injector is not None:
+            return self._injector
+        from ..chaos import get_injector
+
+        return get_injector()
+
     def _connect_locked(self):
         import grpc
 
         if self._channel is not None:
             return
+        chaos = self._chaos()
+        if chaos is not None:
+            fault = chaos.check("solver.connect", self.address)
+            if fault is not None and fault.kind == "refuse":
+                raise ConnectionRefusedError(
+                    f"chaos: connect to {self.address} refused"
+                )
         options = [
             ("grpc.max_receive_message_length", 256 * 1024 * 1024),
             ("grpc.max_send_message_length", 256 * 1024 * 1024),
@@ -251,28 +378,45 @@ class RemoteAssignmentSolver:
 
         # Reader thread: lets `_roundtrip` wait with a real deadline instead
         # of blocking forever in `next()` on a wedged sidecar.
+        solver = self
+        this_channel = self._channel
+
         def drain():
             try:
                 for reply in responses:
                     replies.put(reply)
             except Exception as exc:  # stream broke; unblock the waiter
+                # Remember the error on the owner too: a break with no
+                # waiter in flight would otherwise vanish into the dead
+                # queue and leave the NEXT fallback unattributable. Only
+                # while this stream is still the live one — the CANCELLED
+                # that follows a deliberate teardown must not overwrite
+                # the specific error that caused it.
+                if solver._channel is this_channel:
+                    solver.last_error = exc
+                    solver.last_error_reason = _error_reason(exc)
                 replies.put(exc)
 
         self._reader = threading.Thread(target=drain, daemon=True)
         self._reader.start()
 
     def _teardown_locked(self):
-        if self._requests is not None:
-            self._requests.put(self._sentinel)
-        if self._channel is not None:
-            try:
-                self._channel.close()
-            except Exception:
-                pass
+        requests, channel = self._requests, self._channel
+        # Null the fields BEFORE closing: the reader thread checks
+        # `solver._channel is this_channel` to decide whether a stream
+        # error is live or just the CANCELLED echo of this teardown — the
+        # echo must never overwrite the specific error being recorded.
         self._channel = None
         self._requests = None
         self._replies = None
         self._reader = None
+        if requests is not None:
+            requests.put(self._sentinel)
+        if channel is not None:
+            try:
+                channel.close()
+            except Exception:
+                pass
 
     def close(self):
         with self._lock:
@@ -288,16 +432,37 @@ class RemoteAssignmentSolver:
 
     def _roundtrip(self, frame: bytes) -> bytes:
         with self._lock:
-            self._connect_locked()
             try:
+                self._connect_locked()
+                chaos = self._chaos()
+                if chaos is not None:
+                    fault = chaos.check("solver.stream", self.address)
+                    if fault is not None:
+                        if fault.kind == "break":
+                            raise BrokenPipeError(
+                                "chaos: solver stream broken mid-flight"
+                            )
+                        if fault.kind == "slow" and fault.delay_s > 0:
+                            time.sleep(fault.delay_s)  # slow frame
                 self._requests.put(frame)
                 reply = self._replies.get(timeout=self.timeout)
                 if isinstance(reply, Exception):
                     raise reply
                 return reply
-            except Exception:
+            except Exception as exc:
+                self.last_error = exc
+                self.last_error_reason = _error_reason(exc)
                 self._teardown_locked()
                 raise
+
+    def _fallback(self, cost, feasible, reason: str):
+        from ..core import metrics
+
+        metrics.solver_fallbacks_total.inc(reason)
+        self.local_fallbacks += 1
+        if np.asarray(cost).ndim == 2:
+            return self._local_solver().solve(cost, feasible)
+        return self._local_solver().solve_batch(cost, feasible)
 
     def _solve_remote_or_local(self, cost, feasible):
         from ..obs.trace import span as obs_span
@@ -308,21 +473,37 @@ class RemoteAssignmentSolver:
         with obs_span(
             "solver.grpc", {"address": self.address, "bytes": 0}
         ) as grpc_span:
+            if not self.breaker.allow():
+                # OPEN: no dial, no connect latency — straight to local.
+                if not self._fallback_local:
+                    raise ConnectionError(
+                        f"solver breaker open for {self.address} "
+                        f"(last error: {self.last_error_reason or 'unknown'})"
+                    )
+                grpc_span.set_attribute("breaker", self.breaker.state)
+                grpc_span.set_attribute("fallback", "local")
+                grpc_span.set_attribute(
+                    "fallback_reason",
+                    f"breaker_open/{self.last_error_reason or 'unknown'}",
+                )
+                return self._fallback(cost, feasible, "breaker_open")
+            grpc_span.set_attribute("breaker", self.breaker.state)
             frame = pack_problem(cost, feasible)
             grpc_span.set_attribute("bytes", len(frame))
             try:
                 reply = self._roundtrip(frame)
                 self.remote_solves += 1
+                self.breaker.record_success()
                 return unpack_assignment(reply)
             except Exception as exc:
+                self.breaker.record_failure()
                 if not self._fallback_local:
                     raise
+                reason = _error_reason(exc)
                 grpc_span.set_attribute("fallback", "local")
+                grpc_span.set_attribute("fallback_reason", reason)
                 grpc_span.record_error(exc)
-                self.local_fallbacks += 1
-                if np.asarray(cost).ndim == 2:
-                    return self._local_solver().solve(cost, feasible)
-                return self._local_solver().solve_batch(cost, feasible)
+                return self._fallback(cost, feasible, reason)
 
     def solve(self, cost: np.ndarray, feasible: Optional[np.ndarray] = None) -> np.ndarray:
         return self._solve_remote_or_local(np.asarray(cost, np.float32), feasible)

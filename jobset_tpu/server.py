@@ -190,10 +190,15 @@ class ControllerServer:
         tls_key: Optional[str] = None,
         elector=None,
         standby_accepts_writes: bool = True,
+        injector=None,
     ):
         if cluster is None:
             cluster = make_cluster(clock=Clock())
         self.cluster = cluster
+        # Chaos plane: `injector` (a chaos.FaultInjector) is consulted once
+        # per API request at the `apiserver.request` injection point; None
+        # falls through to the process-global injector (the CLI's --inject).
+        self.injector = injector
         # The lock lives on the Cluster: replicas sharing one Cluster
         # object (in-process HA pair) serialize on the same lock
         # automatically — a standby-accepted write can never race the
@@ -549,10 +554,38 @@ class ControllerServer:
         {"/healthz", "/readyz", "/leaderz", "/metrics", "/debug/traces"}
     )
 
+    def _check_chaos(self, method: str, bare: str):
+        """`apiserver.request` injection point: one arrival per API request
+        (observability surfaces excluded — a chaos 503 on /metrics would
+        blind the very instruments that prove recovery). Returns an error
+        response tuple, or None after applying any latency fault."""
+        injector = self.injector
+        if injector is None:
+            from .chaos import get_injector
+
+            injector = get_injector()
+        if injector is None or bare in self._UNTRACED_PATHS:
+            return None
+        fault = injector.check("apiserver.request", f"{method} {bare}")
+        if fault is None:
+            return None
+        if fault.kind == "latency":
+            if fault.delay_s > 0:
+                import time as _t
+
+                _t.sleep(fault.delay_s)
+            return None
+        return fault.status, {
+            "error": f"chaos: injected {fault.status} (seq {fault.seq})"
+        }
+
     def _route(self, method: str, path: str, body: bytes, headers=None):
         """Returns (status_code, payload_dict_or_text[, content_type])."""
         headers = headers or {}
         bare = path.partition("?")[0]
+        fault_response = self._check_chaos(method, bare)
+        if fault_response is not None:
+            return fault_response
         parent = obs_trace.extract_traceparent(headers.get("traceparent"))
         # Trace a request when it carries a caller's traceparent or mutates
         # state. Parentless GETs are untraced, mirroring the client rule:

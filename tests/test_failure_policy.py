@@ -179,3 +179,87 @@ def test_restart_event_recorded():
     events = cluster.events_with_reason(keys.RESTART_JOBSET_ACTION_REASON)
     assert len(events) == 1
     assert events[0].type == keys.EVENT_WARNING
+
+
+def test_max_restarts_exhaustion_stops_restarting_and_keeps_failed_state():
+    """Exhaustion edge: once restarts_count_towards_max reaches
+    max_restarts, the next failure fails the JobSet terminally — the gang
+    is NOT recreated again and the restart counter freezes."""
+    cluster, js = build(FailurePolicy(max_restarts=2))
+    for expected in (1, 2):
+        cluster.fail_job("default", "js-a-0")
+        cluster.run_until_stable()
+        assert js.status.restarts == expected
+    cluster.fail_job("default", "js-a-0")
+    cluster.run_until_stable()
+    assert js.status.terminal_state == keys.JOBSET_FAILED
+    cond = cluster.jobset_condition(js, keys.JOBSET_FAILED)
+    assert cond.reason == keys.REACHED_MAX_RESTARTS_REASON
+    assert "js-a-0" in cond.message
+    assert js.status.restarts == 2  # frozen: no recreation past the cap
+    assert metrics.jobset_failed_total.value("default/js") == 1
+
+
+def test_reason_rule_matching_nothing_falls_through_to_next_rule():
+    """A rule whose on_job_failure_reasons matches NO failed job must not
+    swallow the decision: the next rule in order is evaluated against the
+    same failed set."""
+    policy = FailurePolicy(
+        max_restarts=5,
+        rules=[
+            FailurePolicyRule(
+                name="deadline_only",
+                action=keys.FAIL_JOBSET,
+                on_job_failure_reasons=[keys.JOB_REASON_DEADLINE_EXCEEDED],
+            ),
+            FailurePolicyRule(
+                name="any_backoff",
+                action=keys.RESTART_JOBSET_AND_IGNORE_MAX_RESTARTS,
+                on_job_failure_reasons=[
+                    keys.JOB_REASON_BACKOFF_LIMIT_EXCEEDED
+                ],
+            ),
+        ],
+    )
+    cluster, js = build(policy)
+    cluster.fail_job(
+        "default", "js-b-0", reason=keys.JOB_REASON_BACKOFF_LIMIT_EXCEEDED
+    )
+    cluster.run_until_stable()
+    # First rule matched nothing; second rule decided: restart, not fail,
+    # and the ignore-max action leaves the counted restarts at zero.
+    assert js.status.terminal_state == ""
+    assert js.status.restarts == 1
+    assert js.status.restarts_count_towards_max == 0
+
+
+def test_same_transition_time_tie_breaks_on_job_name():
+    """Two jobs failing at the SAME virtual instant (one node failure
+    sweeping both): the earliest-failure selection tie-breaks on job name,
+    so the reported first-failed job is deterministic, not an artifact of
+    set-iteration order."""
+    policy = FailurePolicy(max_restarts=0, rules=[])
+    cluster, js = build(policy)
+    # No clock advance between the two failures: identical
+    # last_transition_time on both Failed conditions.
+    cluster.fail_job("default", "js-b-1")
+    cluster.fail_job("default", "js-a-0")
+    cluster.run_until_stable()
+    cond = cluster.jobset_condition(js, keys.JOBSET_FAILED)
+    assert "js-a-0" in cond.message  # lexicographically-first name wins
+
+    from jobset_tpu.core.failure_policy import find_first_failed_job
+
+    failed = [
+        j for j in cluster.jobs.values()
+        if any(c.type == keys.JOB_FAILED and c.status == "True"
+               for c in j.status.conditions)
+    ]
+    assert len(failed) == 2
+    # Selection is order-independent: any presentation order of the failed
+    # set yields the same job.
+    assert (
+        find_first_failed_job(failed).metadata.name
+        == find_first_failed_job(list(reversed(failed))).metadata.name
+        == "js-a-0"
+    )

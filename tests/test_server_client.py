@@ -302,6 +302,158 @@ def test_watch_resource_version_too_old_gets_410(server, client):
         client.watch("default", resource_version=1, timeout=0.2)
 
 
+def test_watch_gone_recovers_via_fresh_list_without_dropping_state(
+    server, client
+):
+    """The 410 recovery contract: WatchGone -> fresh list -> resume the
+    watch from the list's resourceVersion. Objects created AND deleted
+    inside the journal gap are reconciled by the relist (the informer's
+    synthetic add/delete path), and events after the relist's rv stream
+    normally — nothing is silently dropped."""
+    from jobset_tpu.api import serialization
+    from jobset_tpu.client import WatchGone
+
+    server._watch_limit = 4
+    _, rv0 = client.list_with_version()
+    client.create(serialization.to_yaml(_make_simple_jobset("keeper")))
+    # Churn enough writes that rv0 falls out of the retained window.
+    for i in range(6):
+        client.create(serialization.to_yaml(_make_simple_jobset(f"gap{i}")))
+        client.delete(f"gap{i}")
+    with pytest.raises(WatchGone):
+        client.watch("default", resource_version=rv0, timeout=0.2)
+
+    # Recovery: fresh list carries the current state + a resumable rv.
+    items, rv1 = client.list_with_version()
+    assert {i["metadata"]["name"] for i in items} == {"keeper"}
+    assert rv1 > rv0
+
+    # The resumed watch sees everything AFTER the relist — no gap.
+    client.create(serialization.to_yaml(_make_simple_jobset("after")))
+    events, _ = client.watch("default", resource_version=rv1, timeout=2.0)
+    names = [(e["type"], e["object"]["metadata"]["name"]) for e in events]
+    assert ("ADDED", "after") in names
+    assert all(name != "keeper" for _, name in names)  # no replays
+
+
+def test_informer_survives_410_and_converges(server, client):
+    """End-to-end informer resilience: force its resourceVersion out of the
+    journal window while it sleeps, then assert the 410-triggered relist
+    reconciles the cache (synthetic delete for objects that vanished in
+    the gap, add for ones that appeared) without dropping transitions."""
+    import threading
+
+    from jobset_tpu.api import serialization
+    from jobset_tpu.client import JobSetInformer
+
+    server._watch_limit = 4
+    added, deleted = [], []
+    saw_after = threading.Event()
+
+    def on_add(obj):
+        added.append(obj["metadata"]["name"])
+        if obj["metadata"]["name"] == "after-gap":
+            saw_after.set()
+
+    informer = JobSetInformer(
+        client, poll_timeout=0.3,
+        on_add=on_add,
+        on_delete=lambda obj: deleted.append(obj["metadata"]["name"]),
+    )
+    client.create(serialization.to_yaml(_make_simple_jobset("pre-gap")))
+    informer.start()
+    try:
+        assert informer.has_synced()
+        # While the informer's poll sleeps, churn the journal past its rv
+        # and delete pre-gap + create after-gap inside the gap.
+        client.delete("pre-gap")
+        for i in range(6):
+            client.create(
+                serialization.to_yaml(_make_simple_jobset(f"churn{i}"))
+            )
+            client.delete(f"churn{i}")
+        client.create(serialization.to_yaml(_make_simple_jobset("after-gap")))
+        assert saw_after.wait(10.0), f"informer never converged: {added}"
+        assert "pre-gap" in added
+        # The delete is observed either as a watch event or as relist
+        # drift — both fire on_delete; pre-gap must not linger in cache.
+        deadline = threading.Event()
+        for _ in range(50):
+            if "pre-gap" not in informer.cache:
+                break
+            deadline.wait(0.1)
+        assert "pre-gap" not in informer.cache
+        assert "after-gap" in informer.cache
+    finally:
+        informer.stop()
+
+
+def test_informer_watch_retry_backoff_is_bounded():
+    """Persistent transport errors must neither tight-loop the watch
+    thread nor grow the sleep unboundedly: exponential from MIN, capped at
+    MAX, reset after the first successful poll."""
+    import threading
+
+    from jobset_tpu.client import ResourceInformer
+
+    class FlakyClient:
+        def __init__(self):
+            self.calls = 0
+            self.fail = True
+
+        def list_resource_with_version(self, kind, namespace):
+            return [], 0
+
+        def watch_resource(self, kind, namespace, rv, timeout):
+            self.calls += 1
+            if self.fail:
+                raise OSError("connection refused")
+            return [], rv
+
+    class RecordingEvent(threading.Event):
+        def __init__(self):
+            super().__init__()
+            self.waits = []
+
+        def wait(self, timeout=None):
+            self.waits.append(timeout)
+            return super().wait(timeout)
+
+    flaky = FlakyClient()
+    informer = ResourceInformer(flaky, poll_timeout=0.01)
+    informer.WATCH_BACKOFF_MIN_S = 0.01
+    informer.WATCH_BACKOFF_MAX_S = 0.04
+    recorder = RecordingEvent()
+    informer._stop = recorder
+    informer.start()
+    try:
+        for _ in range(200):
+            if len(recorder.waits) >= 6:
+                break
+            threading.Event().wait(0.01)
+        waits = recorder.waits[:6]
+        assert waits[0] == pytest.approx(0.01)
+        assert waits[1] == pytest.approx(0.02)
+        assert max(waits) <= 0.04 + 1e-9  # capped, not unbounded
+        assert waits[-1] == pytest.approx(0.04)
+        # Recovery resets the backoff to MIN for the next error streak.
+        flaky.fail = False
+        calls_before = flaky.calls
+        for _ in range(100):
+            if flaky.calls > calls_before + 2:
+                break
+            threading.Event().wait(0.01)
+        flaky.fail = True
+        n = len(recorder.waits)
+        for _ in range(100):
+            if len(recorder.waits) > n:
+                break
+            threading.Event().wait(0.01)
+        assert recorder.waits[n] == pytest.approx(0.01)
+    finally:
+        informer.stop()
+
+
 def test_informer_cache_and_handlers(server, client):
     import threading
 
