@@ -609,24 +609,131 @@ def run_api_chaos_mode(solver_on: bool, args, rate: float, seed: int = 4,
     }
 
 
-def _bank_apiserver_inject(result: dict) -> None:
-    """Merge the faulted-vs-clean apiserver figures into the banked
-    placement artifact (BENCH_PLACEMENT_TPU_LAST.json) so the resilience
-    number rides alongside the on-chip captures it contextualizes."""
+def _bank_sidecar_key(key: str, result: dict) -> None:
+    """Merge one scenario's figures into the banked placement artifact
+    (BENCH_PLACEMENT_TPU_LAST.json) under `key`, stamped with capture
+    time — shared by every scenario that rides alongside the on-chip
+    captures (apiserver_inject, queue, ...)."""
     try:
         try:
             with open(PLACEMENT_SIDECAR) as f:
                 detail = json.load(f)
         except (OSError, ValueError):
             detail = {}
-        detail["apiserver_inject"] = dict(result)
-        detail["apiserver_inject"]["captured_at"] = time.strftime(
+        detail[key] = dict(result)
+        detail[key]["captured_at"] = time.strftime(
             "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
         )
         with open(PLACEMENT_SIDECAR, "w") as f:
             json.dump(detail, f, indent=1)
     except OSError:
         pass
+
+
+def _bank_apiserver_inject(result: dict) -> None:
+    _bank_sidecar_key("apiserver_inject", result)
+
+
+def run_queue_bench(args) -> dict:
+    """Gang admission-plane bench (docs/queueing.md): admission throughput
+    (workloads admitted/s across the manager's batched admission passes)
+    and preemption latency at a 64-queue / 512-workload mix, measured for
+    BOTH scorer backends (greedy numpy and the jit-batched TPUQueueScorer
+    path) on identical submission sequences — the decisions must agree, and
+    the artifact records that they did.
+    """
+    from jobset_tpu.core import features, make_cluster, metrics
+    from jobset_tpu.queue import Queue
+    from jobset_tpu.testing import make_jobset, make_replicated_job
+
+    num_queues = 64
+    num_workloads = 512
+    preempt_wave = 64
+    pod_mix = (1, 2, 4, 8)
+
+    def build(gate: bool) -> dict:
+        metrics.reset()
+        cluster = make_cluster()
+        qm = cluster.queue_manager
+        for i in range(num_queues):
+            qm.create_queue(Queue(
+                name=f"q{i:02d}",
+                quota={"pods": 16.0},
+                weight=1.0 + (i % 3),
+                cohort=f"cohort{i % 8}",
+            ))
+        # Submit the mixed workload population (deterministic mix).
+        for i in range(num_workloads):
+            pods = pod_mix[i % len(pod_mix)]
+            js = (
+                make_jobset(f"wl-{i:03d}")
+                .replicated_job(
+                    make_replicated_job("w").replicas(pods)
+                    .parallelism(1).completions(1).obj()
+                )
+                .queue(f"q{i % num_queues:02d}", priority=i % 3)
+                .obj()
+            )
+            cluster.create_jobset(js)
+
+        with features.gate("TPUQueueScorer", gate):
+            t0 = time.perf_counter()
+            cluster.run_until_stable(max_ticks=2000)
+            admit_s = time.perf_counter() - t0
+            admitted = sorted(
+                wl.key[1] for wl in qm.workloads.values()
+                if wl.state == "Admitted"
+            )
+
+            # Preemption wave: high-priority gangs into the fullest queues;
+            # measure per-pass wall time until the whole wave is admitted.
+            t0 = time.perf_counter()
+            for i in range(preempt_wave):
+                js = (
+                    make_jobset(f"hi-{i:03d}")
+                    .replicated_job(
+                        make_replicated_job("w").replicas(8)
+                        .parallelism(1).completions(1).obj()
+                    )
+                    .queue(f"q{i % num_queues:02d}", priority=100)
+                    .obj()
+                )
+                cluster.create_jobset(js)
+            cluster.run_until_stable(max_ticks=2000)
+            preempt_wall_s = time.perf_counter() - t0
+            hi_admitted = sum(
+                1 for wl in qm.workloads.values()
+                if wl.state == "Admitted" and wl.key[1].startswith("hi-")
+            )
+        return {
+            "admitted": len(admitted),
+            "decisions": admitted,
+            "admission_wall_s": round(admit_s, 4),
+            "admitted_per_s": round(len(admitted) / admit_s, 1),
+            "preempt_wave": preempt_wave,
+            "preempt_wave_admitted": hi_admitted,
+            "preemptions": int(metrics.queue_preemptions_total.total()),
+            "preempt_wall_s": round(preempt_wall_s, 4),
+            "preempt_latency_ms_per_admit": round(
+                1000.0 * preempt_wall_s / max(hi_admitted, 1), 2
+            ),
+        }
+
+    greedy = build(gate=False)
+    jit = build(gate=True)
+    decisions_match = greedy.pop("decisions") == jit.pop("decisions")
+    return {
+        "queues": num_queues,
+        "workloads": num_workloads,
+        "pod_mix": list(pod_mix),
+        "scorer_decisions_match": decisions_match,
+        "greedy": greedy,
+        "jit": jit,
+    }
+
+
+def _bank_queue(result: dict) -> None:
+    _bank_sidecar_key("queue", result)
 
 
 def preload_domain_gradient(cluster, topology_key: str, max_frac: float = 0.9):
@@ -1854,6 +1961,13 @@ def main() -> int:
              "way)",
     )
     parser.add_argument(
+        "--queue", action="store_true",
+        help="run ONLY the gang admission-queue bench (64 queues, 512 "
+             "workloads, 64-gang preemption wave; both scorer backends) "
+             "and bank it into BENCH_PLACEMENT_TPU_LAST.json under "
+             "'queue'",
+    )
+    parser.add_argument(
         "--model-only", action="store_true",
         help="probe the accelerator and run ONLY the model-MFU worker "
              "(prints its JSON line; used for opportunistic capture while "
@@ -1873,6 +1987,19 @@ def main() -> int:
         "--_placement-worker", action="store_true", help=argparse.SUPPRESS
     )
     args = parser.parse_args()
+
+    if args.queue:
+        # Pure control-plane bench: no accelerator probe needed (the jit
+        # scorer backend runs on whatever backend jax initialized).
+        result = run_queue_bench(args)
+        _bank_queue(result)
+        print(json.dumps({
+            "metric": "queue_admission_throughput",
+            "value": result["greedy"]["admitted_per_s"],
+            "unit": "workloads/s",
+            "detail": result,
+        }))
+        return 0
 
     if getattr(args, "_worker"):
         worker_main(args)

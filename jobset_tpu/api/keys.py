@@ -37,6 +37,11 @@ COORDINATOR_KEY = "jobset.sigs.k8s.io/coordinator"
 # pod webhooks skip planned pods the way they skip the nodeSelector strategy).
 PLACEMENT_PLAN_KEY = "tpu.jobset.x-k8s.io/placement-plan"
 
+# Admission-queue label stamped onto queue-managed JobSets (Kueue's
+# `kueue.x-k8s.io/queue-name` analog; the spec field is authoritative, the
+# label exists so selectors/informers can filter queued workloads).
+QUEUE_NAME_KEY = "tpu.jobset.x-k8s.io/queue-name"
+
 # Reserved managedBy value for the built-in controller.
 JOBSET_CONTROLLER_NAME = "jobset.sigs.k8s.io/jobset-controller"
 
@@ -133,6 +138,14 @@ JOBSET_SUSPENDED_REASON = "SuspendedJobs"
 JOBSET_SUSPENDED_MESSAGE = "jobset is suspended"
 JOBSET_RESUMED_REASON = "ResumeJobs"
 JOBSET_RESUMED_MESSAGE = "jobset is resumed"
+
+# Admission-queue event reasons (queue/ subsystem; Kueue workload events
+# analog: Pending/Admitted/Preempted/Requeued).
+QUEUE_PENDING_REASON = "QueuePending"
+QUEUE_ADMITTED_REASON = "QueueAdmitted"
+QUEUE_PREEMPTED_REASON = "QueuePreempted"
+QUEUE_REQUEUED_REASON = "QueueRequeued"
+QUEUE_RELEASED_REASON = "QueueReleased"
 
 FAIL_JOBSET_ACTION_REASON = "FailJobSetFailurePolicyAction"
 FAIL_JOBSET_ACTION_MESSAGE = "applying FailJobSet failure policy action"

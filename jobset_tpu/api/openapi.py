@@ -99,6 +99,8 @@ def _definitions() -> dict:
                 "coordinator": _ref("Coordinator"),
                 "managedBy": _STR,
                 "ttlSecondsAfterFinished": _INT,
+                "queueName": _STR,
+                "priority": _INT,
             },
         ),
         f"{_PREFIX}.ReplicatedJob": _obj(

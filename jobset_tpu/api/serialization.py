@@ -281,7 +281,7 @@ def _spec_from(d: Optional[dict], strict: bool) -> t.JobSetSpec:
         d,
         {"replicatedJobs", "network", "successPolicy", "failurePolicy",
          "startupPolicy", "suspend", "coordinator", "managedBy",
-         "ttlSecondsAfterFinished"},
+         "ttlSecondsAfterFinished", "queueName", "priority"},
         "spec",
         strict,
     )
@@ -293,6 +293,8 @@ def _spec_from(d: Optional[dict], strict: bool) -> t.JobSetSpec:
         suspend=d.get("suspend"),
         managed_by=d.get("managedBy"),
         ttl_seconds_after_finished=d.get("ttlSecondsAfterFinished"),
+        queue_name=d.get("queueName"),
+        priority=d.get("priority"),
     )
     if d.get("network") is not None:
         n = _as_dict(d["network"], "spec.network")
@@ -564,6 +566,10 @@ def to_dict(js: t.JobSet, include_status: bool = False) -> dict:
         spec["managedBy"] = js.spec.managed_by
     if js.spec.ttl_seconds_after_finished is not None:
         spec["ttlSecondsAfterFinished"] = js.spec.ttl_seconds_after_finished
+    if js.spec.queue_name is not None:
+        spec["queueName"] = js.spec.queue_name
+    if js.spec.priority is not None:
+        spec["priority"] = js.spec.priority
 
     out = {
         "apiVersion": API_VERSION,
@@ -652,6 +658,19 @@ def to_k8s_dict(js: t.JobSet, runner_image: str = DEFAULT_RUNNER_IMAGE) -> dict:
     import json as _json
 
     doc = to_dict(js)
+    # The reference CRD has no queue plane: export the admission-queue
+    # fields as vendor annotations (free-form under any CRD schema) so a
+    # queued JobSet still passes the reference's strict field validation.
+    spec_doc = doc.get("spec", {})
+    for wire_key, ann_key in (
+        ("queueName", "tpu.jobset.x-k8s.io/queue-name"),
+        ("priority", "tpu.jobset.x-k8s.io/priority"),
+    ):
+        value = spec_doc.pop(wire_key, None)
+        if value is not None:
+            doc.setdefault("metadata", {}).setdefault("annotations", {})[
+                ann_key
+            ] = str(value)
     for rj in doc.get("spec", {}).get("replicatedJobs", []):
         tmpl = rj.get("template", {}).get("spec", {}).get("template")
         if tmpl is None:

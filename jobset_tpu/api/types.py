@@ -276,6 +276,14 @@ class JobSetSpec:
     coordinator: Optional[Coordinator] = None
     managed_by: Optional[str] = None
     ttl_seconds_after_finished: Optional[int] = None
+    # Admission queue (Kueue LocalQueue analog, queue/ subsystem): a named
+    # queue makes creation admit-later — the apiserver forces suspend=true
+    # and the QueueManager resumes the gang when quota admits it.
+    queue_name: Optional[str] = None
+    # Workload priority within the admission plane (higher preempts lower;
+    # int32 range like a k8s PriorityClass value). Only meaningful with
+    # queue_name.
+    priority: Optional[int] = None
 
 
 # ---------------------------------------------------------------------------

@@ -174,6 +174,58 @@ def validate_create(js: JobSet) -> list[str]:
         if err:
             errs.append(err)
 
+    # Admission-queue fields (queue/ subsystem): the queue name doubles as
+    # an API object name, so it must be a DNS-1123 label; priority is an
+    # int32 like a k8s PriorityClass value. Type-checked (not assumed)
+    # because the serializer stores these verbatim and validation must
+    # answer with errors, never raise, on a malformed manifest.
+    if js.spec.queue_name is not None:
+        if not isinstance(js.spec.queue_name, str) or not js.spec.queue_name:
+            errs.append(
+                "spec.queueName must be a non-empty string "
+                f"(got {js.spec.queue_name!r})"
+            )
+        elif len(js.spec.queue_name) > 63 or not DNS1123_LABEL_RE.match(
+            js.spec.queue_name
+        ):
+            errs.append(
+                "spec.queueName must be a DNS-1123 label "
+                f"(got {js.spec.queue_name!r})"
+            )
+    if js.spec.priority is not None:
+        if isinstance(js.spec.priority, bool) or not isinstance(
+            js.spec.priority, int
+        ):
+            errs.append(
+                f"spec.priority must be an integer (got {js.spec.priority!r})"
+            )
+        elif not -(2**31) <= js.spec.priority <= 2**31 - 1:
+            errs.append("spec.priority must fit in int32")
+    if js.spec.queue_name:
+        # The admission plane computes the gang request from the pod
+        # templates' workload `resources` payloads; reject non-numeric
+        # values here so gang_request never raises mid-interception.
+        for rjob in js.spec.replicated_jobs:
+            resources = rjob.template.spec.template.spec.workload.get(
+                "resources"
+            )
+            if resources is None:
+                continue
+            if not isinstance(resources, dict):
+                errs.append(
+                    f"workload resources of replicatedJob '{rjob.name}' "
+                    "must be a mapping of resource -> number"
+                )
+                continue
+            for resource, value in resources.items():
+                if isinstance(value, bool) or not isinstance(
+                    value, (int, float)
+                ):
+                    errs.append(
+                        f"workload resource {resource!r} of replicatedJob "
+                        f"'{rjob.name}' must be a number (got {value!r})"
+                    )
+
     return errs
 
 
@@ -275,6 +327,13 @@ def validate_update(old: JobSet, new: JobSet) -> list[str]:
         errs.append("spec.replicatedJobs: Invalid value: field is immutable")
     if munged.spec.managed_by != old.spec.managed_by:
         errs.append("spec.managedBy: Invalid value: field is immutable")
+    # The admission plane keys quota accounting and preemption ordering off
+    # these; moving a live workload between queues or priorities would
+    # corrupt both (Kueue likewise rejects queue-name changes post-create).
+    if munged.spec.queue_name != old.spec.queue_name:
+        errs.append("spec.queueName: Invalid value: field is immutable")
+    if munged.spec.priority != old.spec.priority:
+        errs.append("spec.priority: Invalid value: field is immutable")
 
     # CEL-immutable fields.
     if munged.spec.network != old.spec.network:

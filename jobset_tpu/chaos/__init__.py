@@ -14,6 +14,7 @@ from .injector import (
     KIND_CRASH,
     KIND_DRAIN,
     KIND_ERROR,
+    KIND_EVICT,
     KIND_LATENCY,
     KIND_REFUSE,
     KIND_SLOW,
@@ -22,7 +23,7 @@ from .injector import (
     disable,
     get_injector,
 )
-from .scenarios import node_drain, pod_crash_burst
+from .scenarios import node_drain, pod_crash_burst, queue_spurious_evictions
 
 __all__ = [
     "Fault",
@@ -31,6 +32,7 @@ __all__ = [
     "KIND_CRASH",
     "KIND_DRAIN",
     "KIND_ERROR",
+    "KIND_EVICT",
     "KIND_LATENCY",
     "KIND_REFUSE",
     "KIND_SLOW",
@@ -40,4 +42,5 @@ __all__ = [
     "get_injector",
     "node_drain",
     "pod_crash_burst",
+    "queue_spurious_evictions",
 ]

@@ -34,6 +34,7 @@ just names):
 ``solver.stream``      solver bidi stream: mid-stream breaks, slow frames
 ``cluster.pod``        simulated kubelet: pod crash bursts
 ``cluster.node``       simulated cloud: node drain
+``queue.admission``    gang admission plane: admit-latency, spurious evict
 ================== ======================================================
 
 Spec grammar (CLI ``--inject`` / ``FaultInjector.from_spec``)::
@@ -65,6 +66,7 @@ KIND_BREAK = "break"      # solver.stream: break the stream mid-flight
 KIND_SLOW = "slow"        # solver.stream: delay the reply frame by `ms`
 KIND_CRASH = "crash"      # cluster.pod: crash the pod
 KIND_DRAIN = "drain"      # cluster.node: drain the node
+KIND_EVICT = "evict"      # queue.admission: spuriously evict/deny a gang
 
 
 @dataclass

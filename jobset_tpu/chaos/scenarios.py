@@ -13,7 +13,7 @@ from __future__ import annotations
 
 from typing import Optional
 
-from .injector import FaultInjector, KIND_CRASH, KIND_DRAIN
+from .injector import FaultInjector, KIND_CRASH, KIND_DRAIN, KIND_EVICT
 
 # Pod phases considered "live" for victim selection (mirrors
 # core/objects.py constants without importing the whole core package at
@@ -86,3 +86,47 @@ def node_drain(
         if rule is not None:
             injector.remove_rule(rule)
     return drained
+
+
+def queue_spurious_evictions(
+    cluster,
+    injector: FaultInjector,
+    rate: Optional[float] = None,
+) -> list[str]:
+    """Spuriously evict a deterministic subset of admitted gangs
+    (maintenance-preemption / quota-revocation analog).
+
+    Each admitted workload of the cluster's `QueueManager` is one arrival
+    at the ``queue.admission`` point, visited in sorted (namespace, name)
+    order; an ``evict`` fault re-suspends the gang and requeues it with
+    backoff through the manager's own eviction path — so recovery
+    (re-admission when eligible, Kueue-mutable merge on re-resume) is
+    exercised exactly as a real preemption would. Returns the evicted
+    JobSet names.
+    """
+    manager = getattr(cluster, "queue_manager", None)
+    if manager is None:
+        return []
+    rule = None
+    if rate is not None:
+        rule = injector.add_rule("queue.admission", KIND_EVICT, rate=rate)
+    evicted: list[str] = []
+    try:
+        admitted = sorted(
+            (wl for wl in manager.workloads.values()
+             if wl.state == "Admitted"),
+            key=lambda wl: wl.key,
+        )
+        for wl in admitted:
+            fault = injector.check(
+                "queue.admission", f"{wl.key[0]}/{wl.key[1]}"
+            )
+            if fault is not None and fault.kind == KIND_EVICT:
+                if manager.evict(
+                    wl.uid, message="chaos: spurious eviction"
+                ):
+                    evicted.append(wl.key[1])
+    finally:
+        if rule is not None:
+            injector.remove_rule(rule)
+    return evicted

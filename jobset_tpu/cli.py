@@ -51,6 +51,9 @@ def _build_parser() -> argparse.ArgumentParser:
                    help="gRPC address of a solver sidecar; empty = in-process solver")
     c.add_argument("--tick-interval", type=float, default=0.2,
                    help="background reconcile pump cadence in seconds")
+    c.add_argument("--queues", default="", metavar="FILE",
+                   help="YAML file of admission Queue manifests to create "
+                        "at startup (kind: Queue; docs/queueing.md)")
     c.add_argument("--topology", default="",
                    help="bootstrap a synthetic topology: KEY:DOMAINSxNODESxCAP "
                         "(e.g. cloud.google.com/gke-nodepool:8x4x16)")
@@ -107,7 +110,7 @@ def _build_parser() -> argparse.ArgumentParser:
 
     g = sub.add_parser("get", help="get jobsets / nodes / pods / jobs / events")
     g.add_argument("resource", choices=["jobsets", "jobset", "nodes", "pods", "jobs",
-                                        "services", "events"])
+                                        "services", "events", "queues", "queue"])
     g.add_argument("name", nargs="?")
     g.add_argument("-o", "--output", choices=["wide", "json", "yaml"], default="wide")
     g.add_argument(
@@ -194,6 +197,16 @@ def _cmd_controller(args) -> int:
             solve_budget_s=args.solve_budget or None,
         ),
     )
+
+    if args.queues:
+        import yaml as _yaml
+
+        from .queue.api import queue_from_dict
+
+        with open(args.queues) as f:
+            for doc in _yaml.safe_load_all(f.read()):
+                if isinstance(doc, dict) and doc.get("kind") == "Queue":
+                    cluster.queue_manager.create_queue(queue_from_dict(doc))
 
     if args.topology:
         key, _, shape = args.topology.partition(":")
@@ -306,12 +319,48 @@ def _cmd_get(args) -> int:
 
     client = _client(args)
     resource = "jobsets" if args.resource == "jobset" else args.resource
+    resource = "queues" if resource == "queue" else resource
 
     if getattr(args, "watch", False):
         if resource != "jobsets":
             print("--watch supports jobsets only", file=sys.stderr)
             return 2
         return _watch_jobsets(client, args)
+
+    if resource == "queues":
+        if args.name:
+            status = client.queue_status(args.name)
+            if args.output == "json":
+                print(json.dumps(status, indent=2))
+            elif args.output == "yaml":
+                print(_yaml.safe_dump(status, sort_keys=False))
+            else:
+                print(f"{'NAME':24} {'COHORT':12} {'PENDING':>8} "
+                      f"{'ADMITTED':>9}  USAGE/QUOTA")
+                usage = " ".join(
+                    f"{r}={status['usage'].get(r, 0):g}/{v:g}"
+                    for r, v in sorted(status["quota"].items())
+                )
+                print(f"{status['name']:24} {status['cohort'] or '-':12} "
+                      f"{status['pendingWorkloads']:>8} "
+                      f"{status['admittedWorkloads']:>9}  {usage}")
+            return 0
+        items = client.list_queues()
+        if args.output in ("json", "yaml"):
+            doc = {"items": items}
+            print(json.dumps(doc, indent=2) if args.output == "json"
+                  else _yaml.safe_dump(doc, sort_keys=False))
+            return 0
+        print(f"{'NAME':24} {'COHORT':12} {'WEIGHT':>7}  QUOTA")
+        for item in items:
+            spec = item.get("spec", {})
+            quota = " ".join(
+                f"{r}={v:g}" for r, v in sorted(spec.get("quota", {}).items())
+            )
+            print(f"{item['metadata']['name']:24} "
+                  f"{spec.get('cohort') or '-':12} "
+                  f"{spec.get('weight', 1.0):>7g}  {quota}")
+        return 0
 
     if resource == "jobsets" and args.name:
         raw = client.get_raw(args.name, args.namespace)

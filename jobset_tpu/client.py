@@ -362,6 +362,37 @@ class JobSetClient:
             patch.setdefault("spec", {})["taints"] = taints
         return self._request("PATCH", f"/api/v1/nodes/{name}", json.dumps(patch).encode())
 
+    # -- admission queues --------------------------------------------------
+
+    def create_queue(self, manifest: dict | str) -> dict:
+        """Create an admission queue from a manifest dict or YAML text
+        (kind: Queue; docs/queueing.md)."""
+        if isinstance(manifest, str):
+            body = manifest.encode()
+        else:
+            body = json.dumps(manifest).encode()
+        return self._request("POST", f"{self.API}/queues", body)
+
+    def list_queues(self) -> list[dict]:
+        return self._request("GET", f"{self.API}/queues")["items"]
+
+    def get_queue(self, name: str) -> dict:
+        return self._request("GET", f"{self.API}/queues/{name}")
+
+    def update_queue(self, name: str, manifest: dict | str) -> dict:
+        if isinstance(manifest, str):
+            body = manifest.encode()
+        else:
+            body = json.dumps(manifest).encode()
+        return self._request("PUT", f"{self.API}/queues/{name}", body)
+
+    def delete_queue(self, name: str) -> None:
+        self._request("DELETE", f"{self.API}/queues/{name}")
+
+    def queue_status(self, name: str) -> dict:
+        """Quota usage + pending/admitted workload list of one queue."""
+        return self._request("GET", f"{self.API}/queues/{name}/status")
+
     # -- infra ------------------------------------------------------------
 
     def healthz(self) -> bool:

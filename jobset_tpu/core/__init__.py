@@ -25,6 +25,7 @@ def make_cluster(
     """
     from ..placement import webhooks
     from ..placement.provider import SolverPlacement
+    from ..queue.manager import QueueManager
 
     cluster = Cluster(clock=clock, auto_ready=auto_ready)
     JobController(cluster)
@@ -33,6 +34,9 @@ def make_cluster(
         cluster, placement_provider=placement if placement is not None else SolverPlacement()
     )
     PodReconciler(cluster)
+    # Gang admission plane: inert until a queue is created and a JobSet
+    # names it (sync() is a no-op with no registered workloads).
+    QueueManager(cluster)
     cluster.pod_mutators.append(webhooks.mutate_pod)
     cluster.pod_validators.append(webhooks.validate_pod_create)
     return cluster

@@ -165,6 +165,10 @@ class Cluster:
         self.pod_reconciler = None
         self.job_controller = None
         self.scheduler = None
+        # Gang admission plane (queue.QueueManager attaches itself):
+        # intercepts queue-labeled JobSet creation and runs one admission
+        # pass per tick before the reconcile drain.
+        self.queue_manager = None
         # Pod webhook chain: callables(cluster, pod) -> None / raise AdmissionError.
         self.pod_mutators: list[Callable] = []
         self.pod_validators: list[Callable] = []
@@ -347,14 +351,26 @@ class Cluster:
         # populated status (e.g. round-tripped through the client) starts
         # fresh, exactly as with a real apiserver.
         js.status = JobSetStatus()
+        # Admission-queue interception (Kueue webhook analog): a JobSet
+        # naming a queue is forced suspended at creation and registered as
+        # a pending workload — the QueueManager resumes it on admission.
+        if self.queue_manager is not None and js.spec.queue_name:
+            self.queue_manager.intercept_create(js)
         self.jobsets[key] = js
         self.enqueue_reconcile(*key)
         # Admission-time plan prefetch: the placement solve is dispatched the
         # moment the JobSet is admitted and overlaps the watch->reconcile
         # hop, so the creation pass consumes a finished plan (provider.py).
+        # Queue-held JobSets skip it: they were just forced suspended and
+        # may wait arbitrarily long (or forever) for quota — the solve
+        # would be stale by admission and is requested by the creation
+        # pass itself when actually needed.
+        queue_held = self.queue_manager is not None and js.spec.queue_name
         reconciler = self.jobset_reconciler
-        if reconciler is not None and hasattr(
-            getattr(reconciler, "placement", None), "prepare"
+        if (
+            reconciler is not None
+            and not queue_held
+            and hasattr(getattr(reconciler, "placement", None), "prepare")
         ):
             reconciler.placement.prepare(self, js)
         return js
@@ -373,6 +389,11 @@ class Cluster:
         js.metadata.uid = old.metadata.uid
         js.metadata.creation_time = old.metadata.creation_time
         js.status = old.status
+        # Queue-managed workloads: suspend is controller-owned (a spec
+        # update must not resume an unadmitted gang; an explicit suspend of
+        # an admitted one is a voluntary requeue).
+        if self.queue_manager is not None:
+            self.queue_manager.enforce_update(old, js)
         self.jobsets[key] = js
         self.enqueue_reconcile(*key)
         return js
@@ -414,6 +435,9 @@ class Cluster:
         # A recreated JobSet under the same name starts with a clean
         # containment slate (and the per-key failure map stays bounded).
         self.reconcile_failures.pop(key, None)
+        # Release any admission-queue quota the gang held.
+        if self.queue_manager is not None:
+            self.queue_manager.forget(js.metadata.uid)
 
     def get_jobset(self, namespace: str, name: str) -> Optional[JobSet]:
         return self.jobsets.get((namespace, name))
@@ -815,10 +839,13 @@ class Cluster:
 
         from . import metrics
 
+        from ..utils.collections import capped_exponential_backoff
+
         failures = self.reconcile_failures.get(key, 0) + 1
         self.reconcile_failures[key] = failures
-        backoff = min(
-            self.RECONCILE_BACKOFF_BASE_S * (2 ** (failures - 1)),
+        backoff = capped_exponential_backoff(
+            failures,
+            self.RECONCILE_BACKOFF_BASE_S,
             self.RECONCILE_BACKOFF_CAP_S,
         )
         namespaced = f"{key[0]}/{key[1]}"
@@ -875,6 +902,12 @@ class Cluster:
                     ),
                 )
                 changed = True
+
+        # 0c. Gang admission plane: one batched admission pass (admit /
+        # preempt / backfill) whose suspend-flag flips are consumed by
+        # this same tick's reconcile drain below.
+        if self.queue_manager is not None:
+            changed |= self.queue_manager.sync()
 
         # 1. JobSet reconciler drains the work queue.
         while self.reconcile_queue:

@@ -15,6 +15,11 @@ from contextlib import contextmanager
 _DEFAULTS: dict[str, bool] = {
     # Batched linear-assignment placement solver on TPU (greedy is default).
     "TPUPlacementSolver": False,
+    # Batched JAX admission scorer for the gang queue plane (one jit call
+    # scores feasibility + priority/DRF over all pending candidates); the
+    # pure-Python greedy scorer is the default and produces identical
+    # admission decisions (queue/scorer.py).
+    "TPUQueueScorer": False,
 }
 
 _gates: dict[str, bool] = dict(_DEFAULTS)

@@ -216,6 +216,24 @@ chaos_injected_faults_total = Counter(
     "Faults injected by the chaos plane, per injection point",
     label_names=("point",),
 )
+# Gang admission queue plane (queue/manager.py): workload population per
+# queue plus the preemption counter the eviction path bumps.
+queue_pending_workloads = Gauge(
+    "jobset_queue_pending_workloads",
+    "Queue-managed JobSets waiting for admission, per queue",
+    label_names=("queue",),
+)
+queue_admitted_workloads = Gauge(
+    "jobset_queue_admitted_workloads",
+    "Queue-managed JobSets currently admitted (holding quota), per queue",
+    label_names=("queue",),
+)
+queue_preemptions_total = Counter(
+    "jobset_queue_preemptions_total",
+    "Admitted gangs evicted by the admission plane (priority preemption, "
+    "chaos spurious-evict), per queue",
+    label_names=("queue",),
+)
 
 
 ALL_COUNTERS = (
@@ -227,6 +245,7 @@ ALL_COUNTERS = (
     placement_budget_exceeded_total,
     reconcile_panics_total,
     chaos_injected_faults_total,
+    queue_preemptions_total,
 )
 ALL_HISTOGRAMS = (reconcile_time_seconds, solver_solve_time_seconds)
 ALL_GAUGES = (
@@ -235,6 +254,8 @@ ALL_GAUGES = (
     api_requests_in_flight,
     solver_breaker_state,
     placement_degraded,
+    queue_pending_workloads,
+    queue_admitted_workloads,
 )
 
 

@@ -428,5 +428,12 @@ class JobSetReconciler:
             job.spec.template.spec.tolerations = merge_slices(
                 job.spec.template.spec.tolerations, template.spec.tolerations
             )
+            # schedulingGates is the fifth Kueue-mutable field (the DWS
+            # integration mutates it while suspended); the reference
+            # merges it on resume alongside the other four.
+            job.spec.template.spec.scheduling_gates = merge_slices(
+                job.spec.template.spec.scheduling_gates,
+                template.spec.scheduling_gates,
+            )
         job.spec.suspend = False
         self.cluster.update_job(job)

@@ -1,0 +1,30 @@
+"""Gang admission queue plane: multi-tenant quota, DRF fair sharing,
+priority preemption, cohort borrowing, bounded backfill (docs/queueing.md).
+"""
+
+from .api import Queue, queue_from_dict, queue_to_dict, validate_queue
+from .manager import (
+    ADMITTED,
+    PENDING,
+    PODS_RESOURCE,
+    QueueManager,
+    Workload,
+    gang_request,
+)
+from .scorer import ScoreResult, Snapshot, score
+
+__all__ = [
+    "ADMITTED",
+    "PENDING",
+    "PODS_RESOURCE",
+    "Queue",
+    "QueueManager",
+    "ScoreResult",
+    "Snapshot",
+    "Workload",
+    "gang_request",
+    "queue_from_dict",
+    "queue_to_dict",
+    "score",
+    "validate_queue",
+]

@@ -1,0 +1,126 @@
+"""Queue API objects for the gang admission plane.
+
+A `Queue` is the Kueue LocalQueue/ClusterQueue analog collapsed into one
+object: a named admission queue with per-resource nominal quotas, a DRF
+fair-sharing weight, an optional cohort (queues in the same cohort may
+borrow each other's unused quota), and a bounded backfill depth (how many
+smaller gangs may be admitted past a blocked head-of-line workload).
+
+Queues are cluster-scoped (like ClusterQueues); JobSets reference one via
+`spec.queueName`. Wire format mirrors the k8s object shape so the server's
+CRUD endpoints read naturally:
+
+    apiVersion: jobset.x-k8s.io/v1alpha2
+    kind: Queue
+    metadata: {name: tenant-a}
+    spec:
+      quota: {pods: 16, tpu: 64}
+      weight: 2.0
+      cohort: shared
+      backfillDepth: 2
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..api.validation import DNS1123_LABEL_RE
+
+QUEUE_KIND = "Queue"
+
+
+@dataclass
+class Queue:
+    """One admission queue: nominal quotas + fair-share/borrowing config."""
+
+    name: str
+    # resource -> nominal quota. Every resource a workload requests must be
+    # quota'd here (a request for an undeclared resource is inadmissible),
+    # and every gang implicitly requests `pods`.
+    quota: dict[str, float] = field(default_factory=dict)
+    # DRF weight: a queue's dominant share is divided by this, so weight 2
+    # tolerates twice the usage before losing scheduling preference.
+    weight: float = 1.0
+    # Borrowing group: queues sharing a cohort may exceed their nominal
+    # quota up to the cohort's aggregate nominal while peers are idle.
+    cohort: str = ""
+    # Max gangs admitted past a blocked head-of-line workload per pass.
+    backfill_depth: int = 2
+
+    def clone(self) -> "Queue":
+        return Queue(
+            name=self.name,
+            quota=dict(self.quota),
+            weight=self.weight,
+            cohort=self.cohort,
+            backfill_depth=self.backfill_depth,
+        )
+
+
+def validate_queue(q: Queue) -> list[str]:
+    """Admission validation for queue create/update (empty == valid)."""
+    errs: list[str] = []
+    if not q.name or len(q.name) > 63 or not DNS1123_LABEL_RE.match(q.name):
+        errs.append(f"queue name must be a DNS-1123 label (got {q.name!r})")
+    if not q.quota:
+        errs.append("spec.quota must declare at least one resource")
+    for resource, value in q.quota.items():
+        if not resource:
+            errs.append("spec.quota resource names must be non-empty")
+        try:
+            if float(value) < 0:
+                errs.append(f"spec.quota[{resource!r}] must be >= 0")
+        except (TypeError, ValueError):
+            errs.append(f"spec.quota[{resource!r}] must be a number")
+    try:
+        if float(q.weight) <= 0:
+            errs.append("spec.weight must be > 0")
+    except (TypeError, ValueError):
+        errs.append("spec.weight must be a number")
+    if q.cohort and (
+        len(q.cohort) > 63 or not DNS1123_LABEL_RE.match(q.cohort)
+    ):
+        errs.append(f"spec.cohort must be a DNS-1123 label (got {q.cohort!r})")
+    try:
+        if int(q.backfill_depth) < 0:
+            errs.append("spec.backfillDepth must be >= 0")
+    except (TypeError, ValueError):
+        errs.append("spec.backfillDepth must be an integer")
+    return errs
+
+
+def queue_from_dict(d: dict) -> Queue:
+    """Build a Queue from its k8s-shaped manifest dict."""
+    if not isinstance(d, dict):
+        raise ValueError(f"queue manifest must be a mapping, got {type(d).__name__}")
+    kind = d.get("kind", QUEUE_KIND)
+    if kind != QUEUE_KIND:
+        raise ValueError(f"kind must be {QUEUE_KIND!r}, got {kind!r}")
+    meta = d.get("metadata") or {}
+    spec = d.get("spec") or {}
+    quota_raw = spec.get("quota") or {}
+    if not isinstance(quota_raw, dict):
+        raise ValueError("spec.quota must be a mapping of resource -> number")
+    return Queue(
+        name=meta.get("name", ""),
+        quota={str(k): float(v) for k, v in quota_raw.items()},
+        weight=float(spec.get("weight", 1.0)),
+        cohort=str(spec.get("cohort", "") or ""),
+        backfill_depth=int(spec.get("backfillDepth", 2)),
+    )
+
+
+def queue_to_dict(q: Queue) -> dict:
+    from ..api.serialization import API_VERSION
+
+    return {
+        "apiVersion": API_VERSION,
+        "kind": QUEUE_KIND,
+        "metadata": {"name": q.name},
+        "spec": {
+            "quota": {k: v for k, v in sorted(q.quota.items())},
+            "weight": q.weight,
+            "cohort": q.cohort,
+            "backfillDepth": q.backfill_depth,
+        },
+    }

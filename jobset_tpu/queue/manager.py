@@ -1,0 +1,711 @@
+"""Gang admission queue manager — the Kueue-style admission plane.
+
+The reference JobSet's suspend/resume semantics exist as the preemption/
+admission hook for queueing controllers (reconciler suspend handling +
+the Kueue-mutable-while-suspended validation carve-out); this module is
+the controller that actually drives them. JobSets carrying
+``spec.queueName`` are intercepted at creation (forced suspend =
+admit-later), their aggregate gang request is computed from the
+replicatedJobs, and an admission pass — run by the cluster tick before
+the reconcile drain — admits gangs all-or-nothing against queue quota:
+
+* **Gang semantics**: a workload is admitted atomically (the whole JobSet
+  resumes) or not at all; a partially-fitting gang stays fully suspended
+  with zero pods.
+* **DRF fair sharing**: queues are served in ascending weighted
+  dominant-share order (scorer.py), so underserved tenants admit first.
+* **Priority preemption**: a higher-priority pending workload that cannot
+  fit evicts the newest lowest-priority admitted workloads in its queue/
+  cohort (re-suspend + requeue with exponential backoff) until it fits.
+  The Kueue-mutable pod-template merge still happens on the eventual
+  re-resume, exactly like a first resume.
+* **Cohort borrowing**: queues sharing a cohort may exceed their nominal
+  quota using the cohort's free capacity.
+* **Bounded backfill**: when a queue's head-of-line workload is blocked,
+  up to ``backfill_depth`` smaller gangs behind it may be admitted
+  (non-preemptively) so small work is not starved by a stuck giant.
+
+The feasibility/score math over all pending candidates runs as ONE scorer
+call per pass — vectorized under `jax.jit` when the `TPUQueueScorer` gate
+is on, plain numpy otherwise, with identical decisions either way.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from ..api import keys
+from ..api.types import JobSet
+from .api import Queue, validate_queue
+from .scorer import ScoreResult, Snapshot, score
+
+PENDING = "Pending"
+ADMITTED = "Admitted"
+
+# Resource every gang implicitly requests: one unit per expected pod.
+PODS_RESOURCE = "pods"
+
+
+def gang_request(js: JobSet) -> dict[str, float]:
+    """Aggregate all-or-nothing resource request of one JobSet gang.
+
+    ``pods`` is the built-in resource (sum over replicatedJobs of
+    replicas * pods_expected). Additional per-pod resources come from the
+    pod template's opaque workload payload, e.g.
+    ``workload: {resources: {tpu: 4}}`` counts 4 TPU per pod of that
+    replicated job.
+    """
+    request: dict[str, float] = {PODS_RESOURCE: 0.0}
+    for rjob in js.spec.replicated_jobs:
+        pods = int(rjob.replicas) * rjob.template.spec.pods_expected()
+        request[PODS_RESOURCE] += pods
+        resources = rjob.template.spec.template.spec.workload.get("resources")
+        if isinstance(resources, dict):
+            for resource, per_pod in resources.items():
+                request[resource] = request.get(resource, 0.0) + float(
+                    per_pod
+                ) * pods
+    return request
+
+
+@dataclass
+class Workload:
+    """Queue-side record of one queue-managed JobSet."""
+
+    key: tuple[str, str]           # (namespace, name)
+    uid: str
+    queue: str
+    priority: int
+    request: dict[str, float]
+    arrival: int                   # monotonic submission sequence
+    state: str = PENDING
+    eligible_at: float = 0.0       # backoff gate (virtual clock)
+    backoff_count: int = 0
+    admitted_at: float = 0.0
+    preempted_count: int = 0
+    last_transition_msg: str = ""
+
+    def to_dict(self) -> dict:
+        return {
+            "namespace": self.key[0],
+            "name": self.key[1],
+            "queue": self.queue,
+            "priority": self.priority,
+            "request": dict(self.request),
+            "state": self.state,
+            "eligibleAt": self.eligible_at,
+            "backoffCount": self.backoff_count,
+            "preemptedCount": self.preempted_count,
+        }
+
+
+class QueueManager:
+    """Owns queue objects + workload admission state for one Cluster.
+
+    Single-threaded like the reconcile core: every entry point runs under
+    the cluster lock (HTTP handlers take it; the tick pump holds it).
+    """
+
+    # Requeue backoff after a preemption/eviction (workqueue rate-limiter
+    # analog): base * 2^(n-1), capped.
+    BACKOFF_BASE_S = 1.0
+    BACKOFF_CAP_S = 60.0
+
+    def __init__(self, cluster, injector=None):
+        self.cluster = cluster
+        # Chaos plane: consulted at the `queue.admission` point once per
+        # admission attempt; None falls through to the process-global
+        # injector (the CLI's --inject).
+        self.injector = injector
+        self.queues: dict[str, Queue] = {}
+        self.workloads: dict[str, Workload] = {}  # uid -> workload
+        self._arrival = itertools.count(1)
+        # Backfill accounting persists ACROSS passes while the same head
+        # stays blocked: queue -> (blocked head uid, gangs admitted past
+        # it). Reset when the head changes, admits, or goes away —
+        # without persistence every pass would grant a fresh backfill
+        # budget and the depth bound would be meaningless.
+        self._backfill_state: dict[str, tuple[str, int]] = {}
+        # Queue names with live gauge rows: rows for vanished queues (queue
+        # deleted AND its last workload gone) must be zeroed, not
+        # abandoned at their last value.
+        self._gauge_queues: set[str] = set()
+        cluster.queue_manager = self
+
+    # ------------------------------------------------------------------
+    # Queue CRUD (server endpoints call these under the cluster lock)
+    # ------------------------------------------------------------------
+
+    def create_queue(self, q: Queue) -> Queue:
+        from ..core.cluster import AdmissionError
+
+        if q.name in self.queues:
+            raise AdmissionError(f"queue {q.name} already exists")
+        errs = validate_queue(q)
+        if errs:
+            raise AdmissionError("; ".join(errs))
+        self.queues[q.name] = q
+        self._update_gauges()
+        return q
+
+    def update_queue(self, q: Queue) -> Queue:
+        from ..core.cluster import AdmissionError
+
+        if q.name not in self.queues:
+            raise AdmissionError(f"queue {q.name} not found")
+        errs = validate_queue(q)
+        if errs:
+            raise AdmissionError("; ".join(errs))
+        self.queues[q.name] = q
+        return q
+
+    def delete_queue(self, name: str) -> None:
+        from ..core.cluster import AdmissionError
+
+        if name not in self.queues:
+            raise AdmissionError(f"queue {name} not found")
+        del self.queues[name]
+        # Admitted workloads keep running (their quota simply stops being
+        # tracked); pending ones wait for the queue to reappear — the same
+        # inadmissible-not-rejected stance Kueue takes. The gauge refresh
+        # zeroes the rows once nothing references the name (its vanished-
+        # queue sweep), so deleted queues never export phantom workloads.
+        self._update_gauges()
+
+    def get_queue(self, name: str) -> Optional[Queue]:
+        return self.queues.get(name)
+
+    def queue_status(self, name: str) -> Optional[dict]:
+        q = self.queues.get(name)
+        if q is None:
+            return None
+        usage = self._usage().get(name, {})
+        workloads = sorted(
+            (w for w in self.workloads.values() if w.queue == name),
+            key=lambda w: w.arrival,
+        )
+        return {
+            "name": name,
+            "quota": dict(q.quota),
+            "cohort": q.cohort,
+            "weight": q.weight,
+            "usage": {r: usage.get(r, 0.0) for r in q.quota},
+            "pendingWorkloads": sum(
+                1 for w in workloads if w.state == PENDING
+            ),
+            "admittedWorkloads": sum(
+                1 for w in workloads if w.state == ADMITTED
+            ),
+            "workloads": [w.to_dict() for w in workloads],
+        }
+
+    # ------------------------------------------------------------------
+    # JobSet lifecycle hooks (called by Cluster)
+    # ------------------------------------------------------------------
+
+    def intercept_create(self, js: JobSet) -> None:
+        """Admission interception at JobSet creation: force suspend
+        (admit-later) and register the gang as a pending workload. The
+        forced suspend is what makes the workload Kueue-mutable while it
+        waits (validation's suspended carve-out)."""
+        js.spec.suspend = True
+        js.metadata.labels[keys.QUEUE_NAME_KEY] = js.spec.queue_name
+        wl = Workload(
+            key=(js.metadata.namespace, js.metadata.name),
+            uid=js.metadata.uid,
+            queue=js.spec.queue_name,
+            priority=int(js.spec.priority or 0),
+            request=gang_request(js),
+            arrival=next(self._arrival),
+        )
+        self.workloads[wl.uid] = wl
+        self.cluster.record_event(
+            "JobSet", js.name, keys.EVENT_NORMAL, keys.QUEUE_PENDING_REASON,
+            f"workload queued in {wl.queue} (request {_fmt(wl.request)})",
+        )
+        self._update_gauges()
+
+    def enforce_update(self, old: JobSet, new: JobSet) -> None:
+        """Suspend is controller-owned for queue-managed JobSets: a spec
+        update must not resume a workload the queue has not admitted. An
+        admitted workload that the user explicitly suspends is treated as a
+        voluntary requeue (quota released, no backoff penalty)."""
+        wl = self.workloads.get(old.metadata.uid)
+        if wl is None:
+            return
+        new.metadata.labels.setdefault(keys.QUEUE_NAME_KEY, wl.queue)
+        if wl.state == ADMITTED:
+            if new.spec.suspend:
+                wl.state = PENDING
+                wl.eligible_at = self.cluster.clock.now()
+                self.cluster.record_event(
+                    "JobSet", new.name, keys.EVENT_NORMAL,
+                    keys.QUEUE_REQUEUED_REASON,
+                    "voluntarily suspended; quota released and requeued",
+                )
+                self._update_gauges()
+            else:
+                new.spec.suspend = False
+        else:
+            new.spec.suspend = True
+
+    def forget(self, uid: str) -> None:
+        """Drop the workload record (JobSet deleted): quota frees on the
+        next admission pass."""
+        if self.workloads.pop(uid, None) is not None:
+            self._update_gauges()
+
+    def manages(self, uid: str) -> bool:
+        return uid in self.workloads
+
+    # ------------------------------------------------------------------
+    # Admission pass (cluster tick, before the reconcile drain)
+    # ------------------------------------------------------------------
+
+    def sync(self) -> bool:
+        """One admission pass; returns True when any state changed."""
+        if not self.workloads:
+            return False
+        from ..core.conditions import jobset_finished
+
+        cluster = self.cluster
+        now = cluster.clock.now()
+        changed = False
+
+        # 1. Reap: deleted JobSets are forgotten; finished ones release
+        # quota (the gang no longer holds capacity).
+        for uid, wl in list(self.workloads.items()):
+            js = cluster.jobsets.get(wl.key)
+            if js is None or js.metadata.uid != uid:
+                del self.workloads[uid]
+                changed = True
+                continue
+            if wl.state == ADMITTED and jobset_finished(js):
+                del self.workloads[uid]
+                cluster.record_event(
+                    "JobSet", wl.key[1], keys.EVENT_NORMAL,
+                    keys.QUEUE_RELEASED_REASON,
+                    f"finished; released {_fmt(wl.request)} back to "
+                    f"{wl.queue}",
+                )
+                changed = True
+
+        # 2. Candidates: pending workloads whose backoff has expired and
+        # whose queue exists.
+        candidates = sorted(
+            (
+                wl for wl in self.workloads.values()
+                if wl.state == PENDING
+                and wl.eligible_at <= now
+                and wl.queue in self.queues
+            ),
+            key=lambda w: w.arrival,
+        )
+        if not candidates:
+            self._update_gauges()
+            return changed
+
+        # 3. ONE batched scoring call over every pending candidate
+        # (vectorized feasibility + weighted DRF shares; jit under the
+        # TPUQueueScorer gate, numpy otherwise — identical outputs). The
+        # span makes the pass visible in /debug/traces next to the
+        # reconcile/solver phases it interleaves with.
+        from ..obs.trace import span as obs_span
+
+        with obs_span(
+            "queue.admission", {"candidates": len(candidates)}
+        ) as admission_span:
+            usage = self._usage()
+            snapshot = self._snapshot(candidates, usage)
+            result = score(snapshot)
+            admission_span.set_attribute("scorer_backend", result.backend)
+            changed |= self._select(candidates, usage, snapshot, result, now)
+        self._update_gauges()
+        return changed
+
+    # -- snapshot / usage ------------------------------------------------
+
+    def _usage(self) -> dict[str, dict[str, float]]:
+        usage: dict[str, dict[str, float]] = {}
+        for wl in self.workloads.values():
+            if wl.state != ADMITTED:
+                continue
+            qu = usage.setdefault(wl.queue, {})
+            for r, v in wl.request.items():
+                qu[r] = qu.get(r, 0.0) + v
+        return usage
+
+    def _snapshot(self, candidates, usage) -> Snapshot:
+        queue_names = sorted(self.queues)
+        qidx = {name: i for i, name in enumerate(queue_names)}
+        resources = sorted(
+            {r for q in self.queues.values() for r in q.quota}
+            | {r for wl in candidates for r in wl.request}
+        )
+        ridx = {r: i for i, r in enumerate(resources)}
+        Q, R, P = len(queue_names), len(resources), len(candidates)
+
+        nominal = np.zeros((Q, R), np.float32)
+        declared = np.zeros((Q, R), bool)
+        usage_arr = np.zeros((Q, R), np.float32)
+        weight = np.ones(Q, np.float32)
+        cohorts = sorted(
+            {q.cohort for q in self.queues.values() if q.cohort}
+        )
+        cidx = {c: i for i, c in enumerate(cohorts)}
+        cohort = np.full(Q, -1, np.int32)
+        for name, q in self.queues.items():
+            i = qidx[name]
+            weight[i] = q.weight
+            if q.cohort:
+                cohort[i] = cidx[q.cohort]
+            for r, v in q.quota.items():
+                nominal[i, ridx[r]] = v
+                declared[i, ridx[r]] = True
+            for r, v in usage.get(name, {}).items():
+                if r in ridx:
+                    usage_arr[i, ridx[r]] = v
+
+        request = np.zeros((P, R), np.float32)
+        queue_index = np.zeros(P, np.int32)
+        for p, wl in enumerate(candidates):
+            queue_index[p] = qidx[wl.queue]
+            for r, v in wl.request.items():
+                request[p, ridx[r]] = v
+
+        return Snapshot(
+            resources=resources,
+            queue_names=queue_names,
+            nominal=nominal,
+            declared=declared,
+            usage=usage_arr,
+            weight=weight,
+            cohort=cohort,
+            num_cohorts=len(cohorts),
+            request=request,
+            queue_index=queue_index,
+        )
+
+    # -- selection -------------------------------------------------------
+
+    def _select(
+        self,
+        candidates: list[Workload],
+        usage: dict[str, dict[str, float]],
+        snapshot: Snapshot,
+        result: ScoreResult,
+        now: float,
+    ) -> bool:
+        """Shared greedy selection over the scorer's output: serve queues
+        in ascending weighted-share order; within a queue, priority desc
+        then arrival asc; admit / preempt / backfill. Deterministic — the
+        ordering keys come entirely from the (backend-identical) scorer
+        output and integer workload fields."""
+        snapshot_feasible = {
+            id(wl): bool(result.feasible[p])
+            for p, wl in enumerate(candidates)
+        }
+        candidate_share = {
+            id(wl): float(result.candidate_share[p])
+            for p, wl in enumerate(candidates)
+        }
+        # Global consideration order: (queue weighted share asc, queue
+        # name, priority desc, arrival asc).
+        order = sorted(
+            candidates,
+            key=lambda wl: (
+                candidate_share[id(wl)],
+                wl.queue,
+                -wl.priority,
+                wl.arrival,
+            ),
+        )
+
+        # Drop stale backfill entries (head admitted, deleted, or no
+        # longer pending): the next block starts a fresh budget.
+        self._backfill_state = {
+            qname: (uid, used)
+            for qname, (uid, used) in self._backfill_state.items()
+            if self.workloads.get(uid) is not None
+            and self.workloads[uid].state == PENDING
+        }
+
+        blocked: set[str] = set()          # queues with a blocked head
+        evicted_any = False
+        changed = False
+
+        for wl in order:
+            q = self.queues[wl.queue]
+            if wl.queue in blocked:
+                _, used = self._backfill_state.get(wl.queue, ("", 0))
+                if used >= q.backfill_depth:
+                    continue
+            # Usage only grows within a pass until an eviction frees
+            # capacity, so until then the batched scorer's snapshot
+            # verdict is a sound fast-path: infeasible-then stays
+            # infeasible-now. After any eviction (or for feasible
+            # candidates, whose slot an earlier admit may have taken) the
+            # incremental recheck of the same predicate decides.
+            fits = (
+                snapshot_feasible[id(wl)] or evicted_any
+            ) and self._fits(q, wl.request, usage)
+            if fits:
+                if self._admit(wl, usage, now):
+                    changed = True
+                    if wl.queue in blocked:
+                        head_uid, used = self._backfill_state[wl.queue]
+                        self._backfill_state[wl.queue] = (head_uid, used + 1)
+                continue
+            # Doesn't fit. Head-of-line (first miss for this queue) may
+            # preempt; backfill candidates behind a blocked head may not.
+            if wl.queue not in blocked:
+                blocked.add(wl.queue)
+                prev = self._backfill_state.get(wl.queue)
+                if prev is None or prev[0] != wl.uid:
+                    # New blocked head: fresh backfill budget.
+                    self._backfill_state[wl.queue] = (wl.uid, 0)
+                victims = self._preemption_victims(wl, usage)
+                if victims is not None:
+                    # Chaos gate BEFORE any eviction: a fault injected on
+                    # this admission must delay/deny the preemptor alone,
+                    # never cascade into real evictions whose freed
+                    # capacity the blocked preemptor then can't take.
+                    if self._check_admission_chaos(wl, now):
+                        changed = True
+                        continue
+                    for victim in victims:
+                        self._evict(
+                            victim, now,
+                            reason=keys.QUEUE_PREEMPTED_REASON,
+                            message=(
+                                f"preempted by higher-priority "
+                                f"{wl.key[0]}/{wl.key[1]} "
+                                f"(priority {wl.priority} > "
+                                f"{victim.priority})"
+                            ),
+                            usage=usage,
+                        )
+                        evicted_any = True
+                        changed = True
+                    if self._fits(q, wl.request, usage) and self._admit(
+                        wl, usage, now, check_chaos=False
+                    ):
+                        changed = True
+                        blocked.discard(wl.queue)
+                        self._backfill_state.pop(wl.queue, None)
+        return changed
+
+    def _fits(
+        self,
+        q: Queue,
+        request: dict[str, float],
+        usage: dict[str, dict[str, float]],
+    ) -> bool:
+        """Incremental form of the scorer's feasibility predicate. Every
+        requested resource must be declared by the queue. A cohort-less
+        queue admits within its own nominal quota; a cohort member admits
+        within the cohort's aggregate free capacity (which both allows
+        borrowing past its own nominal and forbids overcommitting capacity
+        a peer has already borrowed)."""
+        qu = usage.get(q.name, {})
+        for r, v in request.items():
+            if v > 0 and r not in q.quota:
+                return False
+        if not q.cohort:
+            return all(
+                qu.get(r, 0.0) + v <= q.quota[r]
+                for r, v in request.items() if v > 0
+            )
+        members = [
+            m for m in self.queues.values() if m.cohort == q.cohort
+        ]
+        for r, v in request.items():
+            if v <= 0:
+                continue
+            cohort_free = sum(
+                m.quota.get(r, 0.0) - usage.get(m.name, {}).get(r, 0.0)
+                for m in members
+            )
+            if v > cohort_free:
+                return False
+        return True
+
+    def _preemption_victims(
+        self, wl: Workload, usage
+    ) -> Optional[list[Workload]]:
+        """Minimal victim set that makes `wl` fit, or None when preemption
+        cannot help. Victims are strictly-lower-priority admitted
+        workloads in the same queue (or same cohort — reclaiming borrowed
+        capacity), evicted newest-lowest-priority first. All-or-nothing:
+        no victim is evicted unless the full set frees enough."""
+        q = self.queues[wl.queue]
+        eligible = sorted(
+            (
+                v for v in self.workloads.values()
+                if v.state == ADMITTED
+                and v.priority < wl.priority
+                and (
+                    v.queue == wl.queue
+                    or (
+                        q.cohort
+                        and self.queues.get(v.queue) is not None
+                        and self.queues[v.queue].cohort == q.cohort
+                    )
+                )
+            ),
+            key=lambda v: (v.priority, -v.admitted_at, -v.arrival),
+        )
+        if not eligible:
+            return None
+        # Simulate evictions against a copy of the usage books.
+        trial = {name: dict(qu) for name, qu in usage.items()}
+        victims: list[Workload] = []
+        for victim in eligible:
+            if self._fits(q, wl.request, trial):
+                break
+            victims.append(victim)
+            vq = trial.setdefault(victim.queue, {})
+            for r, v in victim.request.items():
+                vq[r] = vq.get(r, 0.0) - v
+        if not self._fits(q, wl.request, trial):
+            return None
+        return victims
+
+    # -- state transitions -----------------------------------------------
+
+    def _check_admission_chaos(self, wl: Workload, now: float) -> bool:
+        """`queue.admission` injection point: one arrival per admission
+        attempt. A `latency` fault delays the admission by the fault's
+        delay on the VIRTUAL clock (the gang stays pending until the
+        clock passes it); an `evict` fault here denies the attempt and
+        requeues with backoff (spurious-evict on the admission path).
+        Returns True when the admission is blocked this pass."""
+        injector = self.injector
+        if injector is None:
+            from ..chaos import get_injector
+
+            injector = get_injector()
+        if injector is None:
+            return False
+        fault = injector.check(
+            "queue.admission", f"{wl.key[0]}/{wl.key[1]}"
+        )
+        if fault is None:
+            return False
+        from ..chaos.injector import KIND_EVICT, KIND_LATENCY
+
+        if fault.kind == KIND_LATENCY:
+            wl.eligible_at = now + fault.delay_s
+            return True
+        if fault.kind == KIND_EVICT:
+            self._backoff(wl, now)
+            return True
+        return False
+
+    def _admit(
+        self, wl: Workload, usage, now: float, check_chaos: bool = True
+    ) -> bool:
+        """Admit one gang: resume the JobSet (all child jobs resume in the
+        same reconcile pass — atomic gang admission) and charge quota.
+        check_chaos=False when the caller already consumed this admission
+        attempt's queue.admission arrival (the preemption path checks
+        before evicting; one draw per attempt keeps seeded runs aligned)."""
+        if check_chaos and self._check_admission_chaos(wl, now):
+            return False
+        cluster = self.cluster
+        js = cluster.jobsets.get(wl.key)
+        if js is None:
+            return False
+        wl.state = ADMITTED
+        wl.admitted_at = now
+        wl.backoff_count = 0
+        qu = usage.setdefault(wl.queue, {})
+        for r, v in wl.request.items():
+            qu[r] = qu.get(r, 0.0) + v
+        js.spec.suspend = False
+        cluster.enqueue_reconcile(*wl.key)
+        cluster.record_event(
+            "JobSet", wl.key[1], keys.EVENT_NORMAL,
+            keys.QUEUE_ADMITTED_REASON,
+            f"admitted to {wl.queue} (request {_fmt(wl.request)})",
+        )
+        return True
+
+    def _backoff(self, wl: Workload, now: float) -> None:
+        from ..utils.collections import capped_exponential_backoff
+
+        wl.backoff_count += 1
+        wl.eligible_at = now + capped_exponential_backoff(
+            wl.backoff_count, self.BACKOFF_BASE_S, self.BACKOFF_CAP_S
+        )
+
+    def _evict(
+        self,
+        victim: Workload,
+        now: float,
+        reason: str,
+        message: str,
+        usage=None,
+    ) -> None:
+        """Re-suspend an admitted gang and requeue it with backoff. The
+        resumed-again path later re-merges Kueue-mutable pod-template
+        fields, so mutations made while waiting are preserved."""
+        from ..core import metrics
+
+        cluster = self.cluster
+        js = cluster.jobsets.get(victim.key)
+        victim.state = PENDING
+        victim.preempted_count += 1
+        self._backoff(victim, now)
+        if usage is not None:
+            vq = usage.setdefault(victim.queue, {})
+            for r, v in victim.request.items():
+                vq[r] = vq.get(r, 0.0) - v
+        if js is not None:
+            js.spec.suspend = True
+            cluster.enqueue_reconcile(*victim.key)
+        metrics.queue_preemptions_total.inc(victim.queue)
+        cluster.record_event(
+            "JobSet", victim.key[1], keys.EVENT_WARNING, reason,
+            f"{message}; requeued with backoff "
+            f"({victim.eligible_at - now:.1f}s)",
+        )
+
+    def evict(self, uid: str, reason: str = keys.QUEUE_REQUEUED_REASON,
+              message: str = "evicted") -> bool:
+        """External eviction entry point (chaos scenarios, operators):
+        requeue one admitted workload with backoff."""
+        wl = self.workloads.get(uid)
+        if wl is None or wl.state != ADMITTED:
+            return False
+        self._evict(wl, self.cluster.clock.now(), reason, message)
+        self._update_gauges()
+        return True
+
+    # -- observability ----------------------------------------------------
+
+    def _update_gauges(self) -> None:
+        from ..core import metrics
+
+        counts: dict[str, list[int]] = {
+            name: [0, 0] for name in self.queues
+        }
+        for wl in self.workloads.values():
+            slot = counts.setdefault(wl.queue, [0, 0])
+            slot[0 if wl.state == PENDING else 1] += 1
+        # Zero rows whose queue vanished since the last update so /metrics
+        # never reports phantom workloads for a deleted queue.
+        for name in self._gauge_queues - set(counts):
+            counts[name] = [0, 0]
+        self._gauge_queues = {n for n, c in counts.items() if c != [0, 0]}
+        for name, (pending, admitted) in counts.items():
+            metrics.queue_pending_workloads.set(pending, name)
+            metrics.queue_admitted_workloads.set(admitted, name)
+
+
+def _fmt(request: dict[str, float]) -> str:
+    return ", ".join(f"{r}={v:g}" for r, v in sorted(request.items()))

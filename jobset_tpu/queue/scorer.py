@@ -1,0 +1,216 @@
+"""Batched admission scoring: feasibility + priority/DRF over all pending
+candidates in one shot.
+
+Mirrors the placement solver's design contract (`placement/solver.py`): the
+default path is plain Python/numpy; the `TPUQueueScorer` feature gate
+switches the same math to a single `jax.jit`-compiled call vectorized over
+every pending candidate, padded to power-of-two buckets so recompilation is
+rare. Both backends evaluate the identical float32 formulas, so the
+admission decisions downstream are bit-identical — the greedy path is a
+fallback, not an approximation (tests/test_queue.py asserts parity).
+
+What one scoring call computes, given a snapshot of the admission state:
+
+* ``feasible[p]`` — candidate p's gang request fits its queue right now,
+  either within the queue's own nominal quota or by borrowing the cohort's
+  free capacity (and every requested resource is actually quota'd).
+* ``queue_share[q]`` — the queue's weighted DRF dominant share:
+  ``max_r(usage[q,r] / cluster_nominal[r]) / weight[q]``. The admission
+  loop serves queues in ascending share order, so underserved tenants go
+  first (weighted dominant-resource fairness).
+
+Selection itself (the greedy admit/preempt/backfill loop) is shared Python
+in `queue/manager.py`; the scorer is the O(P*R + Q*R) inner product that
+benefits from batching when thousands of gangs are pending.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..core import features
+
+
+def _round_up_pow2(n: int, minimum: int = 8) -> int:
+    size = minimum
+    while size < n:
+        size *= 2
+    return size
+
+
+@dataclass
+class Snapshot:
+    """Dense arrays describing the admission state at one instant.
+
+    Built by QueueManager from its dict state; `resources` fixes the column
+    order, queue rows are sorted by name, candidate rows are the pending
+    workloads in arrival order.
+    """
+
+    resources: list[str]       # R column names
+    queue_names: list[str]     # Q row names (sorted)
+    nominal: np.ndarray        # [Q, R] float32 nominal quota (0 = undeclared)
+    declared: np.ndarray       # [Q, R] bool — resource explicitly quota'd
+    usage: np.ndarray          # [Q, R] float32 admitted usage
+    weight: np.ndarray         # [Q] float32 DRF weights
+    cohort: np.ndarray         # [Q] int32 cohort index, -1 = no cohort
+    num_cohorts: int
+    request: np.ndarray        # [P, R] float32 gang requests
+    queue_index: np.ndarray    # [P] int32 row into the queue arrays
+
+
+@dataclass
+class ScoreResult:
+    feasible: np.ndarray        # [P] bool
+    queue_share: np.ndarray     # [Q] float32 weighted dominant share
+    candidate_share: np.ndarray  # [P] float32 — its queue's share, gathered
+    backend: str                # "greedy" | "jax"
+
+
+def score(snapshot: Snapshot) -> ScoreResult:
+    """Score one snapshot with the gated backend."""
+    if snapshot.request.shape[0] == 0:
+        return ScoreResult(
+            feasible=np.zeros(0, bool),
+            queue_share=_greedy_share(snapshot),
+            candidate_share=np.zeros(0, np.float32),
+            backend="greedy",
+        )
+    if features.enabled("TPUQueueScorer"):
+        return _score_jax(snapshot)
+    return _score_greedy(snapshot)
+
+
+# ---------------------------------------------------------------------------
+# Greedy (default) backend — numpy float32, same formulas as the kernel.
+# ---------------------------------------------------------------------------
+
+
+def _greedy_share(snapshot: Snapshot) -> np.ndarray:
+    denom = np.maximum(
+        snapshot.nominal.sum(axis=0, dtype=np.float32), np.float32(1.0)
+    )
+    if snapshot.usage.shape[0] == 0:
+        return np.zeros(0, np.float32)
+    share = (snapshot.usage / denom).max(axis=1)
+    return (share / snapshot.weight).astype(np.float32)
+
+
+def _score_greedy(snapshot: Snapshot) -> ScoreResult:
+    qi = snapshot.queue_index
+    free = snapshot.nominal - snapshot.usage
+    own_fit = np.all(snapshot.request <= free[qi], axis=1)
+    covered = np.all(
+        (snapshot.request <= 0) | snapshot.declared[qi], axis=1
+    )
+
+    # Cohort aggregates: free capacity summed over each borrowing group. A
+    # cohort member's fit is judged against the COHORT free capacity (own
+    # nominal fit is neither sufficient — a peer may have borrowed this
+    # queue's headroom — nor necessary, borrowing).
+    C = max(snapshot.num_cohorts, 1)
+    cohort_free = np.zeros((C, snapshot.nominal.shape[1]), np.float32)
+    for q, c in enumerate(snapshot.cohort):
+        if c >= 0:
+            cohort_free[c] += free[q]
+    has_cohort = snapshot.cohort[qi] >= 0
+    cohort_fit = np.all(
+        snapshot.request <= cohort_free[np.maximum(snapshot.cohort[qi], 0)],
+        axis=1,
+    )
+
+    share = _greedy_share(snapshot)
+    return ScoreResult(
+        feasible=covered & np.where(has_cohort, cohort_fit, own_fit),
+        queue_share=share,
+        candidate_share=share[qi],
+        backend="greedy",
+    )
+
+
+# ---------------------------------------------------------------------------
+# JAX backend — the same math as one jit-compiled, padded, batched call.
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=1)
+def _jax():
+    import jax
+    import jax.numpy as jnp
+
+    return jax, jnp
+
+
+@functools.lru_cache(maxsize=8)
+def _kernel(P: int, Q: int, C: int, R: int):
+    """Build the jit kernel for one padded shape bucket."""
+    jax, jnp = _jax()
+
+    @jax.jit
+    def kernel(nominal, declared, usage, weight, cohort, request, qi):
+        # Weighted DRF dominant share per queue (padded rows: usage 0).
+        denom = jnp.maximum(nominal.sum(axis=0), 1.0)
+        share = (usage / denom).max(axis=1) / weight
+
+        free = nominal - usage
+        own_fit = jnp.all(request <= free[qi], axis=1)
+        covered = jnp.all((request <= 0) | declared[qi], axis=1)
+
+        # Cohort free capacity via segment-sum over the queue axis; -1
+        # (no cohort) rows are routed to a dummy trailing segment. Cohort
+        # members are judged against cohort free capacity (borrowing both
+        # ways); standalone queues against their own nominal.
+        seg = jnp.where(cohort >= 0, cohort, C)
+        cohort_free = jax.ops.segment_sum(free, seg, num_segments=C + 1)
+        has_cohort = cohort[qi] >= 0
+        cohort_fit = jnp.all(
+            request <= cohort_free[jnp.maximum(cohort[qi], 0)], axis=1
+        )
+
+        feasible = covered & jnp.where(has_cohort, cohort_fit, own_fit)
+        # Per-candidate fairness score: its queue's weighted share,
+        # gathered so the selection sort consumes one [P] vector.
+        return feasible, share, share[qi]
+
+    return kernel
+
+
+def _score_jax(snapshot: Snapshot) -> ScoreResult:
+    P0, R0 = snapshot.request.shape
+    Q0 = snapshot.nominal.shape[0]
+    P = _round_up_pow2(P0)
+    Q = _round_up_pow2(Q0)
+    R = _round_up_pow2(max(R0, 1), minimum=4)
+    C = _round_up_pow2(max(snapshot.num_cohorts, 1), minimum=4)
+
+    nominal = np.zeros((Q, R), np.float32)
+    nominal[:Q0, :R0] = snapshot.nominal
+    declared = np.zeros((Q, R), bool)
+    declared[:Q0, :R0] = snapshot.declared
+    usage = np.zeros((Q, R), np.float32)
+    usage[:Q0, :R0] = snapshot.usage
+    weight = np.ones(Q, np.float32)
+    weight[:Q0] = snapshot.weight
+    cohort = np.full(Q, -1, np.int32)
+    cohort[:Q0] = snapshot.cohort
+    # Padded candidates request an undeclared sentinel amount so they come
+    # back infeasible, and point at queue row 0 (their result is sliced
+    # away regardless).
+    request = np.full((P, R), np.float32(1.0))
+    request[:P0, :R0] = snapshot.request
+    request[:P0, R0:] = 0.0
+    qi = np.zeros(P, np.int32)
+    qi[:P0] = snapshot.queue_index
+
+    feasible, share, candidate_share = _kernel(P, Q, C, R)(
+        nominal, declared, usage, weight, cohort, request, qi
+    )
+    return ScoreResult(
+        feasible=np.asarray(feasible)[:P0],
+        queue_share=np.asarray(share)[:Q0].astype(np.float32),
+        candidate_share=np.asarray(candidate_share)[:P0].astype(np.float32),
+        backend="jax",
+    )

@@ -768,6 +768,9 @@ class ControllerServer:
     def _route_jobsets(self, method: str, parts: list[str], body: bytes):
         # parts: apis, jobset.x-k8s.io, v1alpha2, namespaces, {ns},
         #        jobsets[, name[, status]]
+        # Cluster-scoped admission queues: .../v1alpha2/queues[/{name}[/status]]
+        if len(parts) >= 4 and parts[3] == "queues":
+            return self._route_queues(method, parts, body)
         if len(parts) < 6 or parts[3] != "namespaces" or parts[5] != "jobsets":
             return 404, {"error": "unknown resource"}
         ns = parts[4]
@@ -864,6 +867,87 @@ class ControllerServer:
             self.cluster.delete_jobset(ns, name)
             self._reconcile_after_write()
             return 200, {"deleted": f"{ns}/{name}"}
+
+        return 405, {"error": f"{method} not allowed"}
+
+    def _route_queues(self, method: str, parts: list[str], body: bytes):
+        """Admission-queue CRUD + status (docs/queueing.md). Queues are
+        cluster-scoped (the ClusterQueue analog); the status endpoint
+        surfaces quota usage and the workload list."""
+        from .queue.api import queue_from_dict, queue_to_dict
+
+        manager = self.cluster.queue_manager
+        if manager is None:
+            return 404, {"error": "queueing is not enabled on this cluster"}
+        name = parts[4] if len(parts) > 4 else None
+
+        if len(parts) == 6 and parts[5] == "status" and name is not None:
+            if method != "GET":
+                return 405, {"error": "queue status supports GET only"}
+            status = manager.queue_status(name)
+            if status is None:
+                return 404, {"error": f"queue {name} not found"}
+            return 200, status
+
+        if method == "POST" and name is None:
+            try:
+                q = queue_from_dict(yaml.safe_load(body.decode()))
+            except Exception as exc:
+                return 400, {"error": f"bad queue manifest: {exc}"}
+            try:
+                created = manager.create_queue(q)
+            except AdmissionError as exc:
+                code = 409 if "already exists" in str(exc) else 422
+                return code, {"error": str(exc)}
+            # A new queue may make pending gangs admissible right away.
+            self._reconcile_after_write()
+            return 201, queue_to_dict(created)
+
+        if method == "GET" and name is None:
+            return 200, {
+                "apiVersion": serialization.API_VERSION,
+                "kind": "QueueList",
+                "items": [
+                    queue_to_dict(q)
+                    for _, q in sorted(manager.queues.items())
+                ],
+            }
+
+        if name is None:
+            return 405, {"error": f"{method} not allowed on collection"}
+
+        if method == "GET":
+            q = manager.get_queue(name)
+            if q is None:
+                return 404, {"error": f"queue {name} not found"}
+            return 200, queue_to_dict(q)
+
+        if method == "PUT":
+            try:
+                q = queue_from_dict(yaml.safe_load(body.decode()))
+            except Exception as exc:
+                return 400, {"error": f"bad queue manifest: {exc}"}
+            if q.name and q.name != name:
+                return 400, {"error": (
+                    f"manifest name {q.name!r} does not match request "
+                    f"name {name!r}"
+                )}
+            q.name = name
+            try:
+                stored = manager.update_queue(q)
+            except AdmissionError as exc:
+                code = 404 if "not found" in str(exc) else 422
+                return code, {"error": str(exc)}
+            self._reconcile_after_write()
+            return 200, queue_to_dict(stored)
+
+        if method == "DELETE":
+            try:
+                manager.delete_queue(name)
+            except AdmissionError as exc:
+                return 404, {"error": str(exc)}
+            self._reconcile_after_write()
+            return 200, {"deleted": name}
 
         return 405, {"error": f"{method} not allowed"}
 

@@ -155,6 +155,12 @@ class JobSetWrapper:
             self._js.metadata.annotations[keys.NODE_SELECTOR_STRATEGY_KEY] = "true"
         return self
 
+    def queue(self, queue_name: str, priority: int = 0) -> "JobSetWrapper":
+        """Submit through an admission queue (queue/ subsystem)."""
+        self._js.spec.queue_name = queue_name
+        self._js.spec.priority = priority
+        return self
+
     def obj(self) -> JobSet:
         return self._js
 

@@ -24,3 +24,13 @@ def merge_slices(a: Optional[Iterable[T]], b: Optional[Iterable[T]]) -> list[T]:
         if item not in out:
             out.append(item)
     return out
+
+
+def capped_exponential_backoff(
+    failures: int, base_s: float, cap_s: float
+) -> float:
+    """`base * 2^(n-1)`, capped — the workqueue
+    ItemExponentialFailureRateLimiter curve shared by reconcile-error
+    containment (core/cluster.py) and queue requeue backoff
+    (queue/manager.py)."""
+    return min(base_s * (2 ** (failures - 1)), cap_s)

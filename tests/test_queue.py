@@ -1,0 +1,601 @@
+"""Gang admission queue plane (jobset_tpu/queue/, docs/queueing.md).
+
+Covers the acceptance contract end to end: a full-quota queue holds a
+3-replicatedJob JobSet fully suspended (zero pods), admission resumes all
+child jobs atomically, a higher-priority arrival preempts the
+lowest-priority admitted workload (re-suspend + backoff requeue +
+re-admission when quota frees), and the JAX-batched scorer produces
+decisions identical to the greedy fallback on the same snapshots — plus
+DRF fairness, cohort borrowing, bounded backfill, the queue.admission
+chaos point, and the queue HTTP surface.
+"""
+
+import numpy as np
+import pytest
+
+from jobset_tpu.api import keys
+from jobset_tpu.chaos import FaultInjector, queue_spurious_evictions
+from jobset_tpu.core import features, make_cluster, metrics
+from jobset_tpu.core.cluster import AdmissionError
+from jobset_tpu.queue import (
+    ADMITTED,
+    PENDING,
+    Queue,
+    gang_request,
+    score,
+)
+from jobset_tpu.queue.scorer import Snapshot
+from jobset_tpu.testing import make_jobset, make_replicated_job
+
+
+def queued_jobset(name, pods, queue="tenant-a", priority=0, workload=None):
+    rj = (
+        make_replicated_job("w").replicas(pods).parallelism(1).completions(1)
+    )
+    if workload:
+        rj = rj.workload(workload)
+    return (
+        make_jobset(name)
+        .replicated_job(rj.obj())
+        .queue(queue, priority=priority)
+        .obj()
+    )
+
+
+def three_rjob_gang(name, queue="tenant-a", priority=1):
+    """driver(1x1) + workers(2x2) + ps(1x2) = 7 pods across 3 rjobs."""
+    return (
+        make_jobset(name)
+        .replicated_job(
+            make_replicated_job("driver").replicas(1).parallelism(1)
+            .completions(1).obj()
+        )
+        .replicated_job(
+            make_replicated_job("workers").replicas(2).parallelism(2)
+            .completions(2).obj()
+        )
+        .replicated_job(
+            make_replicated_job("ps").replicas(1).parallelism(2)
+            .completions(2).obj()
+        )
+        .queue(queue, priority=priority)
+        .obj()
+    )
+
+
+@pytest.fixture()
+def cluster():
+    metrics.reset()
+    c = make_cluster()
+    c.add_topology("rack", num_domains=4, nodes_per_domain=4, capacity=16)
+    return c
+
+
+# ---------------------------------------------------------------------------
+# Queue CRUD + request math
+# ---------------------------------------------------------------------------
+
+
+def test_queue_validation_rejects_bad_specs(cluster):
+    qm = cluster.queue_manager
+    with pytest.raises(AdmissionError, match="DNS-1123"):
+        qm.create_queue(Queue(name="Bad_Name", quota={"pods": 1}))
+    with pytest.raises(AdmissionError, match="at least one resource"):
+        qm.create_queue(Queue(name="empty", quota={}))
+    with pytest.raises(AdmissionError, match=">= 0"):
+        qm.create_queue(Queue(name="neg", quota={"pods": -1}))
+    with pytest.raises(AdmissionError, match="weight"):
+        qm.create_queue(Queue(name="w", quota={"pods": 1}, weight=0))
+    qm.create_queue(Queue(name="ok", quota={"pods": 1}))
+    with pytest.raises(AdmissionError, match="already exists"):
+        qm.create_queue(Queue(name="ok", quota={"pods": 2}))
+
+
+def test_gang_request_aggregates_pods_and_custom_resources():
+    js = three_rjob_gang("g")
+    assert gang_request(js) == {"pods": 7.0}
+    js2 = queued_jobset("t", 4, workload={"resources": {"tpu": 8}})
+    assert gang_request(js2) == {"pods": 4.0, "tpu": 32.0}
+
+
+def test_jobset_queue_fields_validated_and_immutable(cluster):
+    with pytest.raises(AdmissionError, match="DNS-1123"):
+        cluster.create_jobset(queued_jobset("x", 1, queue="Not_Valid"))
+    cluster.queue_manager.create_queue(Queue(name="q", quota={"pods": 4}))
+    js = cluster.create_jobset(queued_jobset("x", 1, queue="q", priority=3))
+    moved = js.clone()
+    moved.spec.queue_name = "other"
+    with pytest.raises(AdmissionError, match="queueName.*immutable"):
+        cluster.update_jobset(moved)
+    bumped = js.clone()
+    bumped.spec.priority = 99
+    with pytest.raises(AdmissionError, match="priority.*immutable"):
+        cluster.update_jobset(bumped)
+
+
+# ---------------------------------------------------------------------------
+# Acceptance: gang semantics end to end (both scorer backends)
+# ---------------------------------------------------------------------------
+
+
+def _run_gang_scenario(gate: bool) -> list[tuple[str, str]]:
+    """The acceptance scenario; returns the ordered (reason, jobset)
+    queue-event stream so backends can be compared decision-for-decision."""
+    metrics.reset()
+    cluster = make_cluster()
+    cluster.add_topology("rack", num_domains=4, nodes_per_domain=4, capacity=16)
+    qm = cluster.queue_manager
+    qm.create_queue(Queue(name="tenant-a", quota={"pods": 8}))
+
+    with features.gate("TPUQueueScorer", gate):
+        # Fill the queue to capacity.
+        filler = cluster.create_jobset(queued_jobset("filler", 8, priority=0))
+        cluster.run_until_stable()
+        assert qm.workloads[filler.metadata.uid].state == ADMITTED
+        assert len(cluster.pods) == 8
+
+        # Full-quota queue holds the 3-rjob gang FULLY suspended: child
+        # jobs exist (suspended), zero pods created. Same priority as the
+        # filler, so it must wait (preemption needs STRICTLY higher).
+        gang = cluster.create_jobset(three_rjob_gang("gang", priority=0))
+        cluster.run_until_stable()
+        assert gang.spec.suspend is True
+        gang_jobs = cluster.jobs_for_jobset(gang)
+        assert len(gang_jobs) == 4  # 1 driver + 2 workers + 1 ps
+        assert all(j.suspended() for j in gang_jobs)
+        assert len(cluster.pods) == 8  # filler's only — zero for the gang
+        assert qm.workloads[gang.metadata.uid].state == PENDING
+
+        # Quota frees -> the whole gang resumes atomically in one
+        # stabilization (all 3 replicated jobs, all pods).
+        cluster.complete_all_jobs(filler)
+        cluster.run_until_stable()
+        assert qm.workloads[gang.metadata.uid].state == ADMITTED
+        assert gang.spec.suspend is False
+        gang_jobs = cluster.jobs_for_jobset(gang)
+        assert all(not j.suspended() for j in gang_jobs)
+        live = [
+            p for p in cluster.pods.values()
+            if p.status.phase in ("Pending", "Running")
+        ]
+        assert len(live) == 7
+
+        # Higher-priority arrival preempts the lowest-priority admitted
+        # workload: the gang is re-suspended and requeued with backoff.
+        hi = cluster.create_jobset(queued_jobset("hi", 8, priority=10))
+        cluster.run_until_stable()
+        assert qm.workloads[hi.metadata.uid].state == ADMITTED
+        wl = qm.workloads[gang.metadata.uid]
+        assert wl.state == PENDING
+        assert wl.backoff_count == 1
+        assert wl.eligible_at > cluster.clock.now()
+        assert gang.spec.suspend is True
+        assert all(j.suspended() for j in cluster.jobs_for_jobset(gang))
+        live = [
+            p for p in cluster.pods.values()
+            if p.status.phase in ("Pending", "Running")
+        ]
+        assert len(live) == 8  # hi's pods only
+        assert metrics.queue_preemptions_total.value("tenant-a") == 1
+
+        # Not re-admitted before the backoff expires, even with quota free.
+        cluster.complete_all_jobs(hi)
+        cluster.run_until_stable()
+        assert qm.workloads[gang.metadata.uid].state == PENDING
+
+        # Backoff expiry + free quota -> re-admitted.
+        cluster.clock.advance(2.0)
+        cluster.run_until_stable()
+        assert qm.workloads[gang.metadata.uid].state == ADMITTED
+        assert all(not j.suspended() for j in cluster.jobs_for_jobset(gang))
+
+    return [
+        (e.reason, e.object_name)
+        for e in cluster.events
+        if e.reason.startswith("Queue")
+    ]
+
+
+def test_gang_admission_preemption_requeue_greedy():
+    events = _run_gang_scenario(gate=False)
+    assert (keys.QUEUE_PREEMPTED_REASON, "gang") in events
+    assert events.count((keys.QUEUE_ADMITTED_REASON, "gang")) == 2
+
+
+def test_gang_admission_preemption_requeue_jax_scorer():
+    events = _run_gang_scenario(gate=True)
+    assert (keys.QUEUE_PREEMPTED_REASON, "gang") in events
+
+
+def test_scorer_backends_make_identical_decisions_end_to_end():
+    """The full scripted scenario — admissions, preemption, backoff,
+    re-admission — must produce the identical ordered decision stream
+    under the greedy and jit-batched scorers."""
+    assert _run_gang_scenario(gate=False) == _run_gang_scenario(gate=True)
+
+
+def test_scorer_parity_on_randomized_snapshots():
+    """Direct parity at the scorer contract: identical feasibility and
+    identical (bit-for-bit) weighted shares on the same snapshot."""
+    rng = np.random.default_rng(11)
+    for trial in range(5):
+        Q = int(rng.integers(1, 20))
+        R = int(rng.integers(1, 5))
+        P = int(rng.integers(1, 60))
+        C = int(rng.integers(1, 4))
+        declared = rng.random((Q, R)) > 0.2
+        snap = Snapshot(
+            resources=[f"r{i}" for i in range(R)],
+            queue_names=[f"q{i}" for i in range(Q)],
+            nominal=(rng.integers(0, 64, (Q, R)) * declared).astype(
+                np.float32
+            ),
+            declared=declared,
+            usage=rng.integers(0, 32, (Q, R)).astype(np.float32),
+            weight=rng.integers(1, 5, Q).astype(np.float32),
+            cohort=rng.integers(-1, C, Q).astype(np.int32),
+            num_cohorts=C,
+            request=rng.integers(0, 16, (P, R)).astype(np.float32),
+            queue_index=rng.integers(0, Q, P).astype(np.int32),
+        )
+        greedy = score(snap)
+        with features.gate("TPUQueueScorer", True):
+            jit = score(snap)
+        assert greedy.backend == "greedy" and jit.backend == "jax"
+        assert np.array_equal(greedy.feasible, jit.feasible), trial
+        assert np.array_equal(greedy.queue_share, jit.queue_share), trial
+        assert np.array_equal(
+            greedy.candidate_share, jit.candidate_share
+        ), trial
+
+
+# ---------------------------------------------------------------------------
+# Fair sharing, borrowing, backfill
+# ---------------------------------------------------------------------------
+
+
+def test_cohort_borrowing_admits_past_nominal_quota(cluster):
+    qm = cluster.queue_manager
+    qm.create_queue(Queue(name="qa", quota={"pods": 4}, cohort="shared"))
+    qm.create_queue(Queue(name="qb", quota={"pods": 4}, cohort="shared"))
+    # qa requests 6 > its nominal 4, but the cohort has 8 free.
+    js = cluster.create_jobset(queued_jobset("borrower", 6, queue="qa"))
+    cluster.run_until_stable()
+    assert qm.workloads[js.metadata.uid].state == ADMITTED
+    # A qb workload needing its full nominal no longer fits (borrowed).
+    js2 = cluster.create_jobset(queued_jobset("squeezed", 4, queue="qb"))
+    cluster.run_until_stable()
+    assert qm.workloads[js2.metadata.uid].state == PENDING
+
+
+def test_no_borrowing_without_cohort(cluster):
+    qm = cluster.queue_manager
+    qm.create_queue(Queue(name="qa", quota={"pods": 4}))
+    qm.create_queue(Queue(name="qb", quota={"pods": 4}))
+    js = cluster.create_jobset(queued_jobset("big", 6, queue="qa"))
+    cluster.run_until_stable()
+    assert qm.workloads[js.metadata.uid].state == PENDING
+
+
+def test_undeclared_resource_is_inadmissible(cluster):
+    qm = cluster.queue_manager
+    qm.create_queue(Queue(name="qa", quota={"pods": 8}))
+    js = cluster.create_jobset(
+        queued_jobset("tpu-job", 2, queue="qa",
+                      workload={"resources": {"tpu": 4}})
+    )
+    cluster.run_until_stable()
+    assert qm.workloads[js.metadata.uid].state == PENDING
+    # Declaring the resource makes it admissible.
+    qm.update_queue(Queue(name="qa", quota={"pods": 8, "tpu": 8}))
+    cluster.run_until_stable()
+    assert qm.workloads[js.metadata.uid].state == ADMITTED
+
+
+def test_drf_fair_sharing_serves_underserved_queue_first(cluster):
+    """qa is saturated; the cohort's remaining capacity must go to the
+    underserved qb candidate even though qa's candidate arrived first."""
+    qm = cluster.queue_manager
+    qm.create_queue(Queue(name="qa", quota={"pods": 6}, cohort="shared"))
+    qm.create_queue(Queue(name="qb", quota={"pods": 6}, cohort="shared"))
+    full = cluster.create_jobset(queued_jobset("qa-full", 6, queue="qa"))
+    cluster.run_until_stable()
+    assert qm.workloads[full.metadata.uid].state == ADMITTED
+
+    # Both pending: qa wants to borrow 2, qb wants its own 6. Created in
+    # qa-first order; DRF (qa share 1.0 > qb share 0.0) serves qb first,
+    # which exhausts the cohort's free capacity.
+    a = cluster.create_jobset(queued_jobset("qa-borrow", 2, queue="qa"))
+    b = cluster.create_jobset(queued_jobset("qb-own", 6, queue="qb"))
+    cluster.run_until_stable()
+    assert qm.workloads[b.metadata.uid].state == ADMITTED
+    assert qm.workloads[a.metadata.uid].state == PENDING
+
+
+def test_backfill_is_bounded_by_depth(cluster):
+    qm = cluster.queue_manager
+    qm.create_queue(
+        Queue(name="qa", quota={"pods": 4}, backfill_depth=1)
+    )
+    big = cluster.create_jobset(queued_jobset("big", 6, queue="qa", priority=5))
+    s1 = cluster.create_jobset(queued_jobset("small1", 2, queue="qa"))
+    s2 = cluster.create_jobset(queued_jobset("small2", 2, queue="qa"))
+    cluster.run_until_stable()
+    qm_wl = qm.workloads
+    # The blocked 6-pod head admits nothing; exactly ONE small gang
+    # backfills past it (depth=1), the second stays pending.
+    assert qm_wl[big.metadata.uid].state == PENDING
+    states = sorted(
+        (qm_wl[s1.metadata.uid].state, qm_wl[s2.metadata.uid].state)
+    )
+    assert states == [ADMITTED, PENDING]
+
+
+def test_backfill_depth_zero_blocks_strictly(cluster):
+    qm = cluster.queue_manager
+    qm.create_queue(Queue(name="qa", quota={"pods": 4}, backfill_depth=0))
+    cluster.create_jobset(queued_jobset("big", 6, queue="qa", priority=5))
+    s1 = cluster.create_jobset(queued_jobset("small", 2, queue="qa"))
+    cluster.run_until_stable()
+    assert qm.workloads[s1.metadata.uid].state == PENDING
+
+
+def test_preemption_is_all_or_nothing(cluster):
+    """When evicting every lower-priority workload still cannot fit the
+    candidate, nothing is evicted (no wasted preemptions)."""
+    qm = cluster.queue_manager
+    qm.create_queue(Queue(name="qa", quota={"pods": 8}))
+    low = cluster.create_jobset(queued_jobset("low", 4, queue="qa", priority=0))
+    cluster.run_until_stable()
+    # 12 > 8 nominal: infeasible even with `low` evicted.
+    cluster.create_jobset(queued_jobset("huge", 12, queue="qa", priority=10))
+    cluster.run_until_stable()
+    assert qm.workloads[low.metadata.uid].state == ADMITTED
+    assert metrics.queue_preemptions_total.value("qa") == 0
+
+
+# ---------------------------------------------------------------------------
+# Lifecycle edges
+# ---------------------------------------------------------------------------
+
+
+def test_deleting_jobset_releases_quota(cluster):
+    qm = cluster.queue_manager
+    qm.create_queue(Queue(name="qa", quota={"pods": 4}))
+    first = cluster.create_jobset(queued_jobset("first", 4, queue="qa"))
+    second = cluster.create_jobset(queued_jobset("second", 4, queue="qa"))
+    cluster.run_until_stable()
+    assert qm.workloads[second.metadata.uid].state == PENDING
+    cluster.delete_jobset("default", "first")
+    cluster.run_until_stable()
+    assert first.metadata.uid not in qm.workloads
+    assert qm.workloads[second.metadata.uid].state == ADMITTED
+
+
+def test_voluntary_suspend_of_admitted_workload_requeues(cluster):
+    qm = cluster.queue_manager
+    qm.create_queue(Queue(name="qa", quota={"pods": 4}))
+    js = cluster.create_jobset(queued_jobset("wl", 4, queue="qa"))
+    cluster.run_until_stable()
+    assert qm.workloads[js.metadata.uid].state == ADMITTED
+
+    stored = cluster.get_jobset("default", "wl")
+    suspended = stored.clone()
+    suspended.spec.suspend = True
+    cluster.update_jobset(suspended)
+    cluster.run_until_stable()
+    wl = qm.workloads[stored.metadata.uid]
+    # Voluntary: requeued without backoff penalty, quota released; it
+    # fits again immediately so the next pass re-admits it.
+    assert wl.state == ADMITTED
+    reasons = [e.reason for e in cluster.events]
+    assert keys.QUEUE_REQUEUED_REASON in reasons
+
+
+def test_update_cannot_resume_unadmitted_gang(cluster):
+    qm = cluster.queue_manager
+    qm.create_queue(Queue(name="qa", quota={"pods": 2}))
+    js = cluster.create_jobset(queued_jobset("held", 4, queue="qa"))
+    cluster.run_until_stable()
+    assert qm.workloads[js.metadata.uid].state == PENDING
+
+    resumed = cluster.get_jobset("default", "held").clone()
+    resumed.spec.suspend = False
+    cluster.update_jobset(resumed)
+    cluster.run_until_stable()
+    stored = cluster.get_jobset("default", "held")
+    assert stored.spec.suspend is True  # controller-owned
+    assert cluster.pods == {}
+
+
+def test_queue_gauges_track_population(cluster):
+    qm = cluster.queue_manager
+    qm.create_queue(Queue(name="qa", quota={"pods": 4}))
+    cluster.create_jobset(queued_jobset("a", 4, queue="qa"))
+    cluster.create_jobset(queued_jobset("b", 4, queue="qa"))
+    cluster.run_until_stable()
+    assert metrics.queue_admitted_workloads.value("qa") == 1
+    assert metrics.queue_pending_workloads.value("qa") == 1
+
+
+def test_kueue_mutation_while_queued_merges_on_admission(cluster):
+    """The Kueue contract through the queue plane: mutate pod-template
+    fields while the gang waits (suspended); admission's resume must merge
+    them into the child jobs."""
+    for node in cluster.nodes.values():
+        node.labels["pool"] = (
+            "reserved" if "domain-1" in node.name else "spot"
+        )
+    qm = cluster.queue_manager
+    qm.create_queue(Queue(name="qa", quota={"pods": 4}))
+    filler = cluster.create_jobset(queued_jobset("filler", 4, queue="qa"))
+    held = cluster.create_jobset(queued_jobset("held", 4, queue="qa"))
+    cluster.run_until_stable()
+    assert qm.workloads[held.metadata.uid].state == PENDING
+
+    # Kueue-style mutation while suspended (allowed by the validation
+    # carve-out BECAUSE the queue forced suspend=true).
+    updated = cluster.get_jobset("default", "held").clone()
+    for rjob in updated.spec.replicated_jobs:
+        tmpl = rjob.template.spec.template
+        tmpl.spec.node_selector["pool"] = "reserved"
+        tmpl.labels["team"] = "ml"
+    cluster.update_jobset(updated)
+
+    cluster.complete_all_jobs(filler)
+    cluster.run_until_stable()
+    assert qm.workloads[held.metadata.uid].state == ADMITTED
+    for job in cluster.jobs_for_jobset(held):
+        assert job.spec.template.spec.node_selector["pool"] == "reserved"
+        assert job.spec.template.labels["team"] == "ml"
+    for pod in cluster.pods.values():
+        if pod.labels.get(keys.JOBSET_NAME_KEY) == "held" and pod.spec.node_name:
+            assert cluster.nodes[pod.spec.node_name].labels["pool"] == "reserved"
+
+
+# ---------------------------------------------------------------------------
+# Chaos: queue.admission injection point
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_admit_latency_delays_admission():
+    metrics.reset()
+    injector = FaultInjector(seed=1)
+    injector.add_rule("queue.admission", "latency", rate=1.0,
+                      delay_s=5.0, times=1)
+    cluster = make_cluster()
+    cluster.add_topology("rack", num_domains=2, nodes_per_domain=4, capacity=16)
+    cluster.queue_manager.injector = injector
+    cluster.queue_manager.create_queue(Queue(name="qa", quota={"pods": 4}))
+    js = cluster.create_jobset(queued_jobset("wl", 2, queue="qa"))
+    cluster.run_until_stable()
+    wl = cluster.queue_manager.workloads[js.metadata.uid]
+    # The injected admit-latency pushed eligibility out on the virtual
+    # clock; quota was free the whole time.
+    assert wl.state == PENDING
+    assert wl.eligible_at == pytest.approx(5.0)
+    assert injector.injected_total("queue.admission") == 1
+    cluster.clock.advance(5.0)
+    cluster.run_until_stable()
+    assert wl.state == ADMITTED
+
+
+def test_chaos_spurious_evict_recovers_with_backoff():
+    metrics.reset()
+    injector = FaultInjector(seed=3)
+    cluster = make_cluster()
+    cluster.add_topology("rack", num_domains=2, nodes_per_domain=4, capacity=16)
+    qm = cluster.queue_manager
+    qm.injector = injector
+    qm.create_queue(Queue(name="qa", quota={"pods": 4}))
+    js = cluster.create_jobset(queued_jobset("wl", 2, queue="qa"))
+    cluster.run_until_stable()
+    assert qm.workloads[js.metadata.uid].state == ADMITTED
+
+    evicted = queue_spurious_evictions(cluster, injector, rate=1.0)
+    assert evicted == ["wl"]
+    wl = qm.workloads[js.metadata.uid]
+    assert wl.state == PENDING and wl.backoff_count == 1
+    assert metrics.queue_preemptions_total.value("qa") == 1
+    cluster.run_until_stable()
+    assert all(j.suspended() for j in cluster.jobs_for_jobset(js))
+
+    cluster.clock.advance(2.0)
+    cluster.run_until_stable()
+    assert wl.state == ADMITTED
+
+
+def test_malformed_queue_fields_are_validation_errors_not_crashes():
+    """A manifest smuggling a non-string queueName or non-integer priority
+    must come back as a validation error (422 on the wire), never an
+    unhandled exception (500)."""
+    from jobset_tpu.api import apply_defaults, validate_create
+
+    js = queued_jobset("bad", 1)
+    js.spec.priority = "high"
+    errs = validate_create(apply_defaults(js))
+    assert any("priority must be an integer" in e for e in errs), errs
+    js2 = queued_jobset("bad2", 1)
+    js2.spec.queue_name = {"not": "a-string"}
+    errs = validate_create(apply_defaults(js2))
+    assert any("queueName" in e for e in errs), errs
+
+
+def test_delete_queue_zeroes_gauge_rows(cluster):
+    qm = cluster.queue_manager
+    qm.create_queue(Queue(name="qa", quota={"pods": 4}))
+    js = cluster.create_jobset(queued_jobset("wl", 2, queue="qa"))
+    cluster.run_until_stable()
+    assert metrics.queue_admitted_workloads.value("qa") == 1
+    cluster.delete_jobset("default", "wl")
+    qm.delete_queue("qa")
+    # No phantom rows for the deleted queue.
+    assert metrics.queue_admitted_workloads.value("qa") == 0
+    assert metrics.queue_pending_workloads.value("qa") == 0
+    assert js.metadata.uid not in qm.workloads
+
+
+def test_delete_queue_before_workload_still_zeroes_gauges(cluster):
+    """The other ordering: queue deleted while its admitted workload
+    lives on (counts stay real), then the workload goes away — the row
+    must drop to zero, not freeze at its last value."""
+    qm = cluster.queue_manager
+    qm.create_queue(Queue(name="qa", quota={"pods": 4}))
+    cluster.create_jobset(queued_jobset("wl", 2, queue="qa"))
+    cluster.run_until_stable()
+    qm.delete_queue("qa")
+    # Workload still referencing the deleted queue: honest count remains.
+    assert metrics.queue_admitted_workloads.value("qa") == 1
+    cluster.delete_jobset("default", "wl")
+    assert metrics.queue_admitted_workloads.value("qa") == 0
+    assert metrics.queue_pending_workloads.value("qa") == 0
+
+
+def test_chaos_fault_on_preemptor_does_not_evict_victims():
+    """A queue.admission fault aimed at a preempting workload must block
+    the preemptor alone — its would-be victims stay admitted (no
+    fault-amplified eviction cascade)."""
+    metrics.reset()
+    injector = FaultInjector(seed=2)
+    cluster = make_cluster()
+    cluster.add_topology("rack", num_domains=2, nodes_per_domain=4, capacity=16)
+    qm = cluster.queue_manager
+    qm.create_queue(Queue(name="qa", quota={"pods": 4}))
+    low = cluster.create_jobset(queued_jobset("low", 4, queue="qa", priority=0))
+    cluster.run_until_stable()
+    assert qm.workloads[low.metadata.uid].state == ADMITTED
+
+    # Every admission attempt faults from here on.
+    qm.injector = injector
+    injector.add_rule("queue.admission", "latency", rate=1.0,
+                      delay_s=30.0, times=1)
+    hi = cluster.create_jobset(queued_jobset("hi", 4, queue="qa", priority=9))
+    cluster.run_until_stable()
+    # The preemptor was delayed; the victim was NOT evicted.
+    assert qm.workloads[low.metadata.uid].state == ADMITTED
+    assert qm.workloads[hi.metadata.uid].state == PENDING
+    assert metrics.queue_preemptions_total.value("qa") == 0
+    # Once the injected latency passes (rule exhausted), the preemption
+    # proceeds normally.
+    cluster.clock.advance(30.0)
+    cluster.run_until_stable()
+    assert qm.workloads[hi.metadata.uid].state == ADMITTED
+    assert qm.workloads[low.metadata.uid].state == PENDING
+    assert metrics.queue_preemptions_total.value("qa") == 1
+
+
+def test_chaos_spurious_evictions_deterministic_across_seeded_runs():
+    def run(seed):
+        cluster = make_cluster()
+        cluster.add_topology("rack", num_domains=2, nodes_per_domain=8,
+                             capacity=16)
+        qm = cluster.queue_manager
+        qm.create_queue(Queue(name="qa", quota={"pods": 64}))
+        for i in range(8):
+            cluster.create_jobset(queued_jobset(f"wl-{i}", 2, queue="qa"))
+        cluster.run_until_stable()
+        injector = FaultInjector(seed=seed)
+        return queue_spurious_evictions(cluster, injector, rate=0.5)
+
+    assert run(7) == run(7)
+    assert run(7) != run(8) or len(run(7)) in (0, 8)

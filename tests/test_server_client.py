@@ -8,10 +8,11 @@ tool, and the kubectl-style CLI verbs driven through `cli.main`.
 """
 
 import json
+import time
 
 import pytest
 
-from jobset_tpu.api import keys
+from jobset_tpu.api import keys, serialization
 from jobset_tpu.client import ApiError, JobSetClient
 from jobset_tpu.server import ControllerServer
 from jobset_tpu.testing import make_jobset, make_replicated_job
@@ -693,3 +694,161 @@ def test_event_watch_long_poll_direct(server, client):
     # The pre-list events were not replayed.
     seqs = [int(e["object"]["metadata"]["name"].split("-")[1]) for e in events]
     assert min(seqs) > before - 1
+
+
+# ---------------------------------------------------------------------------
+# Kueue-mutable round trip + admission queue surface (docs/queueing.md)
+# ---------------------------------------------------------------------------
+
+SUSPENDED_YAML = """
+apiVersion: jobset.x-k8s.io/v1alpha2
+kind: JobSet
+metadata:
+  name: {name}
+spec:
+  suspend: true
+  replicatedJobs:
+  - name: workers
+    replicas: 2
+    template:
+      spec:
+        parallelism: 1
+        completions: 1
+"""
+
+
+def test_kueue_mutable_put_while_suspended_merges_on_resume(server, client):
+    """The Kueue-mutable-while-suspended round trip through the REAL
+    apiserver: PUT pod-template label/annotation/nodeSelector mutations on
+    a suspended JobSet (accepted by the validation carve-out), then
+    resume — `_resume_job` must merge every mutation into the resumed
+    child jobs."""
+    client.create(SUSPENDED_YAML.format(name="km"))
+    with server.lock:
+        assert server.cluster.pods == {}  # suspended: zero pods
+
+    raw = client.get_raw("km")
+    tmpl = raw["spec"]["replicatedJobs"][0]["template"]["spec"].setdefault(
+        "template", {}
+    )
+    meta = tmpl.setdefault("metadata", {})
+    meta.setdefault("labels", {})["team"] = "ml"
+    meta.setdefault("annotations", {})["kueue.x-k8s.io/admission"] = "ok"
+    tmpl.setdefault("spec", {})["nodeSelector"] = {"pool": "reserved"}
+    raw.pop("status", None)
+    client.update(serialization.from_dict(raw))
+
+    # A mutation of a NON-mutable field must still be rejected (the
+    # carve-out is exactly the five pod-template fields).
+    bad = client.get_raw("km")
+    bad["spec"]["replicatedJobs"][0]["replicas"] = 5
+    bad.pop("status", None)
+    with pytest.raises(ApiError) as err:
+        client.update(serialization.from_dict(bad))
+    assert err.value.status == 422
+
+    resumed = client.get_raw("km")
+    resumed["spec"]["suspend"] = False
+    resumed.pop("status", None)
+    client.update(serialization.from_dict(resumed))
+
+    with server.lock:
+        jobs = [
+            j for (ns, _), j in server.cluster.jobs.items() if ns == "default"
+        ]
+        assert len(jobs) == 2
+        for job in jobs:
+            assert not job.suspended()
+            assert job.spec.template.labels["team"] == "ml"
+            assert (
+                job.spec.template.annotations["kueue.x-k8s.io/admission"]
+                == "ok"
+            )
+            assert (
+                job.spec.template.spec.node_selector["pool"] == "reserved"
+            )
+
+
+QUEUED_YAML = """
+apiVersion: jobset.x-k8s.io/v1alpha2
+kind: JobSet
+metadata:
+  name: {name}
+spec:
+  queueName: {queue}
+  priority: {priority}
+  replicatedJobs:
+  - name: workers
+    replicas: {replicas}
+    template:
+      spec:
+        parallelism: 1
+        completions: 1
+"""
+
+
+def test_queue_crud_and_gang_admission_over_http(server, client):
+    """Queue CRUD + the hold -> mutate-while-queued -> admit flow through
+    the real apiserver."""
+    client.create_queue({
+        "kind": "Queue",
+        "metadata": {"name": "tenant-a"},
+        "spec": {"quota": {"pods": 2}},
+    })
+    assert [q["metadata"]["name"] for q in client.list_queues()] == ["tenant-a"]
+    with pytest.raises(ApiError) as err:
+        client.create_queue({"kind": "Queue", "metadata": {"name": "bad!"},
+                             "spec": {"quota": {"pods": 1}}})
+    assert err.value.status == 422
+
+    # Fill the queue, then submit a gang that must be held.
+    filler = client.create(QUEUED_YAML.format(
+        name="filler", queue="tenant-a", priority=0, replicas=2))
+    assert filler.spec.suspend is False  # admitted synchronously
+    held = client.create(QUEUED_YAML.format(
+        name="held", queue="tenant-a", priority=0, replicas=2))
+    assert held.spec.suspend is True
+
+    status = client.queue_status("tenant-a")
+    assert status["admittedWorkloads"] == 1
+    assert status["pendingWorkloads"] == 1
+    assert status["usage"] == {"pods": 2.0}
+    with server.lock:
+        held_pods = [
+            p for p in server.cluster.pods.values()
+            if p.labels.get(keys.JOBSET_NAME_KEY) == "held"
+        ]
+        assert held_pods == []  # fully suspended gang: zero pods
+
+    # Kueue-mutation while queued, through the apiserver.
+    raw = client.get_raw("held")
+    tmpl = raw["spec"]["replicatedJobs"][0]["template"]["spec"].setdefault(
+        "template", {})
+    tmpl.setdefault("metadata", {}).setdefault("labels", {})["team"] = "ml"
+    raw.pop("status", None)
+    updated = client.update(serialization.from_dict(raw))
+    assert updated.spec.suspend is True  # still controller-held
+
+    # Quota frees -> admitted; the merge landed in the resumed jobs.
+    _complete_all(server, "filler")
+    deadline = 50
+    for _ in range(deadline):
+        if client.get("held").spec.suspend is False:
+            break
+        time.sleep(0.1)
+    assert client.get("held").spec.suspend is False
+    with server.lock:
+        held_jobs = [
+            j for j in server.cluster.jobs.values()
+            if j.labels.get(keys.JOBSET_NAME_KEY) == "held"
+        ]
+        assert held_jobs and all(
+            j.spec.template.labels["team"] == "ml" for j in held_jobs
+        )
+
+    st = client.queue_status("tenant-a")
+    assert st["admittedWorkloads"] == 1  # released filler, admitted held
+    client.delete_queue("tenant-a")
+    with pytest.raises(ApiError) as err:
+        client.queue_status("tenant-a")
+    assert err.value.status == 404

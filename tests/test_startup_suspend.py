@@ -138,6 +138,46 @@ def test_resume_merges_kueue_mutated_pod_template_fields():
         assert node.labels["pool"] == "reserved"
 
 
+def test_resume_merges_all_kueue_mutable_fields():
+    """All five Kueue-mutable pod-template fields — labels, annotations,
+    nodeSelector, tolerations, schedulingGates — must merge into the
+    child jobs on resume (jobset_controller.go:443-485)."""
+    from jobset_tpu.api.types import Toleration
+
+    cluster = make_cluster()
+    cluster.add_topology("rack", num_domains=4, nodes_per_domain=4, capacity=16)
+    js = ordered_jobset()
+    js.spec.startup_policy = StartupPolicy(startup_policy_order=keys.STARTUP_ANY_ORDER)
+    js.spec.suspend = True
+    cluster.create_jobset(js)
+    cluster.run_until_stable()
+
+    updated = cluster.get_jobset("default", "js").clone()
+    tol = Toleration(key="reserved", operator="Exists", effect="NoSchedule")
+    for rjob in updated.spec.replicated_jobs:
+        tmpl = rjob.template.spec.template
+        tmpl.labels["team"] = "ml"
+        tmpl.annotations["kueue.x-k8s.io/admission"] = "granted"
+        tmpl.spec.tolerations.append(tol)
+        tmpl.spec.scheduling_gates.append("example.com/hold")
+    updated.spec.suspend = False
+    cluster.update_jobset(updated)
+    cluster.run_until_stable()
+
+    for job in cluster.jobs.values():
+        assert job.spec.template.labels["team"] == "ml"
+        assert (
+            job.spec.template.annotations["kueue.x-k8s.io/admission"]
+            == "granted"
+        )
+        assert tol in job.spec.template.spec.tolerations
+        assert "example.com/hold" in job.spec.template.spec.scheduling_gates
+    # Gated pods are created but held unschedulable (the gate merge is
+    # load-bearing, not cosmetic).
+    assert cluster.pods
+    assert all(not p.spec.node_name for p in cluster.pods.values())
+
+
 def test_in_order_resume_respects_order():
     cluster = make_cluster(auto_ready=False)
     cluster.add_topology("rack", num_domains=4, nodes_per_domain=4, capacity=16)
