@@ -13,17 +13,25 @@ from .injector import (
     KIND_BREAK,
     KIND_CRASH,
     KIND_DRAIN,
+    KIND_ENOSPC,
     KIND_ERROR,
     KIND_EVICT,
     KIND_LATENCY,
     KIND_REFUSE,
     KIND_SLOW,
+    KIND_TORN,
     Rule,
     configure,
     disable,
     get_injector,
 )
-from .scenarios import node_drain, pod_crash_burst, queue_spurious_evictions
+from .scenarios import (
+    node_drain,
+    pod_crash_burst,
+    queue_spurious_evictions,
+    store_enospc_writes,
+    store_torn_writes,
+)
 
 __all__ = [
     "Fault",
@@ -31,11 +39,13 @@ __all__ = [
     "KIND_BREAK",
     "KIND_CRASH",
     "KIND_DRAIN",
+    "KIND_ENOSPC",
     "KIND_ERROR",
     "KIND_EVICT",
     "KIND_LATENCY",
     "KIND_REFUSE",
     "KIND_SLOW",
+    "KIND_TORN",
     "Rule",
     "configure",
     "disable",
@@ -43,4 +53,6 @@ __all__ = [
     "node_drain",
     "pod_crash_burst",
     "queue_spurious_evictions",
+    "store_enospc_writes",
+    "store_torn_writes",
 ]

@@ -35,6 +35,8 @@ just names):
 ``cluster.pod``        simulated kubelet: pod crash bursts
 ``cluster.node``       simulated cloud: node drain
 ``queue.admission``    gang admission plane: admit-latency, spurious evict
+``store.write``        durable-store WAL append: fsync latency, torn-tail
+                       truncation, ENOSPC
 ================== ======================================================
 
 Spec grammar (CLI ``--inject`` / ``FaultInjector.from_spec``)::
@@ -67,6 +69,8 @@ KIND_SLOW = "slow"        # solver.stream: delay the reply frame by `ms`
 KIND_CRASH = "crash"      # cluster.pod: crash the pod
 KIND_DRAIN = "drain"      # cluster.node: drain the node
 KIND_EVICT = "evict"      # queue.admission: spuriously evict/deny a gang
+KIND_TORN = "torn"        # store.write: crash mid-append (partial frame)
+KIND_ENOSPC = "enospc"    # store.write: fail the append before any byte
 
 
 @dataclass

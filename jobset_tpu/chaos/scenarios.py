@@ -13,7 +13,14 @@ from __future__ import annotations
 
 from typing import Optional
 
-from .injector import FaultInjector, KIND_CRASH, KIND_DRAIN, KIND_EVICT
+from .injector import (
+    FaultInjector,
+    KIND_CRASH,
+    KIND_DRAIN,
+    KIND_ENOSPC,
+    KIND_EVICT,
+    KIND_TORN,
+)
 
 # Pod phases considered "live" for victim selection (mirrors
 # core/objects.py constants without importing the whole core package at
@@ -130,3 +137,103 @@ def queue_spurious_evictions(
         if rule is not None:
             injector.remove_rule(rule)
     return evicted
+
+
+def store_torn_writes(
+    data_dir: str,
+    rates=(0.0, 0.1, 0.3, 0.6),
+    seed: int = 11,
+    writes: int = 24,
+    kind: str = KIND_TORN,
+) -> list[dict]:
+    """Durable-store fault sweep at the ``store.write`` point: for each
+    injection rate, drive a create/mutate/delete write sequence against a
+    fresh cluster+store, committing after every write; a commit that hits
+    an injected torn write (partial frame on disk, no fsync ack) or ENOSPC
+    raises and is NOT acknowledged — the tail is repaired and the diff
+    retries on the next commit, exactly as the server's commit path does.
+    After the last write the store is hard-killed (abandoned, never closed
+    or flushed) and recovered into a fresh cluster.
+
+    The invariant each rate's result carries: every object covered by the
+    last fsync-ACKNOWLEDGED commit is recovered byte-identically
+    (``lost`` / ``mismatched`` are object counts — the caller asserts
+    zero). Faults are deterministic per (seed, arrival), so a sweep is
+    reproducible.
+    """
+    import os
+
+    from ..core import make_cluster
+    from ..store import Store, StoreError
+    from ..testing import make_jobset, make_replicated_job
+
+    results: list[dict] = []
+    for i, rate in enumerate(rates):
+        rate_dir = os.path.join(data_dir, f"{kind}-{i}")
+        injector = FaultInjector(seed=seed)
+        if rate > 0:
+            injector.add_rule("store.write", kind, rate=rate)
+        cluster = make_cluster()
+        store = Store(rate_dir, snapshot_interval=10**9, injector=injector)
+        store.recover(cluster)
+
+        acked = failed = 0
+        durable: dict = {}  # last fsync-acknowledged serialized state
+        for w in range(writes):
+            if w % 4 == 3:
+                cluster.delete_jobset("default", f"wl-{w - 3}")
+            else:
+                cluster.create_jobset(
+                    make_jobset(f"wl-{w}")
+                    .replicated_job(
+                        make_replicated_job("w").replicas(1)
+                        .parallelism(1).completions(1).obj()
+                    )
+                    .suspend(True)
+                    .obj()
+                )
+            cluster.run_until_stable()
+            try:
+                if store.commit() is not None:
+                    acked += 1
+                durable = store.serialized_state()
+            except StoreError:
+                failed += 1
+                store.repair()
+
+        # Hard-kill (no flush, no tail repair — per-record fsync is the
+        # only durability), then cold-start recover.
+        store.hard_kill()
+        fresh = make_cluster()
+        recovered_store = Store(rate_dir)
+        recovered_store.recover(fresh)
+        recovered = recovered_store.serialized_state()
+        recovered_store.close()
+
+        lost = mismatched = 0
+        for obj_kind, objs in durable.items():
+            for key, serialized in objs.items():
+                got = recovered.get(obj_kind, {}).get(key)
+                if got is None:
+                    lost += 1
+                elif got != serialized:
+                    mismatched += 1
+        results.append({
+            "kind": kind,
+            "rate": rate,
+            "writes": writes,
+            "commits_acked": acked,
+            "commits_failed": failed,
+            "faults_injected": injector.injected_total("store.write"),
+            "lost": lost,
+            "mismatched": mismatched,
+            "recovered_objects": sum(len(v) for v in recovered.values()),
+        })
+    return results
+
+
+def store_enospc_writes(data_dir: str, **kwargs) -> list[dict]:
+    """ENOSPC variant of `store_torn_writes` (append fails before any byte
+    lands; the log needs no truncation but the commit is still unacked)."""
+    kwargs.setdefault("kind", KIND_ENOSPC)
+    return store_torn_writes(data_dir, **kwargs)

@@ -99,6 +99,16 @@ def _build_parser() -> argparse.ArgumentParser:
                         "solve (remote or local) exceeding it degrades the "
                         "provider to the greedy path for a cool-off window "
                         "(0 = unlimited)")
+    c.add_argument("--data-dir", default="", metavar="DIR",
+                   help="durable control-plane state directory (WAL + "
+                        "snapshots; docs/persistence.md): committed writes "
+                        "are journaled + fsync'd, and a restart replays "
+                        "snapshot+WAL so the control plane survives "
+                        "kill -9. Empty (default) = in-memory only, "
+                        "exactly the pre-store behavior")
+    c.add_argument("--snapshot-interval", type=int, default=256,
+                   help="WAL commits between compacting snapshots "
+                        "(--data-dir only)")
 
     s = sub.add_parser("solver", help="run the placement solver sidecar (gRPC)")
     s.add_argument("--addr", default="127.0.0.1:8500")
@@ -198,6 +208,23 @@ def _cmd_controller(args) -> int:
         ),
     )
 
+    store = None
+    if args.data_dir:
+        from .store import Store
+
+        store = Store(args.data_dir, snapshot_interval=args.snapshot_interval)
+        stats = store.recover(cluster)
+        if stats.get("objects"):
+            print(
+                f"recovered {stats['objects']} objects from {args.data_dir} "
+                f"(rv {stats['resource_version']}, "
+                f"{stats['wal_records_replayed']} WAL records"
+                + (", torn tail truncated" if stats["torn_tail_recovered"]
+                   else "")
+                + f") in {stats['recovery_s']:.3f}s",
+                flush=True,
+            )
+
     if args.queues:
         import yaml as _yaml
 
@@ -206,13 +233,32 @@ def _cmd_controller(args) -> int:
         with open(args.queues) as f:
             for doc in _yaml.safe_load_all(f.read()):
                 if isinstance(doc, dict) and doc.get("kind") == "Queue":
-                    cluster.queue_manager.create_queue(queue_from_dict(doc))
+                    q = queue_from_dict(doc)
+                    # Recovered state already holds previously-preloaded
+                    # queues; the file only fills gaps. Say so — a quota
+                    # change in the file must not look like a silent no-op.
+                    if cluster.queue_manager.get_queue(q.name) is None:
+                        cluster.queue_manager.create_queue(q)
+                    else:
+                        print(f"--queues: queue {q.name!r} already exists in "
+                              f"recovered state; file entry ignored "
+                              f"(durable state wins — update via the API)",
+                              flush=True)
 
     if args.topology:
-        key, _, shape = args.topology.partition(":")
-        domains, nodes, cap = (int(x) for x in shape.split("x"))
-        cluster.add_topology(key, num_domains=domains, nodes_per_domain=nodes,
-                             capacity=cap)
+        if cluster.nodes:
+            # Recovery restored a node population: the durable topology
+            # (including later out-of-band label/taint patches) wins over
+            # the synthetic bootstrap. Say so — a changed --topology flag
+            # must not look like a silent no-op.
+            print(f"--topology ignored: {len(cluster.nodes)} nodes "
+                  f"recovered from {args.data_dir} (durable state wins — "
+                  f"add nodes via the API)", flush=True)
+        else:
+            key, _, shape = args.topology.partition(":")
+            domains, nodes, cap = (int(x) for x in shape.split("x"))
+            cluster.add_topology(key, num_domains=domains,
+                                 nodes_per_domain=nodes, capacity=cap)
 
     tls_cert, tls_key = args.tls_cert or None, args.tls_key or None
     if args.tls_self_signed:
@@ -262,10 +308,17 @@ def _cmd_controller(args) -> int:
     print(f"controller listening on {scheme}://{server.address} "
           f"(solver={'sidecar ' + args.solver_addr if args.solver_addr else 'in-process'}"
           + (f", leader-elect as {elector.identity}" if elector else "")
+          + (f", data-dir {args.data_dir}" if store is not None else "")
           + ")",
           flush=True)
     _wait_for_signal()
+    # Graceful drain (SIGTERM/Ctrl-C): fence writes (503 + Retry-After),
+    # run one final pump, flush/fsync the WAL, release the leader lease —
+    # then close the listener and exit 0.
+    server.drain()
     server.stop()
+    if store is not None:
+        store.close()
     return 0
 
 

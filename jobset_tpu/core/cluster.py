@@ -23,7 +23,6 @@ controller -> scheduler -> Pod reconciler, until a fixed point.
 
 from __future__ import annotations
 
-import itertools
 from collections import deque
 from typing import Callable, Optional
 
@@ -138,7 +137,10 @@ class Cluster:
 
         self.lock = threading.RLock()
 
-        self._uid_iter = itertools.count(1)
+        # Lifetime-monotonic identity counter (uids + pod suffixes). A plain
+        # int (not itertools.count) so the durable store can persist and
+        # restore it — uid reuse across a crash would corrupt owner indexes.
+        self.uid_counter = 0
         self._deferred: deque[Callable[[], None]] = deque()
         # Placement-prefetch requests buffered across the tick's reconcile
         # drain so a multi-JobSet failure storm coalesces into ONE vmapped
@@ -169,6 +171,10 @@ class Cluster:
         # intercepts queue-labeled JobSet creation and runs one admission
         # pass per tick before the reconcile drain.
         self.queue_manager = None
+        # Durable persistence (store.Store attaches itself via recover()/
+        # attach()): None means in-memory only — the default, byte-for-byte
+        # the pre-store behavior.
+        self.store = None
         # Pod webhook chain: callables(cluster, pod) -> None / raise AdmissionError.
         self.pod_mutators: list[Callable] = []
         self.pod_validators: list[Callable] = []
@@ -178,11 +184,13 @@ class Cluster:
     # ------------------------------------------------------------------
 
     def next_uid(self) -> str:
-        return f"uid-{next(self._uid_iter)}"
+        self.uid_counter += 1
+        return f"uid-{self.uid_counter}"
 
     def pod_suffix(self) -> str:
         """Deterministic stand-in for the kubelet's random 5-char pod suffix."""
-        return _base36(next(self._uid_iter) * 2654435761 % 36**5)
+        self.uid_counter += 1
+        return _base36(self.uid_counter * 2654435761 % 36**5)
 
     @staticmethod
     def _placement_event(pod: Pod) -> Optional[str]:
@@ -991,6 +999,141 @@ class Cluster:
             if not self.tick():
                 return i + 1
         raise RuntimeError(f"cluster did not stabilize in {max_ticks} ticks")
+
+    # ------------------------------------------------------------------
+    # Crash-recovery restore (store.Store.recover calls this)
+    # ------------------------------------------------------------------
+
+    def restore_state(
+        self,
+        *,
+        jobsets,
+        jobs,
+        pods,
+        services,
+        nodes,
+        uid_counter: int = 0,
+        events_total: int = 0,
+    ) -> None:
+        """Install recovered objects and rebuild every piece of DERIVED
+        state from them — field indexes, node allocation, domain occupancy,
+        leader watches, job deadlines, work queues. The durable store
+        persists only first-class objects and lifetime counters; anything
+        recomputable is recomputed here so persisted and derived state can
+        never disagree. TTL requeues re-derive on the first pump (every
+        recovered JobSet is enqueued for one resync reconcile, which is a
+        no-op on a recovered fixed point — no duplicate restarts fire)."""
+        self.jobsets = {
+            (js.metadata.namespace, js.metadata.name): js for js in jobsets
+        }
+        self.jobs = {
+            (j.metadata.namespace, j.metadata.name): j for j in jobs
+        }
+        self.pods = {
+            (p.metadata.namespace, p.metadata.name): p for p in pods
+        }
+        self.services = {
+            (s.metadata.namespace, s.metadata.name): s for s in services
+        }
+        self.nodes = {n.name: n for n in nodes}
+        self.uid_counter = max(self.uid_counter, uid_counter)
+        # Events themselves are bounded observability, not persisted; the
+        # lifetime seq continues so journal cursors / event names stay
+        # monotonic across the restart.
+        self.events_total = max(self.events_total, events_total)
+
+        # Reset all derived state before rebuilding.
+        self.jobs_by_owner.clear()
+        self.jobs_by_uid.clear()
+        self.pods_by_job_key.clear()
+        self.pods_by_base_name.clear()
+        self.pods_by_job_uid.clear()
+        self.dirty_job_uids.clear()
+        self.job_deadlines.clear()
+        self.pending_pod_keys.clear()
+        self._newly_bound.clear()
+        self.leader_pod_keys.clear()
+        self.dirty_placement_job_keys.clear()
+        self.domain_job_keys.clear()
+        self.placement_history.clear()
+        self._domain_nodes.clear()
+        self._domain_stats.clear()
+        self.reconcile_queue.clear()
+        self._queued.clear()
+        self._next_tick_queue.clear()
+        self.requeue_after.clear()
+        self.reconcile_failures.clear()
+        for node in self.nodes.values():
+            node.allocated = 0
+
+        for job in self.jobs.values():
+            key = (job.metadata.namespace, job.metadata.name)
+            self.jobs_by_owner.setdefault(job.metadata.owner_uid, set()).add(
+                key
+            )
+            self.jobs_by_uid[job.metadata.uid] = key
+            # One resync per job so the Job controller revisits everything
+            # once (a recovered fixed point syncs to no changes).
+            self.dirty_job_uids.add(job.metadata.uid)
+            finished, _ = job.finished()
+            if (
+                not finished
+                and not job.suspended()
+                and job.spec.active_deadline_seconds is not None
+                and job.status.start_time is not None
+            ):
+                self.job_deadlines[job.metadata.uid] = (
+                    job.status.start_time
+                    + float(job.spec.active_deadline_seconds)
+                )
+            # Plan-time domain claims (may exist with no pod ever bound):
+            # losing one would let another gang double-book the domain the
+            # recovered job's pinned nodeSelectors point at.
+            topology_key = job.metadata.annotations.get(keys.EXCLUSIVE_KEY)
+            planned = job.metadata.annotations.get(keys.PLACEMENT_PLAN_KEY)
+            job_key = job.labels.get(keys.JOB_KEY)
+            if topology_key and planned and job_key and not finished:
+                self.claim_domain(topology_key, planned, job_key)
+
+        for key, pod in self.pods.items():
+            job_key = pod.labels.get(keys.JOB_KEY)
+            if job_key:
+                self.pods_by_job_key.setdefault(job_key, set()).add(key)
+            base = self._pod_base_name(pod.metadata.name)
+            self.pods_by_base_name.setdefault((key[0], base), set()).add(key)
+            self.pods_by_job_uid.setdefault(
+                pod.metadata.owner_uid, set()
+            ).add(key)
+            if not pod.spec.node_name and pod.status.phase == POD_PENDING:
+                self.pending_pod_keys[key] = None
+            if pod.spec.node_name:
+                node = self.nodes.get(pod.spec.node_name)
+                if node is not None:
+                    node.allocated += 1
+                topology_key = pod.annotations.get(keys.EXCLUSIVE_KEY)
+                exclusive = (
+                    topology_key
+                    and keys.NODE_SELECTOR_STRATEGY_KEY
+                    not in pod.annotations
+                )
+                if (
+                    exclusive
+                    and pod.annotations.get(keys.POD_COMPLETION_INDEX_KEY)
+                    == "0"
+                ):
+                    self.leader_pod_keys.add(key)
+                if topology_key and job_key and node is not None:
+                    value = node.labels.get(topology_key)
+                    if value is not None:
+                        self.domain_job_keys.setdefault(
+                            topology_key, {}
+                        ).setdefault(value, set()).add(job_key)
+                        self.placement_history[job_key] = value
+            if (pk := self._placement_event(pod)):
+                self.dirty_placement_job_keys.add(pk)
+
+        for key in self.jobsets:
+            self.enqueue_reconcile(*key)
 
     # ------------------------------------------------------------------
     # Drive helpers (envtest-style jobUpdateFn analogs)

@@ -234,6 +234,35 @@ queue_preemptions_total = Counter(
     "chaos spurious-evict), per queue",
     label_names=("queue",),
 )
+# Durable control-plane store (store/ subsystem, docs/persistence.md):
+# WAL growth, compaction/recovery latency, and the commit/error counters
+# the chaos plane's store.write faults exercise.
+store_wal_bytes = Gauge(
+    "jobset_store_wal_bytes",
+    "Durable byte size of the current write-ahead log segment (drops to 0 "
+    "at each snapshot compaction)",
+)
+store_commits_total = Counter(
+    "jobset_store_commits_total",
+    "WAL commit records fsync-acknowledged by the durable store",
+    label_names=(),
+)
+store_write_errors_total = Counter(
+    "jobset_store_write_errors_total",
+    "WAL appends that failed (torn write, ENOSPC, I/O error); the "
+    "un-journaled diff is retried on the next commit after tail repair",
+    label_names=(),
+)
+store_snapshot_seconds = Histogram(
+    "jobset_store_snapshot_seconds",
+    "Wall time of one compacting store snapshot (write + rename + WAL "
+    "truncation)",
+)
+store_recovery_seconds = Histogram(
+    "jobset_store_recovery_seconds",
+    "Wall time of cold-start recovery (snapshot load + WAL replay + "
+    "derived-state rebuild into a fresh cluster)",
+)
 
 
 ALL_COUNTERS = (
@@ -246,8 +275,15 @@ ALL_COUNTERS = (
     reconcile_panics_total,
     chaos_injected_faults_total,
     queue_preemptions_total,
+    store_commits_total,
+    store_write_errors_total,
 )
-ALL_HISTOGRAMS = (reconcile_time_seconds, solver_solve_time_seconds)
+ALL_HISTOGRAMS = (
+    reconcile_time_seconds,
+    solver_solve_time_seconds,
+    store_snapshot_seconds,
+    store_recovery_seconds,
+)
 ALL_GAUGES = (
     solver_batch_occupancy,
     solver_batch_problems,
@@ -256,6 +292,7 @@ ALL_GAUGES = (
     placement_degraded,
     queue_pending_workloads,
     queue_admitted_workloads,
+    store_wal_bytes,
 )
 
 

@@ -32,7 +32,6 @@ is on, plain numpy otherwise, with identical decisions either way.
 
 from __future__ import annotations
 
-import itertools
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -123,7 +122,10 @@ class QueueManager:
         self.injector = injector
         self.queues: dict[str, Queue] = {}
         self.workloads: dict[str, Workload] = {}  # uid -> workload
-        self._arrival = itertools.count(1)
+        # Submission sequence; a plain int (not itertools.count) so the
+        # durable store can persist and restore it — arrival order is a
+        # fairness tie-break that must survive a crash.
+        self.arrival_seq = 0
         # Backfill accounting persists ACROSS passes while the same head
         # stays blocked: queue -> (blocked head uid, gangs admitted past
         # it). Reset when the head changes, admits, or goes away —
@@ -220,7 +222,7 @@ class QueueManager:
             queue=js.spec.queue_name,
             priority=int(js.spec.priority or 0),
             request=gang_request(js),
-            arrival=next(self._arrival),
+            arrival=self._next_arrival(),
         )
         self.workloads[wl.uid] = wl
         self.cluster.record_event(
@@ -261,6 +263,28 @@ class QueueManager:
 
     def manages(self, uid: str) -> bool:
         return uid in self.workloads
+
+    def _next_arrival(self) -> int:
+        self.arrival_seq += 1
+        return self.arrival_seq
+
+    def restore_state(self, queues, workloads, arrival_seq: int = 0) -> None:
+        """Crash-recovery restore (store.Store.recover): install recovered
+        queues + workload records and re-derive everything else. Quota
+        usage is never persisted — `_usage()` recomputes it from ADMITTED
+        workloads each pass, so recovered accounting is consistent by
+        construction. The backfill budget resets (its blocked head is
+        re-evaluated on the first pass), and pending workloads keep their
+        backoff gates (`eligible_at` on the virtual clock)."""
+        self.queues = {q.name: q for q in queues}
+        self.workloads = {wl.uid: wl for wl in workloads}
+        self.arrival_seq = max(
+            self.arrival_seq,
+            arrival_seq,
+            max((wl.arrival for wl in self.workloads.values()), default=0),
+        )
+        self._backfill_state.clear()
+        self._update_gauges()
 
     # ------------------------------------------------------------------
     # Admission pass (cluster tick, before the reconcile drain)

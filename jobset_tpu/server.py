@@ -223,6 +223,11 @@ class ControllerServer:
         self.standby_accepts_writes = standby_accepts_writes
         self._ready = threading.Event()
         self._stop = threading.Event()
+        # Graceful-drain fence (SIGTERM path): while set, mutating requests
+        # answer 503 + Retry-After so clients fail over while the final
+        # pump / WAL flush / lease release sequence runs.
+        self._draining = threading.Event()
+        self._lease_released = False
 
         # Watch journal (client-go informer substrate analog,
         # client-go/informers/externalversions/jobset/v1alpha2/jobset.go,
@@ -252,6 +257,24 @@ class ControllerServer:
         # trimmed before a pump are simply never journaled; no DELETED —
         # retention is the watcher's concern, as with apiserver event TTL).
         self._events_cursor = 0
+
+        # Crash-recovered cluster (a durable store with state is attached):
+        # continue the global resourceVersion counter and treat every
+        # pre-crash rv as compacted — the event window itself is gone, so
+        # an informer holding an older rv must get 410 Gone and relist
+        # (etcd-compaction semantics) instead of a silently stale watch.
+        # The jobsets snapshot seeds from recovered state so the first
+        # refresh does not flood ADDED events for objects that never
+        # changed.
+        store = getattr(cluster, "store", None)
+        if store is not None and store.resource_version:
+            self._watch_rv = store.resource_version
+            self._watch_trimmed_rv = store.resource_version
+            self._watch_snapshots["jobsets"] = {
+                key: (js.metadata.uid, _jobset_summary(js))
+                for key, js in cluster.jobsets.items()
+            }
+            self._events_cursor = cluster.events_total
 
         host, _, port = address.rpartition(":")
         handler = self._make_handler()
@@ -304,7 +327,13 @@ class ControllerServer:
 
     def stop(self):
         self._stop.set()
-        if self.elector is not None:
+        # Wake every parked long-poll watcher: without this a watcher
+        # sitting in _watch_resource holds its handler thread until its
+        # poll timeout, delaying shutdown by up to that long. Woken
+        # watchers return their (possibly empty) partial batches.
+        with self._watch_cond:
+            self._watch_cond.notify_all()
+        if self.elector is not None and not self._lease_released:
             # Join the pump thread BEFORE releasing: an in-flight
             # pump_if_leader() could otherwise re-acquire the lease right
             # after release() and make the standby wait out the full lease
@@ -313,8 +342,47 @@ class ControllerServer:
             if pump is not None and pump is not threading.current_thread():
                 pump.join(timeout=10.0)
             self.elector.release()
+            self._lease_released = True
         self._httpd.shutdown()
         self._httpd.server_close()
+
+    def drain(self) -> list[str]:
+        """Graceful drain (the CLI's SIGTERM path), in the k8s-shutdown
+        ordering a stateful controller needs: fence writes (503 +
+        Retry-After), stop and join the background pump, run one final
+        leader-gated pump so in-flight work settles, journal + fsync the
+        WAL, then release the leader lease so a standby takes over
+        immediately. Returns the completed phases in order (asserted by
+        the shutdown-ordering test). stop() afterwards closes the
+        listener without re-releasing the lease."""
+        phases: list[str] = []
+        self._draining.set()
+        phases.append("writes-fenced")
+        # Stop the background pump loop (and wake parked watchers) before
+        # the final pump so no concurrent pump races the flush below.
+        self._stop.set()
+        with self._watch_cond:
+            self._watch_cond.notify_all()
+        pump = self._pump_thread
+        if pump is not None and pump is not threading.current_thread():
+            pump.join(timeout=10.0)
+        try:
+            self.pump_if_leader()
+        except Exception:
+            logger.exception("final drain pump failed")
+        phases.append("final-pump")
+        store = getattr(self.cluster, "store", None)
+        if store is not None:
+            with self.lock:
+                self._refresh_watch_locked()
+                self._commit_store_locked()
+            store.flush()
+        phases.append("wal-flushed")
+        if self.elector is not None and not self._lease_released:
+            self.elector.release()
+            self._lease_released = True
+            phases.append("lease-released")
+        return phases
 
     def pump(self):
         """Run the control loops to a fixed point (thread-safe)."""
@@ -322,9 +390,14 @@ class ControllerServer:
             ticks = self.cluster.run_until_stable()
             # run_until_stable returns after one no-op tick when nothing
             # changed; skip the O(jobsets) serialize-and-diff on those idle
-            # background pump rounds.
-            if ticks > 1:
+            # background pump rounds — UNLESS a failed store append left a
+            # diff pending, in which case the idle pump is exactly when the
+            # retry must happen (otherwise an acknowledged write could stay
+            # non-durable forever on a quiet system).
+            store = getattr(self.cluster, "store", None)
+            if ticks > 1 or (store is not None and store.retry_pending):
                 self._refresh_watch_locked()
+                self._commit_store_locked()
 
     def pump_if_leader(self) -> bool:
         """One leader-gated pump round: acquire/renew the lease, reconcile
@@ -341,6 +414,44 @@ class ControllerServer:
         (the watch-driven split the reference's replicas have)."""
         if self.elector is None or self.elector.is_leading:
             self.cluster.run_until_stable()
+
+    # ------------------------------------------------------------------
+    # Durable store journaling
+    # ------------------------------------------------------------------
+
+    def _commit_store_locked(self) -> bool:
+        """Journal the committed state at the same point the watch journal
+        diffs: once per HTTP write (after its synchronous reconcile, before
+        the response — so a healthy store fsyncs the write before it is
+        acknowledged) and once per changing background pump. Caller holds
+        self.lock.
+
+        Returns False when the append failed: the WAL tail is repaired and
+        the diff stays pending for the next commit, but the write — already
+        applied to the in-memory cluster, with reconcile effects that
+        cannot be unwound — is NOT yet crash-durable. The write path
+        surfaces that to the client as an RFC 7234 Warning header (and
+        `jobset_store_write_errors_total` counts it for operators), rather
+        than answering a 5xx for a mutation that did happen."""
+        store = getattr(self.cluster, "store", None)
+        if store is None:
+            return True
+        from .store import StoreError
+
+        try:
+            store.commit(resource_version=self._watch_rv)
+            return True
+        except (StoreError, OSError):
+            logger.exception(
+                "store commit failed; repairing WAL tail and retrying the "
+                "diff on the next commit"
+            )
+            metrics.store_write_errors_total.inc()
+            try:
+                store.repair()
+            except OSError:
+                logger.exception("store WAL repair failed")
+            return False
 
     # ------------------------------------------------------------------
     # Watch journal
@@ -525,6 +636,15 @@ class ControllerServer:
                 if batch:
                     return 200, {
                         "events": batch,
+                        "resourceVersion": self._watch_rv,
+                    }
+                if self._stop.is_set():
+                    # Shutting down: return the (empty) partial batch now
+                    # instead of parking until the poll timeout — stop()
+                    # notifies this condition so shutdown never waits out
+                    # a long-poll.
+                    return 200, {
+                        "events": [],
                         "resourceVersion": self._watch_rv,
                     }
                 remaining = deadline - _t.monotonic()
@@ -713,17 +833,27 @@ class ControllerServer:
                     self._activate_watch_kind(kind)
                 return self._watch_resource(kind, ns, rv, timeout_s)
 
-        if (
-            method in ("POST", "PUT", "DELETE", "PATCH")
-            and self.elector is not None
-            and not self.standby_accepts_writes
-            and not self.elector.is_leading
-        ):
-            return 503, {
-                "error": "this replica is a standby (not the lease holder); "
-                         "retry against the leader",
-                "identity": self.elector.identity,
-            }
+        if method in ("POST", "PUT", "DELETE", "PATCH"):
+            if self._draining.is_set():
+                # Graceful drain: no new writes land after the fence, so
+                # the final pump + WAL flush see a closed write set. The
+                # Retry-After steers clients to the replica taking over.
+                return (
+                    503,
+                    {"error": "server is draining (shutting down); retry"},
+                    None,
+                    {"Retry-After": "5"},
+                )
+            if (
+                self.elector is not None
+                and not self.standby_accepts_writes
+                and not self.elector.is_leading
+            ):
+                return 503, {
+                    "error": "this replica is a standby (not the lease "
+                             "holder); retry against the leader",
+                    "identity": self.elector.identity,
+                }
 
         with self.lock:
             if path.startswith(self.API_PREFIX):
@@ -734,6 +864,22 @@ class ControllerServer:
                 return 404, {"error": f"no route for {method} {path}"}
             if method in ("POST", "PUT", "DELETE", "PATCH"):
                 self._refresh_watch_locked()
+                # Durability point: the WAL record for this write (and its
+                # synchronous reconcile effects) is fsync'd before the
+                # HTTP response acknowledges it. If the append failed the
+                # write is still applied in memory (it cannot be unwound)
+                # but is not crash-durable until the next successful
+                # commit — tell the client with a Warning header.
+                if not self._commit_store_locked():
+                    code = result[0]
+                    payload = result[1]
+                    ctype = result[2] if len(result) > 2 else None
+                    extra = dict(result[3]) if len(result) > 3 else {}
+                    extra["Warning"] = (
+                        '299 - "write applied but not yet crash-durable: '
+                        'store commit failed; journaled on next commit"'
+                    )
+                    result = (code, payload, ctype, extra)
             return result
 
     def _parse_manifest(self, body: bytes, path_ns: str):
@@ -1063,7 +1209,7 @@ class ControllerServer:
                     conn.settimeout(None)
                 super().setup()
 
-            def _respond(self, code: int, payload, ctype=None):
+            def _respond(self, code: int, payload, ctype=None, headers=None):
                 if isinstance(payload, str):
                     data = payload.encode()
                     ctype = ctype or "text/plain; charset=utf-8"
@@ -1073,6 +1219,8 @@ class ControllerServer:
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
+                for name, value in (headers or {}).items():
+                    self.send_header(name, value)
                 self.end_headers()
                 self.wfile.write(data)
 

@@ -1,0 +1,24 @@
+"""Durable control-plane persistence: WAL + snapshots + crash recovery.
+
+The etcd analog of this build (docs/persistence.md): an append-only,
+CRC-framed, fsync'd write-ahead log of committed object state
+(`store.wal`), exact per-kind codecs (`store.codec`), and the `Store`
+orchestrator (`store.store`) that journals commits, compacts periodic
+snapshots, and replays snapshot+WAL into a fresh `Cluster` on cold start —
+tolerating a torn final record, preserving the global resourceVersion, and
+rebuilding all derived state instead of persisting it.
+
+Off by default: a cluster without an attached store behaves exactly as
+before (the CLI enables it with ``controller --data-dir``).
+"""
+
+from .store import KINDS, Store
+from .wal import StoreError, StoreWriteError, WriteAheadLog
+
+__all__ = [
+    "KINDS",
+    "Store",
+    "StoreError",
+    "StoreWriteError",
+    "WriteAheadLog",
+]

@@ -1,0 +1,311 @@
+"""Exact (bit-faithful) store codecs for every persisted kind.
+
+The wire serializers in `api.serialization` are the CRD-shaped exchange
+format and deliberately drop server-owned timing fields (creationTimestamp,
+condition lastTransitionTime) that a durable store must keep: recovery has
+to reproduce TTL deadlines and failure-policy tie-breaks exactly. Each
+codec here therefore reuses the wire serializer for the spec-shaped parts
+(they round-trip losslessly) and carries the lossy supplements explicitly.
+
+The contract every codec obeys — and tests/test_store.py proves — is a
+fixed point: ``encode(decode(encode(obj))) == encode(obj)``. That is what
+makes WAL replay idempotent and recovered state byte-identical to the
+committed state.
+"""
+
+from __future__ import annotations
+
+import json
+
+from ..api import serialization
+from ..api.types import Condition, ObjectMeta, Taint
+from ..core.objects import Job, JobStatus, Node, Pod, PodStatus, Service
+from ..queue.api import Queue, queue_from_dict, queue_to_dict
+from ..queue.manager import Workload
+
+
+def canonical(d: dict) -> str:
+    """Canonical JSON encoding: the store's byte-identity yardstick (shadow
+    diffing, WAL payloads, recovery-equality assertions all use it)."""
+    return json.dumps(d, sort_keys=True, separators=(",", ":"))
+
+
+# ---------------------------------------------------------------------------
+# Shared fragments
+# ---------------------------------------------------------------------------
+
+
+def _meta_dict(meta: ObjectMeta) -> dict:
+    return {
+        "name": meta.name,
+        "generateName": meta.generate_name,
+        "namespace": meta.namespace,
+        "uid": meta.uid,
+        "ownerUid": meta.owner_uid,
+        "labels": dict(meta.labels),
+        "annotations": dict(meta.annotations),
+        "creationTime": meta.creation_time,
+        "deletionTime": meta.deletion_time,
+    }
+
+
+def _meta_from(d: dict) -> ObjectMeta:
+    return ObjectMeta(
+        name=d["name"],
+        generate_name=d.get("generateName", ""),
+        namespace=d["namespace"],
+        uid=d["uid"],
+        owner_uid=d.get("ownerUid", ""),
+        labels=dict(d.get("labels") or {}),
+        annotations=dict(d.get("annotations") or {}),
+        creation_time=d.get("creationTime", 0.0),
+        deletion_time=d.get("deletionTime"),
+    )
+
+
+def _conditions_dict(conditions: list[Condition]) -> list[dict]:
+    return [
+        {
+            "type": c.type,
+            "status": c.status,
+            "reason": c.reason,
+            "message": c.message,
+            "time": c.last_transition_time,
+        }
+        for c in conditions
+    ]
+
+
+def _conditions_from(lst: list[dict]) -> list[Condition]:
+    return [
+        Condition(
+            type=c["type"],
+            status=c["status"],
+            reason=c.get("reason", ""),
+            message=c.get("message", ""),
+            last_transition_time=c.get("time", 0.0),
+        )
+        for c in lst
+    ]
+
+
+# ---------------------------------------------------------------------------
+# JobSet
+# ---------------------------------------------------------------------------
+
+
+def jobset_to_dict(js) -> dict:
+    """Wire manifest + the server-owned fields the wire format drops."""
+    return {
+        "manifest": serialization.to_dict(js, include_status=True),
+        "creationTime": js.metadata.creation_time,
+        "deletionTime": js.metadata.deletion_time,
+        "conditionTimes": [
+            c.last_transition_time for c in js.status.conditions
+        ],
+    }
+
+
+def jobset_from_dict(d: dict):
+    js = serialization.from_dict(d["manifest"])
+    js.metadata.creation_time = d.get("creationTime", 0.0)
+    js.metadata.deletion_time = d.get("deletionTime")
+    for cond, t in zip(js.status.conditions, d.get("conditionTimes", ())):
+        cond.last_transition_time = t
+    return js
+
+
+# ---------------------------------------------------------------------------
+# Job (child)
+# ---------------------------------------------------------------------------
+
+
+def job_to_dict(job: Job) -> dict:
+    s = job.status
+    return {
+        "metadata": _meta_dict(job.metadata),
+        "spec": serialization._job_spec_dict(job.spec),
+        "status": {
+            "active": s.active,
+            "ready": s.ready,
+            "succeeded": s.succeeded,
+            "failed": s.failed,
+            "podFailures": s.pod_failures,
+            "succeededIndexes": sorted(s.succeeded_indexes),
+            "startTime": s.start_time,
+            "completionTime": s.completion_time,
+            "conditions": _conditions_dict(s.conditions),
+        },
+    }
+
+
+def job_from_dict(d: dict) -> Job:
+    s = d["status"]
+    return Job(
+        metadata=_meta_from(d["metadata"]),
+        spec=serialization._job_spec_from(d["spec"], strict=False),
+        status=JobStatus(
+            active=s["active"],
+            ready=s["ready"],
+            succeeded=s["succeeded"],
+            failed=s["failed"],
+            pod_failures=s.get("podFailures", 0),
+            succeeded_indexes=set(s.get("succeededIndexes") or ()),
+            start_time=s.get("startTime"),
+            completion_time=s.get("completionTime"),
+            conditions=_conditions_from(s.get("conditions") or ()),
+        ),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Pod
+# ---------------------------------------------------------------------------
+
+
+def pod_to_dict(pod: Pod) -> dict:
+    return {
+        "metadata": _meta_dict(pod.metadata),
+        "spec": serialization._pod_spec_dict(pod.spec),
+        "status": {
+            "phase": pod.status.phase,
+            "ready": pod.status.ready,
+            "conditions": _conditions_dict(pod.status.conditions),
+        },
+    }
+
+
+def pod_from_dict(d: dict) -> Pod:
+    s = d["status"]
+    return Pod(
+        metadata=_meta_from(d["metadata"]),
+        spec=serialization._pod_spec_from(d["spec"], strict=False),
+        status=PodStatus(
+            phase=s["phase"],
+            ready=s["ready"],
+            conditions=_conditions_from(s.get("conditions") or ()),
+        ),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Service / Node
+# ---------------------------------------------------------------------------
+
+
+def service_to_dict(svc: Service) -> dict:
+    return {
+        "metadata": _meta_dict(svc.metadata),
+        "clusterIP": svc.cluster_ip,
+        "selector": dict(svc.selector),
+        "publishNotReadyAddresses": svc.publish_not_ready_addresses,
+    }
+
+
+def service_from_dict(d: dict) -> Service:
+    return Service(
+        metadata=_meta_from(d["metadata"]),
+        cluster_ip=d.get("clusterIP", "None"),
+        selector=dict(d.get("selector") or {}),
+        publish_not_ready_addresses=d.get("publishNotReadyAddresses", True),
+    )
+
+
+def node_to_dict(node: Node) -> dict:
+    # `allocated` is derived (recomputed from bound pods on restore), so it
+    # is deliberately NOT persisted — the store never journals a node for a
+    # mere bind/unbind.
+    return {
+        "name": node.name,
+        "labels": dict(node.labels),
+        "taints": [
+            {"key": t.key, "value": t.value, "effect": t.effect}
+            for t in node.taints
+        ],
+        "capacity": node.capacity,
+    }
+
+
+def node_from_dict(d: dict) -> Node:
+    return Node(
+        name=d["name"],
+        labels=dict(d.get("labels") or {}),
+        taints=[
+            Taint(
+                key=t["key"],
+                value=t.get("value", ""),
+                effect=t.get("effect", "NoSchedule"),
+            )
+            for t in d.get("taints") or ()
+        ],
+        capacity=d.get("capacity", 110),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Queue / Workload (gang admission plane)
+# ---------------------------------------------------------------------------
+
+
+def queue_store_dict(q: Queue) -> dict:
+    d = queue_to_dict(q)
+    # Normalize numerics to what queue_from_dict coerces (quota/weight ->
+    # float, depth -> int): a live Queue built with int quotas must encode
+    # byte-identically to its decoded twin (the codec fixed point).
+    d["spec"]["quota"] = {
+        k: float(v) for k, v in d["spec"]["quota"].items()
+    }
+    d["spec"]["weight"] = float(d["spec"]["weight"])
+    d["spec"]["backfillDepth"] = int(d["spec"]["backfillDepth"])
+    return d
+
+
+def queue_store_from(d: dict) -> Queue:
+    return queue_from_dict(d)
+
+
+def workload_to_dict(wl: Workload) -> dict:
+    return {
+        "namespace": wl.key[0],
+        "name": wl.key[1],
+        "uid": wl.uid,
+        "queue": wl.queue,
+        "priority": wl.priority,
+        "request": {r: v for r, v in sorted(wl.request.items())},
+        "arrival": wl.arrival,
+        "state": wl.state,
+        "eligibleAt": wl.eligible_at,
+        "backoffCount": wl.backoff_count,
+        "admittedAt": wl.admitted_at,
+        "preemptedCount": wl.preempted_count,
+        "lastTransitionMsg": wl.last_transition_msg,
+    }
+
+
+def workload_from_dict(d: dict) -> Workload:
+    return Workload(
+        key=(d["namespace"], d["name"]),
+        uid=d["uid"],
+        queue=d["queue"],
+        priority=d["priority"],
+        request=dict(d.get("request") or {}),
+        arrival=d["arrival"],
+        state=d["state"],
+        eligible_at=d.get("eligibleAt", 0.0),
+        backoff_count=d.get("backoffCount", 0),
+        admitted_at=d.get("admittedAt", 0.0),
+        preempted_count=d.get("preemptedCount", 0),
+        last_transition_msg=d.get("lastTransitionMsg", ""),
+    )
+
+
+# kind name -> (encode, decode); the Store iterates this table.
+CODECS = {
+    "jobsets": (jobset_to_dict, jobset_from_dict),
+    "jobs": (job_to_dict, job_from_dict),
+    "pods": (pod_to_dict, pod_from_dict),
+    "services": (service_to_dict, service_from_dict),
+    "nodes": (node_to_dict, node_from_dict),
+    "queues": (queue_store_dict, queue_store_from),
+    "workloads": (workload_to_dict, workload_from_dict),
+}

@@ -1,0 +1,400 @@
+"""Durable control-plane store: the build's etcd analog.
+
+The in-memory `Cluster` owns every object; this module makes the control
+plane survive ``kill -9`` by journaling **committed state** — not
+individual API calls — the same way the server's watch journal works: at
+each commit point (every HTTP write after its synchronous reconcile, every
+changing background pump) the store serializes the full object population
+through the exact codecs in `codec.py`, diffs it against the last durable
+shadow, and appends one CRC-framed, fsync'd WAL record of the changed
+objects plus the lifetime counters (uid, queue arrival, event seq) and the
+watch journal's global resourceVersion. Every `snapshot_interval` commits
+the log compacts into an atomically-renamed full snapshot and the WAL
+truncates.
+
+Because committed states are always post-reconcile fixed points, recovery
+is replay-to-fixed-point: load the snapshot, apply WAL records in order
+(skipping any the snapshot already covers), tolerate a torn final record,
+decode the objects, and hand them to ``Cluster.restore_state`` — which
+rebuilds every piece of DERIVED state (field indexes, node allocation,
+domain occupancy, TTL requeues, job deadlines, queue quota usage) rather
+than trusting any persisted copy of it. Replay is idempotent: recovering
+the same directory twice yields byte-identical serialized state, and a
+recovered fixed point pumps to no-op — no duplicate restarts, preemptions,
+or pod churn fire on replay.
+
+resourceVersion semantics across restart match etcd compaction: the
+counter is preserved but the pre-crash event window is gone, so the
+restarted server treats every older rv as compacted — informers holding a
+pre-crash rv receive 410 Gone and relist into the recovered state.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+from .codec import CODECS, canonical
+from .wal import StoreError, StoreWriteError, WriteAheadLog
+
+SNAPSHOT_FILE = "snapshot.json"
+WAL_FILE = "wal.log"
+
+KINDS = tuple(CODECS)
+
+
+class Store:
+    """One data directory = one durable control plane.
+
+    Layout: ``<data_dir>/snapshot.json`` (last compaction, atomic rename)
+    and ``<data_dir>/wal.log`` (records since). Single-writer: every entry
+    point runs under the cluster lock, like the reconcile core.
+    """
+
+    def __init__(
+        self,
+        data_dir: str,
+        snapshot_interval: int = 256,
+        injector=None,
+    ):
+        os.makedirs(data_dir, exist_ok=True)
+        self.data_dir = data_dir
+        self.snapshot_interval = max(1, int(snapshot_interval))
+        self.cluster = None
+        # Single-writer guard: two processes appending to one WAL would
+        # write frames at stale offsets and corrupt fsync-acknowledged
+        # history mid-file (recovery would then silently truncate at the
+        # first corrupt frame). An exclusive flock makes the second opener
+        # fail fast instead — e.g. a replacement controller started on the
+        # same --data-dir while the old one is still draining. The lock
+        # dies with the process, so kill -9 never wedges a restart.
+        self._lock_fd = os.open(
+            os.path.join(data_dir, "LOCK"), os.O_RDWR | os.O_CREAT, 0o644
+        )
+        try:
+            import fcntl
+
+            fcntl.flock(self._lock_fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError as exc:
+            os.close(self._lock_fd)
+            self._lock_fd = None
+            raise StoreError(
+                f"data dir {data_dir!r} is locked by another process "
+                f"(one controller per --data-dir): {exc}"
+            ) from exc
+        self.wal = WriteAheadLog(
+            os.path.join(data_dir, WAL_FILE), injector=injector
+        )
+        # kind -> key -> decoded-object dict (what a snapshot persists).
+        self._state: dict[str, dict[str, dict]] = {k: {} for k in KINDS}
+        # kind -> key -> canonical JSON string (the diffing shadow; always
+        # mirrors _state, precomputed so commits compare strings).
+        self._shadow: dict[str, dict[str, str]] = {k: {} for k in KINDS}
+        self._counters = {"uid": 0, "arrival": 0, "eventsTotal": 0}
+        self._rv = 0
+        self._seq = 0  # last committed record seq
+        self._commits_since_snapshot = 0
+        self.torn_tail_recovered = False
+        self.wal_records_replayed = 0
+        # True after a failed append: the un-journaled diff is pending and
+        # the NEXT commit must run even if the cluster is otherwise idle
+        # (the server's pump checks this — without it, an acknowledged
+        # write could stay non-durable forever on a quiet system).
+        self.retry_pending = False
+        self._load()
+
+    # ------------------------------------------------------------------
+    # Cold-start load (files -> self._state)
+    # ------------------------------------------------------------------
+
+    def _load(self) -> None:
+        snapshot_path = os.path.join(self.data_dir, SNAPSHOT_FILE)
+        snapshot_seq = 0
+        if os.path.exists(snapshot_path):
+            with open(snapshot_path) as f:
+                doc = json.load(f)
+            snapshot_seq = doc.get("seq", 0)
+            self._seq = snapshot_seq
+            self._rv = doc.get("rv", 0)
+            self._counters = dict(doc.get("counters") or self._counters)
+            for kind in KINDS:
+                self._state[kind] = dict(
+                    doc.get("state", {}).get(kind) or {}
+                )
+        records, torn = self.wal.recover()
+        self.torn_tail_recovered = torn
+        for record in records:
+            seq = record.get("seq", 0)
+            if seq <= snapshot_seq:
+                # Crash landed between snapshot rename and WAL truncation:
+                # these records are already compacted in. Re-applying them
+                # would also be safe (last-writer-wins), but skipping keeps
+                # replay single-pass-exact.
+                continue
+            for op in record.get("ops", ()):
+                if op[0] == "put":
+                    self._state[op[1]][op[2]] = op[3]
+                else:
+                    self._state[op[1]].pop(op[2], None)
+            self._seq = seq
+            self._rv = max(self._rv, record.get("rv", 0))
+            self._counters = dict(record.get("counters") or self._counters)
+            self.wal_records_replayed += 1
+        for kind in KINDS:
+            self._shadow[kind] = {
+                key: canonical(obj)
+                for key, obj in self._state[kind].items()
+            }
+        from ..core import metrics
+
+        metrics.store_wal_bytes.set(self.wal.size)
+
+    @property
+    def resource_version(self) -> int:
+        return self._rv
+
+    @property
+    def seq(self) -> int:
+        return self._seq
+
+    def object_count(self) -> int:
+        return sum(len(self._state[k]) for k in KINDS)
+
+    def serialized_state(self) -> dict[str, dict[str, str]]:
+        """Canonical-string view of the durable state (byte-identity
+        comparisons in tests and the chaos sweep)."""
+        return {kind: dict(self._shadow[kind]) for kind in KINDS}
+
+    # ------------------------------------------------------------------
+    # Recovery (self._state -> a fresh Cluster) + attach
+    # ------------------------------------------------------------------
+
+    def recover(self, cluster) -> dict:
+        """Restore the recovered state into `cluster` (expected fresh),
+        rebuild its derived state, and attach as its store. Returns
+        recovery stats; a fresh data dir restores nothing and just
+        attaches."""
+        from ..core import metrics
+        from ..obs.trace import span as obs_span
+
+        t0 = time.perf_counter()
+        stats = {
+            "objects": self.object_count(),
+            "resource_version": self._rv,
+            "wal_records_replayed": self.wal_records_replayed,
+            "torn_tail_recovered": self.torn_tail_recovered,
+        }
+        with obs_span("store.recovery", dict(stats)) as recovery_span:
+            if stats["objects"] or any(self._counters.values()):
+                decoded = {
+                    kind: [
+                        CODECS[kind][1](obj)
+                        for _, obj in sorted(self._state[kind].items())
+                    ]
+                    for kind in KINDS
+                }
+                cluster.restore_state(
+                    jobsets=decoded["jobsets"],
+                    jobs=decoded["jobs"],
+                    pods=decoded["pods"],
+                    services=decoded["services"],
+                    nodes=decoded["nodes"],
+                    uid_counter=self._counters.get("uid", 0),
+                    events_total=self._counters.get("eventsTotal", 0),
+                )
+                if cluster.queue_manager is not None:
+                    cluster.queue_manager.restore_state(
+                        queues=decoded["queues"],
+                        workloads=decoded["workloads"],
+                        arrival_seq=self._counters.get("arrival", 0),
+                    )
+                for kind in KINDS:
+                    stats[kind] = len(decoded[kind])
+            self.attach(cluster)
+            wall = time.perf_counter() - t0
+            recovery_span.set_attribute("recovery_s", wall)
+        stats["recovery_s"] = wall
+        metrics.store_recovery_seconds.observe(wall)
+        return stats
+
+    def attach(self, cluster) -> None:
+        self.cluster = cluster
+        cluster.store = self
+
+    # ------------------------------------------------------------------
+    # Commit path (Cluster state -> WAL)
+    # ------------------------------------------------------------------
+
+    def _live_objects(self, kind: str) -> dict:
+        c = self.cluster
+        if kind == "nodes":
+            return c.nodes
+        if kind == "queues":
+            qm = c.queue_manager
+            return qm.queues if qm is not None else {}
+        if kind == "workloads":
+            qm = c.queue_manager
+            return qm.workloads if qm is not None else {}
+        live = getattr(c, kind)  # jobsets / jobs / pods / services
+        return {f"{ns}/{name}": obj for (ns, name), obj in live.items()}
+
+    def _current_counters(self) -> dict:
+        c = self.cluster
+        qm = c.queue_manager
+        return {
+            "uid": c.uid_counter,
+            "arrival": qm.arrival_seq if qm is not None else 0,
+            "eventsTotal": c.events_total,
+        }
+
+    def commit(self, resource_version: Optional[int] = None) -> Optional[int]:
+        """Journal everything that changed since the last durable commit:
+        serialize the full object population, diff against the shadow,
+        append+fsync ONE record. Returns the committed seq, or None when
+        nothing changed. Raises StoreWriteError on append failure — the
+        in-memory diff is NOT consumed, so the next commit (after
+        repair()) retries it; nothing is acknowledged as durable."""
+        from ..core import metrics
+
+        ops: list = []
+        current: dict[str, dict[str, str]] = {}
+        dicts: dict[str, dict[str, dict]] = {}
+        for kind in KINDS:
+            encode = CODECS[kind][0]
+            shadow = self._shadow[kind]
+            kind_strings: dict[str, str] = {}
+            kind_dicts: dict[str, dict] = {}
+            for key, obj in self._live_objects(kind).items():
+                d = encode(obj)
+                s = canonical(d)
+                kind_strings[key] = s
+                kind_dicts[key] = d
+                if shadow.get(key) != s:
+                    ops.append(["put", kind, key, d])
+            for key in shadow:
+                if key not in kind_strings:
+                    ops.append(["del", kind, key])
+            current[kind] = kind_strings
+            dicts[kind] = kind_dicts
+        counters = self._current_counters()
+        rv = self._rv if resource_version is None else int(resource_version)
+        if not ops and counters == self._counters and rv == self._rv:
+            return None
+        record = {
+            "seq": self._seq + 1,
+            "rv": rv,
+            "counters": counters,
+            "ops": ops,
+        }
+        try:
+            self.wal.append(
+                canonical(record).encode(), detail=f"seq={record['seq']}"
+            )
+        except Exception:
+            self.retry_pending = True
+            raise
+        # Only past the fsync is the diff consumed.
+        self._seq = record["seq"]
+        self._rv = rv
+        self._counters = counters
+        self._shadow = current
+        self._state = dicts
+        self._commits_since_snapshot += 1
+        self.retry_pending = False
+        metrics.store_commits_total.inc()
+        metrics.store_wal_bytes.set(self.wal.size)
+        if self._commits_since_snapshot >= self.snapshot_interval:
+            # Compaction failure must NOT poison this commit's ack: the
+            # record above is already fsync'd (the write IS durable), so a
+            # failed snapshot is logged and retried at the next commit —
+            # never surfaced as a write error.
+            try:
+                self.compact()
+            except OSError:
+                import logging
+
+                logging.getLogger("jobset_tpu.store").exception(
+                    "snapshot compaction failed; the WAL remains "
+                    "authoritative and compaction retries on the next "
+                    "commit"
+                )
+        return self._seq
+
+    def repair(self) -> None:
+        """Truncate a torn tail left by a failed append; the un-journaled
+        diff stays pending and the next commit() retries it."""
+        self.wal.repair()
+        from ..core import metrics
+
+        metrics.store_wal_bytes.set(self.wal.size)
+
+    def compact(self) -> None:
+        """Fold the WAL into a fresh full snapshot: write-temp, fsync,
+        atomic rename, fsync the directory, then truncate the WAL. A crash
+        at any point leaves either (old snapshot + full WAL) or (new
+        snapshot + prefix-skipped WAL) — both recover exactly."""
+        from ..core import metrics
+
+        t0 = time.perf_counter()
+        doc = {
+            "seq": self._seq,
+            "rv": self._rv,
+            "counters": self._counters,
+            "state": self._state,
+        }
+        snapshot_path = os.path.join(self.data_dir, SNAPSHOT_FILE)
+        tmp_path = snapshot_path + ".tmp"
+        try:
+            with open(tmp_path, "w") as f:
+                json.dump(doc, f, sort_keys=True, separators=(",", ":"))
+                f.flush()
+                os.fsync(f.fileno())
+        except OSError:
+            # Never leave a half-written tmp behind (recovery ignores it,
+            # but the next compaction should start clean).
+            try:
+                os.unlink(tmp_path)
+            except OSError:
+                pass
+            raise
+        os.replace(tmp_path, snapshot_path)
+        dir_fd = os.open(self.data_dir, os.O_RDONLY)
+        try:
+            os.fsync(dir_fd)
+        finally:
+            os.close(dir_fd)
+        self.wal.reset()
+        self._commits_since_snapshot = 0
+        metrics.store_snapshot_seconds.observe(time.perf_counter() - t0)
+        metrics.store_wal_bytes.set(self.wal.size)
+
+    def flush(self) -> None:
+        """fsync the WAL (drain path; appends already fsync per record)."""
+        self.wal.flush()
+
+    def close(self) -> None:
+        self.wal.close()
+        if self._lock_fd is not None:
+            os.close(self._lock_fd)  # releases the flock
+            self._lock_fd = None
+        if self.cluster is not None and self.cluster.store is self:
+            self.cluster.store = None
+        self.cluster = None
+
+    def hard_kill(self) -> None:
+        """Crash simulation for tests and chaos scenarios: release the
+        fds (the dir lock dies as it would with the process) with no
+        flush, no tail repair, no final commit — the on-disk bytes are
+        exactly what kill -9 at this instant would leave."""
+        self.wal.abandon()
+        if self._lock_fd is not None:
+            os.close(self._lock_fd)
+            self._lock_fd = None
+        if self.cluster is not None and self.cluster.store is self:
+            self.cluster.store = None
+        self.cluster = None
+
+
+__all__ = ["Store", "StoreError", "StoreWriteError", "KINDS"]

@@ -1,0 +1,211 @@
+"""Append-only, CRC-framed, fsync'd write-ahead log.
+
+One file of back-to-back records, each framed as::
+
+    +----------------+----------------+------------------+
+    | length (u32 LE)| crc32 (u32 LE) | payload (length) |
+    +----------------+----------------+------------------+
+
+The payload is canonical JSON (store.py owns the schema). A record is
+*committed* only once its bytes AND an ``fsync`` have completed — append()
+returns after the fsync, so an acknowledged append survives ``kill -9``.
+
+Crash tolerance is asymmetric by design:
+
+* the **tail** may be torn (a crash mid-append leaves a partial frame):
+  ``recover()`` stops at the first frame whose header is short, whose
+  payload is short, or whose CRC mismatches, and truncates the file back
+  to the last intact frame boundary so future appends extend a clean log;
+* everything **before** the tail is trusted — frames are only ever
+  appended at the durable end (``repair()`` restores that invariant after
+  a failed append), so interior corruption cannot occur in operation and
+  would indicate external damage (recovery still stops safely at it).
+
+Chaos: every append is one arrival at the ``store.write`` injection point.
+``latency`` delays the fsync; ``enospc`` fails the append before any byte
+lands; ``torn`` writes a partial frame to disk and then fails — the
+simulated crash-mid-write. A failed append leaves the log needing
+``repair()`` (truncate back to the durable end) before the next append.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+from typing import Optional
+
+_HEADER = struct.Struct("<II")  # (payload length, payload crc32)
+
+
+class StoreError(Exception):
+    """Base class for persistence failures."""
+
+
+class StoreWriteError(StoreError):
+    """A WAL append failed; the record is NOT committed and the log needs
+    repair() before the next append."""
+
+
+class WriteAheadLog:
+    def __init__(self, path: str, injector=None):
+        self.path = path
+        # Chaos plane: consulted once per append at `store.write`; None
+        # falls through to the process-global injector (CLI --inject).
+        self.injector = injector
+        self._f = None
+        # End offset of the last durable (fsync-acknowledged) frame; the
+        # only position appends may start from.
+        self._durable_end = 0
+        self._needs_repair = False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def recover(self) -> tuple[list[dict], bool]:
+        """Scan the log from the start, returning (records, torn_tail).
+
+        Intact frames decode to their JSON payloads; the scan stops at the
+        first torn/corrupt frame and truncates it away. Leaves the file
+        open, positioned for append at the durable end."""
+        import json
+
+        records: list[dict] = []
+        flags = os.O_RDWR | os.O_CREAT
+        fd = os.open(self.path, flags, 0o644)
+        self._f = os.fdopen(fd, "r+b")
+        f = self._f
+        f.seek(0, os.SEEK_END)
+        size = f.tell()
+        f.seek(0)
+        good_end = 0
+        while True:
+            header = f.read(_HEADER.size)
+            if len(header) < _HEADER.size:
+                break
+            length, crc = _HEADER.unpack(header)
+            payload = f.read(length)
+            if len(payload) < length or zlib.crc32(payload) != crc:
+                break
+            try:
+                records.append(json.loads(payload))
+            except ValueError:
+                break  # CRC collision on garbage: treat as torn
+            good_end = f.tell()
+        torn = size > good_end
+        if torn:
+            f.truncate(good_end)
+            f.flush()
+            os.fsync(f.fileno())
+        f.seek(good_end)
+        self._durable_end = good_end
+        self._needs_repair = False
+        return records, torn
+
+    def close(self) -> None:
+        if self._f is not None:
+            try:
+                self.flush()
+            finally:
+                self._f.close()
+                self._f = None
+
+    def abandon(self) -> None:
+        """Crash simulation (tests/chaos): drop the fd with NO flush or
+        tail repair, leaving the file exactly as kill -9 would. (Appends
+        already fsync per record, so only an un-acknowledged torn tail can
+        be in flight.)"""
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+    # -- append path -------------------------------------------------------
+
+    def _check_chaos(self, detail: str) -> Optional[object]:
+        injector = self.injector
+        if injector is None:
+            from ..chaos import get_injector
+
+            injector = get_injector()
+        if injector is None:
+            return None
+        return injector.check("store.write", detail)
+
+    def append(self, payload: bytes, detail: str = "") -> None:
+        """Durably append one frame (write + flush + fsync). Raises
+        StoreWriteError on failure; the caller must repair() before the
+        next append (the file may hold a torn tail)."""
+        if self._needs_repair:
+            raise StoreWriteError(
+                "write-ahead log has a torn tail from a failed append; "
+                "repair() before appending"
+            )
+        frame = _HEADER.pack(len(payload), zlib.crc32(payload)) + payload
+        f = self._f
+        fault = self._check_chaos(detail)
+        if fault is not None:
+            from ..chaos.injector import KIND_LATENCY, KIND_TORN
+
+            if fault.kind == KIND_LATENCY:
+                if fault.delay_s > 0:
+                    import time as _t
+
+                    _t.sleep(fault.delay_s)
+            elif fault.kind == KIND_TORN:
+                # Crash-mid-write simulation: a partial frame reaches disk,
+                # the fsync never happens, the record is NOT acknowledged.
+                self._needs_repair = True
+                f.write(frame[: max(1, len(frame) // 2)])
+                f.flush()
+                raise StoreWriteError(
+                    f"chaos: torn write at {detail or 'store.write'} "
+                    f"(seq {fault.seq})"
+                )
+            else:  # enospc / any error kind: fail before any byte lands
+                self._needs_repair = True
+                raise StoreWriteError(
+                    f"chaos: injected {fault.kind} at "
+                    f"{detail or 'store.write'} (seq {fault.seq})"
+                )
+        try:
+            f.write(frame)
+            f.flush()
+            os.fsync(f.fileno())
+        except OSError as exc:
+            self._needs_repair = True
+            raise StoreWriteError(f"wal append failed: {exc}") from exc
+        self._durable_end += len(frame)
+
+    def repair(self) -> None:
+        """Truncate back to the last durable frame boundary after a failed
+        append, restoring the appendable invariant."""
+        f = self._f
+        f.truncate(self._durable_end)
+        f.flush()
+        os.fsync(f.fileno())
+        f.seek(self._durable_end)
+        self._needs_repair = False
+
+    def reset(self) -> None:
+        """Empty the log (after its contents were compacted into a durable
+        snapshot)."""
+        f = self._f
+        f.truncate(0)
+        f.flush()
+        os.fsync(f.fileno())
+        f.seek(0)
+        self._durable_end = 0
+        self._needs_repair = False
+
+    def flush(self) -> None:
+        f = self._f
+        f.flush()
+        os.fsync(f.fileno())
+
+    @property
+    def size(self) -> int:
+        """Durable byte size of the log."""
+        return self._durable_end
+
+    @property
+    def needs_repair(self) -> bool:
+        return self._needs_repair

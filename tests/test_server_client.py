@@ -852,3 +852,293 @@ def test_queue_crud_and_gang_admission_over_http(server, client):
     with pytest.raises(ApiError) as err:
         client.queue_status("tenant-a")
     assert err.value.status == 404
+
+
+# ---------------------------------------------------------------------------
+# Durable store integration: shutdown, drain, crash-restart continuity
+# ---------------------------------------------------------------------------
+
+
+def test_stop_wakes_parked_long_poll_watcher(server, client):
+    """A watcher parked in a long poll must not stall shutdown by up to
+    its poll timeout: stop() notifies the watch condition and the watcher
+    returns its (empty) partial batch immediately."""
+    import threading
+
+    _, rv = client.list_with_version()
+    result = {}
+
+    def park():
+        # Generous timeout: without the stop-wake this poll would park the
+        # handler thread (and block a same-thread stop) for 30s.
+        result["response"] = client.watch(
+            "default", resource_version=rv, timeout=30.0
+        )
+
+    watcher = threading.Thread(target=park, daemon=True)
+    watcher.start()
+    time.sleep(0.3)  # let the watcher reach the condition wait
+    t0 = time.monotonic()
+    server.stop()
+    watcher.join(timeout=5.0)
+    assert not watcher.is_alive()
+    assert time.monotonic() - t0 < 5.0
+    events, _ = result["response"]
+    assert events == []  # partial (empty) batch, not an error
+
+
+def test_drain_orders_fence_pump_flush_release(tmp_path, monkeypatch):
+    """Satellite: graceful drain ordering — writes fenced (503 +
+    Retry-After) BEFORE the final pump, WAL flushed after it, leader lease
+    released last."""
+    import http.client
+
+    from jobset_tpu.core import make_cluster
+    from jobset_tpu.core.lease import FileLease, LeaderElector
+    from jobset_tpu.store import Store
+    from jobset_tpu.utils.clock import Clock
+
+    cluster = make_cluster(clock=Clock())
+    store = Store(str(tmp_path / "data"))
+    store.recover(cluster)
+    elector = LeaderElector(
+        FileLease(str(tmp_path / "leader.lease")), "drain-test",
+        lease_duration=15.0, retry_period=0.1,
+    )
+    # Long tick interval: the background pump must not invoke the spy
+    # below before drain() does (the spy's in-pump write probe asserts the
+    # fence is already up, which is only true inside drain).
+    server = ControllerServer(
+        "127.0.0.1:0", cluster=cluster, tick_interval=60.0, elector=elector
+    ).start()
+    try:
+        assert server.pump_if_leader()  # acquire the lease
+        client = JobSetClient(server.address)
+        client.create(SIMPLE_YAML.format(name="pre-drain"))
+        assert elector.is_leading
+
+        order = []
+        orig_pump = server.pump_if_leader
+        orig_flush = store.flush
+        orig_release = elector.release
+
+        def spy_pump():
+            # The fence must already be up when the final pump runs: a
+            # write issued from INSIDE the pump phase sees 503+Retry-After.
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", server.port, timeout=5
+            )
+            conn.request(
+                "POST",
+                "/apis/jobset.x-k8s.io/v1alpha2/namespaces/default/jobsets",
+                body=SIMPLE_YAML.format(name="during-drain"),
+            )
+            resp = conn.getresponse()
+            order.append(("pump", resp.status, resp.getheader("Retry-After")))
+            resp.read()
+            conn.close()
+            return orig_pump()
+
+        monkeypatch.setattr(server, "pump_if_leader", spy_pump)
+        monkeypatch.setattr(
+            store, "flush", lambda: (order.append("flush"), orig_flush())[1]
+        )
+        monkeypatch.setattr(
+            elector, "release",
+            lambda: (order.append("release"), orig_release())[1],
+        )
+
+        phases = server.drain()
+        assert phases == [
+            "writes-fenced", "final-pump", "wal-flushed", "lease-released"
+        ]
+        assert order == [("pump", 503, "5"), "flush", "release"]
+        assert not elector.is_leading
+        # The fenced write never landed; the pre-drain one is durable.
+        assert "default/during-drain" not in store.serialized_state()["jobsets"]
+        assert "default/pre-drain" in store.serialized_state()["jobsets"]
+    finally:
+        server.stop()
+        store.close()
+
+
+def test_watch_continuity_across_crash_restart(tmp_path):
+    """Satellite: an informer holding a pre-restart resourceVersion gets
+    410 Gone from the recovered server (the rv counter survives, the event
+    window does not — etcd-compaction semantics) and relists cleanly into
+    the recovered state; the resumed watch then streams post-restart
+    events with no replays."""
+    from jobset_tpu.client import WatchGone
+    from jobset_tpu.core import make_cluster
+    from jobset_tpu.store import Store
+    from jobset_tpu.utils.clock import Clock
+
+    data_dir = str(tmp_path / "data")
+    cluster = make_cluster(clock=Clock())
+    store = Store(data_dir)
+    store.recover(cluster)
+    server1 = ControllerServer(
+        "127.0.0.1:0", cluster=cluster, tick_interval=0.05
+    ).start()
+    client1 = JobSetClient(server1.address)
+    client1.create(SIMPLE_YAML.format(name="early"))
+    _, held_rv = client1.list_with_version()  # the informer's held rv
+    for i in range(3):  # writes after the held rv, so held_rv < crash rv
+        client1.create(SIMPLE_YAML.format(name=f"late{i}"))
+    pre_crash = {
+        raw["metadata"]["name"]: raw for raw in client1.list_raw()
+    }
+    server1.stop()  # per-write fsync means stop-without-flush loses nothing
+    store.close()
+
+    # Restart: fresh process-equivalent — new cluster, recovered store,
+    # new server (new port).
+    cluster2 = make_cluster(clock=Clock())
+    store2 = Store(data_dir)
+    stats = store2.recover(cluster2)
+    assert stats["jobsets"] == 4
+    server2 = ControllerServer(
+        "127.0.0.1:0", cluster=cluster2, tick_interval=0.05
+    ).start()
+    try:
+        client2 = JobSetClient(server2.address)
+        # Pre-restart rv -> 410 Gone, never a silently stale watch.
+        with pytest.raises(WatchGone):
+            client2.watch("default", resource_version=held_rv, timeout=0.5)
+        # Relist: the recovered state, bit-identical manifests, and a
+        # resumable rv that continued (not restarted) the global counter.
+        items, rv1 = client2.list_with_version()
+        assert {i["metadata"]["name"] for i in items} == set(pre_crash)
+        assert rv1 >= held_rv
+        for raw in items:
+            assert raw == pre_crash[raw["metadata"]["name"]]
+        # The resumed watch streams post-restart events, no replays.
+        client2.create(SIMPLE_YAML.format(name="after-restart"))
+        events, _ = client2.watch(
+            "default", resource_version=rv1, timeout=5.0
+        )
+        names = [
+            (e["type"], e["object"]["metadata"]["name"]) for e in events
+        ]
+        assert ("ADDED", "after-restart") in names
+        assert all(n == "after-restart" for _, n in names)
+    finally:
+        server2.stop()
+        store2.close()
+
+
+def test_informer_relists_into_recovered_state_after_restart(tmp_path):
+    """The full client-side loop: a ResourceInformer started against the
+    recovered server with a stale rv survives the 410 (internal relist)
+    and converges on the recovered object set."""
+    from jobset_tpu.client import ResourceInformer
+    from jobset_tpu.core import make_cluster
+    from jobset_tpu.store import Store
+    from jobset_tpu.utils.clock import Clock
+
+    data_dir = str(tmp_path / "data")
+    cluster = make_cluster(clock=Clock())
+    store = Store(data_dir)
+    store.recover(cluster)
+    server1 = ControllerServer(
+        "127.0.0.1:0", cluster=cluster, tick_interval=0.05
+    ).start()
+    client1 = JobSetClient(server1.address)
+    for i in range(3):
+        client1.create(SIMPLE_YAML.format(name=f"keep{i}"))
+    server1.stop()
+    store.close()
+
+    cluster2 = make_cluster(clock=Clock())
+    store2 = Store(data_dir)
+    store2.recover(cluster2)
+    server2 = ControllerServer(
+        "127.0.0.1:0", cluster=cluster2, tick_interval=0.05
+    ).start()
+    informer = None
+    try:
+        client2 = JobSetClient(server2.address)
+        informer = ResourceInformer(client2).start()
+        deadline = time.monotonic() + 10.0
+        expected = {f"keep{i}" for i in range(3)}
+        while time.monotonic() < deadline:
+            if set(informer.cache) == expected and informer.has_synced():
+                break
+            time.sleep(0.05)
+        assert set(informer.cache) == expected
+    finally:
+        if informer is not None:
+            informer.stop()
+        server2.stop()
+        store2.close()
+
+
+def test_write_with_failed_store_commit_carries_warning_and_retries(tmp_path):
+    """A write whose WAL append fails is applied in memory (its reconcile
+    effects cannot be unwound) but is NOT crash-durable: the 2xx response
+    carries a Warning: 299 header, the error is counted, and the next
+    successful commit journals the pending diff — after which recovery
+    holds both writes."""
+    import http.client
+
+    from jobset_tpu.chaos.injector import FaultInjector, KIND_ENOSPC
+    from jobset_tpu.core import make_cluster, metrics
+    from jobset_tpu.store import Store
+    from jobset_tpu.utils.clock import Clock
+
+    injector = FaultInjector(seed=2)
+    injector.add_rule("store.write", KIND_ENOSPC, times=1)
+    cluster = make_cluster(clock=Clock())
+    store = Store(str(tmp_path / "data"), injector=injector)
+    store.recover(cluster)
+    # Long tick interval: no background pump commit races the fault slot.
+    server = ControllerServer(
+        "127.0.0.1:0", cluster=cluster, tick_interval=60.0
+    ).start()
+    try:
+        errors_before = metrics.store_write_errors_total.total()
+
+        def post(name):
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", server.port, timeout=10
+            )
+            conn.request(
+                "POST",
+                "/apis/jobset.x-k8s.io/v1alpha2/namespaces/default/jobsets",
+                body=SIMPLE_YAML.format(name=name),
+            )
+            resp = conn.getresponse()
+            warning = resp.getheader("Warning")
+            resp.read()
+            conn.close()
+            return resp.status, warning
+
+        status, warning = post("flaky-disk")
+        assert status == 201
+        assert warning is not None and "not yet crash-durable" in warning
+        assert metrics.store_write_errors_total.total() == errors_before + 1
+        # The object IS live despite the failed journal append.
+        assert JobSetClient(server.address).get("flaky-disk") is not None
+
+        # Idle-pump retry: no further writes needed — the pending diff is
+        # journaled by the next pump round even on a quiet system.
+        assert store.retry_pending
+        server.pump()
+        assert not store.retry_pending
+        assert "default/flaky-disk" in store.serialized_state()["jobsets"]
+
+        status, warning = post("healthy-again")
+        assert status == 201
+        assert warning is None  # healthy store: durable before the ack
+    finally:
+        server.stop()
+    store.hard_kill()
+
+    fresh = make_cluster(clock=Clock())
+    recovered = Store(str(tmp_path / "data"))
+    stats = recovered.recover(fresh)
+    # The retried diff and the later write both recovered.
+    assert set(recovered.serialized_state()["jobsets"]) == {
+        "default/flaky-disk", "default/healthy-again"
+    }
+    recovered.close()
