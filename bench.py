@@ -818,6 +818,146 @@ def _bank_restart(result: dict) -> None:
     _bank_sidecar_key("restart", result)
 
 
+def run_slo_bench(args) -> dict:
+    """Lifecycle-SLO bench (docs/observability.md): the standard 64-create
+    split driven through the real apiserver — queue-gated admission, gang
+    placement, readiness — followed by a seeded pod-crash burst and full
+    gang recovery. Time-to-admission / time-to-ready / restart-recovery
+    come from the jobset_slo_* histograms with raw recording on, so the
+    banked p50/p99 are exact, giving future PRs a lifecycle-latency
+    regression baseline alongside the throughput figures."""
+    from jobset_tpu import chaos
+    from jobset_tpu.api import FailurePolicy
+    from jobset_tpu.chaos import FaultInjector
+    from jobset_tpu.client import JobSetClient
+    from jobset_tpu.core import make_cluster, metrics
+    from jobset_tpu.server import ControllerServer
+    from jobset_tpu.testing import make_jobset, make_replicated_job
+    from jobset_tpu.utils.clock import Clock
+
+    topology_key = "tpu-slice"
+    splits = 64
+    per = max(1, args.replicas // splits)
+    total_pods = splits * per * args.pods_per_job
+    crash_rate, crash_seed = 0.25, 17
+
+    metrics.reset()
+    slo_hists = (
+        metrics.slo_time_to_admission_seconds,
+        metrics.slo_time_to_ready_seconds,
+        metrics.slo_restart_recovery_seconds,
+    )
+    for h in slo_hists:
+        h.enable_raw()
+
+    # Real clock: the SLO tracker measures on the cluster clock, and this
+    # bench wants wall latencies, not virtual time.
+    cluster = make_cluster(clock=Clock())
+    cluster.add_topology(
+        topology_key, num_domains=args.domains,
+        nodes_per_domain=args.nodes_per_domain, capacity=16,
+    )
+    # Long tick interval: the synchronous post-write pump and explicit
+    # pump() calls below do the work deterministically.
+    server = ControllerServer(cluster=cluster, tick_interval=30.0).start()
+    injector = FaultInjector(seed=crash_seed)
+    try:
+        client = JobSetClient(f"http://{server.address}", timeout=900.0)
+        # Admission rides through a real queue (ample quota) so the
+        # admission SLO measures the queue plane's latency, not a
+        # constant zero.
+        client.create_queue({
+            "kind": "Queue",
+            "metadata": {"name": "slo-bench"},
+            "spec": {"quota": {"pods": float(total_pods)}},
+        })
+        t0 = time.perf_counter()
+        for i in range(splits):
+            js = (
+                make_jobset(f"slo-{i:03d}")
+                .exclusive_placement(topology_key)
+                .queue("slo-bench")
+                .failure_policy(FailurePolicy(max_restarts=4))
+                .replicated_job(
+                    make_replicated_job("w").replicas(per)
+                    .parallelism(args.pods_per_job)
+                    .completions(args.pods_per_job).obj()
+                )
+                .obj()
+            )
+            # backoffLimit 0: the crash burst escalates to failure-policy
+            # GANG restarts (the recovery SLO under test) instead of being
+            # absorbed by per-pod retries.
+            for rjob in js.spec.replicated_jobs:
+                rjob.template.spec.backoff_limit = 0
+            client.create(js)
+        deadline = time.monotonic() + 600.0
+        while (
+            metrics.slo_time_to_ready_seconds.n < splits
+            and time.monotonic() < deadline
+        ):
+            server.pump()
+        create_s = time.perf_counter() - t0
+        if metrics.slo_time_to_ready_seconds.n != splits:
+            raise RuntimeError(
+                f"slo bench: only {metrics.slo_time_to_ready_seconds.n}"
+                f"/{splits} gangs reached ready"
+            )
+
+        # Seeded crash burst -> gang restarts -> measure recovery.
+        with server.lock:
+            crashed = chaos.pod_crash_burst(
+                cluster, injector, rate=crash_rate
+            )
+        restarted = {name.rsplit("-w-", 1)[0] for name in crashed}
+        t1 = time.perf_counter()
+        while (
+            metrics.slo_restart_recovery_seconds.n < len(restarted)
+            and time.monotonic() < deadline
+        ):
+            server.pump()
+        recovery_s = time.perf_counter() - t1
+        if metrics.slo_restart_recovery_seconds.n < len(restarted):
+            raise RuntimeError(
+                f"slo bench: only {metrics.slo_restart_recovery_seconds.n}"
+                f"/{len(restarted)} gangs recovered"
+            )
+    finally:
+        server.stop()
+
+    def exact(h) -> dict:
+        return {
+            "count": h.n,
+            "p50": round(h.exact_percentile(0.50), 6),
+            "p99": round(h.exact_percentile(0.99), 6),
+            "mean": round(h.sum / h.n, 6) if h.n else None,
+        }
+
+    return {
+        "scenario": (
+            f"{splits}-create split via real apiserver (queue admission, "
+            f"exclusive placement), {crash_rate:g} seeded crash burst, "
+            f"gang recovery"
+        ),
+        "jobsets": splits,
+        "pods": total_pods,
+        "create_wall_s": round(create_s, 3),
+        "recovery_wall_s": round(recovery_s, 3),
+        "crashed_pods": len(crashed),
+        "restarted_jobsets": len(restarted),
+        "crash_seed": crash_seed,
+        "time_to_admission_s": exact(metrics.slo_time_to_admission_seconds),
+        "time_to_ready_s": exact(metrics.slo_time_to_ready_seconds),
+        "restart_recovery_s": exact(
+            metrics.slo_restart_recovery_seconds
+        ),
+    }
+
+
+def _bank_slo(result: dict) -> None:
+    _bank_sidecar_key("slo", result)
+
+
 def preload_domain_gradient(cluster, topology_key: str, max_frac: float = 0.9):
     """Synthetic background occupancy with a load gradient: domain i has
     ~(i/D)*max_frac of its capacity consumed. Every incoming job then
@@ -2056,6 +2196,13 @@ def main() -> int:
              "BENCH_PLACEMENT_TPU_LAST.json under 'restart'",
     )
     parser.add_argument(
+        "--slo", action="store_true",
+        help="run ONLY the lifecycle-SLO bench (64-create split via the "
+             "real apiserver + seeded crash burst; exact time-to-admission"
+             "/time-to-ready/restart-recovery p50/p99) and bank it into "
+             "BENCH_PLACEMENT_TPU_LAST.json under 'slo'",
+    )
+    parser.add_argument(
         "--model-only", action="store_true",
         help="probe the accelerator and run ONLY the model-MFU worker "
              "(prints its JSON line; used for opportunistic capture while "
@@ -2085,6 +2232,19 @@ def main() -> int:
             "metric": "restart_recovery_throughput",
             "value": result["at_10k"]["objects_per_sec"],
             "unit": "objects/s",
+            "detail": result,
+        }))
+        return 0
+
+    if args.slo:
+        # Pure control-plane bench: the lifecycle latencies never touch an
+        # accelerator (greedy placement path).
+        result = run_slo_bench(args)
+        _bank_slo(result)
+        print(json.dumps({
+            "metric": "slo_time_to_ready_p99",
+            "value": result["time_to_ready_s"]["p99"],
+            "unit": "s",
             "detail": result,
         }))
         return 0

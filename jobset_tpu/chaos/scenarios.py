@@ -59,8 +59,21 @@ def pod_crash_burst(
                 "cluster.pod", detail or f"{key[0]}/{key[1]}"
             )
             if fault is not None and fault.kind == KIND_CRASH:
+                from ..api import keys as api_keys  # constants-only module
+
+                owner = pod.labels.get(api_keys.JOBSET_NAME_KEY)
                 cluster.fail_pod(*key)
                 crashed.append(key[1])
+                # First-class event on the owning JobSet so the injection
+                # lands in its flight-recorder timeline at virtual-clock
+                # time (the seq joins the injector's log).
+                if owner:
+                    cluster.record_event(
+                        "JobSet", owner, "Warning", "ChaosPodCrash",
+                        f"chaos: injected crash of pod {key[1]} "
+                        f"(injection seq {fault.seq})",
+                        namespace=key[0],
+                    )
     finally:
         if rule is not None:
             injector.remove_rule(rule)
@@ -87,8 +100,16 @@ def node_drain(
         for name in sorted(cluster.nodes):
             fault = injector.check("cluster.node", name)
             if fault is not None and fault.kind == KIND_DRAIN:
-                cluster.fail_node(name)
+                failed_jobs = cluster.fail_node(name)
                 drained.append(name)
+                # One event per drained node (kind Node, so it reaches the
+                # events API / field selectors without attaching to any
+                # single JobSet's timeline).
+                cluster.record_event(
+                    "Node", name, "Warning", "ChaosNodeDrain",
+                    f"chaos: injected drain failed {len(failed_jobs)} "
+                    f"job(s) (injection seq {fault.seq})",
+                )
     finally:
         if rule is not None:
             injector.remove_rule(rule)

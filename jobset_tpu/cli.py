@@ -9,6 +9,11 @@ a subcommand CLI (`python -m jobset_tpu ...`):
 * ``solver``       — run the TPU placement-solver sidecar (gRPC).
 * ``apply / get / delete / suspend / resume`` — kubectl-style verbs against
                      a running controller.
+* ``describe``     — the flight-recorder timeline of one JobSet (creation
+                     -> admission -> placement -> ready -> restarts, with
+                     trace ids; GET /debug/timeline).
+* ``debug-bundle`` — one-command postmortem export (timelines, traces,
+                     metrics, health, SLO summary) into a .tgz.
 * ``label-nodes``  — the nodeSelector placement-strategy tool
                      (`hack/label_nodes/label_nodes.py` analog): labels and
                      taints every node of each topology domain so JobSets
@@ -132,7 +137,35 @@ def _build_parser() -> argparse.ArgumentParser:
         "--watch-timeout", type=float, default=0.0,
         help="stop watching after N seconds (0 = until interrupted)",
     )
+    g.add_argument(
+        "--for", dest="for_object", default="", metavar="KIND/NAME",
+        help="(events) only events whose involved object is KIND/NAME, "
+             "e.g. --for jobset/my-gang (server-side field-selector "
+             "filtering, the kubectl analog)",
+    )
     _add_server_flag(g)
+
+    de = sub.add_parser(
+        "describe",
+        help="correlated flight-recorder timeline of one jobset "
+             "(creation -> admission -> placement -> ready -> restarts, "
+             "with trace ids; docs/observability.md)",
+    )
+    de.add_argument("resource", choices=["jobset"])
+    de.add_argument("name")
+    de.add_argument("-o", "--output", choices=["wide", "json", "yaml"],
+                    default="wide")
+    _add_server_flag(de)
+
+    db = sub.add_parser(
+        "debug-bundle",
+        help="capture a postmortem tarball from a running controller: "
+             "timelines, traces, metrics scrape, SLO summary, aggregated "
+             "health + config, store/WAL stats",
+    )
+    db.add_argument("output", metavar="OUT.tgz",
+                    help="path of the .tgz bundle to write")
+    _add_server_flag(db)
 
     d = sub.add_parser("delete", help="delete a jobset")
     d.add_argument("name")
@@ -374,6 +407,29 @@ def _cmd_get(args) -> int:
     resource = "jobsets" if args.resource == "jobset" else args.resource
     resource = "queues" if resource == "queue" else resource
 
+    # Validate --for BEFORE any resource branch returns: silently ignoring
+    # the flag on `get jobsets --for ...` would look like filtering.
+    list_events = client.events
+    if getattr(args, "for_object", ""):
+        if resource != "events":
+            print("--for applies to events only", file=sys.stderr)
+            return 2
+        kind_token, _, involved_name = args.for_object.partition("/")
+        kind = {
+            "jobset": "JobSet", "jobsets": "JobSet",
+            "job": "Job", "jobs": "Job",
+            "pod": "Pod", "pods": "Pod",
+        }.get(kind_token.lower())
+        if kind is None or not involved_name:
+            print(f"--for wants KIND/NAME (jobset|job|pod), got "
+                  f"{args.for_object!r}", file=sys.stderr)
+            return 2
+        # Scope to -n/--namespace: same-named objects in other namespaces
+        # must not leak into the listing.
+        list_events = lambda: client.events_for(  # noqa: E731
+            kind, involved_name, namespace=args.namespace
+        )
+
     if getattr(args, "watch", False):
         if resource != "jobsets":
             print("--watch supports jobsets only", file=sys.stderr)
@@ -440,7 +496,7 @@ def _cmd_get(args) -> int:
         "pods": lambda: client.pods(args.namespace),
         "jobs": lambda: client.jobs(args.namespace),
         "services": lambda: client.services(args.namespace),
-        "events": client.events,
+        "events": list_events,
     }[resource]()
     if args.output == "json":
         print(json.dumps({"items": items}, indent=2))
@@ -567,6 +623,98 @@ def _format_jobset_row(raw: dict, header: bool = False) -> str:
     return row
 
 
+def _cmd_describe(args) -> int:
+    """`jobset-tpu describe jobset NAME`: render the flight-recorder
+    timeline served at /debug/timeline/{ns}/{name} — the first triage step
+    in docs/troubleshooting.md."""
+    import yaml as _yaml
+
+    from .client import ApiError
+
+    try:
+        timeline = _client(args).timeline(args.name, args.namespace)
+    except ApiError as exc:
+        print(f"error: {exc.message}", file=sys.stderr)
+        return 1
+    if args.output == "json":
+        print(json.dumps(timeline, indent=2))
+        return 0
+    if args.output == "yaml":
+        print(_yaml.safe_dump(timeline, sort_keys=False))
+        return 0
+    print(_render_timeline(timeline))
+    return 0
+
+
+def _render_timeline(tl: dict) -> str:
+    """Human rendering of one timeline payload (kubectl-describe idiom:
+    identity header, phase latencies, then the correlated event table)."""
+    phases = tl.get("phases") or {}
+    created = phases.get("createdAt")
+    lines = [
+        f"Name:         {tl['namespace']}/{tl['name']}",
+        f"UID:          {tl['uid']}"
+        + ("   (deleted)" if tl.get("deleted") else ""),
+        f"Restarts:     {phases.get('restarts', 0)}"
+        f"   Recoveries: {phases.get('recoveries', 0)}"
+        f"   Terminal: {tl.get('terminalState') or '-'}",
+        "Phases:",
+    ]
+    for label, key in (
+        ("admission", "timeToAdmissionS"),
+        ("scheduled", "timeToScheduledS"),
+        ("ready", "timeToReadyS"),
+    ):
+        value = phases.get(key)
+        lines.append(
+            f"  {label:<12} "
+            + (f"+{value:.3f}s" if value is not None else "-")
+        )
+    if phases.get("inRestartOutage"):
+        lines.append("  ** restart outage in progress (not yet ready) **")
+    lines.append("Timeline:")
+    lines.append(
+        f"  {'TIME(+s)':>9}  {'SOURCE':<9} {'REASON':<28} "
+        f"{'TRACE':<12} MESSAGE"
+    )
+    for entry in tl.get("entries", ()):
+        offset = (
+            f"+{entry['time'] - created:.3f}"
+            if created is not None else f"{entry['time']:.3f}"
+        )
+        trace = (entry.get("traceId") or "")[:12]
+        lines.append(
+            f"  {offset:>9}  {entry['source']:<9} "
+            f"{entry['reason'][:28]:<28} {trace:<12} {entry['message']}"
+        )
+    chaos = tl.get("chaos") or []
+    if chaos:
+        lines.append(f"Chaos injections ({len(chaos)}, in injected order):")
+        for fault in chaos:
+            lines.append(
+                f"  seq={fault['seq']:<5} {fault['point']:<18} "
+                f"{fault['kind']:<8} {fault['detail']}"
+            )
+    commit = tl.get("storeCommit")
+    if commit:
+        lines.append(
+            f"Store:        last durable commit seq={commit['seq']} "
+            f"rv={commit['rv']}"
+        )
+    return "\n".join(lines)
+
+
+def _cmd_debug_bundle(args) -> int:
+    from .obs.bundle import write_bundle
+
+    stats = write_bundle(_client(args), args.output)
+    print(
+        f"wrote {stats['path']}: {len(stats['members'])} members, "
+        f"{stats['timelines']} jobset timeline(s)"
+    )
+    return 0
+
+
 def _cmd_delete(args) -> int:
     _client(args).delete(args.name, args.namespace)
     print(f"jobset.jobset.x-k8s.io/{args.name} deleted")
@@ -640,6 +788,8 @@ _COMMANDS = {
     "solver": _cmd_solver,
     "apply": _cmd_apply,
     "get": _cmd_get,
+    "describe": _cmd_describe,
+    "debug-bundle": _cmd_debug_bundle,
     "delete": _cmd_delete,
     "suspend": _cmd_suspend,
     "resume": _cmd_resume,

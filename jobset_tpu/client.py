@@ -338,8 +338,30 @@ class JobSetClient:
     def services(self, namespace: str = "default") -> list[dict]:
         return self._request("GET", f"/api/v1/namespaces/{namespace}/services")["items"]
 
-    def events(self) -> list[dict]:
-        return self._request("GET", "/api/v1/events")["items"]
+    def events(self, field_selector: Optional[str] = None) -> list[dict]:
+        """Retained cluster events; `field_selector` filters server-side
+        (`involvedObject.kind=JobSet,involvedObject.name=x`, plus `reason`
+        and `type` — the kubectl --field-selector subset)."""
+        path = "/api/v1/events"
+        if field_selector:
+            from urllib.parse import quote
+
+            path += f"?fieldSelector={quote(field_selector)}"
+        return self._request("GET", path)["items"]
+
+    def events_for(self, kind: str, name: str,
+                   namespace: Optional[str] = None) -> list[dict]:
+        """Events whose involved object is `kind`/`name` (the kubectl
+        `get events --for kind/name` analog, filtered server-side).
+        `namespace` additionally scopes to the involved object's
+        namespace — pass it when same-named objects may exist across
+        namespaces."""
+        selector = (
+            f"involvedObject.kind={kind},involvedObject.name={name}"
+        )
+        if namespace:
+            selector += f",involvedObject.namespace={namespace}"
+        return self.events(field_selector=selector)
 
     def nodes(self) -> list[dict]:
         return self._request("GET", "/api/v1/nodes")["items"]
@@ -409,6 +431,30 @@ class JobSetClient:
 
     def metrics_text(self) -> str:
         return self._request("GET", "/metrics")
+
+    # -- flight recorder / debug surfaces ---------------------------------
+
+    def timeline(self, name: str, namespace: str = "default") -> dict:
+        """Per-JobSet flight-recorder timeline (phases, ordered entries,
+        chaos injections, store commit point; docs/observability.md)."""
+        return self._request(
+            "GET", f"/debug/timeline/{namespace}/{name}"
+        )
+
+    def slo_summary(self) -> dict:
+        """`/debug/slo`: time-to-admission / time-to-ready / restart-
+        recovery percentiles plus the solver-fallback ratio."""
+        return self._request("GET", "/debug/slo")
+
+    def health(self) -> dict:
+        """`/debug/health`: the aggregated componentstatuses analog with
+        an overall healthy/degraded verdict."""
+        return self._request("GET", "/debug/health")
+
+    def traces(self, limit: int = 64) -> dict:
+        """`/debug/traces`: recent finished traces (limit=0 for the whole
+        ring) plus the dropped-span counter."""
+        return self._request("GET", f"/debug/traces?limit={int(limit)}")
 
 
 # ---------------------------------------------------------------------------

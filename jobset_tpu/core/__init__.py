@@ -23,11 +23,15 @@ def make_cluster(
     `placement` defaults to `SolverPlacement`, which behaves exactly like the
     greedy path unless the `TPUPlacementSolver` feature gate is enabled.
     """
+    from ..obs.slo import LifecycleTracker
     from ..placement import webhooks
     from ..placement.provider import SolverPlacement
     from ..queue.manager import QueueManager
 
     cluster = Cluster(clock=clock, auto_ready=auto_ready)
+    # Flight-recorder lifecycle tracking (obs/slo.py): phase marks per
+    # JobSet on the cluster clock, feeding timelines + SLO histograms.
+    cluster.slo = LifecycleTracker(cluster.clock)
     JobController(cluster)
     Scheduler(cluster)
     JobSetReconciler(

@@ -30,6 +30,7 @@ from ..api import keys
 from ..api.defaulting import apply_defaults
 from ..api.types import Condition, JobSet, JobSetStatus, Taint
 from ..api.validation import validate_create, validate_update
+from ..obs.trace import current_trace_id
 from ..utils.clock import Clock, FakeClock
 from .objects import (
     Event,
@@ -175,6 +176,10 @@ class Cluster:
         # attach()): None means in-memory only — the default, byte-for-byte
         # the pre-store behavior.
         self.store = None
+        # Lifecycle SLO tracker (obs.slo.LifecycleTracker; make_cluster
+        # attaches it): per-JobSet phase marks feeding the flight-recorder
+        # timeline and the jobset_slo_* histograms. None = untracked.
+        self.slo = None
         # Pod webhook chain: callables(cluster, pod) -> None / raise AdmissionError.
         self.pod_mutators: list[Callable] = []
         self.pod_validators: list[Callable] = []
@@ -214,7 +219,8 @@ class Cluster:
         if job_key:
             self.dirty_placement_job_keys.add(job_key)
 
-    def record_event(self, kind: str, name: str, etype: str, reason: str, message: str):
+    def record_event(self, kind: str, name: str, etype: str, reason: str,
+                     message: str, namespace: str = ""):
         self.events_total += 1
         self.events.append(
             Event(
@@ -225,6 +231,10 @@ class Cluster:
                 message=message,
                 time=self.clock.now(),
                 seq=self.events_total,
+                namespace=namespace,
+                # Correlate by id, not timestamp heuristics: the flight-
+                # recorder timeline and /debug/traces join on this.
+                trace_id=current_trace_id() or "",
             )
         )
 
@@ -362,10 +372,15 @@ class Cluster:
         # Admission-queue interception (Kueue webhook analog): a JobSet
         # naming a queue is forced suspended at creation and registered as
         # a pending workload — the QueueManager resumes it on admission.
-        if self.queue_manager is not None and js.spec.queue_name:
+        queue_held = self.queue_manager is not None and js.spec.queue_name
+        if queue_held:
             self.queue_manager.intercept_create(js)
         self.jobsets[key] = js
         self.enqueue_reconcile(*key)
+        # Flight recorder: open the lifecycle record (creation mark; an
+        # unqueued gang also takes its ~0 admission mark here).
+        if self.slo is not None:
+            self.slo.on_created(js, queued=bool(queue_held))
         # Admission-time plan prefetch: the placement solve is dispatched the
         # moment the JobSet is admitted and overlaps the watch->reconcile
         # hop, so the creation pass consumes a finished plan (provider.py).
@@ -373,7 +388,6 @@ class Cluster:
         # may wait arbitrarily long (or forever) for quota — the solve
         # would be stale by admission and is requested by the creation
         # pass itself when actually needed.
-        queue_held = self.queue_manager is not None and js.spec.queue_name
         reconciler = self.jobset_reconciler
         if (
             reconciler is not None
@@ -446,6 +460,11 @@ class Cluster:
         # Release any admission-queue quota the gang held.
         if self.queue_manager is not None:
             self.queue_manager.forget(js.metadata.uid)
+        # Mark (not drop) the lifecycle record: the flight recorder keeps
+        # serving a deleted JobSet's timeline for postmortems; a recreation
+        # under the same name opens a fresh record.
+        if self.slo is not None:
+            self.slo.on_deleted(js.metadata.uid)
 
     def get_jobset(self, namespace: str, name: str) -> Optional[JobSet]:
         return self.jobsets.get((namespace, name))
@@ -866,6 +885,7 @@ class Cluster:
             "JobSet", key[1], "Warning", "ReconcileError",
             f"reconcile raised (consecutive failure {failures}); "
             f"requeued in {backoff:.1f}s",
+            namespace=key[0],
         )
         # Later of any existing requeue and this backoff: the TTL requeue
         # path shares the map, and a sooner retry must not defeat the rate

@@ -44,6 +44,12 @@ def set_from_string(spec: str) -> None:
         set_gate(name, value.lower() in ("true", "1", "yes"))
 
 
+def all_gates() -> dict[str, bool]:
+    """Snapshot of every gate's current value (build_info labeling,
+    /debug/health)."""
+    return dict(_gates)
+
+
 def reset() -> None:
     _gates.clear()
     _gates.update(_DEFAULTS)

@@ -263,6 +263,40 @@ store_recovery_seconds = Histogram(
     "Wall time of cold-start recovery (snapshot load + WAL replay + "
     "derived-state rebuild into a fresh cluster)",
 )
+# Lifecycle SLOs (obs/slo.py, docs/observability.md): measured off the
+# per-JobSet flight-recorder timeline on the cluster clock — virtual time
+# in simulations (deterministic in tests), wall time in a live controller.
+slo_time_to_admission_seconds = Histogram(
+    "jobset_slo_time_to_admission_seconds",
+    "JobSet creation -> gang admission (queue-managed gangs: the "
+    "QueueAdmitted resume; unqueued gangs admit at creation, observing ~0)",
+)
+slo_time_to_ready_seconds = Histogram(
+    "jobset_slo_time_to_ready_seconds",
+    "JobSet creation -> first moment every replicated job reports all "
+    "replicas ready (the gang's cold time-to-ready)",
+)
+slo_restart_recovery_seconds = Histogram(
+    "jobset_slo_restart_recovery_seconds",
+    "Gang restart (failure-policy recreate) -> all replicas ready again "
+    "(the outage window a training job actually experiences)",
+)
+build_info = Gauge(
+    "jobset_build_info",
+    "Always 1, labeled with the build's version, the active JAX backend, "
+    "and the enabled feature gates (the kube_pod_info idiom: join other "
+    "series against these labels)",
+    label_names=("version", "backend", "gates"),
+)
+
+
+def set_build_info(version: str, backend: str, gates: str) -> None:
+    """(Re)stamp the single build_info row; the old row is dropped so a
+    backend that initializes later (jax loads lazily) never leaves a stale
+    duplicate series."""
+    with build_info._lock:
+        build_info._values.clear()
+        build_info._values[(version, backend, gates)] = 1.0
 
 
 ALL_COUNTERS = (
@@ -283,6 +317,9 @@ ALL_HISTOGRAMS = (
     solver_solve_time_seconds,
     store_snapshot_seconds,
     store_recovery_seconds,
+    slo_time_to_admission_seconds,
+    slo_time_to_ready_seconds,
+    slo_restart_recovery_seconds,
 )
 ALL_GAUGES = (
     solver_batch_occupancy,
@@ -293,6 +330,7 @@ ALL_GAUGES = (
     queue_pending_workloads,
     queue_admitted_workloads,
     store_wal_bytes,
+    build_info,
 )
 
 

@@ -170,3 +170,12 @@ class Event:
     message: str
     time: float = 0.0
     seq: int = 0
+    # Involved object's namespace ("" for cluster-scoped objects or
+    # legacy callers): the flight-recorder timeline filters on it so
+    # same-named JobSets in different namespaces never cross-pollute.
+    namespace: str = ""
+    # W3C trace id of the span active when the event was recorded ("" when
+    # none): the flight-recorder timeline correlates events to traces by
+    # this id instead of timestamp heuristics, and `GET /debug/traces`
+    # joins on it.
+    trace_id: str = ""

@@ -113,6 +113,7 @@ class PodReconciler:
                 keys.EVENT_WARNING,
                 keys.EXCLUSIVE_PLACEMENT_VIOLATION_REASON,
                 keys.EXCLUSIVE_PLACEMENT_VIOLATION_MESSAGE,
+                namespace=pod.metadata.namespace,
             )
             self.cluster.delete_pod(pod.metadata.namespace, pod.metadata.name)
             changed = True

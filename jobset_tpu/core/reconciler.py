@@ -94,6 +94,11 @@ class JobSetReconciler:
         owned = bucket_child_jobs(js, cluster.jobs_for_jobset(js))
         statuses = self.calculate_replicated_job_statuses(js, owned)
         self._update_replicated_job_statuses(js, statuses, ctx)
+        # Flight recorder: detect the all-placed / all-ready transitions
+        # off the statuses just computed (SLO phase marks; a few dict
+        # compares, so it stays off the latency radar).
+        if cluster.slo is not None:
+            cluster.slo.on_status(js, statuses, now)
 
         if jobset_finished(js):
             self._delete_jobs(owned.active, ctx)
@@ -107,6 +112,10 @@ class JobSetReconciler:
         if owned.failed:
             restarts_before = js.status.restarts
             execute_failure_policy(js, owned, ctx, now)
+            if js.status.restarts != restarts_before and cluster.slo is not None:
+                # Flight recorder: the restart-recovery outage window opens
+                # here and closes at the next all-ready transition.
+                cluster.slo.on_restart(js.metadata.uid, now)
             if (
                 js.status.restarts != restarts_before
                 and self.placement is not None
@@ -138,7 +147,8 @@ class JobSetReconciler:
         # Events fire only after the (always-successful, in-memory) status
         # update — same ordering contract as jobset_controller.go:248-263.
         for etype, reason, message in ctx.events:
-            self.cluster.record_event("JobSet", js.name, etype, reason, message)
+            self.cluster.record_event("JobSet", js.name, etype, reason,
+                                      message, namespace=js.namespace)
         metrics.reconcile_time_seconds.observe(_time.perf_counter() - t0)
         if ctx.requeue_next_tick:
             # Waiting on an in-flight solve: revisit next tick, not in this

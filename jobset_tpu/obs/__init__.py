@@ -12,6 +12,15 @@ dependencies:
   reconcile pump -> placement provider -> solver phases.
 * ``logging`` — a structured JSON log formatter that stamps every record
   with the active span's trace/span ids, so logs and traces join on ids.
+* ``slo``     — per-JobSet lifecycle SLO tracking (time-to-admission,
+  time-to-ready, restart-recovery histograms) measured on the cluster
+  clock, summarized at ``GET /debug/slo``.
+* ``timeline`` — the flight recorder: a per-JobSet assembler correlating
+  phase marks, conditions, trace-id-stamped events, chaos injections and
+  store commit points into one ordered record
+  (``GET /debug/timeline/{ns}/{name}``, ``jobset-tpu describe``).
+* ``bundle``  — one-command postmortem export (``jobset-tpu
+  debug-bundle OUT.tgz``) and its loader.
 
 Everything here is stdlib-only and import-light: the control plane's hot
 paths call into it on every reconcile, so span start/end is a few dict
@@ -30,9 +39,11 @@ from .trace import (
     span,
 )
 from .logging import JsonLogFormatter, configure_json_logging, get_logger
+from .slo import LifecycleTracker
 
 __all__ = [
     "JsonLogFormatter",
+    "LifecycleTracker",
     "SpanContext",
     "TRACER",
     "Tracer",

@@ -1,0 +1,128 @@
+"""Postmortem debug bundles: one command captures everything an operator
+(or a bug report) needs to reconstruct what the control plane was doing.
+
+``write_bundle(client, path)`` scrapes a live controller over its public
+API — no privileged side channel, so it works against any reachable
+controller — and writes one ``.tgz``:
+
+* ``manifest.json``   — bundle format version, capture time, server URL,
+  member list (the loader validates against this);
+* ``health.json``     — the aggregated `/debug/health` verdict, including
+  the config block and store/WAL stats;
+* ``slo.json``        — `/debug/slo` percentile summary;
+* ``traces.json``     — the tracer's full finished-trace ring;
+* ``events.json``     — every retained cluster event;
+* ``jobsets.json``    — every JobSet manifest (status included);
+* ``timelines.json``  — one flight-recorder timeline per JobSet, keyed
+  ``namespace/name``;
+* ``metrics.prom``    — a raw Prometheus text scrape.
+
+``load_bundle(path)`` round-trips the tarball back into a dict of parsed
+members (JSON members decoded, ``metrics.prom`` as text) — the loader the
+acceptance test drives, and the entry point for offline analysis tools.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import tarfile
+import time
+
+BUNDLE_FORMAT = 1
+
+_JSON_MEMBERS = (
+    "manifest.json",
+    "health.json",
+    "slo.json",
+    "traces.json",
+    "events.json",
+    "jobsets.json",
+    "timelines.json",
+)
+
+
+def write_bundle(client, path: str) -> dict:
+    """Capture a debug bundle from the controller behind `client` into the
+    tarball at `path`. Returns a summary (members, jobset/timeline
+    counts). Partial capture is better than none: a JobSet deleted between
+    the health snapshot and its timeline fetch is skipped, not fatal."""
+    from ..client import ApiError
+
+    health = client.health()
+    payloads: dict[str, object] = {
+        "health.json": health,
+        "slo.json": client.slo_summary(),
+        "traces.json": client.traces(limit=0),
+        "events.json": client.events(),
+    }
+
+    jobsets: list[dict] = []
+    timelines: dict[str, dict] = {}
+    for key in health.get("cluster", {}).get("jobsetKeys", []):
+        namespace, _, name = key.partition("/")
+        try:
+            jobsets.append(client.get_raw(name, namespace))
+            timelines[key] = client.timeline(name, namespace)
+        except ApiError:
+            continue  # deleted mid-capture
+    payloads["jobsets.json"] = jobsets
+    payloads["timelines.json"] = timelines
+
+    metrics_text = client.metrics_text()
+
+    members = sorted([*_JSON_MEMBERS, "metrics.prom"])
+    payloads["manifest.json"] = {
+        "format": BUNDLE_FORMAT,
+        "capturedAt": time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+        ),
+        "server": client.base_url,
+        "members": members,
+    }
+
+    with tarfile.open(path, "w:gz") as tar:
+        for member in members:
+            if member == "metrics.prom":
+                data = metrics_text.encode()
+            else:
+                data = json.dumps(
+                    payloads[member], indent=1, sort_keys=True
+                ).encode()
+            info = tarfile.TarInfo(member)
+            info.size = len(data)
+            tar.addfile(info, io.BytesIO(data))
+
+    return {
+        "path": path,
+        "members": members,
+        "jobsets": len(jobsets),
+        "timelines": len(timelines),
+    }
+
+
+def load_bundle(path: str) -> dict:
+    """Parse a debug bundle back into ``{member_name: payload}`` (JSON
+    members decoded, ``metrics.prom`` as text). Raises ValueError on a
+    tarball that is not a debug bundle or whose manifest disagrees with
+    its contents."""
+    out: dict[str, object] = {}
+    with tarfile.open(path, "r:gz") as tar:
+        for member in tar.getmembers():
+            fileobj = tar.extractfile(member)
+            if fileobj is None:
+                continue
+            data = fileobj.read()
+            if member.name.endswith(".json"):
+                out[member.name] = json.loads(data)
+            else:
+                out[member.name] = data.decode()
+    manifest = out.get("manifest.json")
+    if not isinstance(manifest, dict) or "members" not in manifest:
+        raise ValueError(f"{path!r} is not a debug bundle (no manifest)")
+    missing = [m for m in manifest["members"] if m not in out]
+    if missing:
+        raise ValueError(
+            f"debug bundle {path!r} is missing members {missing}"
+        )
+    return out

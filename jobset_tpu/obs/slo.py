@@ -1,0 +1,261 @@
+"""Lifecycle SLO instrumentation: the quantities placement-policy work
+optimizes for, measured per JobSet off the flight-recorder phase marks.
+
+Three histograms (registered in ``core/metrics.py`` so the doc-drift lint
+covers them) capture the gang lifecycle latencies an operator actually
+cares about:
+
+* ``jobset_slo_time_to_admission_seconds`` — creation -> gang admission.
+  Queue-managed gangs admit when the QueueManager resumes them; unqueued
+  gangs admit at creation (the observation is ~0 — truthful, and it keeps
+  the histogram's population meaning "every gang" instead of "gangs that
+  happened to be queued").
+* ``jobset_slo_time_to_ready_seconds`` — creation -> the first moment
+  every replicated job reports all replicas ready (cold time-to-ready).
+* ``jobset_slo_restart_recovery_seconds`` — gang restart (failure-policy
+  recreate) -> all replicas ready again: the outage window a training job
+  experiences. Overlapping restarts before recovery extend ONE window
+  (measured from the first unrecovered restart), matching how an operator
+  counts downtime.
+
+Time comes from the cluster clock: virtual in simulations (so tests see
+deterministic durations), wall time in a live controller.
+
+The tracker is a per-cluster observer (``cluster.slo``) fed by three
+hooks — ``on_created`` (Cluster.create_jobset), ``on_admitted``
+(QueueManager._admit), ``on_restart``/``on_status`` (the reconciler) —
+and keeps one bounded record per JobSet uid. Records double as the
+timeline's phase marks (``obs/timeline.py``); they are observability
+state, never persisted, and cost a few dict ops per reconcile.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import OrderedDict
+from typing import Optional
+
+# Bounded phase-mark history per record: enough for a long restart history
+# without letting a crash-looping gang grow memory.
+MAX_MARKS = 64
+# Bounded record population (uids): evicts oldest when exceeded, so a
+# create/delete churn workload cannot grow tracker memory.
+MAX_RECORDS = 8192
+
+
+class LifecycleTracker:
+    """Per-cluster lifecycle phase tracker; one record per JobSet uid."""
+
+    def __init__(self, clock):
+        self.clock = clock
+        self.records: "OrderedDict[str, dict]" = OrderedDict()
+        self._by_key: dict[tuple[str, str], str] = {}  # (ns, name) -> uid
+
+    # -- hooks (called by the cluster / queue manager / reconciler) -------
+
+    def on_created(self, js, queued: bool) -> None:
+        now = self.clock.now()
+        uid = js.metadata.uid
+        record = {
+            "key": (js.metadata.namespace, js.metadata.name),
+            "uid": uid,
+            # Replicated-job names survive deletion so the timeline's
+            # chaos attribution keeps exact child prefixes even for the
+            # postmortem (spec-gone) path.
+            "rjob_names": [r.name for r in js.spec.replicated_jobs],
+            "created_at": now,
+            "queued": queued,
+            "admitted_at": None,
+            "scheduled_at": None,
+            "first_ready_at": None,
+            "ready": False,
+            "restarts": 0,
+            "restart_started_at": None,
+            "recoveries": 0,
+            "deleted_at": None,
+            "marks": [],
+        }
+        self.records[uid] = record
+        self._by_key[record["key"]] = uid
+        self._mark(record, now, "Created", "jobset created")
+        if not queued:
+            # Unqueued gangs admit at creation: the admission SLO is ~0 by
+            # construction and the phase mark keeps timelines uniform.
+            self._admit_locked(record, now, "admitted at creation (no queue)")
+        while len(self.records) > MAX_RECORDS:
+            evicted_uid, evicted = self.records.popitem(last=False)
+            # Only drop the name-index entry if it still points at the
+            # evicted record: a recreated JobSet under the same name owns
+            # the key now, and evicting its predecessor must not blind
+            # record_for() to the live gang.
+            if self._by_key.get(evicted["key"]) == evicted_uid:
+                self._by_key.pop(evicted["key"], None)
+
+    def on_admitted(self, uid: str, now: Optional[float] = None) -> None:
+        record = self.records.get(uid)
+        if record is None:
+            return
+        if now is None:
+            now = self.clock.now()
+        if record["admitted_at"] is None:
+            self._admit_locked(record, now, "gang admitted by queue")
+        else:
+            # Re-admission after preemption/voluntary requeue: a mark, not
+            # a second time-to-admission sample.
+            self._mark(record, now, "Readmitted", "gang re-admitted")
+
+    def _admit_locked(self, record: dict, now: float, detail: str) -> None:
+        from ..core import metrics
+
+        record["admitted_at"] = now
+        metrics.slo_time_to_admission_seconds.observe(
+            max(0.0, now - record["created_at"])
+        )
+        self._mark(record, now, "Admitted", detail)
+
+    def on_restart(self, uid: str, now: Optional[float] = None) -> None:
+        record = self.records.get(uid)
+        if record is None:
+            return
+        if now is None:
+            now = self.clock.now()
+        record["restarts"] += 1
+        record["ready"] = False
+        if record["restart_started_at"] is None:
+            # Overlapping restarts before recovery extend ONE outage
+            # window, measured from the first unrecovered restart.
+            record["restart_started_at"] = now
+        self._mark(
+            record, now, "RestartStarted",
+            f"gang restart {record['restarts']}",
+        )
+
+    def on_status(self, js, statuses, now: Optional[float] = None) -> None:
+        """One call per reconcile status pass: detect the all-active
+        (placement done) and all-ready transitions."""
+        record = self.records.get(js.metadata.uid)
+        if record is None:
+            return
+        replicas = {
+            r.name: int(r.replicas) for r in js.spec.replicated_jobs
+        }
+        total = sum(replicas.values())
+        if total == 0:
+            return
+        by_name = {s.name: s for s in statuses}
+        if len(by_name) < len(replicas):
+            return
+        if now is None:
+            now = self.clock.now()
+        from ..core import metrics
+
+        all_active = all(
+            by_name[name].active >= n for name, n in replicas.items()
+        )
+        if all_active and record["scheduled_at"] is None:
+            record["scheduled_at"] = now
+            self._mark(
+                record, now, "Scheduled",
+                "all replicated jobs have active (placed) pods",
+            )
+        all_ready = all(
+            by_name[name].ready >= n for name, n in replicas.items()
+        )
+        if all_ready and not record["ready"]:
+            record["ready"] = True
+            if record["restart_started_at"] is not None:
+                outage = max(0.0, now - record["restart_started_at"])
+                metrics.slo_restart_recovery_seconds.observe(outage)
+                record["restart_started_at"] = None
+                record["recoveries"] += 1
+                self._mark(
+                    record, now, "Recovered",
+                    f"gang ready again {outage:.3f}s after restart",
+                )
+            if record["first_ready_at"] is None:
+                record["first_ready_at"] = now
+                metrics.slo_time_to_ready_seconds.observe(
+                    max(0.0, now - record["created_at"])
+                )
+                self._mark(
+                    record, now, "Ready", "every replica ready (gang up)"
+                )
+        elif not all_ready:
+            record["ready"] = False
+
+    def on_deleted(self, uid: str) -> None:
+        """Mark the record deleted but KEEP it (until ring eviction): the
+        postmortem use case is describing a JobSet precisely after it
+        failed and was deleted. A recreation under the same name opens a
+        fresh record that takes over the name index."""
+        record = self.records.get(uid)
+        if record is None:
+            return
+        now = self.clock.now()
+        record["deleted_at"] = now
+        self._mark(record, now, "Deleted", "jobset deleted")
+
+    # Back-compat alias (the pre-review hook name).
+    forget = on_deleted
+
+    # -- read side ---------------------------------------------------------
+
+    def record_for(self, namespace: str, name: str) -> Optional[dict]:
+        uid = self._by_key.get((namespace, name))
+        return self.records.get(uid) if uid is not None else None
+
+    @staticmethod
+    def _mark(record: dict, now: float, phase: str, detail: str) -> None:
+        marks = record["marks"]
+        marks.append({"time": now, "phase": phase, "detail": detail})
+        if len(marks) > MAX_MARKS:
+            del marks[: len(marks) - MAX_MARKS]
+
+
+# ---------------------------------------------------------------------------
+# /debug/slo summary
+# ---------------------------------------------------------------------------
+
+
+def _finite(value: float) -> Optional[float]:
+    """nan (empty histogram) and inf (overflow bucket) are not JSON."""
+    return round(value, 6) if math.isfinite(value) else None
+
+
+def _histogram_summary(h) -> dict:
+    mean = h.sum / h.n if h.n else None
+    return {
+        "count": h.n,
+        "p50": _finite(h.percentile(0.50)),
+        "p90": _finite(h.percentile(0.90)),
+        "p99": _finite(h.percentile(0.99)),
+        "mean": round(mean, 6) if mean is not None else None,
+    }
+
+
+def summary() -> dict:
+    """The `/debug/slo` payload: percentile summaries of the three SLO
+    histograms plus the solver-fallback ratio (local fallbacks over all
+    placement solve outcomes — the fraction of placements that did NOT get
+    the optimizing path)."""
+    from ..core import metrics
+
+    fallbacks = metrics.solver_fallbacks_total.total()
+    solves = metrics.solver_solve_time_seconds.n
+    attempts = fallbacks + solves
+    return {
+        "timeToAdmissionSeconds": _histogram_summary(
+            metrics.slo_time_to_admission_seconds
+        ),
+        "timeToReadySeconds": _histogram_summary(
+            metrics.slo_time_to_ready_seconds
+        ),
+        "restartRecoverySeconds": _histogram_summary(
+            metrics.slo_restart_recovery_seconds
+        ),
+        "solverFallbackRatio": (
+            round(fallbacks / attempts, 4) if attempts else 0.0
+        ),
+        "solverFallbacks": fallbacks,
+        "solverSolves": solves,
+    }

@@ -1,0 +1,245 @@
+"""Per-JobSet flight-recorder timeline: one ordered, queryable answer to
+"what happened to JobSet X and how long did each phase take?".
+
+The assembler is query-time: it does not record anything itself, it
+*correlates* what the subsystems already record —
+
+* lifecycle phase marks from the SLO tracker (``obs/slo.py``): created,
+  admitted, scheduled (all pods placed), ready, restart/recovery windows;
+* JobSet status conditions (suspend/resume, startup policy, terminal);
+* cluster ``Event`` records for the JobSet — including the queue plane's
+  admission/preemption/requeue decisions and the pump's containment
+  events — each stamped with the trace id active at emission, so the
+  timeline joins ``GET /debug/traces`` by id, not timestamp heuristics;
+* chaos injections from the fault injector's log: faults whose detail
+  names this JobSet (or one of its pods/child jobs), plus control-plane-
+  wide faults (``solver.*``, ``store.write``) that affect every gang's
+  placement/durability, in injected (seq) order;
+* the durable store's last commit point covering this JobSet (seq /
+  resourceVersion), when ``--data-dir`` is on.
+
+Event/condition/phase entries merge into one time-ordered ``entries``
+list (ties broken phase < condition < event, then by event seq — all
+deterministic, so a seeded simulation run assembles a byte-identical
+timeline). Chaos injections keep their own ``chaos`` list ordered by
+injection seq: the injector deliberately records no wall time (its log is
+the byte-identity artifact of seeded runs), and seq order IS the injected
+order.
+
+Served at ``GET /debug/timeline/{namespace}/{name}`` and rendered by
+``jobset-tpu describe jobset NAME``.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+# Injection points whose faults are control-plane-wide: not attributable
+# to one JobSet by detail string, but material to every gang's placement
+# (solver path) or durability (store writes).
+_GLOBAL_CHAOS_POINTS = ("solver.", "store.")
+
+_DETAIL_SPLIT = re.compile(r"[\s/]+")
+
+# Merge-order priority for same-instant entries: a phase mark explains the
+# condition/event that follows it at the same virtual timestamp.
+_SOURCE_ORDER = {"phase": 0, "condition": 1, "event": 2}
+
+
+def _chaos_matches(detail: str, name: str, child_prefixes) -> bool:
+    """Does an injection-log detail string name this JobSet or one of its
+    children? Details are namespaced names ("ns/jobset", "ns/pod-name"),
+    request lines ("POST /apis/.../jobsets/name"), or addresses. Child
+    object names extend a `<jobset>-<replicatedJob>-` prefix — matched
+    against the spec's actual replicated-job names so a JobSet named
+    "train" never claims faults belonging to "train-2"."""
+    for token in _DETAIL_SPLIT.split(detail):
+        if token == name or any(
+            token.startswith(p) for p in child_prefixes
+        ):
+            return True
+    return False
+
+
+def _entry(
+    time: float,
+    source: str,
+    type_: str,
+    reason: str,
+    message: str,
+    trace_id: str = "",
+    seq: int = 0,
+) -> dict:
+    return {
+        "time": round(float(time), 6),
+        "source": source,
+        "type": type_,
+        "reason": reason,
+        "message": message,
+        "traceId": trace_id or None,
+        "seq": seq,
+    }
+
+
+def assemble(
+    cluster,
+    namespace: str,
+    name: str,
+    injector=None,
+) -> Optional[dict]:
+    """Build the timeline for one JobSet, or None when the cluster has
+    never heard of it. Caller holds the cluster lock (the server route
+    does); the assembly is read-only."""
+    js = cluster.get_jobset(namespace, name)
+    tracker = getattr(cluster, "slo", None)
+    record = (
+        tracker.record_for(namespace, name) if tracker is not None else None
+    )
+    if js is None and record is None:
+        return None
+
+    entries: list[dict] = []
+
+    # Phase marks (SLO tracker). A recovered-from-crash cluster has no
+    # tracker record for pre-crash JobSets; creation falls back to
+    # metadata below and the phases block degrades to nulls.
+    if record is not None:
+        for mark in record["marks"]:
+            entries.append(_entry(
+                mark["time"], "phase", mark["phase"], mark["phase"],
+                mark["detail"],
+            ))
+    elif js is not None:
+        entries.append(_entry(
+            js.metadata.creation_time, "phase", "Created", "Created",
+            "jobset created (no lifecycle record: created before this "
+            "controller started)",
+        ))
+
+    # Status conditions.
+    if js is not None:
+        for c in js.status.conditions:
+            entries.append(_entry(
+                c.last_transition_time, "condition", c.type,
+                c.reason or c.type,
+                f"{c.type}={c.status}"
+                + (f": {c.message}" if c.message else ""),
+            ))
+
+    # Cluster events for this JobSet (queue decisions, restarts,
+    # containment, placement violations all arrive as JobSet events).
+    # Namespace-filtered: a legacy event recorded without one ("") still
+    # matches, but same-named JobSets in different namespaces never
+    # cross-pollute.
+    for e in cluster.events:
+        if (
+            e.object_kind == "JobSet"
+            and e.object_name == name
+            and e.namespace in ("", namespace)
+        ):
+            entries.append(_entry(
+                e.time, "event", e.type, e.reason, e.message,
+                trace_id=e.trace_id, seq=e.seq,
+            ))
+
+    entries.sort(
+        key=lambda x: (x["time"], _SOURCE_ORDER[x["source"]], x["seq"])
+    )
+
+    # Chaos injections, in injected (seq) order.
+    if injector is None:
+        from ..chaos import get_injector
+
+        injector = get_injector()
+    chaos: list[dict] = []
+    if injector is not None:
+        # Exact child-name prefixes: from the live spec, else from the
+        # replicated-job names the lifecycle record preserved past
+        # deletion, else (record-less legacy object) the generic
+        # "<name>-" heuristic.
+        if js is not None:
+            child_prefixes = tuple(
+                f"{name}-{rjob.name}-"
+                for rjob in js.spec.replicated_jobs
+            )
+        elif record is not None and record.get("rjob_names"):
+            child_prefixes = tuple(
+                f"{name}-{rjob_name}-"
+                for rjob_name in record["rjob_names"]
+            )
+        else:
+            child_prefixes = (f"{name}-",)
+        for fault in injector.log_snapshot():
+            point = fault["point"]
+            if point.startswith(_GLOBAL_CHAOS_POINTS) or _chaos_matches(
+                fault["detail"], name, child_prefixes
+            ):
+                chaos.append({
+                    "seq": fault["seq"],
+                    "point": point,
+                    "kind": fault["kind"],
+                    "arrival": fault["arrival"],
+                    "detail": fault["detail"],
+                })
+
+    # Last durable commit covering this JobSet (store enabled only).
+    store = getattr(cluster, "store", None)
+    store_commit = None
+    if store is not None:
+        store_commit = getattr(store, "last_jobset_commit", {}).get(
+            f"{namespace}/{name}"
+        )
+
+    created_at = (
+        record["created_at"] if record is not None
+        else (js.metadata.creation_time if js is not None else None)
+    )
+    phases = {
+        "createdAt": created_at,
+        "admittedAt": record["admitted_at"] if record else None,
+        "scheduledAt": record["scheduled_at"] if record else None,
+        "firstReadyAt": record["first_ready_at"] if record else None,
+        "restarts": (
+            js.status.restarts if js is not None
+            else (record["restarts"] if record else 0)
+        ),
+        "recoveries": record["recoveries"] if record else 0,
+        "deletedAt": record.get("deleted_at") if record else None,
+        "inRestartOutage": bool(
+            record and record["restart_started_at"] is not None
+        ),
+    }
+    for src, dst in (
+        ("admittedAt", "timeToAdmissionS"),
+        ("scheduledAt", "timeToScheduledS"),
+        ("firstReadyAt", "timeToReadyS"),
+    ):
+        phases[dst] = (
+            round(phases[src] - created_at, 6)
+            if phases[src] is not None and created_at is not None
+            else None
+        )
+
+    trace_ids: list[str] = []
+    for entry in entries:
+        tid = entry["traceId"]
+        if tid and tid not in trace_ids:
+            trace_ids.append(tid)
+
+    return {
+        "namespace": namespace,
+        "name": name,
+        "uid": (
+            js.metadata.uid if js is not None else record["uid"]
+        ),
+        "deleted": js is None,
+        "terminalState": (
+            js.status.terminal_state if js is not None else None
+        ),
+        "phases": phases,
+        "entries": entries,
+        "chaos": chaos,
+        "storeCommit": dict(store_commit) if store_commit else None,
+        "traceIds": trace_ids,
+    }

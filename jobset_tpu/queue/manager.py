@@ -228,6 +228,7 @@ class QueueManager:
         self.cluster.record_event(
             "JobSet", js.name, keys.EVENT_NORMAL, keys.QUEUE_PENDING_REASON,
             f"workload queued in {wl.queue} (request {_fmt(wl.request)})",
+            namespace=js.metadata.namespace,
         )
         self._update_gauges()
 
@@ -248,6 +249,7 @@ class QueueManager:
                     "JobSet", new.name, keys.EVENT_NORMAL,
                     keys.QUEUE_REQUEUED_REASON,
                     "voluntarily suspended; quota released and requeued",
+                    namespace=new.metadata.namespace,
                 )
                 self._update_gauges()
             else:
@@ -315,6 +317,7 @@ class QueueManager:
                     keys.QUEUE_RELEASED_REASON,
                     f"finished; released {_fmt(wl.request)} back to "
                     f"{wl.queue}",
+                    namespace=wl.key[0],
                 )
                 changed = True
 
@@ -652,10 +655,15 @@ class QueueManager:
             qu[r] = qu.get(r, 0.0) + v
         js.spec.suspend = False
         cluster.enqueue_reconcile(*wl.key)
+        # Flight recorder: the time-to-admission SLO sample lands here
+        # (first admission only; re-admissions become phase marks).
+        if cluster.slo is not None:
+            cluster.slo.on_admitted(wl.uid, now)
         cluster.record_event(
             "JobSet", wl.key[1], keys.EVENT_NORMAL,
             keys.QUEUE_ADMITTED_REASON,
             f"admitted to {wl.queue} (request {_fmt(wl.request)})",
+            namespace=wl.key[0],
         )
         return True
 
@@ -697,6 +705,7 @@ class QueueManager:
             "JobSet", victim.key[1], keys.EVENT_WARNING, reason,
             f"{message}; requeued with backoff "
             f"({victim.eligible_at - now:.1f}s)",
+            namespace=victim.key[0],
         )
 
     def evict(self, uid: str, reason: str = keys.QUEUE_REQUEUED_REASON,

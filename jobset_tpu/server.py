@@ -41,9 +41,10 @@ import yaml
 
 logger = logging.getLogger("jobset_tpu.server")
 
+from . import __version__
 from .api import serialization
 from .api.types import Taint
-from .core import AdmissionError, Cluster, make_cluster, metrics
+from .core import AdmissionError, Cluster, features, make_cluster, metrics
 from .obs import trace as obs_trace
 from .utils.clock import Clock
 
@@ -51,6 +52,23 @@ from .utils.clock import Clock
 def _jobset_summary(js) -> dict:
     d = serialization.to_dict(js, include_status=True)
     return d
+
+
+def _jax_backend_label() -> str:
+    """Backend label for build_info/health WITHOUT forcing jax to
+    initialize: a pure control-plane process (greedy placement, numpy
+    scorer) never imports jax, and the health endpoint must not pay a
+    backend bring-up to answer."""
+    import sys
+
+    if "jax" not in sys.modules:
+        return "unloaded"
+    try:
+        import jax
+
+        return jax.default_backend()
+    except Exception:
+        return "unavailable"
 
 
 def _pod_dict(pod) -> dict:
@@ -116,11 +134,45 @@ def _event_dict(e) -> dict:
         "metadata": {"name": f"evt-{e.seq}", "namespace": "default"},
         "kind": e.object_kind,
         "name": e.object_name,
+        # Involved object's namespace ("" = cluster-scoped/legacy record).
+        "namespace": e.namespace or None,
         "type": e.type,
         "reason": e.reason,
         "message": e.message,
         "time": e.time,
+        # Trace of the span active at emission (flight-recorder join key).
+        "traceId": e.trace_id or None,
     }
+
+
+# fieldSelector keys accepted by GET /api/v1/events (the kubectl
+# `get events --field-selector` / `--for` contract): selector key ->
+# Event attribute.
+_EVENT_SELECTOR_FIELDS = {
+    "involvedObject.kind": "object_kind",
+    "involvedObject.name": "object_name",
+    "involvedObject.namespace": "namespace",
+    "reason": "reason",
+    "type": "type",
+}
+
+
+def _event_field_selector(selector: str):
+    """Compile `k=v[,k=v...]` into a predicate over Event records; raises
+    ValueError on an unsupported key (the apiserver 400s those too)."""
+    clauses = []
+    for part in filter(None, (p.strip() for p in selector.split(","))):
+        key, sep, value = part.partition("=")
+        if not sep:
+            raise ValueError(f"bad field selector clause {part!r}")
+        field = _EVENT_SELECTOR_FIELDS.get(key.strip())
+        if field is None:
+            raise ValueError(
+                f"unsupported event field selector {key.strip()!r} "
+                f"(supported: {', '.join(sorted(_EVENT_SELECTOR_FIELDS))})"
+            )
+        clauses.append((field, value.strip()))
+    return lambda e: all(getattr(e, f) == v for f, v in clauses)
 
 
 def _escape_pointer(token: str) -> str:
@@ -315,7 +367,24 @@ class ControllerServer:
 
     # ------------------------------------------------------------------
 
+    @staticmethod
+    def _stamp_build_info() -> None:
+        """(Re)stamp jobset_build_info (the kube_pod_info idiom). Called
+        at start AND per scrape/health read: jax loads lazily, so the
+        backend label flips from "unloaded" to the real backend the first
+        time it is read after initialization — a one-time stamp would
+        serve "unloaded" forever."""
+        gates = features.all_gates()
+        metrics.set_build_info(
+            version=__version__,
+            backend=_jax_backend_label(),
+            gates=",".join(sorted(n for n, on in gates.items() if on))
+            or "none",
+        )
+
     def start(self) -> "ControllerServer":
+        # Stamp before the first scrape can land.
+        self._stamp_build_info()
         serve = threading.Thread(target=self._httpd.serve_forever, daemon=True)
         pump = threading.Thread(target=self._pump_loop, daemon=True, name="pump")
         serve.start()
@@ -669,10 +738,16 @@ class ControllerServer:
     # ------------------------------------------------------------------
 
     # Endpoints that are themselves observability surfaces: tracing each
-    # scrape would flood the trace ring with trivial roots.
+    # scrape would flood the trace ring with trivial roots. Everything
+    # under /debug/ (timelines, SLO, health, traces) is covered by the
+    # prefix check in _is_observability_path.
     _UNTRACED_PATHS = frozenset(
-        {"/healthz", "/readyz", "/leaderz", "/metrics", "/debug/traces"}
+        {"/healthz", "/readyz", "/leaderz", "/metrics"}
     )
+
+    @classmethod
+    def _is_observability_path(cls, bare: str) -> bool:
+        return bare in cls._UNTRACED_PATHS or bare.startswith("/debug/")
 
     def _check_chaos(self, method: str, bare: str):
         """`apiserver.request` injection point: one arrival per API request
@@ -684,7 +759,7 @@ class ControllerServer:
             from .chaos import get_injector
 
             injector = get_injector()
-        if injector is None or bare in self._UNTRACED_PATHS:
+        if injector is None or self._is_observability_path(bare):
             return None
         fault = injector.check("apiserver.request", f"{method} {bare}")
         if fault is None:
@@ -715,7 +790,7 @@ class ControllerServer:
         # feature exists to keep.
         metrics.api_requests_in_flight.add(1)
         try:
-            if bare in self._UNTRACED_PATHS or (
+            if self._is_observability_path(bare) or (
                 parent is None and method == "GET"
             ):
                 return self._route_inner(method, path, body, headers)
@@ -753,6 +828,8 @@ class ControllerServer:
         if path == "/readyz":
             return (200, "ok") if self._ready.is_set() else (503, "not ready")
         if path == "/metrics":
+            # Keep the build_info backend label current (jax loads lazily).
+            self._stamp_build_info()
             # Content negotiation (the OpenMetrics contract): exemplars are
             # only legal in application/openmetrics-text — the classic
             # Prometheus text parser errors on the '#' exemplar token — so
@@ -778,6 +855,41 @@ class ControllerServer:
                 "traces": obs_trace.TRACER.finished_traces(limit=limit),
                 "dropped_spans": obs_trace.TRACER.dropped_spans,
             }
+        if path == "/debug/slo" and method == "GET":
+            # Lifecycle SLO percentile summary (docs/observability.md):
+            # time-to-admission / time-to-ready / restart-recovery from the
+            # jobset_slo_* histograms plus the solver-fallback ratio.
+            from .obs import slo as obs_slo
+
+            return 200, obs_slo.summary()
+        if path == "/debug/health" and method == "GET":
+            # Aggregated componentstatuses analog: one degraded/healthy
+            # verdict over leader lease, solver breaker, store durability,
+            # queue backlog and pump containment.
+            with self.lock:
+                return 200, self._health_payload_locked()
+        if path.startswith("/debug/timeline/") and method == "GET":
+            # /debug/timeline/{namespace}/{name}: the per-JobSet flight
+            # recorder (obs/timeline.py).
+            tl_parts = [p for p in path.split("/") if p]
+            if len(tl_parts) != 4:
+                return 404, {
+                    "error": "want /debug/timeline/{namespace}/{name}"
+                }
+            from .obs import timeline as obs_timeline
+
+            with self.lock:
+                timeline = obs_timeline.assemble(
+                    self.cluster, tl_parts[2], tl_parts[3],
+                    injector=self.injector,
+                )
+            if timeline is None:
+                return 404, {
+                    "error": f"no timeline for jobset "
+                             f"{tl_parts[2]}/{tl_parts[3]} (never created "
+                             f"on this controller)"
+                }
+            return 200, timeline
         if path == "/openapi/v2" and method == "GET":
             # Machine-readable schema of the wire format (the reference's
             # hack/swagger artifact analog; generators consume this).
@@ -859,7 +971,7 @@ class ControllerServer:
             if path.startswith(self.API_PREFIX):
                 result = self._route_jobsets(method, parts, body)
             elif parts[:2] == ["api", "v1"]:
-                result = self._route_core(method, parts, body)
+                result = self._route_core(method, parts, body, params)
             else:
                 return 404, {"error": f"no route for {method} {path}"}
             if method in ("POST", "PUT", "DELETE", "PATCH"):
@@ -1097,15 +1209,29 @@ class ControllerServer:
 
         return 405, {"error": f"{method} not allowed"}
 
-    def _route_core(self, method: str, parts: list[str], body: bytes):
+    def _route_core(self, method: str, parts: list[str], body: bytes,
+                    params: Optional[dict] = None):
         # parts: api, v1, ...
         rest = parts[2:]
         if rest[:1] == ["nodes"]:
             return self._route_nodes(method, rest, body)
         if rest[:1] == ["events"] and method == "GET":
             self._activate_watch_kind("events")
+            # fieldSelector (kubectl `get events --field-selector` /
+            # `--for` analog): involved-object filtering happens server-
+            # side instead of a client grep over every retained event.
+            selector = ((params or {}).get("fieldSelector") or [""])[0]
+            try:
+                keep = (
+                    _event_field_selector(selector)
+                    if selector else (lambda e: True)
+                )
+            except ValueError as exc:
+                return 400, {"error": str(exc)}
             return 200, {
-                "items": [_event_dict(e) for e in self.cluster.events],
+                "items": [
+                    _event_dict(e) for e in self.cluster.events if keep(e)
+                ],
                 "resourceVersion": self._watch_rv,
             }
         if len(rest) >= 3 and rest[0] == "namespaces":
@@ -1181,6 +1307,186 @@ class ControllerServer:
             )
             return 200, _node_dict(node)
         return 405, {"error": f"{method} not allowed on nodes"}
+
+    # ------------------------------------------------------------------
+    # Aggregated health (GET /debug/health)
+    # ------------------------------------------------------------------
+
+    # Cap the jobset key listing in the health payload: debug bundles walk
+    # it to fetch timelines, and an unbounded list would dominate the
+    # response on a 10k-gang cluster.
+    _HEALTH_MAX_JOBSET_KEYS = 2048
+
+    def _health_payload_locked(self) -> dict:
+        """One componentstatuses-style verdict (caller holds self.lock):
+        every component reports healthy + message; the overall status is
+        degraded when ANY component is unhealthy. Informational blocks
+        (build, config, cluster population, chaos) ride along so a debug
+        bundle's health.json stands alone."""
+        cluster = self.cluster
+        components: dict[str, dict] = {}
+
+        if self.elector is None:
+            components["leaderElection"] = {
+                "healthy": True,
+                "message": "leader election disabled (single replica)",
+                "leading": True,
+            }
+        else:
+            leading = self.elector.is_leading
+            components["leaderElection"] = {
+                "healthy": True,
+                "leading": leading,
+                "identity": self.elector.identity,
+                "message": (
+                    "holding the lease" if leading
+                    else "standby (reconciliation deferred to the leader)"
+                ),
+            }
+
+        breaker = int(metrics.solver_breaker_state.value())
+        breaker_name = {
+            metrics.BREAKER_CLOSED: "closed",
+            metrics.BREAKER_OPEN: "open",
+            metrics.BREAKER_HALF_OPEN: "half_open",
+        }.get(breaker, str(breaker))
+        degraded = metrics.placement_degraded.value() >= 1
+        fallbacks = metrics.solver_fallbacks_total.total()
+        components["solver"] = {
+            "healthy": breaker == metrics.BREAKER_CLOSED and not degraded,
+            "breakerState": breaker_name,
+            "greedyDegraded": degraded,
+            "fallbacksTotal": fallbacks,
+            "message": (
+                "solver placement active" if breaker == 0 and not degraded
+                else "degraded to greedy placement "
+                     f"(breaker {breaker_name}"
+                     + (", solve budget blown" if degraded else "")
+                     + ")"
+            ),
+        }
+
+        store = getattr(cluster, "store", None)
+        if store is None:
+            components["store"] = {
+                "healthy": True,
+                "enabled": False,
+                "message": "in-memory only (--data-dir off): no "
+                           "crash durability configured",
+            }
+        else:
+            pending = store.retry_pending
+            components["store"] = {
+                "healthy": not pending,
+                "enabled": True,
+                "pendingDiff": pending,
+                "walBytes": store.wal.size,
+                "seq": store.seq,
+                "resourceVersion": store.resource_version,
+                "commitsTotal": metrics.store_commits_total.total(),
+                "writeErrorsTotal": metrics.store_write_errors_total.total(),
+                "message": (
+                    "acknowledged writes exist that are NOT yet "
+                    "crash-durable (WAL append failed; retrying each "
+                    "commit)" if pending else "WAL healthy"
+                ),
+            }
+
+        manager = cluster.queue_manager
+        if manager is None or not manager.queues:
+            components["queue"] = {
+                "healthy": True,
+                "queues": 0 if manager is None else len(manager.queues),
+                "pendingWorkloads": 0,
+                "admittedWorkloads": 0,
+                "message": "no admission queues configured",
+            }
+        else:
+            pending_wl = sum(
+                1 for wl in manager.workloads.values()
+                if wl.state == "Pending"
+            )
+            admitted_wl = len(manager.workloads) - pending_wl
+            components["queue"] = {
+                "healthy": True,
+                "queues": len(manager.queues),
+                "pendingWorkloads": pending_wl,
+                "admittedWorkloads": admitted_wl,
+                "message": f"{pending_wl} pending / {admitted_wl} admitted "
+                           f"across {len(manager.queues)} queues",
+            }
+
+        contained = {
+            f"{ns}/{js_name}": count
+            for (ns, js_name), count in sorted(
+                cluster.reconcile_failures.items()
+            )
+        }
+        pump_errors = metrics.pump_errors_total.total()
+        components["pump"] = {
+            "healthy": not contained,
+            "containedJobSets": contained,
+            "pumpErrorsTotal": pump_errors,
+            "reconcilePanicsTotal": metrics.reconcile_panics_total.total(),
+            "message": (
+                f"{len(contained)} poisoned JobSet(s) in rate-limited "
+                f"requeue" if contained else "reconcile pump healthy"
+            ),
+        }
+
+        injector = self.injector
+        if injector is None:
+            from .chaos import get_injector
+
+            injector = get_injector()
+        components["chaos"] = {
+            "healthy": True,  # informational: injected faults are asked-for
+            "active": injector is not None,
+            "injectedTotal": (
+                injector.injected_total() if injector is not None else 0
+            ),
+            "message": (
+                "fault injection active" if injector is not None
+                else "no fault injection configured"
+            ),
+        }
+
+        jobset_keys = [
+            f"{ns}/{js_name}"
+            for ns, js_name in sorted(cluster.jobsets)
+        ]
+        truncated = len(jobset_keys) > self._HEALTH_MAX_JOBSET_KEYS
+        gates = features.all_gates()
+        return {
+            "status": (
+                "healthy"
+                if all(c["healthy"] for c in components.values())
+                else "degraded"
+            ),
+            "components": components,
+            "build": {
+                "version": __version__,
+                "backend": _jax_backend_label(),
+                "featureGates": gates,
+            },
+            "config": {
+                "tickInterval": self.tick_interval,
+                "tls": self.tls,
+                "leaderElection": self.elector is not None,
+                "storeEnabled": store is not None,
+                "address": self.address,
+            },
+            "cluster": {
+                "jobsets": len(cluster.jobsets),
+                "jobs": len(cluster.jobs),
+                "pods": len(cluster.pods),
+                "services": len(cluster.services),
+                "nodes": len(cluster.nodes),
+                "eventsTotal": cluster.events_total,
+                "jobsetKeys": jobset_keys[: self._HEALTH_MAX_JOBSET_KEYS],
+                "jobsetKeysTruncated": truncated,
+            },
+        }
 
     # ------------------------------------------------------------------
 

@@ -103,6 +103,12 @@ class Store:
         # (the server's pump checks this — without it, an acknowledged
         # write could stay non-durable forever on a quiet system).
         self.retry_pending = False
+        # Flight-recorder correlation: "ns/name" -> {seq, rv, time} of the
+        # last fsync-acknowledged commit whose diff touched that JobSet
+        # (bounded by the live JobSet population; entries drop with the
+        # object). The per-JobSet timeline surfaces it as the durability
+        # point.
+        self.last_jobset_commit: dict[str, dict] = {}
         self._load()
 
     # ------------------------------------------------------------------
@@ -123,6 +129,16 @@ class Store:
                 self._state[kind] = dict(
                     doc.get("state", {}).get(kind) or {}
                 )
+        # Seed the per-JobSet durability points from the snapshot (its seq
+        # is the tightest bound we have for objects it covers); WAL replay
+        # sharpens them below. Without this rebuild, a restarted
+        # controller would serve `storeCommit: null` for every pre-crash
+        # JobSet — exactly the postmortem the point exists for.
+        for key in self._state["jobsets"]:
+            self.last_jobset_commit[key] = {
+                "seq": snapshot_seq, "rv": self._rv, "time": None,
+                "recovered": True,
+            }
         records, torn = self.wal.recover()
         self.torn_tail_recovered = torn
         for record in records:
@@ -138,6 +154,14 @@ class Store:
                     self._state[op[1]][op[2]] = op[3]
                 else:
                     self._state[op[1]].pop(op[2], None)
+                if op[1] == "jobsets":
+                    if op[0] == "put":
+                        self.last_jobset_commit[op[2]] = {
+                            "seq": seq, "rv": record.get("rv", 0),
+                            "time": None, "recovered": True,
+                        }
+                    else:
+                        self.last_jobset_commit.pop(op[2], None)
             self._seq = seq
             self._rv = max(self._rv, record.get("rv", 0))
             self._counters = dict(record.get("counters") or self._counters)
@@ -298,6 +322,14 @@ class Store:
         # Only past the fsync is the diff consumed.
         self._seq = record["seq"]
         self._rv = rv
+        for op in ops:
+            if op[1] == "jobsets":
+                if op[0] == "put":
+                    self.last_jobset_commit[op[2]] = {
+                        "seq": record["seq"], "rv": rv, "time": time.time()
+                    }
+                else:
+                    self.last_jobset_commit.pop(op[2], None)
         self._counters = counters
         self._shadow = current
         self._state = dicts

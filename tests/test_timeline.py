@@ -1,0 +1,592 @@
+"""Flight-recorder observability plane tests: per-JobSet timeline assembly
+(phases, conditions, trace-id-stamped events, chaos injections in injected
+order), lifecycle SLO histograms + /debug/slo, the aggregated
+/debug/health verdict, server-side event field selectors, the describe/
+debug-bundle CLI verbs, and the bundle loader round trip.
+
+Determinism contract: a seeded chaos scenario driven on the virtual clock
+assembles a byte-identical timeline across two runs (the greedy-path
+scenario seeds the process RNG, so even trace ids reproduce); the
+solver-path scenario — whose async solve makes the number of RNG draws
+timing-dependent by design — is compared after a first-appearance
+normalization of trace ids, everything else byte-identical.
+"""
+
+import json
+import random
+
+import pytest
+
+from jobset_tpu import chaos, cli
+from jobset_tpu.api import FailurePolicy
+from jobset_tpu.chaos import FaultInjector
+from jobset_tpu.client import ApiError, JobSetClient
+from jobset_tpu.core import features, make_cluster, metrics
+from jobset_tpu.obs import TRACER
+from jobset_tpu.obs.bundle import load_bundle, write_bundle
+from jobset_tpu.obs.timeline import assemble
+from jobset_tpu.server import ControllerServer
+from jobset_tpu.testing import make_jobset, make_replicated_job
+
+TOPOLOGY = "cloud.google.com/gke-tpu-topology"
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    TRACER.reset()
+    metrics.reset()
+    chaos.disable()
+    yield
+    TRACER.reset()
+    metrics.reset()
+    chaos.disable()
+
+
+@pytest.fixture()
+def server():
+    from jobset_tpu.utils.clock import Clock
+
+    cluster = make_cluster(clock=Clock())
+    # Pods need nodes to bind: readiness SLOs depend on real scheduling.
+    cluster.add_topology(TOPOLOGY, num_domains=8, nodes_per_domain=2,
+                         capacity=16)
+    s = ControllerServer(
+        "127.0.0.1:0", cluster=cluster, tick_interval=0.05
+    ).start()
+    yield s
+    s.stop()
+
+
+@pytest.fixture()
+def client(server):
+    return JobSetClient(server.address)
+
+
+def _gang(name: str, replicas: int = 2, pods: int = 2, exclusive=False,
+          fragile=False):
+    w = (
+        make_jobset(name)
+        .failure_policy(FailurePolicy(max_restarts=4))
+        .replicated_job(
+            make_replicated_job("w").replicas(replicas)
+            .parallelism(pods).completions(pods).obj()
+        )
+    )
+    if exclusive:
+        w = w.exclusive_placement(TOPOLOGY)
+    js = w.obj()
+    if fragile:
+        # backoffLimit 0: ONE pod crash fails the job, so a chaos crash
+        # burst escalates to a failure-policy gang restart instead of
+        # being absorbed by per-pod retries.
+        for rjob in js.spec.replicated_jobs:
+            rjob.template.spec.backoff_limit = 0
+    return js
+
+
+# ---------------------------------------------------------------------------
+# Timeline assembly semantics (direct cluster, virtual clock)
+# ---------------------------------------------------------------------------
+
+
+def test_timeline_phases_cover_the_lifecycle():
+    cluster = make_cluster()
+    cluster.add_topology(TOPOLOGY, num_domains=4, nodes_per_domain=2,
+                         capacity=8)
+    cluster.create_jobset(_gang("flight"))
+    cluster.clock.advance(0.5)
+    cluster.run_until_stable()
+
+    tl = assemble(cluster, "default", "flight")
+    phases = tl["phases"]
+    assert phases["timeToAdmissionS"] == 0.0  # unqueued: admit at creation
+    assert phases["timeToReadyS"] == 0.5
+    assert phases["restarts"] == 0 and not phases["inRestartOutage"]
+    order = [e["reason"] for e in tl["entries"] if e["source"] == "phase"]
+    assert order == ["Created", "Admitted", "Scheduled", "Ready"]
+    # Entries are time-ordered.
+    times = [e["time"] for e in tl["entries"]]
+    assert times == sorted(times)
+
+    # Restart opens an outage window; recovery closes it.
+    cluster.fail_job("default", "flight-w-0")
+    cluster.clock.advance(2.0)
+    cluster.run_until_stable()
+    tl = assemble(cluster, "default", "flight")
+    assert tl["phases"]["restarts"] == 1
+    assert tl["phases"]["recoveries"] == 1
+    reasons = [e["reason"] for e in tl["entries"]]
+    assert "RestartStarted" in reasons and "Recovered" in reasons
+    assert reasons.index("RestartStarted") < reasons.index("Recovered")
+    assert metrics.slo_restart_recovery_seconds.n == 1
+
+    # Unknown JobSet -> no timeline.
+    assert assemble(cluster, "default", "nope") is None
+
+
+def test_slo_histograms_measure_virtual_time():
+    cluster = make_cluster()
+    cluster.add_topology(TOPOLOGY, num_domains=4, nodes_per_domain=2,
+                         capacity=8)
+    cluster.create_jobset(_gang("slo"))
+    cluster.clock.advance(3.0)
+    cluster.run_until_stable()
+    assert metrics.slo_time_to_ready_seconds.n == 1
+    # Exact virtual duration landed (bucket upper bound >= 3s).
+    assert metrics.slo_time_to_ready_seconds.sum == pytest.approx(3.0)
+    cluster.fail_job("default", "slo-w-0")
+    cluster.tick()  # the restart fires here, opening the outage window
+    cluster.clock.advance(7.0)
+    cluster.run_until_stable()
+    assert metrics.slo_restart_recovery_seconds.sum == pytest.approx(7.0)
+
+
+def test_queue_admission_feeds_the_admission_slo():
+    from jobset_tpu.queue import Queue
+
+    cluster = make_cluster()
+    cluster.add_topology(TOPOLOGY, num_domains=4, nodes_per_domain=2,
+                         capacity=8)
+    cluster.queue_manager.create_queue(Queue(name="q", quota={"pods": 100}))
+    js = (
+        make_jobset("queued").queue("q")
+        .replicated_job(
+            make_replicated_job("w").replicas(1)
+            .parallelism(1).completions(1).obj()
+        ).obj()
+    )
+    cluster.create_jobset(js)
+    assert js.spec.suspend  # held pending admission
+    cluster.clock.advance(1.5)
+    cluster.run_until_stable()
+    assert metrics.slo_time_to_admission_seconds.n == 1
+    assert metrics.slo_time_to_admission_seconds.sum == pytest.approx(1.5)
+    tl = assemble(cluster, "default", "queued")
+    assert tl["phases"]["timeToAdmissionS"] == 1.5
+    # The queue's decision events are part of the correlated record.
+    reasons = [e["reason"] for e in tl["entries"]]
+    assert "QueuePending" in reasons and "QueueAdmitted" in reasons
+
+
+def test_timelines_isolated_across_namespaces_and_prefix_names():
+    """Same-named JobSets in different namespaces — and prefix-named
+    JobSets in one namespace — must never cross-pollute timelines."""
+    cluster = make_cluster()
+    cluster.add_topology(TOPOLOGY, num_domains=8, nodes_per_domain=2,
+                         capacity=8)
+    for ns in ("team-a", "team-b"):
+        js = _gang("train")
+        js.metadata.namespace = ns
+        cluster.create_jobset(js)
+    cluster.create_jobset(_gang("train-2"))  # prefix sibling, default ns
+    cluster.run_until_stable()
+    cluster.fail_job("team-b", "train-w-0")
+    cluster.run_until_stable()
+
+    # team-b restarted; team-a's timeline must not show it.
+    team_a = assemble(cluster, "team-a", "train")
+    team_b = assemble(cluster, "team-b", "train")
+    a_reasons = [e["reason"] for e in team_a["entries"]
+                 if e["source"] == "event"]
+    assert "RestartJobSetFailurePolicyAction" not in a_reasons
+    assert any(
+        e["reason"] == "RestartJobSetFailurePolicyAction"
+        for e in team_b["entries"] if e["source"] == "event"
+    )
+
+    # Chaos attribution: a crash of train-2's pod must not land in
+    # train's chaos section (exact child prefixes, not name+dash).
+    injector = FaultInjector(seed=1)
+    injector.add_rule("cluster.pod", "crash", rate=1.0)
+    injector.check("cluster.pod", "default/train-2-w-0-0-abcde")
+    tl_train = assemble(cluster, "default", "train-2", injector=injector)
+    assert len(tl_train["chaos"]) == 1
+    tl_other = assemble(cluster, "team-a", "train", injector=injector)
+    assert tl_other["chaos"] == []
+
+
+def test_deleted_jobset_keeps_a_postmortem_timeline():
+    """Describing a gang AFTER it failed and was deleted is the flight
+    recorder's core postmortem use case."""
+    cluster = make_cluster()
+    cluster.add_topology(TOPOLOGY, num_domains=4, nodes_per_domain=2,
+                         capacity=8)
+    cluster.create_jobset(_gang("gone"))
+    cluster.run_until_stable()
+    cluster.fail_job("default", "gone-w-0")
+    cluster.clock.advance(1.0)
+    cluster.run_until_stable()
+    cluster.delete_jobset("default", "gone")
+
+    tl = assemble(cluster, "default", "gone")
+    assert tl is not None and tl["deleted"] is True
+    assert tl["phases"]["restarts"] >= 1
+    assert tl["phases"]["deletedAt"] is not None
+    reasons = [e["reason"] for e in tl["entries"]]
+    assert "Deleted" in reasons and "RestartStarted" in reasons
+    # A recreation under the same name starts a fresh record.
+    cluster.create_jobset(_gang("gone"))
+    fresh = assemble(cluster, "default", "gone")
+    assert fresh["deleted"] is False and fresh["phases"]["restarts"] == 0
+
+
+def test_store_commit_point_survives_recovery(tmp_path):
+    from jobset_tpu.store import Store
+
+    data_dir = str(tmp_path / "store")
+    cluster = make_cluster()
+    store = Store(data_dir, snapshot_interval=10 ** 9)
+    store.recover(cluster)
+    cluster.create_jobset(_gang("durable"))
+    cluster.run_until_stable()
+    store.commit()
+    live = assemble(cluster, "default", "durable")
+    assert live["storeCommit"]["seq"] == 1
+    store.hard_kill()
+
+    fresh = make_cluster()
+    recovered = Store(data_dir)
+    recovered.recover(fresh)
+    try:
+        tl = assemble(fresh, "default", "durable")
+        assert tl["storeCommit"] is not None
+        assert tl["storeCommit"]["recovered"] is True
+        assert tl["storeCommit"]["seq"] == 1
+    finally:
+        recovered.close()
+
+
+# ---------------------------------------------------------------------------
+# Seeded chaos determinism
+# ---------------------------------------------------------------------------
+
+
+def _crash_scenario():
+    """Greedy-path seeded scenario: create -> ready -> seeded crash burst
+    -> gang recovery, all on the virtual clock."""
+    random.seed(20260803)  # trace ids come from the process RNG
+    TRACER.reset()
+    metrics.reset()
+    injector = FaultInjector(seed=9)
+    cluster = make_cluster()
+    cluster.add_topology(TOPOLOGY, num_domains=4, nodes_per_domain=2,
+                         capacity=8)
+    cluster.create_jobset(_gang("burst", replicas=2, pods=4,
+                                exclusive=True, fragile=True))
+    cluster.clock.advance(0.25)
+    cluster.run_until_stable()
+    crashed = chaos.pod_crash_burst(cluster, injector, rate=0.5)
+    assert crashed  # seed 9 over 8 pods crashes some
+    cluster.clock.advance(1.0)
+    cluster.run_until_stable()
+    return assemble(cluster, "default", "burst", injector=injector)
+
+
+def test_timeline_byte_identical_across_seeded_runs():
+    first, second = _crash_scenario(), _crash_scenario()
+    assert json.dumps(first, sort_keys=True) == json.dumps(
+        second, sort_keys=True
+    )
+    # The injected crashes appear in injected (seq) order and drove a
+    # restart that the timeline records after them.
+    assert [c["point"] for c in first["chaos"]] == ["cluster.pod"] * len(
+        first["chaos"]
+    )
+    seqs = [c["seq"] for c in first["chaos"]]
+    assert seqs == sorted(seqs) and seqs
+    assert first["phases"]["restarts"] >= 1
+    assert first["phases"]["recoveries"] >= 1
+    assert metrics.slo_time_to_ready_seconds.n == 1
+    assert metrics.slo_restart_recovery_seconds.n >= 1
+    # The injections are first-class timeline events too, and they precede
+    # the restart they caused in the merged (time-ordered) entry list.
+    reasons = [e["reason"] for e in first["entries"]]
+    assert "ChaosPodCrash" in reasons
+    assert reasons.index("ChaosPodCrash") < reasons.index("RestartStarted")
+
+
+def _normalize_trace_ids(tl: dict) -> str:
+    """Canonical timeline with trace ids relabeled in first-appearance
+    order and ephemeral sidecar addresses scrubbed: the solver path's
+    async solves make RNG draw counts timing-dependent (so ids differ
+    run-to-run) and each run's sidecar binds a fresh port; everything
+    else must be byte-identical."""
+    import re
+
+    tl = json.loads(json.dumps(tl))
+    mapping: dict = {}
+
+    def norm(tid):
+        if tid is None:
+            return None
+        return mapping.setdefault(tid, f"trace-{len(mapping)}")
+
+    for entry in tl["entries"]:
+        entry["traceId"] = norm(entry["traceId"])
+    tl["traceIds"] = [norm(t) for t in tl["traceIds"]]
+    for fault in tl["chaos"]:
+        fault["detail"] = re.sub(
+            r"\d+\.\d+\.\d+\.\d+:\d+", "ADDR", fault["detail"]
+        )
+    return json.dumps(tl, sort_keys=True)
+
+
+def _solver_break_scenario():
+    """Solver-path scenario: every solver stream use breaks (injected), so
+    placement falls back locally while pods crash — the timeline must
+    carry BOTH fault families in injected order."""
+    from jobset_tpu.placement import service as svc
+    from jobset_tpu.placement.provider import SolverPlacement
+
+    TRACER.reset()
+    metrics.reset()
+    injector = FaultInjector(seed=7)
+    injector.add_rule("solver.stream", "break", rate=1.0)
+    sidecar = svc.SolverServer("127.0.0.1:0").start()
+    remote = svc.RemoteAssignmentSolver(
+        sidecar.address, timeout=5.0, injector=injector
+    )
+    try:
+        with features.gate("TPUPlacementSolver", True):
+            cluster = make_cluster(
+                placement=SolverPlacement(solver=remote)
+            )
+            cluster.add_topology(TOPOLOGY, num_domains=4,
+                                 nodes_per_domain=2, capacity=8)
+            cluster.create_jobset(_gang("solved", replicas=2, pods=4,
+                                        exclusive=True, fragile=True))
+            cluster.clock.advance(0.25)
+            cluster.run_until_stable(max_ticks=500)
+            crashed = chaos.pod_crash_burst(cluster, injector, rate=0.5)
+            assert crashed
+            cluster.clock.advance(1.0)
+            cluster.run_until_stable(max_ticks=500)
+            return assemble(
+                cluster, "default", "solved", injector=injector
+            )
+    finally:
+        remote.close()
+        sidecar.stop(grace=0.1)
+
+
+def test_timeline_solver_stream_break_and_crash_order():
+    first, second = _solver_break_scenario(), _solver_break_scenario()
+    assert _normalize_trace_ids(first) == _normalize_trace_ids(second)
+    points = [c["point"] for c in first["chaos"]]
+    assert "solver.stream" in points and "cluster.pod" in points
+    seqs = [c["seq"] for c in first["chaos"]]
+    assert seqs == sorted(seqs)  # injected order preserved
+    # Every remote attempt broke -> placement fell back locally, and the
+    # SLO plane still measured the lifecycle.
+    assert metrics.solver_fallbacks_total.total() >= 1
+    assert first["phases"]["restarts"] >= 1
+    assert metrics.slo_time_to_ready_seconds.n == 1
+    assert metrics.slo_restart_recovery_seconds.n >= 1
+
+
+# ---------------------------------------------------------------------------
+# HTTP surfaces
+# ---------------------------------------------------------------------------
+
+
+def test_debug_timeline_endpoint_and_trace_correlation(server, client):
+    client.create(_gang("wired"))
+    with server.lock:
+        server.cluster.fail_job("default", "wired-w-0")
+    server.pump()
+    tl = client.timeline("wired")
+    assert tl["namespace"] == "default" and tl["name"] == "wired"
+    event_entries = [e for e in tl["entries"] if e["source"] == "event"]
+    assert event_entries
+    # Satellite contract: events carry the trace id active at emission,
+    # and it joins /debug/traces by id.
+    stamped = [e["traceId"] for e in event_entries if e["traceId"]]
+    assert stamped
+    ring_ids = {t["trace_id"] for t in client.traces(limit=0)["traces"]}
+    assert set(stamped) <= ring_ids
+
+    with pytest.raises(ApiError) as err:
+        client.timeline("never-created")
+    assert err.value.status == 404
+
+
+def test_debug_slo_endpoint_populates(server, client):
+    client.create(_gang("slo-live"))
+    summary = client.slo_summary()
+    assert summary["timeToAdmissionSeconds"]["count"] == 1
+    assert summary["timeToReadySeconds"]["count"] == 1
+    assert summary["timeToReadySeconds"]["p99"] is not None
+    assert summary["solverFallbackRatio"] == 0.0
+    with server.lock:
+        server.cluster.fail_job("default", "slo-live-w-0")
+    server.pump()
+    assert client.slo_summary()["restartRecoverySeconds"]["count"] == 1
+
+
+def test_debug_health_verdict_and_degradation(server, client):
+    health = client.health()
+    assert health["status"] == "healthy"
+    assert set(health["components"]) == {
+        "leaderElection", "solver", "store", "queue", "pump", "chaos",
+    }
+    assert health["components"]["store"]["enabled"] is False
+    assert health["build"]["version"]
+    assert health["config"]["storeEnabled"] is False
+
+    # Open breaker -> solver component unhealthy -> overall degraded.
+    metrics.solver_breaker_state.set(metrics.BREAKER_OPEN)
+    degraded = client.health()
+    assert degraded["status"] == "degraded"
+    assert degraded["components"]["solver"]["breakerState"] == "open"
+    metrics.solver_breaker_state.set(metrics.BREAKER_CLOSED)
+
+    # A contained (poisoned) JobSet degrades the pump component.
+    with server.lock:
+        server.cluster.reconcile_failures[("default", "poisoned")] = 3
+    degraded = client.health()
+    assert degraded["status"] == "degraded"
+    assert degraded["components"]["pump"]["containedJobSets"] == {
+        "default/poisoned": 3
+    }
+    with server.lock:
+        del server.cluster.reconcile_failures[("default", "poisoned")]
+    assert client.health()["status"] == "healthy"
+
+    # Health payload lists jobset keys (the bundle walks these).
+    client.create(_gang("listed"))
+    assert "default/listed" in client.health()["cluster"]["jobsetKeys"]
+
+
+def test_build_info_gauge_served(server, client):
+    text = client.metrics_text()
+    assert 'jobset_build_info{version="' in text
+    assert 'gates="' in text
+
+
+def test_events_field_selector(server, client):
+    client.create(_gang("alpha"))
+    client.create(_gang("beta"))
+    with server.lock:
+        server.cluster.fail_job("default", "alpha-w-0")
+        server.cluster.fail_job("default", "beta-w-0")
+    server.pump()
+    everything = client.events()
+    only_alpha = client.events_for("JobSet", "alpha")
+    assert only_alpha and len(only_alpha) < len(everything)
+    assert all(e["name"] == "alpha" for e in only_alpha)
+    assert all(e["kind"] == "JobSet" for e in only_alpha)
+    # reason clause composes; unknown keys 400 like a real apiserver.
+    assert client.events(
+        field_selector="involvedObject.name=alpha,type=Warning"
+    )
+    with pytest.raises(ApiError) as err:
+        client.events(field_selector="involvedObject.uid=x")
+    assert err.value.status == 400
+
+
+def test_debug_surfaces_exempt_from_chaos(server, client):
+    """A chaos 503 storm must not blind the flight recorder."""
+    server.injector = FaultInjector(seed=3)
+    server.injector.add_rule(
+        "apiserver.request", "error", status=503, rate=1.0
+    )
+    assert client.health()["status"] in ("healthy", "degraded")
+    assert client.slo_summary() is not None
+    with pytest.raises(ApiError):  # normal API paths DO take the faults
+        client.list_raw()
+
+
+# ---------------------------------------------------------------------------
+# CLI verbs + debug bundle
+# ---------------------------------------------------------------------------
+
+
+def test_describe_cli_renders_timeline(server, client, capsys):
+    client.create(_gang("shown"))
+    with server.lock:
+        server.cluster.fail_job("default", "shown-w-0")
+    server.pump()
+    assert cli.main(
+        ["describe", "jobset", "shown", "--server", server.address]
+    ) == 0
+    out = capsys.readouterr().out
+    assert "default/shown" in out
+    assert "Timeline:" in out
+    assert "RestartStarted" in out and "Recovered" in out
+    # JSON output mode emits the raw payload.
+    assert cli.main(
+        ["describe", "jobset", "shown", "-o", "json",
+         "--server", server.address]
+    ) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["phases"]["restarts"] == 1
+    # Unknown jobset: clean error, nonzero exit.
+    assert cli.main(
+        ["describe", "jobset", "ghost", "--server", server.address]
+    ) == 1
+
+
+def test_get_events_for_cli(server, client, capsys):
+    client.create(_gang("evt"))
+    with server.lock:
+        server.cluster.fail_job("default", "evt-w-0")
+    server.pump()
+    assert cli.main(
+        ["get", "events", "--for", "jobset/evt",
+         "--server", server.address]
+    ) == 0
+    out = capsys.readouterr().out
+    assert out.strip()
+    assert cli.main(
+        ["get", "events", "--for", "bogus-kind", "--server", server.address]
+    ) == 2
+    # --for on a non-events resource errors loudly on EVERY branch,
+    # including the ones that list early (jobsets/queues).
+    assert cli.main(
+        ["get", "jobsets", "--for", "jobset/evt", "--server", server.address]
+    ) == 2
+    capsys.readouterr()
+
+
+def test_debug_bundle_round_trips(server, client, tmp_path, capsys):
+    client.create(_gang("bundled"))
+    with server.lock:
+        server.cluster.fail_job("default", "bundled-w-0")
+    server.pump()
+    out_path = str(tmp_path / "postmortem.tgz")
+    assert cli.main(
+        ["debug-bundle", out_path, "--server", server.address]
+    ) == 0
+    assert "postmortem.tgz" in capsys.readouterr().out
+
+    bundle = load_bundle(out_path)
+    manifest = bundle["manifest.json"]
+    assert sorted(manifest["members"]) == sorted(bundle)
+    assert bundle["health.json"]["status"] in ("healthy", "degraded")
+    timeline = bundle["timelines.json"]["default/bundled"]
+    assert timeline["phases"]["restarts"] == 1
+    # The bundled timeline is the same record the live endpoint serves.
+    assert timeline == client.timeline("bundled")
+    assert "jobset_build_info" in bundle["metrics.prom"]
+    assert bundle["slo.json"]["timeToReadySeconds"]["count"] >= 1
+    assert any(
+        js["metadata"]["name"] == "bundled"
+        for js in bundle["jobsets.json"]
+    )
+
+    # Loader rejects non-bundles.
+    import tarfile
+
+    bad = str(tmp_path / "bad.tgz")
+    with tarfile.open(bad, "w:gz"):
+        pass
+    with pytest.raises(ValueError):
+        load_bundle(bad)
+
+
+def test_write_bundle_direct(server, client, tmp_path):
+    client.create(_gang("direct"))
+    stats = write_bundle(client, str(tmp_path / "b.tgz"))
+    assert stats["timelines"] == 1
+    loaded = load_bundle(stats["path"])
+    assert "default/direct" in loaded["timelines.json"]
