@@ -958,6 +958,127 @@ def _bank_slo(result: dict) -> None:
     _bank_sidecar_key("slo", result)
 
 
+def run_ha_bench(args) -> dict:
+    """Replicated-control-plane bench (docs/ha.md): a 3-replica in-process
+    quorum under a sequential write storm with a seeded leader-kill storm
+    — the leader is hard-killed `kills` times mid-storm. Measures:
+
+    * failover time: kill instant -> first write acknowledged by the
+      successor (lease expiry + catch-up + Store replay + port takeover),
+      p50/p99 over the kills;
+    * write availability: fraction of the storm's wall time the control
+      plane acknowledged writes (outage windows are the failovers);
+    * clean-path write latency p50/p99 (the quorum round trip every
+      acknowledged write pays: local fsync + majority follower fsync).
+
+    Every acknowledged write is verified present on the final leader —
+    the zero-lost-acknowledged-writes contract the chaos soak proves
+    byte-identically at smaller scale.
+    """
+    import shutil
+    import tempfile
+
+    from jobset_tpu.chaos.scenarios import ha_write_attempt
+    from jobset_tpu.ha import ReplicaSet
+
+    writes = 240
+    kills = 3
+    replicas = 3
+    lease_duration = 0.5
+    base_dir = tempfile.mkdtemp(prefix="bench-ha-")
+    kill_points = [
+        (i + 1) * writes // (kills + 1) for i in range(kills)
+    ]
+    replica_set = ReplicaSet(
+        base_dir, n=replicas,
+        lease_duration=lease_duration, retry_period=0.1,
+        tick_interval=0.05,
+    ).start()
+
+    def attempt(name: str):
+        # Shared with the chaos soaks: a 201 without Warning IS the
+        # majority-acknowledged contract — one definition, no drift.
+        return ha_write_attempt(replica_set.address, name)
+
+    acked: list[str] = []
+    clean_latencies: list[float] = []
+    failovers: list[float] = []
+    pending_kill_at: float | None = None
+    last_killed: str | None = None
+    t_storm = time.perf_counter()
+    try:
+        for i in range(writes):
+            name = f"ha-{i:04d}"
+            while True:
+                t0 = time.perf_counter()
+                status, warning = attempt(name)
+                if status == 201 and warning is None:
+                    if pending_kill_at is not None:
+                        failovers.append(time.perf_counter() - pending_kill_at)
+                        pending_kill_at = None
+                        # Bring the crashed replica back as a follower
+                        # (the operator replacing the lost node): the NEXT
+                        # kill must again leave a live majority — without
+                        # rejoin, two cumulative kills of a 3-replica set
+                        # would (correctly) refuse to serve forever.
+                        replica_set.rejoin(last_killed)
+                        last_killed = None
+                    else:
+                        clean_latencies.append(time.perf_counter() - t0)
+                    acked.append(name)
+                    break
+                if status == 409:
+                    break
+                replica_set.step()
+                time.sleep(0.01)
+            if i + 1 in kill_points:
+                pending_kill_at = time.perf_counter()
+                last_killed = replica_set.kill_leader()
+        storm_s = time.perf_counter() - t_storm
+        leader = replica_set.leader()
+        final = leader.store.serialized_state()["jobsets"]
+        lost = [n for n in acked if f"default/{n}" not in final]
+        unavailable_s = sum(failovers)
+
+        def pct(samples: list[float], q: float) -> float:
+            if not samples:
+                return float("nan")
+            ordered = sorted(samples)
+            rank = max(0, min(len(ordered) - 1,
+                              math.ceil(q * len(ordered)) - 1))
+            return ordered[rank]
+
+        return {
+            "replicas": replicas,
+            "writes": writes,
+            "kills": kills,
+            "lease_duration_s": lease_duration,
+            "acked_writes": len(acked),
+            "lost_acked_writes": len(lost),
+            "failover_ms": {
+                "p50": round(pct(failovers, 0.5) * 1e3, 1),
+                "p99": round(pct(failovers, 0.99) * 1e3, 1),
+                "samples": [round(f * 1e3, 1) for f in failovers],
+            },
+            "write_latency_ms": {
+                "p50": round(pct(clean_latencies, 0.5) * 1e3, 2),
+                "p99": round(pct(clean_latencies, 0.99) * 1e3, 2),
+            },
+            "write_availability_pct": round(
+                100.0 * (1.0 - unavailable_s / storm_s), 2
+            ),
+            "storm_s": round(storm_s, 2),
+            "acked_writes_per_sec": round(len(acked) / storm_s, 1),
+        }
+    finally:
+        replica_set.stop()
+        shutil.rmtree(base_dir, ignore_errors=True)
+
+
+def _bank_ha(result: dict) -> None:
+    _bank_sidecar_key("ha", result)
+
+
 def preload_domain_gradient(cluster, topology_key: str, max_frac: float = 0.9):
     """Synthetic background occupancy with a load gradient: domain i has
     ~(i/D)*max_frac of its capacity consumed. Every incoming job then
@@ -2203,6 +2324,13 @@ def main() -> int:
              "BENCH_PLACEMENT_TPU_LAST.json under 'slo'",
     )
     parser.add_argument(
+        "--ha", action="store_true",
+        help="run ONLY the replicated-control-plane bench (3-replica "
+             "quorum, seeded leader-kill storm; failover-time p50/p99 and "
+             "write availability) and bank it into "
+             "BENCH_PLACEMENT_TPU_LAST.json under 'ha'",
+    )
+    parser.add_argument(
         "--model-only", action="store_true",
         help="probe the accelerator and run ONLY the model-MFU worker "
              "(prints its JSON line; used for opportunistic capture while "
@@ -2232,6 +2360,19 @@ def main() -> int:
             "metric": "restart_recovery_throughput",
             "value": result["at_10k"]["objects_per_sec"],
             "unit": "objects/s",
+            "detail": result,
+        }))
+        return 0
+
+    if args.ha:
+        # Pure control-plane bench: the quorum/failover path never touches
+        # an accelerator (suspended gangs, greedy placement).
+        result = run_ha_bench(args)
+        _bank_ha(result)
+        print(json.dumps({
+            "metric": "ha_failover_p99",
+            "value": result["failover_ms"]["p99"],
+            "unit": "ms",
             "detail": result,
         }))
         return 0

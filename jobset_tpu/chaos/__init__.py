@@ -22,6 +22,7 @@ from .injector import (
     KIND_TORN,
     Rule,
     configure,
+    consult,
     disable,
     get_injector,
 )
@@ -48,6 +49,7 @@ __all__ = [
     "KIND_TORN",
     "Rule",
     "configure",
+    "consult",
     "disable",
     "get_injector",
     "node_drain",

@@ -36,7 +36,12 @@ just names):
 ``cluster.node``       simulated cloud: node drain
 ``queue.admission``    gang admission plane: admit-latency, spurious evict
 ``store.write``        durable-store WAL append: fsync latency, torn-tail
-                       truncation, ENOSPC
+                       truncation, ENOSPC (also consulted per lease-file
+                       write, so an unwritable shared volume is testable)
+``replication.stream`` HA leader->follower WAL frame shipping: stream
+                       break (frame dropped pre-flight, follower lags and
+                       is caught up from the resend buffer), added
+                       latency
 ================== ======================================================
 
 Spec grammar (CLI ``--inject`` / ``FaultInjector.from_spec``)::
@@ -298,6 +303,31 @@ def configure(spec: str = "", seed: int = 0,
 
 def get_injector() -> Optional[FaultInjector]:
     return _GLOBAL
+
+
+def consult(point: str, detail: str = "",
+            injector: Optional[FaultInjector] = None) -> Optional[Fault]:
+    """One arrival at `point` with the standard call-site boilerplate
+    folded in: resolve `injector` (explicit, else the process-global one),
+    check, and APPLY any latency fault in place (sleep, then report no
+    fault). Returns a Fault only for kinds the caller must interpret
+    (error/torn/enospc/break/...), or None. Shared by the WAL append,
+    lease write, and replication ship sites so fault semantics cannot
+    drift between them."""
+    if injector is None:
+        injector = _GLOBAL
+    if injector is None:
+        return None
+    fault = injector.check(point, detail)
+    if fault is None:
+        return None
+    if fault.kind == KIND_LATENCY:
+        if fault.delay_s > 0:
+            import time as _t
+
+            _t.sleep(fault.delay_s)
+        return None
+    return fault
 
 
 def disable() -> None:

@@ -258,3 +258,215 @@ def store_enospc_writes(data_dir: str, **kwargs) -> list[dict]:
     lands; the log needs no truncation but the commit is still unacked)."""
     kwargs.setdefault("kind", KIND_ENOSPC)
     return store_torn_writes(data_dir, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# Replicated-control-plane scenarios (jobset_tpu/ha, docs/ha.md)
+# ---------------------------------------------------------------------------
+
+
+def ha_write_attempt(address: str, name: str, timeout: float = 5.0):
+    """One suspended-JobSet create against a replicated control plane's
+    serving address. Returns (status, warning): a 201 with warning=None
+    is a MAJORITY-acknowledged write (the contract the HA soaks and
+    `bench.py --ha` both assert on — shared here so they cannot drift);
+    (None, None) means no listener / connection died mid-flight."""
+    import urllib.error
+    import urllib.request
+
+    from ..api import serialization
+    from ..testing import make_jobset, make_replicated_job
+
+    js = (
+        make_jobset(name)
+        .replicated_job(
+            make_replicated_job("w").replicas(1)
+            .parallelism(1).completions(1).obj()
+        )
+        .suspend(True)
+        .obj()
+    )
+    req = urllib.request.Request(
+        f"http://{address}/apis/jobset.x-k8s.io/v1alpha2"
+        f"/namespaces/default/jobsets",
+        data=serialization.to_yaml(js).encode(),
+        method="POST",
+        headers={"Content-Type": "application/yaml"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.headers.get("Warning")
+    except urllib.error.HTTPError as exc:
+        exc.read()
+        return exc.code, None
+    except (urllib.error.URLError, OSError):
+        return None, None
+
+
+def _ha_write_storm(replica_set, writes: int, kill_after: Optional[int],
+                    kill, clock=None, start: int = 0) -> dict:
+    """Sequential suspended-JobSet creates against the replica set's
+    serving address, retrying through failovers. `kill(replica_set)` fires
+    after the `kill_after`-th CLEAN acknowledgement (a 2xx without a
+    Warning header — the majority-acknowledged contract). Sequential,
+    ack-gated writes keep every uid/resourceVersion assignment — and
+    every per-point chaos arrival — a pure function of the write index,
+    which is what makes two seeded runs byte-identical."""
+    import time as _t
+
+    def attempt(name: str):
+        return ha_write_attempt(replica_set.address, name)
+
+    acked: list[str] = []
+    killed = None
+    unavailable_s = 0.0
+    retries = 0
+    for i in range(start, start + writes):
+        name = f"ha-{i:03d}"
+        outage_started = None
+        while True:
+            status, warning = attempt(name)
+            if status == 201 and warning is None:
+                acked.append(name)
+                break
+            if status == 409:
+                # A retried create that actually landed before the ack was
+                # lost: it exists on the serving leader; the NEXT write's
+                # clean ack (same commit stream) covers its durability.
+                break
+            retries += 1
+            if outage_started is None:
+                outage_started = _t.monotonic()
+            replica_set.step()
+            if clock is not None:
+                clock.advance(replica_set.replicas[0].elector.retry_period)
+            _t.sleep(0.02)
+        if outage_started is not None:
+            unavailable_s += _t.monotonic() - outage_started
+        if (
+            kill_after is not None
+            and (i - start) + 1 == kill_after
+            and killed is None
+        ):
+            killed = kill(replica_set)
+    return {
+        "acked": acked,
+        "killed": killed,
+        "retries": retries,
+        "unavailable_s": round(unavailable_s, 3),
+    }
+
+
+def leader_kill(
+    base_dir: str,
+    writes: int = 18,
+    kill_after: int = 8,
+    replicas: int = 3,
+    seed: int = 7,
+    stream_latency_rate: float = 0.25,
+    stream_latency_ms: float = 1.0,
+    kill: bool = True,
+) -> dict:
+    """Seeded leader-kill storm (the HA acceptance scenario): 3 in-process
+    replicas, sequential write storm, the leader hard-killed mid-storm
+    after `kill_after` majority-acknowledged writes; a follower waits out
+    the lease, catches up, replays the committed log, and takes over the
+    serving port. `replication.stream` latency faults ride along at
+    `stream_latency_rate` so the ship path is exercised under jitter
+    without perturbing quorum arithmetic.
+
+    Returns the acked-write list, the final serialized store state of the
+    surviving leader, and the injector's log — a run with `kill=False` is
+    the no-kill baseline the caller asserts byte-identity against (zero
+    majority-acknowledged JobSets lost)."""
+    from ..ha import ReplicaSet
+
+    injector = FaultInjector(seed=seed)
+    if stream_latency_rate > 0:
+        from .injector import KIND_LATENCY
+
+        injector.add_rule(
+            "replication.stream", KIND_LATENCY,
+            rate=stream_latency_rate, delay_s=stream_latency_ms / 1000.0,
+        )
+    replica_set = ReplicaSet(
+        base_dir, n=replicas,
+        lease_duration=0.5, retry_period=0.1, tick_interval=0.05,
+        injector=injector,
+    ).start()
+    try:
+        result = _ha_write_storm(
+            replica_set, writes,
+            kill_after if kill else None,
+            lambda rs: rs.kill_leader(),
+        )
+        leader = replica_set.leader()
+        result.update({
+            "scenario": "leader_kill",
+            "writes": writes,
+            "replicas": replicas,
+            "seed": seed,
+            "leader": leader.replica_id,
+            "final_state": leader.store.serialized_state(),
+            "final_seq": leader.store.seq,
+            "commit_seq": leader.store.commit_seq,
+            "resource_version": leader.store.resource_version,
+            "injection_log": injector.log_snapshot(),
+        })
+        return result
+    finally:
+        replica_set.stop()
+
+
+def follower_kill(
+    base_dir: str,
+    writes: int = 12,
+    kill_after: int = 4,
+    rejoin_after: int = 8,
+    replicas: int = 3,
+    seed: int = 7,
+) -> dict:
+    """Follower-loss storm: a follower is hard-killed mid-storm — the
+    leader keeps acknowledging (quorum is leader + the surviving
+    follower) — then rejoins and must catch up to the exact log. Returns
+    write availability plus the rejoined replica's reconciliation stats
+    (the caller asserts position convergence and zero failed acks)."""
+    from ..ha import ReplicaSet
+
+    injector = FaultInjector(seed=seed)
+    replica_set = ReplicaSet(
+        base_dir, n=replicas,
+        lease_duration=0.5, retry_period=0.1, tick_interval=0.05,
+        injector=injector,
+    ).start()
+    try:
+        killed: list[str] = []
+        rejoin_stats: dict = {}
+
+        acked: list[str] = []
+        for i in range(writes):
+            result = _ha_write_storm(
+                replica_set, 1, None, lambda rs: None, start=i,
+            )
+            acked.extend(result["acked"])
+            if i + 1 == kill_after:
+                killed.append(replica_set.kill_follower())
+            if i + 1 == rejoin_after and killed:
+                rejoin_stats = replica_set.rejoin(killed[0])
+        leader = replica_set.leader()
+        victim = next(
+            r for r in replica_set.replicas
+            if r.replica_id == killed[0]
+        )
+        return {
+            "scenario": "follower_kill",
+            "writes": writes,
+            "killed": killed[0] if killed else None,
+            "acked": len(acked),
+            "rejoin": rejoin_stats,
+            "leader_seq": leader.store.seq,
+            "follower_position": victim.log.position(),
+            "injection_log": injector.log_snapshot(),
+        }
+    finally:
+        replica_set.stop()

@@ -114,6 +114,22 @@ def _build_parser() -> argparse.ArgumentParser:
     c.add_argument("--snapshot-interval", type=int, default=256,
                    help="WAL commits between compacting snapshots "
                         "(--data-dir only)")
+    c.add_argument("--replicate", action="store_true",
+                   help="run as one replica of a quorum-replicated "
+                        "control plane (docs/ha.md): requires --data-dir, "
+                        "--peers, and a shared --lease-file; the elected "
+                        "leader streams WAL frames to the peers and "
+                        "acknowledges writes only once a majority has "
+                        "fsync'd them, a standby mirrors the log and "
+                        "takes over on lease expiry with zero lost "
+                        "acknowledged writes")
+    c.add_argument("--peers", default="",
+                   help="comma-separated peer replica addresses "
+                        "(host:port of each OTHER replica's --addr) for "
+                        "--replicate")
+    c.add_argument("--peer-timeout", type=float, default=5.0,
+                   help="per-call timeout for replication RPCs to peers "
+                        "(--replicate)")
 
     s = sub.add_parser("solver", help="run the placement solver sidecar (gRPC)")
     s.add_argument("--addr", default="127.0.0.1:8500")
@@ -210,10 +226,11 @@ def _build_parser() -> argparse.ArgumentParser:
 
 
 def _cmd_controller(args) -> int:
-    from .core import features, make_cluster
-    from .placement.provider import SolverPlacement
+    from .core import features
     from .server import ControllerServer
-    from .utils.clock import Clock
+
+    if args.replicate:
+        return _cmd_controller_replicated(args)
 
     if args.feature_gates:
         features.set_from_string(args.feature_gates)
@@ -228,18 +245,7 @@ def _cmd_controller(args) -> int:
 
         chaos.configure(args.inject, seed=args.inject_seed)
 
-    solver = None
-    if args.solver_addr:
-        from .placement.service import RemoteAssignmentSolver
-
-        solver = RemoteAssignmentSolver(args.solver_addr)
-    cluster = make_cluster(
-        clock=Clock(),
-        placement=SolverPlacement(
-            solver=solver,
-            solve_budget_s=args.solve_budget or None,
-        ),
-    )
+    cluster = _make_controller_cluster(args)
 
     store = None
     if args.data_dir:
@@ -258,40 +264,7 @@ def _cmd_controller(args) -> int:
                 flush=True,
             )
 
-    if args.queues:
-        import yaml as _yaml
-
-        from .queue.api import queue_from_dict
-
-        with open(args.queues) as f:
-            for doc in _yaml.safe_load_all(f.read()):
-                if isinstance(doc, dict) and doc.get("kind") == "Queue":
-                    q = queue_from_dict(doc)
-                    # Recovered state already holds previously-preloaded
-                    # queues; the file only fills gaps. Say so — a quota
-                    # change in the file must not look like a silent no-op.
-                    if cluster.queue_manager.get_queue(q.name) is None:
-                        cluster.queue_manager.create_queue(q)
-                    else:
-                        print(f"--queues: queue {q.name!r} already exists in "
-                              f"recovered state; file entry ignored "
-                              f"(durable state wins — update via the API)",
-                              flush=True)
-
-    if args.topology:
-        if cluster.nodes:
-            # Recovery restored a node population: the durable topology
-            # (including later out-of-band label/taint patches) wins over
-            # the synthetic bootstrap. Say so — a changed --topology flag
-            # must not look like a silent no-op.
-            print(f"--topology ignored: {len(cluster.nodes)} nodes "
-                  f"recovered from {args.data_dir} (durable state wins — "
-                  f"add nodes via the API)", flush=True)
-        else:
-            key, _, shape = args.topology.partition(":")
-            domains, nodes, cap = (int(x) for x in shape.split("x"))
-            cluster.add_topology(key, num_domains=domains,
-                                 nodes_per_domain=nodes, capacity=cap)
+    _bootstrap_cluster_config(args, cluster)
 
     tls_cert, tls_key = args.tls_cert or None, args.tls_key or None
     if args.tls_self_signed:
@@ -352,6 +325,251 @@ def _cmd_controller(args) -> int:
     server.stop()
     if store is not None:
         store.close()
+    return 0
+
+
+def _make_controller_cluster(args):
+    """The controller's Cluster, wired to the configured placement path
+    (shared by the single-replica and replicated entry points; the
+    replicated path rebuilds one at every promotion)."""
+    from .core import make_cluster
+    from .placement.provider import SolverPlacement
+    from .utils.clock import Clock
+
+    solver = None
+    if args.solver_addr:
+        from .placement.service import RemoteAssignmentSolver
+
+        solver = RemoteAssignmentSolver(args.solver_addr)
+    return make_cluster(
+        clock=Clock(),
+        placement=SolverPlacement(
+            solver=solver,
+            solve_budget_s=args.solve_budget or None,
+        ),
+    )
+
+
+def _bootstrap_cluster_config(args, cluster) -> None:
+    """Apply --queues / --topology bootstrap AFTER recovery, with durable
+    state winning over the flags (and saying so)."""
+    if args.queues:
+        import yaml as _yaml
+
+        from .queue.api import queue_from_dict
+
+        with open(args.queues) as f:
+            for doc in _yaml.safe_load_all(f.read()):
+                if isinstance(doc, dict) and doc.get("kind") == "Queue":
+                    q = queue_from_dict(doc)
+                    # Recovered state already holds previously-preloaded
+                    # queues; the file only fills gaps. Say so — a quota
+                    # change in the file must not look like a silent no-op.
+                    if cluster.queue_manager.get_queue(q.name) is None:
+                        cluster.queue_manager.create_queue(q)
+                    else:
+                        print(f"--queues: queue {q.name!r} already exists in "
+                              f"recovered state; file entry ignored "
+                              f"(durable state wins — update via the API)",
+                              flush=True)
+
+    if args.topology:
+        if cluster.nodes:
+            # Recovery restored a node population: the durable topology
+            # (including later out-of-band label/taint patches) wins over
+            # the synthetic bootstrap. Say so — a changed --topology flag
+            # must not look like a silent no-op.
+            print(f"--topology ignored: {len(cluster.nodes)} nodes "
+                  f"recovered from {args.data_dir} (durable state wins — "
+                  f"add nodes via the API)", flush=True)
+        else:
+            key, _, shape = args.topology.partition(":")
+            domains, nodes, cap = (int(x) for x in shape.split("x"))
+            cluster.add_topology(key, num_domains=domains,
+                                 nodes_per_domain=nodes, capacity=cap)
+
+
+def _cmd_controller_replicated(args) -> int:
+    """`controller --replicate --peers ...`: one replica of the
+    quorum-replicated control plane (docs/ha.md).
+
+    Role loop: stand by (mirror the leader's WAL via /ha/v1, answer
+    writes 503 + leader hint) until the shared lease is acquirable; then
+    catch up against a quorum, replay the committed log into a fresh
+    Cluster, and serve as leader — shipping every WAL frame and
+    acknowledging writes only at majority. A leader that loses quorum or
+    is fenced by a higher term demotes back to standby instead of
+    serving writes it cannot commit."""
+    from .core import features
+    from .core.lease import FileLease, LeaderElector, default_identity
+    from .ha import (
+        FollowerLog,
+        HttpPeer,
+        ReplicationCoordinator,
+        catch_up,
+        establish_term,
+        majority_of,
+    )
+    from .server import ControllerServer
+    from .store import Store
+
+    if not args.data_dir:
+        print("--replicate requires --data-dir", file=sys.stderr)
+        return 2
+    if not args.peers:
+        print("--replicate requires --peers (the other replicas)",
+              file=sys.stderr)
+        return 2
+    if args.feature_gates:
+        features.set_from_string(args.feature_gates)
+    if args.log_json:
+        from .obs import configure_json_logging
+
+        configure_json_logging()
+    if args.inject:
+        from . import chaos
+
+        chaos.configure(args.inject, seed=args.inject_seed)
+
+    peers = [
+        HttpPeer(a.strip(), timeout=args.peer_timeout)
+        for a in args.peers.split(",") if a.strip()
+    ]
+    cluster_size = len(peers) + 1
+    identity = args.lease_identity or default_identity()
+    elector = LeaderElector(
+        FileLease(args.lease_file),
+        identity,
+        lease_duration=args.lease_duration,
+        retry_period=args.lease_retry_period,
+        advertise=args.addr,
+    )
+
+    stopping: list = []
+    signal.signal(signal.SIGTERM, lambda *a: stopping.append(1))
+
+    def start_standby(log):
+        server = ControllerServer(
+            args.addr,
+            cluster=_make_controller_cluster(args),
+            tick_interval=args.tick_interval,
+            elector=elector,
+            standby_accepts_writes=False,
+            replication=log,
+        ).start()
+        print(f"replica {identity} standing by on {server.address} "
+              f"(quorum {majority_of(cluster_size)}/{cluster_size}, peers: "
+              f"{', '.join(p.id for p in peers)})", flush=True)
+        return server
+
+    def quorum_reachable() -> bool:
+        reached = 1  # self
+        for peer in peers:
+            try:
+                peer.position()
+            except Exception:
+                continue
+            reached += 1
+        return reached >= majority_of(cluster_size)
+
+    follower_log = FollowerLog(args.data_dir)
+    standby = start_standby(follower_log)
+    try:
+        while not stopping:
+            # Probe BEFORE touching the lease: acquiring-then-releasing on
+            # every retry while the quorum is down would inflate fencing
+            # terms and churn the shared lease volume at retry-period Hz.
+            if not quorum_reachable():
+                time.sleep(args.lease_retry_period)
+                continue
+            if not elector.ensure():
+                time.sleep(args.lease_retry_period)
+                continue
+            try:
+                # Assert the new term on a majority BEFORE reading
+                # positions (the old epoch can no longer commit past
+                # this), then reconcile our log against the quorum.
+                establish_term(elector.term, peers,
+                               cluster_size=cluster_size)
+                stats = catch_up(follower_log, peers,
+                                 cluster_size=cluster_size)
+            except Exception as exc:
+                # NoQuorumError is the expected shape; any other
+                # reconciliation failure (append rejected, snapshot I/O)
+                # equally must NOT crash the replica while it holds the
+                # lease — hand it back and retry from standby.
+                print(f"cannot promote: {exc}", flush=True)
+                elector.release()
+                time.sleep(args.lease_retry_period)
+                continue
+            # Promote: tear the standby down WITHOUT releasing the lease
+            # we just won, replay the committed log, serve.
+            standby.stop(release_lease=False)
+            follower_log.close()
+            try:
+                cluster = _make_controller_cluster(args)
+                store = Store(args.data_dir,
+                              snapshot_interval=args.snapshot_interval)
+                rstats = store.recover(cluster)
+                _bootstrap_cluster_config(args, cluster)
+            except Exception as exc:
+                # Store open/replay failed mid-promotion: return to
+                # standby (lease released so a healthy replica can lead).
+                print(f"promotion failed: {exc}; returning to standby",
+                      flush=True)
+                elector.release()
+                follower_log = FollowerLog(args.data_dir)
+                standby = start_standby(follower_log)
+                time.sleep(args.lease_retry_period)
+                continue
+            coordinator = ReplicationCoordinator(
+                identity, peers, term=elector.term)
+            coordinator.bind(store)
+            if elector.term > 1:
+                # Term 1 is the cluster's first-ever leadership; any
+                # higher term means a previous leader existed — this
+                # promotion IS a failover.
+                from .core import metrics as _metrics
+
+                _metrics.ha_failovers_total.inc()
+            server = ControllerServer(
+                args.addr,
+                cluster=cluster,
+                tick_interval=args.tick_interval,
+                elector=elector,
+                standby_accepts_writes=False,
+                replication=coordinator,
+            ).start()
+            print(f"replica {identity} LEADING on {server.address} "
+                  f"(term {elector.term}, {rstats.get('objects', 0)} "
+                  f"objects recovered, caught up "
+                  f"{stats.get('records', 0)} records from "
+                  f"{stats.get('source') or 'nobody'})", flush=True)
+            while not stopping:
+                time.sleep(min(0.5, args.lease_retry_period))
+                if coordinator.fenced or coordinator.lost_quorum:
+                    break
+            if stopping:
+                server.drain()
+                server.stop()
+                store.close()
+                return 0
+            # Demote: a leader that cannot commit hands off and mirrors.
+            print(f"replica {identity} demoting: "
+                  + ("fenced by a higher term" if coordinator.fenced
+                     else "quorum lost"), flush=True)
+            server.stop()  # pump already released the lease on stepdown
+            store.close()
+            follower_log = FollowerLog(args.data_dir)
+            try:
+                catch_up(follower_log, peers, cluster_size=cluster_size)
+            except Exception:
+                pass  # keep mirroring; catch-up retries at next promote
+            standby = start_standby(follower_log)
+    except KeyboardInterrupt:
+        pass
+    standby.stop()
+    follower_log.close()
     return 0
 
 

@@ -11,16 +11,28 @@ deployment analog: a shared volume between controller replicas — the same
 role the Lease object's storage plays for the reference). Semantics mirror
 k8s `leaderelection`:
 
-* a record holds (holder identity, acquire time, renew time);
+* a record holds (holder identity, fencing term, acquire time, renew time,
+  optional advertised address);
 * the holder renews every `retry_period`; a non-holder acquires only once
   `lease_duration` has elapsed since the last renewal (the previous leader
   is presumed dead);
+* every fresh acquisition increments the **fencing term** — a monotonic
+  epoch number downstream systems (the HA replication plane) use to reject
+  a deposed leader's writes: a follower that has seen term N refuses
+  append-entries stamped with any term < N, so a stalled ex-leader that
+  resumes can never commit into the new leader's log;
 * mutual exclusion comes from an exclusive flock on a sibling .lock file
   held across each elector's whole read-modify-write (FileLease.guard) —
-  racing standbys serialize there, and a stalled leader resuming with an
-  expired lease observes a standby's takeover instead of clobbering it.
-  (A port of FileLease to storage without flock semantics must bring its
-  own compare-and-swap.)
+  racing standbys serialize there. `FileLease.write` ADDITIONALLY
+  compare-and-swaps on (holder, term): the write re-reads the record and
+  refuses to clobber a lease whose (holder, term) is not the one the
+  caller based its decision on. Under the flock the CAS is a true
+  atomicity guarantee (writes are serialized, so the re-read cannot
+  itself race) and closes the stale-read TOCTOU inside `ensure()`;
+  WITHOUT the flock it is only a narrowing defense — the re-read->replace
+  window stays open — so a port to storage with no flock semantics (NFS,
+  an object store) must still bring a genuinely atomic conditional write
+  of its own.
 
 Timing uses the injectable clock (`utils.clock`) so failover is testable
 on virtual time, exactly like the TTL machinery.
@@ -42,17 +54,32 @@ LEASE_DURATION_S = 15.0
 RETRY_PERIOD_S = 2.0
 
 
+class LeaseConflict(Exception):
+    """A compare-and-swap write found the lease record changed under the
+    caller: someone else acquired (or bumped the term) between the read and
+    the write. The caller must re-read and stand down."""
+
+
 @dataclass
 class LeaseRecord:
     holder: str
     acquired_at: float
     renewed_at: float
+    # Fencing term: bumped on every fresh acquisition, never on renewal.
+    # Monotonic across the lease file's lifetime (release/takeover keep
+    # it), so it orders leaderships totally — the HA plane's epoch.
+    term: int = 0
+    # Advertised client-facing address of the holder (standby 503s carry
+    # it as the leader hint so clients fail over without a discovery hop).
+    address: str = ""
 
     def to_dict(self) -> dict:
         return {
             "holderIdentity": self.holder,
             "acquireTime": self.acquired_at,
             "renewTime": self.renewed_at,
+            "term": self.term,
+            "address": self.address,
         }
 
     @classmethod
@@ -61,20 +88,38 @@ class LeaseRecord:
             holder=str(d["holderIdentity"]),
             acquired_at=float(d["acquireTime"]),
             renewed_at=float(d["renewTime"]),
+            term=int(d.get("term", 0)),
+            address=str(d.get("address", "")),
         )
+
+    @property
+    def released(self) -> bool:
+        """A voluntary-release tombstone: no holder, but the term survives
+        so the next acquisition still increments past it."""
+        return not self.holder
 
 
 class FileLease:
     """Lease storage on a shared filesystem path (atomic-rename writes).
 
     `guard()` takes an exclusive flock on a sibling .lock file so a whole
-    read-modify-write (the elector's ensure()) is atomic across processes —
-    without it, a leader whose own lease expired mid-stall could clobber a
-    standby's fresh acquisition and produce a split-brain window.
+    read-modify-write (the elector's ensure()) is atomic across processes.
+    `write(record, expect=...)` additionally compare-and-swaps on the
+    current record's (holder, term): a write based on a stale read fails
+    with LeaseConflict instead of clobbering a standby's fresh
+    acquisition (split-brain). The CAS is atomic only while writes are
+    serialized by the guard; on flock-less storage it narrows the race
+    window but does not close it (see the module docstring).
+
+    `injector` (or the process-global chaos injector) is consulted at the
+    existing ``store.write`` chaos point once per lease write — an injected
+    ``enospc``/error fault fails the write like a full disk would, which is
+    how the elector's stepdown-on-unwritable-lease path is tested.
     """
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, injector=None):
         self.path = str(path)
+        self.injector = injector
 
     def guard(self):
         import contextlib
@@ -100,7 +145,42 @@ class FileLease:
             # the same way leaderelection treats an unparsable Lease.
             return None
 
-    def write(self, record: LeaseRecord) -> None:
+    @staticmethod
+    def _holder_term(rec: Optional[LeaseRecord]) -> tuple[str, int]:
+        return (rec.holder, rec.term) if rec is not None else ("", 0)
+
+    def _check_chaos(self) -> None:
+        from ..chaos.injector import consult
+
+        fault = consult(
+            "store.write", f"lease:{self.path}", injector=self.injector
+        )
+        if fault is None:
+            return  # no fault (latency already applied in place)
+        # enospc / torn / any error kind: the lease write fails exactly as
+        # a full or failing shared volume would.
+        raise OSError(
+            f"chaos: injected {fault.kind} writing lease {self.path} "
+            f"(seq {fault.seq})"
+        )
+
+    def write(
+        self,
+        record: LeaseRecord,
+        expect: Optional[tuple[str, int]] = None,
+    ) -> None:
+        """Atomically replace the record. With `expect=(holder, term)`,
+        compare-and-swap: re-read the current record and raise
+        LeaseConflict when its (holder, term) differs from `expect` — the
+        caller's decision was based on a stale read."""
+        if expect is not None:
+            current = self._holder_term(self.read())
+            if current != expect:
+                raise LeaseConflict(
+                    f"lease changed under us: expected {expect}, "
+                    f"found {current}"
+                )
+        self._check_chaos()
         directory = os.path.dirname(os.path.abspath(self.path))
         fd, tmp = tempfile.mkstemp(dir=directory, prefix=".lease-")
         try:
@@ -115,23 +195,41 @@ class FileLease:
             raise
 
     def clear(self, holder: str) -> None:
-        """Best-effort release: delete only if still held by `holder`."""
+        """Voluntary release: replace the record with a released tombstone
+        (holder cleared, term preserved) only while still held by
+        `holder`. Release-by-non-holder is a no-op — a deposed leader's
+        late release must not evict its successor. Term preservation keeps
+        fencing terms monotonic across voluntary hand-offs."""
         rec = self.read()
         if rec is not None and rec.holder == holder:
             try:
-                os.unlink(self.path)
-            except OSError:
-                pass
+                self.write(
+                    LeaseRecord(
+                        holder="",
+                        acquired_at=rec.acquired_at,
+                        renewed_at=rec.renewed_at,
+                        term=rec.term,
+                    ),
+                    expect=(holder, rec.term),
+                )
+            except (OSError, LeaseConflict):
+                pass  # best-effort, like leaderelection's ReleaseOnCancel
 
 
 class LeaderElector:
     """Acquire/renew loop driven by the caller (the server's pump thread).
 
     `ensure()` is the single entry point: it renews when this identity
-    already holds the lease, acquires when the lease is absent/expired, and
-    returns whether this replica is currently the leader. The whole
-    read-modify-write runs under the lease's cross-process guard (flock),
-    which is what closes the standby-vs-standby and stalled-leader races.
+    already holds the lease, acquires when the lease is absent/released/
+    expired, and returns whether this replica is currently the leader. The
+    whole read-modify-write runs under the lease's cross-process guard
+    (flock) and every write compare-and-swaps on the record it read, which
+    is what closes the standby-vs-standby and stalled-leader races.
+
+    A failed lease write (ENOSPC, I/O error, CAS conflict) makes the
+    elector STEP DOWN: leadership it cannot durably renew is leadership it
+    cannot prove, and continuing to reconcile would risk two replicas
+    acting as leader once the stale record expires.
     """
 
     def __init__(
@@ -141,6 +239,7 @@ class LeaderElector:
         lease_duration: float = LEASE_DURATION_S,
         retry_period: float = RETRY_PERIOD_S,
         clock: Optional[Clock] = None,
+        advertise: str = "",
     ):
         self.lease = lease
         self.identity = identity
@@ -156,20 +255,45 @@ class LeaderElector:
                 f"lease_duration ({self.lease_duration})"
             )
         self.clock = clock or Clock()
+        # Client-facing address written into the lease record so standby
+        # 503s can point writers at the leader.
+        self.advertise = advertise
         self._leading = False
+        self._term = 0
         self._last_renew = -float("inf")
 
     @property
     def is_leading(self) -> bool:
         return self._leading
 
+    @property
+    def term(self) -> int:
+        """Fencing term of the leadership this elector holds (0 while
+        standing by). Stamped on replicated WAL frames so followers can
+        reject a deposed leader's appends."""
+        return self._term if self._leading else 0
+
+    def leader_hint(self) -> tuple[str, str]:
+        """(holder identity, advertised address) from the current record —
+        what a standby's 503 carries so clients retry against the leader."""
+        rec = self.lease.read()
+        if rec is None or rec.released:
+            return "", ""
+        return rec.holder, rec.address
+
+    def _step_down(self) -> bool:
+        self._leading = False
+        return False
+
     def ensure(self) -> bool:
         # The whole read-modify-write runs under the lease's cross-process
-        # guard: a stalled leader resuming with an EXPIRED own lease must
-        # not clobber a standby that just took over (split-brain).
+        # guard AND each write CASes on the record read here: a stalled
+        # leader resuming with an EXPIRED own lease must not clobber a
+        # standby that just took over (split-brain).
         with self.lease.guard():
             now = self.clock.now()
             rec = self.lease.read()
+            expect = FileLease._holder_term(rec)
             if (
                 rec is not None
                 and rec.holder == self.identity
@@ -178,27 +302,55 @@ class LeaderElector:
                 # Still validly ours: renew (rate-limited to retry_period so
                 # a hot pump loop does not rewrite the file every few ms).
                 if now - self._last_renew >= self.retry_period:
-                    self.lease.write(
-                        LeaseRecord(self.identity, rec.acquired_at, now)
-                    )
+                    try:
+                        self.lease.write(
+                            LeaseRecord(
+                                self.identity, rec.acquired_at, now,
+                                term=rec.term, address=self.advertise,
+                            ),
+                            expect=expect,
+                        )
+                    except (OSError, LeaseConflict):
+                        # Unwritable lease (ENOSPC) or a racing takeover:
+                        # we cannot prove continued leadership — step down
+                        # rather than reconcile on a lease that will expire
+                        # under us.
+                        return self._step_down()
                     self._last_renew = now
                 self._leading = True
+                self._term = rec.term
                 return True
-            if rec is None or now - rec.renewed_at >= self.lease_duration:
-                # Absent or expired (possibly our own, after a stall longer
-                # than the lease — re-acquisition, not renewal).
-                self.lease.write(LeaseRecord(self.identity, now, now))
+            if (
+                rec is None
+                or rec.released
+                or now - rec.renewed_at >= self.lease_duration
+            ):
+                # Absent, voluntarily released, or expired (possibly our
+                # own, after a stall longer than the lease —
+                # re-acquisition, not renewal). A fresh acquisition opens a
+                # NEW term: the fencing epoch every downstream consumer
+                # (WAL replication) orders by.
+                term = (rec.term if rec is not None else 0) + 1
+                try:
+                    self.lease.write(
+                        LeaseRecord(self.identity, now, now, term=term,
+                                    address=self.advertise),
+                        expect=expect,
+                    )
+                except (OSError, LeaseConflict):
+                    return self._step_down()
                 self._leading = True
+                self._term = term
                 self._last_renew = now
                 return True
             # Valid lease held by someone else: standby.
-            self._leading = False
-            return False
+            return self._step_down()
 
     def release(self) -> None:
         """Voluntary hand-off on clean shutdown (leaderelection's
-        ReleaseOnCancel): clears the record so a standby takes over on its
-        next retry instead of waiting out the full lease duration."""
+        ReleaseOnCancel): writes a released tombstone (term preserved) so a
+        standby takes over on its next retry instead of waiting out the
+        full lease duration."""
         if self._leading:
             with self.lease.guard():
                 self.lease.clear(self.identity)

@@ -284,19 +284,66 @@ slo_restart_recovery_seconds = Histogram(
 build_info = Gauge(
     "jobset_build_info",
     "Always 1, labeled with the build's version, the active JAX backend, "
-    "and the enabled feature gates (the kube_pod_info idiom: join other "
-    "series against these labels)",
-    label_names=("version", "backend", "gates"),
+    "the enabled feature gates, and — on replicated control planes — the "
+    "replica's current role and fencing term (the kube_pod_info idiom: "
+    "join other series against these labels; a debug bundle from any "
+    "replica identifies who was leading)",
+    label_names=("version", "backend", "gates", "role", "term"),
+)
+# Replicated control plane (jobset_tpu/ha, docs/ha.md): quorum WAL
+# replication state as seen by THIS replica.
+ha_role = Gauge(
+    "jobset_ha_role",
+    "This replica's replication role: 1 = leader (holds the fenced "
+    "lease, ships WAL frames), 0 = follower/standby",
+)
+ha_term = Gauge(
+    "jobset_ha_term",
+    "Current leadership fencing term observed by this replica "
+    "(monotonic across failovers; followers reject appends from any "
+    "smaller term)",
+)
+ha_commit_seq = Gauge(
+    "jobset_ha_commit_seq",
+    "Quorum commit index: highest WAL record seq fsync-acknowledged by a "
+    "majority of replicas (writes are acknowledged to clients only up to "
+    "here)",
+)
+ha_follower_lag_records = Gauge(
+    "jobset_ha_follower_lag_records",
+    "Leader's view of each follower's replication lag in WAL records "
+    "(0 = caught up)",
+    label_names=("peer",),
+)
+ha_replicated_records_total = Counter(
+    "jobset_ha_replicated_records_total",
+    "WAL records fsync-acknowledged by each follower, per peer",
+    label_names=("peer",),
+)
+ha_quorum_failures_total = Counter(
+    "jobset_ha_quorum_failures_total",
+    "Commits that failed to reach a majority of replicas (the write is "
+    "NOT acknowledged as committed; repeated failure steps the leader "
+    "down)",
+    label_names=(),
+)
+ha_failovers_total = Counter(
+    "jobset_ha_failovers_total",
+    "Leader failovers completed (a standby caught up, replayed the "
+    "committed log, and took over serving)",
+    label_names=(),
 )
 
 
-def set_build_info(version: str, backend: str, gates: str) -> None:
+def set_build_info(version: str, backend: str, gates: str,
+                   role: str = "single", term: int = 0) -> None:
     """(Re)stamp the single build_info row; the old row is dropped so a
-    backend that initializes later (jax loads lazily) never leaves a stale
-    duplicate series."""
+    backend that initializes later (jax loads lazily) — or a replica that
+    changes role/term at failover — never leaves a stale duplicate
+    series."""
     with build_info._lock:
         build_info._values.clear()
-        build_info._values[(version, backend, gates)] = 1.0
+        build_info._values[(version, backend, gates, role, str(term))] = 1.0
 
 
 ALL_COUNTERS = (
@@ -311,6 +358,9 @@ ALL_COUNTERS = (
     queue_preemptions_total,
     store_commits_total,
     store_write_errors_total,
+    ha_replicated_records_total,
+    ha_quorum_failures_total,
+    ha_failovers_total,
 )
 ALL_HISTOGRAMS = (
     reconcile_time_seconds,
@@ -331,6 +381,10 @@ ALL_GAUGES = (
     queue_admitted_workloads,
     store_wal_bytes,
     build_info,
+    ha_role,
+    ha_term,
+    ha_commit_seq,
+    ha_follower_lag_records,
 )
 
 

@@ -1,0 +1,29 @@
+"""Replicated control plane (docs/ha.md): quorum WAL replication over the
+durable store, leader failover with zero lost acknowledged writes."""
+
+from .replication import (
+    FollowerLog,
+    HttpPeer,
+    LocalPeer,
+    NoQuorumError,
+    ReplicationCoordinator,
+    ReplicationError,
+    catch_up,
+    establish_term,
+    majority_of,
+)
+from .supervisor import Replica, ReplicaSet
+
+__all__ = [
+    "FollowerLog",
+    "HttpPeer",
+    "LocalPeer",
+    "NoQuorumError",
+    "Replica",
+    "ReplicaSet",
+    "ReplicationCoordinator",
+    "ReplicationError",
+    "catch_up",
+    "establish_term",
+    "majority_of",
+]

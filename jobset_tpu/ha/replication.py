@@ -1,0 +1,983 @@
+"""Quorum WAL replication: the Raft-style layer over the durable store.
+
+The store PR made ONE controller crash-safe (fsync'd CRC-framed WAL +
+snapshots); this module makes the control plane survive losing that
+controller's whole node. N replicas form a quorum:
+
+* the **leader** (elected via `core.lease.LeaderElector`, whose fencing
+  term is the replication epoch) appends each commit record to its own WAL
+  as before, then streams the identical canonical payload to every
+  follower and counts fsync acknowledgements — an HTTP write is
+  acknowledged to the client only once a MAJORITY of replicas (leader
+  included) has the frame on disk (`Store.commit_seq`, the commit index);
+* each **follower** runs a `FollowerLog`: an append-only mirror of the
+  leader's WAL in a standard store data-dir layout (wal.log +
+  snapshot.json + meta.json), so a follower that wins election simply
+  opens a `Store` on its directory and `Store.recover` replays its
+  committed log into a fresh `Cluster` — the exact crash-restart path the
+  store PR proved, now fed by replication instead of local history;
+* **fencing**: every append-entries call carries the leader's lease term;
+  a follower that has observed term N rejects appends from any term < N,
+  so a deposed leader (stalled, partitioned) cannot commit into the new
+  leader's epoch. The rejected leader marks itself `fenced` and the
+  server steps it down;
+* **catch-up**: a replica promoting (or rejoining after a crash) first
+  reconciles its log against a quorum — it asks every reachable peer for
+  its (term, lastSeq) position, requires that itself plus the reachable
+  peers form a majority, and copies the missing tail (or a full snapshot
+  when the source's resend buffer no longer covers the gap) from the most
+  up-to-date peer. Per-record terms (stamped by `Store.commit`) let it
+  detect a divergent unacknowledged tail left by a dead leader and
+  truncate it before appending the quorum's version.
+
+Why zero majority-acknowledged writes can be lost: an acknowledged frame
+is fsync'd on >= majority of N replicas; after losing any single replica,
+every majority of the survivors intersects that set, so the catch-up
+step's "most up-to-date reachable peer" always holds the frame.
+
+Chaos: each leader->follower ship is one arrival at the
+``replication.stream`` injection point (`break` drops the call before any
+bytes move, `latency` delays it), so seeded kill-storms exercise follower
+lag, resend, and quorum loss deterministically.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from collections import deque
+from typing import Optional
+
+from ..store.codec import canonical
+from ..store.store import (
+    SNAPSHOT_FILE,
+    WAL_FILE,
+    StoreError,
+    write_snapshot_file,
+)
+from ..store.wal import StoreWriteError, WriteAheadLog
+
+META_FILE = "meta.json"
+
+
+class ReplicationError(Exception):
+    """Base class for replication failures."""
+
+
+class NoQuorumError(ReplicationError):
+    """Fewer than a majority of replicas are reachable: promotion (or any
+    operation that must prove it sees every acknowledged write) must not
+    proceed."""
+
+
+def majority_of(cluster_size: int) -> int:
+    return cluster_size // 2 + 1
+
+
+def _entry_term(entry: dict) -> int:
+    """Fencing term stamped inside an entry's record payload (0 for
+    records written by an unreplicated store)."""
+    try:
+        return int(json.loads(entry["payload"]).get("term", 0))
+    except (ValueError, KeyError, TypeError):
+        return 0
+
+
+# ---------------------------------------------------------------------------
+# Follower side: the replication receiver
+# ---------------------------------------------------------------------------
+
+
+class FollowerLog:
+    """Append-only mirror of the leader's WAL in a standard store data-dir.
+
+    Layout is exactly `Store`'s (snapshot.json + wal.log, same CRC frames,
+    same exclusive LOCK flock) plus `meta.json` carrying the durable
+    fencing term — so promotion is nothing more than `close()` followed by
+    `Store(data_dir).recover(cluster)`. Appends fsync per record before
+    acknowledging, which is what makes a majority of acks a durability
+    guarantee rather than a liveness hint.
+    """
+
+    def __init__(self, data_dir: str, injector=None):
+        os.makedirs(data_dir, exist_ok=True)
+        self.data_dir = data_dir
+        # Same single-writer guard as Store: a follower log and a serving
+        # store must never share a directory concurrently.
+        self._lock_fd = os.open(
+            os.path.join(data_dir, "LOCK"), os.O_RDWR | os.O_CREAT, 0o644
+        )
+        try:
+            import fcntl
+
+            fcntl.flock(self._lock_fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError as exc:
+            os.close(self._lock_fd)
+            self._lock_fd = None
+            raise StoreError(
+                f"data dir {data_dir!r} is locked by another process "
+                f"(one replica per --data-dir): {exc}"
+            ) from exc
+        self.wal = WriteAheadLog(
+            os.path.join(data_dir, WAL_FILE), injector=injector
+        )
+        self.snapshot_seq = 0
+        self._snapshot_last_term = 0
+        snapshot_path = os.path.join(data_dir, SNAPSHOT_FILE)
+        if os.path.exists(snapshot_path):
+            try:
+                with open(snapshot_path) as f:
+                    doc = json.load(f)
+                self.snapshot_seq = int(doc.get("seq", 0))
+                self._snapshot_last_term = int(doc.get("lastTerm", 0))
+            except (OSError, ValueError):
+                self.snapshot_seq = 0
+        records, _torn = self.wal.recover()
+        # In-memory resend/catch-up view: [{seq, payload}] of every record
+        # past the snapshot, canonical strings so fetches ship the exact
+        # bytes that were framed.
+        self.records: list[dict] = [
+            {"seq": int(r.get("seq", 0)), "payload": canonical(r)}
+            for r in records
+            if int(r.get("seq", 0)) > self.snapshot_seq
+        ]
+        self.last_seq = (
+            self.records[-1]["seq"] if self.records else self.snapshot_seq
+        )
+        self.term = 0
+        self.commit_seq = self.snapshot_seq
+        meta_path = os.path.join(data_dir, META_FILE)
+        meta: dict = {}
+        if os.path.exists(meta_path):
+            try:
+                with open(meta_path) as f:
+                    meta = json.load(f)
+                self.term = int(meta.get("term", 0))
+                self.commit_seq = min(
+                    int(meta.get("commitSeq", 0)), self.last_seq
+                )
+            except (OSError, ValueError):
+                meta = {}
+        self.commit_seq = max(self.commit_seq, self.snapshot_seq)
+        # Term of the LAST LOG ENTRY — the up-to-dateness rank (Raft's
+        # lastLogTerm), distinct from the OBSERVED term above (Raft's
+        # currentTerm, the fencing floor). Ranking replicas by observed
+        # term would let a gap-rejected straggler — whose term was bumped
+        # by a new leader's probe but which holds none of that epoch's
+        # records — outrank a peer holding majority-acknowledged history.
+        if self.records:
+            self.last_entry_term = _entry_term(self.records[-1])
+        else:
+            self.last_entry_term = max(
+                self._snapshot_last_term,
+                int(meta.get("lastEntryTerm", 0)),
+            )
+        # Self-compaction threshold: once this many COMMITTED records
+        # accumulate, fold them into snapshot.json and truncate the WAL
+        # (a follower mirrors forever; without this its log and in-memory
+        # record list grow without bound).
+        self.compact_records = 1024
+        self._lock = threading.Lock()
+
+    # -- durability helpers -------------------------------------------------
+
+    def _persist_meta(self, fsync: bool = True) -> None:
+        """Durably record (term, commitSeq). The TERM must survive a crash
+        (Raft persists currentTerm for the same reason: a rejoining
+        replica must keep rejecting leaders it already fenced out); the
+        commit index is a best-effort optimization — recovery re-derives a
+        safe lower bound and catch-up sharpens it."""
+        path = os.path.join(self.data_dir, META_FILE)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({
+                "term": self.term,
+                "commitSeq": self.commit_seq,
+                "lastEntryTerm": self.last_entry_term,
+            }, f)
+            if fsync:
+                f.flush()
+                os.fsync(f.fileno())
+        os.replace(tmp, path)
+        if fsync:
+            # The rename itself must survive power loss: a term adopted
+            # during establish_term that evaporates on reboot would
+            # re-open the deposed epoch's window (Raft persists
+            # currentTerm for exactly this reason).
+            dir_fd = os.open(self.data_dir, os.O_RDONLY)
+            try:
+                os.fsync(dir_fd)
+            finally:
+                os.close(dir_fd)
+
+    # -- replication receiver ----------------------------------------------
+
+    def position(self) -> dict:
+        with self._lock:
+            return {
+                "role": "follower",
+                "term": self.term,
+                "lastTerm": self.last_entry_term,
+                "lastSeq": self.last_seq,
+                "commitSeq": self.commit_seq,
+            }
+
+    def append_entries(
+        self, term: int, entries: list[dict], commit_seq: int = 0
+    ) -> dict:
+        """One replication RPC from a leader: fence on term, append the
+        in-order tail (fsync per frame), advance the commit index. Returns
+        {ok, term, lastSeq}; ok=False with reason 'stale-term' fences a
+        deposed leader, 'gap' asks it to resend from lastSeq+1."""
+        with self._lock:
+            if term < self.term:
+                return {
+                    "ok": False, "reason": "stale-term",
+                    "term": self.term, "lastSeq": self.last_seq,
+                }
+            if term > self.term:
+                self.term = int(term)
+                self._persist_meta()
+            for entry in sorted(entries, key=lambda e: e["seq"]):
+                seq = int(entry["seq"])
+                if seq <= self.last_seq:
+                    local_term = self._record_term_locked(seq)
+                    if (
+                        local_term is not None
+                        and local_term != _entry_term(entry)
+                    ):
+                        # Raft's append conflict rule: same seq, different
+                        # term — our version was a deposed leader's
+                        # never-committed write. The current-term leader's
+                        # history wins: drop ours and everything after it,
+                        # then fall through to append the leader's. A
+                        # blind duplicate-skip here would ACK history we
+                        # do not actually hold.
+                        self._truncate_from_locked(seq)
+                    else:
+                        continue  # true duplicate resend: idempotent
+                if seq != self.last_seq + 1:
+                    return {
+                        "ok": False, "reason": "gap",
+                        "term": self.term, "lastSeq": self.last_seq,
+                    }
+                payload = entry["payload"].encode()
+                try:
+                    self.wal.append(payload, detail=f"replica seq={seq}")
+                except StoreWriteError:
+                    # Local disk fault: repair the tail and report our
+                    # durable position — the frame is NOT acknowledged.
+                    try:
+                        self.wal.repair()
+                    except OSError:
+                        pass
+                    return {
+                        "ok": False, "reason": "append-failed",
+                        "term": self.term, "lastSeq": self.last_seq,
+                    }
+                self.records.append(
+                    {"seq": seq, "payload": entry["payload"]}
+                )
+                self.last_seq = seq
+                self.last_entry_term = _entry_term(entry)
+            if commit_seq:
+                self.commit_seq = max(
+                    self.commit_seq, min(int(commit_seq), self.last_seq)
+                )
+        self.maybe_compact()
+        with self._lock:
+            return {
+                "ok": True, "term": self.term, "lastSeq": self.last_seq,
+            }
+
+    def install_snapshot(self, term: int, doc: dict) -> dict:
+        """Full-state transfer for a follower too far behind the leader's
+        resend buffer: atomically replace snapshot.json, truncate the WAL,
+        and fast-forward to the snapshot's seq (Store recovery treats this
+        exactly like a locally-compacted log)."""
+        with self._lock:
+            if term < self.term:
+                return {
+                    "ok": False, "reason": "stale-term",
+                    "term": self.term, "lastSeq": self.last_seq,
+                }
+            if term > self.term:
+                self.term = int(term)
+                self._persist_meta()
+            write_snapshot_file(self.data_dir, doc)
+            self.wal.reset()
+            self.records = []
+            self.snapshot_seq = int(doc.get("seq", 0))
+            self._snapshot_last_term = int(doc.get("lastTerm", 0))
+            self.last_seq = self.snapshot_seq
+            self.last_entry_term = self._snapshot_last_term
+            self.commit_seq = max(self.commit_seq, self.snapshot_seq)
+            self._persist_meta()
+            return {
+                "ok": True, "term": self.term, "lastSeq": self.last_seq,
+            }
+
+    # -- catch-up source ----------------------------------------------------
+
+    def entries_after(self, after_seq: int) -> dict:
+        """Log tail for a peer's catch-up: records with seq > after_seq,
+        preceded by the full snapshot when the gap predates our WAL."""
+        with self._lock:
+            if after_seq < self.snapshot_seq:
+                snapshot_path = os.path.join(self.data_dir, SNAPSHOT_FILE)
+                with open(snapshot_path) as f:
+                    doc = json.load(f)
+                return {"snapshot": doc, "entries": list(self.records)}
+            return {
+                "entries": [
+                    e for e in self.records if e["seq"] > after_seq
+                ]
+            }
+
+    def _record_term_locked(self, seq: int) -> Optional[int]:
+        for e in self.records:
+            if e["seq"] == seq:
+                return _entry_term(e)
+        return None
+
+    def record_term(self, seq: int) -> Optional[int]:
+        """Fencing term of the local record at `seq` (None when we do not
+        hold it) — the divergence probe catch-up uses."""
+        with self._lock:
+            return self._record_term_locked(seq)
+
+    def _truncate_from_locked(self, seq: int) -> int:
+        keep = [e for e in self.records if e["seq"] < seq]
+        dropped = len(self.records) - len(keep)
+        if dropped:
+            # In-place truncate at the exact frame boundary: a crash mid-
+            # operation must never leave previously-fsync'd COMMITTED
+            # records missing (reset-and-reappend would open exactly that
+            # window). The WAL holds only records past the snapshot, in
+            # order, so the boundary is the sum of the kept frames.
+            self.wal.truncate_to(sum(
+                self.wal.frame_size(e["payload"].encode()) for e in keep
+            ))
+            self.records = keep
+            self.last_seq = (
+                keep[-1]["seq"] if keep else self.snapshot_seq
+            )
+            self.last_entry_term = (
+                _entry_term(keep[-1]) if keep
+                else self._snapshot_last_term
+            )
+            self.commit_seq = min(self.commit_seq, self.last_seq)
+        return dropped
+
+    def truncate_from(self, seq: int) -> int:
+        """Drop every local record with seq >= `seq` (a divergent
+        unacknowledged tail from a dead leader) and rebuild the WAL from
+        the retained prefix. Returns the number of records dropped."""
+        with self._lock:
+            return self._truncate_from_locked(seq)
+
+    def maybe_compact(self, limit: Optional[int] = None) -> bool:
+        """Fold the committed prefix into snapshot.json and truncate the
+        WAL once `compact_records` committed records accumulate — the
+        follower-side analog of `Store.compact`. Records are full diffs
+        (last-writer-wins ops over the snapshot state), so folding is a
+        replay; only records PAST the commit index stay in the WAL (they
+        may still need divergence resolution at catch-up). Safe because
+        committed records are immutable on a majority."""
+        limit = self.compact_records if limit is None else limit
+        with self._lock:
+            committed = [
+                e for e in self.records if e["seq"] <= self.commit_seq
+            ]
+            if limit <= 0 or len(committed) < limit:
+                return False
+            from ..store.store import KINDS
+
+            snapshot_path = os.path.join(self.data_dir, SNAPSHOT_FILE)
+            doc: dict = {}
+            if os.path.exists(snapshot_path):
+                with open(snapshot_path) as f:
+                    doc = json.load(f)
+            state = {
+                kind: dict(doc.get("state", {}).get(kind) or {})
+                for kind in KINDS
+            }
+            rv = int(doc.get("rv", 0))
+            counters = dict(doc.get("counters") or {})
+            last_term = int(doc.get("lastTerm", 0))
+            for entry in committed:
+                record = json.loads(entry["payload"])
+                for op in record.get("ops", ()):
+                    if op[0] == "put":
+                        state[op[1]][op[2]] = op[3]
+                    else:
+                        state[op[1]].pop(op[2], None)
+                rv = int(record.get("rv", rv))
+                counters = dict(record.get("counters") or counters)
+                last_term = int(record.get("term", last_term))
+            new_doc = {
+                "seq": committed[-1]["seq"],
+                "rv": rv,
+                "counters": counters,
+                "state": state,
+                "lastTerm": last_term,
+            }
+            write_snapshot_file(self.data_dir, new_doc)
+            tail = [e for e in self.records if e["seq"] > self.commit_seq]
+            self.wal.reset()
+            for entry in tail:
+                self.wal.append(
+                    entry["payload"].encode(),
+                    detail=f"compact keep={entry['seq']}",
+                )
+            self.records = tail
+            self.snapshot_seq = new_doc["seq"]
+            self._snapshot_last_term = last_term
+            if not tail:
+                self.last_entry_term = max(self.last_entry_term, last_term)
+            self._persist_meta()
+            return True
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def close(self) -> None:
+        """Release the directory for promotion (Store re-opens it)."""
+        try:
+            self._persist_meta()
+        except OSError:
+            pass
+        self.wal.close()
+        if self._lock_fd is not None:
+            os.close(self._lock_fd)
+            self._lock_fd = None
+
+    def hard_kill(self) -> None:
+        """Crash simulation: drop the fds with no flush (kill -9)."""
+        self.wal.abandon()
+        if self._lock_fd is not None:
+            os.close(self._lock_fd)
+            self._lock_fd = None
+
+
+# ---------------------------------------------------------------------------
+# Peer transports
+# ---------------------------------------------------------------------------
+
+
+class LocalPeer:
+    """In-process transport for tests / the replica supervisor: calls the
+    peer replica's replication surface directly. `target` is any object
+    exposing the FollowerLog receiver methods (a FollowerLog, a Replica
+    that routes by role, or a ReplicationCoordinator on a current
+    leader)."""
+
+    def __init__(self, peer_id: str, target):
+        self.id = peer_id
+        self.target = target
+
+    def _resolve(self):
+        target = self.target
+        resolved = getattr(target, "replication_surface", None)
+        surface = resolved() if callable(resolved) else target
+        if surface is None:
+            raise ConnectionError(f"peer {self.id} is down")
+        return surface
+
+    def position(self) -> dict:
+        return self._resolve().position()
+
+    def append_entries(self, term, entries, commit_seq=0) -> dict:
+        return self._resolve().append_entries(term, entries, commit_seq)
+
+    def install_snapshot(self, term, doc) -> dict:
+        return self._resolve().install_snapshot(term, doc)
+
+    def entries_after(self, after_seq) -> dict:
+        return self._resolve().entries_after(after_seq)
+
+
+class HttpPeer:
+    """Cross-process transport against a peer controller's `/ha/v1/*`
+    endpoints (`controller --replicate --peers ...`).
+
+    A transport failure opens a short down-window (`down_backoff_s`)
+    during which further calls fail IMMEDIATELY instead of re-dialing: a
+    blackholed peer would otherwise cost a full connect timeout on every
+    write's quorum round (the ship loop runs under the cluster lock, so
+    one dead host must not add seconds to every request). Lives at the
+    transport so the coordinator's chaos arrivals and the in-process
+    LocalPeer tests stay deterministic."""
+
+    def __init__(self, address: str, timeout: float = 5.0,
+                 scheme: str = "http", down_backoff_s: float = 1.0):
+        self.id = address
+        self.address = address
+        self.timeout = timeout
+        self.down_backoff_s = down_backoff_s
+        self.base = f"{scheme}://{address}/ha/v1"
+        self._down_until = 0.0
+        self._last_error = ""
+
+    def _call(self, method: str, path: str, body: Optional[dict] = None) -> dict:
+        import time as _t
+        import urllib.error
+        import urllib.request
+
+        if _t.monotonic() < self._down_until:
+            raise ConnectionError(
+                f"peer {self.id} in down-backoff: {self._last_error}"
+            )
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            self.base + path, data=data, method=method,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                result = json.loads(resp.read())
+                self._down_until = 0.0
+                return result
+        except urllib.error.HTTPError as exc:
+            detail = exc.read()
+            # The peer is UP (it answered); no backoff.
+            raise ConnectionError(
+                f"peer {self.id}: HTTP {exc.code} {detail[:200]!r}"
+            ) from exc
+        except (urllib.error.URLError, OSError, ValueError) as exc:
+            self._last_error = str(exc)
+            self._down_until = _t.monotonic() + self.down_backoff_s
+            raise ConnectionError(f"peer {self.id}: {exc}") from exc
+
+    def position(self) -> dict:
+        return self._call("GET", "/position")
+
+    def append_entries(self, term, entries, commit_seq=0) -> dict:
+        return self._call("POST", "/append", {
+            "term": term, "entries": entries, "commitSeq": commit_seq,
+        })
+
+    def install_snapshot(self, term, doc) -> dict:
+        return self._call("POST", "/snapshot", {"term": term, "snapshot": doc})
+
+    def entries_after(self, after_seq) -> dict:
+        return self._call("GET", f"/log?after={int(after_seq)}")
+
+
+# ---------------------------------------------------------------------------
+# Leader side: the replication coordinator
+# ---------------------------------------------------------------------------
+
+
+class ReplicationCoordinator:
+    """Leader-side frame shipper + commit-index bookkeeper.
+
+    Bound to the leader's `Store` (`bind`), it is called synchronously
+    from the server's commit path (under the cluster lock, exactly where
+    the local fsync already happens): `replicate()` streams the new record
+    to every peer, counts fsync acks, and advances `Store.commit_seq` only
+    on majority. Slow/broken followers are caught up from a bounded resend
+    buffer (or a snapshot install when they fall past it) on the next
+    ship. Repeated quorum failure (or a term rejection from any follower)
+    marks the coordinator `lost_quorum`/`fenced`, which the server turns
+    into a stepdown — a leader that cannot commit must stop accepting
+    writes so clients fail over to the side that can.
+    """
+
+    RESEND_BUFFER = 4096
+
+    def __init__(
+        self,
+        identity: str,
+        peers: list,
+        term: int = 0,
+        stepdown_after: int = 5,
+        injector=None,
+    ):
+        self.identity = identity
+        self.peers = list(peers)
+        self.term = int(term)
+        self.stepdown_after = max(1, int(stepdown_after))
+        self.injector = injector
+        self.store = None
+        # Guards the resend buffer: replicate() appends under the cluster
+        # lock while a rejoining peer's catch-up fetch reads from an HTTP
+        # handler thread.
+        self._buffer_lock = threading.Lock()
+        self._buffer: deque = deque(maxlen=self.RESEND_BUFFER)
+        self._peer_next: dict[str, Optional[int]] = {}
+        self._peer_acked: dict[str, int] = {}
+        self.fenced = False
+        self.lost_quorum = False
+        self._quorum_failures = 0
+
+    @property
+    def cluster_size(self) -> int:
+        return len(self.peers) + 1
+
+    @property
+    def majority(self) -> int:
+        return majority_of(self.cluster_size)
+
+    def bind(self, store) -> None:
+        """Attach to the leader's store: from here on, local commits are
+        NOT the commit point — the quorum is."""
+        self.store = store
+        store.replicated = True
+        store.term = self.term
+
+    # -- the hot path -------------------------------------------------------
+
+    def _ship(self, peer, target_seq: int) -> bool:
+        """Bring one peer up to `target_seq`; True when the peer has
+        fsync-acknowledged every frame through it."""
+        from ..chaos.injector import consult
+
+        fault = consult(
+            "replication.stream", f"-> {peer.id}", injector=self.injector
+        )
+        if fault is not None:
+            # break / any error kind: the stream drops pre-flight
+            # (latency was already applied in place by consult).
+            self._peer_next[peer.id] = None
+            return False
+        from ..core import metrics
+
+        try:
+            next_seq = self._peer_next.get(peer.id)
+            if next_seq is None:
+                pos = peer.position()
+                if int(pos.get("term", 0)) > self.term:
+                    self.fenced = True
+                    return False
+                # First contact (or contact after a failure): the peer's
+                # lastSeq alone cannot be trusted past OUR commit index —
+                # a rejoined replica may hold a dead leader's ghost
+                # record at those seqs, and counting its empty-batch ack
+                # would credit a quorum for head records it does not hold
+                # (Raft's log-matching check is what this stands in
+                # for). So the UNACKED head (commit, target] is always
+                # physically shipped — the peer's append conflict rule
+                # then guarantees honest possession. Records <= our
+                # commit are already on a majority regardless of this
+                # peer; a ghost it holds down there is reconciled by its
+                # own promotion-time catch-up, not by the hot path.
+                next_seq = min(
+                    int(pos.get("lastSeq", 0)), self.store.commit_seq
+                ) + 1
+            with self._buffer_lock:
+                batch = [e for e in self._buffer if e["seq"] >= next_seq]
+            if (batch and batch[0]["seq"] > next_seq) or (
+                not batch and next_seq <= target_seq
+            ):
+                # The peer's gap predates the resend buffer: full-state
+                # transfer, then stream whatever the snapshot missed. A
+                # snapshot may only ever cover COMMITTED history (folding
+                # destroys the per-record terms divergence detection
+                # needs), so while unacked records exist the install is
+                # DEFERRED — the idle pump re-ships until commit catches
+                # up, which cannot need this very peer: the unacked
+                # suffix is bounded by stepdown_after, far inside the
+                # resend buffer, so quorum-critical peers are always
+                # reachable by plain record resend.
+                if self.store.commit_seq < self.store.seq:
+                    return False
+                resp = peer.install_snapshot(
+                    self.term, self.store.snapshot_doc()
+                )
+                if not resp.get("ok"):
+                    # Fence ONLY on a genuinely higher term: a deposed
+                    # ex-leader's surface also answers "stale-term" —
+                    # carrying its own LOWER term — and must not scare
+                    # the legitimate leader into stepping down.
+                    if int(resp.get("term", 0)) > self.term:
+                        self.fenced = True
+                    self._peer_next[peer.id] = None
+                    return False
+                next_seq = int(resp["lastSeq"]) + 1
+                with self._buffer_lock:
+                    batch = [
+                        e for e in self._buffer if e["seq"] >= next_seq
+                    ]
+            resp = peer.append_entries(
+                self.term, batch, commit_seq=self.store.commit_seq
+            )
+            if not resp.get("ok"):
+                if int(resp.get("term", 0)) > self.term:
+                    self.fenced = True
+                # gap / append-failed: force a fresh position probe next
+                # ship — the probe's log-matching rule decides where to
+                # resend from (the raw reported lastSeq could include a
+                # not-yet-truncated ghost suffix).
+                self._peer_next[peer.id] = None
+                return False
+            acked = int(resp["lastSeq"])
+            self._peer_next[peer.id] = acked + 1
+            prev = self._peer_acked.get(peer.id, 0)
+            self._peer_acked[peer.id] = acked
+            if acked > prev:
+                metrics.ha_replicated_records_total.inc(
+                    peer.id, amount=acked - prev
+                )
+            return acked >= target_seq
+        except Exception:
+            # Transport failure: re-probe the peer's position next time.
+            self._peer_next[peer.id] = None
+            return False
+
+    def replicate(self, record: Optional[dict] = None,
+                  payload: Optional[bytes] = None) -> bool:
+        """Ship the latest committed record (default: the store's
+        `last_record`) to every peer; True once a majority (self included)
+        has fsync'd it — only then does the commit index advance and may
+        the server acknowledge the write."""
+        from ..core import metrics
+
+        if record is None or payload is None:
+            if self.store is None or self.store.last_record is None:
+                return False
+            record, payload = self.store.last_record
+        entry = {"seq": int(record["seq"]), "payload": payload.decode()}
+        with self._buffer_lock:
+            if not self._buffer or self._buffer[-1]["seq"] < entry["seq"]:
+                self._buffer.append(entry)
+        acks = 1  # self: Store.commit already fsync'd locally
+        for peer in self.peers:
+            if self._ship(peer, entry["seq"]):
+                acks += 1
+            lag = entry["seq"] - self._peer_acked.get(peer.id, 0)
+            metrics.ha_follower_lag_records.set(max(0, lag), peer.id)
+        quorum = acks >= self.majority and not self.fenced
+        if quorum:
+            self.store.mark_committed(entry["seq"])
+            metrics.ha_commit_seq.set(self.store.commit_seq)
+            self._quorum_failures = 0
+            self.lost_quorum = False
+        else:
+            self._quorum_failures += 1
+            metrics.ha_quorum_failures_total.inc()
+            if self._quorum_failures >= self.stepdown_after:
+                self.lost_quorum = True
+        return quorum
+
+    # -- introspection / catch-up source ------------------------------------
+
+    def _store_guard(self):
+        """The cluster's RLock when the bound store has a live cluster:
+        position/entries_after read Store fields (seq, commit index,
+        snapshot_doc's full state) that the commit path mutates under
+        that lock — an unguarded read mid-commit could hand a rejoining
+        peer a torn snapshot (seq N, state N-1), which it would install
+        and then skip record N forever. Reentrant, so the commit path's
+        own calls are unaffected."""
+        import contextlib
+
+        cluster = getattr(self.store, "cluster", None) if self.store else None
+        return cluster.lock if cluster is not None else contextlib.nullcontext()
+
+    def position(self) -> dict:
+        with self._store_guard():
+            store = self.store
+            return {
+                "role": "leader",
+                "term": self.term,
+                "lastTerm": store.last_record_term if store else 0,
+                "lastSeq": store.seq if store else 0,
+                "commitSeq": store.commit_seq if store else 0,
+            }
+
+    def append_entries(self, term, entries, commit_seq=0) -> dict:
+        """A leader is not a follower: an append from a SMALLER-or-equal
+        term is a deposed peer to be fenced; a LARGER term means we are
+        the deposed one — refuse and mark ourselves fenced so the server
+        steps down."""
+        if int(term) > self.term:
+            self.fenced = True
+        return {
+            "ok": False, "reason": "stale-term",
+            "term": self.term,
+            "lastSeq": self.store.seq if self.store else 0,
+        }
+
+    def install_snapshot(self, term, doc) -> dict:
+        return self.append_entries(term, [])
+
+    def entries_after(self, after_seq: int) -> dict:
+        with self._store_guard():
+            with self._buffer_lock:
+                buffered = [e for e in self._buffer if e["seq"] > after_seq]
+            contiguous = (
+                (buffered and buffered[0]["seq"] == after_seq + 1)
+                or (not buffered and self.store.seq <= after_seq)
+            )
+            if contiguous:
+                return {"entries": buffered}
+            if self.store.commit_seq < self.store.seq:
+                # Snapshots cover committed history ONLY (see _ship);
+                # the fetcher retries once the quorum catches up.
+                return {"entries": [], "deferred": True}
+            return {"snapshot": self.store.snapshot_doc(), "entries": []}
+
+    def follower_lag(self) -> dict[str, int]:
+        """Leader's view of each follower's lag in records (0 = caught
+        up; 'unknown' peers have never acked)."""
+        head = self.store.seq if self.store else 0
+        return {
+            peer.id: head - self._peer_acked.get(peer.id, 0)
+            for peer in self.peers
+        }
+
+
+# ---------------------------------------------------------------------------
+# Catch-up (promotion / rejoin)
+# ---------------------------------------------------------------------------
+
+
+def establish_term(term: int, peers: list,
+                   cluster_size: Optional[int] = None) -> dict:
+    """Raft's new-leader term assertion, run BEFORE catch-up: broadcast
+    `term` to every peer with an empty append-entries. A follower that
+    acks has durably adopted the term and rejects the deposed leader's
+    appends from that instant — so when catch-up then reads peer
+    positions, nothing can sneak into the OLD epoch between the read and
+    the takeover (the race that would let a stalled ex-leader collect a
+    spurious quorum behind the new leader's back). Requires follower acks
+    from a majority (self included); NoQuorumError otherwise. A stalled
+    ex-leader's own surface answers stale-term and fences itself — which
+    is exactly the point."""
+    size = cluster_size if cluster_size is not None else len(peers) + 1
+    need = majority_of(size)
+    acks = 1  # self
+    for peer in peers:
+        try:
+            resp = peer.append_entries(int(term), [], commit_seq=0)
+        except Exception:
+            continue
+        if resp.get("ok"):
+            acks += 1
+    if acks < need:
+        raise NoQuorumError(
+            f"term {term} acknowledged by only {acks}/{size} replicas "
+            f"(majority {need}): refusing to promote"
+        )
+    return {"acks": acks}
+
+
+def catch_up(log: FollowerLog, peers: list,
+             cluster_size: Optional[int] = None) -> dict:
+    """Reconcile a replica's log against a quorum before it may serve.
+
+    Requires self + reachable peers >= majority (else NoQuorumError: we
+    cannot prove we would see every acknowledged write). Copies the
+    missing tail — or a snapshot plus tail — from the most up-to-date
+    reachable peer, after truncating any divergent local suffix (records
+    whose per-entry term disagrees with the quorum's: the
+    unacknowledged leftovers of a dead leader). Returns stats for the
+    log/metrics."""
+    size = cluster_size if cluster_size is not None else len(peers) + 1
+    need = majority_of(size)
+    positions: list[tuple[object, dict]] = []
+    for peer in peers:
+        try:
+            positions.append((peer, peer.position()))
+        except Exception:
+            continue
+    if 1 + len(positions) < need:
+        raise NoQuorumError(
+            f"only {1 + len(positions)}/{size} replicas reachable "
+            f"(majority {need}): refusing to promote/serve"
+        )
+    stats = {
+        "peersReached": len(positions),
+        "source": None,
+        "records": 0,
+        "truncated": 0,
+        "snapshotInstalled": False,
+    }
+    if not positions:
+        return stats  # single-replica "cluster": nothing to reconcile
+
+    def rank(pos: dict) -> tuple[int, int]:
+        # Up-to-dateness is (last ENTRY term, last seq) — Raft's
+        # lastLogTerm rule. Ranking by the OBSERVED term would let a
+        # gap-rejected straggler (term bumped by a new leader's probe,
+        # none of that epoch's records) outrank a peer holding
+        # majority-acknowledged history, losing acknowledged writes.
+        return (
+            int(pos.get("lastTerm", pos.get("term", 0))),
+            int(pos.get("lastSeq", 0)),
+        )
+
+    best_peer, best = max(positions, key=lambda p: rank(p[1]))
+    # Term to stamp on local appends: catch-up is a self-initiated PULL,
+    # so it must clear our own fencing floor (observed terms never
+    # decrease) while adopting the source's if higher.
+    best_term = max(int(best.get("term", 0)), log.term)
+    best_last_term, best_seq = rank(best)
+    if (best_last_term, best_seq) <= (log.last_entry_term, log.last_seq):
+        # We are at least as up to date as any reachable peer; our tail
+        # (possibly holding the dead leader's unacked records) is adopted
+        # and will be committed by our first post-promotion replicate —
+        # the Raft convention for prior-term entries.
+        return stats
+    # Fetch from the last point both sides are guaranteed to agree on:
+    # our commit index (majority-acknowledged records are immutable).
+    base = min(log.commit_seq, log.last_seq)
+    payload = best_peer.entries_after(base)
+    if payload.get("deferred"):
+        # The source is a leader mid-quorum-catch-up: its snapshot would
+        # fold unacked records. Fail the reconciliation; the caller
+        # retries once the source's commit index advances.
+        raise ReplicationError(
+            f"catch-up source {getattr(best_peer, 'id', '?')} deferred "
+            f"its snapshot (uncommitted suffix); retry"
+        )
+    snapshot = payload.get("snapshot")
+    if snapshot is not None:
+        stats["truncated"] += log.truncate_from(
+            int(snapshot.get("seq", 0)) + 1
+        )
+        log.install_snapshot(best_term, snapshot)
+        stats["snapshotInstalled"] = True
+    entries = payload.get("entries") or []
+    for entry in sorted(entries, key=lambda e: e["seq"]):
+        seq = int(entry["seq"])
+        if seq <= log.last_seq:
+            local_term = log.record_term(seq)
+            if local_term is not None and local_term != _entry_term(entry):
+                # Divergent suffix: ours was never majority-acknowledged
+                # (the quorum's version at this seq carries a different
+                # term) — drop it and take the quorum's history.
+                stats["truncated"] += log.truncate_from(seq)
+            else:
+                continue
+        resp = log.append_entries(
+            best_term, [entry], commit_seq=int(best.get("commitSeq", 0))
+        )
+        if not resp.get("ok"):
+            raise ReplicationError(
+                f"catch-up append rejected at seq {seq}: {resp}"
+            )
+        stats["records"] += 1
+    if log.last_seq > best_seq:
+        # Ghost tail beyond the quorum's log: records a dead leader wrote
+        # in an OLDER term past everything the new epoch has. Keeping them
+        # would make this follower skip the new leader's frames at those
+        # seqs as "duplicates" and acknowledge history it does not have.
+        tail_term = log.record_term(best_seq + 1) or 0
+        if tail_term < best_last_term:
+            stats["truncated"] += log.truncate_from(best_seq + 1)
+    stats["source"] = getattr(best_peer, "id", None)
+    return stats
+
+
+__all__ = [
+    "FollowerLog",
+    "HttpPeer",
+    "LocalPeer",
+    "NoQuorumError",
+    "ReplicationCoordinator",
+    "ReplicationError",
+    "catch_up",
+    "majority_of",
+]

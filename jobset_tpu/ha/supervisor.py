@@ -1,0 +1,346 @@
+"""In-process replica supervisor: N controller replicas in one process.
+
+The deployment analog is N `controller --replicate` processes sharing a
+lease volume; tests and `bench.py --ha` need the same topology without
+process management, with every random/timing choice injectable. A
+`ReplicaSet` owns N `Replica`s:
+
+* every replica starts as a **follower**: a `FollowerLog` on its private
+  data-dir, reachable by the leader through a `LocalPeer`;
+* `step()` drives the election loop: the first alive, serverless replica
+  whose elector acquires the lease is **promoted** — catch-up against a
+  quorum, `FollowerLog.close()`, `Store.recover` into a fresh `Cluster`,
+  and a real `ControllerServer` bound to the SAME serving port the
+  previous leader used (clients keep one address across failovers, the
+  in-process stand-in for a service VIP);
+* `kill_leader()` is a crash, not a shutdown: the HTTP listener dies, the
+  store is hard-killed mid-state (fds dropped, no flush, no lease
+  release), and failover happens only when the lease expires — exactly
+  the kill -9 the acceptance soak exercises.
+
+Timing is injectable: a shared `FakeClock` makes lease expiry a test
+decision; the real clock with sub-second lease durations gives the bench
+wall-clock failover numbers.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ..core import make_cluster, metrics
+from ..core.lease import FileLease, LeaderElector
+from ..store import Store
+from .replication import (
+    FollowerLog,
+    LocalPeer,
+    NoQuorumError,
+    ReplicationCoordinator,
+    catch_up,
+    establish_term,
+)
+
+
+class Replica:
+    """One controller replica: identity + data-dir + elector, in exactly
+    one of three states — follower (FollowerLog open), leader (Store +
+    coordinator + serving ControllerServer), or dead (crashed; rejoin()
+    re-opens the follower log)."""
+
+    def __init__(
+        self,
+        replica_id: str,
+        data_dir: str,
+        lease_path: str,
+        clock=None,
+        lease_duration: float = 1.0,
+        retry_period: float = 0.2,
+        injector=None,
+    ):
+        self.replica_id = replica_id
+        self.data_dir = data_dir
+        self.injector = injector
+        self.log: Optional[FollowerLog] = FollowerLog(data_dir)
+        self.elector = LeaderElector(
+            FileLease(lease_path),
+            replica_id,
+            lease_duration=lease_duration,
+            retry_period=retry_period,
+            clock=clock,
+        )
+        self.server = None
+        self.store: Optional[Store] = None
+        self.coordinator: Optional[ReplicationCoordinator] = None
+        self.alive = True
+
+    @property
+    def is_leader(self) -> bool:
+        return self.alive and self.server is not None
+
+    def replication_surface(self):
+        """What a LocalPeer reaches: the coordinator while leading, the
+        follower log otherwise, nothing while dead (ConnectionError)."""
+        if not self.alive:
+            return None
+        if self.coordinator is not None:
+            return self.coordinator
+        return self.log
+
+    def peer(self) -> LocalPeer:
+        return LocalPeer(self.replica_id, self)
+
+
+class ReplicaSet:
+    """N in-process replicas, one shared lease, one stable serving port."""
+
+    def __init__(
+        self,
+        base_dir: str,
+        n: int = 3,
+        address: str = "127.0.0.1:0",
+        clock=None,
+        lease_duration: float = 1.0,
+        retry_period: float = 0.2,
+        tick_interval: float = 0.05,
+        snapshot_interval: int = 256,
+        injector=None,
+        cluster_factory=None,
+    ):
+        self.base_dir = str(base_dir)
+        self.clock = clock
+        self.tick_interval = tick_interval
+        self.snapshot_interval = snapshot_interval
+        self.injector = injector
+        self.cluster_factory = cluster_factory
+        host, _, port = address.rpartition(":")
+        self._host = host or "127.0.0.1"
+        self.serving_port = int(port) if port else 0
+        lease_path = os.path.join(self.base_dir, "leader.lease")
+        self.replicas = [
+            Replica(
+                f"replica-{i}",
+                os.path.join(self.base_dir, f"replica-{i}"),
+                lease_path,
+                clock=clock,
+                lease_duration=lease_duration,
+                retry_period=retry_period,
+                injector=injector,
+            )
+            for i in range(n)
+        ]
+        self._promotions = 0
+
+    # ------------------------------------------------------------------
+
+    def peers_for(self, replica: Replica) -> list[LocalPeer]:
+        return [r.peer() for r in self.replicas if r is not replica]
+
+    def leader(self) -> Optional[Replica]:
+        for r in self.replicas:
+            if r.is_leader:
+                return r
+        return None
+
+    @property
+    def address(self) -> str:
+        return f"{self._host}:{self.serving_port}"
+
+    def start(self) -> "ReplicaSet":
+        if self.step() is None:
+            raise RuntimeError("no replica could acquire the initial lease")
+        return self
+
+    def step(self) -> Optional[Replica]:
+        """One supervision round: give every serverless alive replica a
+        chance to take the (absent/expired/released) lease and promote.
+        Returns the current leader, if any. Deterministic: replicas are
+        visited in id order, so seeded runs elect identical successors."""
+        current = self.leader()
+        if current is not None:
+            coordinator = current.coordinator
+            if coordinator is not None and (
+                coordinator.lost_quorum or coordinator.fenced
+            ):
+                # A leader that stepped down (quorum lost / fenced) still
+                # has a serving HTTP surface; without demotion it would
+                # shadow every standby forever. Tear it back to follower
+                # and fall through to the election below.
+                self.demote(current)
+            else:
+                return current
+        for replica in self.replicas:
+            if not replica.alive or replica.server is not None:
+                continue
+            if not replica.elector.ensure():
+                # Only the LOWEST-id candidate contends each round: giving
+                # the next replica a same-round attempt would let the
+                # expiry boundary fall between the two ensure() calls and
+                # make the successor timing-dependent — seeded scenarios
+                # need a deterministic winner.
+                return None
+            try:
+                self.promote(replica)
+            except NoQuorumError:
+                # Cannot prove we'd see every acknowledged write: hand the
+                # lease back and let the next candidate try this round.
+                self._abort_promotion(replica)
+                continue
+            except Exception:
+                # Any other promotion failure (catch-up append rejected,
+                # snapshot I/O error, store open failure) must not crash
+                # the supervisor while this replica holds the lease — it
+                # demotes back to follower and the election retries.
+                import logging
+
+                logging.getLogger("jobset_tpu.ha").exception(
+                    "promotion of %s failed; returning it to standby",
+                    replica.replica_id,
+                )
+                self._abort_promotion(replica)
+                continue
+            return replica
+        return None
+
+    def _abort_promotion(self, replica: Replica) -> None:
+        """Unwind a failed promotion: release the lease and restore the
+        replica to a serveable follower state, whatever step it died at."""
+        replica.elector.release()
+        if replica.server is not None:
+            replica.server.stop(release_lease=False)
+            replica.server = None
+        if replica.store is not None:
+            replica.store.close()
+            replica.store = None
+        replica.coordinator = None
+        if replica.log is None:
+            replica.log = FollowerLog(replica.data_dir)
+
+    def promote(self, replica: Replica) -> dict:
+        """Follower -> leader: catch up against a quorum, replay the
+        committed log into a fresh Cluster via Store.recover, and take
+        over the serving port (resourceVersion/uid continuity comes from
+        the recovered store, so pre-failover informers get 410 + relist
+        exactly as the single-node restart path guarantees)."""
+        from ..server import ControllerServer
+
+        peers = self.peers_for(replica)
+        # Assert the new term on a majority BEFORE reading anyone's
+        # position: from here the old epoch can no longer commit, so
+        # catch-up sees everything it ever acknowledged.
+        establish_term(
+            replica.elector.term, peers, cluster_size=len(self.replicas)
+        )
+        stats = catch_up(
+            replica.log, peers, cluster_size=len(self.replicas),
+        )
+        replica.log.close()
+        replica.log = None
+        store = Store(
+            replica.data_dir,
+            snapshot_interval=self.snapshot_interval,
+            injector=self.injector,
+        )
+        cluster = (
+            self.cluster_factory() if self.cluster_factory is not None
+            else make_cluster()
+        )
+        store.recover(cluster)
+        coordinator = ReplicationCoordinator(
+            replica.replica_id,
+            self.peers_for(replica),
+            term=replica.elector.term,
+            injector=self.injector,
+        )
+        coordinator.bind(store)
+        server = ControllerServer(
+            f"{self._host}:{self.serving_port}",
+            cluster=cluster,
+            tick_interval=self.tick_interval,
+            elector=replica.elector,
+            standby_accepts_writes=False,
+            replication=coordinator,
+            injector=self.injector,
+        ).start()
+        self.serving_port = server.port
+        replica.store = store
+        replica.coordinator = coordinator
+        replica.server = server
+        self._promotions += 1
+        if self._promotions > 1:
+            metrics.ha_failovers_total.inc()
+        return stats
+
+    def demote(self, replica: Replica) -> None:
+        """Leader -> follower (lost quorum / fenced): stop serving, close
+        the store, and mirror again. The lease was already released by
+        the pump's stepdown; stop(release_lease=False) keeps it that way
+        even if a fresh acquisition raced in."""
+        if replica.server is not None:
+            replica.server.stop(release_lease=False)
+            replica.server = None
+        if replica.store is not None:
+            replica.store.close()
+            replica.store = None
+        replica.coordinator = None
+        replica.log = FollowerLog(replica.data_dir)
+
+    def kill_leader(self) -> str:
+        """Crash the leader: listener gone, store fds dropped mid-state,
+        NO lease release — standbys take over only at lease expiry."""
+        replica = self.leader()
+        if replica is None:
+            raise RuntimeError("no leader to kill")
+        replica.alive = False
+        replica.server.crash()
+        replica.store.hard_kill()
+        replica.server = None
+        replica.coordinator = None
+        replica.store = None
+        return replica.replica_id
+
+    def kill_follower(self) -> str:
+        """Crash the first alive follower (sorted id order, so seeded
+        scenarios pick identical victims): its log fds drop mid-state and
+        the leader sees it as lagging until rejoin()."""
+        for replica in self.replicas:
+            if replica.alive and replica.server is None:
+                replica.alive = False
+                replica.log.hard_kill()
+                replica.log = None
+                return replica.replica_id
+        raise RuntimeError("no follower to kill")
+
+    def rejoin(self, replica_id: str) -> dict:
+        """Bring a crashed replica back as a follower: re-open its log and
+        reconcile it against the quorum (divergent unacked tail from its
+        leadership, if any, is truncated here)."""
+        replica = next(
+            r for r in self.replicas if r.replica_id == replica_id
+        )
+        if replica.alive:
+            raise RuntimeError(f"{replica_id} is already alive")
+        replica.log = FollowerLog(replica.data_dir)
+        replica.alive = True
+        return catch_up(
+            replica.log,
+            self.peers_for(replica),
+            cluster_size=len(self.replicas),
+        )
+
+    def stop(self) -> None:
+        for replica in self.replicas:
+            if replica.server is not None:
+                try:
+                    replica.server.stop()
+                finally:
+                    replica.server = None
+            if replica.store is not None:
+                replica.store.close()
+                replica.store = None
+            replica.coordinator = None
+            if replica.log is not None:
+                replica.log.close()
+                replica.log = None
+
+
+__all__ = ["Replica", "ReplicaSet"]

@@ -243,6 +243,7 @@ class ControllerServer:
         elector=None,
         standby_accepts_writes: bool = True,
         injector=None,
+        replication=None,
     ):
         if cluster is None:
             cluster = make_cluster(clock=Clock())
@@ -273,6 +274,11 @@ class ControllerServer:
         # client retries against the leader.
         self.elector = elector
         self.standby_accepts_writes = standby_accepts_writes
+        # HA replication surface (jobset_tpu/ha, docs/ha.md): a
+        # ReplicationCoordinator on the leader (the commit path ships every
+        # WAL frame and acknowledges writes only at quorum), a FollowerLog
+        # on a standby (serving the /ha/v1 append/position/log endpoints).
+        self.replication = replication
         self._ready = threading.Event()
         self._stop = threading.Event()
         # Graceful-drain fence (SIGTERM path): while set, mutating requests
@@ -367,20 +373,52 @@ class ControllerServer:
 
     # ------------------------------------------------------------------
 
-    @staticmethod
-    def _stamp_build_info() -> None:
+    def _replication_role(self) -> Optional[str]:
+        """"leader"/"follower" when a replication surface is attached,
+        None on an unreplicated controller."""
+        if self.replication is None:
+            return None
+        from .ha.replication import ReplicationCoordinator
+
+        return (
+            "leader"
+            if isinstance(self.replication, ReplicationCoordinator)
+            else "follower"
+        )
+
+    def _replication_term(self) -> int:
+        if self.replication is not None:
+            return int(getattr(self.replication, "term", 0))
+        if self.elector is not None:
+            return self.elector.term
+        return 0
+
+    def _stamp_build_info(self) -> None:
         """(Re)stamp jobset_build_info (the kube_pod_info idiom). Called
         at start AND per scrape/health read: jax loads lazily, so the
         backend label flips from "unloaded" to the real backend the first
         time it is read after initialization — a one-time stamp would
-        serve "unloaded" forever."""
+        serve "unloaded" forever. Role/term are re-stamped for the same
+        reason: a replica's role flips at failover, and a debug bundle
+        from ANY replica must identify who was leading in which term."""
         gates = features.all_gates()
+        role = self._replication_role()
+        term = self._replication_term()
+        if role is None:
+            role = (
+                "single" if self.elector is None
+                else ("leader" if self.elector.is_leading else "standby")
+            )
         metrics.set_build_info(
             version=__version__,
             backend=_jax_backend_label(),
             gates=",".join(sorted(n for n, on in gates.items() if on))
             or "none",
+            role=role,
+            term=term,
         )
+        metrics.ha_role.set(1.0 if role == "leader" else 0.0)
+        metrics.ha_term.set(term)
 
     def start(self) -> "ControllerServer":
         # Stamp before the first scrape can land.
@@ -394,7 +432,10 @@ class ControllerServer:
         self._ready.set()  # readyz gated on the listener being up (main.go:209-216)
         return self
 
-    def stop(self):
+    def stop(self, release_lease: bool = True):
+        """`release_lease=False` is the promotion path: a standby being
+        torn down so THIS process can rebuild as the leader must keep the
+        lease it just acquired."""
         self._stop.set()
         # Wake every parked long-poll watcher: without this a watcher
         # sitting in _watch_resource holds its handler thread until its
@@ -402,16 +443,37 @@ class ControllerServer:
         # watchers return their (possibly empty) partial batches.
         with self._watch_cond:
             self._watch_cond.notify_all()
-        if self.elector is not None and not self._lease_released:
-            # Join the pump thread BEFORE releasing: an in-flight
-            # pump_if_leader() could otherwise re-acquire the lease right
-            # after release() and make the standby wait out the full lease
-            # duration — the delay the voluntary hand-off exists to avoid.
-            pump = self._pump_thread
-            if pump is not None and pump is not threading.current_thread():
-                pump.join(timeout=10.0)
+        # Join the pump thread UNCONDITIONALLY: before a release so an
+        # in-flight pump_if_leader() cannot re-acquire the lease right
+        # after release(), and on the release_lease=False path (the
+        # supervisor's demote) so the caller can close the Store without
+        # racing a pump round that is still committing to it.
+        pump = self._pump_thread
+        if pump is not None and pump is not threading.current_thread():
+            pump.join(timeout=10.0)
+        if release_lease and self.elector is not None and not self._lease_released:
             self.elector.release()
             self._lease_released = True
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    def crash(self):
+        """Crash simulation (HA tests/chaos): drop the listener and the
+        pump with NO drain, NO final commit, and — critically — NO lease
+        release: a kill -9'd leader leaves its lease to expire, which is
+        exactly the window failover time measures. The caller hard-kills
+        the store separately — which is why the pump thread is JOINED
+        here: an in-flight pump racing that hard-kill could commit/renew
+        AFTER the simulated kill instant, something a real kill -9 can
+        never do (and a perturbation seeded byte-identical runs would
+        see)."""
+        self._stop.set()
+        with self._watch_cond:
+            self._watch_cond.notify_all()
+        self._lease_released = True  # never written: the lease just ages out
+        pump = self._pump_thread
+        if pump is not None and pump is not threading.current_thread():
+            pump.join(timeout=10.0)
         self._httpd.shutdown()
         self._httpd.server_close()
 
@@ -460,18 +522,54 @@ class ControllerServer:
             # run_until_stable returns after one no-op tick when nothing
             # changed; skip the O(jobsets) serialize-and-diff on those idle
             # background pump rounds — UNLESS a failed store append left a
-            # diff pending, in which case the idle pump is exactly when the
-            # retry must happen (otherwise an acknowledged write could stay
-            # non-durable forever on a quiet system).
+            # diff pending, or a replicated leader has locally-durable
+            # records the quorum has not acknowledged yet (a recovered
+            # follower is re-shipped from the idle pump; otherwise a
+            # Warning-acked write could stay un-replicated forever on a
+            # quiet system — the store.retry_pending idiom, one level up).
             store = getattr(self.cluster, "store", None)
-            if ticks > 1 or (store is not None and store.retry_pending):
+            replication_behind = (
+                store is not None
+                and store.commit_seq < store.seq
+                and self._replication_role() == "leader"
+            )
+            if ticks > 1 or replication_behind or (
+                store is not None and store.retry_pending
+            ):
                 self._refresh_watch_locked()
                 self._commit_store_locked()
 
     def pump_if_leader(self) -> bool:
         """One leader-gated pump round: acquire/renew the lease, reconcile
         only while leading. Without an elector every replica pumps (the
-        single-replica deployment)."""
+        single-replica deployment). A replicated STANDBY never pumps nor
+        contends here — promotion (catch-up + store recovery + takeover)
+        belongs to its supervisor loop, not to a pump that would reconcile
+        an empty private cluster. A leader whose coordinator lost quorum
+        or got term-fenced steps down: leadership it cannot commit under
+        is leadership it must hand off."""
+        if self._replication_role() == "follower":
+            return False
+        coordinator = (
+            self.replication
+            if self._replication_role() == "leader" else None
+        )
+        if coordinator is not None and (
+            coordinator.fenced or coordinator.lost_quorum
+        ):
+            # Checked BEFORE ensure(): a broken coordinator must not
+            # re-acquire the lease it just gave up (that would spin
+            # terms every tick while holding off the healthy standbys).
+            # One-way door — recovery is demotion (supervisor/CLI role
+            # loop) followed by a fresh election.
+            if self.elector is not None and self.elector.is_leading:
+                logger.warning(
+                    "stepping down: %s",
+                    "fenced by a higher term" if coordinator.fenced
+                    else "quorum lost",
+                )
+                self.elector.release()
+            return False
         if self.elector is not None and not self.elector.ensure():
             return False
         self.pump()
@@ -488,28 +586,31 @@ class ControllerServer:
     # Durable store journaling
     # ------------------------------------------------------------------
 
-    def _commit_store_locked(self) -> bool:
+    def _commit_store_locked(self) -> Optional[str]:
         """Journal the committed state at the same point the watch journal
         diffs: once per HTTP write (after its synchronous reconcile, before
         the response — so a healthy store fsyncs the write before it is
-        acknowledged) and once per changing background pump. Caller holds
-        self.lock.
+        acknowledged) and once per changing background pump. On a
+        replicated leader the freshly fsync'd frame is then streamed to the
+        followers, and the write counts as COMMITTED only once a majority
+        has fsync'd it too (docs/ha.md). Caller holds self.lock.
 
-        Returns False when the append failed: the WAL tail is repaired and
-        the diff stays pending for the next commit, but the write — already
-        applied to the in-memory cluster, with reconcile effects that
-        cannot be unwound — is NOT yet crash-durable. The write path
-        surfaces that to the client as an RFC 7234 Warning header (and
-        `jobset_store_write_errors_total` counts it for operators), rather
-        than answering a 5xx for a mutation that did happen."""
+        Returns None when the write is fully durable (local fsync, plus
+        quorum under replication); otherwise a Warning-header string — the
+        write is already applied to the in-memory cluster (reconcile
+        effects cannot be unwound) but is either not crash-durable (local
+        append failed; retried each commit) or not yet quorum-replicated
+        (followers catch up from the resend buffer / a new leader's
+        catch-up). The write path surfaces the string as an RFC 7234
+        Warning header rather than answering a 5xx for a mutation that
+        did happen."""
         store = getattr(self.cluster, "store", None)
         if store is None:
-            return True
+            return None
         from .store import StoreError
 
         try:
-            store.commit(resource_version=self._watch_rv)
-            return True
+            seq = store.commit(resource_version=self._watch_rv)
         except (StoreError, OSError):
             logger.exception(
                 "store commit failed; repairing WAL tail and retrying the "
@@ -520,7 +621,26 @@ class ControllerServer:
                 store.repair()
             except OSError:
                 logger.exception("store WAL repair failed")
-            return False
+            return (
+                '299 - "write applied but not yet crash-durable: '
+                'store commit failed; journaled on next commit"'
+            )
+        if self._replication_role() == "leader" and (
+            seq is not None or store.commit_seq < store.seq
+        ):
+            # seq None + commit_seq behind = the idle-pump retry of a
+            # Warning-acked write: replicate() re-ships the resend-buffer
+            # backlog so a recovered follower completes the quorum.
+            if not self.replication.replicate():
+                metrics.ha_commit_seq.set(store.commit_seq)
+                return (
+                    '299 - "write is durable on the leader but not yet '
+                    'quorum-replicated: majority of replicas unreachable"'
+                )
+            # Quorum acked: now (and only now) the due compaction may
+            # fold — snapshots must cover committed history only.
+            store.maybe_compact()
+        return None
 
     # ------------------------------------------------------------------
     # Watch journal
@@ -695,6 +815,19 @@ class ControllerServer:
                         "error": "resourceVersion too old; relist",
                         "resourceVersion": self._watch_rv,
                     }
+                if resource_version > self._watch_rv:
+                    # A FUTURE rv can only come from a different server
+                    # incarnation: a pre-failover informer that watched a
+                    # deposed leader past its last quorum-committed event.
+                    # Waiting would hang forever (those events are gone);
+                    # 410 sends it to relist into the recovered state,
+                    # exactly like a too-old rv (etcd's "future revision"
+                    # is equally unservable).
+                    return 410, {
+                        "error": "resourceVersion is ahead of this "
+                                 "server; relist",
+                        "resourceVersion": self._watch_rv,
+                    }
                 batch = [
                     {"resourceVersion": rv, **event}
                     for rv, event_kind, event_ns, event in self._watch_events
@@ -747,7 +880,15 @@ class ControllerServer:
 
     @classmethod
     def _is_observability_path(cls, bare: str) -> bool:
-        return bare in cls._UNTRACED_PATHS or bare.startswith("/debug/")
+        # /ha/v1/* (replication internals) rides along: chaos targets the
+        # replication stream at its own `replication.stream` point, and a
+        # chaos 503 on the append path would double-count one injected
+        # fault; tracing each heartbeat-scale append would flood the ring.
+        return (
+            bare in cls._UNTRACED_PATHS
+            or bare.startswith("/debug/")
+            or bare.startswith("/ha/")
+        )
 
     def _check_chaos(self, method: str, bare: str):
         """`apiserver.request` injection point: one arrival per API request
@@ -908,6 +1049,14 @@ class ControllerServer:
         ):
             return self._admission_review(path.startswith("/mutate"), body)
 
+        # Replication surface (docs/ha.md): served by leader AND standby,
+        # BEFORE the write fences below — a draining or standby replica
+        # must keep accepting append-entries (that is what makes it a
+        # quorum member), and fencing happens by TERM inside the surface,
+        # not by HTTP role checks.
+        if path.startswith("/ha/v1/"):
+            return self._route_replication(method, path, body, params)
+
         parts = [p for p in path.split("/") if p]
 
         # Watch requests block on the journal OUTSIDE the cluster lock so
@@ -956,15 +1105,32 @@ class ControllerServer:
                     None,
                     {"Retry-After": "5"},
                 )
-            if (
+            if self._replication_role() == "follower" or (
                 self.elector is not None
                 and not self.standby_accepts_writes
                 and not self.elector.is_leading
             ):
+                # A replicated FOLLOWER surface never takes client writes
+                # regardless of elector state: during promotion there is
+                # a window where the elector already leads but the
+                # standby server (with its throwaway empty cluster) is
+                # still serving — a write accepted there would be
+                # answered 201 and then discarded with the cluster.
+                # Leader hint from the lease record: clients retry against
+                # the advertised leader instead of rediscovering it.
+                holder, address = (
+                    self.elector.leader_hint()
+                    if self.elector is not None else ("", "")
+                )
                 return 503, {
                     "error": "this replica is a standby (not the lease "
                              "holder); retry against the leader",
-                    "identity": self.elector.identity,
+                    "identity": (
+                        self.elector.identity
+                        if self.elector is not None else None
+                    ),
+                    "leader": holder or None,
+                    "leaderAddress": address or None,
                 }
 
         with self.lock:
@@ -977,20 +1143,21 @@ class ControllerServer:
             if method in ("POST", "PUT", "DELETE", "PATCH"):
                 self._refresh_watch_locked()
                 # Durability point: the WAL record for this write (and its
-                # synchronous reconcile effects) is fsync'd before the
-                # HTTP response acknowledges it. If the append failed the
-                # write is still applied in memory (it cannot be unwound)
-                # but is not crash-durable until the next successful
-                # commit — tell the client with a Warning header.
-                if not self._commit_store_locked():
+                # synchronous reconcile effects) is fsync'd — and, under
+                # replication, quorum-acknowledged — before the HTTP
+                # response acknowledges it. If the append failed (or the
+                # quorum is unreachable) the write is still applied in
+                # memory (it cannot be unwound) but is not yet fully
+                # durable — tell the client with a Warning header; a
+                # clean 2xx without Warning IS the majority-acknowledged
+                # contract the HA soak asserts on.
+                warning = self._commit_store_locked()
+                if warning is not None:
                     code = result[0]
                     payload = result[1]
                     ctype = result[2] if len(result) > 2 else None
                     extra = dict(result[3]) if len(result) > 3 else {}
-                    extra["Warning"] = (
-                        '299 - "write applied but not yet crash-durable: '
-                        'store commit failed; journaled on next commit"'
-                    )
+                    extra["Warning"] = warning
                     result = (code, payload, ctype, extra)
             return result
 
@@ -1309,6 +1476,53 @@ class ControllerServer:
         return 405, {"error": f"{method} not allowed on nodes"}
 
     # ------------------------------------------------------------------
+    # Replication endpoints (/ha/v1/*, docs/ha.md)
+    # ------------------------------------------------------------------
+
+    def _route_replication(self, method: str, path: str, body: bytes,
+                           params: dict):
+        """Quorum transport between replicas: `append` (leader -> this
+        follower: WAL frames + commit index, fsync'd before the ack),
+        `position` ((term, lastSeq, commitSeq) probe), `log` (catch-up
+        tail for a promoting/rejoining peer), `snapshot` (full-state
+        install past the resend buffer). Fencing is by term inside the
+        surface; a replica with no replication configured 404s."""
+        surface = self.replication
+        if surface is None:
+            return 404, {"error": "replication is not enabled (--replicate)"}
+        if path == "/ha/v1/position" and method == "GET":
+            return 200, surface.position()
+        if path == "/ha/v1/log" and method == "GET":
+            try:
+                after = int(params.get("after", ["0"])[0])
+            except ValueError:
+                return 400, {"error": "bad after parameter"}
+            return 200, surface.entries_after(after)
+        if method != "POST":
+            return 405, {"error": f"{method} not allowed on {path}"}
+        try:
+            doc = json.loads(body or b"{}")
+            if not isinstance(doc, dict):
+                raise ValueError("body must be a JSON object")
+        except ValueError as exc:
+            return 400, {"error": f"bad replication request: {exc}"}
+        if path == "/ha/v1/append":
+            result = surface.append_entries(
+                int(doc.get("term", 0)),
+                doc.get("entries") or [],
+                commit_seq=int(doc.get("commitSeq", 0)),
+            )
+            return 200, result
+        if path == "/ha/v1/snapshot":
+            snapshot = doc.get("snapshot")
+            if not isinstance(snapshot, dict):
+                return 400, {"error": "snapshot document required"}
+            return 200, surface.install_snapshot(
+                int(doc.get("term", 0)), snapshot
+            )
+        return 404, {"error": f"no route for {method} {path}"}
+
+    # ------------------------------------------------------------------
     # Aggregated health (GET /debug/health)
     # ------------------------------------------------------------------
 
@@ -1341,6 +1555,54 @@ class ControllerServer:
                 "message": (
                     "holding the lease" if leading
                     else "standby (reconciliation deferred to the leader)"
+                ),
+            }
+
+        role = self._replication_role()
+        if role is None:
+            components["replication"] = {
+                "healthy": True,
+                "enabled": False,
+                "role": "single",
+                "message": "replication disabled (single replica)",
+            }
+        elif role == "leader":
+            coordinator = self.replication
+            store = getattr(cluster, "store", None)
+            lag = coordinator.follower_lag()
+            behind = {p: n for p, n in lag.items() if n > 0}
+            healthy = not (coordinator.lost_quorum or coordinator.fenced)
+            components["replication"] = {
+                "healthy": healthy,
+                "enabled": True,
+                "role": "leader",
+                "term": coordinator.term,
+                "commitSeq": store.commit_seq if store is not None else 0,
+                "lastSeq": store.seq if store is not None else 0,
+                "quorum": coordinator.majority,
+                "replicas": coordinator.cluster_size,
+                "followerLag": lag,
+                "message": (
+                    ("FENCED by a higher term; stepping down"
+                     if coordinator.fenced else
+                     "quorum LOST: writes are not being acknowledged as "
+                     "committed" if coordinator.lost_quorum else
+                     f"{len(behind)} follower(s) behind" if behind else
+                     "all followers caught up")
+                ),
+            }
+        else:
+            position = self.replication.position()
+            components["replication"] = {
+                "healthy": True,
+                "enabled": True,
+                "role": "follower",
+                "term": position["term"],
+                "commitSeq": position["commitSeq"],
+                "lastSeq": position["lastSeq"],
+                "message": (
+                    f"mirroring the leader's WAL (term "
+                    f"{position['term']}, {position['lastSeq']} records)"
                 ),
             }
 
@@ -1382,6 +1644,7 @@ class ControllerServer:
                 "pendingDiff": pending,
                 "walBytes": store.wal.size,
                 "seq": store.seq,
+                "commitSeq": store.commit_seq,
                 "resourceVersion": store.resource_version,
                 "commitsTotal": metrics.store_commits_total.total(),
                 "writeErrorsTotal": metrics.store_write_errors_total.total(),

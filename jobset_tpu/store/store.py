@@ -45,6 +45,34 @@ WAL_FILE = "wal.log"
 KINDS = tuple(CODECS)
 
 
+def write_snapshot_file(data_dir: str, doc: dict) -> None:
+    """Atomically persist a snapshot document: write-temp, fsync, rename
+    over SNAPSHOT_FILE, fsync the directory — crash-safe at every
+    interleaving. Shared by Store.compact and the HA FollowerLog
+    (install + self-compaction) so the ritual cannot drift."""
+    snapshot_path = os.path.join(data_dir, SNAPSHOT_FILE)
+    tmp_path = snapshot_path + ".tmp"
+    try:
+        with open(tmp_path, "w") as f:
+            json.dump(doc, f, sort_keys=True, separators=(",", ":"))
+            f.flush()
+            os.fsync(f.fileno())
+    except OSError:
+        # Never leave a half-written tmp behind (recovery ignores it,
+        # but the next snapshot should start clean).
+        try:
+            os.unlink(tmp_path)
+        except OSError:
+            pass
+        raise
+    os.replace(tmp_path, snapshot_path)
+    dir_fd = os.open(data_dir, os.O_RDONLY)
+    try:
+        os.fsync(dir_fd)
+    finally:
+        os.close(dir_fd)
+
+
 class Store:
     """One data directory = one durable control plane.
 
@@ -94,7 +122,28 @@ class Store:
         self._shadow: dict[str, dict[str, str]] = {k: {} for k in KINDS}
         self._counters = {"uid": 0, "arrival": 0, "eventsTotal": 0}
         self._rv = 0
-        self._seq = 0  # last committed record seq
+        self._seq = 0  # last locally-durable record seq
+        # Quorum commit index (docs/ha.md): the highest seq known durable
+        # on a MAJORITY of replicas. Single-replica stores (no replication
+        # coordinator bound) commit locally and immediately, so commit_seq
+        # tracks seq; a bound ReplicationCoordinator sets `replicated` and
+        # advances commit_seq itself via mark_committed() once a majority
+        # of followers has fsync'd the frame.
+        self.commit_seq = 0
+        self.replicated = False
+        # Leadership fencing term stamped into every record this store
+        # appends (0 = unreplicated, key omitted for byte-stable logs).
+        # Followers use per-record terms to detect and truncate a
+        # divergent tail when a crashed ex-leader rejoins (docs/ha.md).
+        self.term = 0
+        # Term of the LAST record in this log (snapshot or WAL) — the
+        # up-to-dateness rank catch-up compares (Raft's lastLogTerm),
+        # rebuilt during _load and advanced by commit().
+        self.last_record_term = 0
+        # (record dict, canonical payload bytes) of the last appended WAL
+        # record — the frame-shipping handle the replication layer streams
+        # to followers.
+        self.last_record: Optional[tuple[dict, bytes]] = None
         self._commits_since_snapshot = 0
         self.torn_tail_recovered = False
         self.wal_records_replayed = 0
@@ -124,6 +173,7 @@ class Store:
             snapshot_seq = doc.get("seq", 0)
             self._seq = snapshot_seq
             self._rv = doc.get("rv", 0)
+            self.last_record_term = int(doc.get("lastTerm", 0))
             self._counters = dict(doc.get("counters") or self._counters)
             for kind in KINDS:
                 self._state[kind] = dict(
@@ -165,12 +215,21 @@ class Store:
             self._seq = seq
             self._rv = max(self._rv, record.get("rv", 0))
             self._counters = dict(record.get("counters") or self._counters)
+            self.last_record_term = int(
+                record.get("term", self.last_record_term)
+            )
             self.wal_records_replayed += 1
         for kind in KINDS:
             self._shadow[kind] = {
                 key: canonical(obj)
                 for key, obj in self._state[kind].items()
             }
+        # Everything replayed from local disk is treated as committed: a
+        # replica only opens a Store for serving AFTER the HA catch-up
+        # step has reconciled its log against a quorum (docs/ha.md), and a
+        # new leader commits its recovered tail by replicating past it —
+        # the Raft convention of committing prior-term entries implicitly.
+        self.commit_seq = self._seq
         from ..core import metrics
 
         metrics.store_wal_bytes.set(self.wal.size)
@@ -312,15 +371,24 @@ class Store:
             "counters": counters,
             "ops": ops,
         }
+        if self.term:
+            record["term"] = self.term
+        payload = canonical(record).encode()
         try:
-            self.wal.append(
-                canonical(record).encode(), detail=f"seq={record['seq']}"
-            )
+            self.wal.append(payload, detail=f"seq={record['seq']}")
         except Exception:
             self.retry_pending = True
             raise
         # Only past the fsync is the diff consumed.
         self._seq = record["seq"]
+        self.last_record = (record, payload)
+        if self.term:
+            self.last_record_term = self.term
+        if not self.replicated:
+            # Single-replica mode: local fsync IS the commit point. Under
+            # replication the coordinator advances commit_seq only once a
+            # majority has fsync'd this frame.
+            self.commit_seq = self._seq
         self._rv = rv
         for op in ops:
             if op[1] == "jobsets":
@@ -337,22 +405,56 @@ class Store:
         self.retry_pending = False
         metrics.store_commits_total.inc()
         metrics.store_wal_bytes.set(self.wal.size)
-        if self._commits_since_snapshot >= self.snapshot_interval:
-            # Compaction failure must NOT poison this commit's ack: the
-            # record above is already fsync'd (the write IS durable), so a
-            # failed snapshot is logged and retried at the next commit —
-            # never surfaced as a write error.
-            try:
-                self.compact()
-            except OSError:
-                import logging
-
-                logging.getLogger("jobset_tpu.store").exception(
-                    "snapshot compaction failed; the WAL remains "
-                    "authoritative and compaction retries on the next "
-                    "commit"
-                )
+        if not self.replicated:
+            # Replicated leaders compact via maybe_compact() AFTER the
+            # quorum acks this record: a snapshot must only ever fold
+            # COMMITTED history, because folding destroys the per-record
+            # terms that divergence detection needs — an unacked record
+            # baked into snapshot state could never be truncated when a
+            # new epoch replaces it (docs/ha.md).
+            self.maybe_compact()
         return self._seq
+
+    def maybe_compact(self) -> None:
+        """Compact when due — and, under replication, only once the
+        quorum commit index has caught up to the local log (committed
+        history only; see commit()). Compaction failure must NOT poison
+        any commit's ack: the records are already fsync'd (the writes ARE
+        durable), so a failed snapshot is logged and retried at the next
+        opportunity — never surfaced as a write error."""
+        if self._commits_since_snapshot < self.snapshot_interval:
+            return
+        if self.replicated and self.commit_seq < self._seq:
+            return
+        try:
+            self.compact()
+        except OSError:
+            import logging
+
+            logging.getLogger("jobset_tpu.store").exception(
+                "snapshot compaction failed; the WAL remains "
+                "authoritative and compaction retries on the next "
+                "commit"
+            )
+
+    def mark_committed(self, seq: int) -> None:
+        """Advance the quorum commit index (replication coordinator only:
+        a majority of replicas has fsync'd every frame through `seq`)."""
+        self.commit_seq = max(self.commit_seq, min(int(seq), self._seq))
+
+    def snapshot_doc(self) -> dict:
+        """The full-state snapshot document (what compact() persists and
+        what the replication layer installs on a follower too far behind
+        the leader's resend buffer)."""
+        return {
+            "seq": self._seq,
+            "rv": self._rv,
+            "counters": self._counters,
+            "state": self._state,
+            # Up-to-dateness rank of the covered history (catch-up
+            # compares lastTerm/lastSeq; plain recovery ignores it).
+            "lastTerm": self.last_record_term,
+        }
 
     def repair(self) -> None:
         """Truncate a torn tail left by a failed append; the un-journaled
@@ -370,33 +472,7 @@ class Store:
         from ..core import metrics
 
         t0 = time.perf_counter()
-        doc = {
-            "seq": self._seq,
-            "rv": self._rv,
-            "counters": self._counters,
-            "state": self._state,
-        }
-        snapshot_path = os.path.join(self.data_dir, SNAPSHOT_FILE)
-        tmp_path = snapshot_path + ".tmp"
-        try:
-            with open(tmp_path, "w") as f:
-                json.dump(doc, f, sort_keys=True, separators=(",", ":"))
-                f.flush()
-                os.fsync(f.fileno())
-        except OSError:
-            # Never leave a half-written tmp behind (recovery ignores it,
-            # but the next compaction should start clean).
-            try:
-                os.unlink(tmp_path)
-            except OSError:
-                pass
-            raise
-        os.replace(tmp_path, snapshot_path)
-        dir_fd = os.open(self.data_dir, os.O_RDONLY)
-        try:
-            os.fsync(dir_fd)
-        finally:
-            os.close(dir_fd)
+        write_snapshot_file(self.data_dir, self.snapshot_doc())
         self.wal.reset()
         self._commits_since_snapshot = 0
         metrics.store_snapshot_seconds.observe(time.perf_counter() - t0)

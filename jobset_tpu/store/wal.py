@@ -58,6 +58,13 @@ class WriteAheadLog:
         # only position appends may start from.
         self._durable_end = 0
         self._needs_repair = False
+        # Exact bytes of the last durable frame (header + payload).
+        # Replication ships the bare canonical PAYLOAD (Store.last_record)
+        # and each replica re-frames it locally — framing is
+        # deterministic, so the frames come out byte-identical; this
+        # handle is how tests PROVE that (compare leader and follower
+        # last_frame after a replicated commit).
+        self.last_frame: Optional[bytes] = None
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -120,16 +127,6 @@ class WriteAheadLog:
 
     # -- append path -------------------------------------------------------
 
-    def _check_chaos(self, detail: str) -> Optional[object]:
-        injector = self.injector
-        if injector is None:
-            from ..chaos import get_injector
-
-            injector = get_injector()
-        if injector is None:
-            return None
-        return injector.check("store.write", detail)
-
     def append(self, payload: bytes, detail: str = "") -> None:
         """Durably append one frame (write + flush + fsync). Raises
         StoreWriteError on failure; the caller must repair() before the
@@ -141,16 +138,13 @@ class WriteAheadLog:
             )
         frame = _HEADER.pack(len(payload), zlib.crc32(payload)) + payload
         f = self._f
-        fault = self._check_chaos(detail)
+        from ..chaos.injector import consult
+
+        fault = consult("store.write", detail, injector=self.injector)
         if fault is not None:
-            from ..chaos.injector import KIND_LATENCY, KIND_TORN
+            from ..chaos.injector import KIND_TORN
 
-            if fault.kind == KIND_LATENCY:
-                if fault.delay_s > 0:
-                    import time as _t
-
-                    _t.sleep(fault.delay_s)
-            elif fault.kind == KIND_TORN:
+            if fault.kind == KIND_TORN:
                 # Crash-mid-write simulation: a partial frame reaches disk,
                 # the fsync never happens, the record is NOT acknowledged.
                 self._needs_repair = True
@@ -174,6 +168,7 @@ class WriteAheadLog:
             self._needs_repair = True
             raise StoreWriteError(f"wal append failed: {exc}") from exc
         self._durable_end += len(frame)
+        self.last_frame = frame
 
     def repair(self) -> None:
         """Truncate back to the last durable frame boundary after a failed
@@ -185,16 +180,30 @@ class WriteAheadLog:
         f.seek(self._durable_end)
         self._needs_repair = False
 
+    @staticmethod
+    def frame_size(payload: bytes) -> int:
+        """On-disk size of one frame for `payload` (header + payload) —
+        lets callers compute exact record boundaries for truncate_to."""
+        return _HEADER.size + len(payload)
+
+    def truncate_to(self, offset: int) -> None:
+        """Truncate the log IN PLACE to a frame boundary at `offset`
+        (durable suffix drop: the HA conflict rule discarding a divergent
+        tail). Unlike reset-and-reappend, a crash at any instant leaves
+        either the old log or the correctly-truncated one — never a
+        window where previously-fsync'd committed records are missing."""
+        f = self._f
+        f.truncate(offset)
+        f.flush()
+        os.fsync(f.fileno())
+        f.seek(offset)
+        self._durable_end = offset
+        self._needs_repair = False
+
     def reset(self) -> None:
         """Empty the log (after its contents were compacted into a durable
         snapshot)."""
-        f = self._f
-        f.truncate(0)
-        f.flush()
-        os.fsync(f.fileno())
-        f.seek(0)
-        self._durable_end = 0
-        self._needs_repair = False
+        self.truncate_to(0)
 
     def flush(self) -> None:
         f = self._f

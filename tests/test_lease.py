@@ -8,7 +8,12 @@ and on voluntary release.
 """
 
 from jobset_tpu.core import make_cluster
-from jobset_tpu.core.lease import FileLease, LeaderElector, LeaseRecord
+from jobset_tpu.core.lease import (
+    FileLease,
+    LeaderElector,
+    LeaseConflict,
+    LeaseRecord,
+)
 from jobset_tpu.server import ControllerServer
 from jobset_tpu.testing import make_jobset, make_replicated_job
 from jobset_tpu.utils.clock import FakeClock
@@ -73,8 +78,161 @@ def test_corrupt_lease_file_is_treated_as_absent(tmp_path):
 
 
 def test_lease_record_round_trip():
-    rec = LeaseRecord("me", 1.0, 2.0)
+    rec = LeaseRecord("me", 1.0, 2.0, term=4, address="10.0.0.1:8080")
     assert LeaseRecord.from_dict(rec.to_dict()) == rec
+
+
+def test_legacy_record_without_term_parses_as_term_zero():
+    rec = LeaseRecord.from_dict({
+        "holderIdentity": "old", "acquireTime": 1.0, "renewTime": 2.0,
+    })
+    assert rec.term == 0 and rec.address == ""
+
+
+# ---------------------------------------------------------------------------
+# Fencing terms + compare-and-swap (the HA plane's epoch source)
+# ---------------------------------------------------------------------------
+
+
+def test_terms_increment_per_acquisition_never_per_renewal(tmp_path):
+    clock = FakeClock()
+    a = _elector(tmp_path, "a", clock, lease_duration=15.0, retry_period=2.0)
+    b = _elector(tmp_path, "b", clock, lease_duration=15.0, retry_period=2.0)
+    assert a.ensure() and a.term == 1
+    clock.advance(3.0)
+    assert a.ensure() and a.term == 1  # renewal keeps the term
+    # a dies; b takes over at expiry: a NEW term.
+    clock.advance(20.0)
+    assert b.ensure() and b.term == 2
+    assert a.ensure() is False and a.term == 0  # standby exposes no term
+    # b releases voluntarily; a re-acquires: the term still advances
+    # (release preserves it in the tombstone).
+    b.release()
+    assert a.ensure() and a.term == 3
+
+
+def test_cas_write_refuses_stale_expectation(tmp_path):
+    lease = FileLease(str(tmp_path / "leader.lease"))
+    lease.write(LeaseRecord("a", 1.0, 1.0, term=1))
+    # A writer that based its decision on an older read must fail.
+    import pytest
+
+    with pytest.raises(LeaseConflict):
+        lease.write(LeaseRecord("b", 2.0, 2.0, term=2), expect=("", 0))
+    # The matching expectation succeeds.
+    lease.write(LeaseRecord("b", 2.0, 2.0, term=2), expect=("a", 1))
+    assert lease.read().holder == "b"
+
+
+def test_cas_closes_read_write_race_between_electors(tmp_path):
+    """The TOCTOU regression: two electors race on one expired lease with
+    the flock guard NEUTERED (storage without flock semantics). The CAS
+    on (holder, term) makes the second writer observe the first's
+    acquisition and stand down instead of clobbering it."""
+    import contextlib
+
+    class NoGuardLease(FileLease):
+        def guard(self):
+            return contextlib.nullcontext()
+
+    clock = FakeClock()
+    path = str(tmp_path / "leader.lease")
+    a = LeaderElector(NoGuardLease(path), "a", clock=clock)
+    b = LeaderElector(NoGuardLease(path), "b", clock=clock)
+    # Both read the same stale state; interleave the writes by making b
+    # win the race just before a's write lands.
+    real_write = FileLease.write
+    raced = []
+
+    class RacingLease(NoGuardLease):
+        def write(self, record, expect=None):
+            if not raced and record.holder == "a":
+                raced.append(1)
+                # b sneaks in between a's read and a's write.
+                real_write(
+                    FileLease(path),
+                    LeaseRecord("b", clock.now(), clock.now(), term=1),
+                )
+            return real_write(self, record, expect=expect)
+
+    a.lease = RacingLease(path)
+    assert a.ensure() is False  # CAS caught the race: a stands down
+    assert not a.is_leading
+    assert b.ensure() is True  # b's acquisition stands
+    assert FileLease(path).read().holder == "b"
+
+
+def test_release_by_non_holder_is_a_noop(tmp_path):
+    clock = FakeClock()
+    a = _elector(tmp_path, "a", clock)
+    b = _elector(tmp_path, "b", clock)
+    assert a.ensure()
+    assert b.ensure() is False
+    # b was never the holder, but force its release path anyway (the
+    # deposed-leader-late-release shape): the record must survive.
+    b._leading = True
+    b.release()
+    lease = FileLease(str(tmp_path / "leader.lease"))
+    rec = lease.read()
+    assert rec is not None and rec.holder == "a"
+    assert a.ensure() is True  # a's leadership is intact
+
+
+def test_clock_skewed_renewal_does_not_flap(tmp_path):
+    """A leader whose clock skews BACKWARD keeps leading (its lease is
+    simply 'fresher than now'); a standby on a forward-skewed clock takes
+    over only once ITS view says the lease expired, and the old leader
+    then observes the takeover and stands down."""
+    slow, fast = FakeClock(), FakeClock()
+    path = tmp_path
+    a = LeaderElector(FileLease(str(path / "leader.lease")), "a",
+                      clock=slow, lease_duration=15.0, retry_period=2.0)
+    b = LeaderElector(FileLease(str(path / "leader.lease")), "b",
+                      clock=fast, lease_duration=15.0, retry_period=2.0)
+    slow.advance(100.0)
+    fast.advance(100.0)
+    assert a.ensure()
+    # a's clock jumps back 50s: renewals now write renew times in b's
+    # past... but a still holds and must keep holding on its own view.
+    slow.advance(-50.0)
+    assert a.ensure() is True
+    # b's clock runs 20s ahead: from b's view the last renewal (stamped
+    # at a's skewed now=50) is 70s old — expired — so b takes over.
+    fast.advance(20.0)
+    assert b.ensure() is True
+    assert b.term == 2
+    # The skewed ex-leader sees a VALID lease held by someone else (b
+    # renewed at fast-now=120, far in slow-now=50's future) and stands
+    # down instead of clobbering.
+    assert a.ensure() is False
+    assert not a.is_leading
+
+
+def test_stepdown_when_lease_file_unwritable(tmp_path):
+    """ENOSPC on the shared lease volume (injected at the existing
+    store.write chaos point): a leader that cannot renew durably steps
+    down instead of reconciling on a lease that will expire under it."""
+    from jobset_tpu.chaos.injector import FaultInjector, KIND_ENOSPC
+
+    clock = FakeClock()
+    injector = FaultInjector(seed=1)
+    lease = FileLease(str(tmp_path / "leader.lease"), injector=injector)
+    a = LeaderElector(lease, "a", clock=clock,
+                      lease_duration=15.0, retry_period=2.0)
+    b = _elector(tmp_path, "b", clock, lease_duration=15.0, retry_period=2.0)
+    assert a.ensure() and a.is_leading
+    # The volume fills: every lease write now fails.
+    rule = injector.add_rule("store.write", KIND_ENOSPC, rate=1.0)
+    clock.advance(3.0)  # past retry_period: a renewal write is due
+    assert a.ensure() is False
+    assert not a.is_leading
+    # The stale record ages out and a healthy standby takes over.
+    clock.advance(15.0)
+    assert b.ensure() is True
+    # The disk clears: a rejoins as a standby, no split brain.
+    injector.remove_rule(rule)
+    assert a.ensure() is False
+    assert b.ensure() is True
 
 
 def _two_servers(tmp_path, clock):

@@ -288,6 +288,38 @@ def test_data_dir_lock_is_exclusive(tmp_path):
     third.close()
 
 
+def test_hard_kill_reopen_race_is_deterministic(tmp_path):
+    """The HA failover shape, tightened into a loop: hard_kill() followed
+    immediately by a re-open on the same --data-dir must release and
+    re-acquire the flock deterministically EVERY time — mid-state, with
+    committed records on disk — and each reopen recovers the exact
+    pre-kill acknowledged state. (Regression for the kill->reopen race
+    the failover tests lean on: a lingering lock fd or an unreleased
+    flock would make takeover of a crashed replica's directory flaky.)"""
+    data_dir = str(tmp_path / "data")
+    cluster = make_cluster()
+    store = Store(data_dir)
+    store.recover(cluster)
+    expected = None
+    for round_no in range(6):
+        cluster.create_jobset(_gang(f"kr-{round_no}", suspend=True))
+        cluster.run_until_stable()
+        store.commit(resource_version=round_no + 1)
+        expected = store.serialized_state()
+        store.hard_kill()
+        # Immediate reopen: the flock must be re-acquirable at once (the
+        # fds died with hard_kill), and a concurrent second opener must
+        # still be excluded.
+        cluster = make_cluster()
+        store = Store(data_dir)
+        with pytest.raises(StoreError):
+            Store(data_dir)
+        store.recover(cluster)
+        assert store.serialized_state() == expected
+        assert store.commit_seq == store.seq == round_no + 1
+    store.close()
+
+
 def test_snapshot_failure_does_not_poison_the_commit(tmp_path, monkeypatch):
     """Compaction runs AFTER the commit record is fsync'd: a failed
     snapshot write must neither fail the commit (the write IS durable in

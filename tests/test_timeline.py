@@ -427,9 +427,11 @@ def test_debug_health_verdict_and_degradation(server, client):
     health = client.health()
     assert health["status"] == "healthy"
     assert set(health["components"]) == {
-        "leaderElection", "solver", "store", "queue", "pump", "chaos",
+        "leaderElection", "replication", "solver", "store", "queue",
+        "pump", "chaos",
     }
     assert health["components"]["store"]["enabled"] is False
+    assert health["components"]["replication"]["role"] == "single"
     assert health["build"]["version"]
     assert health["config"]["storeEnabled"] is False
 
