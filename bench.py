@@ -958,6 +958,232 @@ def _bank_slo(result: dict) -> None:
     _bank_sidecar_key("slo", result)
 
 
+def run_policy_bench(args) -> dict:
+    """Learned-placement-policy bench (docs/policy.md): the full data
+    flywheel, then shadow-vs-solver on a replayed seeded trace.
+
+    Phase 1 (corpus): a wall-clock run through the real apiserver —
+    exclusive-placement gangs via the auction solver, a seeded crash
+    burst, gang recovery — captured as a debug bundle, exactly the
+    artifact an operator's postmortem produces.
+    Phase 2 (train): `policy train` on that bundle (seeded,
+    deterministic).
+    Phase 3 (transparency): the same seeded trace replayed twice on the
+    VIRTUAL clock, solver-only vs shadow — end-to-end event streams must
+    be byte-identical (the shadow-mode contract).
+    Phase 4 (measure): the trace replayed twice more on the wall clock
+    through the real apiserver, banking time-to-ready / restart-recovery
+    p50/p99 for solver-only vs shadow plus the shadow run's per-decision
+    regret distribution (mean/p90/p99).
+    """
+    import shutil
+    import tempfile
+
+    from jobset_tpu import chaos
+    from jobset_tpu.api import FailurePolicy
+    from jobset_tpu.chaos import FaultInjector
+    from jobset_tpu.client import JobSetClient
+    from jobset_tpu.core import features as gates
+    from jobset_tpu.core import make_cluster, metrics
+    from jobset_tpu.obs.bundle import write_bundle
+    from jobset_tpu.placement.provider import SolverPlacement
+    from jobset_tpu.policy.dataset import build_dataset
+    from jobset_tpu.policy.model import save_checkpoint
+    from jobset_tpu.policy.placer import LearnedPlacement
+    from jobset_tpu.policy.train import train
+    from jobset_tpu.server import ControllerServer
+    from jobset_tpu.testing import make_jobset, make_replicated_job
+    from jobset_tpu.utils.clock import Clock
+
+    topology_key = "tpu-slice"
+    # 24 gangs x 2 exclusive jobs = 48 domains in use, 16 spare for
+    # restart churn (exclusive placement needs one domain per job).
+    domains, nodes_per_domain = 64, 2
+    n_gangs, replicas, pods_per_job = 24, 2, 2
+    crash_rate, crash_seed = 0.3, 17
+    train_seed, train_epochs = 0, 150
+
+    def jobset_spec(name):
+        js = (
+            make_jobset(name)
+            .exclusive_placement(topology_key)
+            .failure_policy(FailurePolicy(max_restarts=4))
+            .replicated_job(
+                make_replicated_job("w").replicas(replicas)
+                .parallelism(pods_per_job)
+                .completions(pods_per_job).obj()
+            )
+            .obj()
+        )
+        for rjob in js.spec.replicated_jobs:
+            rjob.template.spec.backoff_limit = 0
+        return js
+
+    def exact(h) -> dict:
+        return {
+            "count": h.n,
+            "p50": round(h.exact_percentile(0.50), 6),
+            "p99": round(h.exact_percentile(0.99), 6),
+            "mean": round(h.sum / h.n, 6) if h.n else None,
+        }
+
+    def wall_run(placement, bundle_path=None) -> dict:
+        """One wall-clock trace through the real apiserver; returns the
+        run's SLO/policy figures (and optionally captures the bundle)."""
+        metrics.reset()
+        for h in (
+            metrics.slo_time_to_ready_seconds,
+            metrics.slo_restart_recovery_seconds,
+            metrics.policy_regret,
+        ):
+            h.enable_raw()
+        cluster = make_cluster(clock=Clock(), placement=placement)
+        cluster.add_topology(
+            topology_key, num_domains=domains,
+            nodes_per_domain=nodes_per_domain, capacity=16,
+        )
+        server = ControllerServer(cluster=cluster, tick_interval=30.0).start()
+        try:
+            client = JobSetClient(f"http://{server.address}", timeout=900.0)
+            for i in range(n_gangs):
+                client.create(jobset_spec(f"pol-{i:03d}"))
+            deadline = time.monotonic() + 300.0
+            while (
+                metrics.slo_time_to_ready_seconds.n < n_gangs
+                and time.monotonic() < deadline
+            ):
+                server.pump()
+            if metrics.slo_time_to_ready_seconds.n != n_gangs:
+                raise RuntimeError(
+                    f"policy bench: only "
+                    f"{metrics.slo_time_to_ready_seconds.n}/{n_gangs} "
+                    f"gangs reached ready"
+                )
+            injector = FaultInjector(seed=crash_seed)
+            with server.lock:
+                crashed = chaos.pod_crash_burst(
+                    cluster, injector, rate=crash_rate
+                )
+            restarted = {n.rsplit("-w-", 1)[0] for n in crashed}
+            while (
+                metrics.slo_restart_recovery_seconds.n < len(restarted)
+                and time.monotonic() < deadline
+            ):
+                server.pump()
+            if metrics.slo_restart_recovery_seconds.n < len(restarted):
+                raise RuntimeError(
+                    f"policy bench: only "
+                    f"{metrics.slo_restart_recovery_seconds.n}"
+                    f"/{len(restarted)} gangs recovered"
+                )
+            if bundle_path:
+                write_bundle(client, bundle_path)
+        finally:
+            server.stop()
+        return {
+            "time_to_ready_s": exact(metrics.slo_time_to_ready_seconds),
+            "restart_recovery_s": exact(
+                metrics.slo_restart_recovery_seconds
+            ),
+            "regret": {
+                "count": metrics.policy_regret.n,
+                "mean": round(
+                    metrics.policy_regret.sum / metrics.policy_regret.n, 6
+                ) if metrics.policy_regret.n else None,
+                "p90": round(
+                    metrics.policy_regret.exact_percentile(0.90), 6
+                ) if metrics.policy_regret.n else None,
+                "p99": round(
+                    metrics.policy_regret.exact_percentile(0.99), 6
+                ) if metrics.policy_regret.n else None,
+            },
+            "decisions_shadow": metrics.policy_decisions_total.value(
+                "shadow"
+            ),
+            "fallbacks": metrics.policy_fallbacks_total.total(),
+            "crashed_pods": len(crashed),
+        }
+
+    def virtual_event_stream(placement) -> str:
+        """Deterministic virtual-clock replay; the full event stream is
+        the byte-transparency witness."""
+        metrics.reset()
+        cluster = make_cluster(placement=placement)
+        cluster.add_topology(
+            topology_key, num_domains=domains,
+            nodes_per_domain=nodes_per_domain, capacity=16,
+        )
+        for i in range(n_gangs):
+            cluster.create_jobset(jobset_spec(f"pol-{i:03d}"))
+        cluster.run_until_stable(max_ticks=2000)
+        injector = FaultInjector(seed=crash_seed)
+        chaos.pod_crash_burst(cluster, injector, rate=crash_rate)
+        cluster.run_until_stable(max_ticks=2000)
+        return "\n".join(
+            f"{e.time:.6f}|{e.object_kind}|{e.object_name}|{e.type}"
+            f"|{e.reason}|{e.message}"
+            for e in cluster.events
+        )
+
+    tmp = tempfile.mkdtemp(prefix="jobset-policy-bench-")
+    try:
+        bundle_path = os.path.join(tmp, "corpus.tgz")
+        ckpt_path = os.path.join(tmp, "policy.npz")
+        with gates.gate("TPUPlacementSolver", True):
+            t0 = time.perf_counter()
+            wall_run(SolverPlacement(), bundle_path=bundle_path)
+            corpus_s = time.perf_counter() - t0
+
+        dataset = build_dataset([bundle_path])
+        t0 = time.perf_counter()
+        model, train_summary = train(
+            dataset, seed=train_seed, epochs=train_epochs
+        )
+        train_s = time.perf_counter() - t0
+        save_checkpoint(ckpt_path, model)
+
+        def shadow_placement():
+            return LearnedPlacement(
+                checkpoint_path=ckpt_path, mode="shadow"
+            )
+
+        with gates.gate("TPUPlacementSolver", True):
+            ev_solver = virtual_event_stream(SolverPlacement())
+            with gates.gate("TPULearnedPlacer", True):
+                ev_shadow = virtual_event_stream(shadow_placement())
+        transparent = ev_solver == ev_shadow
+
+        with gates.gate("TPUPlacementSolver", True):
+            solver_stats = wall_run(SolverPlacement())
+            with gates.gate("TPULearnedPlacer", True):
+                shadow_stats = wall_run(shadow_placement())
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    return {
+        "scenario": (
+            f"{n_gangs} exclusive gangs x {replicas}x{pods_per_job} pods "
+            f"on {domains} domains; corpus -> train -> seeded replay, "
+            f"{crash_rate:g} crash burst (seed {crash_seed})"
+        ),
+        "corpus": {
+            **dataset.meta,
+            "capture_wall_s": round(corpus_s, 3),
+        },
+        "train": {**train_summary, "train_wall_s": round(train_s, 3)},
+        "shadow_transparent": transparent,
+        "solver": {
+            k: solver_stats[k]
+            for k in ("time_to_ready_s", "restart_recovery_s")
+        },
+        "shadow": shadow_stats,
+    }
+
+
+def _bank_policy(result: dict) -> None:
+    _bank_sidecar_key("policy", result)
+
+
 def run_ha_bench(args) -> dict:
     """Replicated-control-plane bench (docs/ha.md): a 3-replica in-process
     quorum under a sequential write storm with a seeded leader-kill storm
@@ -2304,6 +2530,12 @@ def main() -> int:
              "way)",
     )
     parser.add_argument(
+        "--policy", action="store_true",
+        help="run the learned-placement-policy bench (corpus capture -> "
+             "train -> shadow-vs-solver seeded replay; banks time-to-ready "
+             "p50/p99 and regret under `policy`)",
+    )
+    parser.add_argument(
         "--queue", action="store_true",
         help="run ONLY the gang admission-queue bench (64 queues, 512 "
              "workloads, 64-gang preemption wave; both scorer backends) "
@@ -2386,6 +2618,19 @@ def main() -> int:
             "metric": "slo_time_to_ready_p99",
             "value": result["time_to_ready_s"]["p99"],
             "unit": "s",
+            "detail": result,
+        }))
+        return 0
+
+    if args.policy:
+        # Control-plane bench: the solver + MLP run on whatever backend
+        # jax initialized (CPU is fine at this scale); no probe needed.
+        result = run_policy_bench(args)
+        _bank_policy(result)
+        print(json.dumps({
+            "metric": "policy_shadow_regret_mean",
+            "value": result["shadow"]["regret"]["mean"],
+            "unit": "cost",
             "detail": result,
         }))
         return 0

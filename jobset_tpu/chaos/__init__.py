@@ -11,6 +11,7 @@ from .injector import (
     Fault,
     FaultInjector,
     KIND_BREAK,
+    KIND_CORRUPT,
     KIND_CRASH,
     KIND_DRAIN,
     KIND_ENOSPC,
@@ -29,6 +30,7 @@ from .injector import (
 from .scenarios import (
     node_drain,
     pod_crash_burst,
+    policy_inference_faults,
     queue_spurious_evictions,
     store_enospc_writes,
     store_torn_writes,
@@ -38,6 +40,7 @@ __all__ = [
     "Fault",
     "FaultInjector",
     "KIND_BREAK",
+    "KIND_CORRUPT",
     "KIND_CRASH",
     "KIND_DRAIN",
     "KIND_ENOSPC",
@@ -54,6 +57,7 @@ __all__ = [
     "get_injector",
     "node_drain",
     "pod_crash_burst",
+    "policy_inference_faults",
     "queue_spurious_evictions",
     "store_enospc_writes",
     "store_torn_writes",

@@ -42,6 +42,10 @@ just names):
                        break (frame dropped pre-flight, follower lags and
                        is caught up from the resend buffer), added
                        latency
+``policy.inference``   learned-placement scoring (active mode): added
+                       latency, or ``corrupt`` — the model is treated as
+                       unusable for that decision and placement falls
+                       back to the auction solver
 ================== ======================================================
 
 Spec grammar (CLI ``--inject`` / ``FaultInjector.from_spec``)::
@@ -76,6 +80,7 @@ KIND_DRAIN = "drain"      # cluster.node: drain the node
 KIND_EVICT = "evict"      # queue.admission: spuriously evict/deny a gang
 KIND_TORN = "torn"        # store.write: crash mid-append (partial frame)
 KIND_ENOSPC = "enospc"    # store.write: fail the append before any byte
+KIND_CORRUPT = "corrupt"  # policy.inference: checkpoint/model unusable
 
 
 @dataclass

@@ -15,6 +15,7 @@ from typing import Optional
 
 from .injector import (
     FaultInjector,
+    KIND_CORRUPT,
     KIND_CRASH,
     KIND_DRAIN,
     KIND_ENOSPC,
@@ -258,6 +259,111 @@ def store_enospc_writes(data_dir: str, **kwargs) -> list[dict]:
     lands; the log needs no truncation but the commit is still unacked)."""
     kwargs.setdefault("kind", KIND_ENOSPC)
     return store_torn_writes(data_dir, **kwargs)
+
+
+def policy_inference_faults(
+    checkpoint_path: Optional[str],
+    rates=(0.0, 0.25, 1.0),
+    seed: int = 11,
+    jobsets: int = 6,
+    replicas: int = 2,
+    pods_per_job: int = 2,
+    domains: int = 8,
+    nodes_per_domain: int = 2,
+    kind: str = KIND_CORRUPT,
+    crash_rate: float = 0.4,
+    score_backend: str = "numpy",
+) -> list[dict]:
+    """Learned-placement fault sweep at the ``policy.inference`` point:
+    for each injection rate, drive a fresh cluster with ACTIVE-mode
+    `LearnedPlacement` (both placement gates on) through creation, a
+    seeded pod-crash burst, and gang recovery, while every learned
+    inference is one arrival at the point — a ``corrupt`` fault sends
+    that gang to the auction solver fallback (counted: fallbacks ==
+    faults). A ``latency`` fault only DELAYS the decision — consult()
+    absorbs it — so latency sweeps keep decisions learned and bank
+    ``fallbacks == 0``.
+
+    The invariant each rate's result carries (the caller asserts):
+    ``unplaced_gangs == 0`` and ``double_booked_domains == 0`` at EVERY
+    rate — a sick model may cost optimality, never placement.
+    """
+    from ..core import features, make_cluster, metrics
+    from ..policy.placer import LearnedPlacement
+    from ..testing import make_jobset, make_replicated_job
+
+    topology_key = "tpu-slice"
+    results: list[dict] = []
+    for i, rate in enumerate(rates):
+        injector = FaultInjector(seed=seed)
+        if rate > 0:
+            injector.add_rule("policy.inference", kind, rate=rate)
+        placement = LearnedPlacement(
+            checkpoint_path=checkpoint_path,
+            mode="active",
+            injector=injector,
+            score_backend=score_backend,
+        )
+        fallbacks0 = metrics.policy_fallbacks_total.total()
+        decisions0 = metrics.policy_decisions_total.value("active")
+        with features.gate("TPUPlacementSolver", True), \
+                features.gate("TPULearnedPlacer", True):
+            cluster = make_cluster(placement=placement)
+            cluster.add_topology(
+                topology_key, num_domains=domains,
+                nodes_per_domain=nodes_per_domain, capacity=8,
+            )
+            from ..api import FailurePolicy
+
+            for j in range(jobsets):
+                cluster.create_jobset(
+                    make_jobset(f"pol-{i}-{j}")
+                    .exclusive_placement(topology_key)
+                    .failure_policy(FailurePolicy(max_restarts=4))
+                    .replicated_job(
+                        make_replicated_job("w").replicas(replicas)
+                        .parallelism(pods_per_job)
+                        .completions(pods_per_job).obj()
+                    )
+                    .obj()
+                )
+            cluster.run_until_stable()
+            crashed = pod_crash_burst(cluster, injector, rate=crash_rate)
+            cluster.run_until_stable()
+
+        expected_pods = jobsets * replicas * pods_per_job
+        bound = [p for p in cluster.pods.values() if p.spec.node_name]
+        # A gang is stranded when a LIVE pod never got a node; leftover
+        # Failed pod objects from the crash burst are not placements.
+        unplaced = set()
+        for pod in cluster.pods.values():
+            if pod.status.phase in _LIVE_PHASES and not pod.spec.node_name:
+                unplaced.add(pod.metadata.name.rsplit("-w-", 1)[0])
+        per_domain: dict[str, set] = {}
+        from ..api import keys as api_keys
+
+        for pod in bound:
+            node = cluster.nodes[pod.spec.node_name]
+            per_domain.setdefault(
+                node.labels[topology_key], set()
+            ).add(pod.labels[api_keys.JOB_KEY])
+        results.append({
+            "rate": rate,
+            "kind": kind,
+            "gangs": jobsets,
+            "pods_bound": len(bound),
+            "pods_expected": expected_pods,
+            "crashed_pods": len(crashed),
+            "faults_injected": injector.injected_total("policy.inference"),
+            "fallbacks": metrics.policy_fallbacks_total.total() - fallbacks0,
+            "decisions_active": metrics.policy_decisions_total.value("active")
+            - decisions0,
+            "unplaced_gangs": len(unplaced),
+            "double_booked_domains": sum(
+                1 for ks in per_domain.values() if len(ks) > 1
+            ),
+        })
+    return results
 
 
 # ---------------------------------------------------------------------------

@@ -14,6 +14,9 @@ a subcommand CLI (`python -m jobset_tpu ...`):
                      trace ids; GET /debug/timeline).
 * ``debug-bundle`` — one-command postmortem export (timelines, traces,
                      metrics, health, SLO summary) into a .tgz.
+* ``policy``       — learned placement policy tools: ``policy train``
+                     fits the cost model on debug-bundle corpora
+                     (docs/policy.md).
 * ``label-nodes``  — the nodeSelector placement-strategy tool
                      (`hack/label_nodes/label_nodes.py` analog): labels and
                      taints every node of each topology domain so JobSets
@@ -99,6 +102,23 @@ def _build_parser() -> argparse.ArgumentParser:
     c.add_argument("--inject-seed", type=int, default=0,
                    help="seed for --inject (two runs with the same seed "
                         "inject identical fault sequences)")
+    c.add_argument("--policy-checkpoint", default="", metavar="CKPT",
+                   help="learned placement policy checkpoint (npz from "
+                        "`jobset-tpu policy train`; docs/policy.md): wires "
+                        "the LearnedPlacement provider — enable the "
+                        "TPULearnedPlacer feature gate to activate it")
+    c.add_argument("--policy-mode", choices=["shadow", "active"],
+                   default="shadow",
+                   help="shadow = auction solver still places, the model "
+                        "scores every decision and banks regret; active = "
+                        "place from the learned scores with the solver as "
+                        "fallback (low confidence, bad checkpoint, "
+                        "injected policy.inference faults)")
+    c.add_argument("--policy-confidence", type=float, default=0.0,
+                   help="active mode: minimum predicted-outcome gap "
+                        "(seconds) between a job's best and second-best "
+                        "domain; a gang under the margin falls back to "
+                        "the solver")
     c.add_argument("--solve-budget", type=float, default=0.0,
                    help="per-solve deadline budget in seconds: a placement "
                         "solve (remote or local) exceeding it degrades the "
@@ -209,6 +229,29 @@ def _build_parser() -> argparse.ArgumentParser:
     w.add_argument("--cpu", action="store_true")
     w.add_argument("--profile-dir",
                    help="capture a JAX profiler trace of the training run")
+
+    pol = sub.add_parser(
+        "policy",
+        help="learned placement policy tools (docs/policy.md): train a "
+             "cost model on debug-bundle corpora",
+    )
+    pol_sub = pol.add_subparsers(dest="policy_command", required=True)
+    pt = pol_sub.add_parser(
+        "train",
+        help="train the placement cost model from debug bundles "
+             "(deterministic: same corpus + seed = byte-identical "
+             "checkpoint)",
+    )
+    pt.add_argument("--bundles", required=True, metavar="DIR",
+                    help="directory of debug-bundle .tgz archives (or one "
+                         "bundle file) — the training corpus")
+    pt.add_argument("--out", required=True, metavar="CKPT",
+                    help="checkpoint path to write (plain npz)")
+    pt.add_argument("--seed", type=int, default=0)
+    pt.add_argument("--epochs", type=int, default=200)
+    pt.add_argument("--lr", type=float, default=0.05)
+    pt.add_argument("--hidden", default="32,16",
+                    help="comma-separated MLP hidden layer widths")
 
     sub.add_parser(
         "openapi",
@@ -341,13 +384,22 @@ def _make_controller_cluster(args):
         from .placement.service import RemoteAssignmentSolver
 
         solver = RemoteAssignmentSolver(args.solver_addr)
-    return make_cluster(
-        clock=Clock(),
-        placement=SolverPlacement(
+    if getattr(args, "policy_checkpoint", ""):
+        from .policy.placer import LearnedPlacement
+
+        placement = LearnedPlacement(
+            checkpoint_path=args.policy_checkpoint,
+            mode=args.policy_mode,
+            confidence_margin=args.policy_confidence,
             solver=solver,
             solve_budget_s=args.solve_budget or None,
-        ),
-    )
+        )
+    else:
+        placement = SolverPlacement(
+            solver=solver,
+            solve_budget_s=args.solve_budget or None,
+        )
+    return make_cluster(clock=Clock(), placement=placement)
 
 
 def _bootstrap_cluster_config(args, cluster) -> None:
@@ -1000,6 +1052,36 @@ def _cmd_openapi(args) -> int:
     return 0
 
 
+def _cmd_policy(args) -> int:
+    """`jobset-tpu policy train --bundles DIR --out CKPT`: corpus ->
+    deterministic checkpoint (docs/policy.md training workflow)."""
+    if args.policy_command == "train":
+        import tarfile
+
+        from .policy.train import train_bundles_to_checkpoint
+
+        hidden = tuple(
+            int(h) for h in args.hidden.split(",") if h.strip()
+        )
+        try:
+            summary = train_bundles_to_checkpoint(
+                args.bundles,
+                args.out,
+                seed=args.seed,
+                epochs=args.epochs,
+                lr=args.lr,
+                hidden=hidden,
+            )
+        except (ValueError, OSError, tarfile.TarError) as exc:
+            # Empty corpus, unreadable/corrupt bundle archive, bad
+            # schemaVersion, unwritable --out: one clean line, exit 1.
+            print(f"policy train: {exc}", file=sys.stderr)
+            return 1
+        print(json.dumps(summary, indent=1, sort_keys=True))
+        return 0
+    return 2
+
+
 _COMMANDS = {
     "controller": _cmd_controller,
     "openapi": _cmd_openapi,
@@ -1013,6 +1095,7 @@ _COMMANDS = {
     "resume": _cmd_resume,
     "label-nodes": _cmd_label_nodes,
     "worker": _cmd_worker,
+    "policy": _cmd_policy,
 }
 
 

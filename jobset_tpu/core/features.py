@@ -20,20 +20,34 @@ _DEFAULTS: dict[str, bool] = {
     # pure-Python greedy scorer is the default and produces identical
     # admission decisions (queue/scorer.py).
     "TPUQueueScorer": False,
+    # Learned placement policy (jobset_tpu/policy, docs/policy.md): the
+    # JAX-trained cost model scores (gang, domain) candidates — shadow
+    # mode banks regret while the auction solver still places; active
+    # mode places from the scores with the solver as fallback.
+    "TPULearnedPlacer": False,
 }
 
 _gates: dict[str, bool] = dict(_DEFAULTS)
 
 
+def _unknown_gate(name: str) -> KeyError:
+    """A --feature-gates typo should name its alternatives, not die on a
+    bare KeyError."""
+    return KeyError(
+        f"unknown feature gate {name!r} (known gates: "
+        f"{', '.join(sorted(_gates))})"
+    )
+
+
 def enabled(name: str) -> bool:
     if name not in _gates:
-        raise KeyError(f"unknown feature gate: {name}")
+        raise _unknown_gate(name)
     return _gates[name]
 
 
 def set_gate(name: str, value: bool) -> None:
     if name not in _gates:
-        raise KeyError(f"unknown feature gate: {name}")
+        raise _unknown_gate(name)
     _gates[name] = value
 
 

@@ -333,6 +333,32 @@ ha_failovers_total = Counter(
     "committed log, and took over serving)",
     label_names=(),
 )
+# Learned placement policy plane (jobset_tpu/policy, docs/policy.md):
+# shadow-mode regret banking and active-mode fallback accounting.
+policy_decisions_total = Counter(
+    "jobset_policy_decisions_total",
+    "Placement decisions scored (shadow) or made (active) by the learned "
+    "policy, per mode",
+    label_names=("mode",),
+)
+policy_fallbacks_total = Counter(
+    "jobset_policy_fallbacks_total",
+    "Active-mode placements handed back to the auction solver, by reason "
+    "(checkpoint_missing/checkpoint_corrupt/low_confidence/infeasible/"
+    "chaos_inference_fault/score_error)",
+    label_names=("reason",),
+)
+policy_regret = Histogram(
+    "jobset_policy_regret",
+    "Shadow-mode per-decision regret of the learned pick vs the solver's, "
+    "measured under the solver's structured cost (clamped at 0; ~0 across "
+    "the histogram = the model is ready for active mode)",
+)
+policy_model_loaded = Gauge(
+    "jobset_policy_model_loaded",
+    "1 while a learned-policy checkpoint is loaded and scoreable, 0 when "
+    "missing/corrupt (active mode is falling back to the solver)",
+)
 
 
 def set_build_info(version: str, backend: str, gates: str,
@@ -361,6 +387,8 @@ ALL_COUNTERS = (
     ha_replicated_records_total,
     ha_quorum_failures_total,
     ha_failovers_total,
+    policy_decisions_total,
+    policy_fallbacks_total,
 )
 ALL_HISTOGRAMS = (
     reconcile_time_seconds,
@@ -370,6 +398,7 @@ ALL_HISTOGRAMS = (
     slo_time_to_admission_seconds,
     slo_time_to_ready_seconds,
     slo_restart_recovery_seconds,
+    policy_regret,
 )
 ALL_GAUGES = (
     solver_batch_occupancy,
@@ -385,6 +414,7 @@ ALL_GAUGES = (
     ha_term,
     ha_commit_seq,
     ha_follower_lag_records,
+    policy_model_loaded,
 )
 
 

@@ -30,6 +30,13 @@ import tarfile
 import time
 
 BUNDLE_FORMAT = 1
+# Semantic bundle-content version stamped into the manifest. Major bumps
+# mean a consumer written against this module cannot safely parse the
+# members (load_bundle REJECTS unknown majors — the policy plane's corpus
+# builder needs a stable contract across controller generations); minor
+# bumps are additive (1.1 added per-timeline `placements` records).
+# Bundles written before the stamp existed are treated as "1.0".
+BUNDLE_SCHEMA_VERSION = "1.1"
 
 _JSON_MEMBERS = (
     "manifest.json",
@@ -74,6 +81,7 @@ def write_bundle(client, path: str) -> dict:
     members = sorted([*_JSON_MEMBERS, "metrics.prom"])
     payloads["manifest.json"] = {
         "format": BUNDLE_FORMAT,
+        "schemaVersion": BUNDLE_SCHEMA_VERSION,
         "capturedAt": time.strftime(
             "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
         ),
@@ -120,6 +128,16 @@ def load_bundle(path: str) -> dict:
     manifest = out.get("manifest.json")
     if not isinstance(manifest, dict) or "members" not in manifest:
         raise ValueError(f"{path!r} is not a debug bundle (no manifest)")
+    version = str(manifest.get("schemaVersion", "1.0"))
+    major = version.partition(".")[0]
+    if major != BUNDLE_SCHEMA_VERSION.partition(".")[0]:
+        raise ValueError(
+            f"debug bundle {path!r} has schemaVersion {version}; this "
+            f"build understands major "
+            f"{BUNDLE_SCHEMA_VERSION.partition('.')[0]} "
+            f"(current {BUNDLE_SCHEMA_VERSION}) — re-capture the bundle "
+            f"with a matching controller"
+        )
     missing = [m for m in manifest["members"] if m not in out]
     if missing:
         raise ValueError(

@@ -38,6 +38,9 @@ from typing import Optional
 # Bounded phase-mark history per record: enough for a long restart history
 # without letting a crash-looping gang grow memory.
 MAX_MARKS = 64
+# Bounded placement-decision history per record (the policy plane's
+# training signal): a crash-looping gang keeps its newest decisions.
+MAX_PLACEMENTS = 512
 # Bounded record population (uids): evicts oldest when exceeded, so a
 # create/delete churn workload cannot grow tracker memory.
 MAX_RECORDS = 8192
@@ -74,6 +77,10 @@ class LifecycleTracker:
             "recoveries": 0,
             "deleted_at": None,
             "marks": [],
+            # Placement decisions stamped by the provider (job, domain,
+            # feature vector; see policy/features.py): the flight
+            # recorder's contribution to the learned-policy corpus.
+            "placements": [],
         }
         self.records[uid] = record
         self._by_key[record["key"]] = uid
@@ -182,6 +189,38 @@ class LifecycleTracker:
                 )
         elif not all_ready:
             record["ready"] = False
+
+    def on_placed(
+        self,
+        uid: str,
+        job: str,
+        domain: str,
+        features: list[float],
+        source: str = "solver",
+        now: Optional[float] = None,
+    ) -> None:
+        """One placement decision for one child job: the domain the
+        provider chose and the candidate feature vector at decision time
+        (``policy/features.py`` schema; the ``hist_*`` columns are zero by
+        contract). Exported through the timeline into debug bundles, where
+        ``policy/dataset.py`` joins decisions with outcomes into training
+        examples."""
+        record = self.records.get(uid)
+        if record is None:
+            return
+        if now is None:
+            now = self.clock.now()
+        placements = record.setdefault("placements", [])
+        placements.append({
+            "time": now,
+            "job": job,
+            "domain": domain,
+            "source": source,
+            "restarts": record["restarts"],
+            "features": [round(float(x), 6) for x in features],
+        })
+        if len(placements) > MAX_PLACEMENTS:
+            del placements[: len(placements) - MAX_PLACEMENTS]
 
     def on_deleted(self, uid: str) -> None:
         """Mark the record deleted but KEEP it (until ring eviction): the

@@ -239,6 +239,16 @@ def assemble(
         ),
         "phases": phases,
         "entries": entries,
+        # Placement decisions with their candidate feature vectors — the
+        # learned-policy training signal (policy/dataset.py joins these
+        # with the phase marks above into (features, outcome) examples).
+        "placements": (
+            [
+                {**p, "time": round(float(p["time"]), 6)}
+                for p in record.get("placements", ())
+            ]
+            if record is not None else []
+        ),
         "chaos": chaos,
         "storeCommit": dict(store_commit) if store_commit else None,
         "traceIds": trace_ids,

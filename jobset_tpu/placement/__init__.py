@@ -5,6 +5,9 @@ Layer map (SURVEY.md §3.4): the greedy per-pod webhook path is the default;
 job -> topology-domain assignment into one jitted linear-assignment solve,
 either in-process (`AssignmentSolver` in `.solver`) or over gRPC to a TPU
 sidecar (`RemoteAssignmentSolver` / `SolverServer` in `.service`).
+`jobset_tpu.policy.LearnedPlacement` (the learned-policy plane, behind
+`TPULearnedPlacer`) extends `SolverPlacement` with model-scored placement
+and keeps the solver as verifier/fallback — see docs/policy.md.
 
 Intentionally no eager re-exports: `api.validation` imports `.naming` for
 the DNS-length math while `.naming` uses the api key constants, so package

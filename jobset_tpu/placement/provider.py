@@ -432,9 +432,14 @@ class SolverPlacement:
                     return
             else:
                 assign_span.set_attribute("outcome", "prefetched_plan")
-            self._stamp_plan(cluster, jobs, plan, topology_key)
+            self._stamp_plan(cluster, js, jobs, plan, topology_key)
 
-    def _stamp_plan(self, cluster, jobs, plan, topology_key) -> None:
+    # What _record_decisions stamps as the decision source in the flight
+    # recorder; the learned placer's active mode overrides it per plan.
+    _decision_source = "solver"
+
+    def _stamp_plan(self, cluster, js, jobs, plan, topology_key) -> None:
+        self._record_decisions(cluster, js, jobs, plan, topology_key)
         for job in jobs:
             domain = plan.get(job.metadata.name)
             if domain is None:
@@ -448,6 +453,37 @@ class SolverPlacement:
             cluster.claim_domain(
                 topology_key, domain, job.labels.get(keys.JOB_KEY, "")
             )
+
+    def _record_decisions(self, cluster, js, jobs, plan, topology_key) -> None:
+        """Flight-recorder hook — the policy plane's data flywheel: every
+        stamped (job, domain) decision lands in the JobSet's lifecycle
+        record with its feature vector (policy/features.py), so the debug
+        bundles operators already capture double as training corpora for
+        the learned placement policy. O(1) per placed job off the cached
+        domain stats; a cluster without an SLO tracker records nothing."""
+        tracker = getattr(cluster, "slo", None)
+        if tracker is None or not hasattr(tracker, "on_placed"):
+            return
+        from ..policy import features as pf  # numpy-only, no jax
+
+        view = pf.domain_view(cluster, topology_key, mutable=False)
+        if view is None:
+            return
+        gang = pf.gang_context(cluster, js)
+        for job in jobs:
+            domain = plan.get(job.metadata.name)
+            if domain is None:
+                continue
+            job_key = job.labels.get(keys.JOB_KEY, "")
+            row = pf.feature_row(
+                view, job_key, job.pods_expected(), gang, domain,
+                sticky_domain=cluster.placement_history.get(job_key),
+            )
+            if row is not None:
+                tracker.on_placed(
+                    js.metadata.uid, job.metadata.name, domain, row,
+                    source=self._decision_source,
+                )
 
     def _fetch_valid_plan(self, cluster, js, jobs, topology_key):
         """Return {job_name: domain} from the prefetched solve if it is still

@@ -1,0 +1,41 @@
+"""Learned placement policy plane (docs/policy.md).
+
+The fourth plane alongside ``placement/``, ``queue/`` and ``obs/``: a
+JAX-trained cost model over (gang, topology-domain) assignment candidates,
+trained offline on the controller's OWN flight recorder — the debug-bundle
+corpora the observability plane already exports — and served behind the
+``TPULearnedPlacer`` feature gate with the exact auction solver as
+verifier and fallback.
+
+Modules:
+
+* ``features``  — deterministic fixed-width feature extraction per
+  (gang, domain) candidate (topology coordinates, occupancy,
+  fragmentation, gang shape, queue pressure, historical outcomes);
+* ``dataset``   — corpus builder: debug bundles -> (features, outcome)
+  training examples, joined from timelines + placement decisions;
+* ``model``     — pure-JAX MLP scorer (compile-once, pow2-padded row
+  buckets) with plain-npz deterministic checkpoints;
+* ``train``     — seeded, byte-deterministic offline trainer
+  (``jobset-tpu policy train --bundles DIR --out CKPT``);
+* ``placer``    — the ``LearnedPlacement`` provider: shadow mode scores
+  candidates and banks per-decision regret while the auction solver still
+  places; active mode places from the learned scores and degrades to the
+  solver on low confidence, missing/corrupt checkpoints, or injected
+  ``policy.inference`` faults.
+"""
+
+from .features import FEATURE_DIM, FEATURE_NAMES, DomainHistory
+from .model import CheckpointError, PolicyModel, load_checkpoint, save_checkpoint
+from .placer import LearnedPlacement
+
+__all__ = [
+    "CheckpointError",
+    "DomainHistory",
+    "FEATURE_DIM",
+    "FEATURE_NAMES",
+    "LearnedPlacement",
+    "PolicyModel",
+    "load_checkpoint",
+    "save_checkpoint",
+]

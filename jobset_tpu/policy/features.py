@@ -1,0 +1,322 @@
+"""Deterministic feature extraction for (gang, domain) placement candidates.
+
+One fixed-width float32 vector per candidate, the contract shared by every
+consumer: the decision recorder (``placement/provider.py`` stamps the chosen
+candidate's features into the flight-recorder record), the corpus builder
+(``policy/dataset.py`` re-reads them from debug bundles), and the scorer
+(``policy/placer.py`` builds the full [domains, F] matrix per job at
+inference time). All numpy, no jax — the recorder sits on the reconcile hot
+path and must not pull in a device runtime.
+
+The two ``hist_*`` columns are **zero at record time** and filled later:
+the corpus builder fills them from aggregate per-domain outcomes across the
+whole corpus, and the scorer fills them from the aggregates stored in the
+checkpoint (``DomainHistory``) — so training and inference see the same
+distribution, and old corpora stay parseable when the history evolves.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+import numpy as np
+
+# Fixed feature schema (docs/policy.md documents each column). Order is
+# the wire contract: recorded vectors, corpus matrices, and checkpoints all
+# index by position.
+FEATURE_NAMES: tuple[str, ...] = (
+    "domain_position",    # sorted-domain index / num_domains (topology coord)
+    "domain_coord",       # trailing integer of the domain value / num_domains
+    "domain_distance",    # |coord - sticky domain's coord| / num_domains
+    "occupancy_frac",     # allocated pods / capacity in this domain
+    "free_frac",          # free pods / capacity
+    "fit_headroom",       # (free - pods_needed) / max(capacity, 1)
+    "fragmentation",      # (free % pods_needed) / max(capacity, 1) — waste
+    "domain_occupied",    # 1 when another job key owns the domain
+    "sticky",             # 1 when this job key last ran here
+    "gang_replicas",      # jobs in the gang / 64 (clipped)
+    "job_pods",           # pods this job needs / 64 (clipped)
+    "gang_total_pods",    # total pods in the gang / 1024 (clipped)
+    "queue_backlog",      # pending queue workloads / 64 (clipped)
+    "priority",           # spec.priority / 100 (clipped)
+    "hist_mean_outcome",  # corpus: mean outcome seconds of gangs placed here
+    "hist_restart_rate",  # corpus: restarts per placement decision here
+)
+FEATURE_DIM = len(FEATURE_NAMES)
+
+HIST_MEAN_IDX = FEATURE_NAMES.index("hist_mean_outcome")
+HIST_RESTART_IDX = FEATURE_NAMES.index("hist_restart_rate")
+OCCUPIED_IDX = FEATURE_NAMES.index("domain_occupied")
+
+_TRAILING_INT = re.compile(r"(\d+)\s*$")
+
+
+def domain_coord(value: str) -> float:
+    """Topology coordinate of a domain value: its trailing integer
+    (``domain-7`` -> 7, ``tpu-slice-12`` -> 12), or 0 when the value
+    carries none. Synthetic topologies (cluster.add_topology) and real
+    rack/slice labels both end in an index."""
+    m = _TRAILING_INT.search(value)
+    return float(m.group(1)) if m else 0.0
+
+
+class DomainHistory:
+    """Aggregate per-domain outcome statistics from a training corpus.
+
+    Per domain value: (decisions, outcome_sum_seconds, restarts). The
+    corpus builder accumulates these while labeling examples; the trainer
+    stores them in the checkpoint; the scorer replays them into the
+    ``hist_*`` feature columns at inference time.
+    """
+
+    def __init__(self) -> None:
+        self._stats: dict[str, list[float]] = {}
+
+    def record_decision(self, domain: str, outcome_s: Optional[float]) -> None:
+        s = self._stats.setdefault(domain, [0.0, 0.0, 0.0])
+        s[0] += 1.0
+        if outcome_s is not None:
+            s[1] += float(outcome_s)
+
+    def record_restart(self, domain: str) -> None:
+        s = self._stats.setdefault(domain, [0.0, 0.0, 0.0])
+        s[2] += 1.0
+
+    def mean_outcome(self, domain: str) -> float:
+        s = self._stats.get(domain)
+        return (s[1] / s[0]) if s and s[0] else 0.0
+
+    def mean_outcome_excluding(self, domain: str, outcome_s: float) -> float:
+        """Leave-one-out mean: the domain's mean outcome WITHOUT one
+        observed sample. The corpus builder fills each training row's
+        ``hist_mean_outcome`` with this so the feature never contains the
+        row's own label (a one-example domain would otherwise hand the
+        model its answer verbatim). Inference uses the plain mean — the
+        candidate's outcome is unknown there, so nothing leaks."""
+        s = self._stats.get(domain)
+        if not s or s[0] <= 1:
+            return 0.0
+        return (s[1] - float(outcome_s)) / (s[0] - 1)
+
+    def restart_rate(self, domain: str) -> float:
+        s = self._stats.get(domain)
+        return (s[2] / s[0]) if s and s[0] else 0.0
+
+    def __len__(self) -> int:
+        return len(self._stats)
+
+    # -- checkpoint round trip (plain arrays, deterministic order) --------
+
+    def to_arrays(self) -> tuple[list[str], np.ndarray]:
+        domains = sorted(self._stats)
+        stats = np.array(
+            [self._stats[d] for d in domains], np.float32
+        ).reshape(len(domains), 3)
+        return domains, stats
+
+    @classmethod
+    def from_arrays(cls, domains, stats) -> "DomainHistory":
+        h = cls()
+        for d, row in zip(list(domains), np.asarray(stats, np.float32)):
+            h._stats[str(d)] = [float(row[0]), float(row[1]), float(row[2])]
+        return h
+
+
+class DomainView:
+    """Snapshot of per-domain placement state for one topology key.
+
+    Built once per decision batch from the cluster's incrementally
+    maintained stats (O(domains), no node scan), then optionally mutated by
+    the active-mode placer as it claims domains job by job — so sequential
+    picks inside one gang see each other without touching live cluster
+    state until the plan is stamped.
+    """
+
+    __slots__ = ("values", "index", "free", "capacity", "owners", "_coords")
+
+    def __init__(self, values, free, capacity, owners, index=None,
+                 mutable=True):
+        self.values = values if isinstance(values, list) else list(values)
+        self.index = (
+            index if index is not None
+            else {v: i for i, v in enumerate(self.values)}
+        )
+        free = np.asarray(free, np.float32)
+        self.free = free.copy() if mutable else free
+        self.capacity = np.asarray(capacity, np.float32)
+        # domain value -> set of owning job keys (copied on mutable views:
+        # claim() treats them as scratch state).
+        if mutable:
+            self.owners = {v: set(ks) for v, ks in owners.items() if ks}
+        else:
+            self.owners = owners
+        # Coordinate parsing is lazy: the O(1) recorder path (feature_row)
+        # needs two coords per decision, not a regex pass over every
+        # domain value on the reconcile hot path.
+        self._coords: Optional[np.ndarray] = None
+
+    @property
+    def coords(self) -> np.ndarray:
+        if self._coords is None:
+            self._coords = np.array(
+                [domain_coord(v) for v in self.values], np.float32
+            )
+        return self._coords
+
+    def coord(self, d: int) -> float:
+        if self._coords is not None:
+            return float(self._coords[d])
+        return domain_coord(self.values[d])
+
+    def claim(self, domain: str, job_key: str, pods: float) -> None:
+        d = self.index.get(domain)
+        if d is not None:
+            self.free[d] -= pods
+        self.owners.setdefault(domain, set()).add(job_key)
+
+
+def domain_view(
+    cluster, topology_key: str, mutable: bool = True
+) -> Optional[DomainView]:
+    """Build a DomainView from live cluster state, or None when the
+    topology key labels no nodes.
+
+    `mutable=False` is the recorder's hot-path variant: it reuses the
+    cluster's incrementally-maintained value->index map and aliases the
+    live arrays instead of copying — O(1) construction, but `claim()`
+    must never be called on it (it would corrupt live occupancy)."""
+    stats = cluster.domain_capacity(topology_key)
+    if stats is None:
+        return None
+    values, free, capacity = stats
+    occupancy = cluster.domain_job_keys.get(topology_key, {})
+    index = None
+    if not mutable:
+        cached = getattr(cluster, "_domain_stats", {}).get(topology_key)
+        if cached is not None:
+            index = cached[1]  # (values, index, capacity, allocated)
+    return DomainView(
+        values, free, capacity, occupancy, index=index, mutable=mutable
+    )
+
+
+def gang_context(cluster, js) -> dict:
+    """Gang-level feature inputs shared by every job of one JobSet:
+    gang shape, queue backlog at decision time, and priority."""
+    replicas = 0
+    total_pods = 0
+    for rjob in js.spec.replicated_jobs:
+        n = int(rjob.replicas)
+        replicas += n
+        total_pods += n * rjob.template.spec.pods_expected()
+    backlog = 0
+    manager = getattr(cluster, "queue_manager", None)
+    if manager is not None and getattr(manager, "workloads", None):
+        backlog = sum(
+            1 for wl in manager.workloads.values()
+            if getattr(wl, "state", "") == "Pending"
+        )
+    priority = getattr(js.spec, "priority", None) or 0
+    return {
+        "replicas": replicas,
+        "total_pods": total_pods,
+        "backlog": backlog,
+        "priority": int(priority),
+    }
+
+
+def _gang_columns(gang: dict, pods_needed: float) -> tuple[float, ...]:
+    return (
+        min(gang["replicas"], 64) / 64.0,
+        min(pods_needed, 64) / 64.0,
+        min(gang["total_pods"], 1024) / 1024.0,
+        min(gang["backlog"], 64) / 64.0,
+        max(-1.0, min(gang["priority"], 100) / 100.0),
+    )
+
+
+def feature_matrix(
+    view: DomainView,
+    job_key: str,
+    pods_needed: int,
+    gang: dict,
+    sticky_domain: Optional[str] = None,
+    history: Optional[DomainHistory] = None,
+) -> np.ndarray:
+    """[num_domains, FEATURE_DIM] float32 candidate features for ONE job
+    against every domain of the view. Vectorized; the scorer's inference
+    path. Parity with `feature_row` is test-asserted."""
+    num = len(view.values)
+    pods = float(max(1, pods_needed))
+    cap = np.maximum(view.capacity, 1.0)
+    denom = float(max(num, 1))
+
+    feats = np.zeros((num, FEATURE_DIM), np.float32)
+    feats[:, 0] = np.arange(num, dtype=np.float32) / denom
+    feats[:, 1] = view.coords / denom
+    sticky_idx = view.index.get(sticky_domain) if sticky_domain else None
+    if sticky_idx is not None:
+        feats[:, 2] = np.abs(view.coords - view.coords[sticky_idx]) / denom
+    feats[:, 3] = (view.capacity - view.free) / cap
+    feats[:, 4] = view.free / cap
+    feats[:, 5] = (view.free - pods) / cap
+    feats[:, 6] = np.mod(view.free, pods) / cap
+    for value, owners in view.owners.items():
+        d = view.index.get(value)
+        if d is not None and (owners - {job_key}):
+            feats[d, 7] = 1.0
+    if sticky_idx is not None:
+        feats[sticky_idx, 8] = 1.0
+    feats[:, 9:14] = np.array(
+        _gang_columns(gang, pods), np.float32
+    )[None, :]
+    if history is not None and len(history):
+        for d, value in enumerate(view.values):
+            feats[d, HIST_MEAN_IDX] = history.mean_outcome(value)
+            feats[d, HIST_RESTART_IDX] = history.restart_rate(value)
+    return feats
+
+
+def feature_row(
+    view: DomainView,
+    job_key: str,
+    pods_needed: int,
+    gang: dict,
+    domain: str,
+    sticky_domain: Optional[str] = None,
+    history: Optional[DomainHistory] = None,
+) -> Optional[list[float]]:
+    """FEATURE_DIM floats for ONE (job, domain) candidate — the O(1)
+    scalar path the decision recorder uses on the reconcile hot path (a
+    [D, F] build per placed job would cost O(domains) per pod batch).
+    Returns None for a domain the view does not know."""
+    d = view.index.get(domain)
+    if d is None:
+        return None
+    pods = float(max(1, pods_needed))
+    cap = float(max(view.capacity[d], 1.0))
+    free = float(view.free[d])
+    denom = float(max(len(view.values), 1))
+    coord = view.coord(d)
+    sticky_idx = view.index.get(sticky_domain) if sticky_domain else None
+    distance = (
+        abs(coord - view.coord(sticky_idx)) / denom
+        if sticky_idx is not None else 0.0
+    )
+    owners = view.owners.get(domain, set())
+    row = [
+        d / denom,
+        coord / denom,
+        distance,
+        (cap - free) / cap,
+        free / cap,
+        (free - pods) / cap,
+        (free % pods) / cap,
+        1.0 if owners - {job_key} else 0.0,
+        1.0 if sticky_idx == d else 0.0,
+        *_gang_columns(gang, pods),
+        history.mean_outcome(domain) if history else 0.0,
+        history.restart_rate(domain) if history else 0.0,
+    ]
+    return [float(np.float32(x)) for x in row]

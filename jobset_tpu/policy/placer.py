@@ -1,0 +1,312 @@
+"""`LearnedPlacement`: the policy plane's placement provider.
+
+Same `prepare`/`prepare_batch`/`assign`/`forget` surface as
+`SolverPlacement` (it IS one, by inheritance), gated on the
+``TPULearnedPlacer`` feature gate, with two modes:
+
+* **shadow** (default): the auction solver still makes every placement —
+  end-to-end event streams are byte-identical to a solver-only run — but
+  each stamped decision is also scored by the learned model, and the
+  per-decision regret of the model's counterfactual pick (measured under
+  the solver's own hand-written structured cost, clamped at 0) is banked
+  into ``jobset_policy_regret``. This is the graduation gate: a model is
+  ready for active mode when its shadow regret is ~0.
+* **active**: jobs are placed from the learned scores (sequential argmin
+  over predicted outcome, claims propagating job-to-job through a
+  DomainView). The exact solver remains the verifier and fallback — a
+  missing/corrupt checkpoint, a low-confidence score gap, an infeasible
+  learned plan, or an injected ``policy.inference`` chaos fault all fall
+  back to `SolverPlacement.assign` (counted per reason in
+  ``jobset_policy_fallbacks_total``), reusing the degradation idiom the
+  chaos plane established: a sick model NEVER strands a gang.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..api import keys
+from ..core import features as gates
+from ..core import metrics
+from ..obs.trace import span as obs_span
+from ..placement.provider import SolverPlacement
+from . import features as pf
+from .model import CheckpointError, load_checkpoint, score
+
+FALLBACK_CHECKPOINT_MISSING = "checkpoint_missing"
+FALLBACK_CHECKPOINT_CORRUPT = "checkpoint_corrupt"
+FALLBACK_LOW_CONFIDENCE = "low_confidence"
+FALLBACK_INFEASIBLE = "infeasible"
+FALLBACK_CHAOS = "chaos_inference_fault"
+FALLBACK_SCORE_ERROR = "score_error"
+
+
+class LearnedPlacement(SolverPlacement):
+    """Learned cost-model placement with the auction solver as verifier."""
+
+    MODES = ("shadow", "active")
+
+    def __init__(
+        self,
+        checkpoint_path: str | None = None,
+        mode: str = "shadow",
+        confidence_margin: float = 0.0,
+        score_backend: str = "jax",
+        injector=None,
+        **kwargs,
+    ):
+        super().__init__(**kwargs)
+        if mode not in self.MODES:
+            raise ValueError(
+                f"policy mode {mode!r}: want one of {self.MODES}"
+            )
+        self.checkpoint_path = checkpoint_path
+        self.mode = mode
+        # Minimum predicted-outcome gap (seconds) between a job's best and
+        # second-best domain for the gang to count as confidently placed;
+        # any job under the margin sends the whole gang to the solver.
+        self.confidence_margin = float(confidence_margin)
+        self.score_backend = score_backend
+        # Chaos: explicit injector for tests; None = process-global.
+        self.injector = injector
+        self._model = None
+        self._model_error: str | None = None
+        self._model_loaded = False
+        # Base-provider hook: what _record_decisions stamps as the
+        # decision source in the flight recorder.
+        self._decision_source = "solver"
+
+    # -- model lifecycle ---------------------------------------------------
+
+    def model(self):
+        """Lazy one-shot checkpoint load; never raises. On failure the
+        error class is remembered (health + fallback reason) and the
+        provider behaves as solver-only."""
+        if self._model_loaded:
+            return self._model
+        self._model_loaded = True
+        if not self.checkpoint_path:
+            self._model_error = FALLBACK_CHECKPOINT_MISSING
+        else:
+            try:
+                self._model = load_checkpoint(self.checkpoint_path)
+            except CheckpointError as exc:
+                self._model_error = (
+                    FALLBACK_CHECKPOINT_MISSING
+                    if isinstance(exc.__cause__, FileNotFoundError)
+                    else FALLBACK_CHECKPOINT_CORRUPT
+                )
+        metrics.policy_model_loaded.set(1 if self._model is not None else 0)
+        return self._model
+
+    def reload(self) -> None:
+        """Forget the cached model (tests swap checkpoints underneath)."""
+        self._model = None
+        self._model_error = None
+        self._model_loaded = False
+
+    def policy_status(self) -> dict:
+        """The /debug/health `policy` component payload."""
+        model = self.model()
+        status = {
+            "gate": gates.enabled("TPULearnedPlacer"),
+            "mode": self.mode,
+            "checkpoint": self.checkpoint_path or None,
+            "modelLoaded": model is not None,
+            "modelError": self._model_error,
+            "confidenceMargin": self.confidence_margin,
+            "decisionsShadow": metrics.policy_decisions_total.value("shadow"),
+            "decisionsActive": metrics.policy_decisions_total.value("active"),
+            "fallbacksTotal": metrics.policy_fallbacks_total.total(),
+            "regretCount": metrics.policy_regret.n,
+            "regretMean": (
+                round(metrics.policy_regret.sum / metrics.policy_regret.n, 6)
+                if metrics.policy_regret.n else None
+            ),
+        }
+        if model is not None:
+            status["modelDims"] = list(model.dims)
+            status["historyDomains"] = len(model.history)
+        return status
+
+    def _score(self, model, feats: np.ndarray) -> np.ndarray:
+        return score(model, feats, backend=self.score_backend)
+
+    # -- prefetch (skipped while active placement can serve) ---------------
+
+    def _active_ready(self) -> bool:
+        return (
+            self.mode == "active"
+            and gates.enabled("TPULearnedPlacer")
+            and self.model() is not None
+        )
+
+    def prepare(self, cluster, js, block: bool = True) -> None:
+        # Active mode places from the model, so prefetching a solver plan
+        # is wasted device work; the rare fallback does one synchronous
+        # solve instead. Shadow mode keeps the solver prefetch path
+        # byte-identical to solver-only.
+        if self._active_ready():
+            return
+        super().prepare(cluster, js, block=block)
+
+    def prepare_batch(self, cluster, jobsets, block: bool = True) -> None:
+        if self._active_ready():
+            return
+        super().prepare_batch(cluster, jobsets, block=block)
+
+    # -- active placement --------------------------------------------------
+
+    def assign(self, cluster, js, jobs):
+        if self.mode != "active" or not gates.enabled("TPULearnedPlacer"):
+            # Shadow (and gate-off) rides the solver path unchanged; the
+            # shadow scorer hooks _stamp_plan below.
+            return super().assign(cluster, js, jobs)
+        topology_key = self._topology_key(js)
+        if topology_key is None or not jobs:
+            return super().assign(cluster, js, jobs)
+        if self.model() is None:
+            # Active mode was ASKED for and cannot serve: every batch is a
+            # counted fallback (missing/corrupt checkpoint), not a silent
+            # pass-through — the operator reads this off the metric.
+            return self._fallback(cluster, js, jobs, self._model_error)
+
+        from .. import chaos
+
+        fault = chaos.consult(
+            "policy.inference",
+            detail=f"{js.metadata.namespace}/{js.metadata.name}",
+            injector=self.injector,
+        )
+        if fault is not None:
+            return self._fallback(cluster, js, jobs, FALLBACK_CHAOS)
+
+        with obs_span(
+            "policy.assign",
+            {"jobset": js.metadata.name, "jobs": len(jobs)},
+        ) as span:
+            try:
+                plan, reason = self._learned_plan(
+                    cluster, js, jobs, topology_key
+                )
+            except Exception:  # a scoring bug must not strand the gang
+                plan, reason = None, FALLBACK_SCORE_ERROR
+            if plan is None:
+                span.set_attribute("outcome", f"fallback_{reason}")
+                return self._fallback(cluster, js, jobs, reason)
+            span.set_attribute("outcome", "learned_plan")
+            self._decision_source = "learned"
+            try:
+                SolverPlacement._stamp_plan(
+                    self, cluster, js, jobs, plan, topology_key
+                )
+            finally:
+                self._decision_source = "solver"
+            metrics.policy_decisions_total.inc("active", amount=len(plan))
+
+    def _fallback(self, cluster, js, jobs, reason: str):
+        metrics.policy_fallbacks_total.inc(reason)
+        return super().assign(cluster, js, jobs)
+
+    def _learned_plan(self, cluster, js, jobs, topology_key):
+        """Sequential greedy assignment from predicted outcomes. Returns
+        (plan, None) or (None, fallback_reason). Deterministic: jobs in
+        creation order, domains tie-broken by sorted order (argmin takes
+        the first minimum)."""
+        model = self.model()
+        view = pf.domain_view(cluster, topology_key)
+        if view is None:
+            return None, FALLBACK_INFEASIBLE
+        gang = pf.gang_context(cluster, js)
+        plan: dict[str, str] = {}
+        min_gap = float("inf")
+        for job in jobs:
+            job_key = job.labels.get(keys.JOB_KEY, "")
+            pods = job.pods_expected()
+            sticky = cluster.placement_history.get(job_key)
+            feats = pf.feature_matrix(
+                view, job_key, pods, gang,
+                sticky_domain=sticky, history=model.history,
+            )
+            predicted = self._score(model, feats)
+            feasible = (view.free >= pods) & (
+                feats[:, pf.OCCUPIED_IDX] < 0.5
+            )
+            if not feasible.any():
+                return None, FALLBACK_INFEASIBLE
+            masked = np.where(feasible, predicted, np.inf)
+            best = int(np.argmin(masked))
+            if int(feasible.sum()) > 1:
+                rest = masked.copy()
+                rest[best] = np.inf
+                min_gap = min(
+                    min_gap, float(rest.min() - masked[best])
+                )
+            domain = view.values[best]
+            plan[job.metadata.name] = domain
+            view.claim(domain, job_key, pods)
+        if min_gap < self.confidence_margin:
+            return None, FALLBACK_LOW_CONFIDENCE
+        return plan, None
+
+    # -- shadow scoring (hooks the solver's stamping) ----------------------
+
+    def _stamp_plan(self, cluster, js, jobs, plan, topology_key) -> None:
+        if (
+            self.mode == "shadow"
+            and gates.enabled("TPULearnedPlacer")
+            and self.model() is not None
+        ):
+            try:
+                self._shadow_score(cluster, js, jobs, plan, topology_key)
+            except Exception:
+                # Shadow observation must never affect real placement.
+                pass
+        super()._stamp_plan(cluster, js, jobs, plan, topology_key)
+
+    def _shadow_score(self, cluster, js, jobs, plan, topology_key) -> None:
+        """Score the solver's decisions without touching them: for each
+        placed job, ask the model for its pick and bank the regret of that
+        counterfactual under the solver's own structured cost (clamped at
+        0 — a per-job counterfactual can look locally cheaper than the
+        solver's globally-optimal assignment)."""
+        from ..placement.plans import build_cost_matrix_for_specs
+
+        placed = [j for j in jobs if plan.get(j.metadata.name) is not None]
+        if not placed:
+            return
+        model = self.model()
+        specs = [
+            (j.metadata.name, j.labels.get(keys.JOB_KEY, ""),
+             j.pods_expected())
+            for j in placed
+        ]
+        built = build_cost_matrix_for_specs(cluster, specs, topology_key)
+        view = pf.domain_view(cluster, topology_key)
+        if built is None or view is None:
+            return
+        cost, feasible, domain_values = built
+        if list(domain_values) != view.values:
+            return  # drifted mid-pass; observation only, skip
+        dindex = {v: d for d, v in enumerate(domain_values)}
+        gang = pf.gang_context(cluster, js)
+        for j, (name, job_key, pods) in enumerate(specs):
+            chosen = plan[name]
+            chosen_d = dindex.get(chosen)
+            if chosen_d is None:
+                continue
+            sticky = cluster.placement_history.get(job_key)
+            feats = pf.feature_matrix(
+                view, job_key, pods, gang,
+                sticky_domain=sticky, history=model.history,
+            )
+            predicted = self._score(model, feats)
+            masked = np.where(feasible[j], predicted, np.inf)
+            if not np.isfinite(masked).any():
+                continue
+            learned_d = int(np.argmin(masked))
+            regret = max(
+                0.0, float(cost[j, learned_d]) - float(cost[j, chosen_d])
+            )
+            metrics.policy_regret.observe(regret)
+            metrics.policy_decisions_total.inc("shadow")

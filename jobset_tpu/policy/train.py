@@ -1,0 +1,164 @@
+"""Seeded, byte-deterministic offline trainer for the placement policy.
+
+``jobset-tpu policy train --bundles DIR --out CKPT`` builds the corpus from
+debug bundles (policy/dataset.py) and fits the MLP scorer with full-batch
+gradient descent. Determinism contract — two runs on the same corpus with
+the same seed produce BYTE-identical checkpoints:
+
+* parameter init comes from ``np.random.default_rng(seed)`` (no
+  jax.random, no backend dependence in the initial bytes);
+* full-batch descent: no shuffling, no data-order nondeterminism, and the
+  jitted update step compiles ONCE for the pow2-padded batch bucket
+  (padding rows carry zero weight in the masked loss);
+* no wall-clock anywhere in the loop — epoch count is the only stop
+  condition, and the checkpoint writer zeroes zip timestamps
+  (policy/model.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from .dataset import Dataset, build_dataset, discover_bundles
+from .features import FEATURE_DIM
+from .model import (
+    DEFAULT_HIDDEN,
+    PolicyModel,
+    _round_up_pow2,
+    init_params,
+    save_checkpoint,
+)
+
+
+@functools.lru_cache(maxsize=8)
+def _step_fn(rows_p: int, dims: tuple[int, ...], lr: float):
+    """One compiled full-batch gradient step per (padded batch bucket,
+    layer dims, lr) — the compile-once discipline; the epoch loop replays
+    this single executable."""
+    import jax
+    import jax.numpy as jnp
+
+    n_layers = len(dims) - 1
+
+    def loss_fn(flat, x, y, mask):
+        h = x
+        for i in range(n_layers):
+            h = h @ flat[2 * i] + flat[2 * i + 1]
+            if i < n_layers - 1:
+                h = jax.nn.relu(h)
+        err = (h[:, 0] - y) * mask
+        return jnp.sum(err * err) / jnp.maximum(jnp.sum(mask), 1.0)
+
+    @jax.jit
+    def step(flat, x, y, mask):
+        loss, grads = jax.value_and_grad(loss_fn)(flat, x, y, mask)
+        return loss, [p - lr * g for p, g in zip(flat, grads)]
+
+    return step
+
+
+def train(
+    dataset: Dataset,
+    seed: int = 0,
+    epochs: int = 200,
+    lr: float = 0.05,
+    hidden: tuple[int, ...] = DEFAULT_HIDDEN,
+) -> tuple[PolicyModel, dict]:
+    """Fit the scorer; returns (model, summary). Deterministic for fixed
+    (dataset, seed, epochs, lr, hidden)."""
+    x = np.asarray(dataset.features, np.float32)
+    y = np.asarray(dataset.labels, np.float32)
+    if x.ndim != 2 or x.shape[1] != FEATURE_DIM:
+        raise ValueError(
+            f"dataset feature width {x.shape} != FEATURE_DIM {FEATURE_DIM}"
+        )
+    n = x.shape[0]
+
+    feat_mean = x.mean(axis=0).astype(np.float32)
+    feat_std = np.maximum(x.std(axis=0), 1e-6).astype(np.float32)
+    label_mean = float(y.mean())
+    label_std = float(max(y.std(), 1e-9))
+    xn = (x - feat_mean) / feat_std
+    yn = (y - label_mean) / label_std
+
+    rows_p = _round_up_pow2(n)
+    x_pad = np.zeros((rows_p, FEATURE_DIM), np.float32)
+    x_pad[:n] = xn
+    y_pad = np.zeros(rows_p, np.float32)
+    y_pad[:n] = yn
+    mask = np.zeros(rows_p, np.float32)
+    mask[:n] = 1.0
+
+    params = init_params(seed, FEATURE_DIM, hidden)
+    flat: list[np.ndarray] = []
+    for w, b in params:
+        flat.extend((w, b))
+    dims = (FEATURE_DIM, *hidden, 1)
+
+    if int(epochs) < 1:
+        raise ValueError("epochs must be >= 1")
+    step = _step_fn(rows_p, dims, float(lr))
+    first_loss = last_loss = None
+    for _ in range(int(epochs)):
+        loss, flat = step(flat, x_pad, y_pad, mask)
+        if first_loss is None:
+            first_loss = float(loss)
+        last_loss = float(loss)
+
+    trained = [
+        (np.asarray(flat[2 * i], np.float32),
+         np.asarray(flat[2 * i + 1], np.float32))
+        for i in range(len(dims) - 1)
+    ]
+    meta = {
+        "schema": 1,
+        "seed": int(seed),
+        "epochs": int(epochs),
+        "lr": float(lr),
+        "hidden": list(hidden),
+        "examples": int(n),
+        "corpus": dict(dataset.meta),
+    }
+    model = PolicyModel(
+        params=trained,
+        feat_mean=feat_mean,
+        feat_std=feat_std,
+        label_mean=label_mean,
+        label_std=label_std,
+        history=dataset.history,
+        meta=meta,
+    )
+    summary = {
+        "examples": int(n),
+        "epochs": int(epochs),
+        "seed": int(seed),
+        "lossFirst": round(first_loss, 6) if first_loss is not None else None,
+        "lossFinal": round(last_loss, 6),
+        "labelMeanS": round(label_mean, 6),
+        "domains": len(dataset.history),
+    }
+    return model, summary
+
+
+def train_bundles_to_checkpoint(
+    bundles_path: str,
+    out_path: str,
+    seed: int = 0,
+    epochs: int = 200,
+    lr: float = 0.05,
+    hidden: tuple[int, ...] = DEFAULT_HIDDEN,
+) -> dict:
+    """The CLI entry: corpus -> trained checkpoint at `out_path`."""
+    paths = discover_bundles(bundles_path)
+    if not paths:
+        raise ValueError(f"no debug bundles (*.tgz) under {bundles_path!r}")
+    dataset = build_dataset(paths)
+    model, summary = train(
+        dataset, seed=seed, epochs=epochs, lr=lr, hidden=hidden
+    )
+    save_checkpoint(out_path, model)
+    summary["checkpoint"] = out_path
+    summary["bundles"] = len(paths)
+    return summary

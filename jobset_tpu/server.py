@@ -1628,6 +1628,37 @@ class ControllerServer:
             ),
         }
 
+        placement = getattr(
+            getattr(cluster, "jobset_reconciler", None), "placement", None
+        )
+        if placement is None or not hasattr(placement, "policy_status"):
+            components["policy"] = {
+                "healthy": True,
+                "enabled": False,
+                "message": "no learned placement policy configured",
+            }
+        else:
+            status = placement.policy_status()
+            # Active mode without a scoreable model serves every gang via
+            # the solver fallback — safe, but not what the operator asked
+            # for: surface it as degraded.
+            active_broken = (
+                status["mode"] == "active" and not status["modelLoaded"]
+            )
+            components["policy"] = {
+                "healthy": not active_broken,
+                "enabled": True,
+                **status,
+                "message": (
+                    f"active mode falling back to the solver "
+                    f"({status['modelError']})" if active_broken
+                    else f"{status['mode']} mode"
+                    + ("" if status["modelLoaded"]
+                       else f" (no model: {status['modelError']})")
+                    + (" [gate off]" if not status["gate"] else "")
+                ),
+            }
+
         store = getattr(cluster, "store", None)
         if store is None:
             components["store"] = {

@@ -1,0 +1,647 @@
+"""Learned placement policy plane (jobset_tpu/policy, docs/policy.md):
+
+* the shared placement-provider contract every provider must honor
+  (parameterized over Greedy / Solver / Learned-shadow / Learned-active);
+* shadow-mode decision transparency (byte-identical event streams vs a
+  solver-only run) with regret + decision metrics populating;
+* active-mode fallback safety: missing/corrupt checkpoints, low
+  confidence, and injected ``policy.inference`` chaos all degrade to the
+  auction solver with zero stranded gangs;
+* the data flywheel: debug bundles -> dataset -> seeded deterministic
+  training (byte-identical checkpoints) -> scoreable model;
+* feature extraction parity (vectorized matrix vs the O(1) recorder row)
+  and the bundle schemaVersion contract the corpus builder relies on.
+"""
+
+import json
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+from jobset_tpu.api import FailurePolicy, keys
+from jobset_tpu.chaos import FaultInjector, pod_crash_burst, policy_inference_faults
+from jobset_tpu.client import JobSetClient
+from jobset_tpu.core import features as gates
+from jobset_tpu.core import make_cluster, metrics
+from jobset_tpu.obs.bundle import (
+    BUNDLE_SCHEMA_VERSION,
+    load_bundle,
+    write_bundle,
+)
+from jobset_tpu.placement.provider import GreedyPlacement, SolverPlacement
+from jobset_tpu.policy import features as pf
+from jobset_tpu.policy.dataset import build_dataset, discover_bundles
+from jobset_tpu.policy.model import (
+    CheckpointError,
+    load_checkpoint,
+    save_checkpoint,
+    score,
+)
+from jobset_tpu.policy.placer import LearnedPlacement
+from jobset_tpu.policy.train import train
+from jobset_tpu.server import ControllerServer
+from jobset_tpu.testing import make_jobset, make_replicated_job
+
+pytestmark = pytest.mark.policy
+
+TOPOLOGY = "tpu-slice"
+
+
+def exclusive_jobset(name, replicas=2, pods_per_job=2, max_restarts=4):
+    return (
+        make_jobset(name)
+        .exclusive_placement(TOPOLOGY)
+        .failure_policy(FailurePolicy(max_restarts=max_restarts))
+        .replicated_job(
+            make_replicated_job("w").replicas(replicas)
+            .parallelism(pods_per_job).completions(pods_per_job).obj()
+        )
+        .obj()
+    )
+
+
+def build_cluster(placement=None, domains=10, nodes_per_domain=2, capacity=8):
+    cluster = make_cluster(placement=placement)
+    cluster.add_topology(
+        TOPOLOGY, num_domains=domains,
+        nodes_per_domain=nodes_per_domain, capacity=capacity,
+    )
+    return cluster
+
+
+def event_stream(cluster) -> str:
+    return "\n".join(
+        f"{e.time:.6f}|{e.object_kind}|{e.object_name}|{e.type}"
+        f"|{e.reason}|{e.message}"
+        for e in cluster.events
+    )
+
+
+# ---------------------------------------------------------------------------
+# Corpus + checkpoint fixtures (one capture serves the whole module)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def corpus_bundle(tmp_path_factory):
+    """A real debug bundle from a seeded solver-placed run with a crash
+    burst — the training corpus every other fixture derives from."""
+    path = str(tmp_path_factory.mktemp("corpus") / "bundle.tgz")
+    metrics.reset()
+    with gates.gate("TPUPlacementSolver", True):
+        cluster = build_cluster(domains=12)
+        server = ControllerServer(cluster=cluster, tick_interval=30.0).start()
+        try:
+            client = JobSetClient(f"http://{server.address}")
+            for i in range(6):
+                js = exclusive_jobset(f"corp-{i}")
+                # backoffLimit 0: the crash burst escalates to gang
+                # restarts, so the corpus carries RESTART placements (the
+                # restart-attribution signal) alongside initial ones.
+                for rjob in js.spec.replicated_jobs:
+                    rjob.template.spec.backoff_limit = 0
+                client.create(js)
+            server.pump()
+            cluster.run_until_stable()
+            injector = FaultInjector(seed=5)
+            with server.lock:
+                pod_crash_burst(cluster, injector, rate=0.3)
+            cluster.run_until_stable()
+            write_bundle(client, path)
+        finally:
+            server.stop()
+    return path
+
+
+@pytest.fixture(scope="module")
+def checkpoint(corpus_bundle, tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("ckpt") / "policy.npz")
+    dataset = build_dataset([corpus_bundle])
+    model, _ = train(dataset, seed=0, epochs=40)
+    save_checkpoint(path, model)
+    return path
+
+
+# ---------------------------------------------------------------------------
+# Shared provider contract
+# ---------------------------------------------------------------------------
+
+
+def _providers(checkpoint):
+    return {
+        "greedy": (GreedyPlacement(), ()),
+        "solver": (SolverPlacement(), ("TPUPlacementSolver",)),
+        "learned-shadow": (
+            LearnedPlacement(checkpoint_path=checkpoint, mode="shadow",
+                             score_backend="numpy"),
+            ("TPUPlacementSolver", "TPULearnedPlacer"),
+        ),
+        "learned-active": (
+            LearnedPlacement(checkpoint_path=checkpoint, mode="active",
+                             score_backend="numpy"),
+            ("TPUPlacementSolver", "TPULearnedPlacer"),
+        ),
+    }
+
+
+@pytest.mark.parametrize(
+    "provider_key",
+    ["greedy", "solver", "learned-shadow", "learned-active"],
+)
+def test_provider_contract(provider_key, checkpoint):
+    """Invariants EVERY placement provider must hold, so future providers
+    cannot silently diverge: all pods of a gang placed (or none), an
+    exclusive domain never hosts two job keys, restarts recover fully,
+    and forget() releases any cached plan."""
+    import contextlib
+
+    metrics.reset()
+    provider, needed_gates = _providers(checkpoint)[provider_key]
+    with contextlib.ExitStack() as stack:
+        for g in needed_gates:
+            stack.enter_context(gates.gate(g, True))
+        cluster = build_cluster(placement=provider)
+        for i in range(4):
+            cluster.create_jobset(exclusive_jobset(f"c-{i}"))
+        cluster.run_until_stable()
+
+        def assert_invariants():
+            # Every gang fully placed: all 4*2*2 pods bound.
+            bound = [
+                p for p in cluster.pods.values()
+                if p.status.phase in ("Pending", "Running")
+            ]
+            assert len(bound) == 16
+            assert all(p.spec.node_name for p in bound), (
+                provider_key, [p.metadata.name for p in bound
+                               if not p.spec.node_name],
+            )
+            # Exclusivity: one job key per domain.
+            per_domain = {}
+            for p in bound:
+                node = cluster.nodes[p.spec.node_name]
+                per_domain.setdefault(
+                    node.labels[TOPOLOGY], set()
+                ).add(p.labels[keys.JOB_KEY])
+            assert all(len(ks) == 1 for ks in per_domain.values()), per_domain
+
+        assert_invariants()
+
+        # Gang restart (node failure) recovers to the same invariants.
+        victim = next(
+            p.spec.node_name for p in cluster.pods.values() if p.spec.node_name
+        )
+        assert cluster.fail_node(victim)
+        cluster.run_until_stable()
+        assert_invariants()
+
+        # forget() drops any cached plan state for a deleted JobSet.
+        js = cluster.get_jobset("default", "c-0")
+        uid = js.metadata.uid
+        cluster.delete_jobset("default", "c-0")
+        cluster.run_until_stable()
+        if hasattr(provider, "_plans"):
+            assert uid not in provider._plans
+        # ... and its domains are released for a newcomer.
+        cluster.create_jobset(exclusive_jobset("c-new"))
+        cluster.run_until_stable()
+        assert_invariants()
+
+
+# ---------------------------------------------------------------------------
+# Shadow mode
+# ---------------------------------------------------------------------------
+
+
+def _seeded_trace(placement, crash_seed=9):
+    metrics.reset()
+    cluster = build_cluster(placement=placement, domains=12)
+    for i in range(5):
+        cluster.create_jobset(exclusive_jobset(f"t-{i}"))
+    cluster.run_until_stable()
+    injector = FaultInjector(seed=crash_seed)
+    pod_crash_burst(cluster, injector, rate=0.3)
+    cluster.run_until_stable()
+    return cluster
+
+
+def test_shadow_mode_is_decision_transparent(checkpoint):
+    """With TPULearnedPlacer on in shadow, the end-to-end event stream is
+    byte-identical to a solver-only run, while regret and decision
+    metrics populate (the acceptance criterion verbatim)."""
+    with gates.gate("TPUPlacementSolver", True):
+        solver_cluster = _seeded_trace(SolverPlacement())
+        solver_events = event_stream(solver_cluster)
+        with gates.gate("TPULearnedPlacer", True):
+            shadow_cluster = _seeded_trace(
+                LearnedPlacement(checkpoint_path=checkpoint, mode="shadow",
+                                 score_backend="numpy")
+            )
+            shadow_events = event_stream(shadow_cluster)
+            regret_n = metrics.policy_regret.n
+            decisions = metrics.policy_decisions_total.value("shadow")
+    assert shadow_events == solver_events
+    assert regret_n > 0
+    assert decisions == regret_n
+    # Shadow also must not perturb the recorded decisions: same
+    # (job, domain) placements in both runs.
+    def placements(cluster):
+        return sorted(
+            (p["job"], p["domain"])
+            for r in cluster.slo.records.values()
+            for p in r["placements"]
+        )
+    assert placements(shadow_cluster) == placements(solver_cluster)
+
+
+def test_shadow_without_gate_scores_nothing(checkpoint):
+    with gates.gate("TPUPlacementSolver", True):
+        _seeded_trace(
+            LearnedPlacement(checkpoint_path=checkpoint, mode="shadow",
+                             score_backend="numpy")
+        )
+        assert metrics.policy_regret.n == 0
+        assert metrics.policy_decisions_total.total() == 0
+
+
+# ---------------------------------------------------------------------------
+# Active mode: fallback safety
+# ---------------------------------------------------------------------------
+
+
+def _assert_fully_placed(cluster, expected_pods):
+    bound = [
+        p for p in cluster.pods.values()
+        if p.status.phase in ("Pending", "Running")
+    ]
+    assert len(bound) == expected_pods
+    assert all(p.spec.node_name for p in bound)
+
+
+def test_active_mode_places_from_the_model(checkpoint):
+    with gates.gate("TPUPlacementSolver", True), \
+            gates.gate("TPULearnedPlacer", True):
+        cluster = _seeded_trace(
+            LearnedPlacement(checkpoint_path=checkpoint, mode="active",
+                             score_backend="numpy")
+        )
+        _assert_fully_placed(cluster, 20)
+        assert metrics.policy_decisions_total.value("active") > 0
+        # Decisions were recorded with the learned source (flywheel keeps
+        # feeding itself in active mode).
+        sources = {
+            p["source"]
+            for r in cluster.slo.records.values()
+            for p in r["placements"]
+        }
+        assert "learned" in sources
+
+
+@pytest.mark.parametrize(
+    "ckpt_kind,reason",
+    [("missing", "checkpoint_missing"), ("corrupt", "checkpoint_corrupt")],
+)
+def test_active_mode_bad_checkpoint_falls_back(tmp_path, ckpt_kind, reason):
+    """A gang must NEVER be stranded by a bad checkpoint: placement falls
+    back to the auction solver and the reason is counted."""
+    if ckpt_kind == "missing":
+        path = str(tmp_path / "nope.npz")
+    else:
+        path = str(tmp_path / "bad.npz")
+        with open(path, "wb") as f:
+            f.write(b"definitely not an npz archive")
+    with gates.gate("TPUPlacementSolver", True), \
+            gates.gate("TPULearnedPlacer", True):
+        cluster = _seeded_trace(
+            LearnedPlacement(checkpoint_path=path, mode="active")
+        )
+        _assert_fully_placed(cluster, 20)
+        assert metrics.policy_fallbacks_total.value(reason) > 0
+        assert metrics.policy_model_loaded.value() == 0
+
+
+def test_active_mode_low_confidence_falls_back(checkpoint):
+    """An absurd confidence margin sends every gang to the solver."""
+    with gates.gate("TPUPlacementSolver", True), \
+            gates.gate("TPULearnedPlacer", True):
+        cluster = _seeded_trace(
+            LearnedPlacement(
+                checkpoint_path=checkpoint, mode="active",
+                confidence_margin=1e9, score_backend="numpy",
+            )
+        )
+        _assert_fully_placed(cluster, 20)
+        assert metrics.policy_fallbacks_total.value("low_confidence") > 0
+        assert metrics.policy_decisions_total.value("active") == 0
+
+
+@pytest.mark.chaos
+def test_active_mode_chaos_sweep_never_strands_a_gang(checkpoint):
+    """The ISSUE's chaos acceptance: `policy.inference` faults at ANY
+    rate degrade active mode to the solver with zero lost or mis-placed
+    gangs — and at full injection every decision is a counted fallback."""
+    results = policy_inference_faults(
+        checkpoint, rates=(0.0, 0.5, 1.0), jobsets=4, domains=10,
+    )
+    assert [r["rate"] for r in results] == [0.0, 0.5, 1.0]
+    for r in results:
+        assert r["unplaced_gangs"] == 0, r
+        assert r["double_booked_domains"] == 0, r
+        assert r["pods_bound"] == r["pods_expected"], r
+        if r["rate"] == 0.0:
+            assert r["faults_injected"] == 0 and r["fallbacks"] == 0
+        else:
+            assert r["fallbacks"] == r["faults_injected"] > 0, r
+    assert results[-1]["decisions_active"] == 0  # rate 1.0: all fallback
+
+
+def test_chaos_latency_fault_is_absorbed(checkpoint):
+    """A latency fault at policy.inference delays, never degrades: the
+    decision still lands (consult() sleeps and reports no fault)."""
+    injector = FaultInjector(seed=3)
+    injector.add_rule("policy.inference", "latency", rate=1.0, delay_s=0.0)
+    with gates.gate("TPUPlacementSolver", True), \
+            gates.gate("TPULearnedPlacer", True):
+        metrics.reset()
+        cluster = build_cluster(
+            placement=LearnedPlacement(
+                checkpoint_path=checkpoint, mode="active",
+                injector=injector, score_backend="numpy",
+            )
+        )
+        cluster.create_jobset(exclusive_jobset("lat"))
+        cluster.run_until_stable()
+        _assert_fully_placed(cluster, 4)
+        assert metrics.policy_fallbacks_total.total() == 0
+        assert metrics.policy_decisions_total.value("active") > 0
+
+
+# ---------------------------------------------------------------------------
+# Data flywheel: bundle -> dataset -> train -> checkpoint
+# ---------------------------------------------------------------------------
+
+
+def test_bundle_timelines_carry_placement_decisions(corpus_bundle):
+    bundle = load_bundle(corpus_bundle)
+    assert bundle["manifest.json"]["schemaVersion"] == BUNDLE_SCHEMA_VERSION
+    placements = [
+        p
+        for timeline in bundle["timelines.json"].values()
+        for p in timeline["placements"]
+    ]
+    assert placements
+    for p in placements:
+        assert len(p["features"]) == pf.FEATURE_DIM
+        assert p["domain"].startswith("domain-")
+        assert p["source"] == "solver"
+        # hist columns are zero at record time (the dataset fills them).
+        assert p["features"][pf.HIST_MEAN_IDX] == 0.0
+        assert p["features"][pf.HIST_RESTART_IDX] == 0.0
+
+
+def test_dataset_builder_joins_decisions_with_outcomes(corpus_bundle):
+    dataset = build_dataset([corpus_bundle])
+    assert len(dataset) > 0
+    assert dataset.features.shape == (len(dataset), pf.FEATURE_DIM)
+    assert dataset.meta["decisions"] >= dataset.meta["examples"]
+    assert len(dataset.history) > 0
+    # The corpus builder filled the historical columns from aggregates;
+    # the crash burst restarted at least one gang, so some domain carries
+    # a restart rate.
+    hist_cols = dataset.features[:, pf.HIST_RESTART_IDX]
+    assert dataset.history.to_arrays()[1][:, 2].sum() > 0 or hist_cols.any()
+
+
+def test_hist_mean_outcome_is_leave_one_out():
+    """The training feature must not leak its row's own label: a domain
+    with one sample contributes 0, and with two samples each row sees
+    only the OTHER sample's outcome."""
+    h = pf.DomainHistory()
+    h.record_decision("d-1", 5.0)
+    assert h.mean_outcome("d-1") == 5.0            # inference-time mean
+    assert h.mean_outcome_excluding("d-1", 5.0) == 0.0  # training row
+    h.record_decision("d-1", 3.0)
+    assert h.mean_outcome_excluding("d-1", 5.0) == 3.0
+    assert h.mean_outcome_excluding("d-1", 3.0) == 5.0
+    assert h.mean_outcome_excluding("d-never", 1.0) == 0.0
+
+
+def test_training_is_seeded_deterministic(corpus_bundle, tmp_path):
+    """Two `policy train` runs on the same corpus with the same seed
+    produce BYTE-identical checkpoints (the CI determinism gate)."""
+    out_a, out_b = str(tmp_path / "a.npz"), str(tmp_path / "b.npz")
+    for out in (out_a, out_b):
+        model, _ = train(build_dataset([corpus_bundle]), seed=7, epochs=25)
+        save_checkpoint(out, model)
+    with open(out_a, "rb") as fa, open(out_b, "rb") as fb:
+        assert fa.read() == fb.read()
+    # ... and a different seed produces a different model.
+    model, _ = train(build_dataset([corpus_bundle]), seed=8, epochs=25)
+    save_checkpoint(str(tmp_path / "c.npz"), model)
+    with open(out_a, "rb") as fa, open(str(tmp_path / "c.npz"), "rb") as fc:
+        assert fa.read() != fc.read()
+
+
+def test_checkpoint_round_trip_and_score_parity(checkpoint, corpus_bundle):
+    model = load_checkpoint(checkpoint)
+    dataset = build_dataset([corpus_bundle])
+    feats = dataset.features[: min(9, len(dataset))]
+    jax_scores = score(model, feats, backend="jax")
+    np_scores = score(model, feats, backend="numpy")
+    assert np.allclose(jax_scores, np_scores, atol=1e-4)
+    assert model.meta["seed"] == 0
+    assert model.meta["featureNames"] == list(pf.FEATURE_NAMES)
+
+
+def test_corrupt_checkpoint_raises_checkpoint_error(tmp_path):
+    path = str(tmp_path / "x.npz")
+    with open(path, "wb") as f:
+        f.write(b"garbage")
+    with pytest.raises(CheckpointError):
+        load_checkpoint(path)
+    with pytest.raises(CheckpointError):
+        load_checkpoint(str(tmp_path / "missing.npz"))
+    # Valid zip, wrong contents.
+    bad = str(tmp_path / "y.npz")
+    np.savez(bad, nonsense=np.zeros(3))
+    with pytest.raises(CheckpointError):
+        load_checkpoint(bad)
+
+
+def test_policy_train_cli(corpus_bundle, tmp_path, capsys):
+    from jobset_tpu.cli import main
+
+    out = str(tmp_path / "cli.npz")
+    rc = main([
+        "policy", "train", "--bundles", corpus_bundle, "--out", out,
+        "--seed", "3", "--epochs", "10",
+    ])
+    assert rc == 0
+    summary = json.loads(capsys.readouterr().out)
+    assert summary["checkpoint"] == out
+    assert summary["examples"] > 0
+    load_checkpoint(out)  # valid, parseable
+
+    # Empty corpus dir errors cleanly (exit 1, message on stderr).
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    rc = main([
+        "policy", "train", "--bundles", str(empty), "--out", out,
+    ])
+    assert rc == 1
+
+    # A corrupt bundle archive errors cleanly too (no raw tarfile
+    # traceback).
+    corrupt = tmp_path / "corrupt"
+    corrupt.mkdir()
+    (corrupt / "bad.tgz").write_bytes(b"not a gzip tarball")
+    rc = main([
+        "policy", "train", "--bundles", str(corrupt), "--out", out,
+    ])
+    assert rc == 1
+    assert "policy train:" in capsys.readouterr().err
+
+
+@pytest.mark.slow
+def test_training_soak_reduces_loss(corpus_bundle):
+    """Longer training strictly improves fit on the corpus (the soak is
+    slow-marked; tier-1 never pays for it)."""
+    dataset = build_dataset([corpus_bundle])
+    _, short = train(dataset, seed=0, epochs=5)
+    _, long_ = train(dataset, seed=0, epochs=400)
+    assert long_["lossFinal"] <= short["lossFinal"]
+
+
+# ---------------------------------------------------------------------------
+# Feature extraction + satellites
+# ---------------------------------------------------------------------------
+
+
+def test_feature_row_matches_feature_matrix():
+    """The O(1) recorder path and the vectorized scorer path implement
+    the same schema — parity cell by cell."""
+    with gates.gate("TPUPlacementSolver", True):
+        cluster = build_cluster(domains=6)
+        cluster.create_jobset(exclusive_jobset("par"))
+        cluster.run_until_stable()
+    js = cluster.get_jobset("default", "par")
+    view = pf.domain_view(cluster, TOPOLOGY)
+    gang = pf.gang_context(cluster, js)
+    job = next(iter(cluster.jobs.values()))
+    job_key = job.labels.get(keys.JOB_KEY, "")
+    sticky = cluster.placement_history.get(job_key)
+    history = pf.DomainHistory()
+    history.record_decision("domain-2", 3.5)
+    history.record_restart("domain-2")
+    matrix = pf.feature_matrix(
+        view, job_key, job.pods_expected(), gang,
+        sticky_domain=sticky, history=history,
+    )
+    for d, value in enumerate(view.values):
+        row = pf.feature_row(
+            view, job_key, job.pods_expected(), gang, value,
+            sticky_domain=sticky, history=history,
+        )
+        assert row is not None
+        assert np.allclose(matrix[d], np.array(row, np.float32), atol=1e-5), (
+            value, matrix[d], row,
+        )
+    assert pf.feature_row(
+        view, job_key, 1, gang, "no-such-domain"
+    ) is None
+
+
+def test_unknown_feature_gate_lists_known_gates():
+    with pytest.raises(KeyError) as exc:
+        gates.enabled("TPULearnedPlacerTypo")
+    msg = str(exc.value)
+    assert "TPULearnedPlacer" in msg and "TPUPlacementSolver" in msg
+    with pytest.raises(KeyError) as exc:
+        gates.set_from_string("NoSuchGate=true")
+    assert "known gates" in str(exc.value)
+
+
+def test_bundle_rejects_unknown_schema_major(corpus_bundle, tmp_path):
+    """The corpus builder's stable-contract satellite: a bundle stamped
+    with a future major version is rejected with a clear error; a
+    pre-stamp bundle (no schemaVersion) still loads as 1.0."""
+    import io
+
+    def rewrite(version, out):
+        bundle = load_bundle(corpus_bundle)
+        manifest = bundle["manifest.json"]
+        if version is None:
+            manifest.pop("schemaVersion", None)
+        else:
+            manifest["schemaVersion"] = version
+        with tarfile.open(out, "w:gz") as tar:
+            for member, payload in bundle.items():
+                data = (
+                    payload.encode() if isinstance(payload, str)
+                    else json.dumps(payload).encode()
+                )
+                info = tarfile.TarInfo(member)
+                info.size = len(data)
+                tar.addfile(info, io.BytesIO(data))
+
+    future = str(tmp_path / "future.tgz")
+    rewrite("2.0", future)
+    with pytest.raises(ValueError, match="schemaVersion 2.0"):
+        load_bundle(future)
+
+    legacy = str(tmp_path / "legacy.tgz")
+    rewrite(None, legacy)
+    assert load_bundle(legacy)["manifest.json"].get("schemaVersion") is None
+
+
+def test_health_reports_policy_component(checkpoint):
+    with gates.gate("TPUPlacementSolver", True), \
+            gates.gate("TPULearnedPlacer", True):
+        metrics.reset()
+        cluster = build_cluster(
+            placement=LearnedPlacement(
+                checkpoint_path=checkpoint, mode="shadow",
+                score_backend="numpy",
+            )
+        )
+        server = ControllerServer(cluster=cluster, tick_interval=30.0).start()
+        try:
+            client = JobSetClient(f"http://{server.address}")
+            health = client.health()
+            policy = health["components"]["policy"]
+            assert policy["enabled"] and policy["healthy"]
+            assert policy["mode"] == "shadow"
+            assert policy["modelLoaded"] is True
+            assert policy["gate"] is True
+        finally:
+            server.stop()
+
+    # Active mode with a missing checkpoint degrades the verdict.
+    with gates.gate("TPULearnedPlacer", True):
+        cluster = build_cluster(
+            placement=LearnedPlacement(
+                checkpoint_path="/no/such.npz", mode="active",
+            )
+        )
+        server = ControllerServer(cluster=cluster, tick_interval=30.0).start()
+        try:
+            client = JobSetClient(f"http://{server.address}")
+            health = client.health()
+            policy = health["components"]["policy"]
+            assert policy["enabled"] and not policy["healthy"]
+            assert policy["modelError"] == "checkpoint_missing"
+            assert health["status"] == "degraded"
+        finally:
+            server.stop()
+
+
+def test_discover_bundles(tmp_path, corpus_bundle):
+    d = tmp_path / "corpus"
+    d.mkdir()
+    for name in ("b2.tgz", "b1.tgz", "ignore.txt"):
+        (d / name).write_bytes(b"")
+    found = discover_bundles(str(d))
+    assert [os.path.basename(p) for p in found] == ["b1.tgz", "b2.tgz"]
+    assert discover_bundles(corpus_bundle) == [corpus_bundle]

@@ -427,7 +427,7 @@ def test_debug_health_verdict_and_degradation(server, client):
     health = client.health()
     assert health["status"] == "healthy"
     assert set(health["components"]) == {
-        "leaderElection", "replication", "solver", "store", "queue",
+        "leaderElection", "replication", "solver", "policy", "store", "queue",
         "pump", "chaos",
     }
     assert health["components"]["store"]["enabled"] is False
