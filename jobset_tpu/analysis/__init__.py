@@ -1,0 +1,31 @@
+"""Invariant lint plane: AST-based static analysis of the repo's own
+contracts (docs/static-analysis.md).
+
+Every plane stakes its correctness on hand-enforced invariants — seeded
+byte-identical runs, fsync-before-ack, compile-once pow2-bucketed jit
+kernels, lock-guarded shared state — and review keeps catching violations
+of exactly these rules. This package turns those tribal contracts into a
+machine-checked pass, the role tsan/race-detector wiring plays in the Go
+reference:
+
+* ``engine.py``  — per-file ``ast`` walk, rule registry, inline
+  ``# jslint: disable=RULE reason`` suppressions, a checked-in baseline
+  for grandfathered findings, stable ``RULE file:line message`` output;
+* ``rules/``     — the project-specific rules (determinism, lock
+  discipline, jit hygiene, durability ordering, registry/doc drift).
+
+Entry points: ``jobset-tpu lint [PATHS]`` (CLI), ``tests/test_lint.py``
+(tier-1 gate: the tree must stay lint-clean), and ``lint_stats()``
+(the debug-bundle manifest block).
+"""
+
+from .engine import (  # noqa: F401
+    Finding,
+    LintEngine,
+    Report,
+    default_baseline_path,
+    find_repo_root,
+    lint_stats,
+    rewrite_baseline,
+    run_lint,
+)

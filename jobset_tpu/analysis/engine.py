@@ -1,0 +1,404 @@
+"""The lint engine: file walking, AST parsing, suppressions, baselines.
+
+A *rule* is an object with a ``NAME`` (stable id like ``DET001``), a one-
+line ``DESCRIPTION``, and one or both hooks:
+
+* ``check_module(ctx)``  — called once per parsed ``.py`` file with a
+  :class:`ModuleContext`; yields :class:`Finding`s.
+* ``check_project(root)`` — called once per run with the repo root;
+  yields findings for cross-file contracts (registry/doc drift).
+
+Findings pass through two suppression layers before they are *visible*:
+
+1. **Inline**: ``# jslint: disable=RULE[,RULE2] reason`` on the flagged
+   line or the line directly above it. The reason is mandatory — a bare
+   disable is itself a finding (``SUP001``) so suppressions stay honest.
+2. **Baseline**: a checked-in file of ``RULE path:line`` entries for
+   grandfathered findings (``lint-baseline.txt`` at the repo root by
+   default; regenerate with ``jobset-tpu lint --update-baseline``).
+
+Output is stable and diff-friendly: one ``RULE path:line message`` line
+per visible finding, sorted by (path, line, rule). ``--format github``
+emits ``::error`` workflow annotations instead.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import pathlib
+import re
+from dataclasses import dataclass, field
+from typing import Iterable, Iterator, Optional
+
+# -- suppression comment grammar --------------------------------------------
+
+# `# jslint: disable=DET001 exemplar timestamps are wall-clock by spec`
+# `# jslint: disable=DET001,DET002 reason covering both`
+_SUPPRESS_RE = re.compile(
+    r"#\s*jslint:\s*disable=([A-Z0-9_]+(?:\s*,\s*[A-Z0-9_]+)*)\s*(.*)"
+)
+
+
+def dotted_name(node: ast.AST) -> str:
+    """Best-effort dotted name of an attribute chain ('time.time',
+    'np.random.default_rng', 'self.wal.append', ...); '' when the head is
+    not a plain Name. Shared by every rule that matches call shapes."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+@dataclass
+class Finding:
+    """One rule violation at one location."""
+
+    rule: str
+    path: str  # repo-root-relative, posix separators
+    line: int
+    message: str
+    # Filled by the engine: "" (visible), "inline" or "baseline".
+    suppressed_by: str = ""
+    suppress_reason: str = ""
+
+    def key(self) -> str:
+        """The baseline entry / dedup key."""
+        return f"{self.rule} {self.path}:{self.line}"
+
+    def render(self, fmt: str = "text") -> str:
+        if fmt == "github":
+            return (
+                f"::error file={self.path},line={self.line}::"
+                f"{self.rule} {self.message}"
+            )
+        return f"{self.rule} {self.path}:{self.line} {self.message}"
+
+
+@dataclass
+class ModuleContext:
+    """Everything a per-file rule sees for one parsed module."""
+
+    path: pathlib.Path
+    relpath: str  # posix, relative to the repo root ("jobset_tpu/ha/...")
+    tree: ast.Module
+    source: str
+    lines: list[str] = field(default_factory=list)
+
+    def plane(self) -> str:
+        """The package subdirectory this module lives in ("core", "ha",
+        ...; "" for top-level modules like server.py). The package
+        component is located anywhere in the path, not just at the root,
+        so fixture mini-repos (tests/fixtures/lint/<case>/jobset_tpu/...)
+        scope the same way the real tree does."""
+        parts = pathlib.PurePosixPath(self.relpath).parts
+        for i, part in enumerate(parts):
+            if part == "jobset_tpu" and i + 2 < len(parts):
+                return parts[i + 1]
+        return ""
+
+
+# -- rule registry -----------------------------------------------------------
+
+_RULES: dict[str, object] = {}
+
+
+def register(rule_cls):
+    """Class decorator: instantiate and register a rule by its NAME."""
+    rule = rule_cls()
+    name = getattr(rule, "NAME", None)
+    if not name:
+        raise ValueError(f"rule {rule_cls!r} has no NAME")
+    _RULES[name] = rule
+    return rule_cls
+
+
+def all_rules() -> dict[str, object]:
+    """name -> rule instance, with the rules package imported (rules
+    self-register at import)."""
+    from . import rules  # noqa: F401  (registration side effect)
+
+    return dict(_RULES)
+
+
+# -- roots and defaults ------------------------------------------------------
+
+
+def find_repo_root(start: Optional[pathlib.Path] = None) -> pathlib.Path:
+    """Walk up from `start` to the checkout root (pyproject.toml marker);
+    fall back to the parent of the installed jobset_tpu package."""
+    probe = (start or pathlib.Path(__file__)).resolve()
+    if probe.is_file():
+        probe = probe.parent
+    for candidate in (probe, *probe.parents):
+        if (candidate / "pyproject.toml").exists():
+            return candidate
+    return pathlib.Path(__file__).resolve().parents[2]
+
+
+def default_baseline_path(root: Optional[pathlib.Path] = None) -> pathlib.Path:
+    return (root or find_repo_root()) / "lint-baseline.txt"
+
+
+def load_baseline(path) -> set[str]:
+    """Baseline file -> set of `RULE path:line` keys. Missing file = empty
+    baseline; blank lines and `#` comments are ignored."""
+    p = pathlib.Path(path)
+    if not p.exists():
+        return set()
+    keys: set[str] = set()
+    for raw in p.read_text().splitlines():
+        entry = raw.strip()
+        if entry and not entry.startswith("#"):
+            keys.add(entry)
+    return keys
+
+
+# -- the engine --------------------------------------------------------------
+
+
+@dataclass
+class Report:
+    findings: list[Finding] = field(default_factory=list)
+
+    @property
+    def visible(self) -> list[Finding]:
+        return [f for f in self.findings if not f.suppressed_by]
+
+    @property
+    def suppressed(self) -> list[Finding]:
+        return [f for f in self.findings if f.suppressed_by]
+
+    def stats(self) -> dict:
+        """Per-rule visible/suppressed counts — the lint-debt block the
+        debug bundle manifests (docs/static-analysis.md)."""
+        per_rule: dict[str, dict[str, int]] = {}
+        for f in self.findings:
+            row = per_rule.setdefault(
+                f.rule, {"visible": 0, "inline": 0, "baseline": 0}
+            )
+            row["visible" if not f.suppressed_by else f.suppressed_by] += 1
+        return {
+            "visible": len(self.visible),
+            "suppressed": len(self.suppressed),
+            "perRule": {k: per_rule[k] for k in sorted(per_rule)},
+        }
+
+    def render(self, fmt: str = "text") -> str:
+        return "\n".join(f.render(fmt) for f in self.visible)
+
+
+class LintEngine:
+    def __init__(
+        self,
+        rules: Optional[dict[str, object]] = None,
+        baseline: Optional[Iterable[str]] = None,
+        root: Optional[pathlib.Path] = None,
+    ):
+        self.rules = dict(rules) if rules is not None else all_rules()
+        self.baseline = set(baseline or ())
+        self.root = pathlib.Path(root).resolve() if root else None
+
+    # -- file discovery ---------------------------------------------------
+
+    @staticmethod
+    def _iter_py_files(paths: Iterable) -> Iterator[pathlib.Path]:
+        for path in paths:
+            p = pathlib.Path(path)
+            if p.is_dir():
+                yield from sorted(
+                    f for f in p.rglob("*.py")
+                    if "__pycache__" not in f.parts
+                )
+            elif p.suffix == ".py":
+                yield p
+
+    def _relpath(self, path: pathlib.Path, root: pathlib.Path) -> str:
+        try:
+            rel = path.resolve().relative_to(root)
+        except ValueError:
+            rel = pathlib.Path(os.path.relpath(path.resolve(), root))
+        return rel.as_posix()
+
+    # -- suppression ------------------------------------------------------
+
+    def _suppressions(
+        self, ctx: ModuleContext
+    ) -> tuple[dict[int, tuple[set[str], str]], list[Finding]]:
+        """Per-line inline suppressions. A disable on line N covers
+        findings on N and N+1 (comment-above style). Returns the map and
+        the SUP001 findings for disables with no stated reason."""
+        covered: dict[int, tuple[set[str], str]] = {}
+        bare: list[Finding] = []
+        for i, text in enumerate(ctx.lines, start=1):
+            m = _SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            names = {n.strip() for n in m.group(1).split(",")}
+            reason = m.group(2).strip()
+            if not reason:
+                bare.append(Finding(
+                    rule="SUP001", path=ctx.relpath, line=i,
+                    message=(
+                        "suppression without a reason — state why, e.g. "
+                        "`# jslint: disable=RULE <why this is sanctioned>`"
+                    ),
+                ))
+            for line in (i, i + 1):
+                prev = covered.get(line)
+                if prev:
+                    covered[line] = (prev[0] | names, prev[1] or reason)
+                else:
+                    covered[line] = (set(names), reason)
+        return covered, bare
+
+    # -- run --------------------------------------------------------------
+
+    def run(self, paths: Iterable) -> Report:
+        files = list(self._iter_py_files(paths))
+        root = self.root or find_repo_root(
+            files[0] if files else pathlib.Path.cwd()
+        )
+        findings: list[Finding] = []
+        suppress_maps: dict[str, dict[int, tuple[set[str], str]]] = {}
+
+        for path in files:
+            try:
+                source = path.read_text()
+                tree = ast.parse(source, filename=str(path))
+            except SyntaxError as exc:
+                findings.append(Finding(
+                    rule="SYN001",
+                    path=self._relpath(path, root),
+                    line=exc.lineno or 1,
+                    message=f"file does not parse: {exc.msg}",
+                ))
+                continue
+            except (OSError, UnicodeDecodeError) as exc:
+                # One unreadable file must not abort the whole run — the
+                # engine's contract is that broken inputs surface as
+                # findings, never as a crashed gate.
+                findings.append(Finding(
+                    rule="SYN001",
+                    path=self._relpath(path, root),
+                    line=1,
+                    message=f"file cannot be read as UTF-8 source: {exc}",
+                ))
+                continue
+            ctx = ModuleContext(
+                path=path,
+                relpath=self._relpath(path, root),
+                tree=tree,
+                source=source,
+                lines=source.splitlines(),
+            )
+            covered, bare = self._suppressions(ctx)
+            suppress_maps[ctx.relpath] = covered
+            findings.extend(bare)
+            for rule in self.rules.values():
+                check = getattr(rule, "check_module", None)
+                if check is not None:
+                    findings.extend(check(ctx))
+
+        for rule in self.rules.values():
+            check = getattr(rule, "check_project", None)
+            if check is not None:
+                findings.extend(check(root))
+
+        # Apply suppression layers. SUP001 itself is baseline-suppressible
+        # but never inline-suppressible (a reasonless disable cannot
+        # excuse itself).
+        for f in findings:
+            if f.rule != "SUP001":
+                names, reason = suppress_maps.get(f.path, {}).get(
+                    f.line, (set(), "")
+                )
+                if f.rule in names:
+                    f.suppressed_by = "inline"
+                    f.suppress_reason = reason
+                    continue
+            if f.key() in self.baseline:
+                f.suppressed_by = "baseline"
+                f.suppress_reason = "baseline entry"
+
+        findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+        return Report(findings=findings)
+
+
+# -- convenience entry points ------------------------------------------------
+
+
+def run_lint(
+    paths: Optional[Iterable] = None,
+    baseline_path=None,
+    root: Optional[pathlib.Path] = None,
+    rules: Optional[dict[str, object]] = None,
+) -> Report:
+    """One-call lint: engine over `paths` (default: the installed
+    jobset_tpu package) with the default checked-in baseline."""
+    root = pathlib.Path(root).resolve() if root else find_repo_root()
+    if paths is None:
+        paths = [pathlib.Path(__file__).resolve().parents[1]]
+    if baseline_path is None:
+        baseline_path = default_baseline_path(root)
+    engine = LintEngine(
+        rules=rules, baseline=load_baseline(baseline_path), root=root
+    )
+    return engine.run(paths)
+
+
+def _entry_path(entry: str) -> str:
+    """The file path of a `RULE path:line` baseline entry."""
+    return entry.split(" ", 1)[-1].rsplit(":", 1)[0]
+
+
+def rewrite_baseline(
+    paths: Optional[Iterable] = None,
+    baseline_path=None,
+    root: Optional[pathlib.Path] = None,
+) -> list[str]:
+    """`--update-baseline`: rewrite the baseline file and return its
+    entries. The lint pass runs with an EMPTY baseline — a grandfathered
+    finding that still fires must stay grandfathered, not be dropped
+    because the old baseline suppressed it out of the visible set. Old
+    entries for module files outside the linted paths are preserved (a
+    subset-path run never wipes entries it did not re-check); entries for
+    project-level rules (cross-file drift) are always regenerated, since
+    those rules run on every pass regardless of paths."""
+    root = pathlib.Path(root).resolve() if root else find_repo_root()
+    if paths is None:
+        paths = [pathlib.Path(__file__).resolve().parents[1]]
+    if baseline_path is None:
+        baseline_path = default_baseline_path(root)
+    engine = LintEngine(baseline=(), root=root)
+    report = engine.run(paths)
+    covered = {
+        engine._relpath(p, root) for p in engine._iter_py_files(paths)
+    }
+    project_rules = {
+        name for name, rule in engine.rules.items()
+        if getattr(rule, "check_project", None) is not None
+    }
+    kept = {
+        entry for entry in load_baseline(baseline_path)
+        if entry.split(" ", 1)[0] not in project_rules
+        and _entry_path(entry) not in covered
+    }
+    entries = sorted(kept | {f.key() for f in report.visible})
+    with open(baseline_path, "w") as f:
+        f.write(
+            "# Grandfathered lint findings (docs/static-analysis.md).\n"
+            "# One `RULE path:line` per entry; shrink, never grow —\n"
+            "# regenerate with `jobset-tpu lint --update-baseline`.\n"
+        )
+        f.writelines(e + "\n" for e in entries)
+    return entries
+
+
+def lint_stats() -> dict:
+    """The debug-bundle manifest block: per-rule finding + suppression
+    counts over the installed package (obs/bundle.py)."""
+    return run_lint().stats()

@@ -1,0 +1,157 @@
+"""Determinism rules: no wall-clock, no global/unseeded RNG in seeded
+planes.
+
+The chaos, store, ha, queue, and policy planes all promise *byte-identical
+seeded runs* (chaos soak logs, crash-recovery replays, policy training
+checkpoints, flight-recorder timelines). Those guarantees die quietly the
+moment a module in one of those planes reads the wall clock or draws from
+the process-global RNG:
+
+* ``time.time()`` / ``datetime.now()`` leak wall-clock into state that a
+  replay is supposed to reproduce — the ``hist_mean_outcome`` label leak
+  was exactly this class of bug;
+* module-level ``random.*`` functions mutate the *shared* global stream,
+  so an unrelated caller perturbs every seeded consumer that forgot to
+  own a private ``random.Random(seed)``.
+
+The sanctioned time source is the injectable clock in ``utils/clock.py``
+(``Clock``/``FakeClock``); the sanctioned RNG shapes are seeded instances:
+``random.Random(seed)``, ``np.random.default_rng(seed)``, and
+``jax.random`` keys. ``time.monotonic``/``perf_counter`` stay legal —
+latency measurement is observability, not decision state.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..engine import Finding, ModuleContext, dotted_name, register
+
+# Package subdirectories that participate in seeded byte-identical runs.
+# obs/ is included deliberately: timelines from seeded chaos runs are
+# byte-identical, so its wall-clock uses must each carry a stated reason.
+SEEDED_PLANES = ("chaos", "core", "ha", "obs", "policy", "queue", "store")
+
+# Wall-clock call shapes: (qualified-call suffix, flagged when argless
+# only?). time.gmtime()/localtime() read the clock only without args.
+_WALL_CALLS = {
+    "time.time": False,
+    "time.time_ns": False,
+    "time.gmtime": True,
+    "time.localtime": True,
+    "datetime.now": False,
+    "datetime.utcnow": False,
+    "datetime.today": False,
+    "date.today": False,
+}
+
+# Module-level `random.<fn>` convenience functions draw from the shared
+# global Mersenne-Twister stream.
+_GLOBAL_RANDOM_FNS = {
+    "betavariate", "choice", "choices", "expovariate", "gauss",
+    "getrandbits", "lognormvariate", "normalvariate", "paretovariate",
+    "randbytes", "randint", "random", "randrange", "sample", "seed",
+    "shuffle", "triangular", "uniform", "vonmisesvariate", "weibullvariate",
+}
+
+# Unconditionally nondeterministic sources.
+_ENTROPY_CALLS = {
+    "os.urandom", "uuid.uuid1", "uuid.uuid4", "secrets.token_bytes",
+    "secrets.token_hex", "secrets.token_urlsafe", "secrets.randbelow",
+}
+
+
+def _in_seeded_plane(ctx: ModuleContext) -> bool:
+    return ctx.plane() in SEEDED_PLANES
+
+
+@register
+class WallClockRule:
+    """DET001: wall-clock reads in seeded planes."""
+
+    NAME = "DET001"
+    DESCRIPTION = (
+        "wall-clock read (time.time/datetime.now/...) in a seeded plane — "
+        "route through utils/clock.py or suppress with a reason"
+    )
+
+    def check_module(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if not _in_seeded_plane(ctx):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            for suffix, argless_only in _WALL_CALLS.items():
+                if name == suffix or name.endswith("." + suffix):
+                    if argless_only and (node.args or node.keywords):
+                        continue
+                    yield Finding(
+                        rule=self.NAME, path=ctx.relpath, line=node.lineno,
+                        message=(
+                            f"{name}() reads the wall clock in seeded "
+                            f"plane '{ctx.plane()}' — inject a "
+                            "utils/clock.py Clock (or suppress with the "
+                            "reason this stamp may be wall-clock)"
+                        ),
+                    )
+                    break
+
+
+@register
+class GlobalRandomRule:
+    """DET002: global-stream / unseeded RNG in seeded planes."""
+
+    NAME = "DET002"
+    DESCRIPTION = (
+        "global or unseeded RNG (random.*, bare random.Random(), "
+        "np.random.*, os.urandom) in a seeded plane"
+    )
+
+    def check_module(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if not _in_seeded_plane(ctx):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            message = None
+            if name in _ENTROPY_CALLS:
+                message = f"{name}() is nondeterministic"
+            elif name.startswith("random.") and name.count(".") == 1:
+                fn = name.split(".", 1)[1]
+                if fn in _GLOBAL_RANDOM_FNS:
+                    message = (
+                        f"{name}() draws from (or mutates) the process-"
+                        "global RNG stream — own a random.Random(seed)"
+                    )
+                elif fn in ("Random", "SystemRandom") and not (
+                    node.args or node.keywords
+                ):
+                    message = (
+                        f"bare {name}() seeds from OS entropy — pass a "
+                        "seed derived from the run's seed"
+                    )
+            elif name.endswith("random.default_rng") and not (
+                node.args or node.keywords
+            ):
+                message = (
+                    "np.random.default_rng() without a seed is "
+                    "nondeterministic"
+                )
+            elif ".random." in name and not name.endswith("default_rng"):
+                # np.random.<dist>/seed legacy global-state API (jax.random
+                # is keyed, never matches: its calls take explicit keys but
+                # also live under names like jax.random.normal — exclude).
+                head, _, fn = name.rpartition(".")
+                if head in ("np.random", "numpy.random"):
+                    message = (
+                        f"{name}() uses numpy's legacy global RNG state — "
+                        "own an np.random.default_rng(seed)"
+                    )
+            if message:
+                yield Finding(
+                    rule=self.NAME, path=ctx.relpath, line=node.lineno,
+                    message=message + f" (seeded plane '{ctx.plane()}')",
+                )

@@ -1,0 +1,315 @@
+"""Registry/doc drift rules: every registered thing has a doc row.
+
+Generalizes the metrics doc-drift lint that used to live only in
+`tests/test_metrics_docs.py` (which now delegates here): registries are
+introspected from the *source*, docs are parsed from their tables, and
+the two may not diverge in either direction.
+
+* **DRF001** — every metric family constructed in ``core/metrics.py``
+  (``Counter/Gauge/Histogram("name", ...)``) has a table row in
+  ``docs/metrics.md``; every documented family still exists.
+* **DRF002** — every feature gate in ``core/features.py::_DEFAULTS`` has
+  a row in the "Feature gates" table of ``docs/concepts.md``; every
+  documented gate still exists.
+* **DRF003** — every chaos injection point consulted at a call site
+  (``injector.check("plane.point")`` / ``chaos.consult(...)`` /
+  ``add_rule(...)`` with a literal point) appears in the point table of
+  ``chaos/injector.py``'s module docstring; every documented point is
+  still consulted somewhere (as a string literal in the package).
+
+All three parse the AST rather than importing the modules, so the rules
+also run against fixture trees and never execute project code.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+from typing import Iterator
+
+from ..engine import Finding, register
+
+_METRIC_CLASSES = ("Counter", "Gauge", "Histogram")
+_POINT_CALLS = ("check", "consult", "add_rule")
+_POINT_RE = re.compile(r"``([a-z_]+\.[a-z_]+)``")
+_DOC_ROW_RE = re.compile(r"^\|\s*`([A-Za-z0-9_]+)`", re.MULTILINE)
+
+
+def _parse(path: pathlib.Path) -> ast.Module | None:
+    try:
+        return ast.parse(path.read_text(), filename=str(path))
+    except (OSError, SyntaxError, UnicodeDecodeError):
+        return None
+
+
+def _rel(path: pathlib.Path, root: pathlib.Path) -> str:
+    try:
+        return path.resolve().relative_to(root.resolve()).as_posix()
+    except ValueError:
+        return path.as_posix()
+
+
+def _doc_rows(path: pathlib.Path) -> dict[str, int]:
+    """Backticked first-column table names -> line number."""
+    if not path.exists():
+        return {}
+    rows: dict[str, int] = {}
+    for i, line in enumerate(path.read_text().splitlines(), start=1):
+        m = _DOC_ROW_RE.match(line)
+        if m:
+            rows.setdefault(m.group(1), i)
+    return rows
+
+
+def _section_rows(path: pathlib.Path, heading: str) -> dict[str, int]:
+    """Table rows inside one `## heading` section."""
+    if not path.exists():
+        return {}
+    rows: dict[str, int] = {}
+    inside = False
+    for i, line in enumerate(path.read_text().splitlines(), start=1):
+        if line.startswith("## "):
+            inside = line[3:].strip().lower() == heading.lower()
+            continue
+        if inside:
+            m = _DOC_ROW_RE.match(line)
+            if m:
+                rows.setdefault(m.group(1), i)
+    return rows
+
+
+# -- DRF001: metric families --------------------------------------------------
+
+
+def registered_metric_families(root: pathlib.Path) -> dict[str, int]:
+    """family name -> line of its Counter/Gauge/Histogram construction in
+    core/metrics.py (static parse of the registry)."""
+    src = root / "jobset_tpu" / "core" / "metrics.py"
+    tree = _parse(src)
+    if tree is None:
+        return {}
+    families: dict[str, int] = {}
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id in _METRIC_CLASSES
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            families.setdefault(node.args[0].value, node.lineno)
+    return families
+
+
+@register
+class MetricsDocDriftRule:
+    NAME = "DRF001"
+    DESCRIPTION = (
+        "metric family registered in core/metrics.py without a "
+        "docs/metrics.md row (or a stale documented family)"
+    )
+
+    def check_project(self, root: pathlib.Path) -> Iterator[Finding]:
+        registered = registered_metric_families(root)
+        if not registered:
+            return
+        docs = root / "docs" / "metrics.md"
+        documented = _doc_rows(docs)
+        for name, line in sorted(registered.items()):
+            if name not in documented:
+                yield Finding(
+                    rule=self.NAME,
+                    path=_rel(
+                        root / "jobset_tpu" / "core" / "metrics.py", root
+                    ),
+                    line=line,
+                    message=(
+                        f"metric family `{name}` has no docs/metrics.md "
+                        "table row — add one (operator-facing reference)"
+                    ),
+                )
+        for name, line in sorted(documented.items()):
+            if name not in registered:
+                yield Finding(
+                    rule=self.NAME, path=_rel(docs, root), line=line,
+                    message=(
+                        f"docs/metrics.md documents `{name}` but no such "
+                        "family is registered in core/metrics.py — stale "
+                        "operator guidance, drop or fix the row"
+                    ),
+                )
+
+
+# -- DRF002: feature gates ----------------------------------------------------
+
+
+def declared_feature_gates(root: pathlib.Path) -> dict[str, int]:
+    """gate name -> line of its _DEFAULTS entry in core/features.py."""
+    src = root / "jobset_tpu" / "core" / "features.py"
+    tree = _parse(src)
+    if tree is None:
+        return {}
+    gates: dict[str, int] = {}
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, (ast.Assign, ast.AnnAssign))
+            and isinstance(getattr(node, "value", None), ast.Dict)
+        ):
+            targets = (
+                node.targets if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            names = {
+                t.id for t in targets if isinstance(t, ast.Name)
+            }
+            if "_DEFAULTS" not in names:
+                continue
+            for key in node.value.keys:
+                if isinstance(key, ast.Constant) and isinstance(
+                    key.value, str
+                ):
+                    gates.setdefault(key.value, key.lineno)
+    return gates
+
+
+@register
+class FeatureGateDocDriftRule:
+    NAME = "DRF002"
+    DESCRIPTION = (
+        "feature gate in core/features.py without a docs/concepts.md "
+        "'Feature gates' table row (or a stale documented gate)"
+    )
+
+    def check_project(self, root: pathlib.Path) -> Iterator[Finding]:
+        declared = declared_feature_gates(root)
+        if not declared:
+            return
+        docs = root / "docs" / "concepts.md"
+        documented = _section_rows(docs, "Feature gates")
+        for name, line in sorted(declared.items()):
+            if name not in documented:
+                yield Finding(
+                    rule=self.NAME,
+                    path=_rel(
+                        root / "jobset_tpu" / "core" / "features.py", root
+                    ),
+                    line=line,
+                    message=(
+                        f"feature gate `{name}` has no row in the "
+                        "'Feature gates' table of docs/concepts.md"
+                    ),
+                )
+        for name, line in sorted(documented.items()):
+            if name not in declared:
+                yield Finding(
+                    rule=self.NAME, path=_rel(docs, root), line=line,
+                    message=(
+                        f"docs/concepts.md documents feature gate "
+                        f"`{name}` but core/features.py does not declare "
+                        "it — stale row"
+                    ),
+                )
+
+
+# -- DRF003: chaos injection points ------------------------------------------
+
+
+def scan_chaos_usage(
+    root: pathlib.Path,
+) -> tuple[dict[str, tuple[str, int]], set[str]]:
+    """One AST pass over the package: consulted points — point ->
+    (relpath, line) of a call site passing it as a string literal
+    (injector.check / chaos.consult / add_rule) — plus every string
+    literal anywhere (the stale-direction scan), so DRF003 parses each
+    file once, not twice."""
+    points: dict[str, tuple[str, int]] = {}
+    literals: set[str] = set()
+    pkg = root / "jobset_tpu"
+    for path in sorted(pkg.rglob("*.py")):
+        if "__pycache__" in path.parts or "analysis" in path.parts:
+            continue
+        tree = _parse(path)
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Constant) and isinstance(
+                node.value, str
+            ):
+                literals.add(node.value)
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, (ast.Attribute, ast.Name))
+            ):
+                continue
+            fn_name = (
+                node.func.attr if isinstance(node.func, ast.Attribute)
+                else node.func.id
+            )
+            if fn_name not in _POINT_CALLS or not node.args:
+                continue
+            arg = node.args[0]
+            if (
+                isinstance(arg, ast.Constant)
+                and isinstance(arg.value, str)
+                and re.fullmatch(r"[a-z_]+\.[a-z_]+", arg.value)
+            ):
+                points.setdefault(
+                    arg.value, (_rel(path, root), node.lineno)
+                )
+    return points, literals
+
+
+def documented_chaos_points(root: pathlib.Path) -> set[str]:
+    src = root / "jobset_tpu" / "chaos" / "injector.py"
+    tree = _parse(src)
+    if tree is None:
+        return set()
+    doc = ast.get_docstring(tree) or ""
+    return set(_POINT_RE.findall(doc))
+
+
+@register
+class ChaosPointDriftRule:
+    NAME = "DRF003"
+    DESCRIPTION = (
+        "chaos injection point consulted at a call site but missing from "
+        "the chaos/injector.py point table (or a stale documented point)"
+    )
+
+    def check_project(self, root: pathlib.Path) -> Iterator[Finding]:
+        documented = documented_chaos_points(root)
+        consulted, literals = scan_chaos_usage(root)
+        if not documented and not consulted:
+            return
+        for point, (relpath, line) in sorted(consulted.items()):
+            if point not in documented:
+                yield Finding(
+                    rule=self.NAME, path=relpath, line=line,
+                    message=(
+                        f"chaos point '{point}' is consulted here but "
+                        "missing from the point table in "
+                        "chaos/injector.py's docstring — document it "
+                        "(and give it a scenario)"
+                    ),
+                )
+        if not consulted:
+            return
+        # Stale direction: a documented point must still appear as a
+        # string literal SOMEWHERE in the package (call sites may pass it
+        # through a variable, so any literal mention counts).
+        for point in sorted(documented):
+            if point not in literals:
+                yield Finding(
+                    rule=self.NAME,
+                    path=_rel(
+                        root / "jobset_tpu" / "chaos" / "injector.py", root
+                    ),
+                    line=1,
+                    message=(
+                        f"chaos/injector.py documents point '{point}' "
+                        "but nothing in the package mentions it — stale "
+                        "table row"
+                    ),
+                )

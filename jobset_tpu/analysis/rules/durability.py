@@ -1,0 +1,165 @@
+"""Durability-ordering rules: nothing is acknowledged before its fsync.
+
+The store's contract (docs/persistence.md) is that an acknowledged write
+survives `kill -9`, and the HA plane extends it to "acknowledged at
+majority fsync" (docs/ha.md). The code shapes that carry the contract are
+consistent across `store/` and `ha/`:
+
+* the durable step is ``self.wal.append(...)`` (write + flush + fsync),
+  ``os.fsync``, ``_persist_meta`` (term/commit metadata), or
+  ``write_snapshot_file`` (atomic snapshot install);
+* the acknowledgement is a ``return {"ok": True, ...}`` RPC reply
+  (``append_entries`` / ``install_snapshot`` / the ``/ha/v1`` handlers);
+* the *local* acknowledgement is advancing a durable-position attribute
+  (``_seq`` / ``last_seq`` / ``commit_seq``) — store state that recovery
+  and replication treat as "everything up to here is on disk".
+
+* **DUR001** — an ``ok: True`` reply that lexically precedes a durable
+  call in the same function: some path acknowledges without having
+  fsync'd what it acknowledges.
+* **DUR002** — a durable-position attribute assigned before the WAL
+  append in the same function: a crash between the two leaves in-memory
+  state claiming durability the disk does not have (the
+  reset-and-reappend truncation crash window was this bug's cousin).
+
+Scope: ``jobset_tpu/store/`` and ``jobset_tpu/ha/`` only — the planes
+that own the contract.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..engine import Finding, ModuleContext, dotted_name, register
+
+_DURABLE_ATTR_CALLS = ("append", "fsync", "flush")
+_DURABLE_FN_CALLS = ("_persist_meta", "write_snapshot_file")
+_POSITION_ATTRS = ("_seq", "last_seq", "commit_seq")
+
+
+def _in_scope(ctx: ModuleContext) -> bool:
+    return ctx.plane() in ("store", "ha")
+
+
+def _durable_call_lines(fn: ast.AST) -> list[int]:
+    """Lines of durable calls in `fn`: wal-receiver append/fsync/flush,
+    os.fsync, _persist_meta, write_snapshot_file."""
+    lines = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func)
+        leaf = name.rpartition(".")[2]
+        if leaf in _DURABLE_FN_CALLS:
+            lines.append(node.lineno)
+        elif name == "os.fsync":
+            lines.append(node.lineno)
+        elif leaf in _DURABLE_ATTR_CALLS:
+            # `.append()` is also how lists grow: require a wal-shaped
+            # receiver (self.wal.append / wal.append / self._wal.flush).
+            receiver = name.rpartition(".")[0].rpartition(".")[2]
+            if "wal" in receiver.lower():
+                lines.append(node.lineno)
+    return lines
+
+
+def _wal_append_lines(fn: ast.AST) -> list[int]:
+    return [
+        node.lineno
+        for node in ast.walk(fn)
+        if isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "append"
+        and "wal" in dotted_name(node.func.value).rpartition(".")[2].lower()
+    ]
+
+
+def _is_ok_true_return(node: ast.AST) -> bool:
+    if not (isinstance(node, ast.Return) and isinstance(node.value, ast.Dict)):
+        return False
+    for key, value in zip(node.value.keys, node.value.values):
+        if (
+            isinstance(key, ast.Constant) and key.value == "ok"
+            and isinstance(value, ast.Constant) and value.value is True
+        ):
+            return True
+    return False
+
+
+def _functions(tree: ast.Module):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+@register
+class AckBeforeFsyncRule:
+    NAME = "DUR001"
+    DESCRIPTION = (
+        "`return {\"ok\": True}` reply precedes a durable append/fsync in "
+        "the same function — a path acknowledges undurable state"
+    )
+
+    def check_module(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if not _in_scope(ctx):
+            return
+        for fn in _functions(ctx.tree):
+            durable = _durable_call_lines(fn)
+            if not durable:
+                continue
+            last_durable = max(durable)
+            for node in ast.walk(fn):
+                if _is_ok_true_return(node) and node.lineno < last_durable:
+                    yield Finding(
+                        rule=self.NAME, path=ctx.relpath, line=node.lineno,
+                        message=(
+                            f"`{fn.name}` acknowledges (ok: True) at line "
+                            f"{node.lineno} but a durable append/fsync "
+                            f"follows at line {last_durable} — on this "
+                            "path the record being acknowledged was never "
+                            "fsync'd (fsync-before-ack, docs/ha.md)"
+                        ),
+                    )
+
+
+@register
+class PositionBeforeAppendRule:
+    NAME = "DUR002"
+    DESCRIPTION = (
+        "durable-position attribute (_seq/last_seq/commit_seq) advanced "
+        "before the WAL append in the same function"
+    )
+
+    def check_module(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if not _in_scope(ctx):
+            return
+        for fn in _functions(ctx.tree):
+            appends = _wal_append_lines(fn)
+            if not appends:
+                continue
+            first_append = min(appends)
+            for node in ast.walk(fn):
+                if not isinstance(node, (ast.Assign, ast.AugAssign)):
+                    continue
+                targets = (
+                    node.targets if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for target in targets:
+                    if (
+                        isinstance(target, ast.Attribute)
+                        and target.attr in _POSITION_ATTRS
+                        and node.lineno < first_append
+                    ):
+                        yield Finding(
+                            rule=self.NAME, path=ctx.relpath,
+                            line=node.lineno,
+                            message=(
+                                f"`{fn.name}` advances durable position "
+                                f"self.{target.attr} at line {node.lineno} "
+                                f"before the WAL append at line "
+                                f"{first_append} — a crash between them "
+                                "claims durability the disk does not have"
+                            ),
+                        )

@@ -1,0 +1,292 @@
+"""Jit-hygiene rules: compile-once discipline for `jax.jit` call sites.
+
+ROADMAP item 3 exists because this discipline was broken once already:
+the queue admission kernel re-traced per call (shape variance + jit
+applied per invocation) and ended up 5x *slower* than its numpy fallback.
+The sanctioned shapes in this tree are:
+
+* module-level application — ``@jax.jit`` / ``@partial(jax.jit, ...)``
+  on a top-level def, or a module-level ``jax.jit(...)`` call;
+* a **builder**: a module-level function that applies jit once and
+  returns the compiled callable (``build_train_step``-style);
+* a **cached factory**: an ``@functools.lru_cache`` function keyed on
+  the pow2 shape bucket (``queue/scorer._kernel``-style) so each bucket
+  compiles exactly once.
+
+What the rules flag:
+
+* **JIT001** — jit applied inside a ``for``/``while`` loop: a recompile
+  (or at least a cache lookup + retrace risk) per iteration.
+* **JIT002** — jit applied in a per-call position: inside a method, or
+  inside a function nested deeper than one level, without an enclosing
+  ``lru_cache``. Each call re-wraps and re-traces.
+* **JIT003** — Python ``if``/``while`` branching directly on a traced
+  parameter inside a bare ``@jax.jit`` function (no static_argnums/
+  static_argnames): a TracerBoolConversionError at best, a silent
+  per-branch recompile via re-trace at worst. ``is None`` checks are
+  exempt (identity against None is static under tracing).
+* **JIT004** — host syncs (``.block_until_ready()``, ``np.asarray``,
+  ``jax.device_get``) inside loops in placement/queue/policy hot paths:
+  a device round-trip per iteration is the storm-dispatch overhead
+  pattern (ROADMAP item 3's 73 ms/problem).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from ..engine import Finding, ModuleContext, dotted_name, register
+
+# The hot-path modules whose loops must stay free of per-iteration host
+# syncs: the solve/score/place call chain that runs once per admission
+# pass or reconcile round (corpus/dataset loaders in the same planes are
+# deliberately NOT listed — loading is allowed to touch the host).
+HOT_MODULES = frozenset((
+    "jobset_tpu/placement/provider.py",
+    "jobset_tpu/placement/solver.py",
+    "jobset_tpu/policy/model.py",
+    "jobset_tpu/policy/placer.py",
+    "jobset_tpu/queue/scorer.py",
+))
+
+_CACHE_DECORATORS = ("lru_cache", "cache")
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """`jax.jit`, `jit` (bare import), `partial(jax.jit, ...)`,
+    `functools.partial(jax.jit, ...)`."""
+    name = dotted_name(node)
+    if name in ("jax.jit", "jit"):
+        return True
+    if isinstance(node, ast.Call) and dotted_name(node.func).endswith("partial"):
+        return any(_is_jit_expr(a) for a in node.args)
+    return False
+
+
+def _jit_applications(tree: ast.Module):
+    """Yield (line, parent_chain, static_ok, fn_node) for every jit
+    application: a decorator on a def, or a jax.jit(...) call expression.
+    parent_chain is the list of enclosing FunctionDef/ClassDef/loop nodes
+    outermost-first. static_ok is True when static_argnums/static_argnames
+    were passed. fn_node is the decorated def (decorator case) or None."""
+    out = []
+
+    def walk(node, chain):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if _is_jit_expr(dec):
+                    out.append((
+                        dec.lineno if hasattr(dec, "lineno") else node.lineno,
+                        list(chain), _has_static_args(dec), node,
+                    ))
+        if isinstance(node, ast.Call) and _is_jit_expr(node.func):
+            out.append((
+                node.lineno, list(chain), _has_static_args(node), None,
+            ))
+        in_chain = isinstance(node, (
+            ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+            ast.For, ast.While, ast.AsyncFor,
+        ))
+        if in_chain:
+            chain = chain + [node]
+        for child in ast.iter_child_nodes(node):
+            walk(child, chain)
+
+    walk(tree, [])
+    return out
+
+
+def _has_static_args(node: ast.AST) -> bool:
+    if isinstance(node, ast.Call):
+        for kw in node.keywords:
+            if kw.arg in ("static_argnums", "static_argnames"):
+                return True
+        # partial(jax.jit, static_argnames=...) nests the kwargs one level.
+        return any(
+            isinstance(a, ast.Call) and _has_static_args(a)
+            for a in node.args
+        )
+    return False
+
+
+def _enclosing_cached(chain) -> bool:
+    for node in chain:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                if dotted_name(target).rpartition(".")[2] in _CACHE_DECORATORS:
+                    return True
+    return False
+
+
+@register
+class JitInLoopRule:
+    NAME = "JIT001"
+    DESCRIPTION = "jax.jit applied inside a loop (re-wrap per iteration)"
+
+    def check_module(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for line, chain, _static, _fn in _jit_applications(ctx.tree):
+            if any(
+                isinstance(n, (ast.For, ast.While, ast.AsyncFor))
+                for n in chain
+            ):
+                yield Finding(
+                    rule=self.NAME, path=ctx.relpath, line=line,
+                    message=(
+                        "jax.jit applied inside a loop re-wraps (and risks "
+                        "re-tracing) every iteration — hoist to module "
+                        "level or an lru_cache'd bucket factory"
+                    ),
+                )
+
+
+@register
+class JitNotCachedRule:
+    NAME = "JIT002"
+    DESCRIPTION = (
+        "jax.jit applied per-call (method / deeply nested) without an "
+        "enclosing lru_cache factory"
+    )
+
+    def check_module(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for line, chain, _static, _fn in _jit_applications(ctx.tree):
+            if any(
+                isinstance(n, (ast.For, ast.While, ast.AsyncFor))
+                for n in chain
+            ):
+                continue  # JIT001 already owns loop sites
+            fn_depth = sum(
+                isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                for n in chain
+            )
+            in_class_method = any(
+                isinstance(a, ast.ClassDef)
+                and isinstance(b, (ast.FunctionDef, ast.AsyncFunctionDef))
+                for a, b in zip(chain, chain[1:])
+            )
+            # Module level (depth 0) and single-level builders (depth 1,
+            # compile-once by construction when the caller keeps the
+            # result) are sanctioned; anything deeper, or inside a
+            # method, must sit under an lru_cache factory.
+            if (fn_depth >= 2 or in_class_method) and not _enclosing_cached(
+                chain
+            ):
+                yield Finding(
+                    rule=self.NAME, path=ctx.relpath, line=line,
+                    message=(
+                        "jax.jit applied in a per-call position — every "
+                        "invocation re-wraps and re-traces; hoist to "
+                        "module level, a module-level builder, or an "
+                        "@functools.lru_cache bucket factory "
+                        "(SNIPPETS compile-once discipline)"
+                    ),
+                )
+
+
+@register
+class TracedBranchRule:
+    NAME = "JIT003"
+    DESCRIPTION = (
+        "Python if/while on a traced parameter inside a bare @jax.jit "
+        "function"
+    )
+
+    @staticmethod
+    def _param_in_test(test: ast.AST, params: set[str]) -> Optional[str]:
+        """A parameter name used as a truth value or in a numeric
+        comparison. `x is None` / `x is not None` are static and exempt."""
+        if isinstance(test, ast.Name) and test.id in params:
+            return test.id
+        if isinstance(test, ast.Compare):
+            if all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops
+            ):
+                return None
+            for side in (test.left, *test.comparators):
+                if isinstance(side, ast.Name) and side.id in params:
+                    return side.id
+        if isinstance(test, ast.BoolOp):
+            for v in test.values:
+                hit = TracedBranchRule._param_in_test(v, params)
+                if hit:
+                    return hit
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            return TracedBranchRule._param_in_test(test.operand, params)
+        return None
+
+    def check_module(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for line, _chain, static_ok, fn in _jit_applications(ctx.tree):
+            if fn is None or static_ok:
+                continue
+            params = {
+                a.arg
+                for a in (
+                    *fn.args.posonlyargs, *fn.args.args, *fn.args.kwonlyargs,
+                )
+                if a.arg != "self"
+            }
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.If, ast.While)):
+                    hit = self._param_in_test(node.test, params)
+                    if hit:
+                        yield Finding(
+                            rule=self.NAME, path=ctx.relpath,
+                            line=node.lineno,
+                            message=(
+                                f"`{fn.name}` is @jax.jit with no static_"
+                                f"argnames, but branches on parameter "
+                                f"'{hit}' in Python — a traced value "
+                                "cannot drive Python control flow; use "
+                                "jnp.where/lax.cond or mark it static"
+                            ),
+                        )
+
+
+@register
+class HostSyncInLoopRule:
+    NAME = "JIT004"
+    DESCRIPTION = (
+        "host sync (block_until_ready/np.asarray/device_get) inside a "
+        "loop in a placement/queue/policy hot path"
+    )
+
+    _SYNC_ATTRS = ("block_until_ready",)
+    _SYNC_CALLS = (
+        "np.asarray", "numpy.asarray", "np.array", "numpy.array",
+        "jax.device_get",
+    )
+
+    def check_module(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if ctx.relpath not in HOT_MODULES:
+            return
+
+        def walk(node, in_loop):
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                is_sync = name in self._SYNC_CALLS or (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in self._SYNC_ATTRS
+                )
+                if is_sync and in_loop:
+                    yield Finding(
+                        rule=self.NAME, path=ctx.relpath, line=node.lineno,
+                        message=(
+                            f"{name or node.func.attr}() forces a device->"
+                            "host sync inside a loop on a hot path — "
+                            "batch the readback outside the loop (keep "
+                            "inputs device-resident across rounds)"
+                        ),
+                    )
+            enters_loop = isinstance(
+                node, (ast.For, ast.While, ast.AsyncFor)
+            )
+            leaves = isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            )
+            for child in ast.iter_child_nodes(node):
+                yield from walk(
+                    child, (in_loop or enters_loop) and not leaves
+                )
+
+        yield from walk(ctx.tree, False)

@@ -1,0 +1,197 @@
+"""Lock-discipline rules: guarded-by annotations and acquisition order.
+
+The control plane is multithreaded (HTTP handler pool, reconcile pump,
+replication shipper), and its shared state is guarded by convention, not
+by a checker — until now.
+
+* **LCK001 — guarded-by.** An attribute declared with a trailing
+  ``# guarded-by: <lock>`` comment on its assignment (normally in
+  ``__init__``) may only be read or written inside a ``with self.<lock>:``
+  scope. Two escape hatches mirror the codebase's real conventions: the
+  declaring ``__init__`` (no other thread can hold a reference yet) and
+  methods whose name ends in ``_locked`` (called with the lock already
+  held by the caller — e.g. ``FaultInjector._rng_for_locked``).
+
+* **LCK002 — acquisition order.** The canonical order across planes is
+  ``lock`` (the Cluster's reentrant outermost lock) → ``_lock`` (one per
+  plane object) → ``_buffer_lock`` (replication resend buffer, leaf).
+  Acquiring a lower-ranked lock while holding a higher-ranked one is the
+  static shape of an AB/BA deadlock.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from ..engine import Finding, ModuleContext, register
+
+_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*(\w+)")
+
+# Canonical acquisition order, outermost first (docs/static-analysis.md).
+LOCK_RANKS = {"lock": 0, "_lock": 1, "_buffer_lock": 2}
+
+
+def _lock_name(expr: ast.AST) -> str:
+    """The lock identifier acquired by a `with` item, or "" when the item
+    isn't a lock-shaped expression (self.X / X where X names a lock)."""
+    if isinstance(expr, ast.Attribute):
+        name = expr.attr
+    elif isinstance(expr, ast.Name):
+        name = expr.id
+    else:
+        return ""
+    return name if "lock" in name.lower() else ""
+
+
+class _LockWalker(ast.NodeVisitor):
+    """Walk a body tracking the stack of held locks. A nested function is
+    walked with an EMPTY stack: its body runs when the closure is called,
+    not where it is defined, so an enclosing `with` proves nothing."""
+
+    def __init__(self, on_access, on_acquire):
+        self.held: list[str] = []
+        self.on_access = on_access
+        self.on_acquire = on_acquire
+
+    def visit_FunctionDef(self, node) -> None:
+        saved, self.held = self.held, []
+        self.generic_visit(node)
+        self.held = saved
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    def visit_With(self, node: ast.With) -> None:
+        acquired = []
+        for item in node.items:
+            name = _lock_name(item.context_expr)
+            if name:
+                self.on_acquire(name, list(self.held), node.lineno)
+                self.held.append(name)
+                acquired.append(name)
+        self.generic_visit(node)
+        for _ in acquired:
+            self.held.pop()
+
+    visit_AsyncWith = visit_With
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if isinstance(node.value, ast.Name) and node.value.id == "self":
+            self.on_access(node.attr, list(self.held), node.lineno)
+        self.generic_visit(node)
+
+
+def _class_methods(cls: ast.ClassDef) -> Iterator[ast.FunctionDef]:
+    for node in cls.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _guarded_attrs(cls: ast.ClassDef, ctx: ModuleContext) -> dict[str, str]:
+    """attr -> lock for every `self.<attr> = ...  # guarded-by: <lock>`
+    declaration inside the class body."""
+    guarded: dict[str, str] = {}
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign):
+            targets = [node.target]
+        else:
+            continue
+        if node.lineno > len(ctx.lines):
+            continue
+        m = _GUARDED_RE.search(ctx.lines[node.lineno - 1])
+        if not m:
+            continue
+        for target in targets:
+            if (
+                isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self"
+            ):
+                guarded[target.attr] = m.group(1)
+    return guarded
+
+
+@register
+class GuardedByRule:
+    """LCK001: annotated attributes only touched under their lock."""
+
+    NAME = "LCK001"
+    DESCRIPTION = (
+        "attribute declared `# guarded-by: <lock>` accessed outside a "
+        "`with self.<lock>:` scope"
+    )
+
+    def check_module(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for cls in ast.walk(ctx.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            guarded = _guarded_attrs(cls, ctx)
+            if not guarded:
+                continue
+            for method in _class_methods(cls):
+                if method.name == "__init__" or method.name.endswith(
+                    "_locked"
+                ):
+                    continue
+                findings: list[Finding] = []
+
+                def on_access(attr, held, line, _m=method.name, _f=findings):
+                    lock = guarded.get(attr)
+                    if lock is not None and lock not in held:
+                        _f.append(Finding(
+                            rule=self.NAME, path=ctx.relpath, line=line,
+                            message=(
+                                f"self.{attr} is guarded-by {lock} but "
+                                f"{cls.name}.{_m} touches it without "
+                                f"holding `with self.{lock}:` (hold the "
+                                "lock, or rename the method *_locked if "
+                                "the caller holds it)"
+                            ),
+                        ))
+
+                walker = _LockWalker(
+                    on_access, lambda *a: None
+                )
+                for stmt in method.body:
+                    walker.visit(stmt)
+                yield from findings
+
+
+@register
+class LockOrderRule:
+    """LCK002: canonical cross-plane lock acquisition order."""
+
+    NAME = "LCK002"
+    DESCRIPTION = (
+        "lock acquired out of canonical order (lock -> _lock -> "
+        "_buffer_lock) — AB/BA deadlock shape"
+    )
+
+    def check_module(self, ctx: ModuleContext) -> Iterator[Finding]:
+        findings: list[Finding] = []
+
+        def on_acquire(name, held, line):
+            rank = LOCK_RANKS.get(name)
+            if rank is None:
+                return
+            for outer in held:
+                outer_rank = LOCK_RANKS.get(outer)
+                if outer_rank is not None and outer_rank > rank:
+                    findings.append(Finding(
+                        rule=self.NAME, path=ctx.relpath, line=line,
+                        message=(
+                            f"acquiring '{name}' (rank {rank}) while "
+                            f"holding '{outer}' (rank {outer_rank}) "
+                            "inverts the canonical lock order "
+                            "lock -> _lock -> _buffer_lock"
+                        ),
+                    ))
+
+        # One pass over the whole module: the walker resets the held
+        # stack at every function boundary, so each body is judged once.
+        _LockWalker(lambda *a: None, on_acquire).visit(ctx.tree)
+        yield from findings

@@ -260,6 +260,38 @@ def _build_parser() -> argparse.ArgumentParser:
              "to openapi-generator for third-party SDKs)",
     )
 
+    lint = sub.add_parser(
+        "lint",
+        help="run the invariant lint plane (docs/static-analysis.md): "
+             "AST rules enforcing the determinism, locking, jit-bucket, "
+             "and durability contracts",
+    )
+    lint.add_argument(
+        "paths", nargs="*", metavar="PATH",
+        help="files or directories to lint (default: the installed "
+             "jobset_tpu package)",
+    )
+    lint.add_argument(
+        "--baseline", metavar="FILE",
+        help="baseline file of grandfathered `RULE path:line` entries "
+             "(default: lint-baseline.txt at the repo root)",
+    )
+    lint.add_argument(
+        "--format", choices=("text", "github"), default="text",
+        help="finding output format; `github` emits ::error workflow "
+             "annotations",
+    )
+    lint.add_argument(
+        "--stats", action="store_true",
+        help="print per-rule finding + suppression counts as JSON (the "
+             "lint-debt block debug bundles carry)",
+    )
+    lint.add_argument(
+        "--update-baseline", action="store_true",
+        help="rewrite the baseline file to grandfather every currently "
+             "visible finding, then exit 0",
+    )
+
     return parser
 
 
@@ -1082,8 +1114,42 @@ def _cmd_policy(args) -> int:
     return 2
 
 
+def _cmd_lint(args) -> int:
+    """`jobset-tpu lint [PATHS]`: run the AST rule engine, print one
+    `RULE path:line message` per visible finding, exit non-zero when any
+    remain (docs/static-analysis.md)."""
+    from .analysis import (
+        default_baseline_path,
+        find_repo_root,
+        rewrite_baseline,
+        run_lint,
+    )
+
+    root = find_repo_root()
+    baseline_path = args.baseline or default_baseline_path(root)
+
+    if args.update_baseline:
+        entries = rewrite_baseline(
+            paths=args.paths or None, baseline_path=baseline_path, root=root
+        )
+        print(f"wrote {len(entries)} baseline entries to {baseline_path}")
+        return 0
+
+    report = run_lint(
+        paths=args.paths or None, baseline_path=baseline_path, root=root
+    )
+
+    output = report.render(args.format)
+    if output:
+        print(output)
+    if args.stats:
+        print(json.dumps(report.stats(), indent=1, sort_keys=True))
+    return 1 if report.visible else 0
+
+
 _COMMANDS = {
     "controller": _cmd_controller,
+    "lint": _cmd_lint,
     "openapi": _cmd_openapi,
     "solver": _cmd_solver,
     "apply": _cmd_apply,

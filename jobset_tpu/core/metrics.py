@@ -114,11 +114,13 @@ class Histogram:
                 if seconds <= b:
                     self.counts[i] += 1
                     if trace_id is not None:
+                        # jslint: disable=DET001 exemplar timestamps are wall-clock by the OpenMetrics spec (scrape-side join key, never replayed)
                         self.exemplars[i] = (trace_id, seconds, time.time())
                     return
             self.counts[-1] += 1
             if trace_id is not None:
                 self.exemplars[len(self.buckets)] = (
+                    # jslint: disable=DET001 exemplar timestamps are wall-clock by the OpenMetrics spec (scrape-side join key, never replayed)
                     trace_id, seconds, time.time()
                 )
 

@@ -34,9 +34,10 @@ BUNDLE_FORMAT = 1
 # mean a consumer written against this module cannot safely parse the
 # members (load_bundle REJECTS unknown majors — the policy plane's corpus
 # builder needs a stable contract across controller generations); minor
-# bumps are additive (1.1 added per-timeline `placements` records).
+# bumps are additive (1.1 added per-timeline `placements` records; 1.2
+# added the manifest `lint` block).
 # Bundles written before the stamp existed are treated as "1.0".
-BUNDLE_SCHEMA_VERSION = "1.1"
+BUNDLE_SCHEMA_VERSION = "1.2"
 
 _JSON_MEMBERS = (
     "manifest.json",
@@ -47,6 +48,16 @@ _JSON_MEMBERS = (
     "jobsets.json",
     "timelines.json",
 )
+
+
+def _lint_block() -> dict:
+    """`jobset-tpu lint --stats` counts for the manifest — best-effort."""
+    try:
+        from ..analysis import lint_stats
+
+        return lint_stats()
+    except Exception as exc:  # never fail a postmortem capture over lint
+        return {"error": f"{type(exc).__name__}: {exc}"[:200]}
 
 
 def write_bundle(client, path: str) -> dict:
@@ -83,10 +94,16 @@ def write_bundle(client, path: str) -> dict:
         "format": BUNDLE_FORMAT,
         "schemaVersion": BUNDLE_SCHEMA_VERSION,
         "capturedAt": time.strftime(
+            # jslint: disable=DET001 capturedAt is operator-facing capture metadata, never replayed or byte-compared
             "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
         ),
         "server": client.base_url,
         "members": members,
+        # Lint debt of the CAPTURING build (per-rule visible/suppressed
+        # counts, docs/static-analysis.md): postmortems start by asking
+        # which contracts the build was already known to bend. Bundles
+        # must still capture when the analysis plane itself is broken.
+        "lint": _lint_block(),
     }
 
     with tarfile.open(path, "w:gz") as tar:

@@ -105,6 +105,7 @@ class Span:
         self.context = context
         self.parent_id = parent_id
         self.attributes: dict = dict(attributes or {})
+        # jslint: disable=DET001 span wall stamps are viewer display metadata; durations use perf_counter, timelines correlate by trace id — never byte-compared
         self.start_wall = time.time()
         self._start_perf = time.perf_counter()
         self.duration_s: Optional[float] = None
@@ -195,10 +196,12 @@ class Tracer:
     # syscall per id. Uniqueness, not unpredictability, is the requirement.
     @staticmethod
     def _new_trace_id() -> str:
+        # jslint: disable=DET002 deliberately the process-global stream: seeded soaks random.seed() it so trace ids reproduce (test_timeline byte-identical runs)
         return f"{random.getrandbits(128):032x}"
 
     @staticmethod
     def _new_span_id() -> str:
+        # jslint: disable=DET002 deliberately the process-global stream: seeded soaks random.seed() it so trace ids reproduce (test_timeline byte-identical runs)
         return f"{random.getrandbits(64):016x}"
 
     # -- span lifecycle ---------------------------------------------------

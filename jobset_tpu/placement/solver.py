@@ -663,6 +663,7 @@ class AssignmentSolver:
                 for _ in range(3):
                     t0 = time.perf_counter()
                     y = jax.device_put(np.ones((8,), np.float32))
+                    # jslint: disable=JIT004 the blocking fetch IS the RTT measurement; runs 3x per process, result cached
                     np.asarray(y)
                     samples.append(time.perf_counter() - t0)
                 self._accel_rtt_s = sorted(samples)[1]
@@ -746,8 +747,10 @@ class AssignmentSolver:
         except Exception:
             return False
         for p in problems:
-            jobs_p = _round_up_pow2(int(np.asarray(p["pods_needed"]).shape[0]))
-            domains_p = _round_up_pow2(int(np.asarray(p["load"]).shape[0]))
+            # len(), not np.asarray(...).shape: the inputs are host-side
+            # 1-D sequences and this runs once per problem per storm.
+            jobs_p = _round_up_pow2(len(p["pods_needed"]))
+            domains_p = _round_up_pow2(len(p["load"]))
             if self._solve_device(jobs_p * domains_p) is None:
                 return False
         return True

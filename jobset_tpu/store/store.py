@@ -306,6 +306,16 @@ class Store:
         self.cluster = cluster
         cluster.store = self
 
+    def _now(self) -> float:
+        """Commit stamps ride the owning cluster's clock — virtual in sim,
+        wall on a real controller — so timelines from seeded runs replay
+        byte-identically."""
+        clock = getattr(self.cluster, "clock", None)
+        if clock is not None:
+            return clock.now()
+        # jslint: disable=DET001 no cluster attached yet (recovery-time commit) — nothing virtual to stamp against
+        return time.time()
+
     # ------------------------------------------------------------------
     # Commit path (Cluster state -> WAL)
     # ------------------------------------------------------------------
@@ -394,7 +404,7 @@ class Store:
             if op[1] == "jobsets":
                 if op[0] == "put":
                     self.last_jobset_commit[op[2]] = {
-                        "seq": record["seq"], "rv": rv, "time": time.time()
+                        "seq": record["seq"], "rv": rv, "time": self._now()
                     }
                 else:
                     self.last_jobset_commit.pop(op[2], None)

@@ -1,0 +1,48 @@
+"""DET001/DET002 positive fixture: every line here violates."""
+
+import os
+import random
+import time
+from datetime import datetime
+
+import numpy as np
+
+
+def stamp():
+    return time.time()  # line 11: DET001
+
+
+def stamp_ns():
+    return time.time_ns()  # line 15: DET001
+
+
+def when():
+    return datetime.now()  # line 19: DET001
+
+
+def broken_clock():
+    return time.gmtime()  # line 23: DET001 (argless = reads the clock)
+
+
+def jitter():
+    return random.random()  # line 27: DET002 (global stream)
+
+
+def pick(items):
+    return random.choice(items)  # line 31: DET002
+
+
+def unseeded_instance():
+    return random.Random()  # line 35: DET002 (bare = OS entropy)
+
+
+def unseeded_numpy():
+    return np.random.default_rng()  # line 39: DET002
+
+
+def legacy_numpy():
+    return np.random.rand(4)  # line 43: DET002 (legacy global state)
+
+
+def entropy():
+    return os.urandom(8)  # line 47: DET002

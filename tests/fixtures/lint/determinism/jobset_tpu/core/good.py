@@ -1,0 +1,29 @@
+"""DET negative fixture: the sanctioned shapes stay clean."""
+
+import random
+import time
+
+import numpy as np
+
+
+class Thing:
+    def __init__(self, clock, seed: int):
+        self.clock = clock  # utils/clock.py Clock, injected
+        self.rng = random.Random(seed)  # seeded instance, not global
+        self.np_rng = np.random.default_rng(seed)
+
+    def now(self):
+        return self.clock.now()
+
+    def latency_window(self):
+        # monotonic/perf_counter are observability, not decision state.
+        t0 = time.perf_counter()
+        _ = time.monotonic()
+        return time.perf_counter() - t0
+
+    def draw(self):
+        return self.rng.random() + float(self.np_rng.uniform())
+
+    def render(self, epoch_s: float):
+        # gmtime WITH an argument formats a given instant — no clock read.
+        return time.strftime("%Y-%m-%d", time.gmtime(epoch_s))

@@ -1,0 +1,18 @@
+"""DET suppression fixture: inline disables with and without reasons."""
+
+import random
+import time
+
+
+def stamped_for_display():
+    # jslint: disable=DET001 display-only stamp, never replayed
+    return time.time()
+
+
+def same_line_disable():
+    return time.time()  # jslint: disable=DET001 scrape-side join key only
+
+
+def bare_disable_is_its_own_finding():
+    # jslint: disable=DET002
+    return random.random()

@@ -1,0 +1,12 @@
+"""Scoping fixture: utils/ is not a seeded plane — wall clock is legal."""
+
+import random
+import time
+
+
+def now():
+    return time.time()
+
+
+def jitter():
+    return random.random()

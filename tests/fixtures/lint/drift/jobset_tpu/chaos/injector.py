@@ -1,0 +1,10 @@
+"""DRF003 fixture injector. Point table:
+
+* ``fixture.documented`` — consulted below, has this row;
+* ``fixture.stale`` — this row names a point nothing consults.
+"""
+
+
+class Injector:
+    def check(self, point: str) -> bool:
+        return bool(point)

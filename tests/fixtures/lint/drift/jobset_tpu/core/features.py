@@ -1,0 +1,6 @@
+"""DRF002 fixture gates: one documented, one undocumented."""
+
+_DEFAULTS: dict[str, bool] = {
+    "FixtureDocumentedGate": False,
+    "FixtureUndocumentedGate": False,  # line 5: no concepts.md row
+}

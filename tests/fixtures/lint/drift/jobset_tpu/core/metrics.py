@@ -1,0 +1,15 @@
+"""DRF001 fixture registry: one documented family, one undocumented."""
+
+
+class Counter:
+    def __init__(self, name, help_text):
+        self.name = name
+        self.help_text = help_text
+
+
+class Gauge(Counter):
+    pass
+
+
+documented = Counter("fixture_documented_total", "has a doc row")
+undocumented = Gauge("fixture_undocumented", "missing from docs")  # line 15

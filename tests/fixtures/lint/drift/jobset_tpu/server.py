@@ -1,0 +1,13 @@
+"""DRF003 fixture call sites: one documented point, one undocumented."""
+
+from .chaos.injector import Injector
+
+injector = Injector()
+
+
+def handle(request):
+    if injector.check("fixture.documented"):
+        return None
+    if injector.check("fixture.undocumented"):  # line 11: no table row
+        return None
+    return request

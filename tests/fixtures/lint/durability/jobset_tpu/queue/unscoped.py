@@ -1,0 +1,15 @@
+"""DUR scoping fixture: queue/ does not own the durability contract —
+the same shapes are clean here (a `wal`-named list is just a list)."""
+
+
+class Batcher:
+    def __init__(self):
+        self.wal = []
+        self._seq = 0
+
+    def add(self, item, dry_run=False):
+        if dry_run:
+            return {"ok": True}
+        self._seq += 1
+        self.wal.append(item)
+        return {"ok": True}

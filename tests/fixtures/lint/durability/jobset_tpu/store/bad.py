@@ -1,0 +1,26 @@
+"""DUR001/DUR002 positive fixture."""
+
+
+class Log:
+    def __init__(self, wal):
+        self.wal = wal
+        self._seq = 0
+        self.commit_seq = 0
+
+    def append_entries(self, records, fast_path=False):
+        if fast_path:
+            # line 13: DUR001 — acknowledges before any fsync happened
+            return {"ok": True, "seq": self._seq}
+        for payload in records:
+            self.wal.append(payload)
+        self._seq += len(records)
+        return {"ok": True, "seq": self._seq}
+
+    def commit(self, payload):
+        self._seq += 1  # line 21: DUR002 — position advanced pre-append
+        self.wal.append(payload)
+        return self._seq
+
+    def install(self, payload, seq):
+        self.commit_seq = seq  # line 26: DUR002
+        self.wal.append(payload)

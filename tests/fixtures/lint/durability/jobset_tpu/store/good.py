@@ -1,0 +1,33 @@
+"""DUR negative fixture: fsync-before-ack, append-before-position."""
+
+
+class Log:
+    def __init__(self, wal):
+        self.wal = wal
+        self._seq = 0
+        self.commit_seq = 0
+
+    def append_entries(self, records):
+        for payload in records:
+            self.wal.append(payload)
+        self._seq += len(records)
+        return {"ok": True, "seq": self._seq}
+
+    def reject(self, reason):
+        # A NEGATIVE reply before the fsync is fine — nothing acknowledged.
+        if reason:
+            return {"ok": False, "error": reason}
+        self.wal.append(b"noop")
+        return {"ok": True}
+
+    def commit(self, payload):
+        self.wal.append(payload)
+        self._seq += 1
+        self.commit_seq = self._seq
+        return self._seq
+
+    def bookkeeping_only(self, seq):
+        # No WAL append in this function -> position updates unconstrained
+        # (recovery/replication setters are exactly this shape).
+        self._seq = seq
+        self.commit_seq = seq

@@ -1,0 +1,48 @@
+"""JIT negative fixture: the sanctioned compile-once shapes, in a hot
+module (this relpath is registered in HOT_MODULES)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def module_level(x):
+    return jnp.sum(x * x)
+
+
+@functools.partial(jax.jit, static_argnames=("mode",))
+def static_branch(x, mode):
+    # Branching on a static arg is legal: one compile per mode value.
+    if mode:
+        return x * 2
+    return x
+
+
+@functools.lru_cache(maxsize=8)
+def _kernel(bucket: int):
+    # The cached bucket factory: each pow2 bucket compiles exactly once.
+    def body(x):
+        return jnp.sum(x[:bucket])
+
+    return jax.jit(body)
+
+
+def build_step():
+    # Module-level builder: caller keeps the result, compile-once.
+    return jax.jit(module_level)
+
+
+@jax.jit
+def none_check_is_static(x, mask):
+    if mask is None:  # identity-vs-None is static under tracing
+        return x
+    return x * mask
+
+
+def batched_readback(device_rows):
+    results = [module_level(row) for row in device_rows]
+    # One host sync AFTER the loop, not per iteration.
+    return np.asarray(results)

@@ -1,0 +1,11 @@
+"""JIT004 scoping fixture: not a hot module — corpus loading may touch
+the host per row."""
+
+import numpy as np
+
+
+def load_rows(rows):
+    out = []
+    for row in rows:
+        out.append(np.asarray(row))
+    return out

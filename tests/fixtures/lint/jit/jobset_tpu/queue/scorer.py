@@ -1,0 +1,49 @@
+"""JIT001-004 positive fixture (this relpath is a registered hot module)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def kernel(x):
+    return jnp.sum(x * x)
+
+
+def rewrap_per_iteration(batches):
+    out = []
+    for batch in batches:
+        f = jax.jit(kernel)  # line 15: JIT001 (jit inside a loop)
+        out.append(f(batch))
+    return out
+
+
+class Scorer:
+    def score(self, x):
+        f = jax.jit(kernel)  # line 22: JIT002 (per-call, no lru_cache)
+        return f(x)
+
+
+def outer():
+    def inner():
+        return jax.jit(kernel)  # line 28: JIT002 (nested depth 2)
+    return inner
+
+
+@jax.jit
+def branchy(x, threshold):
+    if threshold > 0:  # line 34: JIT003 (Python branch on traced param)
+        return x * 2
+    return x
+
+
+def per_round_readback(device_rows):
+    total = 0.0
+    for row in device_rows:
+        total += float(np.asarray(row)[0])  # line 42: JIT004 (sync in loop)
+    return total
+
+
+def per_round_block(device_rows):
+    for row in device_rows:
+        row.block_until_ready()  # line 48: JIT004
+    return device_rows

@@ -1,0 +1,43 @@
+"""LCK001/LCK002 positive fixture."""
+
+import threading
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = {}  # guarded-by: _lock
+
+    def get(self, key):
+        return self._entries.get(key)  # line 12: LCK001 (no lock held)
+
+    def put(self, key, value):
+        self._entries[key] = value  # line 15: LCK001
+
+    def locked_then_leaked(self, key):
+        with self._lock:
+            ok = key in self._entries  # covered
+        return ok and self._entries[key]  # line 20: LCK001 (after release)
+
+    def closure_does_not_inherit(self, key):
+        with self._lock:
+            def peek():
+                return self._entries.get(key)  # line 25: LCK001 (closure)
+            return peek
+
+
+class Inverted:
+    def __init__(self):
+        self.lock = threading.RLock()
+        self._lock = threading.Lock()
+        self._buffer_lock = threading.Lock()
+
+    def deadlock_shape(self):
+        with self._buffer_lock:
+            with self._lock:  # line 37: LCK002 (_buffer_lock before _lock)
+                pass
+
+    def outermost_last(self):
+        with self._lock:
+            with self.lock:  # line 42: LCK002 (_lock before cluster lock)
+                pass

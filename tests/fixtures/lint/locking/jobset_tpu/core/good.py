@@ -1,0 +1,43 @@
+"""LCK negative fixture: the sanctioned access shapes."""
+
+import threading
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = {}  # guarded-by: _lock
+        self._entries["boot"] = True  # __init__ is exempt (no other thread)
+
+    def get(self, key):
+        with self._lock:
+            return self._entries.get(key)
+
+    def _evict_locked(self, key):
+        # *_locked methods run with the caller holding the lock.
+        self._entries.pop(key, None)
+
+    def evict(self, key):
+        with self._lock:
+            self._evict_locked(key)
+
+    def unguarded_sibling(self):
+        # No guarded-by annotation on this attribute -> no constraint.
+        return self._lock
+
+
+class Ordered:
+    def __init__(self):
+        self.lock = threading.RLock()
+        self._lock = threading.Lock()
+        self._buffer_lock = threading.Lock()
+
+    def canonical_order(self):
+        with self.lock:
+            with self._lock:
+                with self._buffer_lock:
+                    pass
+
+    def leaf_alone(self):
+        with self._buffer_lock:
+            pass

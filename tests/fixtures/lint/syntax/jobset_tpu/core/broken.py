@@ -1,0 +1,3 @@
+"""SYN001 fixture: this file deliberately does not parse."""
+
+def half_open(:

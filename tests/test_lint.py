@@ -1,0 +1,400 @@
+"""The invariant lint plane's own tests (docs/static-analysis.md).
+
+Two jobs:
+
+1. **The tier-1 gate**: `jobset_tpu/` must stay lint-clean — zero
+   unsuppressed findings over the installed package with the checked-in
+   baseline. This is the test that makes every rule a standing contract.
+2. **Per-rule self-tests** over the fixture trees in
+   `tests/fixtures/lint/`: each rule fires on its violating snippet at
+   the expected lines, stays silent on the clean snippet AND outside its
+   scope, and both suppression layers (inline disable, baseline entry)
+   actually silence it.
+"""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+from jobset_tpu.analysis import (
+    LintEngine,
+    default_baseline_path,
+    lint_stats,
+    run_lint,
+)
+from jobset_tpu.analysis.engine import all_rules, load_baseline
+
+ROOT = pathlib.Path(__file__).parent.parent
+FIXTURES = ROOT / "tests" / "fixtures" / "lint"
+PACKAGE = ROOT / "jobset_tpu"
+
+pytestmark = pytest.mark.lint
+
+
+def fixture_engine(tree: str, rules=None, baseline=None) -> LintEngine:
+    """An engine rooted at one fixture mini-repo."""
+    return LintEngine(rules=rules, baseline=baseline, root=FIXTURES / tree)
+
+
+def run_fixture(tree: str, rules=None, baseline=None):
+    engine = fixture_engine(tree, rules=rules, baseline=baseline)
+    return engine.run([FIXTURES / tree])
+
+
+def visible(report, rule=None, path_part=None):
+    out = report.visible
+    if rule is not None:
+        out = [f for f in out if f.rule == rule]
+    if path_part is not None:
+        out = [f for f in out if path_part in f.path]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The tier-1 gate
+# ---------------------------------------------------------------------------
+
+
+def test_tree_is_lint_clean():
+    """THE gate: zero unsuppressed findings over jobset_tpu/ with the
+    checked-in baseline. A new violation fails here with the exact
+    `RULE path:line message` line to fix or suppress-with-reason."""
+    report = run_lint(paths=[PACKAGE], root=ROOT)
+    assert not report.visible, "\n" + report.render()
+
+
+def test_every_suppression_states_a_reason():
+    """Honest-suppression invariant: every inline disable in the tree
+    carries a reason (SUP001 is part of the gate, but assert it
+    directly so the failure message names the offender)."""
+    report = run_lint(paths=[PACKAGE], root=ROOT)
+    bare = [f for f in report.findings if f.rule == "SUP001"]
+    assert not bare, "\n".join(f.render() for f in bare)
+
+
+def test_every_registered_rule_has_fixture_coverage():
+    """Adding a rule without a fixture self-test is itself drift: each
+    registered per-file rule must fire somewhere in the fixture trees
+    (project-level drift rules fire in the drift tree)."""
+    fired: set[str] = set()
+    for tree in ("determinism", "locking", "jit", "durability", "syntax"):
+        fired |= {f.rule for f in run_fixture(tree).findings}
+    fired |= {f.rule for f in fixture_engine("drift").run([]).findings}
+    registered = set(all_rules())
+    missing = registered - fired
+    assert not missing, (
+        f"rules with no firing fixture: {sorted(missing)} — add a "
+        "violating snippet under tests/fixtures/lint/"
+    )
+
+
+# ---------------------------------------------------------------------------
+# Determinism (DET001/DET002)
+# ---------------------------------------------------------------------------
+
+
+def test_determinism_fires_on_bad():
+    report = run_fixture("determinism")
+    det1 = visible(report, "DET001", "core/bad.py")
+    det2 = visible(report, "DET002", "core/bad.py")
+    assert {f.line for f in det1} == {12, 16, 20, 24}
+    assert {f.line for f in det2} == {28, 32, 36, 40, 44, 48}
+
+
+def test_determinism_clean_on_good():
+    report = run_fixture("determinism")
+    assert not visible(report, path_part="core/good.py")
+
+
+def test_determinism_scoped_to_seeded_planes():
+    """The same calls in utils/ (not a seeded plane) are clean."""
+    report = run_fixture("determinism")
+    assert not visible(report, path_part="utils/unscoped.py")
+
+
+def test_inline_suppression_silences_and_bare_disable_fires():
+    report = run_fixture("determinism")
+    sup = [
+        f for f in report.findings
+        if f.path.endswith("suppressed.py") and f.suppressed_by == "inline"
+    ]
+    # Comment-above and same-line disables both cover their call.
+    assert {f.rule for f in sup} == {"DET001", "DET002"}
+    assert all(f.suppress_reason for f in sup if f.rule == "DET001")
+    vis = visible(report, path_part="suppressed.py")
+    # The reasonless disable silences its DET002 but raises SUP001.
+    assert {f.rule for f in vis} == {"SUP001"}
+
+
+def test_baseline_entry_silences():
+    dirty = run_fixture("determinism")
+    keys = [f.key() for f in dirty.visible]
+    grandfathered = run_fixture("determinism", baseline=keys)
+    assert not grandfathered.visible
+    assert {f.suppressed_by for f in grandfathered.findings} >= {"baseline"}
+
+
+# ---------------------------------------------------------------------------
+# Locking (LCK001/LCK002)
+# ---------------------------------------------------------------------------
+
+
+def test_locking_fires_on_bad():
+    report = run_fixture("locking")
+    lck1 = visible(report, "LCK001", "bad.py")
+    lck2 = visible(report, "LCK002", "bad.py")
+    assert {f.line for f in lck1} == {12, 15, 20, 25}
+    assert {f.line for f in lck2} == {37, 42}
+
+
+def test_locking_clean_on_good():
+    """__init__, *_locked methods, with-scope access, and the canonical
+    acquisition order are all sanctioned."""
+    report = run_fixture("locking")
+    assert not visible(report, path_part="good.py")
+
+
+# ---------------------------------------------------------------------------
+# Jit hygiene (JIT001-004)
+# ---------------------------------------------------------------------------
+
+
+def test_jit_fires_on_bad():
+    report = run_fixture("jit")
+    by_rule = {
+        rule: {f.line for f in visible(report, rule, "queue/scorer.py")}
+        for rule in ("JIT001", "JIT002", "JIT003", "JIT004")
+    }
+    assert by_rule == {
+        "JIT001": {15},
+        "JIT002": {22, 28},
+        "JIT003": {34},
+        "JIT004": {42, 48},
+    }
+
+
+def test_jit_clean_on_sanctioned_shapes():
+    """Module-level jit, static_argnames, lru_cache bucket factories,
+    builders, is-None branches, and post-loop readback are all clean —
+    in a hot module."""
+    report = run_fixture("jit")
+    assert not visible(report, path_part="placement/provider.py")
+
+
+def test_jit004_scoped_to_hot_modules():
+    report = run_fixture("jit")
+    assert not visible(report, path_part="queue/loader.py")
+
+
+# ---------------------------------------------------------------------------
+# Durability ordering (DUR001/DUR002)
+# ---------------------------------------------------------------------------
+
+
+def test_durability_fires_on_bad():
+    report = run_fixture("durability")
+    dur1 = visible(report, "DUR001", "store/bad.py")
+    dur2 = visible(report, "DUR002", "store/bad.py")
+    assert {f.line for f in dur1} == {13}
+    assert {f.line for f in dur2} == {20, 25}
+
+
+def test_durability_clean_on_good():
+    """append-then-ack, negative replies, and append-free bookkeeping
+    setters are all clean."""
+    report = run_fixture("durability")
+    assert not visible(report, path_part="store/good.py")
+
+
+def test_durability_scoped_to_store_and_ha():
+    report = run_fixture("durability")
+    assert not visible(report, path_part="queue/unscoped.py")
+
+
+# ---------------------------------------------------------------------------
+# Registry/doc drift (DRF001-003)
+# ---------------------------------------------------------------------------
+
+
+def test_drift_fires_in_both_directions():
+    report = fixture_engine("drift").run([])
+    messages = {f.rule: sorted(m.message for m in visible(report, f.rule))
+                for f in report.visible}
+    drf1 = [f.message for f in visible(report, "DRF001")]
+    assert any("fixture_undocumented" in m for m in drf1), messages
+    assert any("fixture_stale_total" in m for m in drf1), messages
+    drf2 = [f.message for f in visible(report, "DRF002")]
+    assert any("FixtureUndocumentedGate" in m for m in drf2), messages
+    assert any("FixtureStaleGate" in m for m in drf2), messages
+    drf3 = [f.message for f in visible(report, "DRF003")]
+    assert any("fixture.undocumented" in m for m in drf3), messages
+    assert any("fixture.stale" in m for m in drf3), messages
+
+
+def test_drift_documented_entries_are_clean():
+    """The matched halves (documented metric/gate/point) produce no
+    findings — only the drifted halves fire."""
+    report = fixture_engine("drift").run([])
+    for clean_name in (
+        "fixture_documented_total",
+        "FixtureDocumentedGate",
+        "'fixture.documented'",
+    ):
+        assert not any(
+            clean_name in f.message for f in report.visible
+        ), clean_name
+
+
+def test_drift_rows_outside_feature_gates_section_ignored():
+    report = fixture_engine("drift").run([])
+    assert not any("NotAGateRow" in f.message for f in report.visible)
+
+
+# ---------------------------------------------------------------------------
+# Engine mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_unparsable_file_is_a_finding_not_a_crash():
+    report = run_fixture("syntax")
+    syn = visible(report, "SYN001")
+    assert len(syn) == 1 and syn[0].path.endswith("broken.py")
+
+
+def test_output_is_stable_and_sorted():
+    report = run_fixture("determinism")
+    lines = report.render().splitlines()
+    keys = [
+        (f.path, f.line, f.rule, f.message) for f in report.visible
+    ]
+    assert keys == sorted(keys)
+    again = run_fixture("determinism")
+    assert report.render() == again.render()
+    assert lines and all(" jobset_tpu/" in ln.partition(" ")[2] or
+                         ln.split(" ", 2)[1].startswith("jobset_tpu/")
+                         for ln in lines)
+
+
+def test_github_format_emits_annotations():
+    report = run_fixture("determinism")
+    for line in report.render("github").splitlines():
+        assert line.startswith("::error file=jobset_tpu/"), line
+
+
+def test_stats_counts_visible_and_suppressed():
+    report = run_fixture("determinism")
+    stats = report.stats()
+    assert stats["visible"] == len(report.visible)
+    assert stats["suppressed"] == len(report.suppressed)
+    assert stats["perRule"]["DET001"]["inline"] >= 2
+    total = sum(
+        sum(row.values()) for row in stats["perRule"].values()
+    )
+    assert total == stats["visible"] + stats["suppressed"]
+
+
+def test_lint_stats_entry_point_matches_gate():
+    """The debug-bundle block agrees with the tier-1 gate: zero visible."""
+    stats = lint_stats()
+    assert stats["visible"] == 0
+
+
+# ---------------------------------------------------------------------------
+# CLI (`jobset-tpu lint`)
+# ---------------------------------------------------------------------------
+
+
+def _run_cli(*argv, cwd=None):
+    return subprocess.run(
+        [sys.executable, "-m", "jobset_tpu", "lint", *argv],
+        capture_output=True, text=True, cwd=cwd or ROOT, timeout=120,
+    )
+
+
+def test_cli_clean_tree_exits_zero():
+    proc = _run_cli(str(PACKAGE / "analysis"), "--stats")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    stats = json.loads(proc.stdout)
+    assert stats["visible"] == 0
+
+
+def test_cli_dirty_tree_exits_nonzero_and_github_format():
+    tree = str(FIXTURES / "determinism")
+    proc = _run_cli(tree)
+    assert proc.returncode == 1
+    assert "DET001 " in proc.stdout and ":12 " in proc.stdout
+    proc = _run_cli(tree, "--format", "github")
+    assert proc.returncode == 1
+    assert proc.stdout.startswith("::error file=")
+
+
+def test_cli_baseline_roundtrip(tmp_path):
+    """--update-baseline grandfathers every current finding; a rerun
+    against that baseline is clean; the baseline file is human-diffable."""
+    tree = str(FIXTURES / "determinism")
+    baseline = tmp_path / "baseline.txt"
+    proc = _run_cli(tree, "--baseline", str(baseline), "--update-baseline")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    entries = [
+        ln for ln in baseline.read_text().splitlines()
+        if ln and not ln.startswith("#")
+    ]
+    assert entries == sorted(entries) and entries
+    assert all(" " in e and ":" in e for e in entries)
+    proc = _run_cli(tree, "--baseline", str(baseline), "--stats")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    stats = json.loads(proc.stdout)
+    assert stats["visible"] == 0 and stats["suppressed"] >= len(entries)
+
+
+def test_update_baseline_is_idempotent(tmp_path):
+    """Regenerating twice must not lose still-firing grandfathered
+    entries: the rewrite ignores the existing baseline when deciding what
+    fires (a suppressed-by-baseline finding is still debt)."""
+    tree = str(FIXTURES / "determinism")
+    baseline = tmp_path / "baseline.txt"
+    _run_cli(tree, "--baseline", str(baseline), "--update-baseline")
+    first = baseline.read_text()
+    proc = _run_cli(tree, "--baseline", str(baseline), "--update-baseline")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert baseline.read_text() == first
+    assert _run_cli(tree, "--baseline", str(baseline)).returncode == 0
+
+
+def test_update_baseline_subset_path_preserves_other_entries(tmp_path):
+    """A subset-path --update-baseline run only regenerates entries for
+    the files it linted; grandfathered entries for everything else
+    survive."""
+    tree = FIXTURES / "determinism"
+    baseline = tmp_path / "baseline.txt"
+    _run_cli(str(tree), "--baseline", str(baseline), "--update-baseline")
+    all_entries = set(load_baseline(baseline))
+    bad = tree / "jobset_tpu" / "core" / "bad.py"
+    sup_entries = {e for e in all_entries if "suppressed.py" in e}
+    assert sup_entries, all_entries
+    proc = _run_cli(str(bad), "--baseline", str(baseline),
+                    "--update-baseline")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert set(load_baseline(baseline)) == all_entries
+
+
+def test_unreadable_file_is_a_finding_not_a_crash(tmp_path):
+    """A non-UTF-8 byte in one file surfaces as SYN001 — it must not
+    abort the whole gate with a traceback."""
+    pkg = tmp_path / "jobset_tpu" / "core"
+    pkg.mkdir(parents=True)
+    (tmp_path / "pyproject.toml").write_text("[project]\n")
+    (pkg / "latin.py").write_bytes(b"# caf\xe9\nx = 1\n")
+    (pkg / "ok.py").write_text("x = 2\n")
+    report = LintEngine(baseline=(), root=tmp_path).run([tmp_path])
+    syn = visible(report, "SYN001")
+    assert len(syn) == 1 and syn[0].path.endswith("latin.py"), (
+        report.render()
+    )
+
+
+def test_default_baseline_path_is_repo_root():
+    assert default_baseline_path(ROOT) == ROOT / "lint-baseline.txt"

@@ -1,24 +1,30 @@
 """Doc-drift lint: every registered metric family must be documented.
 
-The obs/chaos/queue planes each added metric families; a table row
-forgotten in docs/metrics.md silently rots the operator-facing reference.
-This test introspects the real registry (core/metrics.py) — not a
-hand-maintained list — so adding a Counter/Gauge/Histogram without a doc
-row fails CI.
+Since the invariant lint plane landed, the actual drift check lives in
+`jobset_tpu/analysis/rules/drift.py` (rule DRF001, alongside DRF002 for
+feature gates and DRF003 for chaos points) so all registries share one
+engine. This module stays as a thin wrapper: the named tests older CI
+configs and docs point at keep passing, now by delegating to the rule —
+plus a parity check proving the rule's static AST view of the registry
+matches the imported runtime registry, so the migration can't have
+silently narrowed coverage.
 """
 
 import pathlib
-import re
 
+from jobset_tpu.analysis import LintEngine
+from jobset_tpu.analysis.rules.drift import (
+    MetricsDocDriftRule,
+    registered_metric_families,
+)
 from jobset_tpu.core import metrics
 
-DOCS = pathlib.Path(__file__).parent.parent / "docs" / "metrics.md"
+ROOT = pathlib.Path(__file__).parent.parent
 
 
-def _documented_families() -> set[str]:
-    text = DOCS.read_text()
-    # Table rows document families as `backticked_metric_name` in col 1.
-    return set(re.findall(r"^\|\s*`([a-z0-9_]+)`", text, re.MULTILINE))
+def _drift_findings():
+    engine = LintEngine(rules={"DRF001": MetricsDocDriftRule()}, root=ROOT)
+    return engine.run([]).visible
 
 
 def _registered_families() -> dict[str, str]:
@@ -33,25 +39,36 @@ def _registered_families() -> dict[str, str]:
 
 
 def test_every_registered_metric_documented():
-    documented = _documented_families()
-    missing = {
-        name: kind
-        for name, kind in _registered_families().items()
-        if name not in documented
-    }
+    missing = [
+        f for f in _drift_findings() if f.path.endswith("metrics.py")
+    ]
     assert not missing, (
-        f"metric families missing from docs/metrics.md: {missing} — add a "
-        "table row (see the drift-check note in that file)"
+        "metric families missing from docs/metrics.md: "
+        f"{[f.message for f in missing]} — add a table row"
     )
 
 
 def test_documented_metrics_exist():
     """The inverse direction: a doc row for a metric that no longer exists
     is stale operator guidance."""
-    registered = set(_registered_families())
-    stale = _documented_families() - registered
+    stale = [
+        f for f in _drift_findings() if f.path.endswith("metrics.md")
+    ]
     assert not stale, (
-        f"docs/metrics.md documents unregistered metrics: {sorted(stale)}"
+        "docs/metrics.md documents unregistered metrics: "
+        f"{[f.message for f in stale]}"
+    )
+
+
+def test_rule_registry_matches_runtime_registry():
+    """DRF001 parses core/metrics.py statically; the set it sees must be
+    exactly the families the imported module registers, or the rule is
+    linting a different universe than the one the server exposes."""
+    static = set(registered_metric_families(ROOT))
+    runtime = set(_registered_families())
+    assert static == runtime, (
+        f"static-only: {sorted(static - runtime)}; "
+        f"runtime-only: {sorted(runtime - static)}"
     )
 
 

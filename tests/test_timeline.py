@@ -570,6 +570,10 @@ def test_debug_bundle_round_trips(server, client, tmp_path, capsys):
     # The bundled timeline is the same record the live endpoint serves.
     assert timeline == client.timeline("bundled")
     assert "jobset_build_info" in bundle["metrics.prom"]
+    # The lint-debt block (docs/static-analysis.md): the capturing build
+    # is lint-clean, and every suppression it carries is counted.
+    assert manifest["lint"]["visible"] == 0
+    assert manifest["lint"]["suppressed"] >= 1
     assert bundle["slo.json"]["timeToReadySeconds"]["count"] >= 1
     assert any(
         js["metadata"]["name"] == "bundled"
