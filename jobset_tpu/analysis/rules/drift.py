@@ -16,9 +16,15 @@ the two may not diverge in either direction.
   ``add_rule(...)`` with a literal point) appears in the point table of
   ``chaos/injector.py``'s module docstring; every documented point is
   still consulted somewhere (as a string literal in the package).
+* **DRF004** — every HTTP route ``server.py`` serves is covered by the
+  flow plane's classification table
+  (``flow/config.py::ROUTE_CLASSES``, docs/flow.md) and every
+  classification row still covers a served route. Coverage semantics
+  come from the runtime's own ``pattern_covers`` (a pure function), so
+  the check and the admission path cannot drift.
 
-All three parse the AST rather than importing the modules, so the rules
-also run against fixture trees and never execute project code.
+All of them parse the AST rather than importing the scanned modules, so
+the rules also run against fixture trees and never execute project code.
 """
 
 from __future__ import annotations
@@ -311,5 +317,170 @@ class ChaosPointDriftRule:
                         f"chaos/injector.py documents point '{point}' "
                         "but nothing in the package mentions it — stale "
                         "table row"
+                    ),
+                )
+
+
+# -- DRF004: HTTP route flow classification ----------------------------------
+
+_ROUTE_VARS = ("path", "bare")
+
+
+def served_routes(root: pathlib.Path) -> dict[str, tuple[str, int]]:
+    """Route literals served by server.py -> (relpath, line), from a
+    static parse: `path ==`/`path in (...)` comparisons,
+    `path.startswith("/...")` guards, `parts[:2] == [...]` prefix
+    matches, and `*_PREFIX` string-constant assignments."""
+    src = root / "jobset_tpu" / "server.py"
+    tree = _parse(src)
+    if tree is None:
+        return {}
+    rel = _rel(src, root)
+    routes: dict[str, tuple[str, int]] = {}
+
+    def add(value, lineno: int) -> None:
+        if isinstance(value, str) and value.startswith("/"):
+            routes.setdefault(value, (rel, lineno))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Compare) and len(node.ops) == 1:
+            left, op = node.left, node.ops[0]
+            right = node.comparators[0]
+            if (
+                isinstance(op, (ast.Eq, ast.In))
+                and isinstance(left, ast.Name)
+                and left.id in _ROUTE_VARS
+            ):
+                if isinstance(right, ast.Constant):
+                    add(right.value, node.lineno)
+                elif isinstance(right, (ast.Tuple, ast.List, ast.Set)):
+                    for elt in right.elts:
+                        if isinstance(elt, ast.Constant):
+                            add(elt.value, elt.lineno)
+            elif (
+                isinstance(op, ast.Eq)
+                and isinstance(right, (ast.List, ast.Tuple))
+                and right.elts
+                and all(
+                    isinstance(e, ast.Constant) and isinstance(e.value, str)
+                    for e in right.elts
+                )
+                and (
+                    (isinstance(left, ast.Name) and left.id == "parts")
+                    or (
+                        isinstance(left, ast.Subscript)
+                        and isinstance(left.value, ast.Name)
+                        and left.value.id == "parts"
+                    )
+                )
+            ):
+                # parts[:2] == ["api", "v1"]  ->  the "/api/v1" route.
+                add(
+                    "/" + "/".join(e.value for e in right.elts),
+                    node.lineno,
+                )
+        elif (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "startswith"
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id in _ROUTE_VARS
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+        ):
+            add(node.args[0].value, node.lineno)
+        elif isinstance(node, ast.Assign) and isinstance(
+            node.value, ast.Constant
+        ):
+            names = {
+                t.id for t in node.targets if isinstance(t, ast.Name)
+            }
+            if any(n.endswith("PREFIX") for n in names):
+                add(node.value.value, node.lineno)
+    return routes
+
+
+def classified_routes(root: pathlib.Path) -> dict[str, tuple[str, int]]:
+    """pattern -> (class, line) rows of flow/config.py::ROUTE_CLASSES
+    (static parse — fixture trees carry their own table)."""
+    src = root / "jobset_tpu" / "flow" / "config.py"
+    tree = _parse(src)
+    if tree is None:
+        return {}
+    rows: dict[str, tuple[str, int]] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        value = getattr(node, "value", None)
+        if not isinstance(value, ast.Tuple):
+            continue
+        targets = (
+            node.targets if isinstance(node, ast.Assign) else [node.target]
+        )
+        if "ROUTE_CLASSES" not in {
+            t.id for t in targets if isinstance(t, ast.Name)
+        }:
+            continue
+        for elt in value.elts:
+            if (
+                isinstance(elt, ast.Tuple)
+                and len(elt.elts) == 2
+                and all(
+                    isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)
+                    for e in elt.elts
+                )
+            ):
+                rows.setdefault(
+                    elt.elts[0].value, (elt.elts[1].value, elt.lineno)
+                )
+    return rows
+
+
+@register
+class RouteFlowClassDriftRule:
+    NAME = "DRF004"
+    DESCRIPTION = (
+        "HTTP route served by server.py without a flow-plane "
+        "classification row in flow/config.py::ROUTE_CLASSES (or a "
+        "stale classification row covering no served route)"
+    )
+
+    def check_project(self, root: pathlib.Path) -> Iterator[Finding]:
+        served = served_routes(root)
+        classified = classified_routes(root)
+        if not served or not classified:
+            return
+        # The MATCHING semantics come from the runtime itself (a pure
+        # function: exact match, or prefix with an implied "/"), so the
+        # check and the admission path cannot disagree about coverage.
+        from ...flow.config import pattern_covers
+
+        for route, (relpath, line) in sorted(served.items()):
+            if not any(
+                pattern_covers(pattern, route) for pattern in classified
+            ):
+                yield Finding(
+                    rule=self.NAME, path=relpath, line=line,
+                    message=(
+                        f"route '{route}' is served here but has no "
+                        "ROUTE_CLASSES row in flow/config.py — decide "
+                        "its priority class (an exempt-worthy endpoint "
+                        "left unclassified sheds with user traffic)"
+                    ),
+                )
+        config_rel = _rel(
+            root / "jobset_tpu" / "flow" / "config.py", root
+        )
+        for pattern, (_cls, line) in sorted(classified.items()):
+            if not any(
+                pattern_covers(pattern, route) for route in served
+            ):
+                yield Finding(
+                    rule=self.NAME, path=config_rel, line=line,
+                    message=(
+                        f"ROUTE_CLASSES classifies '{pattern}' but "
+                        "server.py serves no such route — stale row, "
+                        "drop or fix it"
                     ),
                 )

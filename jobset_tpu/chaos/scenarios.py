@@ -524,6 +524,209 @@ def leader_kill(
         replica_set.stop()
 
 
+# ---------------------------------------------------------------------------
+# Flow-control scenarios (jobset_tpu/flow, docs/flow.md)
+# ---------------------------------------------------------------------------
+
+# Storm-sized priority levels for `thundering_herd`: tiny seat pools so a
+# sequential driver saturates them with `FlowController.hold`, and ZERO
+# queue-wait budgets so a parked arrival sheds instantly instead of
+# sleeping — the whole storm runs in virtual time. workload-low carries
+# no queues at all (saturation sheds), workload-high keeps small sharded
+# queues (its sheds are wait-budget timeouts), and the single watch seat
+# forces the thread-free partial-batch path.
+def _herd_levels():
+    from ..flow import PriorityLevel
+
+    return (
+        PriorityLevel("exempt", seats=0),
+        PriorityLevel("system", seats=4, queues=2, queue_length=8,
+                      queue_wait_s=0.0),
+        PriorityLevel("workload-high", seats=2, queues=2, queue_length=2,
+                      queue_wait_s=0.0),
+        PriorityLevel("workload-low", seats=2, queues=0),
+        PriorityLevel("watch", seats=1),
+    )
+
+
+def thundering_herd(
+    arrivals: int = 240,
+    tenants: int = 6,
+    seed: int = 23,
+    latency_fault_rate: float = 0.1,
+) -> dict:
+    """Seeded overload storm against a flow-controlled controller server
+    (the flow plane's acceptance scenario, driven by ``bench.py
+    --overload``'s deterministic sibling and the flow tests).
+
+    A sequential driver — every arrival completes before the next, so
+    the run is a pure function of the seed — fires a mixed multi-tenant
+    request storm through ``ControllerServer._route`` while
+    ``FlowController.hold`` keeps the workload/watch seat pools
+    saturated (the stand-in for a real concurrent herd):
+
+    * phase ``storm``: low-priority creates shed 429 (no queues:
+      ``saturated``), high-priority creates shed 429 at the zero wait
+      budget (``timeout``) until one held seat is released mid-storm —
+      after which high traffic lands while low traffic keeps shedding
+      (the fairness split); watches answer immediate partial batches
+      with retry hints; ``/debug/health`` (exempt) always executes.
+    * phase ``recover``: every hold is released and the tail of the
+      storm lands clean.
+
+    ``apiserver.request`` latency faults (zero-delay, so the log records
+    arrivals without costing wall time) ride along at
+    ``latency_fault_rate`` — they only see requests that SURVIVED
+    admission, pinning the shed-before-everything contract into the
+    injection log.
+
+    Returns the flow decision log, the injector's injection log, and the
+    final cluster state — all deterministic: two runs with the same seed
+    are byte-identical (``tests/test_flow.py`` asserts it), and no
+    429'd create may leave an object behind (``leaked_shed_objects``
+    must come back empty).
+    """
+    import random
+
+    from ..api import serialization
+    from ..core import make_cluster
+    from ..flow import FlowController
+    from ..server import ControllerServer
+    from ..testing import make_jobset, make_replicated_job
+    from ..utils.clock import FakeClock
+    from .injector import KIND_LATENCY
+
+    injector = FaultInjector(seed=seed)
+    if latency_fault_rate > 0:
+        injector.add_rule(
+            "apiserver.request", KIND_LATENCY,
+            rate=latency_fault_rate, delay_s=0.0,
+        )
+    flow = FlowController(levels=_herd_levels(), seed=seed)
+    cluster = make_cluster(clock=FakeClock())
+    # Never started: requests are driven straight through _route (no
+    # handler threads, no pump — the arrival order IS the program order).
+    server = ControllerServer(
+        cluster=cluster, tick_interval=3600.0,
+        injector=injector, flow=flow,
+    )
+    api = f"{server.API_PREFIX}/namespaces/default/jobsets"
+    rng = random.Random(seed)
+
+    def jobset_body(name: str, priority) -> bytes:
+        js = (
+            make_jobset(name)
+            .replicated_job(
+                make_replicated_job("w").replicas(1)
+                .parallelism(1).completions(1).obj()
+            )
+            .suspend(True)
+            .obj()
+        )
+        if priority is not None:
+            js.spec.priority = priority
+        return serialization.to_yaml(js).encode()
+
+    statuses: dict[str, dict[int, int]] = {}
+    shed_creates: list[str] = []
+    acked_creates: list[str] = []
+    n = 0
+
+    def drive(phase: str) -> None:
+        nonlocal n
+        n += 1
+        tenant = rng.randrange(tenants)
+        op = rng.choices(
+            ("create-low", "create-high", "list", "watch", "health"),
+            weights=(5, 2, 2, 1, 1),
+        )[0]
+        headers = {"user-agent": f"herd-tenant-{tenant}"}
+        if op == "create-low":
+            name = f"herd-{n:04d}"
+            result = server._route(
+                "POST", api, jobset_body(name, None), headers=headers
+            )
+        elif op == "create-high":
+            name = f"herd-{n:04d}"
+            result = server._route(
+                "POST", api, jobset_body(name, 120), headers=headers
+            )
+        elif op == "list":
+            result = server._route("GET", api, b"", headers=headers)
+        elif op == "watch":
+            result = server._route(
+                "GET", f"{api}?watch=1&resourceVersion=0&timeoutSeconds=0",
+                b"", headers=headers,
+            )
+        else:
+            result = server._route(
+                "GET", "/debug/health", b"", headers=headers
+            )
+        status = result[0]
+        per = statuses.setdefault(phase, {})
+        per[status] = per.get(status, 0) + 1
+        if op.startswith("create"):
+            (acked_creates if status == 201 else shed_creates).append(name)
+
+    try:
+        held_low = flow.hold("workload-low", 2)
+        held_high = flow.hold("workload-high", 2)
+        held_watch = flow.hold("watch", 1)
+        for i in range(arrivals):
+            if i == arrivals // 2:
+                # Mid-storm partial recovery: ONE high seat frees, so
+                # high-priority writes start landing while low-priority
+                # traffic keeps shedding — the fairness split the plane
+                # exists for.
+                flow.release(held_high.pop())
+            drive("storm")
+        for ticket in held_low + held_high + held_watch:
+            flow.release(ticket)
+        for _ in range(max(1, arrivals // 3)):
+            drive("recover")
+    finally:
+        server._stop.set()
+        server._httpd.server_close()
+
+    with server.lock:
+        leaked = [
+            name for name in shed_creates
+            if cluster.get_jobset("default", name) is not None
+        ]
+        final_state = {
+            "resourceVersion": server._watch_rv,
+            "jobsets": [
+                {
+                    "namespace": ns,
+                    "name": name,
+                    "uid": js.metadata.uid,
+                    "priority": js.spec.priority,
+                }
+                for (ns, name), js in sorted(cluster.jobsets.items())
+            ],
+        }
+    # Stringified statuses so the dict survives a JSON round trip
+    # unchanged (byte-identity is asserted over json.dumps).
+    return {
+        "scenario": "thundering_herd",
+        "seed": seed,
+        "tenants": tenants,
+        "arrivals": n,
+        "statuses": {
+            phase: {str(code): count for code, count in sorted(per.items())}
+            for phase, per in sorted(statuses.items())
+        },
+        "acked_creates": len(acked_creates),
+        "shed_creates": len(shed_creates),
+        "leaked_shed_objects": leaked,
+        "rejected_total": flow.rejected_total(),
+        "flow": flow.snapshot(),
+        "decision_log": flow.log_snapshot(),
+        "injection_log": injector.log_snapshot(),
+        "final_state": final_state,
+    }
+
+
 def follower_kill(
     base_dir: str,
     writes: int = 12,

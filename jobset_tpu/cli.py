@@ -124,6 +124,19 @@ def _build_parser() -> argparse.ArgumentParser:
                         "solve (remote or local) exceeding it degrades the "
                         "provider to the greedy path for a cool-off window "
                         "(0 = unlimited)")
+    c.add_argument(
+        "--flow", action="store_true",
+        help="enable API priority & fairness on the request path "
+             "(docs/flow.md): per-level inflight seats, shuffle-sharded "
+             "bounded queues, 429 + Retry-After load shedding; /debug/*, "
+             "/ha/* and probe traffic stay exempt (same as "
+             "--feature-gates APIFlowControl=true)",
+    )
+    c.add_argument(
+        "--flow-seed", type=int, default=0,
+        help="seed for the flow plane's shuffle-shard queue assignment "
+             "(deterministic per (seed, flow); default 0)",
+    )
     c.add_argument("--data-dir", default="", metavar="DIR",
                    help="durable control-plane state directory (WAL + "
                         "snapshots; docs/persistence.md): committed writes "
@@ -304,6 +317,13 @@ def _cmd_controller(args) -> int:
     from .core import features
     from .server import ControllerServer
 
+    if args.flow:
+        # --flow is sugar for the gate; replicated standby/leader servers
+        # (and every promotion rebuild) then construct their own
+        # FlowController from the gate. The single-replica path below
+        # additionally threads --flow-seed through.
+        features.set_gate("APIFlowControl", True)
+
     if args.replicate:
         return _cmd_controller_replicated(args)
 
@@ -377,10 +397,17 @@ def _cmd_controller(args) -> int:
             lease_duration=args.lease_duration,
             retry_period=args.lease_retry_period,
         )
+    flow = None
+    if features.enabled("APIFlowControl"):
+        # Built here (not via the server's gate fallback) so --flow-seed
+        # reaches the shuffle-shard hash.
+        from .flow import FlowController
+
+        flow = FlowController(seed=args.flow_seed)
     server = ControllerServer(args.addr, cluster=cluster,
                               tick_interval=args.tick_interval,
                               tls_cert=tls_cert, tls_key=tls_key,
-                              elector=elector,
+                              elector=elector, flow=flow,
                               # Separate-process replicas have private
                               # state: a standby must not accept writes the
                               # leader would never observe.
@@ -390,6 +417,7 @@ def _cmd_controller(args) -> int:
           f"(solver={'sidecar ' + args.solver_addr if args.solver_addr else 'in-process'}"
           + (f", leader-elect as {elector.identity}" if elector else "")
           + (f", data-dir {args.data_dir}" if store is not None else "")
+          + (", flow-control on" if flow is not None else "")
           + ")",
           flush=True)
     _wait_for_signal()

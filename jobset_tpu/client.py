@@ -24,10 +24,16 @@ from .obs import trace as obs_trace
 
 
 class ApiError(Exception):
-    def __init__(self, status: int, message: str):
+    def __init__(self, status: int, message: str,
+                 retry_after: Optional[float] = None):
         super().__init__(f"HTTP {status}: {message}")
         self.status = status
         self.message = message
+        # Server-provided Retry-After hint in seconds (None when absent):
+        # the flow-control plane's 429 sheds and every 503 write fence
+        # carry one so clients back off at the server's pace instead of
+        # guessing.
+        self.retry_after = retry_after
 
 
 # Statuses a GET may safely retry: the request was never processed (503
@@ -35,6 +41,31 @@ class ApiError(Exception):
 # server-side (500). Mutations are NOT retried — an apiserver 500 may have
 # landed the write, and the caller owns that ambiguity.
 _RETRYABLE_STATUSES = frozenset({429, 500, 502, 503, 504})
+
+# Statuses whose Retry-After hint is authoritative pacing (flow-control
+# sheds and write fences); other retryables keep the jittered backoff.
+_HINTED_STATUSES = frozenset({429, 503})
+
+# Ceiling on an honored Retry-After hint — the same bound the informer
+# watch-retry backoff already uses, so a confused server cannot park a
+# client arbitrarily long.
+RETRY_AFTER_CAP_S = 5.0
+
+
+def _parse_retry_after(value) -> Optional[float]:
+    """Retry-After header -> seconds. Only the delta-seconds form is
+    understood (our servers emit nothing else); anything unparsable OR
+    non-positive is treated as absent — honoring a zero hint as
+    "retry immediately" would turn the retry loop into a hot hammer on
+    a server that is actively shedding, so those fall back to the
+    jittered backoff."""
+    if not value:
+        return None
+    try:
+        seconds = float(value)
+    except (TypeError, ValueError):
+        return None
+    return seconds if seconds > 0 else None
 
 
 class JobSetClient:
@@ -58,12 +89,18 @@ class JobSetClient:
         backoff_base_s: float = 0.05,
         backoff_cap_s: float = 2.0,
         retry_seed: Optional[int] = None,
+        user_agent: Optional[str] = None,
     ):
         """ca_cert: path to the PEM CA that signed the controller's serving
         cert (utils/certs.py writes it as ca.crt) — enables https:// URLs
         with verification against the self-signed chain.
         retries: extra attempts for idempotent (GET) requests on 429/5xx
-        and transport errors; retry_seed makes the jitter reproducible."""
+        and transport errors; retry_seed makes the jitter reproducible.
+        user_agent: sent on every request — the flow-control plane's flow
+        distinguisher, so name your tenant/controller here for fair
+        shuffle-sharding (default: jobset-tpu-client/<version>)."""
+        from . import __version__
+
         if "://" not in base_url:
             base_url = f"{'https' if ca_cert else 'http'}://{base_url}"
         self.base_url = base_url.rstrip("/")
@@ -73,6 +110,11 @@ class JobSetClient:
         self.backoff_cap_s = backoff_cap_s
         self._retry_rng = random.Random(retry_seed)
         self.retried_requests = 0
+        self.user_agent = user_agent or f"jobset-tpu-client/{__version__}"
+        # Pacing hint from the last successful watch poll (the flow
+        # plane's saturated-watch-pool partial batches carry one); the
+        # informer consults it between polls.
+        self.last_watch_retry_after: Optional[float] = None
         self._ssl_context = None
         if ca_cert is not None:
             import ssl
@@ -87,6 +129,7 @@ class JobSetClient:
     def _request(self, method: str, path: str, body: Optional[bytes] = None,
                  content_type: str = "application/json"):
         headers = {"Content-Type": content_type} if body is not None else {}
+        headers["User-Agent"] = self.user_agent
         # Client span + W3C traceparent injection: the server extracts the
         # header and parents its apiserver.request span on this one, so a
         # single trace covers client -> apiserver -> reconcile -> solver.
@@ -118,9 +161,12 @@ class JobSetClient:
         GETs retry `self.retries` times on retryable statuses and raw
         transport errors (connection refused/reset — the server may be
         mid-restart) with exponential backoff + full jitter; every other
-        method gets exactly one attempt."""
+        method gets exactly one attempt. A 429/503 carrying a server
+        Retry-After hint is honored (capped at RETRY_AFTER_CAP_S) instead
+        of the jittered guess — the server knows its own queue pressure."""
         attempts = 1 + (self.retries if method == "GET" else 0)
         for attempt in range(attempts):
+            hint = None
             try:
                 return self._transport_once(method, path, body, headers)
             except ApiError as exc:
@@ -129,11 +175,16 @@ class JobSetClient:
                     or exc.status not in _RETRYABLE_STATUSES
                 ):
                     raise
+                if exc.status in _HINTED_STATUSES:
+                    hint = exc.retry_after
             except urllib.error.URLError:
                 if attempt + 1 >= attempts:
                     raise
             self.retried_requests += 1
-            self._backoff_sleep(attempt)
+            if hint is not None:
+                time.sleep(min(hint, RETRY_AFTER_CAP_S))
+            else:
+                self._backoff_sleep(attempt)
 
     def _transport_once(self, method: str, path: str, body, headers):
         """One HTTP round trip; returns (parsed payload, response status)."""
@@ -149,11 +200,13 @@ class JobSetClient:
                 status = resp.status
         except urllib.error.HTTPError as exc:
             detail = exc.read().decode(errors="replace")
+            retry_after = _parse_retry_after(exc.headers.get("Retry-After"))
             try:
                 detail = json.loads(detail).get("error", detail)
             except (json.JSONDecodeError, AttributeError):
                 pass
-            raise ApiError(exc.code, detail) from None
+            raise ApiError(exc.code, detail,
+                           retry_after=retry_after) from None
         if ctype.startswith("application/json"):
             return json.loads(data), status
         return data.decode(), status
@@ -256,7 +309,10 @@ class JobSetClient:
             f"&resourceVersion={int(resource_version)}"
             f"&timeoutSeconds={timeout}"
         )
-        req = urllib.request.Request(self.base_url + path, method="GET")
+        req = urllib.request.Request(
+            self.base_url + path, method="GET",
+            headers={"User-Agent": self.user_agent},
+        )
         try:
             with urllib.request.urlopen(
                 req, timeout=timeout + 10.0, context=self._ssl_context
@@ -264,9 +320,15 @@ class JobSetClient:
                 out = json.loads(resp.read())
         except urllib.error.HTTPError as exc:
             detail = exc.read().decode(errors="replace")
+            retry_after = _parse_retry_after(exc.headers.get("Retry-After"))
             if exc.code == 410:
                 raise WatchGone(410, detail) from None
-            raise ApiError(exc.code, detail) from None
+            raise ApiError(exc.code, detail,
+                           retry_after=retry_after) from None
+        # Saturated-watch-pool partial batches carry a pacing hint (the
+        # flow plane's thread-free long-poll mode); stash it for the
+        # informer loop. None on ordinary parked polls.
+        self.last_watch_retry_after = out.get("retryAfterSeconds")
         return out["events"], out["resourceVersion"]
 
     def list_resource_with_version(self, kind: str, namespace: str = "default"):
@@ -606,6 +668,15 @@ class ResourceInformer:
                     self._apply(event)
                 self._rv = rv
                 backoff = self.WATCH_BACKOFF_MIN_S  # healthy again
+                # Saturated watch pool: the server answered immediately
+                # (partial batch + hint) instead of parking the poll —
+                # honor the pacing hint (bounded) so re-polls don't spin.
+                hint = getattr(self.client, "last_watch_retry_after", None)
+                if hint:
+                    if self._stop.wait(
+                        min(float(hint), self.WATCH_BACKOFF_MAX_S)
+                    ):
+                        return
             except WatchGone:
                 try:
                     self._relist()
@@ -616,6 +687,23 @@ class ResourceInformer:
                     # die silently with a stale cache.
                     if self._stop.wait(backoff):
                         return
+                    backoff = min(backoff * 2, self.WATCH_BACKOFF_MAX_S)
+            except ApiError as exc:
+                # Throttled (429 shed) or fenced (503): a server hint is
+                # authoritative pacing, capped at the same ceiling the
+                # exponential path respects; without one, back off as for
+                # any transport error. Either way resume with the SAME
+                # resourceVersion — the journal holds the gap.
+                hint = (
+                    exc.retry_after
+                    if exc.status in _HINTED_STATUSES else None
+                )
+                if self._stop.wait(
+                    min(hint, self.WATCH_BACKOFF_MAX_S)
+                    if hint is not None else backoff
+                ):
+                    return
+                if hint is None:
                     backoff = min(backoff * 2, self.WATCH_BACKOFF_MAX_S)
             except Exception:
                 # Transient transport error: back off (bounded, growing)

@@ -25,6 +25,11 @@ _DEFAULTS: dict[str, bool] = {
     # mode banks regret while the auction solver still places; active
     # mode places from the scores with the solver as fallback.
     "TPULearnedPlacer": False,
+    # API priority & fairness for the apiserver path (jobset_tpu/flow,
+    # docs/flow.md): per-level seat budgets, shuffle-sharded bounded
+    # queues, and 429 + Retry-After load shedding in front of request
+    # routing; /debug/*, /ha/* and lease/leader traffic stay exempt.
+    "APIFlowControl": False,
 }
 
 _gates: dict[str, bool] = dict(_DEFAULTS)

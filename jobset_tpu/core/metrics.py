@@ -361,6 +361,26 @@ policy_model_loaded = Gauge(
     "1 while a learned-policy checkpoint is loaded and scoreable, 0 when "
     "missing/corrupt (active mode is falling back to the solver)",
 )
+# API flow-control plane (jobset_tpu/flow, docs/flow.md): the priority &
+# fairness analog in front of the apiserver path.
+flow_inflight = Gauge(
+    "jobset_flow_inflight",
+    "Requests currently executing (holding a seat) per flow-control "
+    "priority level",
+    label_names=("level",),
+)
+flow_rejected_total = Counter(
+    "jobset_flow_rejected_total",
+    "Requests shed by the flow-control plane, per priority level and "
+    "reason (queue_full/timeout/saturated answered 429 + Retry-After; "
+    "watch_busy answered 200 with a partial batch + retry hint)",
+    label_names=("level", "reason"),
+)
+flow_queue_wait_seconds = Histogram(
+    "jobset_flow_queue_wait_seconds",
+    "Time a request spent parked in its priority level's queue before "
+    "being granted a seat or shed at the wait budget",
+)
 
 
 def set_build_info(version: str, backend: str, gates: str,
@@ -391,6 +411,7 @@ ALL_COUNTERS = (
     ha_failovers_total,
     policy_decisions_total,
     policy_fallbacks_total,
+    flow_rejected_total,
 )
 ALL_HISTOGRAMS = (
     reconcile_time_seconds,
@@ -401,6 +422,7 @@ ALL_HISTOGRAMS = (
     slo_time_to_ready_seconds,
     slo_restart_recovery_seconds,
     policy_regret,
+    flow_queue_wait_seconds,
 )
 ALL_GAUGES = (
     solver_batch_occupancy,
@@ -417,6 +439,7 @@ ALL_GAUGES = (
     ha_commit_seq,
     ha_follower_lag_records,
     policy_model_loaded,
+    flow_inflight,
 )
 
 

@@ -1,0 +1,56 @@
+"""API priority & fairness analog for the controller server (docs/flow.md).
+
+``config`` declares priority levels, flow schemas and the DRF004-checked
+route classification table; ``controller`` implements seat accounting,
+shuffle-sharded bounded queueing, and 429-shedding. Enabled by the
+``APIFlowControl`` feature gate (or an explicit ``FlowController`` passed
+to ``ControllerServer``).
+"""
+
+from .config import (
+    DEFAULT_LEVELS,
+    DEFAULT_SCHEMAS,
+    HIGH_PRIORITY_THRESHOLD,
+    ROUTE_CLASSES,
+    FlowSchema,
+    PriorityLevel,
+    RequestInfo,
+    classify,
+    request_info,
+    route_class,
+)
+from .controller import (
+    BUSY,
+    EXECUTE,
+    QUEUED,
+    REASON_QUEUE_FULL,
+    REASON_SATURATED,
+    REASON_TIMEOUT,
+    REASON_WATCH_BUSY,
+    REJECT,
+    FlowController,
+    FlowTicket,
+)
+
+__all__ = [
+    "BUSY",
+    "DEFAULT_LEVELS",
+    "DEFAULT_SCHEMAS",
+    "EXECUTE",
+    "FlowController",
+    "FlowSchema",
+    "FlowTicket",
+    "HIGH_PRIORITY_THRESHOLD",
+    "PriorityLevel",
+    "QUEUED",
+    "REASON_QUEUE_FULL",
+    "REASON_SATURATED",
+    "REASON_TIMEOUT",
+    "REASON_WATCH_BUSY",
+    "REJECT",
+    "ROUTE_CLASSES",
+    "RequestInfo",
+    "classify",
+    "request_info",
+    "route_class",
+]

@@ -1,0 +1,297 @@
+"""Flow-control configuration: priority levels, flow schemas, and the
+route classification table.
+
+The kube-apiserver survives request storms with API Priority & Fairness
+(KEP-1040): requests are matched by *FlowSchemas* into *PriorityLevels*,
+each with a bounded concurrency budget ("seats") and bounded queues that
+shuffle-shard flows so one noisy tenant cannot occupy every queue. This
+module is the declarative half of our analog:
+
+* :class:`PriorityLevel` — seats, queue geometry, queue-wait budget and
+  the ``Retry-After`` hint sheds carry.
+* :class:`FlowSchema` — matching rules over the request descriptor
+  (verb, resource kind, namespace, user-agent prefix, JobSet
+  ``spec.priority``); first match wins, ordered.
+* ``ROUTE_CLASSES`` — the exempt/classified partition of every HTTP
+  route the controller server registers. Lint rule **DRF004**
+  (docs/static-analysis.md) machine-checks this table against
+  ``server.py``'s route literals in both directions: an unclassified
+  route and a stale classification row both fail the tier-1 gate.
+
+The runtime half (seat accounting, queueing, shedding) lives in
+:mod:`jobset_tpu.flow.controller`.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Optional
+from urllib.parse import parse_qs
+
+# ---------------------------------------------------------------------------
+# Route classification (the DRF004 contract)
+# ---------------------------------------------------------------------------
+
+# Every HTTP route served by `ControllerServer` maps to a route class.
+# A pattern ending in "/" matches as a prefix; otherwise it matches the
+# exact path or any subpath below it (`P` covers `P` and `P/...`).
+#
+# Classes:
+#   "exempt"       — never queued, never shed: observability (/debug/*,
+#                    probes, /metrics), replication internals (/ha/*) and
+#                    lease/leader traffic must keep working while user
+#                    traffic sheds, or the instruments that prove recovery
+#                    go blind exactly when they matter.
+#   "system"       — control-plane-to-control-plane traffic (admission
+#                    webhook reviews): bounded, generously queued.
+#   "workload"     — user API traffic; refined into workload-high /
+#                    workload-low by the FlowSchemas below.
+#   "workload-low" — fixed-low routes (schema discovery).
+ROUTE_CLASSES: tuple[tuple[str, str], ...] = (
+    ("/healthz", "exempt"),
+    ("/readyz", "exempt"),
+    ("/leaderz", "exempt"),
+    ("/metrics", "exempt"),
+    ("/debug/", "exempt"),
+    ("/ha/", "exempt"),
+    ("/openapi/v2", "workload-low"),
+    ("/validate-jobset-x-k8s-io-v1alpha2-jobset", "system"),
+    ("/mutate-jobset-x-k8s-io-v1alpha2-jobset", "system"),
+    ("/apis/jobset.x-k8s.io/v1alpha2", "workload"),
+    ("/api/v1", "workload"),
+)
+
+# JobSet spec.priority at or above this classifies the write as
+# workload-high (the Tesserae-style mixed-priority tenant split).
+HIGH_PRIORITY_THRESHOLD = 100
+
+
+def pattern_covers(pattern: str, path: str) -> bool:
+    """Whether a ROUTE_CLASSES pattern matches a path (shared with the
+    DRF004 lint so the runtime and the check cannot drift)."""
+    if path == pattern:
+        return True
+    prefix = pattern if pattern.endswith("/") else pattern + "/"
+    return path.startswith(prefix)
+
+
+def route_class(bare_path: str) -> str:
+    """Longest-match classification of a bare (query-stripped) path.
+    Unknown paths (404s) fall through to "workload" so junk traffic is
+    subject to the same fairness budget as real user traffic."""
+    best_pattern, best_class = "", "workload"
+    for pattern, cls in ROUTE_CLASSES:
+        if pattern_covers(pattern, bare_path) and len(pattern) > len(
+            best_pattern
+        ):
+            best_pattern, best_class = pattern, cls
+    return best_class
+
+
+# ---------------------------------------------------------------------------
+# Priority levels
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class PriorityLevel:
+    """One bounded concurrency class (the APF PriorityLevelConfiguration
+    analog).
+
+    ``seats``: concurrent executing requests; <= 0 means unlimited (the
+    exempt class). ``queues``/``queue_length``: shuffle-sharded bounded
+    FIFO parking for arrivals past the seats; 0 queues means saturation
+    sheds (or, for watch long-polls, answers an immediate partial batch).
+    ``queue_wait_s``: how long a parked request may wait for a seat
+    before it is shed with 429. ``retry_after_s``: the Retry-After hint
+    stamped on sheds and watch-busy hints. ``hand_size``: how many
+    candidate queues one flow shuffle-shards across."""
+
+    name: str
+    seats: int
+    queues: int = 0
+    queue_length: int = 0
+    queue_wait_s: float = 0.0
+    retry_after_s: float = 1.0
+    hand_size: int = 2
+
+
+# Level names used by the default config (and the health/metrics labels).
+LEVEL_EXEMPT = "exempt"
+LEVEL_SYSTEM = "system"
+LEVEL_HIGH = "workload-high"
+LEVEL_LOW = "workload-low"
+LEVEL_WATCH = "watch"
+
+DEFAULT_LEVELS: tuple[PriorityLevel, ...] = (
+    PriorityLevel(LEVEL_EXEMPT, seats=0),
+    PriorityLevel(LEVEL_SYSTEM, seats=16, queues=2, queue_length=32,
+                  queue_wait_s=5.0),
+    PriorityLevel(LEVEL_HIGH, seats=16, queues=8, queue_length=16,
+                  queue_wait_s=2.0),
+    PriorityLevel(LEVEL_LOW, seats=16, queues=8, queue_length=16,
+                  queue_wait_s=1.0, retry_after_s=2.0),
+    # Long-poll watches get their own seat pool so parked polls cannot
+    # exhaust the handler threads user writes need; past the pool a
+    # watch is answered immediately with a partial batch + retry hint
+    # instead of parking (never 429 — watches are reads).
+    PriorityLevel(LEVEL_WATCH, seats=32),
+)
+
+
+# ---------------------------------------------------------------------------
+# Flow schemas
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class FlowSchema:
+    """One matching rule routing requests of the "workload" route class
+    into a priority level (the APF FlowSchema analog). Empty tuples
+    match anything; ``min_priority`` matches JobSet writes whose peeked
+    ``spec.priority`` is at least the bound."""
+
+    name: str
+    level: str
+    verbs: tuple[str, ...] = ()
+    kinds: tuple[str, ...] = ()
+    namespaces: tuple[str, ...] = ()
+    user_agent_prefixes: tuple[str, ...] = ()
+    min_priority: Optional[int] = None
+
+    def matches(self, info: "RequestInfo") -> bool:
+        if self.verbs and info.verb not in self.verbs:
+            return False
+        if self.kinds and info.kind not in self.kinds:
+            return False
+        if self.namespaces and info.namespace not in self.namespaces:
+            return False
+        if self.user_agent_prefixes and not any(
+            info.user_agent.startswith(p) for p in self.user_agent_prefixes
+        ):
+            return False
+        if self.min_priority is not None and (
+            info.priority is None or info.priority < self.min_priority
+        ):
+            return False
+        return True
+
+
+DEFAULT_SCHEMAS: tuple[FlowSchema, ...] = (
+    # High-priority gang writes ride the protected level: a priority>=100
+    # JobSet create/update must land even while best-effort traffic sheds.
+    FlowSchema("high-priority-gangs", level=LEVEL_HIGH, kinds=("jobsets",),
+               min_priority=HIGH_PRIORITY_THRESHOLD),
+    # Cluster operations (queue quota admin, node lifecycle) are operator
+    # traffic, not tenant traffic.
+    FlowSchema("cluster-ops", level=LEVEL_HIGH, kinds=("queues", "nodes")),
+    FlowSchema("catch-all", level=LEVEL_LOW),
+)
+
+
+# ---------------------------------------------------------------------------
+# Request descriptor
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class RequestInfo:
+    """Everything the classifier sees about one arrival."""
+
+    method: str
+    path: str  # bare (query-stripped)
+    verb: str  # create/update/delete/patch/get/watch
+    kind: str  # jobsets/queues/nodes/pods/jobs/services/events/webhooks/""
+    namespace: str
+    user_agent: str
+    priority: Optional[int] = None
+    is_watch: bool = False
+
+    @property
+    def flow_key(self) -> str:
+        """The flow distinguisher: (client identity, namespace) — one
+        tenant's storm shuffle-shards away from another's."""
+        return f"{self.user_agent}|{self.namespace}"
+
+
+# Cheap spec.priority peek over the first bytes of a JobSet manifest —
+# works for both JSON (`"priority": 100`) and YAML (`priority: 100`)
+# without paying a full parse on a request that may be shed anyway (the
+# admission chain re-parses authoritatively after admission).
+_PRIORITY_RE = re.compile(rb'[\'"]?priority[\'"]?\s*:\s*(-?\d+)')
+_PEEK_BYTES = 4096
+
+_GROUP_PREFIX = "/apis/jobset.x-k8s.io/v1alpha2"
+
+
+def _peek_priority(body: bytes) -> Optional[int]:
+    m = _PRIORITY_RE.search(body[:_PEEK_BYTES])
+    return int(m.group(1)) if m else None
+
+
+def _resource_kind(bare: str) -> str:
+    parts = [p for p in bare.split("/") if p]
+    if bare.startswith(_GROUP_PREFIX):
+        if len(parts) >= 4 and parts[3] == "queues":
+            return "queues"
+        return "jobsets"
+    if parts[:2] == ["api", "v1"] and len(parts) >= 3:
+        if parts[2] == "namespaces" and len(parts) >= 5:
+            return parts[4]
+        return parts[2]  # nodes, events
+    if bare.startswith("/validate-") or bare.startswith("/mutate-"):
+        return "webhooks"
+    return ""
+
+
+def _namespace_of(bare: str) -> str:
+    parts = [p for p in bare.split("/") if p]
+    try:
+        i = parts.index("namespaces")
+    except ValueError:
+        return ""
+    return parts[i + 1] if i + 1 < len(parts) else ""
+
+
+_VERBS = {"POST": "create", "PUT": "update", "DELETE": "delete",
+          "PATCH": "patch"}
+
+
+def request_info(method: str, path: str, body: bytes = b"",
+                 headers: Optional[dict] = None) -> RequestInfo:
+    """Build the classifier's request descriptor from the raw request."""
+    bare, _, query = path.partition("?")
+    is_watch = bool(parse_qs(query).get("watch"))
+    kind = _resource_kind(bare)
+    priority = None
+    if kind == "jobsets" and method in ("POST", "PUT") and body:
+        priority = _peek_priority(body)
+    return RequestInfo(
+        method=method,
+        path=bare,
+        verb="watch" if is_watch else _VERBS.get(method, "get"),
+        kind=kind,
+        namespace=_namespace_of(bare),
+        user_agent=(headers or {}).get("user-agent") or "",
+        priority=priority,
+        is_watch=is_watch,
+    )
+
+
+def classify(info: RequestInfo,
+             schemas: tuple[FlowSchema, ...] = DEFAULT_SCHEMAS) -> str:
+    """Request descriptor -> priority level name. Route class first
+    (exempt and fixed classes bypass the schemas), then watches to the
+    watch pool, then the first matching FlowSchema."""
+    cls = route_class(info.path)
+    if cls == LEVEL_EXEMPT:
+        return LEVEL_EXEMPT
+    if info.is_watch:
+        return LEVEL_WATCH
+    if cls != "workload":
+        return cls
+    for schema in schemas:
+        if schema.matches(info):
+            return schema.level
+    return LEVEL_LOW

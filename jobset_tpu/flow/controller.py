@@ -1,0 +1,335 @@
+"""Flow controller: seat accounting, shuffle-sharded queues, shedding.
+
+The runtime half of the API Priority & Fairness analog (config lives in
+:mod:`jobset_tpu.flow.config`). One :class:`FlowController` sits in
+front of ``ControllerServer._route``:
+
+* ``admit()`` classifies the arrival and either grants a seat
+  (``execute``), parks it in its level's shuffle-sharded bounded FIFO
+  queue until a seat frees or the wait budget expires, sheds it
+  (``reject`` -> the server answers ``429 + Retry-After`` BEFORE any
+  routing, so a shed request can never have side effects), or — for
+  watch long-polls past the watch seat pool — returns ``busy`` (the
+  server answers an immediate partial batch with a retry hint instead
+  of parking a handler thread).
+* ``release()`` frees the seat and hands it to the longest-waiting
+  parked request across the level's queues (global FIFO by arrival).
+
+Determinism: queue selection is *hash*-shuffle-sharded from
+``(seed, flow_key)`` — a pure function, no RNG state — and the bounded
+decision log records only (arrival seq, level, flow, decision, reason),
+never wall-clock values, so a seeded storm driven sequentially (see
+``chaos/scenarios.py::thundering_herd``) produces byte-identical logs.
+Time enters only through the injectable ``now`` callable (monotonic by
+default, a virtual clock in tests) and the real ``Event.wait`` used by
+the blocking path.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from .config import (
+    DEFAULT_LEVELS,
+    DEFAULT_SCHEMAS,
+    FlowSchema,
+    PriorityLevel,
+    RequestInfo,
+    classify,
+)
+
+# Ticket decisions.
+EXECUTE = "execute"
+QUEUED = "queued"
+REJECT = "reject"
+BUSY = "busy"
+
+# Shed reasons (the `reason` label of jobset_flow_rejected_total).
+REASON_QUEUE_FULL = "queue_full"   # the flow's sharded queue is at bound
+REASON_TIMEOUT = "timeout"         # parked past the level's wait budget
+REASON_SATURATED = "saturated"     # level has no queues and no free seat
+REASON_WATCH_BUSY = "watch_busy"   # watch pool full: answered 200 + hint,
+#                                    counted here for visibility, not a 429
+
+
+@dataclass
+class _Waiter:
+    """One parked request (owned by the controller lock)."""
+
+    seq: int
+    enqueued_at: float
+    queue_index: int
+    event: threading.Event = field(default_factory=threading.Event)
+    granted: bool = False
+
+
+@dataclass
+class FlowTicket:
+    """The admission outcome handed back to the server."""
+
+    level: str
+    decision: str
+    flow_key: str = ""
+    reason: str = ""
+    retry_after_s: float = 1.0
+    queue_wait_s: float = 0.0
+    waiter: Optional[_Waiter] = None
+
+
+class _LevelState:
+    def __init__(self, level: PriorityLevel):
+        self.level = level
+        self.executing = 0
+        self.queues: list[deque] = [deque() for _ in range(level.queues)]
+
+    def queued(self) -> int:
+        return sum(len(q) for q in self.queues)
+
+
+class FlowController:
+    """Thread-safe admission gate over a set of priority levels."""
+
+    MAX_LOG = 100_000  # bounded, large enough to diff a whole storm
+
+    def __init__(
+        self,
+        levels: Optional[tuple[PriorityLevel, ...]] = None,
+        schemas: Optional[tuple[FlowSchema, ...]] = None,
+        seed: int = 0,
+        now: Callable[[], float] = time.monotonic,
+    ):
+        self.seed = seed
+        self.schemas = tuple(schemas) if schemas is not None else DEFAULT_SCHEMAS
+        self._now = now
+        self._lock = threading.Lock()
+        self._levels = {
+            lv.name: _LevelState(lv) for lv in (levels or DEFAULT_LEVELS)
+        }
+        self._arrivals = 0
+        self._rejected: dict[tuple[str, str], int] = {}
+        self.log: list[dict] = []
+
+    # -- admission --------------------------------------------------------
+
+    def admit(self, info: RequestInfo, block: bool = True) -> FlowTicket:
+        """One arrival. Returns an ``execute``/``reject``/``busy`` ticket
+        (``queued`` only with ``block=False`` — resolve with
+        :meth:`resolve` after granting or expiring it; tests drive this
+        path deterministically on a virtual clock)."""
+        level_name = classify(info, self.schemas)
+        flow_key = info.flow_key
+        ticket = self._admit_locked_phase(level_name, flow_key, info.is_watch)
+        self._account(ticket)
+        if ticket.decision == QUEUED and block:
+            budget = self._levels[level_name].level.queue_wait_s
+            ticket.waiter.event.wait(budget)
+            ticket = self.resolve(ticket)
+        return ticket
+
+    def _admit_locked_phase(self, level_name: str, flow_key: str,
+                            is_watch: bool) -> FlowTicket:
+        with self._lock:
+            self._arrivals += 1
+            seq = self._arrivals
+            state = self._levels[level_name]
+            lv = state.level
+            if lv.seats <= 0 or state.executing < lv.seats:
+                state.executing += 1
+                self._log_locked(seq, level_name, flow_key, EXECUTE, "")
+                return FlowTicket(level=level_name, decision=EXECUTE,
+                                  flow_key=flow_key,
+                                  retry_after_s=lv.retry_after_s)
+            if is_watch:
+                # Watch pool saturated: the server answers an immediate
+                # partial batch + retry hint; no seat, no queue, no 429.
+                self._log_locked(seq, level_name, flow_key, BUSY,
+                                 REASON_WATCH_BUSY)
+                self._count_rejected_locked(level_name, REASON_WATCH_BUSY)
+                return FlowTicket(level=level_name, decision=BUSY,
+                                  flow_key=flow_key,
+                                  reason=REASON_WATCH_BUSY,
+                                  retry_after_s=lv.retry_after_s)
+            if lv.queues <= 0:
+                self._log_locked(seq, level_name, flow_key, REJECT,
+                                 REASON_SATURATED)
+                self._count_rejected_locked(level_name, REASON_SATURATED)
+                return FlowTicket(level=level_name, decision=REJECT,
+                                  flow_key=flow_key,
+                                  reason=REASON_SATURATED,
+                                  retry_after_s=lv.retry_after_s)
+            qi = self._shard(lv, state, flow_key)
+            if len(state.queues[qi]) >= lv.queue_length:
+                self._log_locked(seq, level_name, flow_key, REJECT,
+                                 REASON_QUEUE_FULL)
+                self._count_rejected_locked(level_name, REASON_QUEUE_FULL)
+                return FlowTicket(level=level_name, decision=REJECT,
+                                  flow_key=flow_key,
+                                  reason=REASON_QUEUE_FULL,
+                                  retry_after_s=lv.retry_after_s)
+            waiter = _Waiter(seq=seq, enqueued_at=self._now(),
+                             queue_index=qi)
+            state.queues[qi].append(waiter)
+            return FlowTicket(level=level_name, decision=QUEUED,
+                              flow_key=flow_key,
+                              retry_after_s=lv.retry_after_s,
+                              waiter=waiter)
+
+    def resolve(self, ticket: FlowTicket) -> FlowTicket:
+        """Finish a ``queued`` ticket: granted waiters become ``execute``
+        (their seat was already taken by the granting release), anything
+        else is shed as a ``timeout``. The blocking admit path calls this
+        after ``Event.wait``; deterministic tests call it directly after
+        advancing the virtual clock or releasing a held seat."""
+        waiter = ticket.waiter
+        with self._lock:
+            state = self._levels[ticket.level]
+            wait_s = max(0.0, self._now() - waiter.enqueued_at)
+            ticket.queue_wait_s = wait_s
+            if waiter.granted:
+                # release() granted under this same lock and already
+                # dequeued the waiter; the seat is ours.
+                ticket.decision = EXECUTE
+            else:
+                state.queues[waiter.queue_index].remove(waiter)
+                ticket.decision = REJECT
+                ticket.reason = REASON_TIMEOUT
+                self._count_rejected_locked(ticket.level, REASON_TIMEOUT)
+            self._log_locked(waiter.seq, ticket.level, ticket.flow_key,
+                             ticket.decision, ticket.reason)
+        self._account(ticket, queue_wait=True)
+        return ticket
+
+    def release(self, ticket: FlowTicket) -> None:
+        """Free an executing ticket's seat and grant it to the longest-
+        waiting parked request of the level (global FIFO across the
+        sharded queues). ``reject``/``busy`` tickets hold nothing."""
+        if ticket is None or ticket.decision != EXECUTE:
+            return
+        grant: Optional[_Waiter] = None
+        with self._lock:
+            state = self._levels[ticket.level]
+            state.executing -= 1
+            lv = state.level
+            if lv.seats > 0 and state.executing < lv.seats:
+                grant = self._next_waiter_locked(state)
+                if grant is not None:
+                    grant.granted = True
+                    state.executing += 1
+            inflight = state.executing
+        from ..core import metrics
+
+        metrics.flow_inflight.set(inflight, ticket.level)
+        if grant is not None:
+            grant.event.set()
+
+    def hold(self, level: str, n: int) -> list[FlowTicket]:
+        """Acquire `n` seats of `level` directly (test/scenario hook:
+        simulates long-running in-flight requests so a sequential driver
+        can exercise saturation deterministically). Release each ticket
+        to free the seats."""
+        out = []
+        with self._lock:
+            state = self._levels[level]
+            for _ in range(n):
+                state.executing += 1
+                out.append(FlowTicket(level=level, decision=EXECUTE,
+                                      flow_key="hold"))
+            inflight = state.executing
+        from ..core import metrics
+
+        metrics.flow_inflight.set(inflight, level)
+        return out
+
+    # -- internals --------------------------------------------------------
+
+    def _shard(self, lv: PriorityLevel, state: _LevelState,
+               flow_key: str) -> int:
+        """Shuffle sharding: (seed, flow_key) hashes to a hand of
+        candidate queues; the flow enqueues on the least-loaded of its
+        hand. Pure function of (seed, flow, occupancy) — deterministic
+        under a seeded sequential driver, and a single hot flow cannot
+        occupy queues outside its hand."""
+        n = lv.queues
+        hand: list[int] = []
+        i = 0
+        while len(hand) < min(lv.hand_size, n):
+            digest = hashlib.blake2b(
+                f"{self.seed}/{flow_key}/{i}".encode(), digest_size=8
+            ).digest()
+            candidate = int.from_bytes(digest, "big") % n
+            if candidate not in hand:
+                hand.append(candidate)
+            i += 1
+        return min(hand, key=lambda qi: (len(state.queues[qi]),
+                                         hand.index(qi)))
+
+    @staticmethod
+    def _next_waiter_locked(state: _LevelState) -> Optional[_Waiter]:
+        best: Optional[deque] = None
+        for q in state.queues:
+            if q and (best is None or q[0].seq < best[0].seq):
+                best = q
+        return best.popleft() if best is not None else None
+
+    def _count_rejected_locked(self, level: str, reason: str) -> None:
+        key = (level, reason)
+        self._rejected[key] = self._rejected.get(key, 0) + 1
+
+    def _log_locked(self, seq: int, level: str, flow: str, decision: str,
+                    reason: str) -> None:
+        if len(self.log) < self.MAX_LOG:
+            self.log.append({
+                "seq": seq, "level": level, "flow": flow,
+                "decision": decision, "reason": reason,
+            })
+
+    def _account(self, ticket: FlowTicket, queue_wait: bool = False) -> None:
+        """Metrics, outside the controller lock (the handler pool must
+        not serialize on metric locks)."""
+        from ..core import metrics
+
+        if ticket.decision == EXECUTE:
+            with self._lock:
+                inflight = self._levels[ticket.level].executing
+            metrics.flow_inflight.set(inflight, ticket.level)
+        elif ticket.decision in (REJECT, BUSY):
+            metrics.flow_rejected_total.inc(ticket.level, ticket.reason)
+        if queue_wait:
+            metrics.flow_queue_wait_seconds.observe(ticket.queue_wait_s)
+
+    # -- introspection ----------------------------------------------------
+
+    def log_snapshot(self) -> list[dict]:
+        with self._lock:
+            return [dict(e) for e in self.log]
+
+    def rejected_total(self) -> int:
+        with self._lock:
+            return sum(
+                n for (_, reason), n in self._rejected.items()
+                if reason != REASON_WATCH_BUSY
+            )
+
+    def snapshot(self) -> dict:
+        """Per-level stats for /debug/health's `flow` component."""
+        with self._lock:
+            levels = {
+                name: {
+                    "seats": state.level.seats,
+                    "executing": state.executing,
+                    "queued": state.queued(),
+                    "queueWaitBudgetS": state.level.queue_wait_s,
+                }
+                for name, state in sorted(self._levels.items())
+            }
+            rejected: dict[str, dict[str, int]] = {}
+            for (level, reason), n in sorted(self._rejected.items()):
+                rejected.setdefault(level, {})[reason] = n
+            arrivals = self._arrivals
+        return {"levels": levels, "rejected": rejected,
+                "arrivals": arrivals}

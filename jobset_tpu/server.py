@@ -244,6 +244,7 @@ class ControllerServer:
         standby_accepts_writes: bool = True,
         injector=None,
         replication=None,
+        flow=None,
     ):
         if cluster is None:
             cluster = make_cluster(clock=Clock())
@@ -279,6 +280,15 @@ class ControllerServer:
         # WAL frame and acknowledges writes only at quorum), a FollowerLog
         # on a standby (serving the /ha/v1 append/position/log endpoints).
         self.replication = replication
+        # API priority & fairness (jobset_tpu/flow, docs/flow.md): a
+        # FlowController admits/queues/sheds every request BEFORE routing.
+        # Explicit `flow` wins; else the APIFlowControl gate selects the
+        # default config; else the path is unguarded (prior behavior).
+        if flow is None and features.enabled("APIFlowControl"):
+            from .flow import FlowController
+
+            flow = FlowController()
+        self.flow = flow
         self._ready = threading.Event()
         self._stop = threading.Event()
         # Graceful-drain fence (SIGTERM path): while set, mutating requests
@@ -799,12 +809,18 @@ class ControllerServer:
         return respond(True)
 
     def _watch_resource(
-        self, kind: str, ns: str, resource_version: int, timeout_s: float
+        self, kind: str, ns: str, resource_version: int, timeout_s: float,
+        park: bool = True, retry_hint: float = 1.0,
     ):
         """Long-poll: block until `kind` events newer than
         `resource_version` exist for namespace `ns` (or the timeout
         passes). Runs OUTSIDE self.lock — each request has its own handler
-        thread, and writes proceed while watchers wait."""
+        thread, and writes proceed while watchers wait.
+
+        ``park=False`` (flow control's saturated watch pool) answers ONE
+        pass immediately: whatever events are already available — possibly
+        an empty partial batch — plus a ``retryAfterSeconds`` hint, so the
+        poll costs no parked handler thread and the client paces itself."""
         import time as _t
 
         deadline = _t.monotonic() + max(0.0, min(timeout_s, 300.0))
@@ -836,9 +852,21 @@ class ControllerServer:
                     and event_ns == ns
                 ]
                 if batch:
-                    return 200, {
+                    result = {
                         "events": batch,
                         "resourceVersion": self._watch_rv,
+                    }
+                    if not park:
+                        result["retryAfterSeconds"] = retry_hint
+                    return 200, result
+                if not park:
+                    # Saturated watch seat pool: hand back the (empty)
+                    # partial batch now with a pacing hint instead of
+                    # parking this handler thread until the timeout.
+                    return 200, {
+                        "events": [],
+                        "resourceVersion": self._watch_rv,
+                        "retryAfterSeconds": retry_hint,
                     }
                 if self._stop.is_set():
                     # Shutting down: return the (empty) partial batch now
@@ -919,38 +947,85 @@ class ControllerServer:
         """Returns (status_code, payload_dict_or_text[, content_type])."""
         headers = headers or {}
         bare = path.partition("?")[0]
-        fault_response = self._check_chaos(method, bare)
-        if fault_response is not None:
-            return fault_response
-        parent = obs_trace.extract_traceparent(headers.get("traceparent"))
-        # Trace a request when it carries a caller's traceparent or mutates
-        # state. Parentless GETs are untraced, mirroring the client rule:
-        # poll loops (wait_for_condition, watch long-polls, informer
-        # relists) would otherwise churn the bounded trace ring with
-        # one-span root traces and evict the end-to-end traces this
-        # feature exists to keep.
-        metrics.api_requests_in_flight.add(1)
-        try:
-            if self._is_observability_path(bare) or (
-                parent is None and method == "GET"
-            ):
-                return self._route_inner(method, path, body, headers)
-            # One span per API request, parented on the caller's W3C
-            # traceparent when present — the apiserver hop of the
-            # end-to-end trace (client -> here -> reconcile -> provider ->
-            # solver).
-            with obs_trace.span(
-                "apiserver.request",
-                {"http.method": method, "http.path": bare},
-                parent=parent,
-            ) as request_span:
-                result = self._route_inner(method, path, body, headers)
-                request_span.set_attribute("http.status", result[0])
-                return result
-        finally:
-            metrics.api_requests_in_flight.add(-1)
+        # Flow control runs in FRONT of everything (chaos, tracing,
+        # routing): a shed request is answered 429 + Retry-After having
+        # touched nothing, so a 429'd write can never have side effects.
+        # Exempt classes (/debug/*, /ha/*, probes, /metrics) always pass.
+        flow_ticket = None
+        if self.flow is not None:
+            from .flow import config as flow_config
 
-    def _route_inner(self, method: str, path: str, body: bytes, headers=None):
+            info = flow_config.request_info(method, path, body=body,
+                                            headers=headers)
+            flow_ticket = self.flow.admit(info)
+            if flow_ticket.decision == "reject":
+                return (
+                    429,
+                    {
+                        "error": (
+                            f"request shed by API priority level "
+                            f"{flow_ticket.level!r} ({flow_ticket.reason}); "
+                            f"retry after the hint"
+                        ),
+                        "retryAfterSeconds": flow_ticket.retry_after_s,
+                    },
+                    None,
+                    {"Retry-After": format(flow_ticket.retry_after_s, "g")},
+                )
+        try:
+            fault_response = self._check_chaos(method, bare)
+            if fault_response is not None:
+                return fault_response
+            parent = obs_trace.extract_traceparent(headers.get("traceparent"))
+            # A saturated watch pool executes WITHOUT parking: the long-poll
+            # answers its partial batch immediately with a retry hint
+            # instead of costing a dedicated handler thread.
+            watch_park = flow_ticket is None or flow_ticket.decision != "busy"
+            watch_hint = (
+                flow_ticket.retry_after_s if flow_ticket is not None else 1.0
+            )
+            # Trace a request when it carries a caller's traceparent or
+            # mutates state. Parentless GETs are untraced, mirroring the
+            # client rule: poll loops (wait_for_condition, watch long-polls,
+            # informer relists) would otherwise churn the bounded trace ring
+            # with one-span root traces and evict the end-to-end traces this
+            # feature exists to keep.
+            metrics.api_requests_in_flight.add(1)
+            try:
+                if self._is_observability_path(bare) or (
+                    parent is None and method == "GET"
+                ):
+                    return self._route_inner(
+                        method, path, body, headers,
+                        watch_park=watch_park, watch_hint=watch_hint,
+                    )
+                # One span per API request, parented on the caller's W3C
+                # traceparent when present — the apiserver hop of the
+                # end-to-end trace (client -> here -> reconcile ->
+                # provider -> solver).
+                with obs_trace.span(
+                    "apiserver.request",
+                    {"http.method": method, "http.path": bare},
+                    parent=parent,
+                ) as request_span:
+                    if flow_ticket is not None:
+                        request_span.set_attribute(
+                            "flow.level", flow_ticket.level
+                        )
+                    result = self._route_inner(
+                        method, path, body, headers,
+                        watch_park=watch_park, watch_hint=watch_hint,
+                    )
+                    request_span.set_attribute("http.status", result[0])
+                    return result
+            finally:
+                metrics.api_requests_in_flight.add(-1)
+        finally:
+            if flow_ticket is not None:
+                self.flow.release(flow_ticket)
+
+    def _route_inner(self, method: str, path: str, body: bytes, headers=None,
+                     watch_park: bool = True, watch_hint: float = 1.0):
         from urllib.parse import parse_qs
 
         path, _, query = path.partition("?")
@@ -967,7 +1042,11 @@ class ControllerServer:
                 "identity": self.elector.identity,
             }
         if path == "/readyz":
-            return (200, "ok") if self._ready.is_set() else (503, "not ready")
+            if self._ready.is_set():
+                return 200, "ok"
+            # Not-ready is a hold, not a failure: pace the probe's retry
+            # the same way every other 503 on this server does.
+            return 503, "not ready", None, {"Retry-After": "1"}
         if path == "/metrics":
             # Keep the build_info backend label current (jax loads lazily).
             self._stamp_build_info()
@@ -1047,7 +1126,9 @@ class ControllerServer:
             "/validate-jobset-x-k8s-io-v1alpha2-jobset",
             "/mutate-jobset-x-k8s-io-v1alpha2-jobset",
         ):
-            return self._admission_review(path.startswith("/mutate"), body)
+            return self._admission_review(
+                path == "/mutate-jobset-x-k8s-io-v1alpha2-jobset", body
+            )
 
         # Replication surface (docs/ha.md): served by leader AND standby,
         # BEFORE the write fences below — a draining or standby replica
@@ -1092,7 +1173,10 @@ class ControllerServer:
                     return 400, {"error": "bad watch parameters"}
                 if kind != "jobsets":
                     self._activate_watch_kind(kind)
-                return self._watch_resource(kind, ns, rv, timeout_s)
+                return self._watch_resource(
+                    kind, ns, rv, timeout_s,
+                    park=watch_park, retry_hint=watch_hint,
+                )
 
         if method in ("POST", "PUT", "DELETE", "PATCH"):
             if self._draining.is_set():
@@ -1122,16 +1206,26 @@ class ControllerServer:
                     self.elector.leader_hint()
                     if self.elector is not None else ("", "")
                 )
-                return 503, {
-                    "error": "this replica is a standby (not the lease "
-                             "holder); retry against the leader",
-                    "identity": (
-                        self.elector.identity
-                        if self.elector is not None else None
-                    ),
-                    "leader": holder or None,
-                    "leaderAddress": address or None,
-                }
+                # Same Retry-After the drain fence emits: every write
+                # fence paces clients uniformly (a hint-less 503 made
+                # clients fall back to their own jittered backoff while
+                # the drain path steered them — inconsistent herd
+                # behavior across fences).
+                return (
+                    503,
+                    {
+                        "error": "this replica is a standby (not the lease "
+                                 "holder); retry against the leader",
+                        "identity": (
+                            self.elector.identity
+                            if self.elector is not None else None
+                        ),
+                        "leader": holder or None,
+                        "leaderAddress": address or None,
+                    },
+                    None,
+                    {"Retry-After": "5"},
+                )
 
         with self.lock:
             if path.startswith(self.API_PREFIX):
@@ -1710,6 +1804,32 @@ class ControllerServer:
                            f"across {len(manager.queues)} queues",
             }
 
+        if self.flow is None:
+            components["flow"] = {
+                "healthy": True,
+                "enabled": False,
+                "message": "API flow control disabled (APIFlowControl "
+                           "gate off): no inflight limits or shedding",
+            }
+        else:
+            flow_stats = self.flow.snapshot()
+            shed = sum(
+                n
+                for reasons in flow_stats["rejected"].values()
+                for reason, n in reasons.items()
+                if reason != "watch_busy"
+            )
+            components["flow"] = {
+                "healthy": True,  # shedding under overload is the design
+                "enabled": True,
+                **flow_stats,
+                "message": (
+                    f"{shed} request(s) shed across "
+                    f"{flow_stats['arrivals']} arrivals" if shed
+                    else "no load shedding since start"
+                ),
+            }
+
         contained = {
             f"{ns}/{js_name}": count
             for (ns, js_name), count in sorted(
@@ -1768,6 +1888,7 @@ class ControllerServer:
                 "tls": self.tls,
                 "leaderElection": self.elector is not None,
                 "storeEnabled": store is not None,
+                "flowControl": self.flow is not None,
                 "address": self.address,
             },
             "cluster": {
@@ -1833,6 +1954,9 @@ class ControllerServer:
                         headers={
                             "traceparent": self.headers.get("traceparent"),
                             "accept": self.headers.get("Accept"),
+                            # Flow distinguisher input: one tenant's storm
+                            # shuffle-shards apart from another's.
+                            "user-agent": self.headers.get("User-Agent"),
                         },
                     )
                 except Exception as exc:  # route bug -> 500, keep serving

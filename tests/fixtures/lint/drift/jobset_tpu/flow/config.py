@@ -1,0 +1,11 @@
+"""DRF004 fixture classification table: rows covering the fixture
+server's classified routes, plus one stale row covering nothing."""
+
+ROUTE_CLASSES = (
+    ("/fixture/classified", "exempt"),
+    ("/fixture/sub/", "workload"),
+    ("/fixture/parts", "workload"),
+    ("/fixture/tupled", "workload"),
+    ("/fixture/prefixed", "exempt"),
+    ("/fixture/stale", "workload"),  # line 10: covers no served route
+)

@@ -1,13 +1,32 @@
-"""DRF003 fixture call sites: one documented point, one undocumented."""
+"""DRF003 fixture call sites (one documented point, one undocumented)
+and DRF004 fixture routes (one classified, one unclassified, plus a
+prefix-matched and a parts-matched route)."""
 
 from .chaos.injector import Injector
 
 injector = Injector()
 
+FIXTURE_PREFIX = "/fixture/prefixed"
+
 
 def handle(request):
     if injector.check("fixture.documented"):
         return None
-    if injector.check("fixture.undocumented"):  # line 11: no table row
+    if injector.check("fixture.undocumented"):  # line 15: no table row
         return None
     return request
+
+
+def route(method, path):
+    parts = [p for p in path.split("/") if p]
+    if path == "/fixture/classified":
+        return 200
+    if path == "/fixture/unclassified":  # line 24: no ROUTE_CLASSES row
+        return 200
+    if path.startswith("/fixture/sub/"):
+        return 200
+    if parts[:2] == ["fixture", "parts"]:
+        return 200
+    if path in ("/fixture/tupled", "/fixture/classified"):
+        return 200
+    return 404

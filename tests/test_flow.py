@@ -1,0 +1,699 @@
+"""Flow-control plane tests (jobset_tpu/flow, docs/flow.md): the API
+priority & fairness analog in front of the apiserver path.
+
+Covers: route/schema classification, seat accounting and shuffle-sharded
+queueing on a virtual clock, shedding semantics through the real HTTP
+server (429 + Retry-After BEFORE side effects, exempt paths never shed,
+watch-pool partial batches), the client's Retry-After honoring (capped,
+GETs only), the informer's bounded behavior under a sustained 429 storm
+with no events lost once it clears, the 503 write-fence Retry-After
+consistency, and the seeded thundering_herd scenario's byte-identical
+determinism.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from jobset_tpu.chaos.injector import FaultInjector
+from jobset_tpu.client import (
+    RETRY_AFTER_CAP_S,
+    ApiError,
+    JobSetClient,
+    ResourceInformer,
+)
+from jobset_tpu.core import metrics
+from jobset_tpu.flow import (
+    BUSY,
+    EXECUTE,
+    QUEUED,
+    REASON_QUEUE_FULL,
+    REASON_SATURATED,
+    REASON_TIMEOUT,
+    REASON_WATCH_BUSY,
+    REJECT,
+    FlowController,
+    FlowSchema,
+    PriorityLevel,
+    RequestInfo,
+    classify,
+    request_info,
+    route_class,
+)
+from jobset_tpu.server import ControllerServer
+from jobset_tpu.testing import make_jobset, make_replicated_job
+
+
+def _gang_yaml(name: str, priority=None) -> str:
+    base = f"""
+apiVersion: jobset.x-k8s.io/v1alpha2
+kind: JobSet
+metadata:
+  name: {name}
+spec:
+  suspend: true
+"""
+    if priority is not None:
+        base += f"  priority: {priority}\n"
+    base += """  replicatedJobs:
+  - name: w
+    replicas: 1
+    template:
+      spec:
+        parallelism: 1
+        completions: 1
+        template:
+          spec:
+            containers:
+            - name: c
+              image: train:latest
+"""
+    return base
+
+
+def _gang_obj(name: str):
+    return (
+        make_jobset(name)
+        .replicated_job(
+            make_replicated_job("w").replicas(1)
+            .parallelism(1).completions(1).obj()
+        )
+        .suspend(True)
+        .obj()
+    )
+
+
+# ---------------------------------------------------------------------------
+# Classification (flow/config.py)
+# ---------------------------------------------------------------------------
+
+
+def test_route_class_partitions_served_routes():
+    for path in ("/healthz", "/readyz", "/leaderz", "/metrics",
+                 "/debug/health", "/debug/timeline/default/x",
+                 "/ha/v1/append"):
+        assert route_class(path) == "exempt", path
+    assert route_class("/openapi/v2") == "workload-low"
+    assert route_class(
+        "/validate-jobset-x-k8s-io-v1alpha2-jobset") == "system"
+    assert route_class(
+        "/apis/jobset.x-k8s.io/v1alpha2/namespaces/default/jobsets"
+    ) == "workload"
+    assert route_class("/api/v1/nodes") == "workload"
+    # Unknown paths (404 traffic) pay the same fairness budget as user
+    # traffic instead of bypassing it.
+    assert route_class("/not/a/route") == "workload"
+
+
+def test_request_info_parses_verb_kind_namespace_and_priority():
+    api = "/apis/jobset.x-k8s.io/v1alpha2/namespaces/team-a/jobsets"
+    info = request_info("POST", api, body=b'{"spec": {"priority": 120}}',
+                        headers={"user-agent": "tenant-1"})
+    assert (info.verb, info.kind, info.namespace) == (
+        "create", "jobsets", "team-a")
+    assert info.priority == 120
+    assert info.flow_key == "tenant-1|team-a"
+
+    yaml_info = request_info("PUT", api + "/j1",
+                             body=b"spec:\n  priority: 7\n")
+    assert yaml_info.verb == "update" and yaml_info.priority == 7
+
+    watch = request_info("GET", api + "?watch=1&resourceVersion=3")
+    assert watch.is_watch and watch.verb == "watch"
+
+    nodes = request_info("GET", "/api/v1/nodes")
+    assert (nodes.verb, nodes.kind) == ("get", "nodes")
+    pods = request_info("GET", "/api/v1/namespaces/default/pods")
+    assert (pods.kind, pods.namespace) == ("pods", "default")
+
+
+def test_classify_routes_watches_and_priorities():
+    api = "/apis/jobset.x-k8s.io/v1alpha2/namespaces/default/jobsets"
+    assert classify(request_info("GET", "/debug/health")) == "exempt"
+    # Watches ride the dedicated watch pool, even high-priority clients'.
+    assert classify(request_info("GET", api + "?watch=1")) == "watch"
+    # spec.priority >= threshold -> protected level; below or absent ->
+    # best-effort.
+    high = request_info("POST", api, body=b'{"spec": {"priority": 100}}')
+    low = request_info("POST", api, body=b'{"spec": {"priority": 99}}')
+    plain = request_info("POST", api, body=b"{}")
+    assert classify(high) == "workload-high"
+    assert classify(low) == "workload-low"
+    assert classify(plain) == "workload-low"
+    # Cluster operator traffic (queue quota, node lifecycle) is protected.
+    assert classify(request_info("GET", "/api/v1/nodes")) == "workload-high"
+    assert classify(request_info(
+        "POST", "/apis/jobset.x-k8s.io/v1alpha2/queues", body=b"{}"
+    )) == "workload-high"
+    # Webhook reviews are the system class.
+    assert classify(request_info(
+        "POST", "/validate-jobset-x-k8s-io-v1alpha2-jobset", body=b"{}"
+    )) == "system"
+
+
+def test_flow_schema_matching_rules():
+    schema = FlowSchema("by-agent", level="workload-high",
+                        verbs=("create",), namespaces=("prod",),
+                        user_agent_prefixes=("trusted-",))
+    hit = RequestInfo(method="POST", path="/x", verb="create",
+                      kind="jobsets", namespace="prod",
+                      user_agent="trusted-controller/1")
+    assert schema.matches(hit)
+    assert not schema.matches(
+        RequestInfo(method="POST", path="/x", verb="create",
+                    kind="jobsets", namespace="dev",
+                    user_agent="trusted-controller/1"))
+    assert not schema.matches(
+        RequestInfo(method="GET", path="/x", verb="get", kind="jobsets",
+                    namespace="prod", user_agent="trusted-controller/1"))
+
+
+# ---------------------------------------------------------------------------
+# FlowController (virtual clock — no sleeps, no real time)
+# ---------------------------------------------------------------------------
+
+_API = "/apis/jobset.x-k8s.io/v1alpha2/namespaces/default/jobsets"
+
+
+def _tiny_levels(**overrides):
+    defaults = dict(
+        high=PriorityLevel("workload-high", seats=1, queues=2,
+                           queue_length=2, queue_wait_s=1.0,
+                           retry_after_s=0.5),
+        low=PriorityLevel("workload-low", seats=1, queues=0,
+                          retry_after_s=0.25),
+        watch=PriorityLevel("watch", seats=1),
+    )
+    defaults.update(overrides)
+    return (
+        PriorityLevel("exempt", seats=0),
+        PriorityLevel("system", seats=2, queues=1, queue_length=2,
+                      queue_wait_s=1.0),
+        defaults["high"], defaults["low"], defaults["watch"],
+    )
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+def _info(level="low", flow="a", watch=False):
+    if watch:
+        return request_info("GET", _API + "?watch=1",
+                            headers={"user-agent": flow})
+    body = b'{"spec": {"priority": 120}}' if level == "high" else b"{}"
+    return request_info("POST", _API, body=body,
+                        headers={"user-agent": flow})
+
+
+def test_seats_grant_until_full_then_shed_without_queues():
+    clock = _Clock()
+    fc = FlowController(levels=_tiny_levels(), seed=0, now=clock)
+    first = fc.admit(_info("low"))
+    assert first.decision == EXECUTE
+    shed = fc.admit(_info("low"))
+    assert (shed.decision, shed.reason) == (REJECT, REASON_SATURATED)
+    assert shed.retry_after_s == 0.25
+    fc.release(first)
+    assert fc.admit(_info("low")).decision == EXECUTE
+    # Exempt has no seat bound at all.
+    for _ in range(50):
+        assert fc.admit(request_info("GET", "/healthz")).decision == EXECUTE
+
+
+def test_queued_request_granted_on_release_fifo_across_queues():
+    clock = _Clock()
+    fc = FlowController(levels=_tiny_levels(), seed=0, now=clock)
+    holder = fc.admit(_info("high"))
+    assert holder.decision == EXECUTE
+    # Two parked flows land in (possibly) different sharded queues; the
+    # freed seat goes to the LONGEST-waiting by arrival, not by queue.
+    first = fc.admit(_info("high", flow="t1"), block=False)
+    second = fc.admit(_info("high", flow="t2"), block=False)
+    assert first.decision == QUEUED and second.decision == QUEUED
+    clock.t += 0.5
+    fc.release(holder)
+    assert first.waiter.granted and not second.waiter.granted
+    done = fc.resolve(first)
+    assert done.decision == EXECUTE
+    assert done.queue_wait_s == pytest.approx(0.5)
+    # The granting release handed the seat over: still at capacity.
+    assert fc.admit(_info("high", flow="t3"), block=False).decision == QUEUED
+
+
+def test_queued_request_sheds_at_wait_budget():
+    clock = _Clock()
+    fc = FlowController(levels=_tiny_levels(), seed=0, now=clock)
+    holder = fc.admit(_info("high"))
+    parked = fc.admit(_info("high", flow="t1"), block=False)
+    assert parked.decision == QUEUED
+    clock.t += 2.0  # past the 1.0s wait budget with no release
+    shed = fc.resolve(parked)
+    assert (shed.decision, shed.reason) == (REJECT, REASON_TIMEOUT)
+    assert shed.queue_wait_s == pytest.approx(2.0)
+    # The expired waiter left its queue: a release must not grant it.
+    fc.release(holder)
+    assert fc.admit(_info("high", flow="t2")).decision == EXECUTE
+
+
+def test_full_queue_sheds_queue_full():
+    clock = _Clock()
+    levels = _tiny_levels(
+        high=PriorityLevel("workload-high", seats=1, queues=2,
+                           queue_length=1, queue_wait_s=1.0),
+    )
+    fc = FlowController(levels=levels, seed=0, now=clock)
+    fc.admit(_info("high"))
+    # One flow's 2-queue hand fills at queue_length=1 each (shuffle
+    # sharding enqueues on the least-loaded of the hand); the next park
+    # sheds queue_full.
+    assert fc.admit(_info("high", flow="t"), block=False).decision == QUEUED
+    assert fc.admit(_info("high", flow="t"), block=False).decision == QUEUED
+    third = fc.admit(_info("high", flow="t"), block=False)
+    assert (third.decision, third.reason) == (REJECT, REASON_QUEUE_FULL)
+
+
+def test_watch_pool_saturation_answers_busy_not_429():
+    fc = FlowController(levels=_tiny_levels(), seed=0, now=_Clock())
+    first = fc.admit(_info(watch=True))
+    assert first.decision == EXECUTE
+    busy = fc.admit(_info(watch=True, flow="b"))
+    assert (busy.decision, busy.reason) == (BUSY, REASON_WATCH_BUSY)
+    # watch_busy is visibility, not an error: not in the shed total.
+    assert fc.rejected_total() == 0
+    fc.admit(_info("low"))
+    assert fc.admit(_info("low")).decision == REJECT
+    assert fc.rejected_total() == 1
+
+
+def test_shuffle_sharding_is_seeded_and_confines_a_flow():
+    levels = _tiny_levels(
+        high=PriorityLevel("workload-high", seats=1, queues=8,
+                           queue_length=4, queue_wait_s=1.0, hand_size=2),
+    )
+
+    def shard_of(seed, flow):
+        fc = FlowController(levels=levels, seed=seed, now=_Clock())
+        fc.admit(_info("high"))
+        ticket = fc.admit(_info("high", flow=flow), block=False)
+        return ticket.waiter.queue_index
+
+    # Pure function of (seed, flow): same inputs, same queue — twice.
+    assert shard_of(7, "tenant-a") == shard_of(7, "tenant-a")
+    # One flow only ever lands inside its 2-queue hand, however many
+    # requests it parks; a storm from one tenant cannot occupy all 8.
+    fc = FlowController(levels=levels, seed=7, now=_Clock())
+    fc.admit(_info("high"))
+    used = {
+        fc.admit(_info("high", flow="noisy"), block=False).waiter.queue_index
+        for _ in range(8)
+    }
+    assert len(used) <= 2
+    # Seeds permute the hand assignment somewhere across a few flows.
+    assert any(
+        shard_of(7, f"t{i}") != shard_of(8, f"t{i}") for i in range(6)
+    )
+
+
+def test_decision_log_is_bounded_and_wall_clock_free():
+    clock = _Clock()
+    fc = FlowController(levels=_tiny_levels(), seed=0, now=clock)
+    fc.admit(_info("low"))
+    fc.admit(_info("low", flow="b"))
+    log = fc.log_snapshot()
+    assert [e["decision"] for e in log] == [EXECUTE, REJECT]
+    assert all(
+        set(e) == {"seq", "level", "flow", "decision", "reason"}
+        for e in log
+    ), "decision log must carry no wall-clock fields"
+
+
+# ---------------------------------------------------------------------------
+# Through the real HTTP server
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def flow_server():
+    flow = FlowController(levels=_tiny_levels(), seed=0)
+    server = ControllerServer(
+        "127.0.0.1:0", tick_interval=0.05, flow=flow
+    ).start()
+    yield server, flow
+    server.stop()
+
+
+def test_gate_off_by_default_and_health_component():
+    server = ControllerServer("127.0.0.1:0", tick_interval=0.05)
+    try:
+        assert server.flow is None
+        health = server._route("GET", "/debug/health", b"")[1]
+        assert health["components"]["flow"]["enabled"] is False
+    finally:
+        server._httpd.server_close()
+
+
+def test_shed_write_answers_429_with_retry_after_and_no_side_effects(
+    flow_server,
+):
+    server, flow = flow_server
+    client = JobSetClient(server.address, user_agent="tenant-a")
+    held = flow.hold("workload-low", 1)
+    try:
+        with pytest.raises(ApiError) as err:
+            client.create(_gang_yaml("shed-me"))
+        assert err.value.status == 429
+        # The Retry-After header round-trips as the level's hint.
+        assert err.value.retry_after == pytest.approx(0.25)
+        # Shed BEFORE routing: no object, no watch event, no rv bump.
+        with server.lock:
+            assert server.cluster.get_jobset("default", "shed-me") is None
+        # Mutations are never retried, hint or not.
+        assert client.retried_requests == 0
+    finally:
+        for ticket in held:
+            flow.release(ticket)
+    client.create(_gang_yaml("shed-me"))  # seat free again -> lands
+    assert client.get("shed-me").metadata.name == "shed-me"
+
+
+def test_high_priority_writes_land_while_best_effort_sheds(flow_server):
+    server, flow = flow_server
+    client = JobSetClient(server.address, user_agent="tenant-a")
+    held = flow.hold("workload-low", 1)
+    try:
+        with pytest.raises(ApiError) as err:
+            client.create(_gang_yaml("best-effort"))
+        assert err.value.status == 429
+        client.create(_gang_yaml("vip", priority=120))
+    finally:
+        for ticket in held:
+            flow.release(ticket)
+    # (GETs ride workload-low, so read back only after the seat frees.)
+    assert client.get("vip").spec.priority == 120
+
+
+def test_exempt_paths_serve_while_everything_sheds(flow_server):
+    server, flow = flow_server
+    client = JobSetClient(server.address)
+    held = (flow.hold("workload-low", 1) + flow.hold("workload-high", 1)
+            + flow.hold("system", 2) + flow.hold("watch", 1))
+    try:
+        assert client.healthz() and client.readyz()
+        health = client.health()
+        assert health["components"]["flow"]["enabled"] is True
+        text = client.metrics_text()
+        assert "jobset_flow_inflight" in text
+    finally:
+        for ticket in held:
+            flow.release(ticket)
+
+
+def test_saturated_watch_pool_returns_partial_batch_with_hint(flow_server):
+    server, flow = flow_server
+    client = JobSetClient(server.address, user_agent="watcher")
+    client.create(_gang_yaml("seen"))
+    held = flow.hold("watch", 1)
+    try:
+        start = time.monotonic()
+        events, rv = client.watch_resource(
+            "jobsets", "default", 0, timeout=30
+        )
+        # Answered immediately (no 30s park), events included, hint set.
+        assert time.monotonic() - start < 5.0
+        assert any(
+            e["object"]["metadata"]["name"] == "seen" for e in events
+        )
+        assert client.last_watch_retry_after == pytest.approx(1.0)
+    finally:
+        for ticket in held:
+            flow.release(ticket)
+    client.watch_resource("jobsets", "default", rv, timeout=0)
+    assert client.last_watch_retry_after is None
+    snapshot = flow.snapshot()
+    assert snapshot["rejected"]["watch"][REASON_WATCH_BUSY] >= 1
+
+
+def test_flow_metrics_families_exported(flow_server):
+    server, flow = flow_server
+    client = JobSetClient(server.address, user_agent="m")
+    held = flow.hold("workload-low", 1)
+    try:
+        with pytest.raises(ApiError):
+            client.create(_gang_yaml("metric-shed"))
+    finally:
+        for ticket in held:
+            flow.release(ticket)
+    text = client.metrics_text()
+    assert 'jobset_flow_rejected_total{level="workload-low"' in text
+    assert "jobset_flow_queue_wait_seconds" in text
+
+
+# ---------------------------------------------------------------------------
+# Client Retry-After honoring (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_get_retries_honor_server_retry_after_hint(flow_server, monkeypatch):
+    server, flow = flow_server
+    client = JobSetClient(server.address, retries=2, user_agent="g")
+    sleeps = []
+    monkeypatch.setattr("jobset_tpu.client.time.sleep",
+                        lambda s: sleeps.append(s))
+    held = flow.hold("workload-low", 1)
+    try:
+        with pytest.raises(ApiError) as err:
+            client.list()
+        assert err.value.status == 429
+    finally:
+        for ticket in held:
+            flow.release(ticket)
+    # Both retries paced by the server's 0.25s hint, not jittered backoff.
+    assert sleeps == [pytest.approx(0.25), pytest.approx(0.25)]
+    assert client.retried_requests == 2
+
+
+def test_retry_after_hint_is_capped(flow_server, monkeypatch):
+    server, flow = flow_server
+    # A confused server advertising a huge hint must not park clients:
+    # the cap is the informer's existing 5s backoff ceiling.
+    levels = _tiny_levels(
+        low=PriorityLevel("workload-low", seats=1, queues=0,
+                          retry_after_s=120.0),
+    )
+    server.flow = replacement = FlowController(levels=levels, seed=0)
+    client = JobSetClient(server.address, retries=1, user_agent="c")
+    sleeps = []
+    monkeypatch.setattr("jobset_tpu.client.time.sleep",
+                        lambda s: sleeps.append(s))
+    held = replacement.hold("workload-low", 1)
+    try:
+        with pytest.raises(ApiError):
+            client.list()
+    finally:
+        for ticket in held:
+            replacement.release(ticket)
+    assert sleeps == [pytest.approx(RETRY_AFTER_CAP_S)]
+
+
+def test_write_fences_emit_retry_after_consistently(flow_server):
+    """Every 503 hold on this server paces clients the same way: the
+    drain fence, the standby/follower write fence, and the not-ready
+    probe all carry Retry-After (the flow plane's 429s carry their own
+    per-level hint)."""
+    server, _ = flow_server
+    server._draining.set()
+    try:
+        result = server._route(
+            "POST", ControllerServer.API_PREFIX
+            + "/namespaces/default/jobsets",
+            _gang_yaml("fenced").encode(),
+        )
+        assert result[0] == 503
+        assert result[3]["Retry-After"] == "5"
+    finally:
+        server._draining.clear()
+    ready = ControllerServer("127.0.0.1:0", tick_interval=0.05)
+    try:
+        result = ready._route("GET", "/readyz", b"")
+        assert result[0] == 503 and result[3]["Retry-After"] == "1"
+    finally:
+        ready._httpd.server_close()
+
+
+# ---------------------------------------------------------------------------
+# Informer under a sustained 429 storm (satellite)
+# ---------------------------------------------------------------------------
+
+
+class _StubClient:
+    """Feeds the informer loop scripted watch outcomes."""
+
+    def __init__(self, outcomes):
+        self.outcomes = list(outcomes)
+        self.last_watch_retry_after = None
+
+    def list_resource_with_version(self, kind, namespace):
+        return [], 0
+
+    def watch_resource(self, kind, namespace, rv, timeout=0):
+        if not self.outcomes:
+            return [], rv
+        outcome = self.outcomes.pop(0)
+        if isinstance(outcome, Exception):
+            raise outcome
+        return outcome, rv
+
+
+def _record_waits(informer):
+    waits = []
+    original = informer._stop.wait
+
+    def recording(timeout=None):
+        waits.append(timeout)
+        return original(0.002 if timeout else timeout)
+
+    informer._stop.wait = recording
+    return waits
+
+
+def test_informer_watch_retry_paces_on_hint_and_backs_off_without():
+    storm = [
+        ApiError(429, "shed", retry_after=0.07),
+        ApiError(429, "shed", retry_after=0.07),
+        ApiError(429, "shed"),          # hint-less: exponential path
+        ApiError(503, "fenced", retry_after=9.0),  # fence hint: capped
+        ApiError(500, "boom"),          # non-hinted status: exponential
+    ]
+    client = _StubClient(storm)
+    informer = ResourceInformer(client, poll_timeout=0.01)
+    waits = _record_waits(informer)
+    informer.start()
+    deadline = time.monotonic() + 5.0
+    while client.outcomes and time.monotonic() < deadline:
+        time.sleep(0.005)
+    informer.stop()
+    observed = [w for w in waits if w is not None][:5]
+    min_b = ResourceInformer.WATCH_BACKOFF_MIN_S
+    assert observed[0] == pytest.approx(0.07)   # server hint honored
+    assert observed[1] == pytest.approx(0.07)   # ...and not compounded
+    assert observed[2] == pytest.approx(min_b)  # hint-less 429: backoff
+    # 503 fence hint capped at the ceiling, never beyond.
+    assert observed[3] == pytest.approx(ResourceInformer.WATCH_BACKOFF_MAX_S)
+    # The hint-less 429 grew the exponential arm for the next failure.
+    assert observed[4] == pytest.approx(min_b * 2)
+    assert all(
+        w <= ResourceInformer.WATCH_BACKOFF_MAX_S for w in observed
+    ), "watch retry pacing must stay bounded"
+
+
+def test_informer_survives_429_storm_without_losing_events():
+    injector = FaultInjector(seed=11)
+    server = ControllerServer(
+        "127.0.0.1:0", tick_interval=0.05, injector=injector
+    ).start()
+    try:
+        client = JobSetClient(server.address, user_agent="informer")
+        client.create(_gang_yaml("before-storm"))
+        added = []
+        informer = ResourceInformer(
+            client, poll_timeout=0.1,
+            on_add=lambda obj: added.append(obj["metadata"]["name"]),
+        )
+        waits = _record_waits(informer)
+        informer.start()
+        assert informer.has_synced()
+
+        # Storm: every apiserver request (the watch polls included)
+        # answers 429 until the rule is removed.
+        rule = injector.add_rule("apiserver.request", "error",
+                                 rate=1.0, status=429)
+        deadline = time.monotonic() + 5.0
+        while len(waits) < 4 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert waits, "informer never backed off during the storm"
+        # Events born MID-storm (direct cluster writes: client writes
+        # would be shed) must reach the informer once the storm clears.
+        with server.lock:
+            server.cluster.create_jobset(_gang_obj("mid-storm-1"))
+            server.cluster.create_jobset(_gang_obj("mid-storm-2"))
+            server._refresh_watch_locked()
+
+        injector.remove_rule(rule)
+        deadline = time.monotonic() + 10.0
+        while (
+            {"mid-storm-1", "mid-storm-2"} - set(added)
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.01)
+        informer.stop()
+        assert {"before-storm", "mid-storm-1", "mid-storm-2"} <= set(added)
+        assert set(informer.cache) == {
+            "before-storm", "mid-storm-1", "mid-storm-2"
+        }
+        # Backoff stayed bounded for the storm's whole duration.
+        assert all(
+            w <= ResourceInformer.WATCH_BACKOFF_MAX_S
+            for w in waits if w is not None
+        )
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Seeded thundering herd (chaos scenario) — determinism + no leaks
+# ---------------------------------------------------------------------------
+
+
+def test_thundering_herd_is_deterministic_and_leak_free():
+    from jobset_tpu.chaos.scenarios import thundering_herd
+
+    first = thundering_herd(arrivals=120, tenants=4, seed=23)
+    metrics.reset()
+    second = thundering_herd(arrivals=120, tenants=4, seed=23)
+    # Byte-identical across runs: decision log, injection log, final
+    # cluster state — the whole report.
+    assert json.dumps(first, sort_keys=True) == json.dumps(
+        second, sort_keys=True
+    )
+    # The storm actually shed (storm phase has 429s), recovery is clean
+    # (sheds stop once the held seats free), and not one 429'd create
+    # left an object behind.
+    assert first["statuses"]["storm"].get("429", 0) > 0
+    assert "429" not in first["statuses"]["recover"]
+    assert first["leaked_shed_objects"] == []
+    assert first["rejected_total"] > 0
+    # Different seed, different storm.
+    metrics.reset()
+    other = thundering_herd(arrivals=120, tenants=4, seed=24)
+    assert json.dumps(other, sort_keys=True) != json.dumps(
+        first, sort_keys=True
+    )
+
+
+def test_thundering_herd_latency_faults_only_see_admitted_requests():
+    from jobset_tpu.chaos.scenarios import thundering_herd
+
+    metrics.reset()
+    report = thundering_herd(arrivals=120, tenants=4, seed=23)
+    shed_count = sum(
+        per.get("429", 0) for per in report["statuses"].values()
+    )
+    executed = report["arrivals"] - shed_count
+    # The injector consults apiserver.request only for SURVIVING
+    # requests (sheds happen before chaos), so the highest consult index
+    # in the injection log must fit inside the executed count — were
+    # shed requests consulted too, a 50%-shed storm would push consult
+    # indexes well past it (the shed-before-everything proof).
+    hits = [
+        e for e in report["injection_log"]
+        if e["point"] == "apiserver.request"
+    ]
+    assert hits, "the storm should draw some latency faults"
+    assert max(e["arrival"] for e in hits) < executed

@@ -215,7 +215,7 @@ def test_durability_scoped_to_store_and_ha():
 
 
 # ---------------------------------------------------------------------------
-# Registry/doc drift (DRF001-003)
+# Registry/doc drift (DRF001-004)
 # ---------------------------------------------------------------------------
 
 
@@ -232,16 +232,50 @@ def test_drift_fires_in_both_directions():
     drf3 = [f.message for f in visible(report, "DRF003")]
     assert any("fixture.undocumented" in m for m in drf3), messages
     assert any("fixture.stale" in m for m in drf3), messages
+    drf4 = [f.message for f in visible(report, "DRF004")]
+    assert any("/fixture/unclassified" in m for m in drf4), messages
+    assert any("/fixture/stale" in m for m in drf4), messages
+
+
+def test_drift_route_discovery_sees_every_route_shape():
+    """DRF004's static route scan understands each way server.py
+    declares a route (==, in-tuple, startswith, parts-prefix, *_PREFIX
+    constant): all the classified fixture routes stay silent — only the
+    unclassified route and the stale row fire."""
+    from jobset_tpu.analysis.rules.drift import (
+        classified_routes,
+        served_routes,
+    )
+
+    served = served_routes(FIXTURES / "drift")
+    assert set(served) == {
+        "/fixture/classified",
+        "/fixture/unclassified",
+        "/fixture/sub/",
+        "/fixture/parts",
+        "/fixture/tupled",
+        "/fixture/prefixed",
+    }, served
+    classified = classified_routes(FIXTURES / "drift")
+    assert classified["/fixture/stale"][0] == "workload"
+    report = fixture_engine("drift").run([])
+    drf4 = visible(report, "DRF004")
+    assert sorted(
+        m for f in drf4 for m in [f.message] if "served here" in m
+    ) == [f.message for f in drf4 if "/fixture/unclassified" in f.message]
 
 
 def test_drift_documented_entries_are_clean():
-    """The matched halves (documented metric/gate/point) produce no
-    findings — only the drifted halves fire."""
+    """The matched halves (documented metric/gate/point, classified
+    route) produce no findings — only the drifted halves fire."""
     report = fixture_engine("drift").run([])
     for clean_name in (
         "fixture_documented_total",
         "FixtureDocumentedGate",
         "'fixture.documented'",
+        "'/fixture/classified'",
+        "'/fixture/sub/'",
+        "'/fixture/prefixed'",
     ):
         assert not any(
             clean_name in f.message for f in report.visible

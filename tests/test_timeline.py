@@ -428,7 +428,7 @@ def test_debug_health_verdict_and_degradation(server, client):
     assert health["status"] == "healthy"
     assert set(health["components"]) == {
         "leaderElection", "replication", "solver", "policy", "store", "queue",
-        "pump", "chaos",
+        "pump", "chaos", "flow",
     }
     assert health["components"]["store"]["enabled"] is False
     assert health["components"]["replication"]["role"] == "single"
