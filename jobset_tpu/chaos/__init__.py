@@ -2,6 +2,8 @@
 
 `injector` holds the seeded rule engine and the process-global accessor
 (the CLI's ``--inject`` installs one; instrumented boundaries consult it);
+`net` models per-link network partitions (seeded ``PartitionPlan`` of
+directed cuts/heals, enforced at the HA peer transports and the client);
 `scenarios` drives cluster-side faults (pod crash bursts, node drains)
 through the simulation kernel. See ``docs/troubleshooting.md`` §
 "Degradation modes" for how the hardened paths behave under these faults.
@@ -27,11 +29,16 @@ from .injector import (
     disable,
     get_injector,
 )
+from .net import PartitionPlan
 from .scenarios import (
+    asymmetric_link,
+    leader_isolated,
     node_drain,
+    partition_flap,
     pod_crash_burst,
     policy_inference_faults,
     queue_spurious_evictions,
+    split_3way,
     store_enospc_writes,
     store_torn_writes,
 )
@@ -50,15 +57,20 @@ __all__ = [
     "KIND_REFUSE",
     "KIND_SLOW",
     "KIND_TORN",
+    "PartitionPlan",
     "Rule",
     "configure",
     "consult",
     "disable",
     "get_injector",
+    "asymmetric_link",
+    "leader_isolated",
     "node_drain",
+    "partition_flap",
     "pod_crash_burst",
     "policy_inference_faults",
     "queue_spurious_evictions",
+    "split_3way",
     "store_enospc_writes",
     "store_torn_writes",
 ]

@@ -46,6 +46,15 @@ just names):
                        latency, or ``corrupt`` — the model is treated as
                        unusable for that decision and placement falls
                        back to the auction solver
+``net.partition``      per-link network fault model (chaos/net.py): a
+                       directed (src, dst) link cut blackholes/refuses
+                       delivery at both transports (HA peer RPCs in
+                       ha/replication.py, client requests in client.py).
+                       Spec rules here fire per delivery (``refuse``);
+                       a seeded ``PartitionPlan``'s scheduled cut AND
+                       heal transitions land in this log as first-class
+                       entries, so seeded-run byte-identity covers
+                       recovery timing, not just fault onsets
 ================== ======================================================
 
 Spec grammar (CLI ``--inject`` / ``FaultInjector.from_spec``)::
@@ -247,26 +256,53 @@ class FaultInjector:
             if hit is None or hit.exhausted():
                 return None
             hit.injected += 1
-            self._seq += 1
-            self._injected_by_point[point] = (
-                self._injected_by_point.get(point, 0) + 1
+            fault = self._injected_locked(
+                point, hit.kind, arrival, detail,
+                status=hit.status, delay_s=hit.delay_s,
             )
-            fault = Fault(
-                point=point,
-                kind=hit.kind,
-                status=hit.status,
-                delay_s=hit.delay_s,
-                seq=self._seq,
-            )
-            if len(self.log) < self.MAX_LOG:
-                self.log.append({
-                    "seq": self._seq,
-                    "point": point,
-                    "arrival": arrival,
-                    "kind": hit.kind,
-                    "detail": detail,
-                })
         # Outside the lock: metrics must not serialize the handler pool.
+        from ..core import metrics
+
+        metrics.chaos_injected_faults_total.inc(point)
+        return fault
+
+    def _injected_locked(self, point: str, kind: str, arrival: int,
+                         detail: str, status: int = 503,
+                         delay_s: float = 0.0) -> Fault:
+        """Shared bookkeeping for a fault entering the log — rule-fired
+        (check) and externally-applied (record) entries must stay
+        structurally identical, the byte-identity gates compare them in
+        one stream. Caller holds self._lock and bumps the metric outside
+        it."""
+        self._seq += 1
+        self._injected_by_point[point] = (
+            self._injected_by_point.get(point, 0) + 1
+        )
+        fault = Fault(point=point, kind=kind, status=status,
+                      delay_s=delay_s, seq=self._seq)
+        if len(self.log) < self.MAX_LOG:
+            self.log.append({
+                "seq": self._seq,
+                "point": point,
+                "arrival": arrival,
+                "kind": kind,
+                "detail": detail,
+            })
+        return fault
+
+    def record(self, point: str, kind: str, detail: str = "") -> Fault:
+        """First-class injection-log entry for an externally-APPLIED fault
+        transition (the partition plan's scheduled cut/heal events,
+        chaos/net.py): consumes NO rng draw and consults no rules —
+        a scheduled transition must not perturb the point's decision
+        stream — but lands in the log, the sequence numbering, and the
+        counters exactly like a rule-injected fault. Heal events going
+        through here is what lets seeded-run byte-identity cover recovery
+        timing rather than only fault onsets."""
+        with self._lock:
+            arrival = self._arrivals.get(point, 0)
+            self._arrivals[point] = arrival + 1
+            fault = self._injected_locked(point, kind, arrival, detail)
         from ..core import metrics
 
         metrics.chaos_injected_faults_total.inc(point)

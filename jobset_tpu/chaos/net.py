@@ -1,0 +1,280 @@
+"""Per-link network fault model: the chaos plane's partition engine.
+
+The injector's existing points model *process* faults (a request errors,
+a stream breaks, a pod crashes); this module models the *network* — the
+failure class quorum replication actually exists for. A
+:class:`PartitionPlan` is a deterministic, seeded schedule of **directed
+link transitions**: ``cut(src, dst)`` makes every delivery from ``src``
+to ``dst`` fail (blackhole/refuse) until a scheduled ``heal``. Because
+links are directed, asymmetric partitions (A can reach B, B cannot reach
+A) and flapping links are first-class.
+
+Design constraints (the same three the injector carries):
+
+1. **Deterministic.** The plan is a pure function of (seed, the
+   schedule the scenario declared, the logical step at which
+   ``advance()`` is called). Per-delivery checks draw NO randomness and
+   append NO log entries — only scheduled cut/heal *transitions* are
+   recorded (via ``FaultInjector.record``) — so timing-dependent arrival
+   counts (read-fence probes, client retries) cannot perturb the
+   injection log, and two seeded runs stay byte-identical. Flap
+   interval jitter comes from a ``random.Random`` seeded per link at
+   schedule-build time.
+2. **Near-zero cost when off.** ``check_link`` returns immediately when
+   no injector/plan is configured; transports guard with one call.
+3. **Observable.** Cut AND heal transitions land in the injection log
+   as first-class ``net.partition`` entries (heals included — recovery
+   timing is part of the seeded contract), and blocked deliveries bump
+   ``jobset_chaos_partition_blocked_total`` per link.
+
+Time is a **logical step counter**, never the wall clock: the scenario
+driver calls ``plan.advance(step)`` between storm iterations (step =
+write index), so scheduled heals replay byte-identically. Callers that
+want immediate effect (bench wall-clock windows) use ``apply_cut`` /
+``apply_heal``, which schedule at the current step and advance in place.
+
+Enforcement sits at both transports: ``ha/replication.py`` (LocalPeer
+and HttpPeer consult ``guard()`` before every peer RPC, so a cut link
+refuses instead of delivering append-entries/position/log/snapshot) and
+``client.py`` (every client HTTP round trip consults ``check_link``
+against the server's netloc). Rate-based rules at the ``net.partition``
+point (CLI spec, e.g. ``net.partition:refuse@0.05``) ride the same
+check and fire per delivery like any other injector rule.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Optional
+
+from .injector import FaultInjector, consult, get_injector
+
+KIND_CUT = "cut"
+KIND_HEAL = "heal"
+
+_POINT = "net.partition"
+
+
+class PartitionPlan:
+    """Seeded schedule of directed link cuts and heals.
+
+    The schedule is a list of ``(step, kind, src, dst)`` transitions,
+    applied in (step, insertion) order by :meth:`advance`. Scenarios
+    build it up front (or extend it mid-run at deterministic steps —
+    e.g. "cut whoever is leading at step 6", which is itself a
+    deterministic identity in a seeded run)."""
+
+    def __init__(self, seed: int = 0, injector: Optional[FaultInjector] = None):
+        self.seed = seed
+        self.injector = injector
+        self._lock = threading.Lock()
+        # PENDING transitions (insertion order): (step, kind, src, dst).
+        # advance() consumes the due prefix in (step, insertion) order.
+        self._schedule: list[tuple[int, str, str, str]] = []
+        self._cut: set[tuple[str, str]] = set()
+        self.step = 0
+        # (src, dst) -> deliveries blocked while the link was cut
+        # (counters only — per-delivery log entries would make the log
+        # timing-dependent; see module docstring).
+        self.blocked: dict[tuple[str, str], int] = {}
+        if injector is not None:
+            # The transports resolve the plan through the injector they
+            # already carry, so scenario wiring stays one object.
+            injector.partition_plan = self
+
+    # -- schedule building --------------------------------------------------
+
+    def _links(self, src: str, dst: str, symmetric: bool):
+        yield (src, dst)
+        if symmetric:
+            yield (dst, src)
+
+    def cut(self, src: str, dst: str, at: int = 0,
+            heal_at: Optional[int] = None, symmetric: bool = False) -> None:
+        """Schedule a cut of src->dst at step `at` (and dst->src too when
+        `symmetric`), healing at step `heal_at` (None = until healed
+        explicitly)."""
+        with self._lock:
+            for a, b in self._links(src, dst, symmetric):
+                self._schedule.append((int(at), KIND_CUT, a, b))
+                if heal_at is not None:
+                    self._schedule.append((int(heal_at), KIND_HEAL, a, b))
+
+    def heal(self, src: str, dst: str, at: int = 0,
+             symmetric: bool = False) -> None:
+        """Schedule a heal of src->dst at step `at`."""
+        with self._lock:
+            for a, b in self._links(src, dst, symmetric):
+                self._schedule.append((int(at), KIND_HEAL, a, b))
+
+    def flap(self, src: str, dst: str, at: int, until: int,
+             period: int = 2, symmetric: bool = False) -> int:
+        """Schedule a flapping link: alternating cut/heal transitions from
+        step `at` to step `until`, each interval `period` steps long with
+        ±1 step of jitter drawn from a per-link seeded stream (so two
+        flapping links don't move in lockstep). Always ends with a heal
+        at `until`. Returns the number of transitions scheduled."""
+        rng = random.Random(f"{self.seed}/{src}->{dst}")
+        scheduled = 0
+        step, kind = int(at), KIND_CUT
+        with self._lock:
+            while step < int(until):
+                for a, b in self._links(src, dst, symmetric):
+                    self._schedule.append((step, kind, a, b))
+                    scheduled += 1
+                kind = KIND_HEAL if kind == KIND_CUT else KIND_CUT
+                step += max(1, period + rng.choice((-1, 0, 1)))
+            for a, b in self._links(src, dst, symmetric):
+                self._schedule.append((int(until), KIND_HEAL, a, b))
+                scheduled += 1
+        return scheduled
+
+    # -- applying transitions ----------------------------------------------
+
+    def advance(self, step: Optional[int] = None) -> list[dict]:
+        """Apply every not-yet-applied scheduled transition with
+        transition-step <= `step` (default: everything scheduled so far),
+        in (step, insertion) order. Cut/heal events are recorded into the
+        injector log as first-class entries. Returns the applied
+        transitions."""
+        applied: list[dict] = []
+        with self._lock:
+            if step is not None:
+                self.step = max(self.step, int(step))
+            target = self.step if step is not None else None
+            indexed = list(enumerate(self._schedule))
+            due = sorted(
+                (
+                    (at, i, kind, src, dst)
+                    for i, (at, kind, src, dst) in indexed
+                    if target is None or at <= target
+                ),
+                key=lambda t: (t[0], t[1]),
+            )
+            due_indexes = {i for _, i, _, _, _ in due}
+            self._schedule = [
+                t for i, t in indexed if i not in due_indexes
+            ]
+            for at, _i, kind, src, dst in due:
+                link = (src, dst)
+                if kind == KIND_CUT and link not in self._cut:
+                    self._cut.add(link)
+                    applied.append({
+                        "step": at, "kind": KIND_CUT, "src": src, "dst": dst,
+                    })
+                elif kind == KIND_HEAL and link in self._cut:
+                    self._cut.discard(link)
+                    applied.append({
+                        "step": at, "kind": KIND_HEAL, "src": src, "dst": dst,
+                    })
+        # Log OUTSIDE the plan lock (the injector takes its own).
+        if self.injector is not None:
+            for t in applied:
+                self.injector.record(
+                    _POINT, t["kind"],
+                    f"{t['src']}->{t['dst']} @step {t['step']}",
+                )
+        return applied
+
+    def apply_cut(self, src: str, dst: str, symmetric: bool = False) -> None:
+        """Cut now (wall-clock callers: bench windows)."""
+        self.cut(src, dst, at=self.step, symmetric=symmetric)
+        self.advance(self.step)
+
+    def isolate(self, node: str, others, at: Optional[int] = None) -> None:
+        """Cut every link between `node` and each of `others`, both
+        directions, at step `at` (default: now) and apply — THE
+        leader-isolation fault, shared by the checker-gated scenarios
+        and `bench.py --partition` so both measure the same cut."""
+        step = self.step if at is None else int(at)
+        for other in others:
+            if other != node:
+                self.cut(node, other, at=step, symmetric=True)
+        self.advance(step)
+
+    def apply_heal(self, src: str, dst: str, symmetric: bool = False) -> None:
+        """Heal now (wall-clock callers: bench windows)."""
+        self.heal(src, dst, at=self.step, symmetric=symmetric)
+        self.advance(self.step)
+
+    def heal_all(self, step: Optional[int] = None) -> list[dict]:
+        """Schedule-and-apply a heal of every currently-cut link (scenario
+        teardown / convergence phase)."""
+        with self._lock:
+            cut = sorted(self._cut)
+            at = self.step if step is None else int(step)
+        for src, dst in cut:
+            self.heal(src, dst, at=at)
+        return self.advance(at)
+
+    # -- per-delivery checks ------------------------------------------------
+
+    def is_cut(self, src: str, dst: str) -> bool:
+        with self._lock:
+            return (src, dst) in self._cut
+
+    def note_blocked(self, src: str, dst: str) -> None:
+        with self._lock:
+            self.blocked[(src, dst)] = self.blocked.get((src, dst), 0) + 1
+        from ..core import metrics
+
+        metrics.chaos_partition_blocked_total.inc(f"{src}->{dst}")
+
+    def cut_links(self) -> list[tuple[str, str]]:
+        with self._lock:
+            return sorted(self._cut)
+
+
+def get_plan(injector: Optional[FaultInjector] = None) -> Optional[PartitionPlan]:
+    """Resolve the active plan: the one attached to `injector` (explicit,
+    else the process-global injector the CLI's ``--inject`` installs).
+    There is deliberately no plan-only global: a plan without an injector
+    could not log its transitions, and every install path — supervisor,
+    scenarios, bench, an embedding process — already owns an injector to
+    attach to. CLI-only deployments reach this point through rate rules
+    (``net.partition:refuse@RATE``), which need no plan at all."""
+    if injector is None:
+        injector = get_injector()
+    return getattr(injector, "partition_plan", None) if injector else None
+
+
+def check_link(src: str, dst: str,
+               injector: Optional[FaultInjector] = None) -> Optional[str]:
+    """One delivery over the directed link src->dst: returns a reason
+    string when the delivery must fail (link cut by the active plan, or a
+    rate-based ``net.partition`` rule fired), else None. Shared by both
+    transports and the client so partition semantics cannot drift."""
+    if injector is None:
+        injector = get_injector()
+    fault = consult(_POINT, f"{src}->{dst}", injector=injector)
+    if fault is not None:
+        return (
+            f"chaos {_POINT}: injected {fault.kind} on link "
+            f"{src}->{dst} (seq {fault.seq})"
+        )
+    plan = get_plan(injector)
+    if plan is not None and plan.is_cut(src, dst):
+        plan.note_blocked(src, dst)
+        return f"chaos {_POINT}: link {src}->{dst} is cut"
+    return None
+
+
+def guard(src: str, dst: str,
+          injector: Optional[FaultInjector] = None) -> None:
+    """check_link that raises ConnectionError — what the HA peer
+    transports call before dialing (a cut link refuses instead of
+    delivering)."""
+    reason = check_link(src, dst, injector=injector)
+    if reason is not None:
+        raise ConnectionError(reason)
+
+
+__all__ = [
+    "KIND_CUT",
+    "KIND_HEAL",
+    "PartitionPlan",
+    "check_link",
+    "get_plan",
+    "guard",
+]

@@ -371,15 +371,10 @@ def policy_inference_faults(
 # ---------------------------------------------------------------------------
 
 
-def ha_write_attempt(address: str, name: str, timeout: float = 5.0):
-    """One suspended-JobSet create against a replicated control plane's
-    serving address. Returns (status, warning): a 201 with warning=None
-    is a MAJORITY-acknowledged write (the contract the HA soaks and
-    `bench.py --ha` both assert on — shared here so they cannot drift);
-    (None, None) means no listener / connection died mid-flight."""
-    import urllib.error
-    import urllib.request
-
+def _suspended_gang_yaml(name: str, labels=None) -> bytes:
+    """The canonical suspended-JobSet write body shared by every HA and
+    partition write path (kill soaks, `bench.py --ha/--partition`, the
+    partition harness) so the planes' write contracts cannot drift."""
     from ..api import serialization
     from ..testing import make_jobset, make_replicated_job
 
@@ -392,21 +387,22 @@ def ha_write_attempt(address: str, name: str, timeout: float = 5.0):
         .suspend(True)
         .obj()
     )
-    req = urllib.request.Request(
-        f"http://{address}/apis/jobset.x-k8s.io/v1alpha2"
-        f"/namespaces/default/jobsets",
-        data=serialization.to_yaml(js).encode(),
-        method="POST",
-        headers={"Content-Type": "application/yaml"},
+    if labels:
+        js.metadata.labels = dict(labels)
+    return serialization.to_yaml(js).encode()
+
+
+def ha_write_attempt(address: str, name: str, timeout: float = 5.0):
+    """One suspended-JobSet create against a replicated control plane's
+    serving address. Returns (status, warning): a 201 with warning=None
+    is a MAJORITY-acknowledged write (the contract the HA soaks and
+    `bench.py --ha` both assert on — shared here so they cannot drift);
+    (None, None) means no listener / connection died mid-flight."""
+    status, _, headers = _http_call(
+        address, "POST", _API_JOBSETS, _suspended_gang_yaml(name),
+        timeout=timeout,
     )
-    try:
-        with urllib.request.urlopen(req, timeout=timeout) as resp:
-            return resp.status, resp.headers.get("Warning")
-    except urllib.error.HTTPError as exc:
-        exc.read()
-        return exc.code, None
-    except (urllib.error.URLError, OSError):
-        return None, None
+    return status, _header(headers, "Warning")
 
 
 def _ha_write_storm(replica_set, writes: int, kill_after: Optional[int],
@@ -725,6 +721,504 @@ def thundering_herd(
         "injection_log": injector.log_snapshot(),
         "final_state": final_state,
     }
+
+
+# ---------------------------------------------------------------------------
+# Partition-tolerance scenarios (chaos/net.py + jobset_tpu/verify, docs/ha.md
+# "Consistency guarantees"). Each drives a replica set through a seeded
+# network-fault schedule while recording every client-visible operation
+# into a verify.HistoryRecorder, and gates acceptance on the consistency
+# checker: zero majority-acked loss, one unfenced leader per term,
+# session-monotonic reads, and a linearizable register. A run with
+# read_fence=False re-opens the minority-stale-read hole, and the checker
+# FAILS it — the proof the checker has teeth.
+# ---------------------------------------------------------------------------
+
+_API_JOBSETS = "/apis/jobset.x-k8s.io/v1alpha2/namespaces/default/jobsets"
+
+# The single-object register the linearizability invariant covers: one
+# JobSet whose labels["v"] is the register value (labels are the only
+# freely-mutable field, so updates replay through the full PUT path).
+REGISTER_NAME = "reg"
+REGISTER_KEY = f"default/{REGISTER_NAME}"
+
+
+def _http_call(address: str, method: str, path: str, body=None,
+               timeout: float = 5.0):
+    """One raw HTTP round trip; returns (status, parsed-json-or-None,
+    headers dict). status None = no listener / connection died."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"http://{address}{path}", data=body, method=method,
+        headers={"Content-Type": "application/yaml"} if body else {},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            data = resp.read()
+            headers = dict(resp.headers)
+            status = resp.status
+    except urllib.error.HTTPError as exc:
+        data = exc.read()
+        headers = dict(exc.headers)
+        status = exc.code
+    except (urllib.error.URLError, OSError):
+        return None, None, {}
+    try:
+        parsed = _json.loads(data)
+    except ValueError:
+        parsed = None
+    return status, parsed, headers
+
+
+def _header(headers: dict, name: str):
+    for key, value in headers.items():
+        if key.lower() == name.lower():
+            return value
+    return None
+
+
+def _replication_identity(headers: dict):
+    """(term, replica) from the response's replication identity headers
+    (server.py stamps X-Jobset-Term / X-Jobset-Replica on every API
+    response of a replicated server)."""
+    term = _header(headers, "X-Jobset-Term")
+    return (
+        int(term) if term is not None else None,
+        _header(headers, "X-Jobset-Replica"),
+    )
+
+
+class PartitionHarness:
+    """Shared driver for the partition scenarios: a `ha.ReplicaSet` whose
+    injector carries a seeded `PartitionPlan`, plus history-recorded
+    read/write primitives. Writes are ack-gated by default (retried
+    through failovers until a CLEAN majority acknowledgement, recorded as
+    ONE operation) so the committed history — and with it every recorded
+    status, value, and resourceVersion — is a pure function of the
+    operation sequence, never of failover timing; raw fencing terms are
+    the one timing-dependent field, which `HistoryRecorder.normalized()`
+    maps to dense indices for the byte-identity gate."""
+
+    def __init__(self, base_dir: str, seed: int = 13, replicas: int = 3,
+                 read_fence: bool = True):
+        from ..ha import ReplicaSet
+        from ..verify import HistoryRecorder
+        from .net import PartitionPlan
+
+        self.seed = seed
+        self.injector = FaultInjector(seed=seed)
+        self.plan = PartitionPlan(seed=seed, injector=self.injector)
+        self.recorder = HistoryRecorder()
+        # HTTP attempts the most recent write() needed to reach its
+        # terminal status (partition_flap's first-attempt-clean stat).
+        self.last_write_attempts = 0
+        self.replica_set = ReplicaSet(
+            base_dir, n=replicas,
+            lease_duration=0.4, retry_period=0.1, tick_interval=0.05,
+            injector=self.injector, read_fence=read_fence,
+        ).start()
+
+    def stop(self) -> None:
+        self.replica_set.stop()
+
+    # -- primitives ---------------------------------------------------------
+
+    @staticmethod
+    def _gang_body(name: str, labels=None) -> bytes:
+        return _suspended_gang_yaml(name, labels)
+
+    def write(self, session: str, name: str, labels=None,
+              update: bool = False, retry: bool = True,
+              deadline_s: float = 30.0):
+        """One recorded write: POST (create) or PUT (update) of `name`.
+        retry=True keeps attempting — stepping the replica set between
+        tries — until a clean majority ack (or a 409: the write landed
+        under a lost ack; the next clean ack covers it); retry=False
+        records whatever the single attempt answered (Warning probes,
+        no-listener outages)."""
+        import time as _t
+
+        path = _API_JOBSETS + (f"/{name}" if update else "")
+        body = self._gang_body(name, labels)
+        op = self.recorder.invoke(
+            session, "write", f"default/{name}",
+            value=(labels or {}).get("v"),
+        )
+        deadline = _t.monotonic() + deadline_s
+        self.last_write_attempts = 0
+        while True:
+            self.last_write_attempts += 1
+            status, _payload, headers = _http_call(
+                self.replica_set.address,
+                "PUT" if update else "POST", path, body,
+            )
+            ok = status is not None and 200 <= status < 300
+            clean = ok and not _header(headers, "Warning")
+            term, replica = _replication_identity(headers)
+            # Terminal outcomes: a clean majority ack, a 409 (the write
+            # landed under a lost ack; the next clean ack covers its
+            # durability), any client error, or single-shot mode. A
+            # Warning 2xx under retry is NOT terminal — the retry's 409
+            # closes the op, still unacked.
+            if clean or not retry or status == 409 or (
+                status is not None and 400 <= status < 500
+                and status != 409
+            ):
+                self.recorder.complete(
+                    op, ok or status == 409, status=status,
+                    term=term, replica=replica, acked=clean,
+                )
+                return status
+            if _t.monotonic() > deadline:
+                raise RuntimeError(
+                    f"write {name} never acknowledged within {deadline_s}s"
+                )
+            self.replica_set.step()
+            _t.sleep(0.02)
+
+    def read(self, session: str, server=None):
+        """One single-shot recorded read of the jobset collection (items
+        + the journal resourceVersion — the list half of list-then-watch,
+        so the rv is client-visible state). `server` targets a specific
+        replica's in-process surface — the zombie-leader read the fence
+        exists for; default goes over HTTP to the serving address.
+        Returns (status, rv, register value)."""
+        op = self.recorder.invoke(session, "read", REGISTER_KEY)
+        if server is not None:
+            result = server._route("GET", _API_JOBSETS, b"")
+            status, payload = result[0], result[1]
+            headers = dict(result[3]) if len(result) > 3 else {}
+        else:
+            status, payload, headers = _http_call(
+                self.replica_set.address, "GET", _API_JOBSETS
+            )
+        ok = status is not None and 200 <= status < 300
+        rv = value = None
+        if ok and isinstance(payload, dict):
+            rv = payload.get("resourceVersion")
+            for item in payload.get("items", ()):
+                meta = item.get("metadata") or {}
+                if meta.get("name") == REGISTER_NAME:
+                    value = (meta.get("labels") or {}).get("v")
+        term, replica = _replication_identity(headers)
+        self.recorder.complete(
+            op, ok, status=status, value=value, rv=rv,
+            term=term, replica=replica,
+        )
+        return status, rv, value
+
+    # -- topology control ---------------------------------------------------
+
+    def isolate(self, replica_id: str, step: int) -> None:
+        """Cut every link between `replica_id` and the rest, both
+        directions, at plan step `step` (logged cut transitions)."""
+        self.plan.isolate(
+            replica_id,
+            [r.replica_id for r in self.replica_set.replicas],
+            at=step,
+        )
+
+    def split_all(self, step: int) -> None:
+        """Full N-way split: every directed link cut."""
+        ids = [r.replica_id for r in self.replica_set.replicas]
+        for src in ids:
+            for dst in ids:
+                if src != dst:
+                    self.plan.cut(src, dst, at=step)
+        self.plan.advance(step)
+
+    def await_leader(self, other_than=None, deadline_s: float = 30.0):
+        """Step the supervisor until a leader exists (and differs from
+        `other_than`, when given)."""
+        import time as _t
+
+        deadline = _t.monotonic() + deadline_s
+        while _t.monotonic() < deadline:
+            self.replica_set.step()
+            leader = self.replica_set.leader()
+            if leader is not None and leader is not other_than:
+                return leader
+            _t.sleep(0.03)
+        raise RuntimeError("no leader elected within the deadline")
+
+    def await_lost_quorum(self, replica, deadline_s: float = 30.0) -> None:
+        """Wait until `replica`'s coordinator has OBSERVED quorum loss
+        (the pump's idle re-ships accrue the failures within a few
+        ticks). Scenario reads against a minority leader come after
+        this, so their outcome is the fence's deterministic
+        fenced/lost_quorum short-circuit — not a race against the
+        read_fence_age_s freshness window."""
+        import time as _t
+
+        deadline = _t.monotonic() + deadline_s
+        while _t.monotonic() < deadline:
+            coordinator = replica.coordinator
+            if coordinator is None or coordinator.lost_quorum \
+                    or coordinator.fenced:
+                return
+            _t.sleep(0.02)
+        raise RuntimeError("quorum loss never observed")
+
+    def await_no_leader(self, deadline_s: float = 30.0) -> None:
+        """Step until no replica serves (the quorumless split state)."""
+        import time as _t
+
+        deadline = _t.monotonic() + deadline_s
+        while _t.monotonic() < deadline:
+            self.replica_set.step()
+            if self.replica_set.leader() is None:
+                return
+            _t.sleep(0.03)
+        raise RuntimeError("a leader kept serving past the deadline")
+
+    def reconcile(self, replica) -> dict:
+        """Post-heal log reconciliation of a (demoted or lagging)
+        follower against the quorum — the rejoin path: divergent tails
+        from its deposed epoch are truncated, the quorum's tail copied."""
+        from ..ha.replication import catch_up
+
+        return catch_up(
+            replica.log,
+            self.replica_set.peers_for(replica),
+            cluster_size=len(self.replica_set.replicas),
+        )
+
+    # -- verdict ------------------------------------------------------------
+
+    def result(self, scenario: str, extra=None) -> dict:
+        """Final-state capture + the consistency checker verdict. The
+        byte-identity artifact is (injection_log, history, checker,
+        final_keys, final_seq, commit_seq) — deliberately NOT the
+        blocked-delivery counters, which depend on how many read-fence
+        probes and retries wall-clock timing produced."""
+        import json as _json
+
+        from ..verify import check_history
+
+        leader = self.replica_set.leader()
+        serialized = leader.store.serialized_state()["jobsets"]
+        final_state = {}
+        for key, payload in serialized.items():
+            value = None
+            if key == REGISTER_KEY:
+                manifest = _json.loads(payload).get("manifest") or {}
+                meta = manifest.get("metadata") or {}
+                value = (meta.get("labels") or {}).get("v")
+            final_state[key] = value
+        report = check_history(
+            self.recorder.snapshot(),
+            final_state=final_state,
+            register_key=REGISTER_KEY,
+        )
+        return {
+            "scenario": scenario,
+            "seed": self.seed,
+            "leader": leader.replica_id,
+            "history": self.recorder.normalized(),
+            "checker": report.to_dict(),
+            "injection_log": self.injector.log_snapshot(),
+            "final_keys": sorted(final_state),
+            "final_seq": leader.store.seq,
+            "commit_seq": leader.store.commit_seq,
+            "blocked_links": sorted(
+                f"{src}->{dst}" for src, dst in self.plan.blocked
+            ),
+            **(extra or {}),
+        }
+
+
+def leader_isolated(base_dir: str, seed: int = 13,
+                    read_fence: bool = True) -> dict:
+    """The canonical partition scenario: the leader is cut from both
+    followers (symmetric), keeps acking only with quorum Warnings, the
+    majority side elects a successor, and the deposed leader's surface —
+    still holding a connected client — is asked for a read AFTER the
+    session has seen the new epoch. With the read fence on, that zombie
+    read answers 503 + leader hint and the checker passes; with
+    read_fence=False the stale cluster answers, and the checker fails on
+    session monotonicity AND register linearizability — the teeth test.
+    Heal + reconciliation then brings the deposed leader's log to the
+    exact quorum position, ghost tail truncated."""
+    harness = PartitionHarness(base_dir, seed=seed, read_fence=read_fence)
+    try:
+        replica_set = harness.replica_set
+        # Healthy baseline: ledger writes + the register at v=1, v=2.
+        for i in range(4):
+            harness.write("writer", f"iso-{i:03d}")
+        harness.write("writer", REGISTER_NAME, labels={"v": "1"})
+        harness.write("writer", REGISTER_NAME, labels={"v": "2"},
+                      update=True)
+        harness.read("reader")
+        old = replica_set.leader()
+        old_server = old.server
+        # Isolate the leader. Its next write applies locally but cannot
+        # reach a quorum: 2xx + Warning, recorded as indeterminate.
+        harness.isolate(old.replica_id, step=1)
+        harness.write("writer", "iso-warn", retry=False)
+        # A read against the isolated leader once it has OBSERVED quorum
+        # loss: the fence answers 503 (it cannot prove quorum-fresh
+        # state); unfenced it serves — still legal here, nothing newer
+        # exists yet.
+        harness.await_lost_quorum(old)
+        harness.read("reader", server=old_server)
+        # Majority side elects a successor and makes progress.
+        new = harness.await_leader(other_than=old)
+        harness.write("writer", "iso-after")
+        harness.write("writer", REGISTER_NAME, labels={"v": "3"},
+                      update=True)
+        harness.read("reader")
+        # THE zombie read: same session, after observing the new epoch,
+        # against the deposed leader's still-reachable surface.
+        harness.read("reader", server=old_server)
+        # Heal and reconcile the deposed leader to the exact quorum log.
+        harness.plan.heal_all(step=2)
+        rejoin = harness.reconcile(old)
+        position = old.log.position()
+        return harness.result("leader_isolated", extra={
+            "read_fence": read_fence,
+            "isolated": old.replica_id,
+            "rejoin": rejoin,
+            "follower_position": position,
+            "converged": (
+                position["lastSeq"] == new.store.seq
+                and position["commitSeq"] == new.store.commit_seq
+            ),
+        })
+    finally:
+        harness.stop()
+
+
+def split_3way(base_dir: str, seed: int = 17) -> dict:
+    """Full 3-way split: every directed link cut. Nobody can prove a
+    quorum, so after the deposed leader steps down NO replica serves
+    (writes answer nothing at all — unavailability is the correct
+    partition-tolerant behavior, not split-brain). On heal the original
+    leader re-promotes — its own log ranks most up-to-date — and its
+    Warning-acked write from the split is committed by the first
+    post-promotion replicate (Raft's prior-term entry adoption)."""
+    harness = PartitionHarness(base_dir, seed=seed)
+    try:
+        replica_set = harness.replica_set
+        for i in range(3):
+            harness.write("writer", f"split-{i:03d}")
+        harness.write("writer", REGISTER_NAME, labels={"v": "1"})
+        harness.read("reader")
+        harness.split_all(step=1)
+        # One write against the still-serving leader: quorum Warning.
+        harness.write("writer", "split-warn", retry=False)
+        # The leader loses quorum and steps down; elections fail
+        # (establish_term cannot reach a majority) until the heal.
+        harness.await_no_leader()
+        for i in range(3):
+            harness.write("writer", f"split-dark-{i}", retry=False)
+        harness.read("reader")
+        harness.plan.heal_all(step=2)
+        leader = harness.await_leader()
+        harness.write("writer", "split-after")
+        harness.read("reader")
+        serialized = leader.store.serialized_state()["jobsets"]
+        return harness.result("split_3way", extra={
+            "warn_write_committed": "default/split-warn" in serialized,
+        })
+    finally:
+        harness.stop()
+
+
+def partition_flap(base_dir: str, seed: int = 19, writes: int = 10,
+                   period: int = 2) -> dict:
+    """Flapping link between the leader and one follower while a write
+    storm runs: the quorum holds through every flap (leader + the other
+    follower), so availability stays 100% and every write acks clean on
+    the first attempt; the flapped follower lags during cut intervals
+    and is caught up from the resend buffer on each heal. Cut AND heal
+    transitions land in the injection log at their scheduled steps (the
+    per-link seeded jitter included), so two seeded runs log identical
+    flap schedules."""
+    harness = PartitionHarness(base_dir, seed=seed)
+    try:
+        replica_set = harness.replica_set
+        leader = replica_set.leader()
+        victim = next(
+            r for r in replica_set.replicas if r is not leader
+        )
+        transitions = harness.plan.flap(
+            leader.replica_id, victim.replica_id,
+            at=1, until=writes + 1, period=period, symmetric=True,
+        )
+        harness.write("writer", REGISTER_NAME, labels={"v": "1"})
+        clean_first_attempt = 0
+        for i in range(writes):
+            harness.plan.advance(i + 1)
+            status = harness.write("writer", f"flap-{i:03d}")
+            # Honest stat: a clean ack on the FIRST HTTP attempt — not
+            # merely "the internal retry loop eventually got there".
+            if status == 201 and harness.last_write_attempts == 1:
+                clean_first_attempt += 1
+        harness.plan.advance(writes + 1)  # terminal heal
+        harness.write("writer", REGISTER_NAME, labels={"v": "2"},
+                      update=True)
+        harness.read("reader")
+        # One post-heal write re-probes the flapped follower and ships
+        # the whole gap from the resend buffer: exact convergence.
+        harness.write("writer", "flap-final")
+        position = victim.log.position()
+        return harness.result("partition_flap", extra={
+            "flap_transitions": transitions,
+            "clean_first_attempt": clean_first_attempt,
+            "victim": victim.replica_id,
+            "follower_position": position,
+            "converged": position["lastSeq"] == leader.store.seq,
+        })
+    finally:
+        harness.stop()
+
+
+def asymmetric_link(base_dir: str, seed: int = 23,
+                    writes: int = 6) -> dict:
+    """One-directional cut (leader -> follower only): the leader cannot
+    ship frames to the victim — its lag grows, the contact report flags
+    the link — but the REVERSE direction still works, so the victim can
+    pull the tail itself via catch-up (reconciliation over the healthy
+    direction). Quorum holds via the other follower throughout; after
+    the heal one ship converges the victim exactly."""
+    harness = PartitionHarness(base_dir, seed=seed)
+    try:
+        replica_set = harness.replica_set
+        leader = replica_set.leader()
+        victim = next(
+            r for r in replica_set.replicas if r is not leader
+        )
+        harness.write("writer", REGISTER_NAME, labels={"v": "1"})
+        harness.plan.cut(leader.replica_id, victim.replica_id, at=1)
+        harness.plan.advance(1)
+        for i in range(writes):
+            harness.write("writer", f"asym-{i:03d}")
+        harness.read("reader")
+        lag_during_cut = leader.coordinator.follower_lag()[
+            victim.replica_id
+        ]
+        # The healthy reverse direction: the victim pulls the missing
+        # tail itself (catch-up probes leader + other follower — its own
+        # outbound links are NOT cut).
+        pull = harness.reconcile(victim)
+        pulled_position = victim.log.position()
+        harness.plan.heal_all(step=2)
+        harness.write("writer", "asym-final")
+        harness.read("reader")
+        position = victim.log.position()
+        return harness.result("asymmetric_link", extra={
+            "victim": victim.replica_id,
+            "lag_during_cut": lag_during_cut,
+            "reverse_pull": pull,
+            "pulled_to": pulled_position["lastSeq"],
+            "follower_position": position,
+            "converged": position["lastSeq"] == leader.store.seq,
+        })
+    finally:
+        harness.stop()
 
 
 def follower_kill(

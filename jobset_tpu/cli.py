@@ -543,12 +543,17 @@ def _cmd_controller_replicated(args) -> int:
 
         chaos.configure(args.inject, seed=args.inject_seed)
 
+    identity = args.lease_identity or default_identity()
+    # src names this replica on the network fault model's directed links
+    # (chaos/net.py), so `--inject 'net.partition:refuse@RATE'` rules —
+    # and any plan an embedding process attaches to the global injector —
+    # see real (identity, peer address) links instead of ""->address.
+    # The injector itself resolves process-globally (--inject).
     peers = [
-        HttpPeer(a.strip(), timeout=args.peer_timeout)
+        HttpPeer(a.strip(), timeout=args.peer_timeout, src=identity)
         for a in args.peers.split(",") if a.strip()
     ]
     cluster_size = len(peers) + 1
-    identity = args.lease_identity or default_identity()
     elector = LeaderElector(
         FileLease(args.lease_file),
         identity,

@@ -90,6 +90,7 @@ class JobSetClient:
         backoff_cap_s: float = 2.0,
         retry_seed: Optional[int] = None,
         user_agent: Optional[str] = None,
+        chaos_src: str = "client",
     ):
         """ca_cert: path to the PEM CA that signed the controller's serving
         cert (utils/certs.py writes it as ca.crt) — enables https:// URLs
@@ -98,7 +99,13 @@ class JobSetClient:
         and transport errors; retry_seed makes the jitter reproducible.
         user_agent: sent on every request — the flow-control plane's flow
         distinguisher, so name your tenant/controller here for fair
-        shuffle-sharding (default: jobset-tpu-client/<version>)."""
+        shuffle-sharding (default: jobset-tpu-client/<version>).
+        chaos_src: this client's identity on the network fault model's
+        directed links (chaos/net.py): every HTTP round trip is one
+        delivery over (chaos_src, server netloc) — a PartitionPlan that
+        cuts the link makes requests fail like a blackholed network
+        (URLError), engaging the same GET-retry/informer-backoff paths a
+        real partition would."""
         from . import __version__
 
         if "://" not in base_url:
@@ -111,6 +118,12 @@ class JobSetClient:
         self._retry_rng = random.Random(retry_seed)
         self.retried_requests = 0
         self.user_agent = user_agent or f"jobset-tpu-client/{__version__}"
+        from urllib.parse import urlsplit
+
+        self.chaos_src = chaos_src
+        # The directed-link destination for the network fault model: the
+        # server's netloc, matching what a PartitionPlan cut names.
+        self._chaos_dst = urlsplit(self.base_url).netloc
         # Pacing hint from the last successful watch poll (the flow
         # plane's saturated-watch-pool partial batches carry one); the
         # informer consults it between polls.
@@ -186,8 +199,21 @@ class JobSetClient:
             else:
                 self._backoff_sleep(attempt)
 
+    def _check_link(self) -> None:
+        """One delivery over the (chaos_src, server) link of the network
+        fault model: raises URLError while the active PartitionPlan has
+        the link cut (or a `net.partition` rate rule fires), so a cut
+        behaves exactly like a blackholed network — GET retries and
+        informer backoff engage, mutations fail to the caller."""
+        from .chaos import net as chaos_net
+
+        reason = chaos_net.check_link(self.chaos_src, self._chaos_dst)
+        if reason is not None:
+            raise urllib.error.URLError(reason)
+
     def _transport_once(self, method: str, path: str, body, headers):
         """One HTTP round trip; returns (parsed payload, response status)."""
+        self._check_link()
         req = urllib.request.Request(
             self.base_url + path, data=body, method=method, headers=headers
         )
@@ -304,6 +330,7 @@ class JobSetClient:
         "pods", "services", "events") — the client-go generated-informer
         analog covering EVERY type an external controller consumes, so
         nothing needs polling."""
+        self._check_link()
         path = (
             f"{self._resource_path(kind, namespace)}?watch=1"
             f"&resourceVersion={int(resource_version)}"

@@ -218,6 +218,12 @@ chaos_injected_faults_total = Counter(
     "Faults injected by the chaos plane, per injection point",
     label_names=("point",),
 )
+chaos_partition_blocked_total = Counter(
+    "jobset_chaos_partition_blocked_total",
+    "Deliveries blackholed by the network fault model's cut links "
+    "(chaos/net.py PartitionPlan), per directed src->dst link",
+    label_names=("link",),
+)
 # Gang admission queue plane (queue/manager.py): workload population per
 # queue plus the preemption counter the eviction path bumps.
 queue_pending_workloads = Gauge(
@@ -335,6 +341,13 @@ ha_failovers_total = Counter(
     "committed log, and took over serving)",
     label_names=(),
 )
+ha_read_fence_rejections_total = Counter(
+    "jobset_ha_read_fence_rejections_total",
+    "API reads answered 503 + leader hint by the quorum read fence (the "
+    "ReadIndex analog: a replica that cannot prove majority contact "
+    "freshness must not serve reads from its possibly-stale cluster)",
+    label_names=(),
+)
 # Learned placement policy plane (jobset_tpu/policy, docs/policy.md):
 # shadow-mode regret banking and active-mode fallback accounting.
 policy_decisions_total = Counter(
@@ -403,12 +416,14 @@ ALL_COUNTERS = (
     placement_budget_exceeded_total,
     reconcile_panics_total,
     chaos_injected_faults_total,
+    chaos_partition_blocked_total,
     queue_preemptions_total,
     store_commits_total,
     store_write_errors_total,
     ha_replicated_records_total,
     ha_quorum_failures_total,
     ha_failovers_total,
+    ha_read_fence_rejections_total,
     policy_decisions_total,
     policy_fallbacks_total,
     flow_rejected_total,

@@ -182,6 +182,24 @@ class FollowerLog:
 
     # -- durability helpers -------------------------------------------------
 
+    def seed_meta(self, term: int, commit_seq: int,
+                  last_entry_term: int) -> None:
+        """Durably seed the mirror's meta from a known-good position (the
+        supervisor's demotion path: the Store never maintained meta.json,
+        so a reopened mirror would otherwise believe commitSeq=0 and a
+        later catch-up would fall back to a full snapshot install).
+        Monotonic-max semantics, commit index capped at the physical log
+        — the same invariants recovery derives."""
+        with self._lock:
+            self.term = max(self.term, int(term))
+            self.commit_seq = max(
+                self.commit_seq, min(int(commit_seq), self.last_seq)
+            )
+            self.last_entry_term = max(
+                self.last_entry_term, int(last_entry_term)
+            )
+            self._persist_meta()
+
     def _persist_meta(self, fsync: bool = True) -> None:
         """Durably record (term, commitSeq). The TERM must survive a crash
         (Raft persists currentTerm for the same reason: a rejoining
@@ -470,13 +488,27 @@ class LocalPeer:
     peer replica's replication surface directly. `target` is any object
     exposing the FollowerLog receiver methods (a FollowerLog, a Replica
     that routes by role, or a ReplicationCoordinator on a current
-    leader)."""
+    leader).
 
-    def __init__(self, peer_id: str, target):
+    `src` names the CALLING replica for the network fault model: every
+    call is one delivery over the directed (src, id) link, refused while
+    the active PartitionPlan has it cut — so in-process partition
+    scenarios exercise exactly the link semantics the HTTP transport
+    enforces. `last_contact` (monotonic of the last successful call)
+    feeds the coordinator's partition-suspicion surface."""
+
+    def __init__(self, peer_id: str, target, src: str = "",
+                 injector=None):
         self.id = peer_id
         self.target = target
+        self.src = src
+        self.injector = injector
+        self.last_contact: Optional[float] = None
 
     def _resolve(self):
+        from ..chaos import net as chaos_net
+
+        chaos_net.guard(self.src, self.id, injector=self.injector)
         target = self.target
         resolved = getattr(target, "replication_surface", None)
         surface = resolved() if callable(resolved) else target
@@ -484,17 +516,27 @@ class LocalPeer:
             raise ConnectionError(f"peer {self.id} is down")
         return surface
 
-    def position(self) -> dict:
-        return self._resolve().position()
+    def _done(self, result: dict) -> dict:
+        import time as _t
+
+        self.last_contact = _t.monotonic()
+        return result
+
+    def position(self, timeout: Optional[float] = None) -> dict:
+        # `timeout` mirrors HttpPeer's probe signature; in-process calls
+        # cannot block on a dial, so it is accepted and ignored.
+        return self._done(self._resolve().position())
 
     def append_entries(self, term, entries, commit_seq=0) -> dict:
-        return self._resolve().append_entries(term, entries, commit_seq)
+        return self._done(
+            self._resolve().append_entries(term, entries, commit_seq)
+        )
 
     def install_snapshot(self, term, doc) -> dict:
-        return self._resolve().install_snapshot(term, doc)
+        return self._done(self._resolve().install_snapshot(term, doc))
 
     def entries_after(self, after_seq) -> dict:
-        return self._resolve().entries_after(after_seq)
+        return self._done(self._resolve().entries_after(after_seq))
 
 
 class HttpPeer:
@@ -505,52 +547,124 @@ class HttpPeer:
     during which further calls fail IMMEDIATELY instead of re-dialing: a
     blackholed peer would otherwise cost a full connect timeout on every
     write's quorum round (the ship loop runs under the cluster lock, so
-    one dead host must not add seconds to every request). Lives at the
-    transport so the coordinator's chaos arrivals and the in-process
-    LocalPeer tests stay deterministic."""
+    one dead host must not add seconds to every request). Position
+    PROBES bypass the window (`probe=True`) and a successful probe
+    clears it on the spot — a healed peer rejoins the quorum on the
+    very next ship instead of serving out the rest of its penalty
+    (which inflated quorum latency right after every heal). Lives at
+    the transport so the coordinator's chaos arrivals and the
+    in-process LocalPeer tests stay deterministic.
+
+    `src` names the calling replica for the network fault model
+    (chaos/net.py): every call is one delivery over the directed
+    (src, address) link, refused while the active PartitionPlan has it
+    cut."""
 
     def __init__(self, address: str, timeout: float = 5.0,
-                 scheme: str = "http", down_backoff_s: float = 1.0):
+                 scheme: str = "http", down_backoff_s: float = 1.0,
+                 src: str = "", injector=None):
         self.id = address
         self.address = address
         self.timeout = timeout
         self.down_backoff_s = down_backoff_s
         self.base = f"{scheme}://{address}/ha/v1"
+        self.src = src
+        self.injector = injector
+        self.last_contact: Optional[float] = None
         self._down_until = 0.0
+        self._probe_after = 0.0
         self._last_error = ""
 
-    def _call(self, method: str, path: str, body: Optional[dict] = None) -> dict:
+    def _call(self, method: str, path: str, body: Optional[dict] = None,
+              probe: bool = False,
+              dial_timeout: Optional[float] = None) -> dict:
         import time as _t
         import urllib.error
         import urllib.request
 
-        if _t.monotonic() < self._down_until:
-            raise ConnectionError(
-                f"peer {self.id} in down-backoff: {self._last_error}"
-            )
+        from ..chaos import net as chaos_net
+
+        now = _t.monotonic()
+        if now < self._down_until:
+            # Probes may enter the down-window to detect a heal — but at
+            # most ONE dial per backoff period: against a genuine
+            # blackhole (no chaos guard to fail fast) every dial costs a
+            # full connect timeout, and the ship loop probes under the
+            # cluster lock, so an unthrottled bypass would reintroduce
+            # the per-write stall the window exists to prevent.
+            if not probe or now < self._probe_after:
+                raise ConnectionError(
+                    f"peer {self.id} in down-backoff: {self._last_error}"
+                )
+            self._probe_after = now + self.down_backoff_s
+        try:
+            chaos_net.guard(self.src, self.id, injector=self.injector)
+        except ConnectionError as exc:
+            # A cut link behaves exactly like a dead host: open the
+            # down-window so the ship loop fails fast until a heal-side
+            # probe proves the peer back.
+            self._last_error = str(exc)
+            self._down_until = _t.monotonic() + self.down_backoff_s
+            raise
         data = json.dumps(body).encode() if body is not None else None
         req = urllib.request.Request(
             self.base + path, data=data, method=method,
             headers={"Content-Type": "application/json"},
         )
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            t = self.timeout if dial_timeout is None else dial_timeout
+            with urllib.request.urlopen(req, timeout=t) as resp:
                 result = json.loads(resp.read())
+                # Success — including a probe inside the down-window —
+                # resets the backoff immediately: the peer is provably
+                # back, no reason to keep failing fast.
                 self._down_until = 0.0
+                self._probe_after = 0.0
+                self.last_contact = _t.monotonic()
                 return result
         except urllib.error.HTTPError as exc:
             detail = exc.read()
-            # The peer is UP (it answered); no backoff.
+            # The peer is UP (it answered): clear any open down-window —
+            # an error status proves reachability exactly as a 2xx does —
+            # and the contact counts for partition suspicion.
+            self._down_until = 0.0
+            self._probe_after = 0.0
+            self.last_contact = _t.monotonic()
             raise ConnectionError(
                 f"peer {self.id}: HTTP {exc.code} {detail[:200]!r}"
             ) from exc
         except (urllib.error.URLError, OSError, ValueError) as exc:
             self._last_error = str(exc)
+            # Stamped at dial COMPLETION: a blackholed peer's connect
+            # timeout can exceed the backoff period, and a start-stamped
+            # throttle would already be expired by the time the dial
+            # fails — re-dialing on every probe.
             self._down_until = _t.monotonic() + self.down_backoff_s
+            self._probe_after = _t.monotonic() + self.down_backoff_s
             raise ConnectionError(f"peer {self.id}: {exc}") from exc
 
-    def position(self) -> dict:
-        return self._call("GET", "/position")
+    @property
+    def in_down_window(self) -> bool:
+        """True while the peer is inside its down-backoff window (known
+        dark). The pump heartbeat skips such peers — its job is keeping
+        QUIET HEALTHY links fresh, and dialing a blackhole from the pump
+        thread would stall reconcile by a connect timeout per window;
+        heal detection stays with the ship/read paths' own probes."""
+        import time as _t
+
+        return _t.monotonic() < self._down_until
+
+    def position(self, timeout: Optional[float] = None) -> dict:
+        # The probe path: may dial inside the down-window (throttled to
+        # one dial per backoff period) so a healed peer's next probe
+        # re-admits it instantly instead of serving out the penalty.
+        # `timeout` lets LATENCY-SENSITIVE callers (the pump heartbeat,
+        # the read fence's confirm_quorum) dial short — a blackholed
+        # connect on the renew thread must never outlast the lease —
+        # while catch_up/promotion keep the operator's full peer
+        # timeout for slow-but-healthy links.
+        return self._call("GET", "/position", probe=True,
+                          dial_timeout=timeout)
 
     def append_entries(self, term, entries, commit_seq=0) -> dict:
         return self._call("POST", "/append", {
@@ -610,6 +724,26 @@ class ReplicationCoordinator:
         self.fenced = False
         self.lost_quorum = False
         self._quorum_failures = 0
+        # Read-fence freshness window (docs/ha.md "Consistency
+        # guarantees"): a read is served only when a majority of
+        # replicas was contacted within this many seconds — else the
+        # ReadIndex-analog probe below must re-prove the quorum first.
+        self.read_fence_age_s = 1.0
+        # Operator-facing partition suspicion threshold (/debug/health):
+        # a peer not contacted for this long is flagged partitionSuspected
+        # BEFORE quorum loss or failover fires.
+        self.suspect_after_s = 2.0
+        # Per-peer heartbeat retry state: (next attempt, backoff). A
+        # failed heartbeat dial backs off exponentially (capped) so an
+        # idle leader with a blackholed peer does not block its pump
+        # thread on a connect timeout every down-window expiry.
+        self._heartbeat_retry: dict[str, tuple[float, float]] = {}
+        # Dial timeout for the fence/heartbeat position probes (server
+        # construction clamps it below the lease): these run on the
+        # lease-renewal cadence, where a blackholed peer's full connect
+        # timeout could expire the lease and force a spurious stepdown
+        # of a quorate leader. catch_up/ship keep the full peer timeout.
+        self.probe_timeout_s = 1.0
 
     @property
     def cluster_size(self) -> int:
@@ -826,6 +960,126 @@ class ReplicationCoordinator:
             peer.id: head - self._peer_acked.get(peer.id, 0)
             for peer in self.peers
         }
+
+    # -- quorum freshness (the read fence's ReadIndex analog) ----------------
+
+    def confirm_quorum(self, max_age_s: Optional[float] = None) -> bool:
+        """True when this leader can prove a MAJORITY of replicas (self
+        included) is reachable right now: peers contacted within
+        `max_age_s` count as fresh; stale ones are probed (a position
+        round trip — the ReadIndex analog's heartbeat). A probe that
+        reveals a higher term fences us on the spot. The read fence
+        serves a GET only when this holds — a quorum-partitioned leader
+        must answer 503 + leader hint rather than its possibly-stale
+        cluster (docs/ha.md "Consistency guarantees")."""
+        import time as _t
+
+        if self.fenced or self.lost_quorum:
+            return False
+        max_age = self.read_fence_age_s if max_age_s is None else max_age_s
+        now = _t.monotonic()
+        fresh = 1  # self
+        stale = []
+        for peer in self.peers:
+            t = getattr(peer, "last_contact", None)
+            if t is not None and now - t <= max_age:
+                fresh += 1
+            else:
+                stale.append(peer)
+        if fresh >= self.majority:
+            return True
+        for peer in stale:
+            try:
+                pos = peer.position(timeout=self.probe_timeout_s)
+            except Exception:
+                continue
+            if int(pos.get("term", 0)) > self.term:
+                self.fenced = True
+                return False
+            fresh += 1
+            if fresh >= self.majority:
+                return True
+        return False
+
+    def heartbeat(self, max_age_s: Optional[float] = None) -> None:
+        """Leader-side contact keep-alive, driven from the pump loop: a
+        caught-up quiet cluster otherwise never contacts its peers (the
+        pump only re-ships when behind), so /debug/health would flag
+        every link partitionSuspected on a perfectly healthy idle
+        system. Probes only peers silent past half the suspicion
+        threshold (bounded: HttpPeer throttles in-window probe dials to
+        one per backoff period) and swallows unreachability — deciding
+        suspicion is the contact report's job — but a probe that reveals
+        a higher term still fences on the spot."""
+        import time as _t
+
+        if self.fenced or self.lost_quorum:
+            return
+        # Refresh HALF a window before the tighter of the two consumers
+        # (suspicion threshold, read-fence freshness): background
+        # refresh must keep idle-period GETs on confirm_quorum's cached
+        # fast path, not just keep suspicion quiet.
+        max_age = (
+            min(self.suspect_after_s, self.read_fence_age_s) / 2.0
+            if max_age_s is None else max_age_s
+        )
+        now = _t.monotonic()
+        for peer in self.peers:
+            t = getattr(peer, "last_contact", None)
+            if t is not None and now - t <= max_age:
+                continue
+            if getattr(peer, "in_down_window", False):
+                # Known dark: a dial would stall the pump thread for a
+                # connect timeout and cannot refresh contact anyway.
+                # The link stays (correctly) suspected; the ship/read
+                # paths' own throttled probes detect the heal.
+                continue
+            next_try, backoff = self._heartbeat_retry.get(
+                peer.id, (0.0, 0.0)
+            )
+            if now < next_try:
+                continue
+            try:
+                pos = peer.position(timeout=self.probe_timeout_s)
+            except Exception:
+                # Exponential failure backoff (capped): a blackholed
+                # dial costs a full connect timeout on the pump thread,
+                # so repeat attempts must get rarer, not periodic.
+                backoff = min(
+                    max(backoff * 2, self.suspect_after_s * 2), 60.0
+                )
+                self._heartbeat_retry[peer.id] = (
+                    _t.monotonic() + backoff, backoff
+                )
+                continue
+            self._heartbeat_retry.pop(peer.id, None)
+            if int(pos.get("term", 0)) > self.term:
+                self.fenced = True
+                return
+
+    def contact_report(self) -> dict[str, dict]:
+        """Per-peer contact ages for /debug/health: when was each peer
+        last successfully reached (any transport-level success — even a
+        term rejection proves the link), and is a partition suspected on
+        its link (never contacted, or silent past `suspect_after_s`)?
+        Surfaces a cut link to operators BEFORE quorum loss or failover
+        fires."""
+        import time as _t
+
+        now = _t.monotonic()
+        report: dict[str, dict] = {}
+        for peer in self.peers:
+            t = getattr(peer, "last_contact", None)
+            age = None if t is None else max(0.0, now - t)
+            report[peer.id] = {
+                "lastContactAgeSeconds": (
+                    None if age is None else round(age, 3)
+                ),
+                "partitionSuspected": (
+                    age is None or age > self.suspect_after_s
+                ),
+            }
+        return report
 
 
 # ---------------------------------------------------------------------------

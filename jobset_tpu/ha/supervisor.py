@@ -86,9 +86,6 @@ class Replica:
             return self.coordinator
         return self.log
 
-    def peer(self) -> LocalPeer:
-        return LocalPeer(self.replica_id, self)
-
 
 class ReplicaSet:
     """N in-process replicas, one shared lease, one stable serving port."""
@@ -105,6 +102,7 @@ class ReplicaSet:
         snapshot_interval: int = 256,
         injector=None,
         cluster_factory=None,
+        read_fence: bool = True,
     ):
         self.base_dir = str(base_dir)
         self.clock = clock
@@ -112,6 +110,11 @@ class ReplicaSet:
         self.snapshot_interval = snapshot_interval
         self.injector = injector
         self.cluster_factory = cluster_factory
+        # Quorum read fence on promoted leaders (docs/ha.md). False is
+        # for the partition checker's teeth test ONLY: it re-opens the
+        # stale-read hole so the consistency checker can prove it would
+        # catch one.
+        self.read_fence = read_fence
         host, _, port = address.rpartition(":")
         self._host = host or "127.0.0.1"
         self.serving_port = int(port) if port else 0
@@ -133,7 +136,14 @@ class ReplicaSet:
     # ------------------------------------------------------------------
 
     def peers_for(self, replica: Replica) -> list[LocalPeer]:
-        return [r.peer() for r in self.replicas if r is not replica]
+        # src identity makes every peer call one delivery over the
+        # directed (src, dst) link of the network fault model: a cut
+        # link refuses in-process exactly as HttpPeer would cross-process.
+        return [
+            LocalPeer(r.replica_id, r, src=replica.replica_id,
+                      injector=self.injector)
+            for r in self.replicas if r is not replica
+        ]
 
     def leader(self) -> Optional[Replica]:
         for r in self.replicas:
@@ -260,6 +270,7 @@ class ReplicaSet:
             standby_accepts_writes=False,
             replication=coordinator,
             injector=self.injector,
+            read_fence=self.read_fence,
         ).start()
         self.serving_port = server.port
         replica.store = store
@@ -275,6 +286,12 @@ class ReplicaSet:
         the store, and mirror again. The lease was already released by
         the pump's stepdown; stop(release_lease=False) keeps it that way
         even if a fresh acquisition raced in."""
+        commit_seq = term = last_term = 0
+        if replica.store is not None:
+            commit_seq = replica.store.commit_seq
+            last_term = replica.store.last_record_term
+        if replica.coordinator is not None:
+            term = replica.coordinator.term
         if replica.server is not None:
             replica.server.stop(release_lease=False)
             replica.server = None
@@ -283,6 +300,13 @@ class ReplicaSet:
             replica.store = None
         replica.coordinator = None
         replica.log = FollowerLog(replica.data_dir)
+        # Seed the mirror's meta from the store's final position: the
+        # Store never maintained meta.json, so without this the reopened
+        # FollowerLog believes commitSeq=0 and a later catch-up falls
+        # back to a full snapshot install — when in truth everything up
+        # to the commit index is majority-acknowledged and only the
+        # unacked suffix (the deposed epoch's ghost tail) can diverge.
+        replica.log.seed_meta(term, commit_seq, last_term)
 
     def kill_leader(self) -> str:
         """Crash the leader: listener gone, store fds dropped mid-state,

@@ -245,6 +245,7 @@ class ControllerServer:
         injector=None,
         replication=None,
         flow=None,
+        read_fence: bool = True,
     ):
         if cluster is None:
             cluster = make_cluster(clock=Clock())
@@ -280,6 +281,34 @@ class ControllerServer:
         # WAL frame and acknowledges writes only at quorum), a FollowerLog
         # on a standby (serving the /ha/v1 append/position/log endpoints).
         self.replication = replication
+        # Quorum read fence (docs/ha.md "Consistency guarantees", the
+        # ReadIndex analog): with replication attached, API reads are
+        # served only after the leader proves majority-contact freshness
+        # (ReplicationCoordinator.confirm_quorum); a quorum-partitioned
+        # leader — and every replicated follower, whose private cluster
+        # is empty — answers 503 + leader hint exactly like standby
+        # writes do. read_fence=False re-opens the stale-read hole
+        # (the consistency checker's teeth test only).
+        self.read_fence = read_fence
+        # The fence's cached-freshness path is sound only when a contact
+        # fresher than the window PROVES no successor can hold the lease
+        # yet: the lease cannot change hands in under lease_duration, so
+        # the window must sit strictly inside it (Raft's lease-read
+        # constraint). Clamp rather than trust the default against
+        # whatever lease the deployment configured.
+        if (read_fence and replication is not None
+                and elector is not None
+                and hasattr(replication, "read_fence_age_s")):
+            replication.read_fence_age_s = min(
+                replication.read_fence_age_s,
+                elector.lease_duration / 2.0,
+            )
+            # Fence/heartbeat probe dials run on the renew cadence; a
+            # blackholed connect must never outlast the lease.
+            replication.probe_timeout_s = min(
+                replication.probe_timeout_s,
+                elector.lease_duration / 4.0,
+            )
         # API priority & fairness (jobset_tpu/flow, docs/flow.md): a
         # FlowController admits/queues/sheds every request BEFORE routing.
         # Explicit `flow` wins; else the APIFlowControl gate selects the
@@ -343,6 +372,15 @@ class ControllerServer:
                 for key, js in cluster.jobsets.items()
             }
             self._events_cursor = cluster.events_total
+        # Highest rv known quorum-committed — the watch delivery floor on
+        # a replicated leader (docs/ha.md "Consistency guarantees"):
+        # events past it may still be truncated if this replica turns out
+        # to be on the minority side, so watchers are never handed them
+        # (etcd likewise only delivers committed revisions). At
+        # construction the whole journal is committed: an unreplicated
+        # server trivially, a promoted leader because promotion ran
+        # catch_up against a majority first.
+        self._quorum_rv = self._watch_rv
 
         host, _, port = address.rpartition(":")
         handler = self._make_handler()
@@ -402,6 +440,107 @@ class ControllerServer:
         if self.elector is not None:
             return self.elector.term
         return 0
+
+    def _read_fence_check(self):
+        """Quorum read fence (the ReadIndex analog; docs/ha.md
+        "Consistency guarantees"): returns a 503 + leader-hint response
+        when this replica must NOT serve API reads — a replicated
+        follower (its private cluster is empty), or a leader that cannot
+        prove majority-contact freshness (fenced, quorum lost, or the
+        confirm_quorum probe fails — the quorum-partitioned-leader
+        stale-read hole). None means the read may be served. Unreplicated
+        servers and read_fence=False are never fenced."""
+        if not self.read_fence or self.replication is None:
+            return None
+        role = self._replication_role()
+        if role == "leader":
+            coordinator = self.replication
+            # Pending-unacked fence: the live cluster state includes
+            # Warning-acked writes no majority holds — a read could
+            # observe a value the new epoch will truncate (the same race
+            # the watch delivery floor closes; here there is no journal
+            # to filter, so the read is refused outright). Checked under
+            # the cluster lock: a healthy write holds it through its
+            # quorum round, so concurrent reads never see the transient
+            # mid-commit gap.
+            store = getattr(self.cluster, "store", None)
+            if store is not None:
+                with self.lock:
+                    pending = store.commit_seq < store.seq
+                if pending:
+                    metrics.ha_read_fence_rejections_total.inc()
+                    return self._read_fence_response(
+                        "state includes writes no majority has "
+                        "acknowledged yet"
+                    )
+            if not (coordinator.fenced or coordinator.lost_quorum) and \
+                    coordinator.confirm_quorum():
+                return None
+            reason = (
+                "majority contact unconfirmed - network partition "
+                "suspected"
+            )
+        else:
+            reason = "replicated follower serves no client reads"
+        metrics.ha_read_fence_rejections_total.inc()
+        return self._read_fence_response(reason)
+
+    def _read_fence_response(self, reason: str):
+        holder, address = (
+            self.elector.leader_hint()
+            if self.elector is not None else ("", "")
+        )
+        return (
+            503,
+            {
+                "error": (
+                    f"reads are fenced on this replica (cannot prove "
+                    f"quorum-fresh state: {reason}); retry against the "
+                    f"leader"
+                ),
+                "identity": (
+                    self.elector.identity
+                    if self.elector is not None else None
+                ),
+                "leader": holder or None,
+                "leaderAddress": address or None,
+            },
+            None,
+            {"Retry-After": "1"},
+        )
+
+    def _watch_delivery_rv(self) -> int:
+        """The journal position watchers may be served up to: on a
+        replicated leader with the read fence, the last quorum-committed
+        rv (events past it came from writes no majority has acknowledged
+        and may yet be truncated); otherwise the journal head. Replicated
+        followers never reach delivery — the admission fence 503s their
+        watch GETs."""
+        if not self.read_fence or self.replication is None:
+            return self._watch_rv
+        if self._replication_role() == "leader":
+            return min(self._watch_rv, self._quorum_rv)
+        return self._watch_rv
+
+    def _stamp_replication_headers(self, result, bare: str):
+        """Replication identity headers (X-Jobset-Term /
+        X-Jobset-Replica) on every API response of a replicated server:
+        the partition consistency checker (jobset_tpu/verify) joins
+        client-visible invoke/response pairs against (term, serving
+        replica) to machine-check that at most one unfenced leader
+        serves per term. Observability surfaces stay untouched."""
+        if self.replication is None or self._is_observability_path(bare):
+            return result
+        code, payload = result[0], result[1]
+        ctype = result[2] if len(result) > 2 else None
+        extra = dict(result[3]) if len(result) > 3 else {}
+        extra.setdefault("X-Jobset-Term", str(self._replication_term()))
+        identity = getattr(self.replication, "identity", "") or (
+            self.elector.identity if self.elector is not None else ""
+        )
+        if identity:
+            extra.setdefault("X-Jobset-Replica", identity)
+        return (code, payload, ctype, extra)
 
     def _stamp_build_info(self) -> None:
         """(Re)stamp jobset_build_info (the kube_pod_info idiom). Called
@@ -582,6 +721,14 @@ class ControllerServer:
             return False
         if self.elector is not None and not self.elector.ensure():
             return False
+        if coordinator is not None:
+            # Idle-contact heartbeat: keeps last_contact fresh on quiet
+            # links so /debug/health's partitionSuspected means a cut
+            # link, never an idle one. A probe revealing a higher term
+            # fences; the next round's fenced branch then steps down.
+            coordinator.heartbeat()
+            if coordinator.fenced:
+                return False
         self.pump()
         return True
 
@@ -650,6 +797,14 @@ class ControllerServer:
             # Quorum acked: now (and only now) the due compaction may
             # fold — snapshots must cover committed history only.
             store.maybe_compact()
+        # Fully durable (local fsync + quorum where replicated): the
+        # journal head is committed — advance the watch delivery floor
+        # and wake parked polls that were bounded by it (self.lock →
+        # _watch_cond is the order _refresh_watch_locked established).
+        if self._quorum_rv != self._watch_rv:
+            with self._watch_cond:
+                self._quorum_rv = self._watch_rv
+                self._watch_cond.notify_all()
         return None
 
     # ------------------------------------------------------------------
@@ -827,9 +982,12 @@ class ControllerServer:
         with self._watch_cond:
             while True:
                 if resource_version < self._watch_trimmed_rv:
+                    # Advertised rv capped at the delivery floor like
+                    # every other client-facing rv: a resume token must
+                    # never cover uncommitted events.
                     return 410, {
                         "error": "resourceVersion too old; relist",
-                        "resourceVersion": self._watch_rv,
+                        "resourceVersion": self._watch_delivery_rv(),
                     }
                 if resource_version > self._watch_rv:
                     # A FUTURE rv can only come from a different server
@@ -842,30 +1000,41 @@ class ControllerServer:
                     return 410, {
                         "error": "resourceVersion is ahead of this "
                                  "server; relist",
-                        "resourceVersion": self._watch_rv,
+                        "resourceVersion": self._watch_delivery_rv(),
                     }
+                # Quorum delivery floor (docs/ha.md "Consistency
+                # guarantees"): on a replicated leader, events past the
+                # last quorum-committed rv stay PARKED — a minority-side
+                # leader's own Warning-acked write journals events that
+                # may later be truncated, and it can land inside the
+                # read fence's freshness window, moments after the cut,
+                # while peer contact still looks fresh. They deliver when
+                # the quorum catches up (the commit path notifies); the
+                # reported rv is capped at the floor so an informer can
+                # never outrun the committed prefix.
+                floor = self._watch_delivery_rv()
                 batch = [
                     {"resourceVersion": rv, **event}
                     for rv, event_kind, event_ns, event in self._watch_events
-                    if rv > resource_version
+                    if floor >= rv > resource_version
                     and event_kind == kind
                     and event_ns == ns
                 ]
                 if batch:
                     result = {
                         "events": batch,
-                        "resourceVersion": self._watch_rv,
+                        "resourceVersion": floor,
                     }
                     if not park:
                         result["retryAfterSeconds"] = retry_hint
-                    return 200, result
+                    break
                 if not park:
                     # Saturated watch seat pool: hand back the (empty)
                     # partial batch now with a pacing hint instead of
                     # parking this handler thread until the timeout.
                     return 200, {
                         "events": [],
-                        "resourceVersion": self._watch_rv,
+                        "resourceVersion": floor,
                         "retryAfterSeconds": retry_hint,
                     }
                 if self._stop.is_set():
@@ -875,12 +1044,29 @@ class ControllerServer:
                     # a long-poll.
                     return 200, {
                         "events": [],
-                        "resourceVersion": self._watch_rv,
+                        "resourceVersion": floor,
                     }
                 remaining = deadline - _t.monotonic()
                 if remaining <= 0:
-                    return 200, {"events": [], "resourceVersion": self._watch_rv}
+                    return 200, {
+                        "events": [], "resourceVersion": floor,
+                    }
                 self._watch_cond.wait(remaining)
+        # Delivery-time read fence: the admission-time check in
+        # _route_inner cannot cover a poll that was PARKED before this
+        # replica lost its quorum. Un-quorum-committed events are already
+        # withheld by the delivery floor above; this withholds even the
+        # committed batch (503 + leader hint) once the replica can no
+        # longer prove quorum freshness — the majority side may have
+        # moved on. Checked OUTSIDE the condition lock: confirm_quorum
+        # may probe peers over the network, and the write path's notify
+        # must never block behind that. Empty returns above skip the
+        # check — they carry no object state, and a stale rv alone is
+        # already handled by the 410 relist semantics.
+        fenced = self._read_fence_check()
+        if fenced is not None:
+            return fenced
+        return 200, result
 
     def _pump_loop(self):
         while not self._stop.wait(self.tick_interval):
@@ -995,9 +1181,12 @@ class ControllerServer:
                 if self._is_observability_path(bare) or (
                     parent is None and method == "GET"
                 ):
-                    return self._route_inner(
-                        method, path, body, headers,
-                        watch_park=watch_park, watch_hint=watch_hint,
+                    return self._stamp_replication_headers(
+                        self._route_inner(
+                            method, path, body, headers,
+                            watch_park=watch_park, watch_hint=watch_hint,
+                        ),
+                        bare,
                     )
                 # One span per API request, parented on the caller's W3C
                 # traceparent when present — the apiserver hop of the
@@ -1017,7 +1206,7 @@ class ControllerServer:
                         watch_park=watch_park, watch_hint=watch_hint,
                     )
                     request_span.set_attribute("http.status", result[0])
-                    return result
+                    return self._stamp_replication_headers(result, bare)
             finally:
                 metrics.api_requests_in_flight.add(-1)
         finally:
@@ -1137,6 +1326,19 @@ class ControllerServer:
         # not by HTTP role checks.
         if path.startswith("/ha/v1/"):
             return self._route_replication(method, path, body, params)
+
+        # Quorum read fence (docs/ha.md "Consistency guarantees"): every
+        # API read — plain GETs and watch long-polls alike — is served
+        # only by a replica that can prove quorum-fresh state. Sits AFTER
+        # the observability/replication surfaces above (health probes and
+        # append-entries must work on a partitioned replica — that is how
+        # operators see the partition and how it heals) and BEFORE the
+        # watch/read routing below, so a minority-side replica answers
+        # 503 + leader hint instead of its possibly-stale cluster.
+        if method == "GET":
+            fenced = self._read_fence_check()
+            if fenced is not None:
+                return fenced
 
         parts = [p for p in path.split("/") if p]
 
@@ -1350,7 +1552,7 @@ class ControllerServer:
                 "apiVersion": serialization.API_VERSION,
                 "kind": "JobSetList",
                 "items": items,
-                "resourceVersion": self._watch_rv,
+                "resourceVersion": self._watch_delivery_rv(),
             }
 
         if name is None:
@@ -1493,7 +1695,7 @@ class ControllerServer:
                 "items": [
                     _event_dict(e) for e in self.cluster.events if keep(e)
                 ],
-                "resourceVersion": self._watch_rv,
+                "resourceVersion": self._watch_delivery_rv(),
             }
         if len(rest) >= 3 and rest[0] == "namespaces":
             ns, resource = rest[1], rest[2]
@@ -1507,7 +1709,10 @@ class ControllerServer:
                     if pns == ns
                 ]
                 # resourceVersion enables list-then-watch (informers).
-                return 200, {"items": items, "resourceVersion": self._watch_rv}
+                return 200, {
+                    "items": items,
+                    "resourceVersion": self._watch_delivery_rv(),
+                }
             if resource == "jobs":
                 self._activate_watch_kind("jobs")
                 items = [
@@ -1515,7 +1720,10 @@ class ControllerServer:
                     for (jns, _), j in sorted(self.cluster.jobs.items())
                     if jns == ns
                 ]
-                return 200, {"items": items, "resourceVersion": self._watch_rv}
+                return 200, {
+                    "items": items,
+                    "resourceVersion": self._watch_delivery_rv(),
+                }
             if resource == "services":
                 self._activate_watch_kind("services")
                 items = [
@@ -1523,7 +1731,10 @@ class ControllerServer:
                     for (sns, _), s in sorted(self.cluster.services.items())
                     if sns == ns
                 ]
-                return 200, {"items": items, "resourceVersion": self._watch_rv}
+                return 200, {
+                    "items": items,
+                    "resourceVersion": self._watch_delivery_rv(),
+                }
         return 404, {"error": "unknown core resource"}
 
     def _route_nodes(self, method: str, rest: list[str], body: bytes):
@@ -1666,6 +1877,15 @@ class ControllerServer:
             lag = coordinator.follower_lag()
             behind = {p: n for p, n in lag.items() if n > 0}
             healthy = not (coordinator.lost_quorum or coordinator.fenced)
+            # Per-peer last-contact ages + partition suspicion: a cut
+            # link shows up here (partitionSuspected=true on that peer)
+            # BEFORE quorum loss or failover fires, so operators can
+            # triage "suspected network partition" from one surface
+            # (docs/troubleshooting.md).
+            contact = coordinator.contact_report()
+            suspected = sorted(
+                p for p, c in contact.items() if c["partitionSuspected"]
+            )
             components["replication"] = {
                 "healthy": healthy,
                 "enabled": True,
@@ -1676,11 +1896,15 @@ class ControllerServer:
                 "quorum": coordinator.majority,
                 "replicas": coordinator.cluster_size,
                 "followerLag": lag,
+                "peerContact": contact,
+                "partitionSuspected": suspected,
                 "message": (
                     ("FENCED by a higher term; stepping down"
                      if coordinator.fenced else
                      "quorum LOST: writes are not being acknowledged as "
                      "committed" if coordinator.lost_quorum else
+                     f"partition suspected on link(s) to "
+                     f"{', '.join(suspected)}" if suspected else
                      f"{len(behind)} follower(s) behind" if behind else
                      "all followers caught up")
                 ),

@@ -1,0 +1,33 @@
+"""Jepsen-style consistency verification for the replicated control plane.
+
+`history` records the client-visible side of a run — every invoke/response
+pair of create/update/read operations, stamped with the serving replica's
+term and identity (the `X-Jobset-Term` / `X-Jobset-Replica` headers a
+replicated server emits) — on a logical clock, never the wall clock, so
+two seeded runs record byte-identical histories. `checker` proves four
+invariants over any recorded history (docs/ha.md "Consistency
+guarantees"):
+
+1. **Durability** — no majority-acknowledged write is ever lost: every
+   clean-acked (2xx, no Warning) write's object is present in the final
+   state, and the register's final value is never older than the newest
+   acknowledged write.
+2. **Leader uniqueness** — at most one unfenced leader serves writes per
+   term.
+3. **Session monotonicity** — within one client session, observed
+   resourceVersions never go backwards (a replica cannot serve a read
+   older than what the session already saw).
+4. **Linearizability** — operations on the single-object register admit
+   a legal linearization (a small-window Wing–Gong search; writes that
+   answered with a quorum Warning are *indeterminate* — they may take
+   effect or be lost, never both).
+
+The partition scenarios (`chaos/scenarios.py`) run the checker as their
+acceptance gate; a deliberately fence-disabled run FAILS it, which is the
+proof the checker has teeth.
+"""
+
+from .checker import CheckReport, check_history
+from .history import HistoryRecorder
+
+__all__ = ["CheckReport", "HistoryRecorder", "check_history"]

@@ -1,0 +1,323 @@
+"""The consistency checker: four invariants over a recorded history.
+
+``check_history`` is pure — it consumes a `HistoryRecorder` snapshot (or
+any list of op dicts of that shape) plus the run's final durable state
+and returns a `CheckReport`; nothing here touches the cluster, the
+network, or the wall clock, so the same history always yields the same
+verdict and the report is safely byte-comparable across seeded runs.
+
+Invariant 4 is a Wing & Gong linearizability search over the single
+register's operations: depth-first over the concurrent frontier (ops
+whose invocation precedes every pending op's response), memoized on
+(pending set, register value). A successful read that observed the
+register ABSENT participates as an observation of the initial value —
+a stale replica serving pre-creation state after an acknowledged create
+is a linearizability violation, not a skippable gap. Writes that answered with a quorum
+Warning — or whose connection died with the outcome unknown — are
+*indeterminate*: the search may apply them or drop them (lost on the
+minority side), never both. Histories here are nearly sequential (the
+scenario drivers are), so the frontier stays tiny; `MAX_WINDOW` guards
+the exponential worst case and reports an over-wide history as
+uncheckable rather than hanging.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+# Concurrent-frontier bound for the linearizability search: scenario
+# drivers are sequential per session, so real frontiers hold a handful of
+# ops; past this the search refuses (reported, not silently skipped).
+MAX_WINDOW = 16
+
+
+@dataclass
+class CheckReport:
+    """Machine-checked verdict over one history."""
+
+    ok: bool = True
+    invariants: dict = field(default_factory=dict)
+    violations: list = field(default_factory=list)
+    stats: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {
+            "ok": self.ok,
+            "invariants": self.invariants,
+            "violations": self.violations,
+            "stats": self.stats,
+        }
+
+    def _fail(self, invariant: str, message: str, **detail) -> None:
+        self.ok = False
+        self.invariants[invariant]["ok"] = False
+        self.violations.append(
+            {"invariant": invariant, "message": message, **detail}
+        )
+
+
+def _completed(op: dict) -> bool:
+    return op.get("response") is not None
+
+
+def _write_applied_maybe(op: dict) -> bool:
+    """Could this write have taken effect without a clean majority ack?
+    True for quorum-Warning 2xx acks (applied on a minority, may be
+    lost) and for unknown outcomes (no response / connection died); an
+    explicit HTTP error status means the server never applied it."""
+    if op.get("acked"):
+        return False  # definite, not maybe
+    if op.get("ok"):
+        return True  # 2xx with Warning: applied somewhere, not durable
+    return op.get("status") is None  # outcome unknown
+
+
+def check_history(
+    ops: list[dict],
+    final_state: Optional[dict] = None,
+    register_key: Optional[str] = None,
+    initial_value: Optional[str] = None,
+) -> CheckReport:
+    """Prove the four consistency invariants over `ops`.
+
+    final_state: {object key: final value-or-None} of the surviving
+    leader's durable state (value matters only for the register).
+    register_key: the single-object register whose ops are linearized.
+    """
+    report = CheckReport()
+    writes = [op for op in ops if op["kind"] == "write"]
+    reads = [op for op in ops if op["kind"] == "read"]
+    acked = [op for op in writes if op.get("acked")]
+    report.stats = {
+        "ops": len(ops),
+        "writes": len(writes),
+        "reads": len(reads),
+        "acked_writes": len(acked),
+        "indeterminate_writes": sum(
+            1 for op in writes if _write_applied_maybe(op)
+        ),
+        "failed_ops": sum(
+            1 for op in ops if _completed(op) and not op.get("ok")
+        ),
+    }
+
+    _check_durability(report, acked, final_state, register_key,
+                      initial_value, writes)
+    _check_leader_per_term(report, writes)
+    _check_session_monotonic(report, ops)
+    _check_linearizable(report, ops, register_key, initial_value)
+    return report
+
+
+# -- invariant 1: no majority-acked write is ever lost -----------------------
+
+
+def _check_durability(report, acked, final_state, register_key,
+                      initial_value, writes) -> None:
+    report.invariants["durability"] = {"ok": True, "checked": len(acked)}
+    if final_state is None:
+        report.invariants["durability"]["checked"] = 0
+        return
+    for op in acked:
+        if op["key"] not in final_state:
+            report._fail(
+                "durability",
+                f"majority-acked write of {op['key']} (op {op['id']}) "
+                f"is absent from the final state — an acknowledged "
+                f"write was LOST",
+                op=op["id"], key=op["key"],
+            )
+    if register_key is None or register_key not in final_state:
+        return
+    final_value = final_state[register_key]
+    acked_reg = [op for op in acked if op["key"] == register_key]
+    if not acked_reg:
+        return
+    # Register staleness: the final value must be AT LEAST as new as the
+    # newest acknowledged write (a later indeterminate write landing is
+    # fine — that overwrote, it did not lose).
+    order = {op["value"]: op["id"] for op in writes
+             if op["key"] == register_key and op["value"] is not None}
+    newest_acked = max(acked_reg, key=lambda op: op["id"])
+    if final_value == initial_value and final_value not in order:
+        report._fail(
+            "durability",
+            f"register {register_key} ended at its initial value but "
+            f"write op {newest_acked['id']} "
+            f"(value {newest_acked['value']!r}) was majority-acked",
+            op=newest_acked["id"], key=register_key,
+        )
+        return
+    final_writer = order.get(final_value)
+    if final_writer is None:
+        report._fail(
+            "durability",
+            f"register {register_key} ended at {final_value!r}, a value "
+            f"no recorded write produced",
+            key=register_key,
+        )
+    elif final_writer < newest_acked["id"]:
+        report._fail(
+            "durability",
+            f"register {register_key} ended at {final_value!r} (op "
+            f"{final_writer}) — OLDER than majority-acked op "
+            f"{newest_acked['id']} (value {newest_acked['value']!r}): an "
+            f"acknowledged write was rolled back",
+            op=newest_acked["id"], key=register_key,
+        )
+
+
+# -- invariant 2: at most one unfenced leader serves writes per term ---------
+
+
+def _check_leader_per_term(report, writes) -> None:
+    served: dict[int, set] = {}
+    for op in writes:
+        if op.get("ok") and op.get("term") is not None and op.get("replica"):
+            served.setdefault(op["term"], set()).add(op["replica"])
+    report.invariants["leader_per_term"] = {
+        "ok": True, "terms": len(served),
+    }
+    for term, replicas in sorted(served.items()):
+        if len(replicas) > 1:
+            report._fail(
+                "leader_per_term",
+                f"term {term} saw writes served by "
+                f"{sorted(replicas)} — more than one unfenced leader "
+                f"accepted writes in one epoch",
+                term=term, replicas=sorted(replicas),
+            )
+
+
+# -- invariant 3: per-session reads are monotonic in resourceVersion --------
+
+
+def _check_session_monotonic(report, ops) -> None:
+    checked = 0
+    floors: dict[str, tuple[int, int]] = {}  # session -> (rv floor, op id)
+    for op in sorted(
+        (o for o in ops if _completed(o) and o.get("ok")
+         and o.get("rv") is not None),
+        key=lambda o: o["response"],
+    ):
+        checked += 1
+        session = op["session"]
+        floor = floors.get(session)
+        if floor is not None and op["rv"] < floor[0]:
+            report.invariants.setdefault(
+                "session_monotonic", {"ok": True, "checked": 0}
+            )
+            report._fail(
+                "session_monotonic",
+                f"session {session} observed resourceVersion "
+                f"{op['rv']} (op {op['id']}) after already seeing "
+                f"{floor[0]} (op {floor[1]}) — a stale replica served "
+                f"state the session had outrun",
+                op=op["id"], session=session,
+            )
+        if floor is None or op["rv"] > floor[0]:
+            floors[session] = (op["rv"], op["id"])
+    inv = report.invariants.setdefault("session_monotonic", {"ok": True})
+    inv["checked"] = checked
+
+
+# -- invariant 4: the single-object register linearizes ----------------------
+
+
+def _check_linearizable(report, ops, register_key, initial_value) -> None:
+    inv = {"ok": True, "checked": 0}
+    report.invariants["linearizable"] = inv
+    if register_key is None:
+        return
+    entries = []
+    for op in ops:
+        if op["key"] != register_key:
+            continue
+        if op["kind"] == "write":
+            if _completed(op) and not op.get("ok") and \
+                    op.get("status") is not None:
+                continue  # cleanly rejected: never applied
+            entries.append({
+                "id": op["id"], "kind": "write", "value": op["value"],
+                "inv": op["invoke"],
+                "res": op["response"] if _completed(op) else None,
+                "maybe": _write_applied_maybe(op),
+            })
+        elif op.get("ok"):
+            # value None = the read observed the register ABSENT — a
+            # real observation (it must linearize before every applied
+            # create), not a gap in the history: a stale replica serving
+            # pre-creation state after an acked write must fail here.
+            entries.append({
+                "id": op["id"], "kind": "read", "value": op["value"],
+                "inv": op["invoke"], "res": op["response"],
+                "maybe": False,
+            })
+    inv["checked"] = len(entries)
+    if not entries:
+        return
+    verdict = _wing_gong(entries, initial_value)
+    if verdict == "window":
+        report._fail(
+            "linearizable",
+            f"register {register_key}: concurrent window exceeded "
+            f"{MAX_WINDOW} ops — history too wide to check",
+            key=register_key,
+        )
+    elif not verdict:
+        report._fail(
+            "linearizable",
+            f"register {register_key}: no legal linearization exists "
+            f"over its {len(entries)} operations — a read observed a "
+            f"value no consistent order of the writes can explain",
+            key=register_key,
+            ops=[e["id"] for e in entries],
+        )
+
+
+def _wing_gong(entries, initial_value):
+    """Wing & Gong search; True / False / "window" (frontier too wide).
+
+    An op joins the frontier once its invocation precedes every pending
+    op's response; a pending set without reads is always completable
+    (writes order by invocation), and an indeterminate write may be
+    dropped (lost) instead of applied."""
+    inf = float("inf")
+    res = [inf if e["res"] is None else e["res"] for e in entries]
+    frontier_overflow = [False]
+    seen: set = set()
+
+    def solve(pending: frozenset, value) -> bool:
+        if not any(entries[i]["kind"] == "read" for i in pending):
+            return True
+        key = (pending, value)
+        if key in seen:
+            return False
+        seen.add(key)
+        min_res = min(res[i] for i in pending)
+        frontier = [i for i in sorted(pending)
+                    if entries[i]["inv"] <= min_res]
+        if len(frontier) > MAX_WINDOW:
+            frontier_overflow[0] = True
+            return False
+        for i in frontier:
+            e = entries[i]
+            rest = pending - {i}
+            if e["kind"] == "read":
+                if e["value"] == value and solve(rest, value):
+                    return True
+            else:
+                if solve(rest, e["value"]):
+                    return True
+                if e["maybe"] and solve(rest, value):
+                    return True  # dropped: lost on the minority side
+        return False
+
+    ok = solve(frozenset(range(len(entries))), initial_value)
+    if frontier_overflow[0] and not ok:
+        return "window"
+    return ok
+
+
+__all__ = ["CheckReport", "MAX_WINDOW", "check_history"]

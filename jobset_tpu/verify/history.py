@@ -1,0 +1,118 @@
+"""Bounded, wall-clock-free history recorder for the consistency checker.
+
+One `HistoryRecorder` captures the client-visible half of a run: each
+operation is an *invoke* (session, kind, key, intended value) followed by
+a *response* (ok, observed value, resourceVersion, serving term/replica,
+whether the write was majority-acknowledged). Time is a logical counter
+bumped once per invoke and once per response — the recorded order IS the
+real-time order the checker's linearizability window uses, and because no
+wall clock is read, two seeded runs that perform the same operations
+record byte-identical histories (the scenarios' byte-identity gate
+compares the `normalized()` form, which additionally maps raw fencing
+terms to dense first-appearance indices: term VALUES depend on how many
+failed lease acquisitions a partition produced — timing — while the term
+STRUCTURE, which writes shared an epoch, is deterministic).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+
+class HistoryRecorder:
+    """Append-only operation history on a logical clock.
+
+    ``invoke`` returns an op id; ``complete`` closes it. An op left
+    incomplete (driver crashed mid-call, connection died with the outcome
+    unknown) keeps ``response: None`` — the checker treats such writes as
+    indeterminate, exactly like a quorum-Warning ack.
+    """
+
+    MAX_OPS = 100_000  # bounded, but big enough for any scenario storm
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.ops: list[dict] = []
+        self._time = 0
+        self._by_id: dict[int, dict] = {}
+
+    def invoke(self, session: str, kind: str, key: str,
+               value: Optional[str] = None) -> int:
+        """Start one operation (`kind` is "write" or "read"); returns the
+        op id for `complete`. `value` is a write's intended value."""
+        with self._lock:
+            self._time += 1
+            op_id = len(self.ops)
+            op = {
+                "id": op_id,
+                "session": session,
+                "kind": kind,
+                "key": key,
+                "value": value,
+                "invoke": self._time,
+                "response": None,
+                "ok": None,
+                "status": None,
+                "rv": None,
+                "term": None,
+                "replica": None,
+                "acked": False,
+            }
+            if len(self.ops) < self.MAX_OPS:
+                self.ops.append(op)
+                self._by_id[op_id] = op
+            return op_id
+
+    def complete(
+        self,
+        op_id: int,
+        ok: bool,
+        status: Optional[int] = None,
+        value: Optional[str] = None,
+        rv: Optional[int] = None,
+        term: Optional[int] = None,
+        replica: Optional[str] = None,
+        acked: bool = False,
+    ) -> None:
+        """Close an operation: `ok` = the server answered 2xx; `acked` =
+        a write's clean majority acknowledgement (2xx AND no Warning
+        header — the durable contract); `value` is a read's observed
+        value; `term`/`replica` come from the response's replication
+        identity headers."""
+        with self._lock:
+            op = self._by_id.get(op_id)
+            if op is None:
+                return
+            self._time += 1
+            op["response"] = self._time
+            op["ok"] = bool(ok)
+            op["status"] = status
+            if value is not None:
+                op["value"] = value
+            op["rv"] = rv
+            op["term"] = term
+            op["replica"] = replica
+            op["acked"] = bool(acked)
+
+    # -- views --------------------------------------------------------------
+
+    def snapshot(self) -> list[dict]:
+        with self._lock:
+            return [dict(op) for op in self.ops]
+
+    def normalized(self) -> list[dict]:
+        """Snapshot with raw fencing terms mapped to dense indices in
+        first-appearance order — the byte-identity form (see module
+        docstring for why raw term values are timing-dependent)."""
+        dense: dict[int, int] = {}
+        out = []
+        for op in self.snapshot():
+            term = op["term"]
+            if term is not None:
+                op["term"] = dense.setdefault(term, len(dense))
+            out.append(op)
+        return out
+
+
+__all__ = ["HistoryRecorder"]

@@ -1,0 +1,18 @@
+"""DRF003 fixture for the network fault model's call shapes
+(chaos/net.py): the point travels through a module-level constant into
+``consult`` — the consulted-direction scan only sees literal first args,
+so the documented row is kept alive by the constant's literal mention
+(the stale-direction scan); a literal ``consult`` call with no table row
+still fires."""
+
+from .injector import consult
+
+_POINT = "fixture.net_documented"
+
+
+def check_link(src, dst):
+    if consult(_POINT):
+        return f"{src}->{dst} is cut"
+    if consult("fixture.net_undocumented"):  # line 16: no table row
+        return f"{src}->{dst} dropped"
+    return None

@@ -232,6 +232,11 @@ def test_drift_fires_in_both_directions():
     drf3 = [f.message for f in visible(report, "DRF003")]
     assert any("fixture.undocumented" in m for m in drf3), messages
     assert any("fixture.stale" in m for m in drf3), messages
+    # The chaos/net.py call shapes: a literal consult() with no table
+    # row fires; a point passed through a module-level constant keeps
+    # its documented row green via the constant's literal mention.
+    assert any("fixture.net_undocumented" in m for m in drf3), messages
+    assert not any("fixture.net_documented" in m for m in drf3), messages
     drf4 = [f.message for f in visible(report, "DRF004")]
     assert any("/fixture/unclassified" in m for m in drf4), messages
     assert any("/fixture/stale" in m for m in drf4), messages

@@ -1,0 +1,773 @@
+"""Partition-tolerance plane (chaos/net.py + jobset_tpu/verify, docs/ha.md
+§ "Consistency guarantees").
+
+The contracts proven here are the tentpole's acceptance criteria:
+
+* the network fault model: a seeded `PartitionPlan` of DIRECTED link
+  cuts/heals, enforced at both transports (LocalPeer/HttpPeer peer RPCs
+  and client round trips) — a cut link refuses instead of delivering;
+  cut AND heal transitions are first-class injection-log entries and
+  consume no RNG draw, so seeded byte-identity covers recovery timing;
+* the quorum read fence (ReadIndex analog): a replica that cannot prove
+  majority-contact freshness answers reads 503 + leader hint — closing
+  the quorum-partitioned-leader stale-read hole;
+* the Jepsen-style consistency checker: four invariants (durability of
+  majority-acked writes, one unfenced leader per term, per-session rv
+  monotonicity, register linearizability) proven over recorded
+  histories — and shown to FAIL a deliberately fence-disabled run;
+* the four seeded partition scenarios pass the checker and replay
+  byte-identically;
+* an informer across a partition heal never caches minority-side state:
+  its cached rv 410-relists into the quorum's state.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from jobset_tpu.chaos import net as chaos_net
+from jobset_tpu.chaos.injector import KIND_REFUSE, FaultInjector
+from jobset_tpu.chaos.net import KIND_CUT, KIND_HEAL, PartitionPlan
+from jobset_tpu.chaos.scenarios import (
+    PartitionHarness,
+    asymmetric_link,
+    leader_isolated,
+    partition_flap,
+    split_3way,
+)
+from jobset_tpu.core import make_cluster, metrics
+from jobset_tpu.ha import (
+    FollowerLog,
+    HttpPeer,
+    LocalPeer,
+    ReplicationCoordinator,
+)
+from jobset_tpu.verify import HistoryRecorder, check_history
+
+pytestmark = [pytest.mark.ha, pytest.mark.partition]
+
+
+# ---------------------------------------------------------------------------
+# The fault model: PartitionPlan
+# ---------------------------------------------------------------------------
+
+
+def test_plan_applies_scheduled_cuts_and_heals_in_step_order():
+    plan = PartitionPlan(seed=1)
+    plan.cut("a", "b", at=2, heal_at=4)
+    assert not plan.is_cut("a", "b")
+    assert plan.advance(1) == []
+    applied = plan.advance(2)
+    assert applied == [{"step": 2, "kind": KIND_CUT, "src": "a", "dst": "b"}]
+    assert plan.is_cut("a", "b")
+    assert not plan.is_cut("b", "a")  # directed: reverse stays up
+    applied = plan.advance(4)
+    assert applied == [{"step": 4, "kind": KIND_HEAL, "src": "a", "dst": "b"}]
+    assert not plan.is_cut("a", "b")
+
+
+def test_plan_symmetric_cut_and_heal_all():
+    plan = PartitionPlan(seed=1)
+    plan.cut("a", "b", at=1, symmetric=True)
+    plan.cut("a", "c", at=1)
+    plan.advance(1)
+    assert plan.cut_links() == [("a", "b"), ("a", "c"), ("b", "a")]
+    healed = plan.heal_all()
+    assert {(t["src"], t["dst"]) for t in healed} == {
+        ("a", "b"), ("a", "c"), ("b", "a")
+    }
+    assert plan.cut_links() == []
+
+
+def test_plan_transitions_are_first_class_injection_log_entries():
+    """Cut AND heal land in the injector log with the normal sequence
+    numbering — heals are not an implicit side effect (satellite: seeded
+    byte-identity must cover recovery timing)."""
+    injector = FaultInjector(seed=3)
+    plan = PartitionPlan(seed=3, injector=injector)
+    plan.cut("a", "b", at=1, heal_at=2)
+    plan.advance(2)
+    log = injector.log_snapshot()
+    assert [(e["point"], e["kind"]) for e in log] == [
+        ("net.partition", KIND_CUT), ("net.partition", KIND_HEAL),
+    ]
+    assert [e["seq"] for e in log] == [1, 2]
+    assert "a->b" in log[0]["detail"] and "a->b" in log[1]["detail"]
+    assert injector.injected_total("net.partition") == 2
+
+
+def test_record_consumes_no_rng_draw():
+    """Scheduled transitions must not perturb the point's decision
+    stream: a run with interleaved record() calls sees the exact same
+    rule-fire sequence as one without."""
+    outcomes = []
+    for with_records in (False, True):
+        injector = FaultInjector(seed=7)
+        injector.add_rule("net.partition", KIND_REFUSE, rate=0.5)
+        seq = []
+        for i in range(40):
+            if with_records and i % 5 == 0:
+                injector.record("net.partition", KIND_CUT, "x->y")
+                injector.record("net.partition", KIND_HEAL, "x->y")
+            fault = injector.check("net.partition", "x->y")
+            seq.append(None if fault is None else fault.kind)
+        outcomes.append(seq)
+    assert outcomes[0] == outcomes[1]
+
+
+def test_flap_schedule_is_seed_deterministic_and_ends_healed():
+    def run(seed):
+        injector = FaultInjector(seed=seed)
+        plan = PartitionPlan(seed=seed, injector=injector)
+        plan.flap("a", "b", at=1, until=20, period=2, symmetric=True)
+        transitions = []
+        for step in range(1, 21):
+            transitions.extend(
+                (t["step"], t["kind"], t["src"], t["dst"])
+                for t in plan.advance(step)
+            )
+        return transitions, plan.cut_links(), injector.log_snapshot()
+
+    first = run(19)
+    again = run(19)
+    assert first == again
+    transitions, cut, log = first
+    assert cut == []  # always ends with a heal at `until`
+    kinds = {t[1] for t in transitions}
+    assert kinds == {KIND_CUT, KIND_HEAL}
+    # A different seed jitters the intervals differently.
+    other, _, _ = run(20)
+    assert other != transitions
+
+
+def test_check_link_plan_cut_rate_rule_and_guard():
+    injector = FaultInjector(seed=5)
+    plan = PartitionPlan(seed=5, injector=injector)
+    assert chaos_net.check_link("a", "b", injector=injector) is None
+    plan.apply_cut("a", "b")
+    blocked_before = metrics.chaos_partition_blocked_total.value("a->b")
+    reason = chaos_net.check_link("a", "b", injector=injector)
+    assert reason is not None and "cut" in reason
+    assert chaos_net.check_link("b", "a", injector=injector) is None
+    assert plan.blocked[("a", "b")] == 1
+    assert metrics.chaos_partition_blocked_total.value("a->b") == \
+        blocked_before + 1
+    with pytest.raises(ConnectionError):
+        chaos_net.guard("a", "b", injector=injector)
+    plan.apply_heal("a", "b")
+    assert chaos_net.check_link("a", "b", injector=injector) is None
+    # Rate-based net.partition rules ride the same check (CLI spec).
+    ruled = FaultInjector.from_spec("net.partition:refuse@1.0", seed=5)
+    reason = chaos_net.check_link("x", "y", injector=ruled)
+    assert reason is not None and "refuse" in reason
+
+
+def test_local_peer_enforces_directed_links(tmp_path):
+    injector = FaultInjector(seed=9)
+    plan = PartitionPlan(seed=9, injector=injector)
+    log = FollowerLog(str(tmp_path / "f"))
+    peer = LocalPeer("replica-1", log, src="replica-0", injector=injector)
+    try:
+        assert peer.last_contact is None
+        assert peer.position()["lastSeq"] == 0
+        assert peer.last_contact is not None
+        plan.apply_cut("replica-0", "replica-1")
+        with pytest.raises(ConnectionError):
+            peer.position()
+        plan.apply_heal("replica-0", "replica-1")
+        peer.position()
+    finally:
+        log.close()
+
+
+# ---------------------------------------------------------------------------
+# HttpPeer: cut links open the down-window; a successful probe resets it
+# ---------------------------------------------------------------------------
+
+
+def _standby(tmp_path, tag="standby"):
+    from jobset_tpu.server import ControllerServer
+
+    follower_log = FollowerLog(str(tmp_path / tag))
+    server = ControllerServer(
+        cluster=make_cluster(), tick_interval=3600,
+        standby_accepts_writes=False, replication=follower_log,
+    ).start()
+    return server, follower_log
+
+
+def test_http_peer_probe_resets_down_backoff_immediately(tmp_path):
+    """Satellite: a healed peer must rejoin the quorum on the very next
+    position probe instead of serving out its down_backoff_s penalty."""
+    server, follower_log = _standby(tmp_path)
+    injector = FaultInjector(seed=11)
+    plan = PartitionPlan(seed=11, injector=injector)
+    peer = HttpPeer(server.address, timeout=5.0, down_backoff_s=60.0,
+                    src="lead", injector=injector)
+    try:
+        assert peer.position()["lastSeq"] == 0
+        plan.apply_cut("lead", server.address)
+        with pytest.raises(ConnectionError):
+            peer.append_entries(1, [])
+        # The cut opened the down-window: even after the heal, non-probe
+        # calls fail fast without dialing...
+        plan.apply_heal("lead", server.address)
+        with pytest.raises(ConnectionError, match="down-backoff"):
+            peer.append_entries(1, [])
+        # ...but the probe path bypasses the window, and its success
+        # clears the penalty on the spot.
+        assert peer.position()["lastSeq"] == 0
+        assert peer._down_until == 0.0
+        result = peer.append_entries(1, [])
+        assert result.get("ok", True)
+        assert peer.last_contact is not None
+    finally:
+        server.stop()
+        follower_log.close()
+
+
+# ---------------------------------------------------------------------------
+# Quorum freshness: confirm_quorum and the contact report
+# ---------------------------------------------------------------------------
+
+
+class _FakePeer:
+    def __init__(self, peer_id, term=1, fail=False):
+        self.id = peer_id
+        self.term = term
+        self.fail = fail
+        self.last_contact = None
+        self.probes = 0
+
+    def position(self, timeout=None):
+        self.probes += 1
+        if self.fail:
+            raise ConnectionError("unreachable")
+        self.last_contact = time.monotonic()
+        return {"term": self.term, "lastSeq": 0, "commitSeq": 0}
+
+
+def test_confirm_quorum_counts_fresh_probes_stale_and_fences_on_term():
+    a, b = _FakePeer("a"), _FakePeer("b")
+    coordinator = ReplicationCoordinator("lead", [a, b], term=1)
+    # Nobody contacted yet: both get probed, quorum confirmed.
+    assert coordinator.confirm_quorum()
+    assert a.probes + b.probes >= 1
+    # Fresh contacts short-circuit: no new probes.
+    probes = a.probes + b.probes
+    assert coordinator.confirm_quorum()
+    assert a.probes + b.probes == probes
+    # All peers dark: the leader cannot prove a majority.
+    dark = ReplicationCoordinator(
+        "lead", [_FakePeer("a", fail=True), _FakePeer("b", fail=True)],
+        term=1,
+    )
+    assert not dark.confirm_quorum()
+    # A probe revealing a higher term fences on the spot.
+    bumped = ReplicationCoordinator(
+        "lead", [_FakePeer("a", term=9), _FakePeer("b", fail=True)], term=1,
+    )
+    assert not bumped.confirm_quorum()
+    assert bumped.fenced
+    # Fenced / lost_quorum short-circuit without probing.
+    assert not coordinator.confirm_quorum.__self__ is None
+    coordinator.lost_quorum = True
+    assert not coordinator.confirm_quorum()
+
+
+def test_contact_report_flags_silent_links():
+    a, b = _FakePeer("a"), _FakePeer("b")
+    coordinator = ReplicationCoordinator("lead", [a, b], term=1)
+    coordinator.suspect_after_s = 0.05
+    report = coordinator.contact_report()
+    assert report["a"] == {
+        "lastContactAgeSeconds": None, "partitionSuspected": True,
+    }
+    a.position()
+    report = coordinator.contact_report()
+    assert report["a"]["partitionSuspected"] is False
+    assert report["a"]["lastContactAgeSeconds"] >= 0.0
+    time.sleep(0.08)
+    assert coordinator.contact_report()["a"]["partitionSuspected"] is True
+
+
+# ---------------------------------------------------------------------------
+# The read fence over HTTP
+# ---------------------------------------------------------------------------
+
+_JOBSETS = "/apis/jobset.x-k8s.io/v1alpha2/namespaces/default/jobsets"
+
+
+def test_replicated_follower_fences_reads_with_leader_hint(tmp_path):
+    """A replicated follower's private cluster is empty — it must never
+    answer API reads; 503 + leader hint + Retry-After, like standby
+    writes. Observability surfaces stay open."""
+    from jobset_tpu.core.lease import FileLease, LeaderElector
+    from jobset_tpu.server import ControllerServer
+    from jobset_tpu.utils.clock import FakeClock
+
+    clock = FakeClock()
+    lease = str(tmp_path / "leader.lease")
+    LeaderElector(
+        FileLease(lease), "lead", clock=clock, advertise="127.0.0.1:9999"
+    ).ensure()
+    standby_elect = LeaderElector(FileLease(lease), "stand", clock=clock)
+    follower_log = FollowerLog(str(tmp_path / "standby"))
+    server = ControllerServer(
+        cluster=make_cluster(), tick_interval=3600, elector=standby_elect,
+        standby_accepts_writes=False, replication=follower_log,
+    ).start()
+    try:
+        rejections = metrics.ha_read_fence_rejections_total.value()
+        try:
+            urllib.request.urlopen(
+                f"http://{server.address}{_JOBSETS}", timeout=10
+            )
+            raise AssertionError("fenced follower served a read")
+        except urllib.error.HTTPError as exc:
+            assert exc.code == 503
+            assert exc.headers.get("Retry-After") == "1"
+            body = json.loads(exc.read())
+            assert "fenced" in body["error"]
+            assert body["leader"] == "lead"
+            assert body["leaderAddress"] == "127.0.0.1:9999"
+        assert metrics.ha_read_fence_rejections_total.value() == \
+            rejections + 1
+        # Health stays open on a fenced replica — that is how operators
+        # see the partition.
+        with urllib.request.urlopen(
+            f"http://{server.address}/debug/health", timeout=10
+        ) as resp:
+            assert resp.status == 200
+    finally:
+        server.stop()
+        follower_log.close()
+
+
+def test_minority_leader_fences_reads_majority_leader_serves(tmp_path):
+    """The stale-read hole, closed: a quorum-partitioned leader answers
+    GETs 503 instead of its stale cluster; with read_fence=False the
+    same zombie read is served — which is what the checker's teeth test
+    exploits."""
+    harness = PartitionHarness(str(tmp_path), seed=37)
+    try:
+        harness.write("w", "obj-0")
+        old = harness.replica_set.leader()
+        status, rv, _ = harness.read("r")
+        assert status == 200 and rv is not None
+        harness.isolate(old.replica_id, step=1)
+        # A write attempt gives the isolated leader's pump pending
+        # unacked records; its idle re-ships then observe quorum loss.
+        harness.write("w", "obj-warn", retry=False)
+        harness.await_lost_quorum(old)
+        status, _, _ = harness.read("r", server=old.server)
+        assert status == 503
+        # The majority side elects a successor that serves reads again.
+        new = harness.await_leader(other_than=old)
+        status, rv, _ = harness.read("r")
+        assert status == 200 and rv is not None
+        assert new is harness.replica_set.leader()
+    finally:
+        harness.stop()
+
+
+def test_debug_health_reports_peer_contact_and_partition_suspected(tmp_path):
+    """Satellite: /debug/health surfaces per-peer lastContactAgeSeconds
+    and partitionSuspected so a cut link is visible BEFORE failover."""
+    harness = PartitionHarness(str(tmp_path), seed=41)
+    try:
+        harness.write("w", "seed-0")
+        leader = harness.replica_set.leader()
+        victim = next(
+            r for r in harness.replica_set.replicas if r is not leader
+        )
+        leader.coordinator.suspect_after_s = 0.2
+
+        def health():
+            with urllib.request.urlopen(
+                f"http://{harness.replica_set.address}/debug/health",
+                timeout=10,
+            ) as resp:
+                return json.loads(resp.read())["components"]["replication"]
+
+        replication = health()
+        assert set(replication["peerContact"]) == {
+            r.replica_id for r in harness.replica_set.replicas
+            if r is not leader
+        }
+        # One direction only: leader -> victim. Writes keep acking via
+        # the other follower; the silent link is flagged.
+        harness.plan.cut(leader.replica_id, victim.replica_id, at=1)
+        harness.plan.advance(1)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            harness.write("w", f"during-{int(time.monotonic() * 1e6)}")
+            replication = health()
+            if replication["partitionSuspected"] == [victim.replica_id]:
+                break
+            time.sleep(0.05)
+        assert replication["partitionSuspected"] == [victim.replica_id]
+        contact = replication["peerContact"][victim.replica_id]
+        assert contact["partitionSuspected"] is True
+        assert contact["lastContactAgeSeconds"] >= 0.2
+        assert "partition suspected" in replication["message"]
+        assert replication["healthy"] is True  # quorum still holds
+    finally:
+        harness.stop()
+
+
+def test_idle_leader_heartbeat_keeps_contact_fresh(tmp_path):
+    """A quiet, healthy cluster must never read as partitioned: the
+    leader pump's heartbeat probes idle links (the re-ship path alone
+    only contacts peers when behind), so partitionSuspected means a cut
+    link, not an idle one."""
+    harness = PartitionHarness(str(tmp_path), seed=47)
+    try:
+        harness.write("w", "only")
+        leader = harness.replica_set.leader()
+        leader.coordinator.suspect_after_s = 0.3
+        time.sleep(1.2)  # several suspicion windows of pure idleness
+        report = leader.coordinator.contact_report()
+        assert all(
+            not c["partitionSuspected"] for c in report.values()
+        ), report
+    finally:
+        harness.stop()
+
+
+# ---------------------------------------------------------------------------
+# The checker: each invariant has teeth on hand-built histories
+# ---------------------------------------------------------------------------
+
+
+def _op(op_id, session, kind, key, value, invoke, response, *, ok=True,
+        status=200, rv=None, term=None, replica=None, acked=False):
+    return {
+        "id": op_id, "session": session, "kind": kind, "key": key,
+        "value": value, "invoke": invoke, "response": response, "ok": ok,
+        "status": status, "rv": rv, "term": term, "replica": replica,
+        "acked": acked,
+    }
+
+
+def test_checker_passes_clean_history():
+    ops = [
+        _op(0, "w", "write", "k/reg", "1", 1, 2, acked=True, term=1,
+            replica="r0"),
+        _op(1, "r", "read", "k/reg", "1", 3, 4, rv=1, term=1,
+            replica="r0"),
+        _op(2, "w", "write", "k/reg", "2", 5, 6, acked=True, term=1,
+            replica="r0"),
+        _op(3, "r", "read", "k/reg", "2", 7, 8, rv=2, term=1,
+            replica="r0"),
+    ]
+    report = check_history(ops, final_state={"k/reg": "2"},
+                           register_key="k/reg")
+    assert report.ok, report.violations
+    assert all(inv["ok"] for inv in report.invariants.values())
+    assert report.stats["acked_writes"] == 2
+
+
+def test_checker_durability_catches_lost_acked_write():
+    ops = [_op(0, "w", "write", "k/a", None, 1, 2, acked=True)]
+    report = check_history(ops, final_state={})
+    assert not report.ok
+    assert [v["invariant"] for v in report.violations] == ["durability"]
+    assert "LOST" in report.violations[0]["message"]
+
+
+def test_checker_durability_catches_register_rollback():
+    ops = [
+        _op(0, "w", "write", "k/reg", "1", 1, 2, acked=True),
+        _op(1, "w", "write", "k/reg", "2", 3, 4, acked=True),
+    ]
+    report = check_history(ops, final_state={"k/reg": "1"},
+                           register_key="k/reg")
+    assert not report.ok
+    assert any(v["invariant"] == "durability" and "rolled back"
+               in v["message"] for v in report.violations)
+
+
+def test_checker_catches_two_leaders_in_one_term():
+    ops = [
+        _op(0, "w", "write", "k/a", None, 1, 2, term=3, replica="r0",
+            acked=True),
+        _op(1, "w", "write", "k/b", None, 3, 4, term=3, replica="r1",
+            acked=True),
+    ]
+    report = check_history(
+        ops, final_state={"k/a": None, "k/b": None}
+    )
+    assert not report.ok
+    assert [v["invariant"] for v in report.violations] == [
+        "leader_per_term"
+    ]
+
+
+def test_checker_catches_session_rv_regression():
+    ops = [
+        _op(0, "s1", "read", "k/reg", None, 1, 2, rv=5),
+        _op(1, "s1", "read", "k/reg", None, 3, 4, rv=3),
+        _op(2, "s2", "read", "k/reg", None, 5, 6, rv=1),  # other session
+    ]
+    report = check_history(ops, final_state={})
+    assert not report.ok
+    violations = [v for v in report.violations
+                  if v["invariant"] == "session_monotonic"]
+    assert len(violations) == 1 and violations[0]["session"] == "s1"
+
+
+def test_checker_catches_non_linearizable_read():
+    """An acked write completed before the read was invoked: the read
+    cannot legally observe the initial value."""
+    ops = [
+        _op(0, "w", "write", "k/reg", "1", 1, 2, acked=True),
+        _op(1, "r", "read", "k/reg", "0", 3, 4, rv=1),
+    ]
+    report = check_history(ops, final_state={"k/reg": "1"},
+                           register_key="k/reg", initial_value="0")
+    assert not report.ok
+    assert any(v["invariant"] == "linearizable"
+               for v in report.violations)
+
+
+def test_checker_catches_stale_absent_read():
+    """A read observing the register ABSENT after its create was
+    majority-acked (a stale replica serving pre-creation state) is a
+    linearizability violation, not a skippable gap."""
+    ops = [
+        _op(0, "w", "write", "k/reg", "1", 1, 2, acked=True),
+        _op(1, "r", "read", "k/reg", None, 3, 4, rv=1),
+    ]
+    report = check_history(ops, final_state={"k/reg": "1"},
+                           register_key="k/reg")
+    assert not report.ok
+    assert any(v["invariant"] == "linearizable"
+               for v in report.violations)
+    # The same absent read BEFORE the create completes is legal.
+    ops = [
+        _op(0, "r", "read", "k/reg", None, 1, 2, rv=0),
+        _op(1, "w", "write", "k/reg", "1", 3, 4, acked=True),
+    ]
+    report = check_history(ops, final_state={"k/reg": "1"},
+                           register_key="k/reg")
+    assert report.ok, report.violations
+
+
+def test_checker_indeterminate_write_may_be_lost_or_applied():
+    """A Warning-acked write is indeterminate: a read observing the old
+    value (it was lost) AND a later history observing the new value (it
+    landed) are both legal — but not both in one history."""
+    base = [
+        _op(0, "w", "write", "k/reg", "1", 1, 2, acked=True),
+        _op(1, "w", "write", "k/reg", "2", 3, 4, acked=False),  # Warning
+    ]
+    lost = base + [_op(2, "r", "read", "k/reg", "1", 5, 6, rv=2)]
+    report = check_history(lost, final_state={"k/reg": "1"},
+                           register_key="k/reg")
+    assert report.ok, report.violations
+    landed = base + [_op(2, "r", "read", "k/reg", "2", 5, 6, rv=2)]
+    report = check_history(landed, final_state={"k/reg": "2"},
+                           register_key="k/reg")
+    assert report.ok, report.violations
+    flip_flop = base + [
+        _op(2, "r", "read", "k/reg", "2", 5, 6, rv=2),
+        _op(3, "r", "read", "k/reg", "1", 7, 8, rv=2),
+    ]
+    report = check_history(flip_flop, final_state={"k/reg": "1"},
+                           register_key="k/reg")
+    assert not report.ok
+
+
+def test_history_recorder_logical_clock_and_normalized_terms():
+    recorder = HistoryRecorder()
+    first = recorder.invoke("s", "write", "k/a", value="1")
+    second = recorder.invoke("s", "read", "k/a")
+    recorder.complete(second, True, status=200, value="1", rv=4, term=7)
+    recorder.complete(first, True, status=201, term=7, acked=True)
+    ops = recorder.snapshot()
+    assert [op["invoke"] for op in ops] == [1, 2]
+    assert ops[1]["response"] == 3 and ops[0]["response"] == 4
+    # normalized(): raw (timing-dependent) terms -> dense indices.
+    assert {op["term"] for op in recorder.normalized()} == {0}
+    # An op never completed stays response=None (indeterminate).
+    open_op = recorder.invoke("s", "write", "k/b")
+    assert recorder.snapshot()[2]["response"] is None
+    del open_op
+
+
+# ---------------------------------------------------------------------------
+# The four seeded scenarios: checker-gated acceptance + teeth + identity
+# ---------------------------------------------------------------------------
+
+
+def _assert_accepted(result):
+    assert result["checker"]["ok"], result["checker"]["violations"]
+    stats = result["checker"]["stats"]
+    assert stats["acked_writes"] > 0
+    assert result["checker"]["invariants"]["linearizable"]["checked"] > 0
+
+
+def test_scenario_leader_isolated_passes_checker(tmp_path):
+    result = leader_isolated(str(tmp_path))
+    _assert_accepted(result)
+    # The isolated leader's Warning write was recorded indeterminate...
+    assert result["checker"]["stats"]["indeterminate_writes"] >= 1
+    # ...and its ghost tail was truncated at rejoin: exact convergence.
+    assert result["converged"], result["follower_position"]
+    assert "default/iso-warn" not in result["final_keys"]
+    # Both the cut and the heal are first-class log entries.
+    kinds = [e["kind"] for e in result["injection_log"]
+             if e["point"] == "net.partition"]
+    assert KIND_CUT in kinds and KIND_HEAL in kinds
+
+
+def test_scenario_leader_isolated_fence_disabled_fails_checker(tmp_path):
+    """THE teeth test: with the read fence off, the deposed leader
+    serves its stale cluster to a session that already saw the new
+    epoch — and the checker catches it on monotonicity AND
+    linearizability."""
+    result = leader_isolated(str(tmp_path), read_fence=False)
+    assert not result["checker"]["ok"]
+    violated = {v["invariant"] for v in result["checker"]["violations"]}
+    assert "session_monotonic" in violated
+    assert "linearizable" in violated
+
+
+def test_scenario_split_3way_unavailable_not_split_brain(tmp_path):
+    result = split_3way(str(tmp_path))
+    _assert_accepted(result)
+    # During the full split nobody served: the dark writes all failed.
+    assert result["checker"]["stats"]["failed_ops"] >= 3
+    # The pre-stepdown Warning write survived re-promotion (prior-term
+    # entry adoption) — durable even though never client-acked.
+    assert result["warn_write_committed"]
+
+
+def test_scenario_partition_flap_availability_holds(tmp_path):
+    result = partition_flap(str(tmp_path))
+    _assert_accepted(result)
+    assert result["flap_transitions"] > 4
+    assert result["clean_first_attempt"] == 10  # quorum held every flap
+    assert result["converged"], result["follower_position"]
+
+
+def test_scenario_asymmetric_link_reverse_pull_converges(tmp_path):
+    result = asymmetric_link(str(tmp_path))
+    _assert_accepted(result)
+    assert result["lag_during_cut"] > 0  # the cut direction starved
+    assert result["reverse_pull"]["peersReached"] >= 2
+    assert result["pulled_to"] > 0  # the healthy direction delivered
+    assert result["converged"], result["follower_position"]
+
+
+def _identity_artifact(result):
+    return json.dumps(
+        {key: result[key] for key in (
+            "injection_log", "history", "checker",
+            "final_keys", "final_seq", "commit_seq",
+        )},
+        sort_keys=True,
+    )
+
+
+def test_seeded_runs_are_byte_identical(tmp_path):
+    """Acceptance: injection + decision logs (and the whole normalized
+    history + verdict) byte-identical across two seeded runs — heals
+    included, which is what FaultInjector.record buys."""
+    first = leader_isolated(str(tmp_path / "a"))
+    second = leader_isolated(str(tmp_path / "b"))
+    assert _identity_artifact(first) == _identity_artifact(second)
+
+
+@pytest.mark.slow
+def test_all_scenarios_byte_identical_across_seeded_runs(tmp_path):
+    for scenario in (split_3way, partition_flap, asymmetric_link):
+        first = scenario(str(tmp_path / f"{scenario.__name__}-a"))
+        second = scenario(str(tmp_path / f"{scenario.__name__}-b"))
+        assert _identity_artifact(first) == _identity_artifact(second), \
+            scenario.__name__
+
+
+# ---------------------------------------------------------------------------
+# Informer across a partition heal (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_informer_across_partition_heal_never_serves_minority_state(
+    tmp_path,
+):
+    """A live informer through a leader isolation: the minority-side
+    Warning write must never reach its cache (the watch delivery floor
+    parks events past the quorum-committed rv — even inside the read
+    fence's freshness window), a cached rv older than the quorum commit
+    410-relists into the quorum's state after failover, and post-heal
+    the informer converges on exactly the majority history."""
+    from jobset_tpu.client import JobSetClient, JobSetInformer, WatchGone
+
+    harness = PartitionHarness(str(tmp_path), seed=43)
+    added = []
+    client = JobSetClient(harness.replica_set.address, timeout=5.0)
+    informer = None
+    try:
+        harness.write("w", "pre-0")
+        status, stale_rv, _ = harness.read("setup")
+        assert status == 200
+        for i in range(1, 3):
+            harness.write("w", f"pre-{i}")
+        informer = JobSetInformer(
+            client, poll_timeout=0.5, on_add=lambda obj: added.append(
+                (obj.get("metadata") or {}).get("name")
+            ),
+        ).start()
+        assert set(informer.cache) == {f"pre-{i}" for i in range(3)}
+
+        old = harness.replica_set.leader()
+        harness.isolate(old.replica_id, step=1)
+        # The minority write: applied on the isolated leader only
+        # (Warning ack). It journals watch events PAST the quorum
+        # commit floor — the woken poll must not be handed them.
+        status = harness.write("w", "minority", retry=False)
+        assert status is not None and 200 <= status < 300
+        harness.await_lost_quorum(old)
+        new = harness.await_leader(other_than=old)
+        assert new is not old
+        # Majority-side progress the informer must converge on.
+        harness.write("w", "post-0")
+        deadline = time.monotonic() + 15
+        while "post-0" not in informer.cache:
+            assert time.monotonic() < deadline, informer.cache.keys()
+            time.sleep(0.05)
+        assert "minority" not in informer.cache
+        assert "minority" not in added
+        # THE satellite contract: a cached rv older than the quorum
+        # commit 410-relists on the recovered leader — and the relist
+        # serves majority state only.
+        with pytest.raises(WatchGone):
+            client.watch_resource(
+                "jobsets", "default", stale_rv, timeout=2.0
+            )
+        items, _ = client.list_resource_with_version("jobsets")
+        names = {(obj.get("metadata") or {}).get("name") for obj in items}
+        assert "minority" not in names and "post-0" in names
+        # Heal; the deposed leader rejoins and truncates its ghost tail —
+        # the minority write must stay gone everywhere.
+        harness.plan.heal_all(step=2)
+        rejoin = harness.reconcile(old)
+        assert rejoin["truncated"] >= 1 or rejoin["snapshotInstalled"]
+        harness.write("w", "post-1")
+        deadline = time.monotonic() + 15
+        while "post-1" not in informer.cache:
+            assert time.monotonic() < deadline, informer.cache.keys()
+            time.sleep(0.05)
+        assert set(informer.cache) == (
+            {f"pre-{i}" for i in range(3)} | {"post-0", "post-1"}
+        )
+        assert "minority" not in added
+    finally:
+        if informer is not None:
+            informer.stop()
+        harness.stop()
