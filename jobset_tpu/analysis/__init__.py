@@ -12,7 +12,11 @@ reference:
   ``# jslint: disable=RULE reason`` suppressions, a checked-in baseline
   for grandfathered findings, stable ``RULE file:line message`` output;
 * ``rules/``     — the project-specific rules (determinism, lock
-  discipline, jit hygiene, durability ordering, registry/doc drift).
+  discipline, jit hygiene, durability ordering, registry/doc drift,
+  and the whole-tree race rules RACE001-003);
+* ``concurrency/`` — the shared whole-tree concurrency model the RACE
+  rules interrogate (lock inference, global lock graph, thread escape);
+  the dynamic runtime twin is ``jobset_tpu/testing/race.py``.
 
 Entry points: ``jobset-tpu lint [PATHS]`` (CLI), ``tests/test_lint.py``
 (tier-1 gate: the tree must stay lint-clean), and ``lint_stats()``

@@ -1,0 +1,37 @@
+"""Whole-tree concurrency analysis: the static half of the race plane.
+
+The per-function LCK rules (rules/locking.py) see one method at a time,
+but every concurrency bug this repo actually shipped crossed a boundary
+those rules cannot: ``Counter.value()`` read state another *method*
+locked, the lease CAS TOCTOU spanned a call edge, and the stop()-vs-pump
+joins involved two classes. This package builds one model of the whole
+tree — every class, every attribute access with the locks held at it,
+every call edge, every ``threading.Thread(target=...)`` hand-off — and
+the RACE rules (rules/races.py) interrogate it:
+
+* **RACE001** — *inferred* guarded-by: an attribute written under
+  ``with self.X:`` in at least one method but touched with no lock held
+  elsewhere. Unlike LCK001 this needs no ``# guarded-by:`` annotation;
+  the locking discipline a class already practices is the contract.
+* **RACE002** — global lock-acquisition graph: an edge is "held A,
+  acquired B", including across call edges (method holding ``_lock``
+  calls into another class that takes ``_buffer_lock``). Cycles and
+  canonical-rank inversions are the static shape of AB/BA deadlock.
+  Replaces the retired same-function pairwise LCK002.
+* **RACE003** — thread escape: an attribute written lock-free on a
+  thread entry path (``threading.Thread(target=...)``, ``run()`` of a
+  Thread subclass) while other methods touch it lock-free too.
+
+Entry point: :func:`build_model` (memoized per tree signature — three
+rules share one parse of the package).
+"""
+
+from .model import (  # noqa: F401
+    Access,
+    Acquisition,
+    ClassModel,
+    ConcurrencyModel,
+    FunctionModel,
+    build_model,
+)
+from .lockgraph import LockGraph, build_lock_graph  # noqa: F401

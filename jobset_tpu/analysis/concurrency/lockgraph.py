@@ -1,0 +1,274 @@
+"""Global lock-acquisition graph over the whole-tree model.
+
+Node = one lock, class-qualified (``Cluster.lock``,
+``ReplicationCoordinator._buffer_lock``): name-only aggregation (the
+retired LCK002's view) would alias every plane's ``_lock`` into one
+node and manufacture cycles between unrelated objects.
+
+Edge ``A -> B`` = somewhere, B is acquired while A is held — either
+directly in one body, or *across call edges*: a method holding A calls
+(by conservative name resolution) into code that may transitively
+acquire B. Each edge remembers its witness sites (file, line, call
+chain) so a finding can point at real code.
+
+Two hazard shapes fall out:
+
+* **cycles** — an SCC with >= 2 nodes is an AB/BA deadlock shape no
+  matter how many call edges hide it;
+* **rank inversions** — the canonical order ``lock`` -> ``_lock`` ->
+  ``_buffer_lock`` (rules/locking.py LOCK_RANKS) violated along any
+  edge, now including interprocedural ones.
+
+Resolution is deliberately conservative: ``self.m()`` resolves within
+the class; other calls resolve by terminal name across the tree but
+only for names that are not generic container/builtin vocabulary
+(``append``, ``get``, ``items`` ... resolve to nothing rather than to
+everything). Same-node edges are ignored — a reentrant RLock self-
+acquire is legal, and for cross-instance calls (one replica dialing
+another) a same-class edge is not a single-lock deadlock.
+"""
+
+from __future__ import annotations
+
+import builtins
+import pathlib
+from dataclasses import dataclass, field
+from typing import Iterator, Optional
+
+from .model import Call, ConcurrencyModel, FunctionModel, build_model
+
+# Names never resolved across the tree: builtin/container vocabulary
+# would connect every class that appends to a list into one giant
+# pseudo call graph.
+_GENERIC_NAMES = frozenset(
+    set(dir(list)) | set(dir(dict)) | set(dir(set)) | set(dir(str))
+    | set(dir(bytes)) | set(dir(tuple)) | set(dir(frozenset))
+    | {n for n in dir(builtins)}
+    | {
+        "acquire", "release", "wait", "notify", "notify_all", "start",
+        "join", "put", "close", "read", "write", "flush", "fileno",
+        "send", "recv", "connect", "accept", "encode", "decode",
+    }
+)
+
+
+@dataclass(frozen=True)
+class LockNode:
+    owner: str  # class name, or "" when unresolvable-but-unique is off
+    attr: str
+
+    def label(self) -> str:
+        return f"{self.owner}.{self.attr}" if self.owner else self.attr
+
+
+@dataclass
+class EdgeSite:
+    relpath: str
+    line: int
+    via: str  # "" for a direct nested `with`; else the call chain
+
+
+@dataclass
+class LockGraph:
+    edges: dict[tuple[LockNode, LockNode], list[EdgeSite]] = field(
+        default_factory=dict
+    )
+
+    def add(self, src: LockNode, dst: LockNode, site: EdgeSite) -> None:
+        if src == dst:
+            return
+        self.edges.setdefault((src, dst), []).append(site)
+
+    def nodes(self) -> set[LockNode]:
+        out: set[LockNode] = set()
+        for src, dst in self.edges:
+            out.add(src)
+            out.add(dst)
+        return out
+
+    def successors(self, node: LockNode) -> set[LockNode]:
+        return {dst for (src, dst) in self.edges if src == node}
+
+    def cycles(self) -> list[frozenset[LockNode]]:
+        """SCCs with >= 2 nodes (Tarjan), sorted for stable output."""
+        index: dict[LockNode, int] = {}
+        low: dict[LockNode, int] = {}
+        on_stack: set[LockNode] = set()
+        stack: list[LockNode] = []
+        counter = [0]
+        sccs: list[frozenset[LockNode]] = []
+
+        def strongconnect(v: LockNode) -> None:
+            # Iterative Tarjan: the tree is small but recursion depth
+            # must not depend on it.
+            work = [(v, iter(sorted(self.successors(v),
+                                    key=LockNode.label)))]
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            on_stack.add(v)
+            while work:
+                node, successors = work[-1]
+                advanced = False
+                for succ in successors:
+                    if succ not in index:
+                        index[succ] = low[succ] = counter[0]
+                        counter[0] += 1
+                        stack.append(succ)
+                        on_stack.add(succ)
+                        work.append((succ, iter(sorted(
+                            self.successors(succ), key=LockNode.label
+                        ))))
+                        advanced = True
+                        break
+                    if succ in on_stack:
+                        low[node] = min(low[node], index[succ])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+                if low[node] == index[node]:
+                    scc = []
+                    while True:
+                        w = stack.pop()
+                        on_stack.discard(w)
+                        scc.append(w)
+                        if w == node:
+                            break
+                    if len(scc) >= 2:
+                        sccs.append(frozenset(scc))
+
+        for v in sorted(self.nodes(), key=LockNode.label):
+            if v not in index:
+                strongconnect(v)
+        return sorted(
+            sccs, key=lambda s: sorted(n.label() for n in s)
+        )
+
+
+def _resolve_lock(
+    model: ConcurrencyModel, fn: FunctionModel, lock: str, on_self: bool
+) -> Optional[LockNode]:
+    """Class-qualify one acquired/held lock name. `self.X` binds to the
+    enclosing class; a non-self `obj.X` binds only when exactly one
+    class in the tree owns a lock attr named X (else: unknown, skip)."""
+    if fn.cls:
+        cls = model.classes.get(fn.cls)
+        alias = cls.lock_aliases.get(lock) if cls else None
+        if alias is not None and (
+            on_self or (cls and lock not in cls.lock_attrs)
+        ):
+            alias_owners = model.lock_owners.get(alias, set())
+            if len(alias_owners) == 1:
+                return LockNode(
+                    owner=next(iter(alias_owners)), attr=alias
+                )
+    if on_self and fn.cls:
+        return LockNode(owner=fn.cls, attr=lock)
+    owners = model.lock_owners.get(lock, set())
+    if fn.cls and fn.cls in owners:
+        # Held-stack entries lose their `self.` qualifier; prefer the
+        # enclosing class when it is one of the owners.
+        return LockNode(owner=fn.cls, attr=lock)
+    if len(owners) == 1:
+        return LockNode(owner=next(iter(owners)), attr=lock)
+    return None
+
+
+def _resolve_call(
+    model: ConcurrencyModel, fn: FunctionModel, call: Call
+) -> list[FunctionModel]:
+    if call.name.startswith("__") or call.name in _GENERIC_NAMES:
+        return []
+    if call.on_self and fn.cls:
+        cls = model.classes.get(fn.cls)
+        if cls is not None:
+            hits = [
+                f for key, f in cls.functions.items()
+                if key == call.name
+            ]
+            if hits:
+                return hits
+    return model.functions_by_name.get(call.name, [])
+
+
+def _transitive_acquisitions(
+    model: ConcurrencyModel,
+) -> dict[str, set[LockNode]]:
+    """qualname|relpath-key -> every lock node the function may acquire,
+    transitively through resolved calls. Iterative fixpoint, cycle-safe."""
+    key_of = {}
+    direct: dict[str, set[LockNode]] = {}
+    callees: dict[str, set[str]] = {}
+    fns = list(model.all_functions())
+    for fn in fns:
+        k = f"{fn.relpath}::{fn.qualname}"
+        key_of[id(fn)] = k
+        acquired = set()
+        for acq in fn.acquisitions:
+            node = _resolve_lock(model, fn, acq.lock, acq.on_self)
+            if node is not None:
+                acquired.add(node)
+        direct[k] = acquired
+        callees[k] = set()
+    for fn in fns:
+        k = key_of[id(fn)]
+        for call in fn.calls:
+            for callee in _resolve_call(model, fn, call):
+                callees[k].add(key_of[id(callee)])
+    closure = {k: set(v) for k, v in direct.items()}
+    changed = True
+    while changed:
+        changed = False
+        for k, callee_keys in callees.items():
+            for ck in callee_keys:
+                extra = closure[ck] - closure[k]
+                if extra:
+                    closure[k] |= extra
+                    changed = True
+    return {key_of[id(fn)]: closure[key_of[id(fn)]] for fn in fns}
+
+
+def build_lock_graph(root: pathlib.Path) -> LockGraph:
+    model = build_model(root)
+    transitive = _transitive_acquisitions(model)
+    graph = LockGraph()
+    for fn in model.all_functions():
+        # Direct edges: every acquisition with a held prefix.
+        for acq in fn.acquisitions:
+            dst = _resolve_lock(model, fn, acq.lock, acq.on_self)
+            if dst is None:
+                continue
+            for held in acq.held:
+                src = _resolve_lock(model, fn, held, on_self=False)
+                if src is not None:
+                    graph.add(src, dst, EdgeSite(
+                        relpath=fn.relpath, line=acq.line, via="",
+                    ))
+        # Interprocedural edges: held here, acquired somewhere down a
+        # resolved call chain.
+        for call in fn.calls:
+            if not call.held:
+                continue
+            targets = _resolve_call(model, fn, call)
+            if not targets:
+                continue
+            acquired: set[LockNode] = set()
+            chains: dict[LockNode, str] = {}
+            for callee in targets:
+                k = f"{callee.relpath}::{callee.qualname}"
+                for node in transitive.get(k, ()):
+                    acquired.add(node)
+                    chains.setdefault(node, callee.qualname)
+            for held in call.held:
+                src = _resolve_lock(model, fn, held, on_self=False)
+                if src is None:
+                    continue
+                for dst in acquired:
+                    graph.add(src, dst, EdgeSite(
+                        relpath=fn.relpath, line=call.line,
+                        via=f"{call.name}() -> {chains[dst]}",
+                    ))
+    return graph

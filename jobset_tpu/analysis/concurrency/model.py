@@ -1,0 +1,497 @@
+"""One parse of the tree -> a queryable concurrency model.
+
+Everything the RACE rules need is extracted in a single pass per file:
+which attributes each method touches and which locks were held at each
+touch, which locks each class owns (``self.X = threading.Lock()``),
+which calls each function makes while holding what, and which
+functions are thread entry points (``threading.Thread(target=...)``
+references, ``run()`` overrides of Thread subclasses).
+
+The model is *syntactic* — no project code is imported — so it runs
+against fixture mini-repos exactly like the real tree (the same
+contract every other rule in the plane honors). Held-lock tracking
+follows rules/locking.py's conventions: a ``with self.X:`` (or
+``with obj.X:``) item whose attribute name contains ``lock`` acquires
+``X``; nested functions are walked with an EMPTY held stack (a closure
+runs when called, not where defined) but are modeled as functions in
+their own right so ``Thread(target=local_fn)`` hand-offs stay visible.
+
+:func:`build_model` memoizes on a (path, mtime, size) signature of the
+scanned files: the three RACE rules each call it once per lint run and
+share one parse.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+from dataclasses import dataclass, field
+from typing import Iterator, Optional
+
+from ..engine import dotted_name
+from ..rules.locking import _GUARDED_RE  # annotation grammar is shared
+
+# Attribute names that acquire when used as a `with` context manager.
+# Condition objects guard state exactly like locks do (`with self._cond:`),
+# so "cond" names participate; the canonical-lock RANK table in
+# rules/locking.py stays lock-only.
+_LOCKISH = ("lock", "cond")
+
+# threading constructors whose product is itself a synchronization
+# primitive — an attribute holding one is never "shared unguarded data".
+_SYNC_TYPES = {
+    "Lock", "RLock", "Condition", "Event", "Semaphore",
+    "BoundedSemaphore", "Barrier", "Queue", "SimpleQueue", "LifoQueue",
+}
+
+
+def lockish_name(expr: ast.AST) -> str:
+    """The lock attribute acquired by a `with` item ('' when the item is
+    not lock-shaped). `self.X` and `obj.X` both yield X; a bare name
+    yields itself."""
+    if isinstance(expr, ast.Attribute):
+        name = expr.attr
+    elif isinstance(expr, ast.Name):
+        name = expr.id
+    else:
+        return ""
+    low = name.lower()
+    return name if any(part in low for part in _LOCKISH) else ""
+
+
+def _with_target_on_self(expr: ast.AST) -> bool:
+    return (
+        isinstance(expr, ast.Attribute)
+        and isinstance(expr.value, ast.Name)
+        and expr.value.id == "self"
+    )
+
+
+@dataclass(frozen=True)
+class Access:
+    """One `self.<attr>` touch inside a function body."""
+
+    attr: str
+    held: tuple[str, ...]  # lock names held, outermost first
+    line: int
+    write: bool
+
+
+@dataclass(frozen=True)
+class Acquisition:
+    """One `with <lock>:` entry."""
+
+    lock: str
+    on_self: bool
+    held: tuple[str, ...]
+    line: int
+
+
+@dataclass(frozen=True)
+class Call:
+    """One call site, by terminal name."""
+
+    name: str          # terminal identifier ('replicate' in self.c.replicate())
+    qualified: str     # best-effort dotted form
+    on_self: bool      # self.<name>(...)
+    held: tuple[str, ...]
+    line: int
+
+
+@dataclass
+class FunctionModel:
+    name: str
+    qualname: str      # "Class.method", "Class.method.<nested>", "module_fn"
+    cls: str           # owning class name, "" for module-level
+    relpath: str
+    line: int
+    accesses: list[Access] = field(default_factory=list)
+    acquisitions: list[Acquisition] = field(default_factory=list)
+    calls: list[Call] = field(default_factory=list)
+    # Local function names this body hands to threading.Thread(target=...).
+    local_thread_targets: set[str] = field(default_factory=set)
+
+
+@dataclass
+class ClassModel:
+    name: str
+    relpath: str
+    line: int
+    bases: tuple[str, ...]
+    # method/nested-function name -> model (nested functions keyed as
+    # "method.<nested>"; a plain-name index is kept separately).
+    functions: dict[str, FunctionModel] = field(default_factory=dict)
+    # lock-ish attr -> threading type name ("Lock"/"RLock"/"Condition")
+    lock_attrs: dict[str, str] = field(default_factory=dict)
+    # attr -> threading/queue type for ANY sync-primitive-holding attr
+    sync_attrs: dict[str, str] = field(default_factory=dict)
+    # lock-ish attr assigned from another object's lock attribute
+    # (`self.lock = cluster.lock`): attr -> aliased terminal attr name.
+    # The graph must treat the alias as the aliased lock, or a false
+    # A->alias(A) edge can close a nonexistent cycle.
+    lock_aliases: dict[str, str] = field(default_factory=dict)
+    # attrs with an explicit `# guarded-by:` annotation (LCK001's domain)
+    annotated: dict[str, str] = field(default_factory=dict)
+    # method names referenced as Thread targets (self.<m> or a nested fn)
+    thread_targets: set[str] = field(default_factory=set)
+    # first assignment line per attr, for messages
+    attr_lines: dict[str, int] = field(default_factory=dict)
+
+    def is_thread_subclass(self) -> bool:
+        return any("Thread" in base for base in self.bases)
+
+    def entry_functions(self) -> set[str]:
+        """Function keys that begin life on another thread: Thread
+        targets, and run() when the class subclasses Thread. Targets
+        naming a nested function ("drain") match the nested key
+        ("start.drain") by terminal segment."""
+        wanted = set(self.thread_targets)
+        if self.is_thread_subclass():
+            wanted.add("run")
+        return {
+            key for key in self.functions
+            if key in wanted or key.rsplit(".", 1)[-1] in wanted
+        }
+
+
+@dataclass
+class ConcurrencyModel:
+    root: pathlib.Path
+    classes: dict[str, ClassModel] = field(default_factory=dict)  # by name
+    module_functions: dict[str, list[FunctionModel]] = field(
+        default_factory=dict
+    )
+
+    # -- resolution indexes (built by finalize) ---------------------------
+    functions_by_name: dict[str, list[FunctionModel]] = field(
+        default_factory=dict
+    )
+    # lock attr name -> class names assigning a threading lock to it
+    lock_owners: dict[str, set[str]] = field(default_factory=dict)
+
+    def finalize(self) -> None:
+        index: dict[str, list[FunctionModel]] = {}
+        for cls in self.classes.values():
+            for key, fn in cls.functions.items():
+                index.setdefault(fn.name, []).append(fn)
+            for attr, kind in cls.lock_attrs.items():
+                self.lock_owners.setdefault(attr, set()).add(cls.name)
+        for fns in self.module_functions.values():
+            for fn in fns:
+                index.setdefault(fn.name, []).append(fn)
+        self.functions_by_name = index
+
+    def all_functions(self) -> Iterator[FunctionModel]:
+        for cls in self.classes.values():
+            yield from cls.functions.values()
+        for fns in self.module_functions.values():
+            yield from fns
+
+    def lock_type(self, owner: str, attr: str) -> str:
+        cls = self.classes.get(owner)
+        return cls.lock_attrs.get(attr, "") if cls else ""
+
+
+class _BodyWalker(ast.NodeVisitor):
+    """Walk one function body tracking held locks; record accesses,
+    acquisitions, and calls into the FunctionModel. Nested FunctionDefs
+    are NOT entered (the caller models them separately with a fresh
+    stack) — but their Thread-target references are."""
+
+    def __init__(self, fn: FunctionModel):
+        self.fn = fn
+        self.held: list[str] = []
+        self._write_depth = 0
+
+    # Nested defs are modeled separately; record the boundary only.
+    def visit_FunctionDef(self, node) -> None:
+        return
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    def visit_With(self, node: ast.With) -> None:
+        acquired = []
+        for item in node.items:
+            name = lockish_name(item.context_expr)
+            if name:
+                self.fn.acquisitions.append(Acquisition(
+                    lock=name,
+                    on_self=_with_target_on_self(item.context_expr),
+                    held=tuple(self.held),
+                    line=node.lineno,
+                ))
+                self.held.append(name)
+                acquired.append(name)
+        self.generic_visit(node)
+        for _ in acquired:
+            self.held.pop()
+
+    visit_AsyncWith = visit_With
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for target in node.targets:
+            self._visit_store(target)
+        self.visit(node.value)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        self._visit_store(node.target)
+        if node.value is not None:
+            self.visit(node.value)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        # `self.x += 1` both reads and writes; record the write (the
+        # read is implied and the rules treat writes as the stronger
+        # evidence anyway).
+        self._visit_store(node.target)
+        self.visit(node.value)
+
+    def _visit_store(self, target: ast.AST) -> None:
+        if isinstance(target, ast.Attribute) and isinstance(
+            target.value, ast.Name
+        ) and target.value.id == "self":
+            self.fn.accesses.append(Access(
+                attr=target.attr, held=tuple(self.held),
+                line=target.lineno, write=True,
+            ))
+            return
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for element in target.elts:
+                self._visit_store(element)
+            return
+        if isinstance(target, (ast.Subscript, ast.Attribute, ast.Starred)):
+            # `self.d[k] = v` mutates the object self.d holds: a write
+            # for lockset purposes, recorded against the container attr.
+            inner = target.value if not isinstance(
+                target, ast.Starred
+            ) else target.value
+            if isinstance(target, ast.Subscript) and isinstance(
+                target.value, ast.Attribute
+            ) and isinstance(target.value.value, ast.Name) and (
+                target.value.value.id == "self"
+            ):
+                self.fn.accesses.append(Access(
+                    attr=target.value.attr, held=tuple(self.held),
+                    line=target.lineno, write=True,
+                ))
+                return
+            self.visit(inner)
+
+    _MUTATORS = {
+        "append", "appendleft", "extend", "insert", "remove", "pop",
+        "popleft", "clear", "update", "setdefault", "add", "discard",
+        "sort", "reverse", "write",
+    }
+
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        name = ""
+        qualified = dotted_name(func)
+        on_self = False
+        if isinstance(func, ast.Attribute):
+            name = func.attr
+            on_self = (
+                isinstance(func.value, ast.Name) and func.value.id == "self"
+            )
+            # `self.buf.append(x)`: a mutating method on a container
+            # attribute is a WRITE to that attribute for lockset
+            # purposes (the Counter.value() bug class lives here).
+            if name in self._MUTATORS and isinstance(
+                func.value, ast.Attribute
+            ) and isinstance(func.value.value, ast.Name) and (
+                func.value.value.id == "self"
+            ):
+                self.fn.accesses.append(Access(
+                    attr=func.value.attr, held=tuple(self.held),
+                    line=node.lineno, write=True,
+                ))
+        elif isinstance(func, ast.Name):
+            name = func.id
+        if name:
+            self.fn.calls.append(Call(
+                name=name, qualified=qualified, on_self=on_self,
+                held=tuple(self.held), line=node.lineno,
+            ))
+        # threading.Thread(target=self.m) / Thread(target=local_fn)
+        if name == "Thread":
+            for kw in node.keywords:
+                if kw.arg != "target":
+                    continue
+                target = kw.value
+                if isinstance(target, ast.Attribute) and isinstance(
+                    target.value, ast.Name
+                ) and target.value.id == "self":
+                    self.fn.local_thread_targets.add(target.attr)
+                elif isinstance(target, ast.Name):
+                    self.fn.local_thread_targets.add(target.id)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if isinstance(node.value, ast.Name) and node.value.id == "self" and (
+            isinstance(node.ctx, ast.Load)
+        ):
+            self.fn.accesses.append(Access(
+                attr=node.attr, held=tuple(self.held),
+                line=node.lineno, write=False,
+            ))
+        self.generic_visit(node)
+
+
+def _sync_type(value: ast.AST) -> str:
+    """'Lock'/'RLock'/'Event'/... when `value` constructs a threading or
+    queue synchronization primitive, else ''."""
+    if not isinstance(value, ast.Call):
+        return ""
+    name = dotted_name(value.func)
+    terminal = name.rsplit(".", 1)[-1]
+    return terminal if terminal in _SYNC_TYPES else ""
+
+
+def _model_function(
+    node, cls_name: str, qualprefix: str, relpath: str,
+    out: list[FunctionModel],
+) -> FunctionModel:
+    """Model `node` and (recursively) its nested functions, appending
+    every model to `out`; returns the model for `node` itself."""
+    fn = FunctionModel(
+        name=node.name,
+        qualname=f"{qualprefix}{node.name}",
+        cls=cls_name,
+        relpath=relpath,
+        line=node.lineno,
+    )
+    walker = _BodyWalker(fn)
+    for stmt in node.body:
+        walker.visit(stmt)
+    out.append(fn)
+    for stmt in ast.walk(node):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)) and (
+            stmt is not node
+        ):
+            nested = FunctionModel(
+                name=stmt.name,
+                qualname=f"{qualprefix}{node.name}.{stmt.name}",
+                cls=cls_name,
+                relpath=relpath,
+                line=stmt.lineno,
+            )
+            nested_walker = _BodyWalker(nested)
+            for inner in stmt.body:
+                nested_walker.visit(inner)
+            out.append(nested)
+    return fn
+
+
+def _model_class(
+    cls: ast.ClassDef, relpath: str, lines: list[str]
+) -> ClassModel:
+    model = ClassModel(
+        name=cls.name,
+        relpath=relpath,
+        line=cls.lineno,
+        bases=tuple(dotted_name(b) for b in cls.bases),
+    )
+    for node in ast.walk(cls):
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            value = node.value
+            for target in targets:
+                if not (
+                    isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"
+                ):
+                    continue
+                attr = target.attr
+                model.attr_lines.setdefault(attr, node.lineno)
+                kind = _sync_type(value) if value is not None else ""
+                if kind:
+                    model.sync_attrs[attr] = kind
+                    if kind in ("Lock", "RLock", "Condition"):
+                        model.lock_attrs[attr] = kind
+                elif (
+                    lockish_name(target)
+                    and isinstance(value, ast.Attribute)
+                    and lockish_name(value)
+                ):
+                    model.lock_aliases[attr] = value.attr
+                if node.lineno <= len(lines):
+                    m = _GUARDED_RE.search(lines[node.lineno - 1])
+                    if m:
+                        model.annotated[attr] = m.group(1)
+    modeled: list[FunctionModel] = []
+    for node in cls.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _model_function(node, cls.name, f"{cls.name}.", relpath, modeled)
+    for fn in modeled:
+        key = fn.qualname[len(cls.name) + 1:]
+        model.functions[key] = fn
+        model.thread_targets |= fn.local_thread_targets
+    return model
+
+
+def _model_module(
+    tree: ast.Module, relpath: str, lines: list[str], model: ConcurrencyModel
+) -> None:
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            cm = _model_class(node, relpath, lines)
+            # Later definition of an identically-named class wins; the
+            # tree has no such collisions today and fixtures keep names
+            # unique per mini-repo.
+            model.classes[cm.name] = cm
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            modeled: list[FunctionModel] = []
+            _model_function(node, "", "", relpath, modeled)
+            model.module_functions.setdefault(relpath, []).extend(modeled)
+
+
+def _package_files(root: pathlib.Path) -> list[pathlib.Path]:
+    pkg = root / "jobset_tpu"
+    if not pkg.is_dir():
+        return []
+    return sorted(
+        p for p in pkg.rglob("*.py") if "__pycache__" not in p.parts
+    )
+
+
+def _signature(files: list[pathlib.Path]) -> tuple:
+    sig = []
+    for p in files:
+        try:
+            st = p.stat()
+            sig.append((str(p), st.st_mtime_ns, st.st_size))
+        except OSError:
+            sig.append((str(p), 0, -1))
+    return tuple(sig)
+
+
+_CACHE: dict[str, tuple[tuple, ConcurrencyModel]] = {}
+
+
+def build_model(root: pathlib.Path) -> ConcurrencyModel:
+    """The memoized entry point: one model per tree state."""
+    root = pathlib.Path(root).resolve()
+    files = _package_files(root)
+    sig = _signature(files)
+    cached = _CACHE.get(str(root))
+    if cached is not None and cached[0] == sig:
+        return cached[1]
+    model = ConcurrencyModel(root=root)
+    for path in files:
+        try:
+            source = path.read_text()
+            tree = ast.parse(source, filename=str(path))
+        except (OSError, SyntaxError, UnicodeDecodeError):
+            continue  # SYN001 is the engine's job
+        try:
+            rel = path.resolve().relative_to(root).as_posix()
+        except ValueError:
+            rel = path.as_posix()
+        _model_module(tree, rel, source.splitlines(), model)
+    model.finalize()
+    _CACHE[str(root)] = (sig, model)
+    return model

@@ -163,6 +163,11 @@ def load_baseline(path) -> set[str]:
 @dataclass
 class Report:
     findings: list[Finding] = field(default_factory=list)
+    # rule name -> wall seconds spent in its check hooks this run
+    # (module hooks summed across files + the project hook). Surfaced as
+    # `timingMs` by --stats so a rule that turns the tier-1 gate slow is
+    # attributable — the whole-tree RACE rules motivated this.
+    timings: dict[str, float] = field(default_factory=dict)
 
     @property
     def visible(self) -> list[Finding]:
@@ -173,8 +178,9 @@ class Report:
         return [f for f in self.findings if f.suppressed_by]
 
     def stats(self) -> dict:
-        """Per-rule visible/suppressed counts — the lint-debt block the
-        debug bundle manifests (docs/static-analysis.md)."""
+        """Per-rule visible/suppressed counts (+ per-rule timing) — the
+        lint-debt block the debug bundle manifests
+        (docs/static-analysis.md)."""
         per_rule: dict[str, dict[str, int]] = {}
         for f in self.findings:
             row = per_rule.setdefault(
@@ -185,6 +191,10 @@ class Report:
             "visible": len(self.visible),
             "suppressed": len(self.suppressed),
             "perRule": {k: per_rule[k] for k in sorted(per_rule)},
+            "timingMs": {
+                k: round(self.timings[k] * 1000.0, 3)
+                for k in sorted(self.timings)
+            },
         }
 
     def render(self, fmt: str = "text") -> str:
@@ -225,28 +235,23 @@ class LintEngine:
 
     # -- suppression ------------------------------------------------------
 
-    def _suppressions(
-        self, ctx: ModuleContext
-    ) -> tuple[dict[int, tuple[set[str], str]], list[Finding]]:
-        """Per-line inline suppressions. A disable on line N covers
-        findings on N and N+1 (comment-above style). Returns the map and
-        the SUP001 findings for disables with no stated reason."""
+    @staticmethod
+    def _scan_suppressions(
+        lines: list[str],
+    ) -> tuple[dict[int, tuple[set[str], str]], list[tuple[int, str]]]:
+        """Per-line inline suppressions from raw source lines. A disable
+        on line N covers findings on N and N+1 (comment-above style).
+        Returns the map and the (line, reason) pairs with empty reasons."""
         covered: dict[int, tuple[set[str], str]] = {}
-        bare: list[Finding] = []
-        for i, text in enumerate(ctx.lines, start=1):
+        bare: list[tuple[int, str]] = []
+        for i, text in enumerate(lines, start=1):
             m = _SUPPRESS_RE.search(text)
             if not m:
                 continue
             names = {n.strip() for n in m.group(1).split(",")}
             reason = m.group(2).strip()
             if not reason:
-                bare.append(Finding(
-                    rule="SUP001", path=ctx.relpath, line=i,
-                    message=(
-                        "suppression without a reason — state why, e.g. "
-                        "`# jslint: disable=RULE <why this is sanctioned>`"
-                    ),
-                ))
+                bare.append((i, reason))
             for line in (i, i + 1):
                 prev = covered.get(line)
                 if prev:
@@ -255,15 +260,57 @@ class LintEngine:
                     covered[line] = (set(names), reason)
         return covered, bare
 
+    def _suppressions(
+        self, ctx: ModuleContext
+    ) -> tuple[dict[int, tuple[set[str], str]], list[Finding]]:
+        """Inline suppressions of one parsed module, plus the SUP001
+        findings for disables with no stated reason."""
+        covered, bare_lines = self._scan_suppressions(ctx.lines)
+        bare = [
+            Finding(
+                rule="SUP001", path=ctx.relpath, line=i,
+                message=(
+                    "suppression without a reason — state why, e.g. "
+                    "`# jslint: disable=RULE <why this is sanctioned>`"
+                ),
+            )
+            for i, _ in bare_lines
+        ]
+        return covered, bare
+
+    def _file_suppressions(
+        self, path: pathlib.Path
+    ) -> dict[int, tuple[set[str], str]]:
+        """Suppression map for a file that was NOT among the linted
+        paths (a project rule reported against it). Best-effort: an
+        unreadable file simply has no inline suppressions."""
+        try:
+            lines = pathlib.Path(path).read_text().splitlines()
+        except (OSError, UnicodeDecodeError):
+            return {}
+        return self._scan_suppressions(lines)[0]
+
     # -- run --------------------------------------------------------------
 
     def run(self, paths: Iterable) -> Report:
+        import time as _time
+
         files = list(self._iter_py_files(paths))
         root = self.root or find_repo_root(
             files[0] if files else pathlib.Path.cwd()
         )
         findings: list[Finding] = []
         suppress_maps: dict[str, dict[int, tuple[set[str], str]]] = {}
+        timings: dict[str, float] = {}
+
+        def timed(rule_name: str, check, arg) -> list[Finding]:
+            start = _time.perf_counter()
+            found = list(check(arg))
+            timings[rule_name] = (
+                timings.get(rule_name, 0.0)
+                + (_time.perf_counter() - start)
+            )
+            return found
 
         for path in files:
             try:
@@ -298,21 +345,28 @@ class LintEngine:
             covered, bare = self._suppressions(ctx)
             suppress_maps[ctx.relpath] = covered
             findings.extend(bare)
-            for rule in self.rules.values():
+            for name, rule in self.rules.items():
                 check = getattr(rule, "check_module", None)
                 if check is not None:
-                    findings.extend(check(ctx))
+                    findings.extend(timed(name, check, ctx))
 
-        for rule in self.rules.values():
+        for name, rule in self.rules.items():
             check = getattr(rule, "check_project", None)
             if check is not None:
-                findings.extend(check(root))
+                findings.extend(timed(name, check, root))
 
         # Apply suppression layers. SUP001 itself is baseline-suppressible
         # but never inline-suppressible (a reasonless disable cannot
-        # excuse itself).
+        # excuse itself). Project rules (whole-tree scans) may report
+        # against files OUTSIDE the linted paths — their suppression
+        # comments are loaded lazily so a subset-PATHS run honors the
+        # same inline disables the full gate does.
         for f in findings:
             if f.rule != "SUP001":
+                if f.path not in suppress_maps:
+                    suppress_maps[f.path] = self._file_suppressions(
+                        root / f.path
+                    )
                 names, reason = suppress_maps.get(f.path, {}).get(
                     f.line, (set(), "")
                 )
@@ -325,7 +379,7 @@ class LintEngine:
                 f.suppress_reason = "baseline entry"
 
         findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
-        return Report(findings=findings)
+        return Report(findings=findings, timings=timings)
 
 
 # -- convenience entry points ------------------------------------------------

@@ -1,4 +1,4 @@
 """Project-specific lint rules. Importing this package registers every
 rule with the engine (docs/static-analysis.md is the catalog)."""
 
-from . import determinism, durability, drift, jit, locking  # noqa: F401
+from . import determinism, durability, drift, jit, locking, races  # noqa: F401

@@ -34,7 +34,9 @@ from typing import Iterator
 from ..engine import Finding, ModuleContext, dotted_name, register
 
 _DURABLE_ATTR_CALLS = ("append", "fsync", "flush")
-_DURABLE_FN_CALLS = ("_persist_meta", "write_snapshot_file")
+_DURABLE_FN_CALLS = (
+    "_persist_meta", "_persist_meta_locked", "write_snapshot_file"
+)
 _POSITION_ATTRS = ("_seq", "last_seq", "commit_seq")
 
 

@@ -12,11 +12,14 @@ by a checker — until now.
   methods whose name ends in ``_locked`` (called with the lock already
   held by the caller — e.g. ``FaultInjector._rng_for_locked``).
 
-* **LCK002 — acquisition order.** The canonical order across planes is
-  ``lock`` (the Cluster's reentrant outermost lock) → ``_lock`` (one per
-  plane object) → ``_buffer_lock`` (replication resend buffer, leaf).
-  Acquiring a lower-ranked lock while holding a higher-ranked one is the
-  static shape of an AB/BA deadlock.
+The canonical acquisition order across planes — ``lock`` (the Cluster's
+reentrant outermost lock) → ``_lock`` (one per plane object) →
+``_buffer_lock`` (replication resend buffer, leaf) — lives here as
+``LOCK_RANKS``, but its enforcement moved: the same-function pairwise
+LCK002 rule is **retired**, replaced by RACE002's whole-tree lock-
+acquisition graph (rules/races.py), which sees the same inversions plus
+the ones that only exist across call edges, and genuine cycles LCK002's
+rank ladder could never express.
 """
 
 from __future__ import annotations
@@ -161,37 +164,7 @@ class GuardedByRule:
                 yield from findings
 
 
-@register
-class LockOrderRule:
-    """LCK002: canonical cross-plane lock acquisition order."""
-
-    NAME = "LCK002"
-    DESCRIPTION = (
-        "lock acquired out of canonical order (lock -> _lock -> "
-        "_buffer_lock) — AB/BA deadlock shape"
-    )
-
-    def check_module(self, ctx: ModuleContext) -> Iterator[Finding]:
-        findings: list[Finding] = []
-
-        def on_acquire(name, held, line):
-            rank = LOCK_RANKS.get(name)
-            if rank is None:
-                return
-            for outer in held:
-                outer_rank = LOCK_RANKS.get(outer)
-                if outer_rank is not None and outer_rank > rank:
-                    findings.append(Finding(
-                        rule=self.NAME, path=ctx.relpath, line=line,
-                        message=(
-                            f"acquiring '{name}' (rank {rank}) while "
-                            f"holding '{outer}' (rank {outer_rank}) "
-                            "inverts the canonical lock order "
-                            "lock -> _lock -> _buffer_lock"
-                        ),
-                    ))
-
-        # One pass over the whole module: the walker resets the held
-        # stack at every function boundary, so each body is judged once.
-        _LockWalker(lambda *a: None, on_acquire).visit(ctx.tree)
-        yield from findings
+# LCK002 (same-function pairwise acquisition order) is retired: RACE002
+# (rules/races.py) checks the same canonical ranks over the whole-tree
+# lock graph, call edges included. LOCK_RANKS above remains the single
+# source of truth for the canonical order.

@@ -1,0 +1,309 @@
+"""Race-detection rules: the static half of the concurrency plane.
+
+These are whole-tree (``check_project``) rules over the shared
+:mod:`jobset_tpu.analysis.concurrency` model — the per-function LCK
+rules keep enforcing *declared* contracts (``# guarded-by:`` and the
+canonical rank order inside one body); the RACE rules find the
+violations nobody declared:
+
+* **RACE001 — inferred guarded-by.** A class that writes ``self.x``
+  under ``with self.L:`` in one method has *told us* ``x`` is shared
+  mutable state guarded by ``L``; any other method touching ``x`` with
+  no lock held is the ``Counter.value()`` unlocked-read bug shape.
+  Inference, not annotation — the rule that would have caught that bug
+  before review did.
+* **RACE002 — global lock graph.** Cycles and canonical-rank
+  inversions in the whole-tree lock-acquisition graph, including edges
+  that only exist across call boundaries (method holding ``_lock``
+  calls another class that takes ``_buffer_lock``). Replaces the
+  retired same-function pairwise LCK002.
+* **RACE003 — thread escape.** An attribute written lock-free on a
+  ``threading.Thread(target=...)`` entry path while also touched
+  lock-free from ordinary methods: unguarded cross-thread state with
+  no locking discipline at all (so RACE001's inference has nothing to
+  infer from). The stop()-vs-pump join bugs lived here.
+
+The dynamic lockset checker (:mod:`jobset_tpu.testing.race`) is the
+runtime cross-check of the same contracts.
+"""
+
+from __future__ import annotations
+
+import pathlib
+from typing import Iterator
+
+from ..engine import Finding, register
+from .locking import LOCK_RANKS
+
+
+def _terminal(key: str) -> str:
+    return key.rsplit(".", 1)[-1]
+
+
+def _exempt_function(key: str) -> bool:
+    """__init__ bodies (no other thread holds a reference yet) and
+    *_locked functions (caller holds the lock) are outside the bare-
+    access rules, exactly as in LCK001."""
+    terminal = _terminal(key)
+    return terminal == "__init__" or terminal.endswith("_locked")
+
+
+@register
+class InferredGuardRule:
+    """RACE001: lock discipline a class practices is a contract it must
+    keep practicing."""
+
+    NAME = "RACE001"
+    DESCRIPTION = (
+        "attribute written under `with self.<lock>:` in one method but "
+        "accessed with no lock held in another (inferred guarded-by "
+        "violation — the Counter.value() unlocked-read shape)"
+    )
+
+    def check_project(self, root: pathlib.Path) -> Iterator[Finding]:
+        from ..concurrency import build_model
+
+        model = build_model(pathlib.Path(root))
+        for cls in sorted(model.classes.values(), key=lambda c: c.name):
+            if not cls.lock_attrs:
+                continue
+            # attr -> {class-owned lock held at >= 1 write}
+            evidence: dict[str, set[str]] = {}
+            writers: dict[str, str] = {}
+            for key, fn in cls.functions.items():
+                if _exempt_function(key):
+                    continue
+                for access in fn.accesses:
+                    if not access.write or not access.held:
+                        continue
+                    owned = [
+                        lock for lock in access.held
+                        if lock in cls.lock_attrs
+                    ]
+                    if owned and access.attr not in cls.annotated:
+                        evidence.setdefault(access.attr, set()).update(owned)
+                        writers.setdefault(access.attr, key)
+            if not evidence:
+                continue
+            seen: set[tuple[str, int]] = set()
+            for key, fn in sorted(cls.functions.items()):
+                if _exempt_function(key):
+                    continue
+                for access in fn.accesses:
+                    locks = evidence.get(access.attr)
+                    if locks is None or len(locks) != 1 or access.held:
+                        continue
+                    if (access.attr, access.line) in seen:
+                        continue
+                    seen.add((access.attr, access.line))
+                    lock = next(iter(locks))
+                    yield Finding(
+                        rule=self.NAME, path=fn.relpath, line=access.line,
+                        message=(
+                            f"self.{access.attr} is written under `with "
+                            f"self.{lock}:` in {cls.name}."
+                            f"{writers[access.attr]} but {cls.name}.{key} "
+                            "touches it with no lock held — hold the "
+                            f"lock, annotate `# guarded-by: {lock}`, or "
+                            "rename the method *_locked if the caller "
+                            "holds it"
+                        ),
+                    )
+
+
+@register
+class LockGraphRule:
+    """RACE002: whole-tree lock-acquisition graph hazards."""
+
+    NAME = "RACE002"
+    DESCRIPTION = (
+        "lock-acquisition hazard in the global lock graph: a cycle "
+        "(AB/BA deadlock shape, including across call edges) or a "
+        "canonical-order inversion (lock -> _lock -> _buffer_lock)"
+    )
+
+    def check_project(self, root: pathlib.Path) -> Iterator[Finding]:
+        from ..concurrency import build_lock_graph
+
+        graph = build_lock_graph(pathlib.Path(root))
+        emitted: set[tuple[str, int, str]] = set()
+
+        def emit(path: str, line: int, message: str):
+            key = (path, line, message)
+            if key not in emitted:
+                emitted.add(key)
+                yield Finding(
+                    rule=self.NAME, path=path, line=line, message=message
+                )
+
+        # Cycles: every edge inside an SCC, at each witness site.
+        for scc in graph.cycles():
+            members = ", ".join(sorted(n.label() for n in scc))
+            for (src, dst), sites in sorted(
+                graph.edges.items(),
+                key=lambda kv: (kv[0][0].label(), kv[0][1].label()),
+            ):
+                if src not in scc or dst not in scc:
+                    continue
+                for site in sites:
+                    via = f" via {site.via}" if site.via else ""
+                    yield from emit(
+                        site.relpath, site.line,
+                        (
+                            f"lock-order cycle {{{members}}}: acquiring "
+                            f"{dst.label()} while holding "
+                            f"{src.label()}{via} — AB/BA deadlock shape"
+                        ),
+                    )
+        # Canonical rank inversions (the retired LCK002's contract, now
+        # interprocedural).
+        for (src, dst), sites in sorted(
+            graph.edges.items(),
+            key=lambda kv: (kv[0][0].label(), kv[0][1].label()),
+        ):
+            src_rank = LOCK_RANKS.get(src.attr)
+            dst_rank = LOCK_RANKS.get(dst.attr)
+            if src_rank is None or dst_rank is None or dst_rank >= src_rank:
+                continue
+            for site in sites:
+                via = f" via {site.via}" if site.via else ""
+                yield from emit(
+                    site.relpath, site.line,
+                    (
+                        f"acquiring '{dst.attr}' (rank {dst_rank}) while "
+                        f"holding '{src.attr}' (rank {src_rank}){via} "
+                        "inverts the canonical lock order "
+                        "lock -> _lock -> _buffer_lock"
+                    ),
+                )
+        # Name-based fallback over DIRECT acquisitions: when a non-self
+        # lock's owning class is ambiguous (many classes name a `_lock`)
+        # the graph drops the edge rather than alias unrelated locks —
+        # but the canonical ranks are defined on NAMES, so the retired
+        # LCK002's same-body coverage must not shrink with it. Messages
+        # match the graph-based shape, so `emitted` dedups overlap.
+        from ..concurrency import build_model
+
+        model = build_model(pathlib.Path(root))
+        for fn in model.all_functions():
+            for acq in fn.acquisitions:
+                dst_rank = LOCK_RANKS.get(acq.lock)
+                if dst_rank is None:
+                    continue
+                for held in acq.held:
+                    src_rank = LOCK_RANKS.get(held)
+                    if src_rank is None or dst_rank >= src_rank:
+                        continue
+                    yield from emit(
+                        fn.relpath, acq.line,
+                        (
+                            f"acquiring '{acq.lock}' (rank {dst_rank}) "
+                            f"while holding '{held}' (rank {src_rank}) "
+                            "inverts the canonical lock order "
+                            "lock -> _lock -> _buffer_lock"
+                        ),
+                    )
+
+
+@register
+class ThreadEscapeRule:
+    """RACE003: unguarded state shared with a spawned thread."""
+
+    NAME = "RACE003"
+    DESCRIPTION = (
+        "attribute written with no lock on a threading.Thread entry "
+        "path and accessed lock-free from other methods — unguarded "
+        "cross-thread state"
+    )
+
+    def check_project(self, root: pathlib.Path) -> Iterator[Finding]:
+        from ..concurrency import build_model
+
+        model = build_model(pathlib.Path(root))
+        for cls in sorted(model.classes.values(), key=lambda c: c.name):
+            entries = cls.entry_functions()
+            if not entries:
+                continue
+            # Reachable-from-entry closure over self-calls (nested
+            # functions ride with their enclosing method).
+            reachable = set(entries)
+            frontier = list(entries)
+            by_terminal: dict[str, list[str]] = {}
+            for key in cls.functions:
+                by_terminal.setdefault(_terminal(key), []).append(key)
+            while frontier:
+                key = frontier.pop()
+                fn = cls.functions[key]
+                wanted = {
+                    call.name for call in fn.calls if call.on_self
+                } | fn.local_thread_targets
+                for name in wanted:
+                    for candidate in by_terminal.get(name, ()):
+                        if candidate not in reachable:
+                            reachable.add(candidate)
+                            frontier.append(candidate)
+                for nested in cls.functions:
+                    if nested.startswith(key + ".") and (
+                        nested not in reachable
+                    ):
+                        reachable.add(nested)
+                        frontier.append(nested)
+
+            # Partition bare accesses; skip attrs with ANY locked access
+            # (RACE001/LCK001 own partially-disciplined attrs) and sync
+            # primitives (they are the guard, not the guarded).
+            locked_somewhere: set[str] = set()
+            entry_access: dict[str, list] = {}
+            other_access: dict[str, list] = {}
+            for key, fn in cls.functions.items():
+                if _terminal(key) == "__init__":
+                    continue
+                side = entry_access if key in reachable else other_access
+                if _exempt_function(key):
+                    continue
+                for access in fn.accesses:
+                    if access.held:
+                        locked_somewhere.add(access.attr)
+                    else:
+                        side.setdefault(access.attr, []).append(
+                            (access, key)
+                        )
+            for attr in sorted(
+                set(entry_access) & set(other_access)
+            ):
+                if (
+                    attr in locked_somewhere
+                    or attr in cls.sync_attrs
+                    or attr in cls.annotated
+                ):
+                    continue
+                entry_writes = [
+                    (a, k) for a, k in entry_access[attr] if a.write
+                ]
+                other_writes = [
+                    (a, k) for a, k in other_access[attr] if a.write
+                ]
+                if not entry_writes and not other_writes:
+                    continue  # read-only sharing of init-time state
+                access, key = min(
+                    entry_writes or other_writes,
+                    key=lambda t: t[0].line,
+                )
+                fn = cls.functions[key]
+                other_key = (
+                    other_access[attr][0][1]
+                    if entry_writes else entry_access[attr][0][1]
+                )
+                entry_names = ", ".join(sorted(entries))
+                yield Finding(
+                    rule=self.NAME, path=fn.relpath, line=access.line,
+                    message=(
+                        f"self.{attr} is written with no lock held in "
+                        f"{cls.name}.{key} and touched from "
+                        f"{cls.name}.{other_key}, across the thread "
+                        f"entry point(s) {entry_names} — unguarded "
+                        "cross-thread state; guard it, make it a "
+                        "threading primitive, or confine it to one "
+                        "thread"
+                    ),
+                )

@@ -177,17 +177,25 @@ class PartitionPlan:
                 )
         return applied
 
+    def _current_step(self) -> int:
+        """Locked read of the plan clock — advance() writes it under the
+        lock, and the apply-now helpers run on scenario/bench driver
+        threads concurrent with delivery-path advances."""
+        with self._lock:
+            return self.step
+
     def apply_cut(self, src: str, dst: str, symmetric: bool = False) -> None:
         """Cut now (wall-clock callers: bench windows)."""
-        self.cut(src, dst, at=self.step, symmetric=symmetric)
-        self.advance(self.step)
+        step = self._current_step()
+        self.cut(src, dst, at=step, symmetric=symmetric)
+        self.advance(step)
 
     def isolate(self, node: str, others, at: Optional[int] = None) -> None:
         """Cut every link between `node` and each of `others`, both
         directions, at step `at` (default: now) and apply — THE
         leader-isolation fault, shared by the checker-gated scenarios
         and `bench.py --partition` so both measure the same cut."""
-        step = self.step if at is None else int(at)
+        step = self._current_step() if at is None else int(at)
         for other in others:
             if other != node:
                 self.cut(node, other, at=step, symmetric=True)
@@ -195,8 +203,9 @@ class PartitionPlan:
 
     def apply_heal(self, src: str, dst: str, symmetric: bool = False) -> None:
         """Heal now (wall-clock callers: bench windows)."""
-        self.heal(src, dst, at=self.step, symmetric=symmetric)
-        self.advance(self.step)
+        step = self._current_step()
+        self.heal(src, dst, at=step, symmetric=symmetric)
+        self.advance(step)
 
     def heal_all(self, step: Optional[int] = None) -> list[dict]:
         """Schedule-and-apply a heal of every currently-cut link (scenario

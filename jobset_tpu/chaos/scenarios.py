@@ -956,8 +956,7 @@ class PartitionHarness:
         deadline = _t.monotonic() + deadline_s
         while _t.monotonic() < deadline:
             coordinator = replica.coordinator
-            if coordinator is None or coordinator.lost_quorum \
-                    or coordinator.fenced:
+            if coordinator is None or any(coordinator.health_flags()):
                 return
             _t.sleep(0.02)
         raise RuntimeError("quorum loss never observed")

@@ -277,7 +277,9 @@ def _build_parser() -> argparse.ArgumentParser:
         "lint",
         help="run the invariant lint plane (docs/static-analysis.md): "
              "AST rules enforcing the determinism, locking, jit-bucket, "
-             "and durability contracts",
+             "and durability contracts, plus the whole-tree race rules "
+             "(RACE001-003: inferred guarded-by, global lock-graph "
+             "cycles/order, thread escape)",
     )
     lint.add_argument(
         "paths", nargs="*", metavar="PATH",
@@ -296,8 +298,9 @@ def _build_parser() -> argparse.ArgumentParser:
     )
     lint.add_argument(
         "--stats", action="store_true",
-        help="print per-rule finding + suppression counts as JSON (the "
-             "lint-debt block debug bundles carry)",
+        help="print per-rule finding + suppression counts and per-rule "
+             "wall timing as JSON (the lint-debt block debug bundles "
+             "carry)",
     )
     lint.add_argument(
         "--update-baseline", action="store_true",
@@ -1151,6 +1154,8 @@ def _cmd_lint(args) -> int:
     """`jobset-tpu lint [PATHS]`: run the AST rule engine, print one
     `RULE path:line message` per visible finding, exit non-zero when any
     remain (docs/static-analysis.md)."""
+    import pathlib
+
     from .analysis import (
         default_baseline_path,
         find_repo_root,
@@ -1159,6 +1164,22 @@ def _cmd_lint(args) -> int:
     )
 
     root = find_repo_root()
+    if args.paths:
+        # The nearest ancestor of the first PATH that contains a
+        # jobset_tpu/ package is the lint root, so linting a mini-repo
+        # (`jobset-tpu lint tests/fixtures/lint/race`, or one file
+        # inside it) scopes the whole-tree rules (RACE001-003, drift)
+        # to THAT tree — it fails the same way the fixture self-tests
+        # do, instead of silently scanning the installed package. For
+        # paths inside the real repo this resolves to the repo root as
+        # before.
+        candidate = pathlib.Path(args.paths[0]).resolve()
+        if candidate.is_file():
+            candidate = candidate.parent
+        for probe in (candidate, *candidate.parents):
+            if (probe / "jobset_tpu").is_dir():
+                root = probe
+                break
     baseline_path = args.baseline or default_baseline_path(root)
 
     if args.update_baseline:

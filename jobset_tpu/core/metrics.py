@@ -29,7 +29,7 @@ class Counter:
         self.name = name
         self.help = help_text
         self.label_names = label_names
-        self._values: dict[tuple, float] = defaultdict(float)
+        self._values: dict[tuple, float] = defaultdict(float)  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def inc(self, *labels, amount: float = 1.0) -> None:
@@ -56,7 +56,7 @@ class Gauge:
         self.name = name
         self.help = help_text
         self.label_names = label_names
-        self._values: dict[tuple, float] = {}
+        self._values: dict[tuple, float] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def set(self, value: float, *labels) -> None:
@@ -82,18 +82,18 @@ class Histogram:
         self.name = name
         self.help = help_text
         self.buckets = [0.001 * (2 ** (i / 2)) for i in range(num_buckets)]
-        self.counts = [0] * (num_buckets + 1)
-        self.sum = 0.0
-        self.n = 0
+        self.counts = [0] * (num_buckets + 1)  # guarded-by: _lock
+        self.sum = 0.0  # guarded-by: _lock
+        self.n = 0  # guarded-by: _lock
         # Optional raw-sample recording (enable_raw): the bucket ladder's
         # ~41% quantization made bench p99s bit-identical across modes
         # (VERDICT r2 weak #4); benchmarks need exact percentiles.
-        self.raw: list[float] | None = None
+        self.raw: list[float] | None = None  # guarded-by: _lock
         # Per-bucket exemplars: bucket index -> (trace_id, value, unix_ts).
         # Only observations made under an active trace are recorded, so the
         # exposition can link a latency bucket to the trace that landed
         # there (OpenMetrics exemplar semantics).
-        self.exemplars: dict[int, tuple[str, float, float]] = {}
+        self.exemplars: dict[int, tuple[str, float, float]] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def enable_raw(self) -> None:
@@ -126,12 +126,20 @@ class Histogram:
 
     def percentile(self, q: float) -> float:
         """Approximate percentile from bucket counts (upper bucket bound),
-        the way Prometheus histogram_quantile works — bounded memory."""
-        if self.n == 0:
+        the way Prometheus histogram_quantile works — bounded memory.
+        Snapshots under the lock: /debug/slo calls this from a handler
+        thread while the reconcile pump is mid-observe(), and a torn
+        (counts, n) read walks the CDF against the wrong total — the
+        Counter.value() unlocked-read bug, rediscovered here by the race
+        plane (RACE001 + RaceHarness, docs/static-analysis.md)."""
+        with self._lock:
+            counts = list(self.counts)
+            n = self.n
+        if n == 0:
             return math.nan
-        target = q * self.n
+        target = q * n
         cumulative = 0
-        for i, count in enumerate(self.counts):
+        for i, count in enumerate(counts):
             cumulative += count
             if cumulative >= target:
                 return self.buckets[i] if i < len(self.buckets) else math.inf
@@ -551,15 +559,20 @@ def jobset_failed(namespaced_name: str) -> None:
 
 
 def reset() -> None:
-    """Test helper: clear all metric state."""
+    """Test helper: clear all metric state. Takes each metric's lock —
+    suites reset between cases while a previous case's server threads
+    may still be draining an inc()/observe()."""
     for counter in ALL_COUNTERS:
-        counter._values.clear()
+        with counter._lock:
+            counter._values.clear()
     for gauge in ALL_GAUGES:
-        gauge._values.clear()
+        with gauge._lock:
+            gauge._values.clear()
     for hist in ALL_HISTOGRAMS:
-        hist.counts = [0] * len(hist.counts)
-        hist.sum = 0.0
-        hist.n = 0
-        hist.exemplars.clear()
-        if hist.raw is not None:
-            hist.raw = []
+        with hist._lock:
+            hist.counts = [0] * len(hist.counts)
+            hist.sum = 0.0
+            hist.n = 0
+            hist.exemplars.clear()
+            if hist.raw is not None:
+                hist.raw = []

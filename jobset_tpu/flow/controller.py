@@ -110,9 +110,9 @@ class FlowController:
         self._levels = {
             lv.name: _LevelState(lv) for lv in (levels or DEFAULT_LEVELS)
         }
-        self._arrivals = 0
-        self._rejected: dict[tuple[str, str], int] = {}
-        self.log: list[dict] = []
+        self._arrivals = 0  # guarded-by: _lock
+        self._rejected: dict[tuple[str, str], int] = {}  # guarded-by: _lock
+        self.log: list[dict] = []  # guarded-by: _lock
 
     # -- admission --------------------------------------------------------
 

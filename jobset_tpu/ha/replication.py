@@ -198,9 +198,9 @@ class FollowerLog:
             self.last_entry_term = max(
                 self.last_entry_term, int(last_entry_term)
             )
-            self._persist_meta()
+            self._persist_meta_locked()
 
-    def _persist_meta(self, fsync: bool = True) -> None:
+    def _persist_meta_locked(self, fsync: bool = True) -> None:
         """Durably record (term, commitSeq). The TERM must survive a crash
         (Raft persists currentTerm for the same reason: a rejoining
         replica must keep rejecting leaders it already fenced out); the
@@ -256,7 +256,7 @@ class FollowerLog:
                 }
             if term > self.term:
                 self.term = int(term)
-                self._persist_meta()
+                self._persist_meta_locked()
             for entry in sorted(entries, key=lambda e: e["seq"]):
                 seq = int(entry["seq"])
                 if seq <= self.last_seq:
@@ -322,7 +322,7 @@ class FollowerLog:
                 }
             if term > self.term:
                 self.term = int(term)
-                self._persist_meta()
+                self._persist_meta_locked()
             write_snapshot_file(self.data_dir, doc)
             self.wal.reset()
             self.records = []
@@ -331,7 +331,7 @@ class FollowerLog:
             self.last_seq = self.snapshot_seq
             self.last_entry_term = self._snapshot_last_term
             self.commit_seq = max(self.commit_seq, self.snapshot_seq)
-            self._persist_meta()
+            self._persist_meta_locked()
             return {
                 "ok": True, "term": self.term, "lastSeq": self.last_seq,
             }
@@ -454,28 +454,37 @@ class FollowerLog:
             self._snapshot_last_term = last_term
             if not tail:
                 self.last_entry_term = max(self.last_entry_term, last_term)
-            self._persist_meta()
+            self._persist_meta_locked()
             return True
 
     # -- lifecycle ----------------------------------------------------------
 
     def close(self) -> None:
-        """Release the directory for promotion (Store re-opens it)."""
-        try:
-            self._persist_meta()
-        except OSError:
-            pass
-        self.wal.close()
-        if self._lock_fd is not None:
-            os.close(self._lock_fd)
-            self._lock_fd = None
+        """Release the directory for promotion (Store re-opens it).
+        Locked: the supervisor's promotion path closes this mirror while
+        a straggling leader RPC may still be inside append_entries."""
+        with self._lock:
+            try:
+                self._persist_meta_locked()
+            except OSError:
+                pass
+            self.wal.close()
+            if self._lock_fd is not None:
+                os.close(self._lock_fd)
+                self._lock_fd = None
 
     def hard_kill(self) -> None:
-        """Crash simulation: drop the fds with no flush (kill -9)."""
-        self.wal.abandon()
-        if self._lock_fd is not None:
-            os.close(self._lock_fd)
-            self._lock_fd = None
+        """Crash simulation: drop the fds with no flush (kill -9) —
+        deliberately WITHOUT _lock. A real SIGKILL does not wait for a
+        mutex; serializing here would keep the simulated crash from
+        ever landing inside an in-flight append's window, which is the
+        exact interleaving the torn-write/rejoin chaos tests exist to
+        cover."""
+        # jslint: disable=RACE001 crash simulation: kill -9 must not take _lock — tearing mid-append is the point
+        wal, fd, self._lock_fd = self.wal, self._lock_fd, None
+        wal.abandon()
+        if fd is not None:
+            os.close(fd)
 
 
 # ---------------------------------------------------------------------------
@@ -718,12 +727,20 @@ class ReplicationCoordinator:
         # lock while a rejoining peer's catch-up fetch reads from an HTTP
         # handler thread.
         self._buffer_lock = threading.Lock()
-        self._buffer: deque = deque(maxlen=self.RESEND_BUFFER)
+        self._buffer: deque = deque(maxlen=self.RESEND_BUFFER)  # guarded-by: _buffer_lock
         self._peer_next: dict[str, Optional[int]] = {}
         self._peer_acked: dict[str, int] = {}
-        self.fenced = False
-        self.lost_quorum = False
-        self._quorum_failures = 0
+        # Step-down flags get their own LEAF lock, never held across a
+        # peer call: the commit path writes them while holding the
+        # cluster lock and shipping to peers, and handler/pump threads
+        # read them — guarding them with the cluster lock instead would
+        # let two in-process leaders (dual-leader heal window, LocalPeer
+        # transport) deadlock hold-and-wait on each other's cluster
+        # locks inside append_entries.
+        self._flags_lock = threading.Lock()
+        self.fenced = False  # guarded-by: _flags_lock
+        self.lost_quorum = False  # guarded-by: _flags_lock
+        self._quorum_failures = 0  # guarded-by: _flags_lock
         # Read-fence freshness window (docs/ha.md "Consistency
         # guarantees"): a read is served only when a majority of
         # replicas was contacted within this many seconds — else the
@@ -744,6 +761,11 @@ class ReplicationCoordinator:
         # timeout could expire the lease and force a spurious stepdown
         # of a quorate leader. catch_up/ship keep the full peer timeout.
         self.probe_timeout_s = 1.0
+
+    def _mark_fenced(self) -> None:
+        """A probe/ack revealed a higher term: fence this leader."""
+        with self._flags_lock:
+            self.fenced = True
 
     @property
     def cluster_size(self) -> int:
@@ -782,7 +804,7 @@ class ReplicationCoordinator:
             if next_seq is None:
                 pos = peer.position()
                 if int(pos.get("term", 0)) > self.term:
-                    self.fenced = True
+                    self._mark_fenced()
                     return False
                 # First contact (or contact after a failure): the peer's
                 # lastSeq alone cannot be trusted past OUR commit index —
@@ -825,7 +847,7 @@ class ReplicationCoordinator:
                     # carrying its own LOWER term — and must not scare
                     # the legitimate leader into stepping down.
                     if int(resp.get("term", 0)) > self.term:
-                        self.fenced = True
+                        self._mark_fenced()
                     self._peer_next[peer.id] = None
                     return False
                 next_seq = int(resp["lastSeq"]) + 1
@@ -838,7 +860,7 @@ class ReplicationCoordinator:
             )
             if not resp.get("ok"):
                 if int(resp.get("term", 0)) > self.term:
-                    self.fenced = True
+                    self._mark_fenced()
                 # gap / append-failed: force a fresh position probe next
                 # ship — the probe's log-matching rule decides where to
                 # resend from (the raw reported lastSeq could include a
@@ -881,17 +903,20 @@ class ReplicationCoordinator:
                 acks += 1
             lag = entry["seq"] - self._peer_acked.get(peer.id, 0)
             metrics.ha_follower_lag_records.set(max(0, lag), peer.id)
-        quorum = acks >= self.majority and not self.fenced
+        with self._flags_lock:
+            quorum = acks >= self.majority and not self.fenced
+            if quorum:
+                self._quorum_failures = 0
+                self.lost_quorum = False
+            else:
+                self._quorum_failures += 1
+                if self._quorum_failures >= self.stepdown_after:
+                    self.lost_quorum = True
         if quorum:
             self.store.mark_committed(entry["seq"])
             metrics.ha_commit_seq.set(self.store.commit_seq)
-            self._quorum_failures = 0
-            self.lost_quorum = False
         else:
-            self._quorum_failures += 1
             metrics.ha_quorum_failures_total.inc()
-            if self._quorum_failures >= self.stepdown_after:
-                self.lost_quorum = True
         return quorum
 
     # -- introspection / catch-up source ------------------------------------
@@ -924,9 +949,13 @@ class ReplicationCoordinator:
         """A leader is not a follower: an append from a SMALLER-or-equal
         term is a deposed peer to be fenced; a LARGER term means we are
         the deposed one — refuse and mark ourselves fenced so the server
-        steps down."""
+        steps down. The fence flag goes through its leaf lock, NOT the
+        cluster lock: this runs on an HTTP handler thread, and taking
+        the cluster lock here while a dual leader's commit thread holds
+        its own and ships to us (LocalPeer) would deadlock hold-and-wait
+        across the two replicas."""
         if int(term) > self.term:
-            self.fenced = True
+            self._mark_fenced()
         return {
             "ok": False, "reason": "stale-term",
             "term": self.term,
@@ -952,14 +981,28 @@ class ReplicationCoordinator:
                 return {"entries": [], "deferred": True}
             return {"snapshot": self.store.snapshot_doc(), "entries": []}
 
+    def health_flags(self) -> tuple[bool, bool]:
+        """(fenced, lost_quorum) under their leaf lock: the pump
+        thread's step-down check races the commit path's writes to
+        these flags (found by the dynamic lockset harness under the
+        leader-kill scenario; tests/test_race_harness.py pins the
+        fix)."""
+        with self._flags_lock:
+            return self.fenced, self.lost_quorum
+
     def follower_lag(self) -> dict[str, int]:
         """Leader's view of each follower's lag in records (0 = caught
-        up; 'unknown' peers have never acked)."""
-        head = self.store.seq if self.store else 0
-        return {
-            peer.id: head - self._peer_acked.get(peer.id, 0)
-            for peer in self.peers
-        }
+        up; 'unknown' peers have never acked). Under the store guard:
+        /debug/health reads this from a handler thread while the commit
+        path's _ship() advances _peer_acked under the cluster lock — the
+        unguarded read was found by the dynamic lockset harness
+        (tests/test_race_harness.py pins the fix)."""
+        with self._store_guard():
+            head = self.store.seq if self.store else 0
+            return {
+                peer.id: head - self._peer_acked.get(peer.id, 0)
+                for peer in self.peers
+            }
 
     # -- quorum freshness (the read fence's ReadIndex analog) ----------------
 
@@ -974,7 +1017,8 @@ class ReplicationCoordinator:
         cluster (docs/ha.md "Consistency guarantees")."""
         import time as _t
 
-        if self.fenced or self.lost_quorum:
+        fenced, lost_quorum = self.health_flags()
+        if fenced or lost_quorum:
             return False
         max_age = self.read_fence_age_s if max_age_s is None else max_age_s
         now = _t.monotonic()
@@ -994,7 +1038,7 @@ class ReplicationCoordinator:
             except Exception:
                 continue
             if int(pos.get("term", 0)) > self.term:
-                self.fenced = True
+                self._mark_fenced()
                 return False
             fresh += 1
             if fresh >= self.majority:
@@ -1013,7 +1057,8 @@ class ReplicationCoordinator:
         a higher term still fences on the spot."""
         import time as _t
 
-        if self.fenced or self.lost_quorum:
+        fenced, lost_quorum = self.health_flags()
+        if fenced or lost_quorum:
             return
         # Refresh HALF a window before the tighter of the two consumers
         # (suspicion threshold, read-fence freshness): background
@@ -1054,7 +1099,7 @@ class ReplicationCoordinator:
                 continue
             self._heartbeat_retry.pop(peer.id, None)
             if int(pos.get("term", 0)) > self.term:
-                self.fenced = True
+                self._mark_fenced()
                 return
 
     def contact_report(self) -> dict[str, dict]:

@@ -168,8 +168,8 @@ class ReplicaSet:
         current = self.leader()
         if current is not None:
             coordinator = current.coordinator
-            if coordinator is not None and (
-                coordinator.lost_quorum or coordinator.fenced
+            if coordinator is not None and any(
+                coordinator.health_flags()
             ):
                 # A leader that stepped down (quorum lost / fenced) still
                 # has a serving HTTP surface; without demotion it would

@@ -35,9 +35,11 @@ BUNDLE_FORMAT = 1
 # members (load_bundle REJECTS unknown majors — the policy plane's corpus
 # builder needs a stable contract across controller generations); minor
 # bumps are additive (1.1 added per-timeline `placements` records; 1.2
-# added the manifest `lint` block).
+# added the manifest `lint` block; 1.3 added the race-rule counts
+# (RACE001-003) and per-rule `timingMs` inside that block — the race-
+# detection plane's debt is now part of every postmortem).
 # Bundles written before the stamp existed are treated as "1.0".
-BUNDLE_SCHEMA_VERSION = "1.2"
+BUNDLE_SCHEMA_VERSION = "1.3"
 
 _JSON_MEMBERS = (
     "manifest.json",

@@ -386,16 +386,21 @@ class RemoteAssignmentSolver:
                 for reply in responses:
                     replies.put(reply)
             except Exception as exc:  # stream broke; unblock the waiter
-                # Remember the error on the owner too: a break with no
-                # waiter in flight would otherwise vanish into the dead
-                # queue and leave the NEXT fallback unattributable. Only
-                # while this stream is still the live one — the CANCELLED
-                # that follows a deliberate teardown must not overwrite
-                # the specific error that caused it.
-                if solver._channel is this_channel:
-                    solver.last_error = exc
-                    solver.last_error_reason = _error_reason(exc)
+                # Unblock FIRST: a waiter inside _roundtrip holds _lock
+                # while parked on replies.get, so the lock below cannot
+                # be taken until it drains this very exception.
                 replies.put(exc)
+                # Then remember the error on the owner too: a break with
+                # no waiter in flight would otherwise vanish into the
+                # dead queue and leave the NEXT fallback unattributable.
+                # Under _lock, and only while this stream is still the
+                # live one — the CANCELLED echo of a deliberate teardown
+                # (which nulls _channel under the same lock) must not
+                # overwrite the specific error that caused it.
+                with solver._lock:
+                    if solver._channel is this_channel:
+                        solver.last_error = exc
+                        solver.last_error_reason = _error_reason(exc)
 
         self._reader = threading.Thread(target=drain, daemon=True)
         self._reader.start()
@@ -475,16 +480,20 @@ class RemoteAssignmentSolver:
         ) as grpc_span:
             if not self.breaker.allow():
                 # OPEN: no dial, no connect latency — straight to local.
+                # The last-error read takes the lock: the stream drain
+                # thread records transport errors under it.
+                with self._lock:
+                    last_reason = self.last_error_reason or "unknown"
                 if not self._fallback_local:
                     raise ConnectionError(
                         f"solver breaker open for {self.address} "
-                        f"(last error: {self.last_error_reason or 'unknown'})"
+                        f"(last error: {last_reason})"
                     )
                 grpc_span.set_attribute("breaker", self.breaker.state)
                 grpc_span.set_attribute("fallback", "local")
                 grpc_span.set_attribute(
                     "fallback_reason",
-                    f"breaker_open/{self.last_error_reason or 'unknown'}",
+                    f"breaker_open/{last_reason}",
                 )
                 return self._fallback(cost, feasible, "breaker_open")
             grpc_span.set_attribute("breaker", self.breaker.state)

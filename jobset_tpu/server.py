@@ -473,7 +473,7 @@ class ControllerServer:
                         "state includes writes no majority has "
                         "acknowledged yet"
                     )
-            if not (coordinator.fenced or coordinator.lost_quorum) and \
+            if not any(coordinator.health_flags()) and \
                     coordinator.confirm_quorum():
                 return None
             reason = (
@@ -703,9 +703,14 @@ class ControllerServer:
             self.replication
             if self._replication_role() == "leader" else None
         )
-        if coordinator is not None and (
-            coordinator.fenced or coordinator.lost_quorum
-        ):
+        fenced = lost_quorum = False
+        if coordinator is not None:
+            # Guarded read (coordinator.health_flags takes the cluster
+            # lock): the commit path writes these flags from handler
+            # threads — the pump's bare read here was the race the
+            # dynamic lockset harness caught under leader-kill.
+            fenced, lost_quorum = coordinator.health_flags()
+        if fenced or lost_quorum:
             # Checked BEFORE ensure(): a broken coordinator must not
             # re-acquire the lease it just gave up (that would spin
             # terms every tick while holding off the healthy standbys).
@@ -714,7 +719,7 @@ class ControllerServer:
             if self.elector is not None and self.elector.is_leading:
                 logger.warning(
                     "stepping down: %s",
-                    "fenced by a higher term" if coordinator.fenced
+                    "fenced by a higher term" if fenced
                     else "quorum lost",
                 )
                 self.elector.release()
@@ -727,7 +732,7 @@ class ControllerServer:
             # link, never an idle one. A probe revealing a higher term
             # fences; the next round's fenced branch then steps down.
             coordinator.heartbeat()
-            if coordinator.fenced:
+            if coordinator.health_flags()[0]:
                 return False
         self.pump()
         return True
@@ -1876,7 +1881,8 @@ class ControllerServer:
             store = getattr(cluster, "store", None)
             lag = coordinator.follower_lag()
             behind = {p: n for p, n in lag.items() if n > 0}
-            healthy = not (coordinator.lost_quorum or coordinator.fenced)
+            fenced, lost_quorum = coordinator.health_flags()
+            healthy = not (lost_quorum or fenced)
             # Per-peer last-contact ages + partition suspicion: a cut
             # link shows up here (partitionSuspected=true on that peer)
             # BEFORE quorum loss or failover fires, so operators can
@@ -1900,9 +1906,9 @@ class ControllerServer:
                 "partitionSuspected": suspected,
                 "message": (
                     ("FENCED by a higher term; stepping down"
-                     if coordinator.fenced else
+                     if fenced else
                      "quorum LOST: writes are not being acknowledged as "
-                     "committed" if coordinator.lost_quorum else
+                     "committed" if lost_quorum else
                      f"partition suspected on link(s) to "
                      f"{', '.join(suspected)}" if suspected else
                      f"{len(behind)} follower(s) behind" if behind else

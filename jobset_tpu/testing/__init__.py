@@ -1,3 +1,11 @@
 from .wrappers import make_jobset, make_replicated_job, test_pod_spec
 
-__all__ = ["make_jobset", "make_replicated_job", "test_pod_spec"]
+__all__ = [
+    "make_jobset",
+    "make_replicated_job",
+    "test_pod_spec",
+    # The dynamic lockset checker lives in .race (imported lazily by
+    # consumers — it monkey-patches threading primitives on entry, so
+    # nothing here should pull it in as an import side effect):
+    # from jobset_tpu.testing.race import RaceHarness
+]

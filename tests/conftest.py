@@ -20,3 +20,21 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def race_harness():
+    """Run the test body under the dynamic lockset checker
+    (jobset_tpu/testing/race.py, docs/static-analysis.md). Construct
+    the system under test INSIDE the test so its locks are tracked;
+    the fixture raises RaceError with both stacks if any watched
+    access's candidate lockset went empty."""
+    from jobset_tpu.testing.race import RaceError, RaceHarness
+
+    harness = RaceHarness(raise_on_exit=False)
+    with harness:
+        yield harness
+    if harness.races():
+        raise RaceError(harness.races())

@@ -1,0 +1,43 @@
+"""RACE001/RACE003 positive fixture (tests/test_lint.py pins lines)."""
+
+import threading
+
+
+class Telemetry:
+    """RACE001: `count` is written under _lock in record() but touched
+    bare elsewhere — the Counter.value() unlocked-read shape."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def record(self):
+        with self._lock:
+            self.count += 1
+
+    def snapshot(self):
+        return self.count  # line 19: RACE001 (bare read)
+
+    def drain(self):
+        self.count = 0  # line 22: RACE001 (bare write)
+
+
+class Pump:
+    """RACE003: `ticks` is written lock-free on the pump thread and
+    read lock-free from stats() — no locking discipline at all, so
+    RACE001 has nothing to infer from."""
+
+    def __init__(self):
+        self.ticks = 0
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop)
+        self._thread.start()
+
+    def _loop(self):
+        for _ in range(1000):
+            self.ticks += 1  # line 40: RACE003 (entry-side bare write)
+
+    def stats(self):
+        return self.ticks

@@ -1,0 +1,64 @@
+"""RACE negative fixture: every sanctioned shape stays silent."""
+
+import threading
+
+
+class LockedTelemetry:
+    """Same state as bad.Telemetry, disciplined: all clean."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self.count = 1  # __init__ is exempt (no other thread yet)
+
+    def record(self):
+        with self._lock:
+            self.count += 1
+
+    def snapshot(self):
+        with self._lock:
+            return self.count
+
+    def _bump_locked(self):
+        self.count += 1  # *_locked: the caller holds the lock
+
+
+class GuardedPump:
+    """Thread-shared state locked on both sides; the stop flag is a
+    threading primitive (its own synchronization)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.ticks = 0
+        self.stop = threading.Event()
+
+    def start(self):
+        threading.Thread(target=self._loop).start()
+
+    def _loop(self):
+        while not self.stop.is_set():
+            with self._lock:
+                self.ticks += 1
+
+    def stats(self):
+        with self._lock:
+            return self.ticks
+
+
+class ConfinedPump:
+    """Thread-confined counter (never touched off the pump thread) and
+    read-only config sharing: both clean."""
+
+    def __init__(self, interval):
+        self.interval = interval
+        self.spins = 0
+
+    def start(self):
+        threading.Thread(target=self._loop).start()
+
+    def _loop(self):
+        for _ in range(self.interval):
+            self.spins += 1
+
+    def describe(self):
+        return self.interval  # read-only sharing of init-time state

@@ -1,0 +1,20 @@
+"""RACE002 cycle fixture, half A (see ha/shipper.py for half B)."""
+
+import threading
+
+from ..ha.shipper import Shipper
+
+
+class Relay:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.shipper = Shipper(self)
+
+    def push(self, item):
+        with self._lock:
+            self.shipper.ship(item)  # line 15: RACE002 (cycle member:
+            # _lock held, call edge acquires Shipper._buffer_lock)
+
+    def offer(self, batch):
+        with self._lock:
+            return len(batch)

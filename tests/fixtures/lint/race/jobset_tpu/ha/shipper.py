@@ -1,0 +1,21 @@
+"""RACE002 cycle fixture, half B (see core/relay.py for half A)."""
+
+import threading
+
+
+class Shipper:
+    def __init__(self, relay):
+        self._buffer_lock = threading.Lock()
+        self.relay = relay
+        self.buffer = []
+
+    def ship(self, item):
+        with self._buffer_lock:
+            self.buffer.append(item)
+
+    def flush(self):
+        with self._buffer_lock:
+            return self.relay.offer(self.buffer)  # line 18: RACE002
+            # (_buffer_lock held, call edge into Relay.offer which takes
+            # _lock: closes the cross-module cycle AND inverts the
+            # canonical rank order)

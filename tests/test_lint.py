@@ -78,9 +78,11 @@ def test_every_suppression_states_a_reason():
 def test_every_registered_rule_has_fixture_coverage():
     """Adding a rule without a fixture self-test is itself drift: each
     registered per-file rule must fire somewhere in the fixture trees
-    (project-level drift rules fire in the drift tree)."""
+    (project-level drift rules fire in the drift tree, the whole-tree
+    RACE rules in the race tree)."""
     fired: set[str] = set()
-    for tree in ("determinism", "locking", "jit", "durability", "syntax"):
+    for tree in ("determinism", "locking", "jit", "durability", "syntax",
+                 "race"):
         fired |= {f.rule for f in run_fixture(tree).findings}
     fired |= {f.rule for f in fixture_engine("drift").run([]).findings}
     registered = set(all_rules())
@@ -138,16 +140,20 @@ def test_baseline_entry_silences():
 
 
 # ---------------------------------------------------------------------------
-# Locking (LCK001/LCK002)
+# Locking (LCK001 + the RACE002 graph that replaced LCK002)
 # ---------------------------------------------------------------------------
 
 
 def test_locking_fires_on_bad():
+    """LCK001 at its annotated lines; the same-function rank inversions
+    the retired LCK002 used to flag now fire as RACE002 graph edges at
+    the same lines."""
     report = run_fixture("locking")
     lck1 = visible(report, "LCK001", "bad.py")
-    lck2 = visible(report, "LCK002", "bad.py")
+    race2 = visible(report, "RACE002", "bad.py")
     assert {f.line for f in lck1} == {12, 15, 20, 25}
-    assert {f.line for f in lck2} == {37, 42}
+    assert {f.line for f in race2} == {37, 42}
+    assert not visible(report, "LCK002"), "LCK002 is retired"
 
 
 def test_locking_clean_on_good():
@@ -155,6 +161,58 @@ def test_locking_clean_on_good():
     acquisition order are all sanctioned."""
     report = run_fixture("locking")
     assert not visible(report, path_part="good.py")
+
+
+# ---------------------------------------------------------------------------
+# Races (RACE001-003, tests/fixtures/lint/race/)
+# ---------------------------------------------------------------------------
+
+
+def test_race001_inferred_guard_fires_at_bare_accesses():
+    report = run_fixture("race")
+    race1 = visible(report, "RACE001", "core/bad.py")
+    assert {f.line for f in race1} == {19, 22}
+    assert all("Telemetry" in f.message for f in race1)
+
+
+def test_race002_cross_module_cycle_fires_on_both_edges():
+    """The deliberate Relay._lock <-> Shipper._buffer_lock cycle spans
+    two modules and exists only through call edges; both witness sites
+    fire, and the inverted edge also reports the canonical-rank
+    violation."""
+    report = run_fixture("race")
+    relay = visible(report, "RACE002", "core/relay.py")
+    shipper = visible(report, "RACE002", "ha/shipper.py")
+    assert {f.line for f in relay} == {15}
+    assert {f.line for f in shipper} == {18}
+    messages = [f.message for f in relay + shipper]
+    assert any("lock-order cycle" in m for m in messages)
+    assert any("inverts the canonical lock order" in m for m in messages)
+
+
+def test_race003_thread_escape_fires_at_entry_write():
+    report = run_fixture("race")
+    race3 = visible(report, "RACE003", "core/bad.py")
+    assert {f.line for f in race3} == {40}
+    assert "Pump._loop" in race3[0].message
+
+
+def test_race_rules_clean_on_sanctioned_shapes():
+    """Locked-on-both-sides state, *_locked helpers, __init__ writes,
+    threading primitives, thread-confined counters, and read-only
+    config sharing are all silent."""
+    report = run_fixture("race")
+    assert not visible(report, path_part="good.py")
+
+
+def test_race_teeth_static_gate_fails_on_seeded_fixture():
+    """The acceptance teeth: the seeded fixture (deliberate lock-order
+    cycle + unguarded cross-thread write) FAILS the static pass — a
+    tree-is-clean gate over it would go red."""
+    report = run_fixture("race")
+    assert {f.rule for f in report.visible} >= {
+        "RACE001", "RACE002", "RACE003"
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -333,6 +391,16 @@ def test_stats_counts_visible_and_suppressed():
         sum(row.values()) for row in stats["perRule"].values()
     )
     assert total == stats["visible"] + stats["suppressed"]
+
+
+def test_stats_carries_per_rule_timing():
+    """--stats exposes per-rule wall time so a rule that slows the gate
+    is attributable; every registered rule that ran has a row."""
+    report = run_fixture("determinism")
+    stats = report.stats()
+    timing = stats["timingMs"]
+    assert set(timing) == set(all_rules())
+    assert all(isinstance(v, float) and v >= 0 for v in timing.values())
 
 
 def test_lint_stats_entry_point_matches_gate():

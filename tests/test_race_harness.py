@@ -1,0 +1,448 @@
+"""The dynamic lockset checker's own tests (docs/static-analysis.md).
+
+Four jobs:
+
+1. **Mechanics**: the Eraser state machine detects a textbook unlocked
+   cross-thread write (with both stacks), and every modeled
+   happens-before edge — consistent locking, thread start/join,
+   Condition/Event notify→wait — suppresses the false positive it
+   exists to suppress.
+2. **Teeth** (acceptance): the same unguarded-cross-thread-write shape
+   the static fixture seeds (tests/fixtures/lint/race/) fails the
+   DYNAMIC harness too.
+3. **Regressions for real races this PR fixed**: each test reproduces
+   the PRE-fix code shape (subclass carrying the old body) and asserts
+   the harness flags it, then drives the FIXED code under the same
+   interleaving and asserts silence — the fix is load-bearing, not
+   incidental.
+4. **Chaos scenarios under the harness** (`race` marker): the fast
+   subset (thundering herd, torn-write sweep, a short leader-kill) runs
+   in tier-1; the full-size soak is additionally `slow`-marked.
+"""
+
+import math
+import threading
+
+import pytest
+
+from jobset_tpu.testing.race import RaceHarness
+
+pytestmark = pytest.mark.race
+
+
+class _Shared:
+    """Minimal watched class for mechanics tests."""
+
+    def __init__(self):
+        self.n = 0
+        self._lock = threading.Lock()
+
+
+def _run_pair(body_main, body_worker):
+    """Drive two concurrent loops; returns the harness's race list."""
+    with RaceHarness(watch={_Shared: {"n"}}, raise_on_exit=False) as rh:
+        shared = _Shared()
+        worker = threading.Thread(
+            target=lambda: body_worker(shared), name="worker"
+        )
+        worker.start()
+        body_main(shared)
+        worker.join()
+    return rh.races(), rh
+
+
+# ---------------------------------------------------------------------------
+# Mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_detects_unlocked_cross_thread_write_with_both_stacks():
+    def worker(s):
+        for _ in range(200):
+            s.n += 1
+
+    def main(s):
+        for _ in range(200):
+            s.n += 1
+
+    races, rh = _run_pair(main, worker)
+    assert races, "unlocked cross-thread write must be reported"
+    report = races[0]
+    assert report.cls == "_Shared" and report.attr == "n"
+    rendered = rh.render()
+    assert "first " in rendered and "second" in rendered
+    assert "test_race_harness.py" in rendered  # real stacks, not harness frames
+
+
+def test_one_shot_unlocked_write_against_locked_readers_is_detected():
+    """Eraser demotion must intersect BOTH accesses' locksets: a single
+    lock-free write (the pre-fix `fenced = True` shape) racing
+    consistently-locked readers is exactly one demotion event — seeding
+    the candidate lockset from only the second access would miss it."""
+    with RaceHarness(watch={_Shared: {"n"}}, raise_on_exit=False) as rh:
+        s = _Shared()
+        stop = threading.Event()
+
+        def reader():
+            while not stop.is_set():
+                with s._lock:
+                    _ = s.n
+
+        t = threading.Thread(target=reader, name="locked-reader")
+        t.start()
+        for _ in range(200):
+            s.n += 1  # unlocked one-sided writes
+        stop.set()
+        t.join()
+    assert any(r.attr == "n" for r in rh.races()), rh.render()
+
+
+def test_consistent_locking_is_clean():
+    def worker(s):
+        for _ in range(200):
+            with s._lock:
+                s.n += 1
+
+    def main(s):
+        for _ in range(200):
+            with s._lock:
+                s.n += 1
+
+    races, _ = _run_pair(main, worker)
+    assert not races
+
+
+def test_start_join_happens_before_is_clean():
+    with RaceHarness(watch={_Shared: {"n"}}, raise_on_exit=False) as rh:
+        s = _Shared()
+        s.n = 7  # before start: ordered
+
+        def worker():
+            s.n += 1
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+        assert s.n == 8  # after join: ordered
+    assert not rh.races()
+
+
+def test_event_handoff_happens_before_is_clean():
+    """threading.Event is built on Condition, so set()/wait() produce
+    the notify->wait HB edge: classic publish-then-signal is clean."""
+    with RaceHarness(watch={_Shared: {"n"}}, raise_on_exit=False) as rh:
+        s = _Shared()
+        ready = threading.Event()
+
+        def producer():
+            s.n = 42
+            ready.set()
+
+        t = threading.Thread(target=producer)
+        t.start()
+        assert ready.wait(5.0)
+        assert s.n == 42  # ordered through the event
+        t.join()
+    assert not rh.races()
+
+
+def test_raises_race_error_on_exit():
+    from jobset_tpu.testing.race import RaceError
+
+    with pytest.raises(RaceError) as excinfo:
+        with RaceHarness(watch={_Shared: {"n"}}):
+            s = _Shared()
+
+            def worker():
+                for _ in range(200):
+                    s.n += 1
+
+            t = threading.Thread(target=worker)
+            t.start()
+            for _ in range(200):
+                s.n += 1
+            t.join()
+    assert "_Shared.n" in str(excinfo.value)
+
+
+def test_ignore_silences_known_findings():
+    def worker(s):
+        for _ in range(50):
+            s.n += 1
+
+    with RaceHarness(
+        watch={_Shared: {"n"}},
+        ignore={("_Shared", "n")},
+        raise_on_exit=False,
+    ) as rh:
+        s = _Shared()
+        t = threading.Thread(target=lambda: worker(s))
+        t.start()
+        for _ in range(50):
+            s.n += 1
+        t.join()
+    assert not rh.races()
+
+
+# ---------------------------------------------------------------------------
+# Teeth: the seeded dynamic shape fails the harness
+# ---------------------------------------------------------------------------
+
+
+class _SeededPump:
+    """The dynamic twin of tests/fixtures/lint/race/ core/bad.py::Pump
+    (unguarded cross-thread write): RACE003 statically, a lockset-empty
+    write here."""
+
+    def __init__(self):
+        self.ticks = 0
+        self.stop = threading.Event()
+
+    def start(self):
+        thread = threading.Thread(target=self._loop, name="pump")
+        thread.start()
+        return thread
+
+    def _loop(self):
+        while not self.stop.is_set():
+            self.ticks += 1
+
+    def stats(self):
+        return self.ticks
+
+
+def test_race_teeth_dynamic_harness_fails_on_seeded_shape():
+    with RaceHarness(
+        watch={_SeededPump: {"ticks"}}, raise_on_exit=False
+    ) as rh:
+        pump = _SeededPump()
+        thread = pump.start()
+        total = 0
+        for _ in range(200):
+            total += pump.stats()
+        pump.stop.set()
+        thread.join()
+    assert any(
+        r.cls == "_SeededPump" and r.attr == "ticks" for r in rh.races()
+    ), "the seeded unguarded cross-thread write must fail the harness"
+
+
+# ---------------------------------------------------------------------------
+# Regressions: real races fixed in this PR
+# ---------------------------------------------------------------------------
+
+
+def _drive_histogram(hist_cls):
+    """One observer thread + a percentile-reading main thread."""
+    from jobset_tpu.core import metrics
+
+    with RaceHarness(raise_on_exit=False) as rh:
+        hist = hist_cls("race_test_seconds", "regression fixture")
+        stop = threading.Event()
+
+        def observer():
+            value = 0.001
+            while not stop.is_set():
+                hist.observe(value)
+                value = value * 1.1 if value < 1.0 else 0.001
+
+        thread = threading.Thread(target=observer, name="observer")
+        thread.start()
+        for _ in range(300):
+            hist.percentile(0.99)
+        stop.set()
+        thread.join()
+    return rh.races()
+
+
+class _PreFixHistogram:
+    """Carrier for the PRE-fix Histogram.percentile body (unlocked
+    reads of counts/n — the exact shape shipped before this PR)."""
+
+    def __new__(cls, *args, **kwargs):
+        from jobset_tpu.core import metrics
+
+        class PreFix(metrics.Histogram):
+            def percentile(self, q):
+                if self.n == 0:  # unlocked read racing observe()
+                    return math.nan
+                target = q * self.n
+                cumulative = 0
+                for i, count in enumerate(self.counts):  # unlocked read
+                    cumulative += count
+                    if cumulative >= target:
+                        return (
+                            self.buckets[i]
+                            if i < len(self.buckets) else math.inf
+                        )
+                return math.inf
+
+        return PreFix(*args, **kwargs)
+
+
+def test_histogram_percentile_regression_prefix_shape_races():
+    """/debug/slo's percentile read vs the pump's observe(): the pre-fix
+    unlocked body is flagged by the harness."""
+    races = _drive_histogram(_PreFixHistogram)
+    assert any(r.attr in ("counts", "n") for r in races), [
+        r.render() for r in races
+    ]
+
+
+def test_histogram_percentile_fixed_is_clean():
+    from jobset_tpu.core import metrics
+
+    races = _drive_histogram(metrics.Histogram)
+    assert not races, "\n".join(r.render() for r in races)
+
+
+class _StubPeer:
+    def __init__(self, peer_id):
+        self.id = peer_id
+        self.last_contact = None
+
+    def position(self, timeout=None):
+        return {"term": 0, "lastSeq": 0}
+
+    def append_entries(self, term, entries, commit_seq=0):
+        last = entries[-1]["seq"] if entries else commit_seq
+        return {"ok": True, "term": term, "lastSeq": last}
+
+    def install_snapshot(self, term, doc):
+        return {"ok": True, "term": term, "lastSeq": 0}
+
+
+class _StubCluster:
+    def __init__(self):
+        self.lock = threading.RLock()
+
+
+class _StubStore:
+    """Just enough Store surface for ReplicationCoordinator.replicate."""
+
+    def __init__(self, cluster):
+        self.cluster = cluster
+        self.seq = 0
+        self.commit_seq = 0
+        self.last_record = None
+        self.replicated = True
+        self.term = 0
+
+    def mark_committed(self, seq):
+        self.commit_seq = max(self.commit_seq, seq)
+
+    def snapshot_doc(self):
+        return {"seq": self.seq, "lastTerm": 0}
+
+
+def _drive_coordinator(coordinator_cls):
+    """Commit-path replicate() under the cluster lock on one thread,
+    /debug/health's follower_lag() on another — the real server's
+    interleaving."""
+    with RaceHarness(raise_on_exit=False) as rh:
+        cluster = _StubCluster()
+        store = _StubStore(cluster)
+        coordinator = coordinator_cls(
+            "replica-0", [_StubPeer("replica-1"), _StubPeer("replica-2")]
+        )
+        coordinator.bind(store)
+        stop = threading.Event()
+
+        def commit_path():
+            seq = 0
+            while not stop.is_set():
+                seq += 1
+                with cluster.lock:
+                    store.seq = seq
+                    coordinator.replicate(
+                        record={"seq": seq}, payload=b"{}"
+                    )
+
+        thread = threading.Thread(target=commit_path, name="commit")
+        thread.start()
+        for _ in range(300):
+            coordinator.follower_lag()
+        stop.set()
+        thread.join()
+    return rh.races()
+
+
+def test_follower_lag_regression_prefix_shape_races():
+    """The pre-fix follower_lag read _peer_acked with no guard while
+    _ship() advanced it under the cluster lock."""
+    from jobset_tpu.ha.replication import ReplicationCoordinator
+
+    class PreFixCoordinator(ReplicationCoordinator):
+        def follower_lag(self):
+            head = self.store.seq if self.store else 0  # unguarded
+            return {
+                peer.id: head - self._peer_acked.get(peer.id, 0)
+                for peer in self.peers
+            }
+
+    races = _drive_coordinator(PreFixCoordinator)
+    assert any(r.attr == "_peer_acked" for r in races), [
+        r.render() for r in races
+    ]
+
+
+def test_follower_lag_fixed_is_clean():
+    from jobset_tpu.ha.replication import ReplicationCoordinator
+
+    races = _drive_coordinator(ReplicationCoordinator)
+    assert not races, "\n".join(r.render() for r in races)
+
+
+# ---------------------------------------------------------------------------
+# Chaos scenarios under the harness
+# ---------------------------------------------------------------------------
+
+
+def test_thundering_herd_under_race_harness(tmp_path):
+    """The flow plane's acceptance storm re-run under the checker: the
+    sequential driver plus the flow/injector/metrics lock discipline
+    must produce zero lockset violations."""
+    from jobset_tpu.chaos.scenarios import thundering_herd
+
+    with RaceHarness(raise_on_exit=False) as rh:
+        report = thundering_herd(arrivals=60, tenants=3, seed=23)
+    assert report["arrivals"] > 0
+    assert not rh.races(), "\n".join(r.render() for r in rh.races())
+
+
+def test_store_torn_writes_under_race_harness(tmp_path):
+    from jobset_tpu.chaos.scenarios import store_torn_writes
+
+    with RaceHarness(raise_on_exit=False) as rh:
+        results = store_torn_writes(
+            str(tmp_path), rates=(0.0, 0.3), writes=8
+        )
+    assert all(r["lost"] == 0 and r["mismatched"] == 0 for r in results)
+    assert not rh.races(), "\n".join(r.render() for r in rh.races())
+
+
+def test_short_leader_kill_under_race_harness(tmp_path):
+    """A short HA failover — real replica servers, handler threads,
+    heartbeats — under the checker. This is the multithreaded soak
+    where the harness earns its keep in tier-1."""
+    from jobset_tpu.chaos.scenarios import leader_kill
+
+    with RaceHarness(raise_on_exit=False) as rh:
+        result = leader_kill(
+            str(tmp_path), writes=6, kill_after=3,
+            stream_latency_rate=0.0,
+        )
+    assert result["acked"], "storm must land writes"
+    assert not rh.races(), "\n".join(r.render() for r in rh.races())
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_full_leader_kill_soak_under_race_harness(tmp_path):
+    """The full-size leader-kill soak under the checker (slow set)."""
+    from jobset_tpu.chaos.scenarios import leader_kill
+
+    with RaceHarness(raise_on_exit=False) as rh:
+        result = leader_kill(str(tmp_path), writes=18, kill_after=8)
+    assert result["acked"]
+    assert not rh.races(), "\n".join(r.render() for r in rh.races())
