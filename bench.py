@@ -512,17 +512,28 @@ def run_api_mode(solver_on: bool, args) -> dict:
 
 def run_api_chaos_mode(solver_on: bool, args, rate: float, seed: int = 4,
                        splits: int = 64) -> dict:
-    """Apiserver-inclusive placement under injected faults (bench --inject).
+    """Apiserver-inclusive placement: the fast wire path vs the per-object
+    path, clean and under injected faults (bench --inject).
 
-    The cold gang arrival is split into `splits` JobSet creates so the
-    injected 503 stream has a request population to land on; the SAME
-    split shape is measured clean first, so the reported ratio isolates
-    what the faults cost (app-level create retries + client GET retries +
-    the extra admission work) rather than the split itself. Fault
-    injection is deterministic under `seed` (chaos.FaultInjector), so the
-    faulted figure is reproducible run-to-run.
+    The same 64-JobSet/4096-pod gang arrival is measured two ways:
+
+    * **batch** (the headline `clean_api_pods_per_sec`): the splits ride
+      the ``:batchCreate`` verb in ``--inject-groups`` round trips over a
+      binary-encoded keep-alive connection (docs/protocol.md) — the fast
+      wire plane this number exists to prove out.
+    * **per_object** (the historical shape): one JSON create round trip
+      per split, which is where the injected 503 stream has a request
+      population to land on — the clean-vs-faulted ratio is measured
+      here, same as every prior bank. Fault injection is deterministic
+      under `seed` (chaos.FaultInjector).
+
+    Both timed windows run with the GC frozen (the run_storm_mode
+    discipline): collector pauses were measured adding up to ~80 ms of
+    run-to-run noise at this allocation rate.
     """
-    from jobset_tpu.api import FailurePolicy
+    import gc
+
+    from jobset_tpu.api import FailurePolicy, serialization
     from jobset_tpu.chaos import FaultInjector
     from jobset_tpu.client import ApiError, JobSetClient
     from jobset_tpu.core import features, metrics
@@ -533,8 +544,27 @@ def run_api_chaos_mode(solver_on: bool, args, rate: float, seed: int = 4,
     splits = max(1, min(splits, args.replicas))
     per = max(1, args.replicas // splits)
     total_pods = splits * per * args.pods_per_job
+    groups = max(1, min(getattr(args, "inject_groups", 2), splits))
 
-    def one_pass(injector) -> tuple[float, list[float]]:
+    def build_manifests() -> list[dict]:
+        return [
+            serialization.to_dict(
+                make_jobset(f"chaos-{i}")
+                .exclusive_placement(topology_key)
+                .failure_policy(FailurePolicy(max_restarts=10))
+                .replicated_job(
+                    make_replicated_job("workers")
+                    .replicas(per)
+                    .parallelism(args.pods_per_job)
+                    .completions(args.pods_per_job)
+                    .obj()
+                )
+                .obj()
+            )
+            for i in range(splits)
+        ]
+
+    def one_pass(injector, batched: bool) -> tuple[float, list[float]]:
         metrics.reset()
         request_s: list[float] = []  # every create round trip, 503s included
         with features.gate("TPUPlacementSolver", solver_on):
@@ -548,39 +578,79 @@ def run_api_chaos_mode(solver_on: bool, args, rate: float, seed: int = 4,
                 client = JobSetClient(
                     f"http://{server.address}", timeout=900.0,
                     retries=5, retry_seed=seed,
+                    encoding="binary" if batched else "json",
                 )
-                t0 = time.perf_counter()
-                for i in range(splits):
-                    js = (
-                        make_jobset(f"chaos-{i}")
-                        .exclusive_placement(topology_key)
-                        .failure_policy(FailurePolicy(max_restarts=10))
-                        .replicated_job(
-                            make_replicated_job("workers")
-                            .replicas(per)
-                            .parallelism(args.pods_per_job)
-                            .completions(args.pods_per_job)
-                            .obj()
-                        )
-                        .obj()
-                    )
-                    for _ in range(50):
-                        # App-level create retry: injected 503s fire before
-                        # routing, so a 503'd create never landed and is
-                        # safe to resubmit (the client itself never
-                        # retries mutations).
-                        t1 = time.perf_counter()
-                        try:
-                            client.create(js)
-                            request_s.append(time.perf_counter() - t1)
-                            break
-                        except ApiError as exc:
-                            request_s.append(time.perf_counter() - t1)
-                            if exc.status != 503:
-                                raise
+                manifests = build_manifests()
+                gc.collect()
+                gc.freeze()
+                try:
+                    t0 = time.perf_counter()
+                    if batched:
+                        # Ceil split: every manifest lands in some group
+                        # even when groups does not divide splits (the
+                        # final chunks just run short/empty).
+                        per_group = -(-splits // groups)
+                        for g in range(groups):
+                            chunk = manifests[
+                                g * per_group : (g + 1) * per_group
+                            ]
+                            if not chunk:
+                                continue
+                            for _ in range(50):
+                                # Whole-batch retry: an injected 503 fires
+                                # before routing, so a 503'd batch never
+                                # landed and is safe to resubmit.
+                                t1 = time.perf_counter()
+                                try:
+                                    items = client.batch_create(
+                                        chunk, view="minimal"
+                                    )
+                                    request_s.append(
+                                        time.perf_counter() - t1
+                                    )
+                                    bad = [
+                                        i for i in items
+                                        if i["code"] != 201
+                                    ]
+                                    if bad:
+                                        raise RuntimeError(
+                                            f"batch item failed: {bad[:2]}"
+                                        )
+                                    break
+                                except ApiError as exc:
+                                    request_s.append(
+                                        time.perf_counter() - t1
+                                    )
+                                    if exc.status != 503:
+                                        raise
+                            else:
+                                raise RuntimeError(
+                                    "chaos batch retries exhausted"
+                                )
                     else:
-                        raise RuntimeError("chaos create retries exhausted")
-                elapsed = time.perf_counter() - t0
+                        for manifest in manifests:
+                            for _ in range(50):
+                                # App-level create retry (see above).
+                                t1 = time.perf_counter()
+                                try:
+                                    client.create(manifest)
+                                    request_s.append(
+                                        time.perf_counter() - t1
+                                    )
+                                    break
+                                except ApiError as exc:
+                                    request_s.append(
+                                        time.perf_counter() - t1
+                                    )
+                                    if exc.status != 503:
+                                        raise
+                            else:
+                                raise RuntimeError(
+                                    "chaos create retries exhausted"
+                                )
+                    elapsed = time.perf_counter() - t0
+                finally:
+                    gc.unfreeze()
                 with server.lock:
                     bound = sum(
                         1 for p in cluster.pods.values() if p.spec.node_name
@@ -593,29 +663,55 @@ def run_api_chaos_mode(solver_on: bool, args, rate: float, seed: int = 4,
                 server.stop()
         return elapsed, request_s
 
-    one_pass(None)  # untimed warm pass: the per-split solve shape compiles
-    # here, so the clean-vs-faulted comparison below is warm on both sides
-    clean_s, clean_lat = one_pass(None)
+    # Untimed warm passes: solve shapes and wire codecs compile/warm here,
+    # so every timed comparison below is warm on both sides.
+    one_pass(None, batched=True)
+    one_pass(None, batched=False)
+    # Median of 3 for the batched headline (the run_storm_mode
+    # discipline): at ~0.2 s per pass, single-draw scheduler noise is a
+    # visible fraction of the number being banked.
+    batch_passes = sorted(
+        (one_pass(None, batched=True) for _ in range(3)),
+        key=lambda pair: pair[0],
+    )
+    batch_s, batch_lat = batch_passes[1]
+    clean_s, clean_lat = one_pass(None, batched=False)
     injector = FaultInjector(seed=seed)
     injector.add_rule("apiserver.request", "error", status=503, rate=rate)
-    faulted_s, faulted_lat = one_pass(injector)
+    faulted_s, faulted_lat = one_pass(injector, batched=False)
     return {
         "mode": "solver" if solver_on else "greedy",
         "splits": splits,
         "pods": total_pods,
         "fault_rate": rate,
         "fault_seed": seed,
-        "clean_api_pods_per_sec": round(total_pods / clean_s, 1),
-        "faulted_api_pods_per_sec": round(total_pods / faulted_s, 1),
-        # Per-request (create round trip, 503 attempts included) latency
-        # shape — the same p50/p99 form the overload bench banks, so the
-        # fault and overload stories compare like for like.
-        "clean_request_ms": _latency_summary_ms(clean_lat),
-        "faulted_request_ms": _latency_summary_ms(faulted_lat),
-        "faults_injected": injector.injected_total(),
-        "fault_overhead_pct": round(
-            100.0 * (faulted_s / clean_s - 1.0), 1
-        ),
+        # Headline: the fast wire plane (batchCreate + binary + keep-alive).
+        # Only the batched shape lives at top level — comparing it to the
+        # per-object fault figures would read the shape difference as
+        # fault overhead, so everything per-object (clean, faulted,
+        # ratio, latencies) lives in its own sub-dict, measured on ONE
+        # consistent shape.
+        "clean_api_pods_per_sec": round(total_pods / batch_s, 1),
+        "batch": {
+            "groups": groups,
+            "encoding": "binary",
+            "clean_pods_per_sec": round(total_pods / batch_s, 1),
+            "request_ms": _latency_summary_ms(batch_lat),
+        },
+        # The historical per-object JSON shape: the clean-vs-faulted ratio
+        # is measured here, where the 503 stream has 64 arrivals to hit.
+        "per_object": {
+            "encoding": "json",
+            "clean_pods_per_sec": round(total_pods / clean_s, 1),
+            "faulted_pods_per_sec": round(total_pods / faulted_s, 1),
+            "fault_overhead_pct": round(
+                100.0 * (faulted_s / clean_s - 1.0), 1
+            ),
+            "clean_request_ms": _latency_summary_ms(clean_lat),
+            "faulted_request_ms": _latency_summary_ms(faulted_lat),
+            "faults_injected": injector.injected_total(),
+        },
+        "batch_over_per_object": round(clean_s / batch_s, 2),
     }
 
 
@@ -641,6 +737,30 @@ def _bank_sidecar_key(key: str, result: dict) -> None:
 
 
 def _bank_apiserver_inject(result: dict) -> None:
+    # Retain the displaced bank for comparison (the acceptance contract:
+    # the pre-wire-plane number must stay visible next to the new one).
+    try:
+        with open(PLACEMENT_SIDECAR) as f:
+            prior = json.load(f).get("apiserver_inject") or {}
+    except (OSError, ValueError):
+        prior = {}
+    if prior:
+        result = dict(result)
+        previous = {
+            k: prior.get(k)
+            for k in ("clean_api_pods_per_sec", "captured_at")
+            if k in prior
+        }
+        # Pre-wire-plane banks carried the faulted figure at top level;
+        # newer ones keep it under per_object (one consistent shape).
+        faulted = prior.get("faulted_api_pods_per_sec")
+        if faulted is None:
+            faulted = (prior.get("per_object") or {}).get(
+                "faulted_pods_per_sec"
+            )
+        if faulted is not None:
+            previous["faulted_pods_per_sec"] = faulted
+        result["previous"] = previous
     _bank_sidecar_key("apiserver_inject", result)
 
 
@@ -1001,10 +1121,33 @@ def run_queue_bench(args) -> dict:
             )
             cluster.create_jobset(js)
 
+        import gc
+
         with features.gate("TPUQueueScorer", gate):
-            t0 = time.perf_counter()
-            cluster.run_until_stable(max_ticks=2000)
-            admit_s = time.perf_counter() - t0
+            if gate:
+                # Compile-once warm-up OUTSIDE the timed window (the
+                # apiserver bench's warm-pass discipline): a production
+                # controller compiles its shape bucket once at startup
+                # (--queues preload calls scorer.warm), so the banked
+                # steady-state admission throughput must not charge the
+                # one-time trace+compile to the first admission pass.
+                from jobset_tpu.queue import scorer as queue_scorer
+
+                queue_scorer.warm(
+                    num_queues, 1, 8, num_workloads
+                )
+            # GC frozen through both timed windows (the run_storm_mode
+            # discipline, same for both backends): collector pauses at
+            # this allocation rate are a visible fraction of the
+            # sub-second walls being compared.
+            gc.collect()
+            gc.freeze()
+            try:
+                t0 = time.perf_counter()
+                cluster.run_until_stable(max_ticks=2000)
+                admit_s = time.perf_counter() - t0
+            finally:
+                gc.unfreeze()
             admitted = sorted(
                 wl.key[1] for wl in qm.workloads.values()
                 if wl.state == "Admitted"
@@ -1012,20 +1155,25 @@ def run_queue_bench(args) -> dict:
 
             # Preemption wave: high-priority gangs into the fullest queues;
             # measure per-pass wall time until the whole wave is admitted.
-            t0 = time.perf_counter()
-            for i in range(preempt_wave):
-                js = (
-                    make_jobset(f"hi-{i:03d}")
-                    .replicated_job(
-                        make_replicated_job("w").replicas(8)
-                        .parallelism(1).completions(1).obj()
+            gc.collect()
+            gc.freeze()
+            try:
+                t0 = time.perf_counter()
+                for i in range(preempt_wave):
+                    js = (
+                        make_jobset(f"hi-{i:03d}")
+                        .replicated_job(
+                            make_replicated_job("w").replicas(8)
+                            .parallelism(1).completions(1).obj()
+                        )
+                        .queue(f"q{i % num_queues:02d}", priority=100)
+                        .obj()
                     )
-                    .queue(f"q{i % num_queues:02d}", priority=100)
-                    .obj()
-                )
-                cluster.create_jobset(js)
-            cluster.run_until_stable(max_ticks=2000)
-            preempt_wall_s = time.perf_counter() - t0
+                    cluster.create_jobset(js)
+                cluster.run_until_stable(max_ticks=2000)
+                preempt_wall_s = time.perf_counter() - t0
+            finally:
+                gc.unfreeze()
             hi_admitted = sum(
                 1 for wl in qm.workloads.values()
                 if wl.state == "Admitted" and wl.key[1].startswith("hi-")
@@ -1077,33 +1225,64 @@ def run_restart_bench(args) -> dict:
     from jobset_tpu.store import Store
     from jobset_tpu.testing import make_jobset, make_replicated_job
 
-    def measure(n_jobsets: int, commit_every: int = 100) -> dict:
+    def measure(n_jobsets: int, batch_size: int = 0) -> dict:
+        from jobset_tpu.api import serialization
+        from jobset_tpu.client import JobSetClient
+        from jobset_tpu.server import ControllerServer
+
+        # ~12 batches at any size: enough commits to cross the snapshot
+        # cadence below (the measured restart must be snapshot + short
+        # WAL tail), few enough that the O(objects) per-commit diff stays
+        # a small fraction of the build.
+        if batch_size <= 0:
+            batch_size = max(64, n_jobsets // 12)
         data_dir = tempfile.mkdtemp(prefix="jobset-restart-bench-")
         try:
             cluster = make_cluster()
             # Snapshot cadence chosen so compaction actually happens within
-            # the run's ~n/commit_every commits: the measured restart is a
+            # the run's ~n/batch_size commits: the measured restart is a
             # snapshot load + a short WAL tail — the steady-state shape an
             # operator pays for — not WAL-only replay.
             store = Store(data_dir, snapshot_interval=8)
             store.recover(cluster)
-            t0 = time.perf_counter()
-            for i in range(n_jobsets):
-                cluster.create_jobset(
-                    make_jobset(f"wl-{i:05d}")
-                    .replicated_job(
-                        make_replicated_job("w").replicas(1)
-                        .parallelism(1).completions(1).obj()
-                    )
-                    .suspend(True)
-                    .obj()
+            # Population builds through the REAL write path — the server's
+            # :batchCreate verb over a binary keep-alive connection
+            # (docs/protocol.md) — so every batch is one round trip, one
+            # reconcile, and ONE fsync'd WAL commit. The old builder
+            # committed every 100 direct creates, and each commit re-diffs
+            # the whole object population: 10k jobsets spent 151 s
+            # building state around the 3.5 s recovery being measured.
+            server = ControllerServer(
+                cluster=cluster, tick_interval=30.0
+            ).start()
+            try:
+                client = JobSetClient(
+                    f"http://{server.address}", timeout=900.0,
+                    encoding="binary",
                 )
-                if (i + 1) % commit_every == 0:
-                    cluster.run_until_stable(max_ticks=2000)
-                    store.commit()
-            cluster.run_until_stable(max_ticks=2000)
-            store.commit()
-            build_s = time.perf_counter() - t0
+                t0 = time.perf_counter()
+                for start in range(0, n_jobsets, batch_size):
+                    batch = [
+                        serialization.to_dict(
+                            make_jobset(f"wl-{i:05d}")
+                            .replicated_job(
+                                make_replicated_job("w").replicas(1)
+                                .parallelism(1).completions(1).obj()
+                            )
+                            .suspend(True)
+                            .obj()
+                        )
+                        for i in range(
+                            start, min(start + batch_size, n_jobsets)
+                        )
+                    ]
+                    items = client.batch_create(batch, view="minimal")
+                    bad = [i for i in items if i["code"] != 201]
+                    if bad:
+                        raise RuntimeError(f"batch item failed: {bad[:2]}")
+                build_s = time.perf_counter() - t0
+            finally:
+                server.stop()
             wal_bytes = store.wal.size
             total_objects = store.object_count()
             snapshot_written = os.path.exists(
@@ -1141,6 +1320,222 @@ def run_restart_bench(args) -> dict:
 
 def _bank_restart(result: dict) -> None:
     _bank_sidecar_key("restart", result)
+
+
+def run_wire_bench(args) -> dict:
+    """Fast-wire-plane microbench (bench --wire, docs/protocol.md):
+
+    * per-kind encode/decode ns/object for both wire encodings — the
+      store codec dicts through canonical JSON vs the binary frame — so
+      the next re-anchor can see the encoding cost separately from the
+      batching win;
+    * end-to-end round-trip pods/s through a real server for the 2x2 of
+      {per-object, batched} x {json, binary} on a 256-gang population
+      (1-pod gangs, greedy placement: the wire is the variable, not the
+      solver);
+    * storm-dispatch residency: repeated 8-problem vmapped rounds at the
+      banked 512x960 shape — host-side dispatch overhead per problem
+      with the device-resident operand cache (banked separately under
+      `storm_residency`).
+    """
+    import gc
+    import statistics
+
+    import numpy as np
+
+    from jobset_tpu import wire
+    from jobset_tpu.api import serialization
+    from jobset_tpu.client import JobSetClient
+    from jobset_tpu.core import make_cluster, metrics
+    from jobset_tpu.queue import Queue
+    from jobset_tpu.queue.manager import Workload
+    from jobset_tpu.server import ControllerServer
+    from jobset_tpu.store import codec
+    from jobset_tpu.testing import make_jobset, make_replicated_job
+
+    # -- (a) per-kind codec ns/object ----------------------------------
+    cluster = make_cluster()
+    cluster.add_node("wire-node-0", labels={"tpu-slice": "s0"}, capacity=16)
+    js = (
+        make_jobset("wire-sample")
+        .replicated_job(
+            make_replicated_job("w").replicas(2)
+            .parallelism(2).completions(2).obj()
+        )
+        .obj()
+    )
+    cluster.create_jobset(js)
+    cluster.run_until_stable(max_ticks=2000)
+    samples = {
+        "jobsets": next(iter(cluster.jobsets.values())),
+        "jobs": next(iter(cluster.jobs.values())),
+        "pods": next(iter(cluster.pods.values())),
+        "services": next(iter(cluster.services.values())),
+        "nodes": next(iter(cluster.nodes.values())),
+        "queues": Queue(name="wire-q", quota={"pods": 16.0}, weight=2.0,
+                        cohort="wire"),
+        "workloads": Workload(
+            key=("default", "wire-sample"), uid="uid-9", queue="wire-q",
+            priority=1, request={"pods": 4.0}, arrival=7, state="Pending",
+        ),
+    }
+    kind_ids = wire.kind_ids()
+    reps = 300
+    codec_rows: dict[str, dict] = {}
+    for kind, obj in sorted(samples.items()):
+        encode, decode = codec.CODECS[kind]
+        doc = encode(obj)
+        json_bytes = codec.canonical(doc).encode()
+        frame = wire.encode(doc, kind_id=kind_ids[kind])
+
+        def timed_ns(fn) -> float:
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                fn()
+            return 1e9 * (time.perf_counter() - t0) / reps
+
+        codec_rows[kind] = {
+            "bytes_json": len(json_bytes),
+            "bytes_binary": len(frame),
+            "encode_json_ns": round(
+                timed_ns(lambda: codec.canonical(doc).encode())
+            ),
+            "encode_binary_ns": round(
+                timed_ns(lambda: wire.encode(doc, kind_id=kind_ids[kind]))
+            ),
+            "decode_json_ns": round(timed_ns(lambda: json.loads(json_bytes))),
+            "decode_binary_ns": round(timed_ns(lambda: wire.decode(frame))),
+        }
+
+    # -- (b) HTTP round-trip pods/s (2x2) ------------------------------
+    n_gangs = 256
+
+    def gang_manifests() -> list[dict]:
+        return [
+            serialization.to_dict(
+                make_jobset(f"wire-{i:04d}")
+                .replicated_job(
+                    make_replicated_job("w").replicas(1)
+                    .parallelism(1).completions(1).obj()
+                )
+                .obj()
+            )
+            for i in range(n_gangs)
+        ]
+
+    def roundtrip(encoding: str, batched: bool) -> float:
+        metrics.reset()
+        cluster = make_cluster()
+        for n in range(32):
+            cluster.add_node(f"n{n:03d}", capacity=110)
+        server = ControllerServer(cluster=cluster, tick_interval=30.0).start()
+        try:
+            client = JobSetClient(
+                f"http://{server.address}", timeout=900.0, encoding=encoding
+            )
+            manifests = gang_manifests()
+            gc.collect()
+            gc.freeze()
+            try:
+                t0 = time.perf_counter()
+                if batched:
+                    items = client.batch_create(manifests, view="minimal")
+                    if any(i["code"] != 201 for i in items):
+                        raise RuntimeError("wire bench batch item failed")
+                else:
+                    for manifest in manifests:
+                        client.create(manifest)
+                elapsed = time.perf_counter() - t0
+            finally:
+                gc.unfreeze()
+            with server.lock:
+                bound = sum(
+                    1 for p in cluster.pods.values() if p.spec.node_name
+                )
+            if bound != n_gangs:
+                raise RuntimeError(
+                    f"wire bench placement incomplete: {bound}/{n_gangs}"
+                )
+        finally:
+            server.stop()
+        return round(n_gangs / elapsed, 1)
+
+    roundtrip("binary", True)  # warm (codecs, server paths)
+    roundtrip_rows = {
+        "per_object": {
+            "json": roundtrip("json", False),
+            "binary": roundtrip("binary", False),
+        },
+        "batched": {
+            "json": roundtrip("json", True),
+            "binary": roundtrip("binary", True),
+        },
+    }
+
+    # -- (c) storm-dispatch residency ----------------------------------
+    from jobset_tpu.placement.solver import AssignmentSolver
+
+    solver = AssignmentSolver(backend="default")
+    j, d = 512, 960
+
+    def storm_problems() -> list[dict]:
+        return [
+            {
+                "load": np.zeros(d, np.float32),
+                "free": np.full(d, 8.0, np.float32),
+                "pods_needed": np.full(j, 8.0, np.float32),
+                "sticky": np.full(j, -1, np.int32),
+                "occupied": np.zeros(d, bool),
+                "own_domain": np.full(j, -1, np.int32),
+            }
+            for _ in range(8)
+        ]
+
+    problems = storm_problems()
+    for p in solver.solve_structured_batch_async(problems):
+        p.result()  # compile + warm + seed the residency cache
+    dispatch_ms: list[float] = []
+    round_ms: list[float] = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        pendings = solver.solve_structured_batch_async(problems)
+        dispatch_ms.append(1000.0 * (time.perf_counter() - t0))
+        for p in pendings:
+            p.result()
+        round_ms.append(1000.0 * (time.perf_counter() - t0))
+    storm = {
+        "problems": len(problems),
+        "jobs": j,
+        "domains": d,
+        "backend": jax_backend_name(),
+        # Host-side batching overhead (stacking + residency lookups +
+        # dispatch enqueue) — the cost the device-resident operand cache
+        # exists to cut; device solve time is excluded by construction.
+        "dispatch_host_ms_p50": round(statistics.median(dispatch_ms), 3),
+        "per_problem_overhead_ms": round(
+            statistics.median(dispatch_ms) / len(problems), 3
+        ),
+        "round_ms_p50": round(statistics.median(round_ms), 3),
+        "operand_transfers": solver.batch_operand_transfers,
+        "operand_reuses": solver.batch_operand_reuses,
+    }
+
+    return {
+        "codec_ns_per_object": codec_rows,
+        "roundtrip_pods_per_sec": {
+            "gangs": n_gangs,
+            **roundtrip_rows,
+        },
+        "storm_residency": storm,
+    }
+
+
+def _bank_wire(result: dict) -> None:
+    _bank_sidecar_key("wire", {
+        "codec_ns_per_object": result["codec_ns_per_object"],
+        "roundtrip_pods_per_sec": result["roundtrip_pods_per_sec"],
+    })
+    _bank_sidecar_key("storm_residency", result["storm_residency"])
 
 
 def run_slo_bench(args) -> dict:
@@ -3012,6 +3407,12 @@ def main() -> int:
              "BENCH_PLACEMENT_TPU_LAST.json under apiserver_inject",
     )
     parser.add_argument(
+        "--inject-groups", type=int, default=2,
+        help="round trips the batched (:batchCreate) clean pass splits "
+             "the 64-create shape into (docs/protocol.md; the per-object "
+             "comparison always uses one create per split)",
+    )
+    parser.add_argument(
         "--inject-seed", type=int, default=4,
         help="seed for --inject fault determinism (default 4: its realized "
              "fault density over the phase's 64 creates sits at the "
@@ -3030,6 +3431,15 @@ def main() -> int:
              "workloads, 64-gang preemption wave; both scorer backends) "
              "and bank it into BENCH_PLACEMENT_TPU_LAST.json under "
              "'queue'",
+    )
+    parser.add_argument(
+        "--wire", action="store_true",
+        help="run ONLY the fast-wire-plane microbench (per-kind "
+             "encode/decode ns/object for JSON vs binary frames, "
+             "batched-vs-per-object HTTP round-trip pods/s for both "
+             "encodings, storm-dispatch residency overhead) and bank it "
+             "into BENCH_PLACEMENT_TPU_LAST.json under 'wire' + "
+             "'storm_residency'",
     )
     parser.add_argument(
         "--restart", action="store_true",
@@ -3088,6 +3498,19 @@ def main() -> int:
         "--_placement-worker", action="store_true", help=argparse.SUPPRESS
     )
     args = parser.parse_args()
+
+    if args.wire:
+        # Control-plane + solver-dispatch bench: runs on whatever backend
+        # jax initialized (the storm-residency section labels it).
+        result = run_wire_bench(args)
+        _bank_wire(result)
+        print(json.dumps({
+            "metric": "wire_batched_binary_pods_per_sec",
+            "value": result["roundtrip_pods_per_sec"]["batched"]["binary"],
+            "unit": "pods/s",
+            "detail": result,
+        }))
+        return 0
 
     if args.restart:
         # Pure control-plane bench: durable-store recovery never touches

@@ -487,6 +487,23 @@ def _bootstrap_cluster_config(args, cluster) -> None:
                               f"recovered state; file entry ignored "
                               f"(durable state wins — update via the API)",
                               flush=True)
+        # Compile-once warm-up (ROADMAP item 2): with the jit scorer
+        # gated on, trace+compile its shape bucket NOW, at startup, so
+        # the first real admission pass never pays it.
+        manager = cluster.queue_manager
+        if manager is not None and manager.queues:
+            from .queue import scorer as queue_scorer
+
+            resources = {
+                r for q in manager.queues.values() for r in q.quota
+            }
+            cohorts = {
+                q.cohort for q in manager.queues.values() if q.cohort
+            }
+            queue_scorer.warm(
+                len(manager.queues), max(len(resources), 1),
+                len(cohorts), 512,
+            )
 
     if args.topology:
         if cluster.nodes:

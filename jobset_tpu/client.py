@@ -18,9 +18,118 @@ import urllib.error
 import urllib.request
 from typing import Optional
 
+from . import wire
 from .api import serialization
 from .api.types import JobSet
 from .obs import trace as obs_trace
+
+
+class _KeepAlivePool:
+    """Persistent keep-alive HTTP transport: one `http.client` connection
+    per (pool, thread), reused across requests so the hot API path stops
+    paying a TCP (and TLS) setup per call (docs/protocol.md "Connection
+    discipline"). Thread-local by construction — informer threads, the
+    retry loop and user threads each ride their own socket, so no
+    cross-thread request interleaving is possible.
+
+    Stale-connection discipline: a server may close an idle keep-alive
+    connection at any time. A failure on a REUSED connection is retried
+    exactly once on a fresh connection ONLY when re-sending is safe: the
+    method is idempotent (GET/HEAD), or the request provably never went
+    out (CannotSendRequest). A mutation whose reused connection dies
+    after the send is ambiguous — the server may have processed it — so
+    it propagates as URLError and the caller keeps owning that
+    ambiguity, exactly as with the old per-request transport (mutations
+    are never auto-retried anywhere in this client). A response that
+    fails AFTER its status line arrived is never retried for any method
+    — the request was definitively processed."""
+
+    def __init__(self, base_url: str, timeout: float, ssl_context=None):
+        from urllib.parse import urlsplit
+
+        parts = urlsplit(base_url)
+        self.scheme = parts.scheme
+        self.host = parts.hostname or "127.0.0.1"
+        self.port = parts.port
+        self.timeout = timeout
+        self._ssl_context = ssl_context
+        self._local = threading.local()
+
+    def _connect(self):
+        import http.client
+
+        if self.scheme == "https":
+            return http.client.HTTPSConnection(
+                self.host, self.port, timeout=self.timeout,
+                context=self._ssl_context,
+            )
+        return http.client.HTTPConnection(
+            self.host, self.port, timeout=self.timeout
+        )
+
+    def close(self) -> None:
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            self._local.conn = None
+
+    def request(self, method: str, path: str, body, headers: dict,
+                timeout: Optional[float] = None):
+        """One round trip -> (status, response headers, body bytes).
+        Transport-level failures raise urllib.error.URLError (matching
+        what the urlopen path raised, so retry classification upstream
+        is unchanged)."""
+        import http.client
+
+        effective_timeout = self.timeout if timeout is None else timeout
+        for attempt in (0, 1):
+            conn = getattr(self._local, "conn", None)
+            reused = conn is not None
+            if conn is None:
+                conn = self._connect()
+                self._local.conn = conn
+            got_response = False
+            try:
+                # Per-request deadline, restored EVERY call: a previous
+                # watch long-poll's longer deadline must not leak onto
+                # this thread's later ordinary requests.
+                if conn.sock is not None:
+                    conn.sock.settimeout(effective_timeout)
+                conn.request(method, path, body=body, headers=headers)
+                if conn.sock is not None:
+                    conn.sock.settimeout(effective_timeout)
+                resp = conn.getresponse()
+                got_response = True
+                data = resp.read()
+            except (http.client.RemoteDisconnected,
+                    http.client.CannotSendRequest,
+                    BrokenPipeError, ConnectionResetError) as exc:
+                self.close()
+                # One redo on a stale idle keep-alive connection — but
+                # ONLY when re-sending cannot double-apply: idempotent
+                # methods, or a request that never left the client. A
+                # mutation that failed after send is ambiguous (the
+                # server may have committed it before the connection
+                # died) and must surface, not silently re-send. A
+                # failure after the status line arrived is never
+                # retried: the request was definitively processed.
+                safe_redo = (
+                    method in ("GET", "HEAD")
+                    or isinstance(exc, http.client.CannotSendRequest)
+                )
+                if reused and attempt == 0 and safe_redo and \
+                        not got_response:
+                    continue
+                raise urllib.error.URLError(exc) from None
+            except (http.client.HTTPException, OSError) as exc:
+                self.close()
+                raise urllib.error.URLError(exc) from None
+            if resp.will_close:
+                self.close()
+            return resp.status, resp.headers, data
 
 
 class ApiError(Exception):
@@ -91,6 +200,7 @@ class JobSetClient:
         retry_seed: Optional[int] = None,
         user_agent: Optional[str] = None,
         chaos_src: str = "client",
+        encoding: str = "json",
     ):
         """ca_cert: path to the PEM CA that signed the controller's serving
         cert (utils/certs.py writes it as ca.crt) — enables https:// URLs
@@ -105,9 +215,21 @@ class JobSetClient:
         delivery over (chaos_src, server netloc) — a PartitionPlan that
         cuts the link makes requests fail like a blackholed network
         (URLError), engaging the same GET-retry/informer-backoff paths a
-        real partition would."""
+        real partition would.
+        encoding: "json" (default — wire-compatible with every server) or
+        "binary" (docs/protocol.md): structured request bodies ship as
+        application/vnd.jobset.binary frames and responses are requested
+        in the same encoding via Accept. Mixed versions interoperate: a
+        server that never learned the media type ignores the Accept and
+        answers JSON, which this client always still parses."""
         from . import __version__
 
+        if encoding not in ("json", "binary"):
+            raise ValueError(
+                f"unknown client encoding {encoding!r} "
+                "(expected 'json' or 'binary')"
+            )
+        self.encoding = encoding
         if "://" not in base_url:
             base_url = f"{'https' if ca_cert else 'http'}://{base_url}"
         self.base_url = base_url.rstrip("/")
@@ -136,6 +258,18 @@ class JobSetClient:
             # The self-signed serving cert names localhost/127.0.0.1; tests
             # and compose deployments connect by those, so hostname checking
             # stays ON (the SANs cover it).
+        # Persistent keep-alive transport (docs/protocol.md "Connection
+        # discipline"): every request — reads, writes, watch long-polls —
+        # reuses one thread-local connection instead of a fresh TCP(+TLS)
+        # setup per call.
+        self._pool = _KeepAlivePool(
+            self.base_url, timeout, ssl_context=self._ssl_context
+        )
+
+    def close(self) -> None:
+        """Close this thread's pooled keep-alive connection (other
+        threads' connections close when their threads exit)."""
+        self._pool.close()
 
     # -- transport --------------------------------------------------------
 
@@ -211,36 +345,69 @@ class JobSetClient:
         if reason is not None:
             raise urllib.error.URLError(reason)
 
-    def _transport_once(self, method: str, path: str, body, headers):
-        """One HTTP round trip; returns (parsed payload, response status)."""
-        self._check_link()
-        req = urllib.request.Request(
-            self.base_url + path, data=body, method=method, headers=headers
-        )
-        try:
-            with urllib.request.urlopen(
-                req, timeout=self.timeout, context=self._ssl_context
-            ) as resp:
-                data = resp.read()
-                ctype = resp.headers.get("Content-Type", "")
-                status = resp.status
-        except urllib.error.HTTPError as exc:
-            detail = exc.read().decode(errors="replace")
-            retry_after = _parse_retry_after(exc.headers.get("Retry-After"))
-            try:
-                detail = json.loads(detail).get("error", detail)
-            except (json.JSONDecodeError, AttributeError):
-                pass
-            raise ApiError(exc.code, detail,
-                           retry_after=retry_after) from None
+    @staticmethod
+    def _parse_payload(data: bytes, ctype: str):
+        """Response bytes -> Python payload by Content-Type (binary wire
+        frames, JSON, or plain text — whatever the server negotiated)."""
+        if ctype.startswith(wire.CONTENT_TYPE):
+            return wire.decode(data)
         if ctype.startswith("application/json"):
-            return json.loads(data), status
-        return data.decode(), status
+            return json.loads(data)
+        return data.decode()
+
+    @staticmethod
+    def _error_detail(data: bytes):
+        detail = data.decode(errors="replace")
+        try:
+            detail = json.loads(detail).get("error", detail)
+        except (json.JSONDecodeError, AttributeError):
+            pass
+        return detail
+
+    def _transport_once(self, method: str, path: str, body, headers):
+        """One HTTP round trip over the keep-alive pool; returns
+        (parsed payload, response status)."""
+        self._check_link()
+        if self.encoding == "binary":
+            headers.setdefault("Accept", wire.CONTENT_TYPE)
+        status, resp_headers, data = self._pool.request(
+            method, path, body, headers
+        )
+        if status >= 400:
+            raise ApiError(
+                status, self._error_detail(data),
+                retry_after=_parse_retry_after(
+                    resp_headers.get("Retry-After")
+                ),
+            )
+        return self._parse_payload(
+            data, resp_headers.get("Content-Type", "")
+        ), status
 
     # -- jobsets ----------------------------------------------------------
 
     def _collection(self, namespace: str) -> str:
         return f"{self.API}/namespaces/{namespace}/jobsets"
+
+    def _encode_body(self, doc: dict) -> tuple[bytes, str]:
+        """Structured request body in the client's negotiated encoding."""
+        if self.encoding == "binary":
+            return wire.encode(doc), wire.CONTENT_TYPE
+        return json.dumps(doc).encode(), "application/json"
+
+    @staticmethod
+    def _manifest_dict(js: "JobSet | dict | str") -> dict:
+        """JobSet object / manifest dict / YAML text -> manifest dict."""
+        if isinstance(js, JobSet):
+            return serialization.to_dict(js)
+        if isinstance(js, dict):
+            return js
+        import yaml as _yaml
+
+        doc = _yaml.safe_load(js)
+        if not isinstance(doc, dict):
+            raise ValueError("manifest text must parse to a mapping")
+        return doc
 
     def create(self, js: JobSet | dict | str, namespace: Optional[str] = None) -> JobSet:
         """Create from a JobSet object, a manifest dict, or YAML text.
@@ -252,21 +419,59 @@ class JobSetClient:
         """
         if isinstance(js, JobSet):
             manifest_ns = js.metadata.namespace
-            body = serialization.to_yaml(js).encode()
+            body, ctype = self._encode_body(serialization.to_dict(js))
         elif isinstance(js, dict):
             manifest_ns = (js.get("metadata") or {}).get("namespace")
-            body = json.dumps(js).encode()
+            body, ctype = self._encode_body(js)
         else:
             import yaml as _yaml
 
             manifest_ns = ((_yaml.safe_load(js) or {}).get("metadata") or {}).get(
                 "namespace"
             )
-            body = js.encode()
+            body, ctype = js.encode(), "application/yaml"
         ns = namespace or manifest_ns or "default"
         out = self._request("POST", self._collection(ns), body,
-                            content_type="application/yaml")
+                            content_type=ctype)
         return serialization.from_dict(out)
+
+    def batch_create(
+        self,
+        manifests,
+        namespace: str = "default",
+        view: str = "full",
+    ) -> list[dict]:
+        """One ``:batchCreate`` round trip (docs/protocol.md): every
+        manifest (JobSet objects, dicts, or YAML texts) ships in a single
+        request with per-item create semantics — the returned list holds
+        one ``{"code": 201, "object"/"name"...}`` or
+        ``{"code": 4xx, "error": ...}`` entry per input, in order; an
+        invalid item never poisons its siblings. ``view="minimal"``
+        returns name/uid stubs instead of full manifests (bulk loads)."""
+        doc: dict = {
+            "items": [self._manifest_dict(m) for m in manifests],
+        }
+        if view != "full":
+            doc["view"] = view
+        body, ctype = self._encode_body(doc)
+        out = self._request(
+            "POST", f"{self._collection(namespace)}:batchCreate", body,
+            content_type=ctype,
+        )
+        return out["items"]
+
+    def batch_update_status(
+        self, items: list[dict], namespace: str = "default"
+    ) -> list[dict]:
+        """One ``:batchStatus`` round trip: ``items`` are
+        ``{"name": ..., "status": {...}}`` wire dicts; returns the
+        per-item result list (200/400/404 codes, in order)."""
+        body, ctype = self._encode_body({"items": items})
+        out = self._request(
+            "POST", f"{self._collection(namespace)}:batchStatus", body,
+            content_type=ctype,
+        )
+        return out["items"]
 
     def apply_yaml(self, text: str, namespace: Optional[str] = None) -> list[JobSet]:
         """Create every document in a (possibly multi-doc) YAML stream; each
@@ -323,39 +528,73 @@ class JobSetClient:
         """
         return self.watch_resource("jobsets", namespace, resource_version, timeout)
 
+    @staticmethod
+    def _expand_frame(frame: dict) -> list[dict]:
+        """Coalesced watch frame -> the legacy per-event list
+        (docs/protocol.md): rv deltas rebased on the frame's baseRV,
+        PATCH events replayed against their in-frame predecessor via
+        wire.apply_delta."""
+        base = int(frame.get("baseRV", 0))
+        events: list[dict] = []
+        for entry in frame.get("events") or []:
+            drv, etype = int(entry[0]), entry[1]
+            if etype == "PATCH":
+                obj = wire.apply_delta(
+                    events[int(entry[2])]["object"], entry[3]
+                )
+                etype = "MODIFIED"
+            else:
+                obj = entry[2]
+            events.append({
+                "resourceVersion": base + drv,
+                "type": etype,
+                "object": obj,
+            })
+        return events
+
     def watch_resource(
         self, kind: str, namespace="default", resource_version=0, timeout=15.0
     ):
         """One long-poll watch for any journaled kind ("jobsets", "jobs",
         "pods", "services", "events") — the client-go generated-informer
         analog covering EVERY type an external controller consumes, so
-        nothing needs polling."""
+        nothing needs polling.
+
+        Always asks for coalesced frames (?frames=1, docs/protocol.md);
+        a server that predates them ignores the parameter and answers
+        the legacy per-event list, which is parsed identically — the
+        mixed-version interop contract."""
         self._check_link()
         path = (
             f"{self._resource_path(kind, namespace)}?watch=1"
             f"&resourceVersion={int(resource_version)}"
-            f"&timeoutSeconds={timeout}"
+            f"&timeoutSeconds={timeout}&frames=1"
         )
-        req = urllib.request.Request(
-            self.base_url + path, method="GET",
-            headers={"User-Agent": self.user_agent},
+        headers = {"User-Agent": self.user_agent}
+        if self.encoding == "binary":
+            headers["Accept"] = wire.CONTENT_TYPE
+        status, resp_headers, data = self._pool.request(
+            "GET", path, None, headers, timeout=timeout + 10.0
         )
-        try:
-            with urllib.request.urlopen(
-                req, timeout=timeout + 10.0, context=self._ssl_context
-            ) as resp:
-                out = json.loads(resp.read())
-        except urllib.error.HTTPError as exc:
-            detail = exc.read().decode(errors="replace")
-            retry_after = _parse_retry_after(exc.headers.get("Retry-After"))
-            if exc.code == 410:
+        if status >= 400:
+            detail = self._error_detail(data)
+            if status == 410:
                 raise WatchGone(410, detail) from None
-            raise ApiError(exc.code, detail,
-                           retry_after=retry_after) from None
+            raise ApiError(
+                status, detail,
+                retry_after=_parse_retry_after(
+                    resp_headers.get("Retry-After")
+                ),
+            )
+        out = self._parse_payload(
+            data, resp_headers.get("Content-Type", "")
+        )
         # Saturated-watch-pool partial batches carry a pacing hint (the
         # flow plane's thread-free long-poll mode); stash it for the
         # informer loop. None on ordinary parked polls.
         self.last_watch_retry_after = out.get("retryAfterSeconds")
+        if "frame" in out:
+            return self._expand_frame(out["frame"]), out["resourceVersion"]
         return out["events"], out["resourceVersion"]
 
     def list_resource_with_version(self, kind: str, namespace: str = "default"):
@@ -366,9 +605,9 @@ class JobSetClient:
 
     def update(self, js: JobSet, namespace: Optional[str] = None) -> JobSet:
         ns = namespace or js.metadata.namespace or "default"
-        body = serialization.to_yaml(js).encode()
+        body, ctype = self._encode_body(serialization.to_dict(js))
         out = self._request("PUT", f"{self._collection(ns)}/{js.metadata.name}", body,
-                            content_type="application/yaml")
+                            content_type=ctype)
         return serialization.from_dict(out)
 
     def delete(self, name: str, namespace: str = "default") -> None:
@@ -379,9 +618,10 @@ class JobSetClient:
         """Write the status subresource (external controllers of managedBy
         jobsets — the k8s `/status` endpoint analog). `status` is the wire
         dict (camelCase keys); returns the stored manifest."""
-        body = json.dumps({"status": status}).encode()
+        body, ctype = self._encode_body({"status": status})
         return self._request(
-            "PUT", f"{self._collection(namespace)}/{name}/status", body
+            "PUT", f"{self._collection(namespace)}/{name}/status", body,
+            content_type=ctype,
         )
 
     def suspend(self, name: str, namespace: str = "default") -> JobSet:

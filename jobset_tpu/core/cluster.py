@@ -148,6 +148,13 @@ class Cluster:
         # solver dispatch (provider.prepare_batch): (placement, js) pairs,
         # deduped by JobSet uid at drain time (last request wins).
         self._prepare_requests: list[tuple] = []
+        # Bulk-admission buffer (the :batchCreate verb, docs/protocol.md):
+        # while a bulk_admission() context is open, admission-time plan
+        # prefetches collect here and solve as ONE global assignment at
+        # context exit (provider.prepare_group) — sibling creates' plans
+        # come out disjoint instead of colliding. None = ordinary
+        # per-create prefetch.
+        self._bulk_admission: Optional[list] = None
         # One bounded between-tick wait for in-flight placement solves
         # (reconciles park on PLAN_PENDING instead of sleeping inside the
         # timed pass; see request_solve_backoff).
@@ -394,8 +401,45 @@ class Cluster:
             and not queue_held
             and hasattr(getattr(reconciler, "placement", None), "prepare")
         ):
-            reconciler.placement.prepare(self, js)
+            if self._bulk_admission is not None:
+                # Bulk admission (:batchCreate): defer — the batch solves
+                # one joint assignment at context exit instead of N
+                # colliding per-create solves.
+                self._bulk_admission.append(js)
+            else:
+                reconciler.placement.prepare(self, js)
         return js
+
+    def bulk_admission(self):
+        """Context manager for batched creates (the :batchCreate verb):
+        admission-time plan prefetches inside the context are deferred
+        and solved as ONE global assignment on exit
+        (provider.prepare_group), so sibling creates get disjoint plans
+        instead of each solving for the same free domains and re-solving
+        at claim time. Reentrant: a nested context is a no-op."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _ctx():
+            if self._bulk_admission is not None:
+                yield
+                return
+            self._bulk_admission = []
+            try:
+                yield
+            finally:
+                pending, self._bulk_admission = self._bulk_admission, None
+                placement = getattr(
+                    self.jobset_reconciler, "placement", None
+                )
+                if pending and placement is not None:
+                    if hasattr(placement, "prepare_group"):
+                        placement.prepare_group(self, pending)
+                    elif hasattr(placement, "prepare"):
+                        for js in pending:
+                            placement.prepare(self, js)
+
+        return _ctx()
 
     def update_jobset(self, js: JobSet) -> JobSet:
         key = (js.metadata.namespace, js.metadata.name)

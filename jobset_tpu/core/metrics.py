@@ -402,6 +402,25 @@ flow_queue_wait_seconds = Histogram(
     "Time a request spent parked in its priority level's queue before "
     "being granted a seat or shed at the wait budget",
 )
+# Fast wire plane (jobset_tpu/wire, docs/protocol.md): binary codec
+# negotiation, batched verbs, coalesced watch frames.
+http_encoding_total = Counter(
+    "jobset_http_encoding_total",
+    "API requests served per negotiated wire encoding (json includes "
+    "YAML manifest bodies; binary is application/vnd.jobset.binary on "
+    "the request body and/or Accept side)",
+    label_names=("encoding",),
+)
+http_batch_items_total = Counter(
+    "jobset_http_batch_items_total",
+    "Items processed by the batched verbs (:batchCreate/:batchStatus), "
+    "counted per item regardless of per-item outcome",
+)
+watch_frames_total = Counter(
+    "jobset_watch_frames_total",
+    "Coalesced multi-event watch frames served (?frames=1 long-poll "
+    "answers; one frame carries N events against a shared rv floor)",
+)
 
 
 def set_build_info(version: str, backend: str, gates: str,
@@ -435,6 +454,9 @@ ALL_COUNTERS = (
     policy_decisions_total,
     policy_fallbacks_total,
     flow_rejected_total,
+    http_encoding_total,
+    http_batch_items_total,
+    watch_frames_total,
 )
 ALL_HISTOGRAMS = (
     reconcile_time_seconds,

@@ -179,6 +179,18 @@ class FlowSchema:
 
 
 DEFAULT_SCHEMAS: tuple[FlowSchema, ...] = (
+    # Batched verbs (:batchCreate/:batchStatus, docs/protocol.md) get
+    # their own schemas so their seat accounting is explicit: one batch
+    # request occupies `items` seats of its level (width accounting in
+    # flow/controller.py), keeping a 64-item batch as expensive to the
+    # fairness budget as 64 single writes. The priority SPLIT is
+    # inherited, not escalated — a batch rides workload-high only when
+    # its peeked max item priority clears the same bar a single write
+    # would need, else it lands in workload-low with every other
+    # best-effort write. Batching buys round trips, never priority.
+    FlowSchema("batch-high-priority-gangs", level=LEVEL_HIGH,
+               verbs=("batch",), min_priority=HIGH_PRIORITY_THRESHOLD),
+    FlowSchema("batch-verbs", level=LEVEL_LOW, verbs=("batch",)),
     # High-priority gang writes ride the protected level: a priority>=100
     # JobSet create/update must land even while best-effort traffic sheds.
     FlowSchema("high-priority-gangs", level=LEVEL_HIGH, kinds=("jobsets",),
@@ -201,12 +213,16 @@ class RequestInfo:
 
     method: str
     path: str  # bare (query-stripped)
-    verb: str  # create/update/delete/patch/get/watch
+    verb: str  # create/update/delete/patch/get/watch/batch
     kind: str  # jobsets/queues/nodes/pods/jobs/services/events/webhooks/""
     namespace: str
     user_agent: str
     priority: Optional[int] = None
     is_watch: bool = False
+    # Seat width: 1 for ordinary requests; a batched verb carries its
+    # item count so flow admission charges `items` seats for the one
+    # request (per-item seat accounting, docs/protocol.md).
+    items: int = 1
 
     @property
     def flow_key(self) -> str:
@@ -258,24 +274,61 @@ _VERBS = {"POST": "create", "PUT": "update", "DELETE": "delete",
           "PATCH": "patch"}
 
 
+def _is_batch(bare: str, method: str) -> bool:
+    from ..wire import BATCH_SUFFIXES
+
+    return method == "POST" and bare.endswith(BATCH_SUFFIXES)
+
+
 def request_info(method: str, path: str, body: bytes = b"",
-                 headers: Optional[dict] = None) -> RequestInfo:
-    """Build the classifier's request descriptor from the raw request."""
+                 headers: Optional[dict] = None,
+                 body_obj=None) -> RequestInfo:
+    """Build the classifier's request descriptor from the raw request.
+
+    ``body_obj``: the already-decoded body document when the server
+    negotiated a binary request encoding (or pre-parsed a batch body for
+    width accounting) — priority/item peeks read it directly instead of
+    regex-scanning bytes that are no longer JSON/YAML text."""
     bare, _, query = path.partition("?")
     is_watch = bool(parse_qs(query).get("watch"))
     kind = _resource_kind(bare)
     priority = None
-    if kind == "jobsets" and method in ("POST", "PUT") and body:
-        priority = _peek_priority(body)
+    items = 1
+    if _is_batch(bare, method) and isinstance(body_obj, dict):
+        batch_items = body_obj.get("items")
+        if isinstance(batch_items, list):
+            items = max(1, len(batch_items))
+            # Batch priority = max item priority: the whole batch rides
+            # the level its most protected item would have earned alone.
+            peeked = [
+                (item.get("spec") or {}).get("priority")
+                for item in batch_items
+                if isinstance(item, dict)
+            ]
+            peeked = [p for p in peeked if isinstance(p, int)]
+            if peeked:
+                priority = max(peeked)
+    elif kind == "jobsets" and method in ("POST", "PUT"):
+        if isinstance(body_obj, dict):
+            priority = (body_obj.get("spec") or {}).get("priority")
+            if not isinstance(priority, int):
+                priority = None
+        elif body:
+            priority = _peek_priority(body)
     return RequestInfo(
         method=method,
         path=bare,
-        verb="watch" if is_watch else _VERBS.get(method, "get"),
+        verb=(
+            "watch" if is_watch
+            else "batch" if _is_batch(bare, method)
+            else _VERBS.get(method, "get")
+        ),
         kind=kind,
         namespace=_namespace_of(bare),
         user_agent=(headers or {}).get("user-agent") or "",
         priority=priority,
         is_watch=is_watch,
+        items=items,
     )
 
 

@@ -64,6 +64,7 @@ class _Waiter:
     seq: int
     enqueued_at: float
     queue_index: int
+    width: int = 1  # seats this request occupies (batched verbs > 1)
     event: threading.Event = field(default_factory=threading.Event)
     granted: bool = False
 
@@ -79,6 +80,10 @@ class FlowTicket:
     retry_after_s: float = 1.0
     queue_wait_s: float = 0.0
     waiter: Optional[_Waiter] = None
+    # Seat width (docs/protocol.md "Batched verbs"): a batched request
+    # occupies `items` seats for its whole execution, so one 64-item
+    # batchCreate weighs on the fairness budget like 64 single writes.
+    width: int = 1
 
 
 class _LevelState:
@@ -123,7 +128,10 @@ class FlowController:
         path deterministically on a virtual clock)."""
         level_name = classify(info, self.schemas)
         flow_key = info.flow_key
-        ticket = self._admit_locked_phase(level_name, flow_key, info.is_watch)
+        ticket = self._admit_locked_phase(
+            level_name, flow_key, info.is_watch,
+            width=max(1, getattr(info, "items", 1)),
+        )
         self._account(ticket)
         if ticket.decision == QUEUED and block:
             budget = self._levels[level_name].level.queue_wait_s
@@ -132,18 +140,25 @@ class FlowController:
         return ticket
 
     def _admit_locked_phase(self, level_name: str, flow_key: str,
-                            is_watch: bool) -> FlowTicket:
+                            is_watch: bool, width: int = 1) -> FlowTicket:
         with self._lock:
             self._arrivals += 1
             seq = self._arrivals
             state = self._levels[level_name]
             lv = state.level
             if lv.seats <= 0 or state.executing < lv.seats:
-                state.executing += 1
+                # Width accounting (APF's seat-width idiom): admission
+                # needs one free seat, execution occupies `width` —
+                # a wide batch may overshoot the level bound for its own
+                # duration, but everything arriving behind it waits until
+                # the batch's seats free, so sustained batch load is
+                # metered exactly like the equivalent single writes.
+                state.executing += width
                 self._log_locked(seq, level_name, flow_key, EXECUTE, "")
                 return FlowTicket(level=level_name, decision=EXECUTE,
                                   flow_key=flow_key,
-                                  retry_after_s=lv.retry_after_s)
+                                  retry_after_s=lv.retry_after_s,
+                                  width=width)
             if is_watch:
                 # Watch pool saturated: the server answers an immediate
                 # partial batch + retry hint; no seat, no queue, no 429.
@@ -172,12 +187,12 @@ class FlowController:
                                   reason=REASON_QUEUE_FULL,
                                   retry_after_s=lv.retry_after_s)
             waiter = _Waiter(seq=seq, enqueued_at=self._now(),
-                             queue_index=qi)
+                             queue_index=qi, width=width)
             state.queues[qi].append(waiter)
             return FlowTicket(level=level_name, decision=QUEUED,
                               flow_key=flow_key,
                               retry_after_s=lv.retry_after_s,
-                              waiter=waiter)
+                              waiter=waiter, width=width)
 
     def resolve(self, ticket: FlowTicket) -> FlowTicket:
         """Finish a ``queued`` ticket: granted waiters become ``execute``
@@ -210,21 +225,26 @@ class FlowController:
         sharded queues). ``reject``/``busy`` tickets hold nothing."""
         if ticket is None or ticket.decision != EXECUTE:
             return
-        grant: Optional[_Waiter] = None
+        grants: list[_Waiter] = []
         with self._lock:
             state = self._levels[ticket.level]
-            state.executing -= 1
+            state.executing -= ticket.width
             lv = state.level
-            if lv.seats > 0 and state.executing < lv.seats:
+            # A wide release may free several seats: keep granting in
+            # global FIFO order while seats remain (each grant occupies
+            # its own width, so a wide waiter closes the window).
+            while lv.seats > 0 and state.executing < lv.seats:
                 grant = self._next_waiter_locked(state)
-                if grant is not None:
-                    grant.granted = True
-                    state.executing += 1
+                if grant is None:
+                    break
+                grant.granted = True
+                state.executing += grant.width
+                grants.append(grant)
             inflight = state.executing
         from ..core import metrics
 
         metrics.flow_inflight.set(inflight, ticket.level)
-        if grant is not None:
+        for grant in grants:
             grant.event.set()
 
     def hold(self, level: str, n: int) -> list[FlowTicket]:

@@ -178,13 +178,62 @@ def _kernel(P: int, Q: int, C: int, R: int):
     return kernel
 
 
+# Compile-once high-water candidate buckets (the policy plane's
+# discipline, SNIPPETS.md [3] — see ROADMAP item 2): an admission run's
+# candidate count P shrinks pass over pass as gangs admit
+# (512 -> 448 -> ... -> 8), and naive per-pass pow2 bucketing walked
+# that whole ladder — SEVEN kernel compiles inside one bench window,
+# which is exactly why the jit backend banked 5x slower than numpy.
+# Instead P pads to a monotone high-water bucket per (Q, C, R) shape:
+# the first (largest) pass compiles once and every later pass reuses the
+# same kernel. Padded rows are sliced away and never influence real
+# rows, so decisions stay bit-identical to the greedy backend at any
+# bucket size (tests/test_queue.py parity + tests/test_wire.py
+# compile-once regression).
+_P_HIGH_WATER: dict[tuple[int, int, int], int] = {}
+
+
+def _p_bucket(P0: int, Q: int, C: int, R: int) -> int:
+    key = (Q, C, R)
+    bucket = max(_round_up_pow2(P0), _P_HIGH_WATER.get(key, 0))
+    _P_HIGH_WATER[key] = bucket
+    return bucket
+
+
+def warm(num_queues: int, num_resources: int, num_cohorts: int,
+         max_candidates: int) -> None:
+    """Pre-compile the jit kernel for a deployment's shape buckets —
+    called where compile time is affordable (controller startup with
+    --queues preload, the bench's untimed setup) so the first admission
+    pass runs against a warm kernel instead of paying trace+compile
+    inside its own latency. A no-op when the gate is off or the bucket
+    already compiled."""
+    if not features.enabled("TPUQueueScorer") or max_candidates <= 0:
+        return
+    Q0 = max(num_queues, 1)
+    snapshot = Snapshot(
+        resources=[f"r{i}" for i in range(max(num_resources, 1))],
+        queue_names=[f"q{i}" for i in range(Q0)],
+        nominal=np.ones((Q0, max(num_resources, 1)), np.float32),
+        declared=np.ones((Q0, max(num_resources, 1)), bool),
+        usage=np.zeros((Q0, max(num_resources, 1)), np.float32),
+        weight=np.ones(Q0, np.float32),
+        cohort=np.full(Q0, -1, np.int32),
+        num_cohorts=max(num_cohorts, 0),
+        request=np.zeros((max_candidates, max(num_resources, 1)),
+                         np.float32),
+        queue_index=np.zeros(max_candidates, np.int32),
+    )
+    _score_jax(snapshot)
+
+
 def _score_jax(snapshot: Snapshot) -> ScoreResult:
     P0, R0 = snapshot.request.shape
     Q0 = snapshot.nominal.shape[0]
-    P = _round_up_pow2(P0)
     Q = _round_up_pow2(Q0)
     R = _round_up_pow2(max(R0, 1), minimum=4)
     C = _round_up_pow2(max(snapshot.num_cohorts, 1), minimum=4)
+    P = _p_bucket(P0, Q, C, R)
 
     nominal = np.zeros((Q, R), np.float32)
     nominal[:Q0, :R0] = snapshot.nominal

@@ -41,7 +41,7 @@ import yaml
 
 logger = logging.getLogger("jobset_tpu.server")
 
-from . import __version__
+from . import __version__, wire
 from .api import serialization
 from .api.types import Taint
 from .core import AdmissionError, Cluster, features, make_cluster, metrics
@@ -386,13 +386,59 @@ class ControllerServer:
         handler = self._make_handler()
 
         class _Server(ThreadingHTTPServer):
+            # Keep-alive discipline (docs/protocol.md): persistent
+            # client connections mean handler threads can outlive the
+            # accept loop — server_close() only closes the LISTENER. A
+            # stopped (or crash-simulated) server must also tear down
+            # established connections, or a parked keep-alive handler
+            # keeps answering stale state from a dead incarnation — the
+            # zombie-replica bug the HA informer-failover test catches.
+            daemon_threads = True
+
+            def __init__(self, *srv_args, **srv_kwargs):
+                super().__init__(*srv_args, **srv_kwargs)
+                self._open_conns: set = set()  # guarded-by: _conns_lock
+                self._conns_lock = threading.Lock()
+
+            def process_request(self, request, client_address):
+                with self._conns_lock:
+                    self._open_conns.add(request)
+                super().process_request(request, client_address)
+
+            def shutdown_request(self, request):
+                with self._conns_lock:
+                    self._open_conns.discard(request)
+                super().shutdown_request(request)
+
+            def close_all_connections(self):
+                """Force-close every established connection: parked
+                keep-alive reads see EOF, handler threads exit, clients
+                reconnect (and reach whoever owns the port now)."""
+                import socket as _socket
+
+                with self._conns_lock:
+                    conns = list(self._open_conns)
+                    self._open_conns.clear()
+                for conn in conns:
+                    try:
+                        conn.shutdown(_socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+
             def handle_error(self, request, client_address):
-                # Aborted TLS handshakes (scanners, silent peers) are
-                # ordinary noise, not bugs worth a traceback.
+                # Aborted TLS handshakes (scanners, silent peers) and
+                # connections we force-closed at shutdown are ordinary
+                # noise, not bugs worth a traceback.
                 import sys as _sys
 
                 exc = _sys.exception()
-                if isinstance(exc, ConnectionAbortedError):
+                if isinstance(exc, (ConnectionAbortedError,
+                                    ConnectionResetError,
+                                    BrokenPipeError)):
                     return
                 super().handle_error(request, client_address)
 
@@ -605,6 +651,11 @@ class ControllerServer:
             self._lease_released = True
         self._httpd.shutdown()
         self._httpd.server_close()
+        # Tear down established keep-alive connections too: a pooled
+        # client connection must never keep being answered by a stopped
+        # incarnation (it reconnects and reaches the current owner of
+        # the port).
+        self._httpd.close_all_connections()
 
     def crash(self):
         """Crash simulation (HA tests/chaos): drop the listener and the
@@ -625,6 +676,10 @@ class ControllerServer:
             pump.join(timeout=10.0)
         self._httpd.shutdown()
         self._httpd.server_close()
+        # kill -9 semantics: established connections die WITH the
+        # process — a keep-alive handler thread of the dead incarnation
+        # must not keep serving its stale cluster to pooled clients.
+        self._httpd.close_all_connections()
 
     def drain(self) -> list[str]:
         """Graceful drain (the CLI's SIGTERM path), in the k8s-shutdown
@@ -968,9 +1023,34 @@ class ControllerServer:
             return respond(False, "; ".join(errors))
         return respond(True)
 
+    # Coalesced watch frames (docs/protocol.md): a frame event is either
+    # [rvDelta, type, object] (full) or [rvDelta, "PATCH", refIndex, ops]
+    # — a MODIFIED whose object is the frame's earlier event at refIndex
+    # plus sparse wire.delta ops. rvDeltas count from the frame's baseRV.
+    @staticmethod
+    def _coalesce_frame(base_rv: int, batch: list[dict]) -> dict:
+        seen: dict[tuple, tuple[int, dict]] = {}  # identity -> (idx, obj)
+        events = []
+        for event in batch:
+            obj = event["object"]
+            meta = obj.get("metadata") or {}
+            key = (meta.get("namespace"), meta.get("name"), meta.get("uid"))
+            drv = event["resourceVersion"] - base_rv
+            prev = seen.get(key) if event["type"] == "MODIFIED" else None
+            if prev is not None:
+                ops = wire.delta(prev[1], obj)
+                events.append([drv, "PATCH", prev[0], ops])
+            else:
+                events.append([drv, event["type"], obj])
+            if event["type"] == "DELETED":
+                seen.pop(key, None)
+            else:
+                seen[key] = (len(events) - 1, obj)
+        return {"baseRV": base_rv, "events": events}
+
     def _watch_resource(
         self, kind: str, ns: str, resource_version: int, timeout_s: float,
-        park: bool = True, retry_hint: float = 1.0,
+        park: bool = True, retry_hint: float = 1.0, frames: bool = False,
     ):
         """Long-poll: block until `kind` events newer than
         `resource_version` exist for namespace `ns` (or the timeout
@@ -980,7 +1060,13 @@ class ControllerServer:
         ``park=False`` (flow control's saturated watch pool) answers ONE
         pass immediately: whatever events are already available — possibly
         an empty partial batch — plus a ``retryAfterSeconds`` hint, so the
-        poll costs no parked handler thread and the client paces itself."""
+        poll costs no parked handler thread and the client paces itself.
+
+        ``frames=True`` (?frames=1, docs/protocol.md) answers the batch
+        as ONE coalesced frame — shared header + per-event rv deltas
+        against the watcher's own resourceVersion floor, repeat-object
+        MODIFIEDs delta-compressed — honoring the same quorum delivery
+        floor and 410 relist contract as the legacy per-event list."""
         import time as _t
 
         deadline = _t.monotonic() + max(0.0, min(timeout_s, 300.0))
@@ -1026,10 +1112,22 @@ class ControllerServer:
                     and event_ns == ns
                 ]
                 if batch:
-                    result = {
-                        "events": batch,
-                        "resourceVersion": floor,
-                    }
+                    if frames:
+                        # One frame for the whole batch: shared header,
+                        # rv deltas from the watcher's floor, repeat
+                        # objects delta-compressed (docs/protocol.md).
+                        metrics.watch_frames_total.inc()
+                        result = {
+                            "frame": self._coalesce_frame(
+                                resource_version, batch
+                            ),
+                            "resourceVersion": floor,
+                        }
+                    else:
+                        result = {
+                            "events": batch,
+                            "resourceVersion": floor,
+                        }
                     if not park:
                         result["retryAfterSeconds"] = retry_hint
                     break
@@ -1138,7 +1236,39 @@ class ControllerServer:
         """Returns (status_code, payload_dict_or_text[, content_type])."""
         headers = headers or {}
         bare = path.partition("?")[0]
-        # Flow control runs in FRONT of everything (chaos, tracing,
+        # Wire-encoding negotiation FIRST (docs/protocol.md): a pure
+        # function of the Content-Type/Accept headers, so it may run
+        # before flow admission and a shed 429 stays side-effect-free.
+        # Body decoding is kept as cheap as possible until after flow
+        # admission: ordinary binary bodies are NOT parsed pre-flow —
+        # the classifier's spec.priority peek runs on a bounded slice of
+        # the frame's JSON payload — so overload shedding keeps its
+        # cheap-reject property. Only batch bodies parse up front
+        # (width accounting needs the item count before a seat is
+        # charged), and those are bounded by the byte ceiling below.
+        req_binary, resp_binary = wire.negotiate(headers)
+        body_obj = None
+        is_batch = method == "POST" and bare.endswith(wire.BATCH_SUFFIXES)
+        if is_batch and len(body) > self._BATCH_MAX_BODY_BYTES:
+            return 413, {"error": (
+                f"batch body of {len(body)} bytes exceeds the "
+                f"{self._BATCH_MAX_BODY_BYTES}-byte ceiling; split it"
+            )}
+        if is_batch and body:
+            if req_binary:
+                try:
+                    body_obj = wire.decode(body)
+                except wire.WireError as exc:
+                    return 400, {"error": str(exc)}
+            else:
+                try:
+                    body_obj = json.loads(body)
+                except ValueError:
+                    try:
+                        body_obj = yaml.safe_load(body.decode())
+                    except Exception as exc:  # noqa: BLE001 — any parse failure is a client error
+                        return 400, {"error": f"bad batch body: {exc}"}
+        # Flow control runs in FRONT of everything else (chaos, tracing,
         # routing): a shed request is answered 429 + Retry-After having
         # touched nothing, so a 429'd write can never have side effects.
         # Exempt classes (/debug/*, /ha/*, probes, /metrics) always pass.
@@ -1146,8 +1276,16 @@ class ControllerServer:
         if self.flow is not None:
             from .flow import config as flow_config
 
-            info = flow_config.request_info(method, path, body=body,
-                                            headers=headers)
+            info = flow_config.request_info(
+                method, path,
+                # Binary single-object bodies: hand the classifier a
+                # bounded slice of the frame's JSON payload so the
+                # priority regex peek works without a full decode.
+                body=(wire.peek_payload(body) if req_binary and body
+                      else body),
+                headers=headers,
+                body_obj=body_obj,
+            )
             flow_ticket = self.flow.admit(info)
             if flow_ticket.decision == "reject":
                 return (
@@ -1164,6 +1302,14 @@ class ControllerServer:
                     {"Retry-After": format(flow_ticket.retry_after_s, "g")},
                 )
         try:
+            # Deferred binary decode (post-admission): a shed request
+            # never paid it; a malformed frame is a loud 400 before any
+            # routing side effect.
+            if req_binary and body and body_obj is None:
+                try:
+                    body_obj = wire.decode(body)
+                except wire.WireError as exc:
+                    return 400, {"error": str(exc)}
             fault_response = self._check_chaos(method, bare)
             if fault_response is not None:
                 return fault_response
@@ -1181,15 +1327,19 @@ class ControllerServer:
             # informer relists) would otherwise churn the bounded trace ring
             # with one-span root traces and evict the end-to-end traces this
             # feature exists to keep.
+            encoding = "binary" if (req_binary or resp_binary) else "json"
             metrics.api_requests_in_flight.add(1)
             try:
                 if self._is_observability_path(bare) or (
                     parent is None and method == "GET"
                 ):
+                    if not self._is_observability_path(bare):
+                        metrics.http_encoding_total.inc(encoding)
                     return self._stamp_replication_headers(
                         self._route_inner(
                             method, path, body, headers,
                             watch_park=watch_park, watch_hint=watch_hint,
+                            body_obj=body_obj,
                         ),
                         bare,
                     )
@@ -1197,9 +1347,11 @@ class ControllerServer:
                 # traceparent when present — the apiserver hop of the
                 # end-to-end trace (client -> here -> reconcile ->
                 # provider -> solver).
+                metrics.http_encoding_total.inc(encoding)
                 with obs_trace.span(
                     "apiserver.request",
-                    {"http.method": method, "http.path": bare},
+                    {"http.method": method, "http.path": bare,
+                     "http.encoding": encoding},
                     parent=parent,
                 ) as request_span:
                     if flow_ticket is not None:
@@ -1209,6 +1361,7 @@ class ControllerServer:
                     result = self._route_inner(
                         method, path, body, headers,
                         watch_park=watch_park, watch_hint=watch_hint,
+                        body_obj=body_obj,
                     )
                     request_span.set_attribute("http.status", result[0])
                     return self._stamp_replication_headers(result, bare)
@@ -1219,7 +1372,8 @@ class ControllerServer:
                 self.flow.release(flow_ticket)
 
     def _route_inner(self, method: str, path: str, body: bytes, headers=None,
-                     watch_park: bool = True, watch_hint: float = 1.0):
+                     watch_park: bool = True, watch_hint: float = 1.0,
+                     body_obj=None):
         from urllib.parse import parse_qs
 
         path, _, query = path.partition("?")
@@ -1227,6 +1381,10 @@ class ControllerServer:
 
         if path == "/healthz":
             return 200, "ok"
+        if path == "/debug/wire" and method == "GET":
+            # Machine-readable wire schema: version byte, media type,
+            # frame layout, kind-id registry (docs/protocol.md).
+            return 200, wire.schema()
         if path == "/leaderz":
             if self.elector is None:
                 return 200, {"leaderElection": False, "leading": True}
@@ -1383,6 +1541,7 @@ class ControllerServer:
                 return self._watch_resource(
                     kind, ns, rv, timeout_s,
                     park=watch_park, retry_hint=watch_hint,
+                    frames=bool(params.get("frames")),
                 )
 
         if method in ("POST", "PUT", "DELETE", "PATCH"):
@@ -1436,7 +1595,8 @@ class ControllerServer:
 
         with self.lock:
             if path.startswith(self.API_PREFIX):
-                result = self._route_jobsets(method, parts, body)
+                result = self._route_jobsets(method, parts, body,
+                                             body_obj=body_obj)
             elif parts[:2] == ["api", "v1"]:
                 result = self._route_core(method, parts, body, params)
             else:
@@ -1462,13 +1622,28 @@ class ControllerServer:
                     result = (code, payload, ctype, extra)
             return result
 
+    @staticmethod
+    def _load_manifest_body(body: bytes):
+        """Manifest body bytes -> document. JSON is tried first (C-speed
+        parse — the common SDK path); anything else falls back to the
+        YAML loader, preserving the historical Content-Type-sniffing
+        behavior (valid JSON parses identically under both)."""
+        try:
+            return json.loads(body)
+        except ValueError:
+            return yaml.safe_load(body.decode())
+
     def _parse_manifest(self, body: bytes, path_ns: str):
-        """Parse a manifest; the URL-path namespace is authoritative.  A
-        manifest that explicitly names a different namespace is rejected
-        (kube-apiserver behavior); an absent namespace inherits the path's.
-        The raw dict is consulted because ObjectMeta.namespace defaults to
-        'default', which is indistinguishable from 'absent' after parsing."""
-        data = yaml.safe_load(body.decode())
+        return self._manifest_from_dict(self._load_manifest_body(body),
+                                        path_ns)
+
+    def _manifest_from_dict(self, data, path_ns: str):
+        """Admit one manifest document; the URL-path namespace is
+        authoritative.  A manifest that explicitly names a different
+        namespace is rejected (kube-apiserver behavior); an absent
+        namespace inherits the path's. The raw dict is consulted because
+        ObjectMeta.namespace defaults to 'default', which is
+        indistinguishable from 'absent' after parsing."""
         if not isinstance(data, dict):
             raise serialization.SerializationError("manifest body must be a mapping")
         manifest_ns = (data.get("metadata") or {}).get("namespace")
@@ -1491,13 +1666,58 @@ class ControllerServer:
         js.metadata.namespace = path_ns
         return js
 
-    def _route_jobsets(self, method: str, parts: list[str], body: bytes):
+    # Per-item ceiling on the batched verbs: far above any sane round
+    # trip, far below anything that could park the cluster lock for
+    # unbounded time on one request.
+    _BATCH_MAX_ITEMS = 4096
+    # Byte ceiling on batch bodies, enforced BEFORE the pre-admission
+    # parse width accounting requires — bounds the one parse the flow
+    # plane cannot shed its way out of.
+    _BATCH_MAX_BODY_BYTES = 64 << 20
+
+    def _route_jobsets(self, method: str, parts: list[str], body: bytes,
+                       body_obj=None):
         # parts: apis, jobset.x-k8s.io, v1alpha2, namespaces, {ns},
         #        jobsets[, name[, status]]
         # Cluster-scoped admission queues: .../v1alpha2/queues[/{name}[/status]]
         if len(parts) >= 4 and parts[3] == "queues":
-            return self._route_queues(method, parts, body)
-        if len(parts) < 6 or parts[3] != "namespaces" or parts[5] != "jobsets":
+            return self._route_queues(method, parts, body,
+                                      body_obj=body_obj)
+        if len(parts) < 6 or parts[3] != "namespaces":
+            return 404, {"error": "unknown resource"}
+        # Batched verbs (docs/protocol.md): POST .../jobsets:batchCreate
+        # and .../jobsets:batchStatus — per-item semantics, one round
+        # trip, one synchronous reconcile + one WAL fsync covering every
+        # accepted item before the (single) response acknowledges them.
+        if len(parts) == 6 and parts[5].startswith("jobsets:"):
+            verb = parts[5].partition(":")[2]
+            if method != "POST":
+                return 405, {"error": "batch verbs support POST only"}
+            if verb not in ("batchCreate", "batchStatus"):
+                return 404, {"error": f"unknown batch verb {verb!r}"}
+            doc = body_obj
+            if doc is None:
+                try:
+                    doc = self._load_manifest_body(body)
+                except Exception as exc:  # noqa: BLE001 — any parse failure is a client error
+                    return 400, {"error": f"bad batch body: {exc}"}
+            if not isinstance(doc, dict) or not isinstance(
+                doc.get("items"), list
+            ):
+                return 400, {"error": "batch body must be a mapping with "
+                                      "an 'items' list"}
+            items = doc["items"]
+            if len(items) > self._BATCH_MAX_ITEMS:
+                return 413, {"error": (
+                    f"batch of {len(items)} items exceeds the "
+                    f"{self._BATCH_MAX_ITEMS}-item ceiling; split it"
+                )}
+            metrics.http_batch_items_total.inc(amount=len(items))
+            if verb == "batchCreate":
+                return self._batch_create(parts[4], items,
+                                          view=doc.get("view") or "full")
+            return self._batch_status(parts[4], items)
+        if parts[5] != "jobsets":
             return 404, {"error": "unknown resource"}
         ns = parts[4]
         name = parts[6] if len(parts) > 6 else None
@@ -1515,7 +1735,10 @@ class ControllerServer:
             if method != "PUT":
                 return 405, {"error": "status subresource supports GET/PUT only"}
             try:
-                data = yaml.safe_load(body.decode())
+                data = (
+                    body_obj if body_obj is not None
+                    else self._load_manifest_body(body)
+                )
                 status = serialization.status_from_dict(
                     data.get("status", data) or {}
                 )
@@ -1530,7 +1753,11 @@ class ControllerServer:
 
         if method == "POST" and name is None:
             try:
-                js = self._parse_manifest(body, ns)
+                js = (
+                    self._manifest_from_dict(body_obj, ns)
+                    if body_obj is not None
+                    else self._parse_manifest(body, ns)
+                )
             except Exception as exc:
                 return 400, {"error": f"bad manifest: {exc}"}
             try:
@@ -1571,7 +1798,11 @@ class ControllerServer:
 
         if method == "PUT":
             try:
-                updated = self._parse_manifest(body, ns)
+                updated = (
+                    self._manifest_from_dict(body_obj, ns)
+                    if body_obj is not None
+                    else self._parse_manifest(body, ns)
+                )
             except Exception as exc:
                 return 400, {"error": f"bad manifest: {exc}"}
             if updated.metadata.name and updated.metadata.name != name:
@@ -1596,7 +1827,91 @@ class ControllerServer:
 
         return 405, {"error": f"{method} not allowed"}
 
-    def _route_queues(self, method: str, parts: list[str], body: bytes):
+    # ------------------------------------------------------------------
+    # Batched verbs (docs/protocol.md)
+    # ------------------------------------------------------------------
+
+    def _batch_create(self, ns: str, items: list, view: str = "full"):
+        """Per-item create semantics in one round trip: every item runs
+        the full admission chain (schema gate, defaulting, validation)
+        independently — an invalid item answers its own 400/409/422 slot
+        without poisoning siblings — then ONE synchronous reconcile
+        settles every accepted gang and the caller's write path journals
+        them in one fsync'd WAL commit before the response acknowledges
+        anything (fsync-before-ack holds for each item because no item is
+        acknowledged before the shared commit). `view="minimal"` returns
+        per-item name/uid instead of full manifests (bulk loaders)."""
+        if view not in ("full", "minimal"):
+            return 400, {"error": f"unknown batch view {view!r}"}
+        results = []
+        created_any = False
+        # bulk_admission: sibling creates' placement prefetches solve as
+        # one joint assignment at context exit (disjoint plans, zero
+        # reconcile-time re-solves) instead of N colliding solves.
+        with self.cluster.bulk_admission():
+            for item in items:
+                try:
+                    js = self._manifest_from_dict(item, ns)
+                except Exception as exc:  # noqa: BLE001 — per-item client error
+                    results.append({"code": 400,
+                                    "error": f"bad manifest: {exc}"})
+                    continue
+                try:
+                    created = self.cluster.create_jobset(js)
+                except AdmissionError as exc:
+                    code = 409 if "already exists" in str(exc) else 422
+                    results.append({"code": code, "error": str(exc)})
+                    continue
+                created_any = True
+                if view == "minimal":
+                    results.append({
+                        "code": 201,
+                        "name": created.metadata.name,
+                        "namespace": created.metadata.namespace,
+                        "uid": created.metadata.uid,
+                    })
+                else:
+                    results.append({"code": 201,
+                                    "object": _jobset_summary(created)})
+        if created_any:
+            self._reconcile_after_write()
+        return 200, {"kind": "BatchResult", "items": results}
+
+    def _batch_status(self, ns: str, items: list):
+        """Per-item status subresource writes in one round trip: each
+        item is {"name": ..., "status": {...}} (the wire status dict);
+        per-item 200/400/404 codes, one shared reconcile for the
+        accepted set."""
+        results = []
+        changed_any = False
+        for item in items:
+            if not isinstance(item, dict) or not item.get("name"):
+                results.append({"code": 400,
+                                "error": "batch status item needs a name"})
+                continue
+            try:
+                status = serialization.status_from_dict(
+                    item.get("status") or {}
+                )
+            except Exception as exc:  # noqa: BLE001 — per-item client error
+                results.append({"code": 400,
+                                "error": f"bad status: {exc}"})
+                continue
+            try:
+                stored = self.cluster.update_jobset_status(
+                    ns, item["name"], status
+                )
+            except AdmissionError as exc:
+                results.append({"code": 404, "error": str(exc)})
+                continue
+            changed_any = True
+            results.append({"code": 200, "object": _jobset_summary(stored)})
+        if changed_any:
+            self._reconcile_after_write()
+        return 200, {"kind": "BatchResult", "items": results}
+
+    def _route_queues(self, method: str, parts: list[str], body: bytes,
+                      body_obj=None):
         """Admission-queue CRUD + status (docs/queueing.md). Queues are
         cluster-scoped (the ClusterQueue analog); the status endpoint
         surfaces quota usage and the workload list."""
@@ -1606,6 +1921,12 @@ class ControllerServer:
         if manager is None:
             return 404, {"error": "queueing is not enabled on this cluster"}
         name = parts[4] if len(parts) > 4 else None
+
+        def load_queue_body():
+            return (
+                body_obj if body_obj is not None
+                else self._load_manifest_body(body)
+            )
 
         if len(parts) == 6 and parts[5] == "status" and name is not None:
             if method != "GET":
@@ -1617,7 +1938,7 @@ class ControllerServer:
 
         if method == "POST" and name is None:
             try:
-                q = queue_from_dict(yaml.safe_load(body.decode()))
+                q = queue_from_dict(load_queue_body())
             except Exception as exc:
                 return 400, {"error": f"bad queue manifest: {exc}"}
             try:
@@ -1650,7 +1971,7 @@ class ControllerServer:
 
         if method == "PUT":
             try:
-                q = queue_from_dict(yaml.safe_load(body.decode()))
+                q = queue_from_dict(load_queue_body())
             except Exception as exc:
                 return 400, {"error": f"bad queue manifest: {exc}"}
             if q.name and q.name != name:
@@ -2160,10 +2481,19 @@ class ControllerServer:
                     conn.settimeout(None)
                 super().setup()
 
-            def _respond(self, code: int, payload, ctype=None, headers=None):
+            def _respond(self, code: int, payload, ctype=None, headers=None,
+                         binary: bool = False):
                 if isinstance(payload, str):
                     data = payload.encode()
                     ctype = ctype or "text/plain; charset=utf-8"
+                elif binary and ctype is None and code < 400:
+                    # Negotiated binary response (docs/protocol.md): only
+                    # structured 2xx/3xx payloads are framed — errors stay
+                    # JSON so generic tooling and logs can always read a
+                    # failure, and explicit content types (/metrics
+                    # exposition) are never re-encoded.
+                    data = wire.encode(payload)
+                    ctype = wire.CONTENT_TYPE
                 else:
                     data = json.dumps(payload).encode()
                     ctype = ctype or "application/json"
@@ -2178,12 +2508,15 @@ class ControllerServer:
             def _handle(self, method: str):
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else b""
+                accept = self.headers.get("Accept")
                 try:
                     result = server._route(
                         method, self.path, body,
                         headers={
                             "traceparent": self.headers.get("traceparent"),
-                            "accept": self.headers.get("Accept"),
+                            "accept": accept,
+                            # Wire-encoding negotiation (docs/protocol.md).
+                            "content-type": self.headers.get("Content-Type"),
                             # Flow distinguisher input: one tenant's storm
                             # shuffle-shards apart from another's.
                             "user-agent": self.headers.get("User-Agent"),
@@ -2191,7 +2524,7 @@ class ControllerServer:
                     )
                 except Exception as exc:  # route bug -> 500, keep serving
                     result = 500, {"error": f"{type(exc).__name__}: {exc}"}
-                self._respond(*result)
+                self._respond(*result, binary=wire.accepts_binary(accept))
 
             def do_GET(self):
                 self._handle("GET")

@@ -1,0 +1,271 @@
+"""Binary wire plane: content-negotiated frame codec for the HTTP path.
+
+The apiserver's default exchange format is JSON (YAML accepted on
+manifest bodies), which every existing client keeps using untouched.
+This module adds the opt-in fast path (docs/protocol.md): a client that
+sends ``Content-Type: application/vnd.jobset.binary`` ships its request
+body as a *wire frame*, and one that sends the same media type in
+``Accept`` gets its response framed the same way.
+
+A frame reuses the store's proven framing discipline
+(``store/wal.py``: length + CRC32 + canonical JSON payload) with a
+negotiation header in front::
+
+    +-------+---------+---------+----------------+----------------+---------+
+    | magic | version | kind id | length (u32 LE)| crc32 (u32 LE) | payload |
+    | 2B JW |  u8     |  u8     |                |                | length  |
+    +-------+---------+---------+----------------+----------------+---------+
+
+The payload is the *canonical JSON* encoding (``store/codec.canonical``:
+sorted keys, no whitespace) of exactly the same document the JSON path
+carries — so the two encodings are interchangeable object-for-object,
+and the store codecs' fixed point (``encode(decode(encode(x))) ==
+encode(x)``, tests/test_store.py) extends to the wire: a manifest that
+round-trips the JSON path round-trips the binary path byte-identically.
+The CRC makes a truncated or corrupted body a loud 400 instead of a
+silently mis-parsed manifest.
+
+The *kind id* byte exposes the store codec registry as a wire schema
+(``schema()``): id 0 is the generic API document (requests, responses,
+lists, watch frames — anything the JSON path would carry), ids >= 1 name
+the per-kind store codecs in sorted registry order. Generic frames are
+all the HTTP path needs; the per-kind ids exist so schema-aware tooling
+(the ``bench.py --wire`` microbench, future replication transports) can
+tag payloads without a side channel. An unknown *version* byte is
+rejected — the version is the compatibility contract, negotiated
+implicitly by the media type (v1 is the only version this tree speaks).
+
+Watch-frame delta compression (``delta``/``apply_delta``) also lives
+here: coalesced watch responses carry later events for an object a
+frame has already shipped as sparse set/del operations against the
+in-frame predecessor instead of a full re-serialization
+(docs/protocol.md "Coalesced watch frames").
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import zlib
+from typing import Optional
+
+# The negotiated media type (request AND response side).
+CONTENT_TYPE = "application/vnd.jobset.binary"
+
+# Wire format version: bumped on any frame-layout or payload-contract
+# change; a decoder that sees a version it does not speak must reject
+# the frame (never guess).
+VERSION = 1
+
+MAGIC = b"JW"
+_HEADER = struct.Struct("<II")  # (payload length, payload crc32)
+_PREFIX_LEN = len(MAGIC) + 2  # magic + version + kind id
+
+# Generic API document (the only kind id the HTTP path itself uses).
+KIND_OBJECT = 0
+
+# Batched-verb path suffixes (AIP custom-verb style): POST
+# .../jobsets:batchCreate and .../jobsets:batchStatus. Shared protocol
+# constant — the server's router, the flow classifier, and the client
+# SDK all derive from it.
+BATCH_SUFFIXES = (":batchCreate", ":batchStatus")
+
+
+class WireError(ValueError):
+    """Malformed, truncated, corrupt, or wrong-version wire frame."""
+
+
+def _canonical(obj) -> bytes:
+    # store/codec.canonical's encoding (sorted keys, no whitespace),
+    # inlined bytes-side so client-side encoding does not import the
+    # store plane (and its numpy dependencies) into the stdlib-light SDK.
+    return json.dumps(obj, sort_keys=True, separators=(",", ":")).encode()
+
+
+def kind_ids() -> dict[str, int]:
+    """The wire schema's kind registry: store codec kinds in sorted
+    order, ids starting at 1 (0 is the generic API document). Lazy
+    import: the SDK encodes generic frames without pulling the store
+    plane in."""
+    from .store.codec import CODECS
+
+    ids = {"object": KIND_OBJECT}
+    for i, kind in enumerate(sorted(CODECS), start=1):
+        ids[kind] = i
+    return ids
+
+
+def schema() -> dict:
+    """Machine-readable wire schema (served at ``GET /debug/wire``):
+    version byte, media type, frame layout, and the kind-id registry."""
+    return {
+        "version": VERSION,
+        "contentType": CONTENT_TYPE,
+        "frame": {
+            "magic": MAGIC.decode(),
+            "layout": "magic(2) version(u8) kind(u8) length(u32le) "
+                      "crc32(u32le) payload(canonical JSON, length bytes)",
+        },
+        "kinds": kind_ids(),
+    }
+
+
+def encode(obj, kind_id: int = KIND_OBJECT) -> bytes:
+    """Python document -> one wire frame."""
+    payload = _canonical(obj)
+    return b"".join((
+        MAGIC,
+        bytes((VERSION, kind_id)),
+        _HEADER.pack(len(payload), zlib.crc32(payload)),
+        payload,
+    ))
+
+
+def decode_frame(data: bytes) -> tuple[object, int]:
+    """One wire frame -> (document, kind id). Raises WireError on a bad
+    magic, unknown version, short frame, CRC mismatch, trailing junk, or
+    a payload that is not valid JSON."""
+    if len(data) < _PREFIX_LEN + _HEADER.size:
+        raise WireError("wire frame shorter than its header")
+    if data[: len(MAGIC)] != MAGIC:
+        raise WireError("bad wire frame magic (not a binary frame?)")
+    version, kind_id = data[len(MAGIC)], data[len(MAGIC) + 1]
+    if version != VERSION:
+        raise WireError(
+            f"unsupported wire version {version} (this server speaks "
+            f"{VERSION}); fall back to application/json"
+        )
+    length, crc = _HEADER.unpack_from(data, _PREFIX_LEN)
+    start = _PREFIX_LEN + _HEADER.size
+    payload = data[start : start + length]
+    if len(payload) != length:
+        raise WireError(
+            f"truncated wire frame: want {length} payload bytes, "
+            f"got {len(payload)}"
+        )
+    if len(data) != start + length:
+        raise WireError("trailing bytes after wire frame")
+    if zlib.crc32(payload) != crc:
+        raise WireError("wire frame CRC mismatch (corrupt payload)")
+    try:
+        return json.loads(payload), kind_id
+    except json.JSONDecodeError as exc:
+        raise WireError(
+            f"wire frame payload is not valid JSON: {exc}"
+        ) from exc
+
+
+def decode(data: bytes):
+    """One wire frame -> document (kind id discarded)."""
+    return decode_frame(data)[0]
+
+
+def peek_payload(data: bytes, limit: int = 4096) -> bytes:
+    """The first `limit` payload bytes of a frame WITHOUT validating it
+    (no CRC, no length check) — for cheap pre-admission classification
+    peeks only (the payload is canonical JSON text, so byte-level regex
+    peeks like the flow plane's spec.priority scan work on it). Returns
+    b"" for anything too short to be a frame."""
+    start = _PREFIX_LEN + _HEADER.size
+    if len(data) <= start or data[: len(MAGIC)] != MAGIC:
+        return b""
+    return data[start : start + limit]
+
+
+# ---------------------------------------------------------------------------
+# Content negotiation
+# ---------------------------------------------------------------------------
+
+
+def is_binary_content_type(content_type: Optional[str]) -> bool:
+    return bool(content_type) and content_type.split(";")[0].strip() == (
+        CONTENT_TYPE
+    )
+
+
+def accepts_binary(accept: Optional[str]) -> bool:
+    """Whether an Accept header asks for the binary encoding. Exact
+    media-type match only: ``*/*`` and ``application/*`` keep getting
+    JSON — a generic client must never receive frames it cannot parse."""
+    if not accept:
+        return False
+    return any(
+        part.split(";")[0].strip() == CONTENT_TYPE
+        for part in accept.split(",")
+    )
+
+
+def negotiate(headers: Optional[dict]) -> tuple[bool, bool]:
+    """(request body is binary, response should be binary) from the
+    request headers — a pure function of Content-Type/Accept with no
+    side effects, so it may run before flow admission (a shed 429 must
+    still honor the client's Accept without having touched anything)."""
+    headers = headers or {}
+    return (
+        is_binary_content_type(headers.get("content-type")),
+        accepts_binary(headers.get("accept")),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Watch-frame delta compression
+# ---------------------------------------------------------------------------
+#
+# Ops are flat [op, path, value?] triples over RFC 6901 pointer paths:
+# ["set", "/status/replicatedJobsStatus/0", {...}] assigns (creating the
+# key), ["del", "/metadata/labels/stale"] removes. Dicts recurse;
+# lists are replaced wholesale when unequal (watch diffs overwhelmingly
+# touch scalar status fields — element-wise list diffs don't pay for
+# their decode complexity on this wire).
+
+
+def _escape(token: str) -> str:
+    return token.replace("~", "~0").replace("/", "~1")
+
+
+def _unescape(token: str) -> str:
+    return token.replace("~1", "/").replace("~0", "~")
+
+
+def delta(old, new, path: str = "") -> list:
+    """Sparse ops transforming `old` into `new`; [] when equal."""
+    if isinstance(old, dict) and isinstance(new, dict):
+        ops: list = []
+        for key, value in new.items():
+            sub = f"{path}/{_escape(str(key))}"
+            if key not in old:
+                ops.append(["set", sub, value])
+            else:
+                ops.extend(delta(old[key], value, sub))
+        for key in old:
+            if key not in new:
+                ops.append(["del", f"{path}/{_escape(str(key))}"])
+        return ops
+    if old != new:
+        return [["set", path, new]]
+    return []
+
+
+def apply_delta(old, ops: list):
+    """Replay `ops` (from :func:`delta`) onto a deep copy of `old`."""
+    import copy
+
+    doc = copy.deepcopy(old)
+    for op in ops:
+        name, path = op[0], op[1]
+        tokens = [_unescape(t) for t in path.split("/")[1:]]
+        if not tokens:
+            if name != "set":
+                raise WireError("cannot delete the document root")
+            doc = copy.deepcopy(op[2])
+            continue
+        parent = doc
+        for token in tokens[:-1]:
+            parent = parent[token]
+        if name == "set":
+            parent[tokens[-1]] = copy.deepcopy(op[2])
+        elif name == "del":
+            parent.pop(tokens[-1], None)
+        else:
+            raise WireError(f"unknown delta op {name!r}")
+    return doc

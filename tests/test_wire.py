@@ -1,0 +1,808 @@
+"""Fast wire plane tests (docs/protocol.md): binary codec negotiation,
+batched verbs, coalesced watch frames, keep-alive transport, bulk
+admission, and the compile-once/residency satellites.
+
+The interop contract under test: the binary encoding and the JSON path
+carry the SAME documents (object-for-object equality both directions),
+an old client against a new server and a new client against an old
+server both keep working, and batch verbs have per-item semantics — an
+invalid item never poisons siblings, and the WAL holds exactly the
+successes.
+"""
+
+import json
+import os
+import threading
+import urllib.request
+
+import pytest
+
+from jobset_tpu import wire
+from jobset_tpu.api import serialization
+from jobset_tpu.client import ApiError, JobSetClient
+from jobset_tpu.core import features, make_cluster
+from jobset_tpu.server import ControllerServer
+from jobset_tpu.testing import make_jobset, make_replicated_job
+
+
+def _manifest(name, replicas=1, namespace=None):
+    js = (
+        make_jobset(name)
+        .replicated_job(
+            make_replicated_job("w").replicas(replicas)
+            .parallelism(1).completions(1).obj()
+        )
+        .obj()
+    )
+    doc = serialization.to_dict(js)
+    if namespace:
+        doc.setdefault("metadata", {})["namespace"] = namespace
+    return doc
+
+
+@pytest.fixture()
+def server():
+    s = ControllerServer("127.0.0.1:0", tick_interval=0.05).start()
+    yield s
+    s.stop()
+
+
+@pytest.fixture()
+def client(server):
+    return JobSetClient(f"http://{server.address}")
+
+
+@pytest.fixture()
+def binary_client(server):
+    return JobSetClient(f"http://{server.address}", encoding="binary")
+
+
+# ---------------------------------------------------------------------------
+# Frame codec
+# ---------------------------------------------------------------------------
+
+
+class TestFrameCodec:
+    def test_round_trip_all_kinds(self):
+        """Every store codec dict round-trips the binary frame exactly,
+        and re-encoding the decode is byte-identical (the codec fixed
+        point extended to the wire)."""
+        from jobset_tpu.queue import Queue
+        from jobset_tpu.store import codec
+
+        cluster = make_cluster()
+        cluster.add_node("n0", labels={"tpu-slice": "a"}, capacity=16)
+        cluster.create_jobset(
+            make_jobset("wire-rt")
+            .replicated_job(
+                make_replicated_job("w").replicas(2)
+                .parallelism(2).completions(2).obj()
+            )
+            .obj()
+        )
+        cluster.run_until_stable()
+        from jobset_tpu.queue.manager import Workload
+
+        samples = {
+            "jobsets": next(iter(cluster.jobsets.values())),
+            "jobs": next(iter(cluster.jobs.values())),
+            "pods": next(iter(cluster.pods.values())),
+            "services": next(iter(cluster.services.values())),
+            "nodes": next(iter(cluster.nodes.values())),
+            "queues": Queue(name="q", quota={"pods": 4.0}),
+            "workloads": Workload(
+                key=("default", "wire-rt"), uid="u1", queue="q",
+                priority=0, request={"pods": 2.0}, arrival=1,
+                state="Pending",
+            ),
+        }
+        ids = wire.kind_ids()
+        assert set(samples) | {"object"} == set(ids)
+        for kind, obj in samples.items():
+            encode, _ = codec.CODECS[kind]
+            doc = encode(obj)
+            frame = wire.encode(doc, kind_id=ids[kind])
+            decoded, kind_id = wire.decode_frame(frame)
+            assert decoded == doc
+            assert kind_id == ids[kind]
+            assert wire.encode(decoded, kind_id=ids[kind]) == frame
+
+    def test_corruption_is_loud(self):
+        frame = bytearray(wire.encode({"a": 1}))
+        frame[-1] ^= 0xFF
+        with pytest.raises(wire.WireError, match="CRC"):
+            wire.decode(bytes(frame))
+
+    def test_truncation_is_loud(self):
+        frame = wire.encode({"a": [1, 2, 3]})
+        with pytest.raises(wire.WireError, match="truncated|shorter"):
+            wire.decode(frame[:-2])
+
+    def test_trailing_bytes_rejected(self):
+        with pytest.raises(wire.WireError, match="trailing"):
+            wire.decode(wire.encode({}) + b"x")
+
+    def test_unknown_version_rejected(self):
+        frame = bytearray(wire.encode({"a": 1}))
+        frame[2] = 99
+        with pytest.raises(wire.WireError, match="version"):
+            wire.decode(bytes(frame))
+
+    def test_not_a_frame_rejected(self):
+        with pytest.raises(wire.WireError, match="magic|shorter"):
+            wire.decode(b'{"json": "body"}')
+
+    def test_negotiation_is_exact_media_type(self):
+        assert wire.negotiate(
+            {"content-type": wire.CONTENT_TYPE, "accept": wire.CONTENT_TYPE}
+        ) == (True, True)
+        assert wire.negotiate(
+            {"content-type": "application/json", "accept": "*/*"}
+        ) == (False, False)
+        # */* and application/* must NOT elect binary.
+        assert not wire.accepts_binary("application/*")
+        assert wire.accepts_binary(
+            f"application/json, {wire.CONTENT_TYPE};q=0.9"
+        )
+
+    def test_delta_round_trip(self):
+        old = {"a": {"b": 1, "c": [1, 2]}, "drop": "me", "keep": "x"}
+        new = {"a": {"b": 2, "c": [1, 2, 3], "d": None}, "keep": "x"}
+        ops = wire.delta(old, new)
+        assert wire.apply_delta(old, ops) == new
+        assert wire.delta(new, new) == []
+        # Escaped pointer tokens survive.
+        o2 = {"we/ird~key": 1}
+        n2 = {"we/ird~key": 2}
+        assert wire.apply_delta(o2, wire.delta(o2, n2)) == n2
+
+
+# ---------------------------------------------------------------------------
+# HTTP negotiation interop
+# ---------------------------------------------------------------------------
+
+
+class TestNegotiationInterop:
+    def test_binary_create_equals_json_create(self, server, client,
+                                              binary_client):
+        """The stored object is identical whichever encoding carried it."""
+        a = client.create(_manifest("json-a"))
+        b = binary_client.create(_manifest("bin-b"))
+        raw_a = client.get_raw("json-a")
+        raw_b = client.get_raw("bin-b")
+        # Same document through both encodings and both Accept sides.
+        assert binary_client.get_raw("json-a") == raw_a
+        assert client.get_raw("bin-b") == raw_b
+        assert a.metadata.name == "json-a" and b.metadata.name == "bin-b"
+        for doc in (raw_a, raw_b):
+            doc = dict(doc)
+            for d in (raw_a, raw_b):
+                assert d["kind"] == "JobSet"
+
+    def test_json_client_against_binary_preferring_server(self, server):
+        """An old JSON client never sees a frame: binary is strictly
+        opt-in by Accept, whatever other clients negotiated."""
+        bin_client = JobSetClient(f"http://{server.address}",
+                                  encoding="binary")
+        bin_client.create(_manifest("mixed"))
+        req = urllib.request.Request(
+            f"http://{server.address}"
+            "/apis/jobset.x-k8s.io/v1alpha2/namespaces/default/jobsets/mixed"
+        )
+        with urllib.request.urlopen(req) as resp:
+            assert resp.headers["Content-Type"].startswith(
+                "application/json"
+            )
+            doc = json.loads(resp.read())
+        assert doc["metadata"]["name"] == "mixed"
+
+    def test_binary_response_content_type(self, server, binary_client):
+        binary_client.create(_manifest("ct"))
+        req = urllib.request.Request(
+            f"http://{server.address}"
+            "/apis/jobset.x-k8s.io/v1alpha2/namespaces/default/jobsets/ct",
+            headers={"Accept": wire.CONTENT_TYPE},
+        )
+        with urllib.request.urlopen(req) as resp:
+            assert resp.headers["Content-Type"] == wire.CONTENT_TYPE
+            doc = wire.decode(resp.read())
+        assert doc["metadata"]["name"] == "ct"
+
+    def test_errors_stay_json_even_when_binary_negotiated(self, server,
+                                                          binary_client):
+        """Failure payloads are always JSON — generic tooling must be
+        able to read an error regardless of negotiation."""
+        with pytest.raises(ApiError) as err:
+            binary_client.get("never-created")
+        assert err.value.status == 404
+        assert "not found" in err.value.message
+
+    def test_corrupt_binary_body_is_400_with_no_side_effects(self, server,
+                                                             client):
+        frame = bytearray(wire.encode(_manifest("poisoned")))
+        frame[-1] ^= 0xFF
+        req = urllib.request.Request(
+            f"http://{server.address}"
+            "/apis/jobset.x-k8s.io/v1alpha2/namespaces/default/jobsets",
+            data=bytes(frame), method="POST",
+            headers={"Content-Type": wire.CONTENT_TYPE},
+        )
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(req)
+        assert err.value.code == 400
+        assert client.list() == []
+
+    def test_wire_schema_endpoint(self, client):
+        schema = client._request("GET", "/debug/wire")
+        assert schema["version"] == wire.VERSION
+        assert schema["contentType"] == wire.CONTENT_TYPE
+        assert schema["kinds"]["object"] == 0
+        assert set(schema["kinds"]) > {"jobsets", "pods", "nodes"}
+
+    def test_encoding_metric_counts(self, server, client, binary_client):
+        from jobset_tpu.core import metrics
+
+        before_json = metrics.http_encoding_total.value("json")
+        before_bin = metrics.http_encoding_total.value("binary")
+        client.create(_manifest("m1"))
+        binary_client.create(_manifest("m2"))
+        assert metrics.http_encoding_total.value("json") > before_json
+        assert metrics.http_encoding_total.value("binary") > before_bin
+
+
+# ---------------------------------------------------------------------------
+# Batched verbs
+# ---------------------------------------------------------------------------
+
+
+class TestBatchVerbs:
+    def test_batch_create_round_trip(self, server, client, binary_client):
+        items = binary_client.batch_create(
+            [_manifest(f"bt-{i}") for i in range(5)]
+        )
+        assert [i["code"] for i in items] == [201] * 5
+        assert sorted(
+            i["object"]["metadata"]["name"] for i in items
+        ) == [f"bt-{i}" for i in range(5)]
+        assert len(client.list()) == 5
+
+    def test_partial_failure_does_not_poison_siblings(self, server, client):
+        """Per-item semantics: a bad item answers its own 4xx slot;
+        siblings land normally, in order."""
+        items = client.batch_create([
+            _manifest("ok-1"),
+            _manifest("ns-clash", namespace="elsewhere"),  # 400: ns mismatch
+            _manifest("ok-1"),                             # 409: duplicate
+            _manifest("ok-2"),
+        ])
+        assert [i["code"] for i in items] == [201, 400, 409, 201]
+        assert "namespace" in items[1]["error"]
+        assert "already exists" in items[2]["error"]
+        assert sorted(
+            js.metadata.name for js in client.list()
+        ) == ["ok-1", "ok-2"]
+
+    def test_minimal_view(self, server, binary_client):
+        items = binary_client.batch_create(
+            [_manifest("mv-0")], view="minimal"
+        )
+        assert items[0]["code"] == 201
+        assert items[0]["name"] == "mv-0"
+        assert "object" not in items[0]
+
+    def test_batch_status(self, server, client):
+        client.batch_create([_manifest("bs-0"), _manifest("bs-1")])
+        items = client.batch_update_status([
+            {"name": "bs-0", "status": {"restarts": 2}},
+            {"name": "missing", "status": {"restarts": 1}},
+            {"status": {"restarts": 1}},  # no name -> per-item 400
+        ])
+        assert [i["code"] for i in items] == [200, 404, 400]
+        assert client.get_raw("bs-0")["status"]["restarts"] == 2
+
+    def test_batch_items_metric(self, server, client):
+        from jobset_tpu.core import metrics
+
+        before = metrics.http_batch_items_total.total()
+        client.batch_create([_manifest(f"bm-{i}") for i in range(3)])
+        assert metrics.http_batch_items_total.total() == before + 3
+
+    def test_oversized_batch_is_413(self, server, client):
+        with pytest.raises(ApiError) as err:
+            client._request(
+                "POST",
+                f"{client.API}/namespaces/default/jobsets:batchCreate",
+                json.dumps(
+                    {"items": [{} for _ in range(4097)]}
+                ).encode(),
+            )
+        assert err.value.status == 413
+
+    def test_unknown_batch_verb_404(self, server, client):
+        with pytest.raises(ApiError) as err:
+            client._request(
+                "POST",
+                f"{client.API}/namespaces/default/jobsets:batchFrobnicate",
+                b'{"items": []}',
+            )
+        assert err.value.status == 404
+
+    def test_wal_holds_exactly_the_successes(self, tmp_path):
+        """Batch partial failure + durability: after a hard kill, the
+        recovered cluster holds every accepted item and nothing else —
+        the per-item 4xx left no WAL record behind."""
+        from jobset_tpu.store import Store
+
+        data_dir = str(tmp_path / "store")
+        os.makedirs(data_dir)
+        cluster = make_cluster()
+        store = Store(data_dir)
+        store.recover(cluster)
+        server = ControllerServer(
+            "127.0.0.1:0", cluster=cluster, tick_interval=0.05
+        ).start()
+        try:
+            client = JobSetClient(f"http://{server.address}",
+                                  encoding="binary")
+            items = client.batch_create([
+                _manifest("durable-0"),
+                _manifest("bad", namespace="elsewhere"),
+                _manifest("durable-1"),
+            ])
+            assert [i["code"] for i in items] == [201, 400, 201]
+        finally:
+            server.stop()
+        store.hard_kill()
+        fresh = make_cluster()
+        recovered = Store(data_dir)
+        recovered.recover(fresh)
+        try:
+            assert sorted(
+                name for _, name in fresh.jobsets
+            ) == ["durable-0", "durable-1"]
+        finally:
+            recovered.close()
+
+    def test_bulk_admission_plans_are_disjoint(self):
+        """The :batchCreate bulk-admission path solves ONE joint
+        assignment: sibling gangs come out on disjoint exclusive domains
+        with no reconcile-time re-solves (the collide-then-re-solve
+        behavior this path exists to remove)."""
+        from jobset_tpu.placement import provider as provider_mod
+
+        cluster = make_cluster()
+        for d in range(8):
+            for n in range(2):
+                cluster.add_node(
+                    f"d{d}-n{n}", labels={"tpu-slice": f"s{d}"},
+                    capacity=110,
+                )
+        server = ControllerServer(
+            "127.0.0.1:0", cluster=cluster, tick_interval=0.05
+        )
+        solve_calls = {"n": 0}
+        orig = provider_mod.SolverPlacement._fetch_valid_plan
+
+        def counting_fetch(self, *a, **k):
+            plan = orig(self, *a, **k)
+            if plan is None:
+                solve_calls["n"] += 1
+            return plan
+
+        manifests = [
+            serialization.to_dict(
+                make_jobset(f"gang-{i}")
+                .exclusive_placement("tpu-slice")
+                .replicated_job(
+                    make_replicated_job("w").replicas(2)
+                    .parallelism(2).completions(2).obj()
+                )
+                .obj()
+            )
+            for i in range(4)
+        ]
+        with features.gate("TPUPlacementSolver", True):
+            server.start()
+            try:
+                client = JobSetClient(f"http://{server.address}")
+                provider_mod.SolverPlacement._fetch_valid_plan = (
+                    counting_fetch
+                )
+                try:
+                    items = client.batch_create(manifests)
+                finally:
+                    provider_mod.SolverPlacement._fetch_valid_plan = orig
+                assert [i["code"] for i in items] == [201] * 4
+                with server.lock:
+                    domains = {}
+                    for pod in cluster.pods.values():
+                        assert pod.spec.node_name, "pod unbound"
+                        dom = pod.spec.node_selector.get("tpu-slice")
+                        owner = pod.labels.get("jobset.x-k8s.io/jobset-name")
+                        domains.setdefault(dom, set()).add(owner)
+                    # Exclusive: one jobset... one JOB per domain; no
+                    # domain shared across jobsets.
+                    for dom, owners in domains.items():
+                        assert len(owners) == 1, (dom, owners)
+            finally:
+                server.stop()
+        # Every creation pass consumed its prefetched joint plan: zero
+        # fresh reconcile-time solves.
+        assert solve_calls["n"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Coalesced watch frames
+# ---------------------------------------------------------------------------
+
+
+class TestWatchFrames:
+    def _legacy_watch(self, server, rv=0, timeout=2.0):
+        """A pre-frames client: no frames=1 parameter, legacy event list."""
+        url = (
+            f"http://{server.address}"
+            f"/apis/jobset.x-k8s.io/v1alpha2/namespaces/default/jobsets"
+            f"?watch=1&resourceVersion={rv}&timeoutSeconds={timeout}"
+        )
+        with urllib.request.urlopen(url) as resp:
+            return json.loads(resp.read())
+
+    def test_frames_equal_legacy_events(self, server, client):
+        """The coalesced frame expands to exactly the legacy per-event
+        list — same objects, same rvs, same types — including
+        delta-compressed repeat MODIFIEDs."""
+        client.create(_manifest("wf-a"))
+        client.suspend("wf-a")
+        client.resume("wf-a")
+        legacy = self._legacy_watch(server)
+        events, rv = client.watch_resource("jobsets", timeout=2.0)
+        assert rv == legacy["resourceVersion"]
+        assert events == legacy["events"]
+        types = [e["type"] for e in events]
+        assert types[0] == "ADDED"
+        assert "MODIFIED" in types
+
+    def test_repeat_modifieds_are_patch_compressed(self, server, client):
+        client.create(_manifest("wf-d"))
+        for _ in range(3):
+            client.suspend("wf-d")
+            client.resume("wf-d")
+        raw = client._request(
+            "GET",
+            f"{client.API}/namespaces/default/jobsets?watch=1"
+            f"&resourceVersion=0&timeoutSeconds=2&frames=1",
+        )
+        frame = raw["frame"]
+        kinds = [entry[1] for entry in frame["events"]]
+        assert kinds.count("PATCH") >= 2
+        # And the wire metric counted the frame.
+        from jobset_tpu.core import metrics
+
+        assert metrics.watch_frames_total.total() >= 1
+
+    def test_continuity_across_410_relist(self, server, client):
+        """Frames honor the journal-window contract: an evicted rv gets
+        410 + a relist token, and resuming from the relist rv streams
+        coalesced frames again with no gap."""
+        server._watch_limit = 4
+        client.batch_create([_manifest(f"wf-r{i}") for i in range(8)])
+        with pytest.raises(Exception) as err:
+            client.watch_resource("jobsets", resource_version=1,
+                                  timeout=1.0)
+        from jobset_tpu.client import WatchGone
+
+        assert isinstance(err.value, WatchGone)
+        items, rv = client.list_with_version()
+        assert len(items) == 8
+        client.create(_manifest("wf-after"))
+        events, new_rv = client.watch_resource(
+            "jobsets", resource_version=rv, timeout=2.0
+        )
+        assert [e["object"]["metadata"]["name"] for e in events] == [
+            "wf-after"
+        ]
+        assert new_rv > rv
+
+    def test_informer_over_frames(self, server, client):
+        """The informer stack rides the frame-coalesced watch unchanged:
+        adds/updates/deletes all observed."""
+        from jobset_tpu.client import JobSetInformer
+
+        seen = {"add": [], "update": [], "delete": []}
+        informer = JobSetInformer(
+            client,
+            poll_timeout=1.0,
+            on_add=lambda o: seen["add"].append(o["metadata"]["name"]),
+            on_update=lambda old, new: seen["update"].append(
+                new["metadata"]["name"]
+            ),
+            on_delete=lambda o: seen["delete"].append(o["metadata"]["name"]),
+        ).start()
+        try:
+            client.create(_manifest("inf-a"))
+            client.suspend("inf-a")
+            client.delete("inf-a")
+            deadline = threading.Event()
+            for _ in range(100):
+                if seen["delete"]:
+                    break
+                deadline.wait(0.05)
+            assert "inf-a" in seen["add"]
+            assert "inf-a" in seen["update"]
+            assert "inf-a" in seen["delete"]
+        finally:
+            informer.stop()
+
+
+# ---------------------------------------------------------------------------
+# Keep-alive transport
+# ---------------------------------------------------------------------------
+
+
+class TestKeepAlive:
+    def test_connection_is_reused(self, server, client):
+        client.create(_manifest("ka-0"))
+        conn1 = client._pool._local.conn
+        client.get_raw("ka-0")
+        client.list()
+        assert client._pool._local.conn is conn1
+
+    def test_stale_connection_recovers(self, server, client):
+        """A keep-alive connection the server closed under us is retried
+        once on a fresh socket instead of failing the request."""
+        client.create(_manifest("ka-1"))
+        # Sabotage: close the pooled socket behind the pool's back.
+        client._pool._local.conn.sock.close()
+        assert client.get("ka-1").metadata.name == "ka-1"
+
+    def test_close_then_reuse(self, server, client):
+        client.create(_manifest("ka-2"))
+        client.close()
+        assert client.get("ka-2").metadata.name == "ka-2"
+
+
+# ---------------------------------------------------------------------------
+# Flow integration (batch width accounting)
+# ---------------------------------------------------------------------------
+
+
+class TestBatchFlow:
+    def test_batch_verb_classified_to_batch_schema(self):
+        """Batches inherit the priority split: best-effort batches land
+        in workload-low like their single-write peers (batching must
+        never escalate priority); a batch carrying a protected item
+        rides workload-high like that item would alone."""
+        from jobset_tpu.flow import config as flow_config
+
+        body = json.dumps({"items": [{} for _ in range(7)]}).encode()
+        info = flow_config.request_info(
+            "POST",
+            "/apis/jobset.x-k8s.io/v1alpha2/namespaces/default"
+            "/jobsets:batchCreate",
+            body=body,
+            body_obj=json.loads(body),
+        )
+        assert info.verb == "batch"
+        assert info.items == 7
+        assert info.priority is None
+        assert flow_config.classify(info) == flow_config.LEVEL_LOW
+        high = flow_config.request_info(
+            "POST",
+            "/apis/jobset.x-k8s.io/v1alpha2/namespaces/default"
+            "/jobsets:batchCreate",
+            body_obj={"items": [
+                {"spec": {"priority": 5}},
+                {"spec": {"priority": 150}},
+            ]},
+        )
+        assert high.items == 2
+        assert high.priority == 150
+        assert flow_config.classify(high) == flow_config.LEVEL_HIGH
+
+    def test_width_seat_accounting(self):
+        from jobset_tpu.flow import config as flow_config
+        from jobset_tpu.flow.controller import FlowController
+
+        levels = (
+            flow_config.PriorityLevel("workload-high", seats=4),
+            flow_config.PriorityLevel("exempt", seats=0),
+            flow_config.PriorityLevel("system", seats=4),
+            flow_config.PriorityLevel("workload-low", seats=4),
+            flow_config.PriorityLevel("watch", seats=4),
+        )
+        fc = FlowController(levels=levels)
+        info = flow_config.RequestInfo(
+            method="POST", path="/apis/jobset.x-k8s.io/v1alpha2/x",
+            verb="batch", kind="jobsets", namespace="default",
+            user_agent="t", items=3,
+        )
+        assert flow_config.classify(info) == "workload-low"
+        ticket = fc.admit(info)
+        assert ticket.decision == "execute"
+        assert ticket.width == 3
+        assert fc._levels["workload-low"].executing == 3
+        # One more wide batch: a seat is still free (3 < 4), so it
+        # admits and overshoots for its own duration (APF width rule).
+        t2 = fc.admit(info)
+        assert t2.decision == "execute"
+        assert fc._levels["workload-low"].executing == 6
+        # Now saturated: the next arrival sheds.
+        t3 = fc.admit(info, block=False)
+        assert t3.decision in ("reject", "queued")
+        fc.release(ticket)
+        fc.release(t2)
+        assert fc._levels["workload-low"].executing == 0
+
+    def test_shed_batch_has_no_side_effects(self):
+        from jobset_tpu.flow import config as flow_config
+        from jobset_tpu.flow.controller import FlowController
+
+        levels = tuple(
+            flow_config.PriorityLevel(name, seats=(0 if name == "exempt"
+                                                   else 1))
+            for name in ("exempt", "system", "workload-high",
+                         "workload-low", "watch")
+        )
+        fc = FlowController(levels=levels)
+        cluster = make_cluster()
+        server = ControllerServer(
+            "127.0.0.1:0", cluster=cluster, tick_interval=0.05, flow=fc
+        ).start()
+        try:
+            held = fc.hold("workload-low", 1)
+            client = JobSetClient(f"http://{server.address}",
+                                  encoding="binary")
+            with pytest.raises(ApiError) as err:
+                client.batch_create([_manifest("shed-0")])
+            assert err.value.status == 429
+            assert err.value.retry_after is not None
+            with server.lock:
+                assert not cluster.jobsets
+            for t in held:
+                fc.release(t)
+            items = client.batch_create([_manifest("shed-0")])
+            assert items[0]["code"] == 201
+        finally:
+            server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Satellites: compile-once scorer bucket, storm residency
+# ---------------------------------------------------------------------------
+
+
+class TestScorerHighWater:
+    def test_shrinking_candidates_compile_once(self):
+        import numpy as np
+
+        from jobset_tpu.queue import scorer
+
+        def snap(p):
+            return scorer.Snapshot(
+                resources=["pods"],
+                queue_names=[f"q{i}" for i in range(3)],
+                nominal=np.full((3, 1), 16.0, np.float32),
+                declared=np.ones((3, 1), bool),
+                usage=np.zeros((3, 1), np.float32),
+                weight=np.ones(3, np.float32),
+                cohort=np.full(3, -1, np.int32),
+                num_cohorts=0,
+                request=np.ones((p, 1), np.float32),
+                queue_index=np.zeros(p, np.int32),
+            )
+
+        with features.gate("TPUQueueScorer", True):
+            scorer._kernel.cache_clear()
+            scorer._P_HIGH_WATER.clear()
+            results = {}
+            for p in (130, 64, 31, 9, 2):
+                results[p] = scorer.score(snap(p))
+            # ONE kernel for the whole shrinking ladder (the high-water
+            # bucket), not one per pow2 shape.
+            assert scorer._kernel.cache_info().currsize == 1
+        # Bit-identical to the greedy backend at every size (padding to
+        # the high-water bucket must not perturb real rows).
+        for p, jit_result in results.items():
+            greedy = scorer._score_greedy(snap(p))
+            assert (jit_result.feasible == greedy.feasible).all()
+            assert (jit_result.queue_share == greedy.queue_share).all()
+            assert (
+                jit_result.candidate_share == greedy.candidate_share
+            ).all()
+
+    def test_warm_precompiles_the_bucket(self):
+        from jobset_tpu.queue import scorer
+
+        with features.gate("TPUQueueScorer", True):
+            scorer._kernel.cache_clear()
+            scorer._P_HIGH_WATER.clear()
+            scorer.warm(3, 1, 0, 100)
+            assert scorer._kernel.cache_info().currsize == 1
+        # Gate off: warm is a no-op.
+        scorer._kernel.cache_clear()
+        scorer._P_HIGH_WATER.clear()
+        scorer.warm(3, 1, 0, 100)
+        assert scorer._kernel.cache_info().currsize == 0
+
+
+class TestStormResidency:
+    def test_repeat_rounds_reuse_device_operands(self):
+        import numpy as np
+
+        from jobset_tpu.placement.solver import AssignmentSolver
+
+        solver = AssignmentSolver(backend="default")
+        j, d = 16, 32
+
+        def problems(load):
+            return [
+                {
+                    "load": np.full(d, load, np.float32),
+                    "free": np.full(d, 4.0, np.float32),
+                    "pods_needed": np.full(j, 4.0, np.float32),
+                    "sticky": np.full(j, -1, np.int32),
+                    "occupied": np.zeros(d, bool),
+                    "own_domain": np.full(j, -1, np.int32),
+                }
+                for _ in range(4)
+            ]
+
+        first = [
+            p.result() for p in solver.solve_structured_batch_async(
+                problems(0.0)
+            )
+        ]
+        transfers_after_first = solver.batch_operand_transfers
+        second = [
+            p.result() for p in solver.solve_structured_batch_async(
+                problems(0.0)
+            )
+        ]
+        # Identical round: every operand stayed device-resident.
+        assert solver.batch_operand_transfers == transfers_after_first
+        assert solver.batch_operand_reuses >= 7
+        for a, b in zip(first, second):
+            assert (a == b).all()
+        # One changed operand ships exactly one transfer.
+        [p.result() for p in solver.solve_structured_batch_async(
+            problems(0.5)
+        )]
+        assert (
+            solver.batch_operand_transfers == transfers_after_first + 1
+        )
+        # Residency answers match a fresh (cache-less) solver.
+        fresh = [
+            p.result() for p in AssignmentSolver(
+                backend="default"
+            ).solve_structured_batch_async(problems(0.0))
+        ]
+        third = [
+            p.result() for p in solver.solve_structured_batch_async(
+                problems(0.0)
+            )
+        ]
+        for a, b in zip(fresh, third):
+            assert (a == b).all()
+
+    def test_shared_fetch_iterations(self):
+        import numpy as np
+
+        from jobset_tpu.placement.solver import AssignmentSolver
+
+        solver = AssignmentSolver(backend="default")
+        pendings = solver.solve_structured_batch_async([
+            {
+                "load": np.zeros(8, np.float32),
+                "free": np.full(8, 2.0, np.float32),
+                "pods_needed": np.full(4, 2.0, np.float32),
+                "sticky": np.full(4, -1, np.int32),
+                "occupied": np.zeros(8, bool),
+                "own_domain": np.full(4, -1, np.int32),
+            }
+            for _ in range(3)
+        ])
+        for p in pendings:
+            out = p.result()
+            assert out.shape == (4,)
+            assert (out >= 0).all()
+            assert p.iterations >= 0
