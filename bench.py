@@ -1322,6 +1322,273 @@ def _bank_restart(result: dict) -> None:
     _bank_sidecar_key("restart", result)
 
 
+# ---------------------------------------------------------------------------
+# Columnar-core scale bench (bench --scale, docs/columnar.md)
+# ---------------------------------------------------------------------------
+
+SCALE_SHAPES = (
+    # (label, domains): nodes = domains * 16 @ capacity 32/node.
+    ("1k", 64),
+    ("15k", 960),       # the headline 15,360-node shape
+    ("100k", 6250),     # 100,000 nodes — object-graph territory's ceiling
+)
+SCALE_TOPOLOGY_KEY = "tpu-slice"
+SCALE_GANGS = 8            # exclusive 512-pod gangs (big-slice shape)
+SCALE_PODS_PER_GANG = 512  # 8 gangs x 512 = 4,096 standing pods
+SCALE_ROUNDS = 16         # churn rounds per timed block
+SCALE_BLOCKS = 5          # timed blocks; the best block is reported
+                          # (min-time de-noising, symmetric across gates)
+SCALE_SEED = 20260804
+
+
+def _scale_build(gate: bool, domains: int):
+    """Standing population: one 8-gang campaign of 512-pod exclusive
+    slices over `domains` topology domains (16 nodes x capacity 32 each, so
+    a gang exactly fills its domain)."""
+    from jobset_tpu.api import FailurePolicy
+    from jobset_tpu.core import features, make_cluster
+    from jobset_tpu.testing import make_jobset, make_replicated_job
+
+    with features.gate("ColumnarCore", gate):
+        t0 = time.perf_counter()
+        cluster = make_cluster()
+        cluster.add_topology(
+            SCALE_TOPOLOGY_KEY, num_domains=domains, nodes_per_domain=16,
+            capacity=32,
+        )
+        build_s = time.perf_counter() - t0
+        gang = (
+            make_replicated_job("gang")
+            .replicas(SCALE_GANGS)
+            .parallelism(SCALE_PODS_PER_GANG)
+            .completions(SCALE_PODS_PER_GANG)
+            .obj()
+        )
+        # The churn's seeded pod crashes accumulate per-job failures; a
+        # high backoffLimit keeps them in-place retries (the workload being
+        # measured) instead of tripping whole-campaign restarts mid-block.
+        gang.template.spec.backoff_limit = 10_000
+        js = (
+            make_jobset("campaign")
+            .exclusive_placement(SCALE_TOPOLOGY_KEY)
+            .failure_policy(FailurePolicy(max_restarts=50))
+            .replicated_job(gang)
+            .obj()
+        )
+        t0 = time.perf_counter()
+        cluster.create_jobset(js)
+        cluster.run_until_stable(max_ticks=4000)
+        initial_s = time.perf_counter() - t0
+    total = SCALE_GANGS * SCALE_PODS_PER_GANG
+    bound = sum(1 for p in cluster.pods.values() if p.spec.node_name)
+    if bound != total:
+        raise RuntimeError(f"scale initial placement: {bound}/{total} bound")
+    return cluster, build_s, initial_s
+
+
+def _scale_pod_cache(cluster) -> dict:
+    """Per-gang sorted pod keys, refreshed only after pod-replacing rounds
+    (container restarts keep names, so the cache stays valid between)."""
+    return {
+        key: sorted(
+            (p.metadata.namespace, p.metadata.name)
+            for p in cluster.pods_for_job(job)
+        )
+        for key, job in cluster.jobs.items()
+    }
+
+
+def _scale_churn_block(cluster, rng, rounds: int) -> tuple[int, int]:
+    """One block of seeded churn rounds against the standing population:
+    every round restarts one container per gang in place (the readiness
+    churn a long-running fleet actually sees — gang readiness dips and
+    recovers with zero pod replacement), and every 4th round additionally
+    crashes one pod in 8 seeded gangs (pod replacement through the
+    scheduler's node-fit + domain-occupancy path). Returns (ticks,
+    pod transitions)."""
+    # The cache is built ONCE and tolerated stale: container restarts keep
+    # pod names, and each crash round retires at most one key per touched
+    # gang (a seeded pick landing on a retired/Failed key just no-ops,
+    # identically under both gate settings) — so driver bookkeeping stays
+    # off the measured tick loop.
+    cache = _scale_pod_cache(cluster)
+    gang_keys = sorted(cache)
+    ticks = 0
+    transitions = 0
+    for r in range(rounds):
+        for gk in gang_keys:
+            pods = cache[gk]
+            key = pods[rng.randrange(len(pods))]
+            if key in cluster.pods:
+                cluster.restart_pod_container(*key)
+                transitions += 1
+        if r % 4 == 3:
+            for gk in rng.sample(gang_keys, min(8, len(gang_keys))):
+                pods = cache[gk]
+                key = pods[rng.randrange(len(pods))]
+                if key in cluster.pods:
+                    cluster.fail_pod(*key)
+                    transitions += 2  # the crash and its replacement
+        ticks += cluster.run_until_stable(max_ticks=4000)
+    return ticks, transitions
+
+
+def _scale_event_stream(cluster) -> str:
+    """Canonical serialization of the whole event stream + terminal pod
+    state — the byte-parity digest compared across gate settings."""
+    events = [
+        (e.seq, e.object_kind, e.object_name, e.namespace, e.type,
+         e.reason, e.message, e.time)
+        for e in cluster.events
+    ]
+    pods = sorted(
+        (k, p.status.phase, p.status.ready, p.status.restarts,
+         p.spec.node_name)
+        for k, p in cluster.pods.items()
+    )
+    jobs = sorted(
+        (k, j.status.active, j.status.ready, j.status.succeeded,
+         j.status.failed, sorted(j.status.succeeded_indexes))
+        for k, j in cluster.jobs.items()
+    )
+    return json.dumps(
+        {"events_total": cluster.events_total, "events": events,
+         "pods": pods, "jobs": jobs},
+        sort_keys=True,
+    )
+
+
+def run_scale_bench(args) -> dict:
+    """Nodes-vs-tick-throughput curve for the columnar core (bench --scale,
+    docs/columnar.md): the SAME 4,096-pod standing population churned over
+    1k / 15k / 100k-node topologies, under both `ColumnarCore` settings.
+
+    Two figures per (shape, gate): steady-state tick throughput over the
+    seeded churn (ticks/s and pod transitions/s; the reconcile pump's
+    per-tick hot loops — gang-readiness aggregation, phase advancement,
+    node-fit checks, occupancy accounting — dominate), and whole-campaign
+    gang recovery (fail -> every pod rebound) pods/s. GC is frozen through
+    every timed window like the other benches; build and initial-placement
+    wall time are recorded untimed. Event-stream byte-parity across gate
+    settings is asserted at every shape (the digest compares every event
+    field plus terminal pod/job state)."""
+    import gc
+    import random
+    import statistics
+
+    total_pods = SCALE_GANGS * SCALE_PODS_PER_GANG
+    shapes_out = []
+    speedup_15k = None
+    parity_all = True
+    for label, domains in SCALE_SHAPES:
+        per_gate: dict[str, dict] = {}
+        digests: dict[bool, str] = {}
+        for gate in (False, True):
+            cluster, build_s, initial_s = _scale_build(gate, domains)
+            rng = random.Random(SCALE_SEED)
+            # Warmup block: interpreter/alloc caches, first-touch columns.
+            _scale_churn_block(cluster, rng, 3)
+            gc.collect()
+            gc.freeze()
+            blocks = []
+            try:
+                for _ in range(SCALE_BLOCKS):
+                    t0 = time.perf_counter()
+                    ticks, transitions = _scale_churn_block(
+                        cluster, rng, SCALE_ROUNDS
+                    )
+                    blocks.append(
+                        (time.perf_counter() - t0, ticks, transitions)
+                    )
+                # Whole-campaign gang recovery: one failure-policy restart
+                # rebuilds every gang through creation + scheduling.
+                cluster.fail_job("default", "campaign-gang-0")
+                t0 = time.perf_counter()
+                cluster.run_until_stable(max_ticks=4000)
+                recovery_s = time.perf_counter() - t0
+            finally:
+                gc.unfreeze()
+            bound = sum(
+                1 for p in cluster.pods.values() if p.spec.node_name
+            )
+            if bound != total_pods:
+                raise RuntimeError(
+                    f"scale recovery incomplete: {bound}/{total_pods}"
+                )
+            digests[gate] = _scale_event_stream(cluster)
+            # Best block = min wall time: scheduler noise on a small box
+            # only ever slows a block down, and the same rule applies to
+            # both gate settings.
+            best = min(blocks, key=lambda b: b[0])
+            med = statistics.median(b[0] for b in blocks)
+            med_block = next(b for b in blocks if b[0] == med)
+            per_gate["on" if gate else "off"] = {
+                "build_s": round(build_s, 3),
+                "initial_placement_s": round(initial_s, 3),
+                "ticks_per_s": round(best[1] / best[0], 1),
+                "transitions_per_s": round(best[2] / best[0], 1),
+                "median_ticks_per_s": round(med_block[1] / med_block[0], 1),
+                "block_wall_s": [round(b[0], 4) for b in blocks],
+                "recovery_pods_per_sec": round(total_pods / recovery_s, 1),
+            }
+        parity = digests[False] == digests[True]
+        if not parity:
+            # Parity is the bench's headline guarantee: banking a speedup
+            # over divergent behavior would be meaningless.
+            raise RuntimeError(
+                f"scale {label}: event streams diverged across "
+                "ColumnarCore settings"
+            )
+        parity_all &= parity
+        speedup = round(
+            per_gate["on"]["ticks_per_s"] / per_gate["off"]["ticks_per_s"],
+            2,
+        )
+        if label == "15k":
+            speedup_15k = speedup
+        shapes_out.append({
+            "shape": label,
+            "nodes": domains * 16,
+            "domains": domains,
+            "standing_pods": total_pods,
+            "off": per_gate["off"],
+            "on": per_gate["on"],
+            "tick_speedup": speedup,
+            "recovery_speedup": round(
+                per_gate["on"]["recovery_pods_per_sec"]
+                / per_gate["off"]["recovery_pods_per_sec"], 2,
+            ),
+            "event_stream_parity": parity,
+        })
+        print(
+            f"scale {label}: off {per_gate['off']['ticks_per_s']} t/s, "
+            f"on {per_gate['on']['ticks_per_s']} t/s ({speedup}x), "
+            f"parity={parity}",
+            file=sys.stderr,
+        )
+    return {
+        "scenario": (
+            "standing 8x512-pod exclusive campaign; seeded container-"
+            "restart churn + pod-crash replacement + whole-campaign "
+            "recovery, both ColumnarCore settings"
+        ),
+        "config": {
+            "gangs": SCALE_GANGS,
+            "pods_per_gang": SCALE_PODS_PER_GANG,
+            "rounds_per_block": SCALE_ROUNDS,
+            "blocks": SCALE_BLOCKS,
+            "seed": SCALE_SEED,
+        },
+        "shapes": shapes_out,
+        "tick_speedup_15k": speedup_15k,
+        "parity_event_stream": parity_all,
+    }
+
+
+def _bank_scale(result: dict) -> None:
+    _bank_sidecar_key("scale", result)
+
+
 def run_wire_bench(args) -> dict:
     """Fast-wire-plane microbench (bench --wire, docs/protocol.md):
 
@@ -3442,6 +3709,14 @@ def main() -> int:
              "'storm_residency'",
     )
     parser.add_argument(
+        "--scale", action="store_true",
+        help="run ONLY the columnar-core scale bench (nodes-vs-tick-"
+             "throughput curve at 1k/15k/100k nodes with a standing "
+             "4,096-pod gang population, both ColumnarCore gate settings, "
+             "event-stream parity asserted) and bank it into "
+             "BENCH_PLACEMENT_TPU_LAST.json under 'scale'",
+    )
+    parser.add_argument(
         "--restart", action="store_true",
         help="run ONLY the cold-start recovery bench (durable store "
              "snapshot+WAL replay at 1k and 10k objects) and bank it into "
@@ -3508,6 +3783,20 @@ def main() -> int:
             "metric": "wire_batched_binary_pods_per_sec",
             "value": result["roundtrip_pods_per_sec"]["batched"]["binary"],
             "unit": "pods/s",
+            "detail": result,
+        }))
+        return 0
+
+    if args.scale:
+        # Pure control-plane bench: the columnar tick loops run on numpy
+        # (the jit'd JAX aggregation path engages on whatever backend jax
+        # initialized, CPU included).
+        result = run_scale_bench(args)
+        _bank_scale(result)
+        print(json.dumps({
+            "metric": "scale_tick_speedup_15k",
+            "value": result["tick_speedup_15k"],
+            "unit": "x",
             "detail": result,
         }))
         return 0

@@ -43,6 +43,7 @@ from ..engine import Finding, ModuleContext, dotted_name, register
 # pass or reconcile round (corpus/dataset loaders in the same planes are
 # deliberately NOT listed — loading is allowed to touch the host).
 HOT_MODULES = frozenset((
+    "jobset_tpu/core/columnar.py",
     "jobset_tpu/placement/provider.py",
     "jobset_tpu/placement/solver.py",
     "jobset_tpu/policy/model.py",

@@ -107,6 +107,9 @@ class Cluster:
         # insertion-ordered (dict) so scheduling order == creation order
         self.pending_pod_keys: dict[tuple[str, str], None] = {}
         self._newly_bound: deque[tuple[str, str]] = deque()
+        # Pods whose container restarted in place (restart_pod_container):
+        # the kubelet pass re-readies them next tick, like _newly_bound.
+        self._restarting: deque[tuple[str, str]] = deque()
         self.leader_pod_keys: set[tuple[str, str]] = set()
         # Pod-event queue for the PodReconciler (the watch-filter analog of
         # pod_controller.go:63-73): job-keys whose pod set changed since the
@@ -137,6 +140,18 @@ class Cluster:
         import threading
 
         self.lock = threading.RLock()
+
+        # Array-backed hot-state mirror (core/columnar.py, docs/columnar.md),
+        # attached when the ColumnarCore gate is on at construction (the
+        # store-attach idiom: the gate is sampled once, here). None = the
+        # object graph is the only state — byte-for-byte prior behavior.
+        from . import features
+
+        self.columnar = None
+        if features.enabled("ColumnarCore"):
+            from .columnar import ColumnarState
+
+            self.columnar = ColumnarState(self)
 
         # Lifetime-monotonic identity counter (uids + pod suffixes). A plain
         # int (not itertools.count) so the durable store can persist and
@@ -225,6 +240,10 @@ class Cluster:
         job_key = self._placement_event(pod)
         if job_key:
             self.dirty_placement_job_keys.add(job_key)
+        if self.columnar is not None:
+            self.columnar.pod_touched_locked(
+                (pod.metadata.namespace, pod.metadata.name), pod
+            )
 
     def record_event(self, kind: str, name: str, etype: str, reason: str,
                      message: str, namespace: str = ""):
@@ -266,6 +285,8 @@ class Cluster:
         self.nodes[name] = node
         self._domain_nodes.clear()  # invalidate lazy domain->nodes map
         self._domain_stats.clear()
+        if self.columnar is not None:
+            self.columnar.node_added_locked(node)
         return node
 
     def add_topology(
@@ -301,6 +322,8 @@ class Cluster:
             node.taints = list(taints)
         self._domain_nodes.clear()
         self._domain_stats.clear()
+        if self.columnar is not None:
+            self.columnar.node_patched_locked(node)
         return node
 
     def domain_nodes(self, topology_key: str) -> dict[str, list[str]]:
@@ -528,6 +551,8 @@ class Cluster:
         self.jobs_by_owner.setdefault(owner.metadata.uid, set()).add(key)
         self.dirty_job_uids.add(job.metadata.uid)
         self.jobs_by_uid[job.metadata.uid] = key
+        if self.columnar is not None:
+            self.columnar.job_created_locked(job)
         self.enqueue_reconcile(owner.metadata.namespace, owner.metadata.name)
         return job
 
@@ -537,6 +562,8 @@ class Cluster:
             raise AdmissionError(f"job {key} not found")
         self.jobs[key] = job
         self.dirty_job_uids.add(job.metadata.uid)
+        if self.columnar is not None:
+            self.columnar.job_updated_locked(job)
         self._enqueue_owner_of(job)
         return job
 
@@ -561,18 +588,19 @@ class Cluster:
         topology_key = job.metadata.annotations.get(keys.EXCLUSIVE_KEY)
         job_key = job.labels.get(keys.JOB_KEY)
         if topology_key and job_key:
-            domains = self.domain_job_keys.get(topology_key, {})
             # Bound-pod occupancy (bind_pod records the domain in
             # placement_history on every bind, so under exclusive placement
             # this is the job's one domain) ...
             prev = self.placement_history.get(job_key)
-            if prev in domains:
-                domains[prev].discard(job_key)
+            if prev is not None:
+                self._occ_discard(topology_key, prev, job_key)
             # ... and the plan-time claim, which may exist with no pod ever
             # bound.
             planned_domain = job.metadata.annotations.get(keys.PLACEMENT_PLAN_KEY)
             if planned_domain:
                 self.release_domain_claim(topology_key, planned_domain, job_key)
+        if self.columnar is not None:
+            self.columnar.job_deleted_locked(job.metadata.uid)
         self._enqueue_owner_of(job)
 
     def get_job(self, namespace: str, name: str) -> Optional[Job]:
@@ -622,6 +650,8 @@ class Cluster:
         self.dirty_job_uids.add(owner.metadata.uid)
         if (pk := self._placement_event(pod)):
             self.dirty_placement_job_keys.add(pk)
+        if self.columnar is not None:
+            self.columnar.pod_created_locked(key, pod, owner.metadata.uid)
         return pod
 
     def delete_pod(
@@ -648,6 +678,8 @@ class Cluster:
         self.dirty_job_uids.add(pod.metadata.owner_uid)
         if (pk := self._placement_event(pod)):
             self.dirty_placement_job_keys.add(pk)
+        if self.columnar is not None:
+            self.columnar.pod_deleted_locked(key, pod)
 
     def get_pod(self, namespace: str, name: str) -> Optional[Pod]:
         return self.pods.get((namespace, name))
@@ -683,25 +715,44 @@ class Cluster:
     # Placement bookkeeping (shared with the scheduler)
     # ------------------------------------------------------------------
 
+    def _occ_add(self, topology_key: str, domain: str, job_key: str) -> None:
+        """THE write point for domain occupancy (`domain_job_keys`): every
+        set mutation funnels here so the columnar occupancy-count vector
+        can be maintained incrementally (only actual membership changes
+        reach the mirror)."""
+        owners = self.domain_job_keys.setdefault(topology_key, {}).setdefault(
+            domain, set()
+        )
+        if job_key not in owners:
+            owners.add(job_key)
+            if self.columnar is not None:
+                self.columnar.occ_add_locked(topology_key, domain, job_key)
+
+    def _occ_discard(self, topology_key: str, domain: str, job_key: str) -> None:
+        domains = self.domain_job_keys.get(topology_key)
+        owners = domains.get(domain) if domains is not None else None
+        if owners is not None and job_key in owners:
+            owners.discard(job_key)
+            if self.columnar is not None:
+                self.columnar.occ_discard_locked(topology_key, domain, job_key)
+
     def claim_domain(self, topology_key: str, domain: str, job_key: str) -> None:
         """Pre-claim a topology domain for a job key at *plan* time (before
         any pod exists), so subsequent solves and the scheduler's ownership
         checks see the reservation and never double-book a domain."""
-        self.domain_job_keys.setdefault(topology_key, {}).setdefault(
-            domain, set()
-        ).add(job_key)
+        self._occ_add(topology_key, domain, job_key)
         self.placement_history[job_key] = domain
 
     def release_domain_claim(self, topology_key: str, domain: str, job_key: str) -> None:
-        domains = self.domain_job_keys.get(topology_key, {})
-        if domain in domains:
-            domains[domain].discard(job_key)
+        self._occ_discard(topology_key, domain, job_key)
 
     def bind_pod(self, pod: Pod, node: Node) -> None:
         pod.spec.node_name = node.name
         node.allocated += 1
         self._domain_stats_adjust(node, +1)
         key = (pod.metadata.namespace, pod.metadata.name)
+        if self.columnar is not None:
+            self.columnar.pod_bound_locked(key, node.name)
         self.pending_pod_keys.pop(key, None)
         self._newly_bound.append(key)
         topology_key = pod.annotations.get(keys.EXCLUSIVE_KEY)
@@ -717,9 +768,7 @@ class Cluster:
         if topology_key and job_key:
             value = node.labels.get(topology_key)
             if value is not None:
-                self.domain_job_keys.setdefault(topology_key, {}).setdefault(
-                    value, set()
-                ).add(job_key)
+                self._occ_add(topology_key, value, job_key)
                 self.placement_history[job_key] = value
 
     def _release_pod_placement(self, pod: Pod, release_domain: bool = True) -> None:
@@ -736,9 +785,17 @@ class Cluster:
         # this function's contract).
         if (pk := self._placement_event(pod)):
             self.dirty_placement_job_keys.add(pk)
-        if node is not None and node.allocated > 0:
+        released = node is not None and node.allocated > 0
+        if released:
             node.allocated -= 1
             self._domain_stats_adjust(node, -1)
+        if self.columnar is not None:
+            # Mirror exactly what the object path did: the row's binding is
+            # always cleared, the node counter only when it was decremented.
+            self.columnar.pod_unbound_locked(
+                (pod.metadata.namespace, pod.metadata.name),
+                node.name if released else "",
+            )
         if not release_domain:
             return
         topology_key = pod.annotations.get(keys.EXCLUSIVE_KEY)
@@ -761,15 +818,22 @@ class Cluster:
                 ):
                     return
                 # Greedy path: clear the key once no other bound pod of this
-                # job remains in the domain.
-                still_there = any(
-                    p.spec.node_name
-                    and self.nodes.get(p.spec.node_name) is not None
-                    and self.nodes[p.spec.node_name].labels.get(topology_key) == value
-                    for p in self.pods_for_job_key(pod.metadata.namespace, job_key)
-                )
+                # job remains in the domain. With the columnar mirror the
+                # check is one vectorized pass over the gang's node/domain
+                # columns; otherwise it scans the gang's pod records.
+                if self.columnar is not None:
+                    still_there = self.columnar.job_key_in_domain_locked(
+                        self, topology_key, value, job_key
+                    )
+                else:
+                    still_there = any(
+                        p.spec.node_name
+                        and self.nodes.get(p.spec.node_name) is not None
+                        and self.nodes[p.spec.node_name].labels.get(topology_key) == value
+                        for p in self.pods_for_job_key(pod.metadata.namespace, job_key)
+                    )
                 if not still_there:
-                    domains[value].discard(job_key)
+                    self._occ_discard(topology_key, value, job_key)
 
     # ------------------------------------------------------------------
     # Services
@@ -1025,12 +1089,19 @@ class Cluster:
             changed |= self.scheduler.schedule_pending()
 
         # 4. kubelet analog: pods bound since the last pass become
-        # running/ready (index-driven; no full pod scan). The queue is
-        # drained even with auto_ready off so it cannot grow unboundedly in
+        # running/ready, and in-place container restarts recover
+        # (index-driven; no full pod scan). The queues are drained even
+        # with auto_ready off so they cannot grow unboundedly in
         # manually-driven simulations (readiness then comes from
-        # set_job_ready).
+        # set_job_ready). With the columnar mirror attached, the tick's
+        # whole batch advances the phase columns in ONE vectorized
+        # assignment after the per-object writes.
+        advanced: list[int] = []
+        recovered: list[int] = []
+        col = self.columnar
         while self._newly_bound:
-            pod = self.pods.get(self._newly_bound.popleft())
+            key = self._newly_bound.popleft()
+            pod = self.pods.get(key)
             if (
                 self.auto_ready
                 and pod is not None
@@ -1041,6 +1112,30 @@ class Cluster:
                 pod.status.ready = True
                 self.dirty_job_uids.add(pod.metadata.owner_uid)
                 changed = True
+                if col is not None:
+                    row = col.pod_row_locked(key)
+                    if row is not None:
+                        advanced.append(row)
+        while self._restarting:
+            key = self._restarting.popleft()
+            pod = self.pods.get(key)
+            if (
+                self.auto_ready
+                and pod is not None
+                and pod.status.phase == POD_RUNNING
+                and pod.spec.node_name
+                and not pod.status.ready
+            ):
+                pod.status.ready = True
+                self.dirty_job_uids.add(pod.metadata.owner_uid)
+                changed = True
+                if col is not None:
+                    row = col.pod_row_locked(key)
+                    if row is not None:
+                        recovered.append(row)
+        if col is not None:
+            col.set_phase_rows_locked(advanced, POD_RUNNING, ready=True)
+            col.set_ready_rows_locked(recovered, ready=True)
 
         # 5. Pod reconciler enforces exclusive-placement drift.
         if self.pod_reconciler is not None:
@@ -1116,6 +1211,7 @@ class Cluster:
         self.job_deadlines.clear()
         self.pending_pod_keys.clear()
         self._newly_bound.clear()
+        self._restarting.clear()
         self.leader_pod_keys.clear()
         self.dirty_placement_job_keys.clear()
         self.domain_job_keys.clear()
@@ -1199,6 +1295,11 @@ class Cluster:
         for key in self.jobsets:
             self.enqueue_reconcile(*key)
 
+        # The columnar mirror is pure derived state: rebuild it wholesale
+        # from the recovered objects, like every other index above.
+        if self.columnar is not None:
+            self.columnar.rebuild_locked(self)
+
     # ------------------------------------------------------------------
     # Drive helpers (envtest-style jobUpdateFn analogs)
     # ------------------------------------------------------------------
@@ -1210,12 +1311,13 @@ class Cluster:
                 self._release_pod_placement(pod)
                 pod.status.phase = phase
                 pod.status.ready = False
+                key = (pod.metadata.namespace, pod.metadata.name)
+                if self.columnar is not None:
+                    self.columnar.pod_phase_locked(key, phase, ready=False)
                 # No longer schedulable: keep the scheduler's pending index
                 # tight (never-bound pods would otherwise sit in it until
                 # job deletion).
-                self.pending_pod_keys.pop(
-                    (pod.metadata.namespace, pod.metadata.name), None
-                )
+                self.pending_pod_keys.pop(key, None)
 
     def mark_job_complete(self, job: Job) -> None:
         """Record the Complete condition and finish the job's pods (the
@@ -1233,6 +1335,8 @@ class Cluster:
             )
         )
         self._finish_pods(job, POD_SUCCEEDED)
+        if self.columnar is not None:
+            self.columnar.job_status_locked(job)
         self._enqueue_owner_of(job)
 
     def complete_job(self, namespace: str, name: str) -> None:
@@ -1254,6 +1358,8 @@ class Cluster:
         pod.status.phase = phase
         pod.status.ready = False
         key = (pod.metadata.namespace, pod.metadata.name)
+        if self.columnar is not None:
+            self.columnar.pod_phase_locked(key, phase, ready=False)
         self.pending_pod_keys.pop(key, None)
         self.leader_pod_keys.discard(key)  # a dead leader is not watched
         self.dirty_job_uids.add(pod.metadata.owner_uid)
@@ -1289,6 +1395,26 @@ class Cluster:
         if job is not None:
             job.status.pod_failures += 1
 
+    def restart_pod_container(self, namespace: str, name: str) -> None:
+        """Restart ONE pod's container in place (restartPolicy=OnFailure
+        kubelet analog, distinct from pod-level failure): the pod stays
+        Running and bound, drops Ready until the next kubelet pass, and
+        bumps status.restarts (the containerStatuses restartCount analog).
+        The owner job re-aggregates its ready count, so gang readiness dips
+        and recovers without any pod replacement — the dominant churn of a
+        long-running fleet, and the phase-advancement workload the scale
+        bench drives."""
+        pod = self.pods[(namespace, name)]
+        if pod.status.phase != POD_RUNNING or not pod.status.ready:
+            return
+        pod.status.ready = False
+        pod.status.restarts += 1
+        key = (namespace, name)
+        self._restarting.append(key)
+        self.dirty_job_uids.add(pod.metadata.owner_uid)
+        if self.columnar is not None:
+            self.columnar.pod_restarted_locked(key)
+
     def mark_job_failed(self, job: Job, reason: str, message: str) -> None:
         """Record the Failed condition and finish the job's pods (no failed
         counter bump — the caller owns the accounting)."""
@@ -1305,6 +1431,8 @@ class Cluster:
             )
         )
         self._finish_pods(job, POD_FAILED)
+        if self.columnar is not None:
+            self.columnar.job_status_locked(job)
 
     def fail_job(
         self,
@@ -1327,6 +1455,12 @@ class Cluster:
             if pod.status.phase == POD_PENDING:
                 pod.status.phase = POD_RUNNING
             pod.status.ready = True
+            if self.columnar is not None:
+                self.columnar.pod_phase_locked(
+                    (pod.metadata.namespace, pod.metadata.name),
+                    pod.status.phase,
+                    ready=True,
+                )
         self._enqueue_owner_of(job)
 
     def fail_node(self, node_name: str) -> list[str]:

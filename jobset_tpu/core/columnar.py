@@ -1,0 +1,968 @@
+"""Array-backed hot cluster state (the `ColumnarCore` gate, docs/columnar.md).
+
+The simulated cluster's source of truth is a Python object graph
+(core/cluster.py) — the right shape for k8s-semantic fidelity, the wrong
+shape for 100k-node benches: every per-tick hot loop (the Job controller's
+gang-readiness aggregation, the scheduler's free-domain and node-fit scans,
+domain occupancy accounting) walks objects and dicts at Python speed.
+
+This module mirrors the HOT SUBSET of that state into packed columns:
+
+* an interned string table for job keys and topology-domain values,
+* int32 columns for pod phase / node index / completion index / restart
+  count and the owning job row,
+* int32 node capacity/allocation columns plus per-topology domain tables
+  (sorted domain values, per-domain node rows, an occupancy COUNT vector
+  maintained incrementally at every claim/bind/release site).
+
+The mirror is maintained incrementally by `Cluster` at its existing
+mutation points and is *derived acceleration state only*: the object graph
+stays authoritative, every vectorized path computes bit-identical results
+to the Python loop it replaces (the parity contract tests/test_columnar.py
+asserts on whole event streams), and a fresh `ColumnarState(cluster)`
+rebuild must equal the incrementally-maintained one (`snapshot_locked`).
+
+Backends: numpy is mandatory; the biggest scan (the whole-store
+gang-readiness aggregation) additionally has a jit'd JAX kernel behind
+compile-once pow2 capacity buckets (the queue-scorer discipline from
+SNIPPETS [3] — column capacities only ever double, so each growth step
+compiles at most once) that engages above `_JAX_MIN_ROWS` live rows.
+
+Locking: all methods are `*_locked` — the caller (Cluster, whose server
+fronts serialize on `cluster.lock`) owns the lock, exactly like the rest
+of the cluster's state; single-threaded simulations need no lock at all.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import numpy as np
+
+from ..api import keys
+from .objects import (
+    POD_FAILED,
+    POD_PENDING,
+    POD_RUNNING,
+    POD_SUCCEEDED,
+)
+
+# Phase interning (fixed, ordered so `phase <= RUNNING` selects live pods).
+PHASE_PENDING = 0
+PHASE_RUNNING = 1
+PHASE_SUCCEEDED = 2
+PHASE_FAILED = 3
+_PHASE_IDS = {
+    POD_PENDING: PHASE_PENDING,
+    POD_RUNNING: PHASE_RUNNING,
+    POD_SUCCEEDED: PHASE_SUCCEEDED,
+    POD_FAILED: PHASE_FAILED,
+}
+
+# Live rows below this skip the JAX kernel: dispatch overhead beats numpy
+# at small scans, and the numpy result is bit-identical anyway.
+_JAX_MIN_ROWS = 16384
+
+
+def _round_up_pow2(n: int, minimum: int = 1024) -> int:
+    size = minimum
+    while size < n:
+        size *= 2
+    return size
+
+
+@functools.lru_cache(maxsize=1)
+def _jax():
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        return jax, jnp
+    except Exception:  # pragma: no cover - jax is baked into the image
+        return None
+
+
+@functools.lru_cache(maxsize=8)
+def _agg_kernel(P: int, J: int):
+    """Compile-once aggregation kernel for one (pod-capacity, job-capacity)
+    bucket: the bincount trio of the gang-readiness scan. Both dims are
+    pow2 capacities that only ever grow by doubling, so a run compiles at
+    most log2(growth) variants (the monotone-bucket discipline)."""
+    jax, jnp = _jax()
+
+    @jax.jit
+    def kernel(jobs, phase, ready):
+        alive = jobs >= 0
+        pend_run = alive & (phase <= PHASE_RUNNING)
+        # Dead rows scatter into row 0 with zero weight instead of
+        # indexing out of bounds; integer scatter-adds keep the counts
+        # exact (bit-identical to numpy's bincount).
+        safe = jnp.where(alive, jobs, 0)
+        zeros = jnp.zeros(J, jnp.int32)
+        active = zeros.at[safe].add(pend_run.astype(jnp.int32))
+        ready_c = zeros.at[safe].add(
+            (pend_run & (ready != 0)).astype(jnp.int32)
+        )
+        failed = zeros.at[safe].add(
+            (alive & (phase == PHASE_FAILED)).astype(jnp.int32)
+        )
+        return active, ready_c, failed
+
+    return kernel
+
+
+class StringTable:
+    """Append-only intern table: string -> dense int32 id.
+
+    Ids are stable for the table's lifetime (never recycled), so columns
+    may cache them across incremental updates.
+    """
+
+    def __init__(self):
+        self._ids: dict[str, int] = {}  # guarded-by: lock (owner's)
+        self._values: list[str] = []  # guarded-by: lock (owner's)
+
+    def intern_locked(self, value: str) -> int:
+        sid = self._ids.get(value)
+        if sid is None:
+            sid = len(self._values)
+            self._ids[value] = sid
+            self._values.append(value)
+        return sid
+
+    def id_locked(self, value: str) -> int:
+        """Id of an already-interned value, -1 if never seen."""
+        return self._ids.get(value, -1)
+
+    def value_locked(self, sid: int) -> str:
+        return self._values[sid]
+
+
+class _Topology:
+    """Per-topology-key domain table: sorted domain values, per-domain node
+    rows (node insertion order — the same order the object path scans),
+    and the incrementally-maintained occupancy count + owner mirrors."""
+
+    def __init__(self, values: list[str], node_capacity: int):
+        self.values = values  # sorted, parity with sorted(domain_nodes)
+        self.index = {v: i for i, v in enumerate(values)}
+        self.node_rows: list[list[int]] = [[] for _ in values]
+        # node row -> domain index under this key (-1 = unlabeled).
+        self.node_domain = np.full(node_capacity, -1, np.int32)
+        self.occ_count = np.zeros(max(len(values), 1), np.int32)
+        # job-key id -> set of occupied domain indexes (the own_domains
+        # mirror the leader path reads instead of scanning occupancy).
+        self.owner_domains: dict[int, set[int]] = {}
+        # Job-key ids owning a domain value this table cannot index (e.g.
+        # a claim on a value no node carries): the leader fast path must
+        # fall back to the object scan for these keys, or it would treat
+        # an owner as unplaced.
+        self.foreign_owners: set[int] = set()
+
+
+class Aggregates:
+    """One whole-store gang-readiness pass: per-job-row live counts,
+    per-job DISTINCT-index counts, and sorted distinct
+    (job, completion-index) pair arrays for succeeded and existing indexes.
+
+    The counts cover the steady state (nothing succeeded, nothing to
+    create) without materializing any per-job set; the pair slices serve
+    the exact index values when a job actually completes indexes or needs
+    pods created. The existing-pair sort is built LAZILY from compact
+    snapshot copies — when the store-wide duplicate tracker proves every
+    live (job, index) pair distinct, the distinct count IS the plain
+    bincount and no sort happens at all."""
+
+    def __init__(
+        self, active, ready, failed, spairs, base: int, jlen: int,
+        ejobs, ecidx, exist_count, epairs,
+    ):
+        self.active = active
+        self.ready = ready
+        self.failed = failed
+        self._spairs = spairs
+        self._base = base
+        self._ejobs = ejobs
+        self._ecidx = ecidx
+        self._epairs = epairs
+        if spairs.shape[0]:
+            self.succ_count = np.bincount(spairs // base, minlength=jlen)
+        else:
+            self.succ_count = np.zeros(jlen, np.int64)
+        self.exist_count = exist_count
+
+    def _slice(self, pairs, row: int):
+        base = self._base
+        lo = int(np.searchsorted(pairs, row * base))
+        hi = int(np.searchsorted(pairs, (row + 1) * base))
+        return pairs[lo:hi] % base
+
+    def succeeded_idxs_locked(self, row: int):
+        """Distinct completion indexes of live Succeeded pods."""
+        return self._slice(self._spairs, row)
+
+    def existing_idxs_locked(self, row: int):
+        """Distinct completion indexes of live (Pending/Running/Succeeded)
+        pods."""
+        if self._epairs is None:
+            self._epairs = np.unique(
+                self._ejobs.astype(np.int64) * self._base + self._ecidx
+            )
+        return self._slice(self._epairs, row)
+
+
+class ColumnarState:
+    """The packed mirror. One instance per Cluster (attached when the
+    `ColumnarCore` gate is on at construction); every method assumes the
+    cluster's single-writer discipline (`*_locked`)."""
+
+    def __init__(self, cluster):
+        self.lock = cluster.lock
+        self.strings = StringTable()
+
+        # Pod columns (row-recycled; capacities grow by doubling).
+        self._pod_rows: dict[tuple[str, str], int] = {}  # guarded-by: lock
+        self._pod_free: list[int] = []  # guarded-by: lock
+        self._pod_len = 0  # guarded-by: lock  (high-water rows in use)
+        cap = 1024
+        self.pod_phase = np.zeros(cap, np.int32)  # guarded-by: lock
+        self.pod_ready = np.zeros(cap, np.int8)  # guarded-by: lock
+        self.pod_node = np.full(cap, -1, np.int32)  # guarded-by: lock
+        self.pod_job = np.full(cap, -1, np.int32)  # guarded-by: lock
+        self.pod_cidx = np.full(cap, -1, np.int32)  # guarded-by: lock
+        self.pod_restarts = np.zeros(cap, np.int32)  # guarded-by: lock
+        # Interned id of the pod's exclusive-placement nodeSelector value
+        # (-1 = none): feeds the PodReconciler's vectorized drift check.
+        self.pod_sel = np.full(cap, -1, np.int32)  # guarded-by: lock
+        # job-key (the JOB_KEY hash label) -> live pod rows, the columnar
+        # mirror of cluster.pods_by_job_key: the drift check gathers a
+        # gang's rows from here instead of walking the key set per pod.
+        self._jk_rows: dict[str, list[int]] = {}  # guarded-by: lock
+        # Live (job-row, completion-index) multiplicity tracker for rows
+        # in the "existing" class (Pending/Running/Succeeded with an
+        # index): while no pair occurs twice, the distinct-index count the
+        # gang-readiness scan needs is a plain bincount — no sort.
+        self._live_idx: dict[tuple[int, int], int] = {}  # guarded-by: lock
+        self._live_idx_dups = 0  # guarded-by: lock
+
+        # Job columns: the reconcile pump's bucket-and-statuses inputs —
+        # restart attempt (from the RESTARTS_KEY label; -1 = unparseable,
+        # which classifies as stale exactly like the object path's
+        # ValueError branch), terminal state (0 live / 1 Complete /
+        # 2 Failed), interned ReplicatedJob name, suspend flag, expected
+        # pod count, and the status counts the Job controller writes.
+        self._job_rows: dict[str, int] = {}  # guarded-by: lock
+        self._job_free: list[int] = []  # guarded-by: lock
+        self._job_len = 0  # guarded-by: lock
+        jcap = 1024
+        self.job_expected = np.zeros(jcap, np.int32)  # guarded-by: lock
+        self.job_attempt = np.full(jcap, -1, np.int32)  # guarded-by: lock
+        self.job_finished = np.zeros(jcap, np.int8)  # guarded-by: lock
+        self.job_rjob = np.full(jcap, -1, np.int32)  # guarded-by: lock
+        self.job_suspended = np.zeros(jcap, np.int8)  # guarded-by: lock
+        self.job_active = np.zeros(jcap, np.int32)  # guarded-by: lock
+        self.job_ready = np.zeros(jcap, np.int32)  # guarded-by: lock
+        self.job_succeeded = np.zeros(jcap, np.int32)  # guarded-by: lock
+
+        # Node columns (insertion order == cluster.nodes order; nodes are
+        # never deleted).
+        self._node_rows: dict[str, int] = {}  # guarded-by: lock
+        self._node_objs: list = []  # guarded-by: lock
+        ncap = 1024
+        self.node_capacity = np.zeros(ncap, np.int32)  # guarded-by: lock
+        self.node_allocated = np.zeros(ncap, np.int32)  # guarded-by: lock
+        self.node_tainted = np.zeros(ncap, np.int8)  # guarded-by: lock
+
+        # Lazily-built per-topology domain tables (invalidated whenever
+        # node labels/taints change, like Cluster._domain_stats).
+        self._topologies: dict[str, _Topology] = {}  # guarded-by: lock
+
+        self.rebuild_locked(cluster)
+
+    # ------------------------------------------------------------------
+    # Growth helpers
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _grow(arr: np.ndarray, cap: int, fill) -> np.ndarray:
+        out = np.full(cap, fill, arr.dtype)
+        out[: arr.shape[0]] = arr
+        return out
+
+    def _pod_capacity_locked(self, need: int) -> None:
+        cap = self.pod_phase.shape[0]
+        if need <= cap:
+            return
+        cap = _round_up_pow2(need, minimum=cap * 2)
+        self.pod_phase = self._grow(self.pod_phase, cap, 0)
+        self.pod_ready = self._grow(self.pod_ready, cap, 0)
+        self.pod_node = self._grow(self.pod_node, cap, -1)
+        self.pod_job = self._grow(self.pod_job, cap, -1)
+        self.pod_cidx = self._grow(self.pod_cidx, cap, -1)
+        self.pod_restarts = self._grow(self.pod_restarts, cap, 0)
+        self.pod_sel = self._grow(self.pod_sel, cap, -1)
+
+    def _job_capacity_locked(self, need: int) -> None:
+        cap = self.job_expected.shape[0]
+        if need <= cap:
+            return
+        cap = _round_up_pow2(need, minimum=cap * 2)
+        self.job_expected = self._grow(self.job_expected, cap, 0)
+        self.job_attempt = self._grow(self.job_attempt, cap, -1)
+        self.job_finished = self._grow(self.job_finished, cap, 0)
+        self.job_rjob = self._grow(self.job_rjob, cap, -1)
+        self.job_suspended = self._grow(self.job_suspended, cap, 0)
+        self.job_active = self._grow(self.job_active, cap, 0)
+        self.job_ready = self._grow(self.job_ready, cap, 0)
+        self.job_succeeded = self._grow(self.job_succeeded, cap, 0)
+
+    def _node_capacity_locked(self, need: int) -> None:
+        cap = self.node_capacity.shape[0]
+        if need <= cap:
+            return
+        cap = _round_up_pow2(need, minimum=cap * 2)
+        self.node_capacity = self._grow(self.node_capacity, cap, 0)
+        self.node_allocated = self._grow(self.node_allocated, cap, 0)
+        self.node_tainted = self._grow(self.node_tainted, cap, 0)
+
+    # ------------------------------------------------------------------
+    # Nodes + topology tables
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _has_noschedule(node) -> bool:
+        return any(t.effect == "NoSchedule" for t in node.taints)
+
+    def node_added_locked(self, node) -> None:
+        row = len(self._node_objs)
+        self._node_capacity_locked(row + 1)
+        self._node_rows[node.name] = row
+        self._node_objs.append(node)
+        self.node_capacity[row] = node.capacity
+        self.node_allocated[row] = node.allocated
+        self.node_tainted[row] = 1 if self._has_noschedule(node) else 0
+        self._topologies.clear()
+
+    def node_patched_locked(self, node) -> None:
+        row = self._node_rows.get(node.name)
+        if row is None:  # pragma: no cover - patch of an untracked node
+            return
+        self.node_tainted[row] = 1 if self._has_noschedule(node) else 0
+        self._topologies.clear()
+
+    def node_obj_locked(self, row: int):
+        return self._node_objs[row]
+
+    def topology_locked(self, cluster, topology_key: str) -> _Topology:
+        """The domain table for one topology key, built lazily from the
+        node store (same label scan / sorted order as the object path) and
+        seeded with the CURRENT occupancy so incremental updates continue
+        from truth."""
+        tab = self._topologies.get(topology_key)
+        if tab is not None:
+            return tab
+        by_value: dict[str, list[int]] = {}
+        for row, node in enumerate(self._node_objs):
+            value = node.labels.get(topology_key)
+            if value is not None:
+                by_value.setdefault(value, []).append(row)
+        tab = _Topology(sorted(by_value), self.node_capacity.shape[0])
+        for value, rows in by_value.items():
+            idx = tab.index[value]
+            tab.node_rows[idx] = rows
+            tab.node_domain[rows] = idx
+        for value, job_keys in cluster.domain_job_keys.get(
+            topology_key, {}
+        ).items():
+            idx = tab.index.get(value)
+            for jk in job_keys:
+                kid = self.strings.intern_locked(jk)
+                if idx is None:
+                    tab.foreign_owners.add(kid)
+                else:
+                    tab.occ_count[idx] += 1
+                    tab.owner_domains.setdefault(kid, set()).add(idx)
+        self._topologies[topology_key] = tab
+        return tab
+
+    def occ_add_locked(self, topology_key: str, value: str, job_key: str) -> None:
+        """One NEW (domain, job_key) occupancy entry (the cluster helper
+        guarantees the underlying set actually grew)."""
+        tab = self._topologies.get(topology_key)
+        if tab is None:
+            return  # table not built yet; lazily seeded from truth
+        kid = self.strings.intern_locked(job_key)
+        idx = tab.index.get(value)
+        if idx is None:
+            tab.foreign_owners.add(kid)
+            return
+        tab.occ_count[idx] += 1
+        tab.owner_domains.setdefault(kid, set()).add(idx)
+
+    def occ_discard_locked(
+        self, topology_key: str, value: str, job_key: str
+    ) -> None:
+        tab = self._topologies.get(topology_key)
+        if tab is None:
+            return
+        kid = self.strings.intern_locked(job_key)
+        idx = tab.index.get(value)
+        if idx is None:
+            # Cannot prove no other foreign value remains for this key;
+            # keeping it in foreign_owners only keeps the fallback path.
+            return
+        if tab.occ_count[idx] > 0:
+            tab.occ_count[idx] -= 1
+        owned = tab.owner_domains.get(kid)
+        if owned is not None:
+            owned.discard(idx)
+            if not owned:
+                del tab.owner_domains[kid]
+
+    # ------------------------------------------------------------------
+    # Jobs
+    # ------------------------------------------------------------------
+
+    def job_created_locked(self, job) -> None:
+        if self._job_free:
+            row = self._job_free.pop()
+        else:
+            row = self._job_len
+            self._job_len += 1
+            self._job_capacity_locked(self._job_len)
+        self._job_rows[job.metadata.uid] = row
+        try:
+            attempt = int(job.labels.get(keys.RESTARTS_KEY, ""))
+        except ValueError:
+            attempt = -1  # classifies stale, like the object path
+        self.job_attempt[row] = attempt
+        self.job_rjob[row] = self.strings.intern_locked(
+            job.labels.get(keys.REPLICATED_JOB_NAME_KEY, "")
+        )
+        self.job_status_locked(job)
+
+    def job_updated_locked(self, job) -> None:
+        """Full row re-sync: update_job replaces the object wholesale."""
+        row = self._job_rows.get(job.metadata.uid)
+        if row is None:
+            return
+        try:
+            attempt = int(job.labels.get(keys.RESTARTS_KEY, ""))
+        except ValueError:
+            attempt = -1
+        self.job_attempt[row] = attempt
+        self.job_rjob[row] = self.strings.intern_locked(
+            job.labels.get(keys.REPLICATED_JOB_NAME_KEY, "")
+        )
+        self.job_status_locked(job)
+
+    def job_counts_locked(self, job) -> None:
+        """Light hook for the Job controller's count writes
+        (_apply_status, suspend zeroing): only the three count columns —
+        spec, labels and conditions were untouched by the caller."""
+        row = self._job_rows.get(job.metadata.uid)
+        if row is None:
+            return
+        self.job_active[row] = job.status.active
+        self.job_ready[row] = job.status.ready
+        self.job_succeeded[row] = job.status.succeeded
+
+    def job_status_locked(self, job) -> None:
+        """Re-sync one job's status/suspend columns from the object — the
+        hook at every Job-status write point (_apply_status, suspend
+        zeroing, the terminal-condition markers)."""
+        row = self._job_rows.get(job.metadata.uid)
+        if row is None:
+            return
+        self.job_expected[row] = job.pods_expected()
+        self.job_suspended[row] = 1 if job.suspended() else 0
+        finished, cond_type = job.finished()
+        self.job_finished[row] = (
+            0 if not finished else (1 if cond_type == "Complete" else 2)
+        )
+        self.job_active[row] = job.status.active
+        self.job_ready[row] = job.status.ready
+        self.job_succeeded[row] = job.status.succeeded
+
+    def job_deleted_locked(self, uid: str) -> None:
+        row = self._job_rows.pop(uid, None)
+        if row is not None:
+            self.job_expected[row] = 0
+            self.job_attempt[row] = -1
+            self.job_finished[row] = 0
+            self.job_rjob[row] = -1
+            self.job_suspended[row] = 0
+            self.job_active[row] = 0
+            self.job_ready[row] = 0
+            self.job_succeeded[row] = 0
+            self._job_free.append(row)
+
+    def job_row_locked(self, uid: str) -> Optional[int]:
+        return self._job_rows.get(uid)
+
+    # ------------------------------------------------------------------
+    # Pods
+    # ------------------------------------------------------------------
+
+    def _idx_enter_locked(self, row: int) -> None:
+        cidx = int(self.pod_cidx[row])
+        jrow = int(self.pod_job[row])
+        if cidx < 0 or jrow < 0:
+            return
+        key = (jrow, cidx)
+        n = self._live_idx.get(key, 0) + 1
+        self._live_idx[key] = n
+        if n == 2:
+            self._live_idx_dups += 1
+
+    def _idx_leave_locked(self, row: int) -> None:
+        cidx = int(self.pod_cidx[row])
+        jrow = int(self.pod_job[row])
+        if cidx < 0 or jrow < 0:
+            return
+        key = (jrow, cidx)
+        n = self._live_idx.get(key)
+        if n is None:  # pragma: no cover - defensive
+            return
+        if n == 1:
+            del self._live_idx[key]
+        else:
+            self._live_idx[key] = n - 1
+            if n == 2:
+                self._live_idx_dups -= 1
+
+    def _sel_id_locked(self, pod) -> int:
+        topology_key = pod.annotations.get(keys.EXCLUSIVE_KEY)
+        if not topology_key:
+            return -1
+        value = pod.spec.node_selector.get(topology_key)
+        return -1 if value is None else self.strings.intern_locked(value)
+
+    def pod_created_locked(self, key, pod, owner_uid: str) -> None:
+        if self._pod_free:
+            row = self._pod_free.pop()
+        else:
+            row = self._pod_len
+            self._pod_len += 1
+            self._pod_capacity_locked(self._pod_len)
+        self._pod_rows[key] = row
+        self.pod_phase[row] = _PHASE_IDS[pod.status.phase]
+        self.pod_ready[row] = 1 if pod.status.ready else 0
+        node_row = (
+            self._node_rows.get(pod.spec.node_name, -1)
+            if pod.spec.node_name
+            else -1
+        )
+        self.pod_node[row] = node_row
+        jrow = self._job_rows.get(owner_uid)
+        self.pod_job[row] = -1 if jrow is None else jrow
+        idx = pod.completion_index()
+        self.pod_cidx[row] = -1 if idx is None else idx
+        self.pod_restarts[row] = pod.status.restarts
+        self.pod_sel[row] = self._sel_id_locked(pod)
+        if self.pod_phase[row] <= PHASE_SUCCEEDED:
+            self._idx_enter_locked(row)
+        jk = pod.labels.get(keys.JOB_KEY)
+        if jk:
+            self._jk_rows.setdefault(jk, []).append(row)
+
+    def pod_deleted_locked(self, key, pod=None) -> None:
+        row = self._pod_rows.pop(key, None)
+        if row is None:
+            return
+        if self.pod_phase[row] <= PHASE_SUCCEEDED:
+            self._idx_leave_locked(row)
+        jk = pod.labels.get(keys.JOB_KEY) if pod is not None else None
+        if jk:
+            rows = self._jk_rows.get(jk)
+            if rows is not None:
+                try:
+                    rows.remove(row)
+                except ValueError:  # pragma: no cover - defensive
+                    pass
+        self.pod_job[row] = -1
+        self.pod_node[row] = -1
+        self.pod_cidx[row] = -1
+        self.pod_sel[row] = -1
+        self.pod_ready[row] = 0
+        self.pod_restarts[row] = 0
+        self.pod_phase[row] = 0
+        self._pod_free.append(row)
+
+    def pod_row_locked(self, key) -> Optional[int]:
+        return self._pod_rows.get(key)
+
+    def pod_bound_locked(self, key, node_name: str) -> None:
+        row = self._pod_rows.get(key)
+        nrow = self._node_rows.get(node_name)
+        if row is None or nrow is None:
+            return
+        self.pod_node[row] = nrow
+        self.node_allocated[nrow] += 1
+
+    def pod_unbound_locked(self, key, node_name: str) -> None:
+        row = self._pod_rows.get(key)
+        if row is not None:
+            self.pod_node[row] = -1
+        nrow = self._node_rows.get(node_name)
+        if nrow is not None and self.node_allocated[nrow] > 0:
+            self.node_allocated[nrow] -= 1
+
+    def pod_phase_locked(self, key, phase: str, ready: bool) -> None:
+        row = self._pod_rows.get(key)
+        if row is None:
+            return
+        old = int(self.pod_phase[row])
+        new = _PHASE_IDS[phase]
+        if old <= PHASE_SUCCEEDED and new == PHASE_FAILED:
+            self._idx_leave_locked(row)
+        self.pod_phase[row] = new
+        self.pod_ready[row] = 1 if ready else 0
+        if old == PHASE_FAILED and new <= PHASE_SUCCEEDED:
+            self._idx_enter_locked(row)
+
+    def pod_restarted_locked(self, key) -> None:
+        row = self._pod_rows.get(key)
+        if row is None:
+            return
+        self.pod_ready[row] = 0
+        self.pod_restarts[row] += 1
+
+    def pod_touched_locked(self, key, pod) -> None:
+        """Re-sync one row from its object after an out-of-band spec
+        mutation (the Cluster.touch_pod contract)."""
+        row = self._pod_rows.get(key)
+        if row is None:
+            return
+        self.pod_sel[row] = self._sel_id_locked(pod)
+        self.pod_node[row] = (
+            self._node_rows.get(pod.spec.node_name, -1)
+            if pod.spec.node_name
+            else -1
+        )
+        idx = pod.completion_index()
+        cidx = -1 if idx is None else idx
+        if cidx != self.pod_cidx[row]:
+            in_class = self.pod_phase[row] <= PHASE_SUCCEEDED
+            if in_class:
+                self._idx_leave_locked(row)
+            self.pod_cidx[row] = cidx
+            if in_class:
+                self._idx_enter_locked(row)
+
+    def set_phase_rows_locked(self, rows: list[int], phase: str, ready: bool) -> None:
+        """Batched phase advancement (the kubelet pass): one vectorized
+        column assignment for the tick's whole newly-bound/restarting set."""
+        if not rows:
+            return
+        idx = np.asarray(rows, np.int32)
+        self.pod_phase[idx] = _PHASE_IDS[phase]
+        self.pod_ready[idx] = 1 if ready else 0
+
+    def set_ready_rows_locked(self, rows: list[int], ready: bool) -> None:
+        if not rows:
+            return
+        self.pod_ready[np.asarray(rows, np.int32)] = 1 if ready else 0
+
+    # ------------------------------------------------------------------
+    # Vectorized hot loops
+    # ------------------------------------------------------------------
+
+    def job_aggregates_locked(self, force_jax: Optional[bool] = None) -> Aggregates:
+        """ONE whole-store pass computing every job's live pod aggregates —
+        the gang-readiness scan the Job controller's per-pod Python loop
+        performs per dirty job, batched over all jobs at once.
+
+        The bincount trio runs on the jit'd JAX kernel above
+        `_JAX_MIN_ROWS` live rows (compile-once per pow2 capacity bucket),
+        numpy below; both produce identical integer counts
+        (test_columnar.py asserts equality directly)."""
+        P = self._pod_len
+        J = max(self._job_len, 1)
+        jobs = self.pod_job[:P]
+        phase = self.pod_phase[:P]
+        ready = self.pod_ready[:P]
+        cidx = self.pod_cidx[:P]
+
+        use_jax = force_jax
+        if use_jax is None:
+            use_jax = P >= _JAX_MIN_ROWS and _jax() is not None
+        if use_jax and _jax() is not None:
+            # Full pow2 capacities as the bucket shape: stable across
+            # ticks, monotone across growth.
+            Pc = self.pod_phase.shape[0]
+            Jc = self.job_expected.shape[0]
+            kernel = _agg_kernel(Pc, Jc)
+            a, r, f = kernel(
+                self.pod_job[:Pc], self.pod_phase[:Pc], self.pod_ready[:Pc]
+            )
+            active = np.asarray(a, np.int64)[:J]
+            ready_c = np.asarray(r, np.int64)[:J]
+            failed = np.asarray(f, np.int64)[:J]
+        else:
+            alive = jobs >= 0
+            pend_run = alive & (phase <= PHASE_RUNNING)
+            active = np.bincount(jobs[pend_run], minlength=J)
+            ready_c = np.bincount(
+                jobs[pend_run & (ready != 0)], minlength=J
+            )
+            failed = np.bincount(
+                jobs[alive & (phase == PHASE_FAILED)], minlength=J
+            )
+
+        # Distinct (job, completion-index) pairs — succeeded, and
+        # "existing" (live or succeeded). Small result sets; numpy-only.
+        # The succeeded sort is skipped entirely in the common steady state
+        # (no Succeeded pod anywhere in the store), and the existing sort
+        # whenever the live-index tracker proves every pair distinct —
+        # then the distinct count IS the plain per-job bincount.
+        alive = jobs >= 0
+        has_idx = cidx >= 0
+        base = max(int(cidx.max()) + 2, 2) if P else 2
+        succ = alive & (phase == PHASE_SUCCEEDED) & has_idx
+        if succ.any():
+            spairs = np.unique(
+                jobs[succ].astype(np.int64) * base + cidx[succ]
+            )
+        else:
+            spairs = np.empty(0, np.int64)
+        exist = (
+            alive
+            & ((phase <= PHASE_RUNNING) | (phase == PHASE_SUCCEEDED))
+            & has_idx
+        )
+        ejobs = jobs[exist]  # compact snapshot copies (fancy indexing):
+        ecidx = cidx[exist]  # the lazy pair sort must see pass-start state
+        if self._live_idx_dups == 0:
+            exist_count = np.bincount(ejobs, minlength=J)
+            epairs = None  # built lazily if a job turns out short of pods
+        else:
+            epairs = np.unique(ejobs.astype(np.int64) * base + ecidx)
+            exist_count = np.bincount(epairs // base, minlength=J)
+        return Aggregates(
+            active, ready_c, failed, spairs, base, J,
+            ejobs, ecidx, exist_count, epairs,
+        )
+
+    def bucket_and_statuses_locked(self, js, jobs: list):
+        """The reconcile pump's child-job bucketing + per-ReplicatedJob
+        status math (bucket_child_jobs + calculate_replicated_job_statuses)
+        as ONE vectorized pass over the job columns.
+
+        The partition is STABLE over the input list (np.flatnonzero
+        ascending == the object path's append order), so downstream
+        consumers — deletion order, failure-policy inputs — see the exact
+        lists the Python loops would have built. Returns
+        (ChildJobs, [ReplicatedJobStatus]) or None when any job lacks a
+        row (caller falls back to the object path)."""
+        from ..api.types import ReplicatedJobStatus
+        from .child_jobs import ChildJobs
+
+        rows_list = []
+        job_rows = self._job_rows
+        for job in jobs:
+            row = job_rows.get(job.metadata.uid)
+            if row is None:
+                return None
+            rows_list.append(row)
+        rows = np.asarray(rows_list, np.int64)
+
+        restarts = js.status.restarts
+        att = self.job_attempt[rows]
+        fin = self.job_finished[rows]
+        stale = att < restarts
+        active_m = ~stale & (fin == 0)
+        failed_m = ~stale & (fin == 2)
+        succ_m = ~stale & (fin == 1)
+
+        owned = ChildJobs(
+            active=[jobs[i] for i in np.flatnonzero(active_m)],
+            successful=[jobs[i] for i in np.flatnonzero(succ_m)],
+            failed=[jobs[i] for i in np.flatnonzero(failed_m)],
+            delete=[jobs[i] for i in np.flatnonzero(stale)],
+        )
+
+        rjob_ids = self.job_rjob[rows]
+        ready_crit = (
+            self.job_succeeded[rows] + self.job_ready[rows]
+            >= self.job_expected[rows]
+        )
+        has_active = self.job_active[rows] > 0
+        suspended = self.job_suspended[rows] == 1
+        statuses = []
+        for rjob in js.spec.replicated_jobs:
+            rid = self.strings.id_locked(rjob.name)
+            mine = rjob_ids == rid if rid >= 0 else np.zeros(len(rows), bool)
+            mine_active = mine & active_m
+            statuses.append(
+                ReplicatedJobStatus(
+                    name=rjob.name,
+                    ready=int(np.count_nonzero(mine_active & ready_crit)),
+                    active=int(np.count_nonzero(mine_active & has_active)),
+                    suspended=int(
+                        np.count_nonzero(mine_active & suspended)
+                    ),
+                    succeeded=int(np.count_nonzero(mine & succ_m)),
+                    failed=int(np.count_nonzero(mine & failed_m)),
+                )
+            )
+        return owned, statuses
+
+    def first_fit_node_locked(self):
+        """First node (insertion order) with free capacity and no
+        NoSchedule taint — the plain-pod scheduling scan, vectorized.
+        Parity holds for pods with no nodeSelector and no tolerations
+        (the scheduler falls back to the object scan otherwise)."""
+        n = len(self._node_objs)
+        if not n:
+            return None
+        fits = (self.node_allocated[:n] < self.node_capacity[:n]) & (
+            self.node_tainted[:n] == 0
+        )
+        idx = int(np.argmax(fits))
+        if not fits[idx]:
+            return None
+        return self._node_objs[idx]
+
+    def job_key_in_domain_locked(
+        self, cluster, topology_key: str, value: str, job_key: str
+    ) -> bool:
+        """Does `job_key` still have any BOUND pod in topology domain
+        `value`? — the release-path occupancy check, vectorized over the
+        gang's rows instead of scanning every pod record's node labels."""
+        tab = self.topology_locked(cluster, topology_key)
+        idx = tab.index.get(value)
+        if idx is None:
+            return False  # no node carries this value: nothing bound there
+        rows = self._jk_rows.get(job_key)
+        if not rows:
+            return False
+        nodes = self.pod_node[np.asarray(rows, np.int32)]
+        bound = nodes >= 0
+        if not bound.any():
+            return False
+        return bool(np.any(tab.node_domain[nodes[bound]] == idx))
+
+    def free_domain_indexes_locked(self, tab: _Topology) -> np.ndarray:
+        """Unoccupied domain indexes in sorted-value order — the leader
+        path's `sorted(v for v in domains if not occupancy.get(v))`."""
+        return np.flatnonzero(tab.occ_count[: len(tab.values)] == 0)
+
+    def followers_match_locked(
+        self, cluster, namespace: str, job_key: str, leader_value: str
+    ) -> Optional[bool]:
+        """Vectorized validatePodPlacements: do all follower pods of
+        `job_key` pin their exclusive nodeSelector to the leader's domain?
+        The gang's rows come from the job-key row index (job keys hash the
+        namespaced job name, so the index is namespace-exact by
+        construction). Returns None when the mirror disagrees with the
+        object index on the gang's pod count (caller falls back)."""
+        rows = self._jk_rows.get(job_key, ())
+        # pods_by_job_key is discard-on-delete (never stale), and job keys
+        # are namespace-exact hashes, so a bare length compare validates
+        # the mirror against the object index in O(1).
+        if len(rows) != len(cluster.pods_by_job_key.get(job_key, ())):
+            return None
+        if not rows:
+            return True
+        idx = np.asarray(rows, np.int32)
+        followers = self.pod_cidx[idx] != 0
+        leader_id = self.strings.id_locked(leader_value)
+        if leader_id < 0:
+            # The leader's domain value was never interned, so no pod's
+            # selector can equal it (and an UNSET selector, -1, must not
+            # false-match): valid only with no followers at all.
+            return not bool(followers.any())
+        return bool(np.all(self.pod_sel[idx][followers] == leader_id))
+
+    # ------------------------------------------------------------------
+    # Rebuild + canonical snapshot (the incremental-vs-rebuilt contract)
+    # ------------------------------------------------------------------
+
+    def rebuild_locked(self, cluster) -> None:
+        """Derive every column from the object graph from scratch (fresh
+        construction, crash-recovery restore). Incremental maintenance and
+        this rebuild must agree — test_columnar.py churns then compares
+        `snapshot_locked` outputs."""
+        self._pod_rows.clear()
+        self._pod_free.clear()
+        self._jk_rows.clear()
+        self._live_idx.clear()
+        self._live_idx_dups = 0
+        self._pod_len = 0
+        self._job_rows.clear()
+        self._job_free.clear()
+        self._job_len = 0
+        self._node_rows.clear()
+        self._node_objs = []
+        self._topologies.clear()
+        self.pod_job[:] = -1
+        self.pod_node[:] = -1
+        self.pod_cidx[:] = -1
+        self.pod_sel[:] = -1
+        self.pod_phase[:] = 0
+        self.pod_ready[:] = 0
+        self.pod_restarts[:] = 0
+        self.job_expected[:] = 0
+        self.node_capacity[:] = 0
+        self.node_allocated[:] = 0
+        self.node_tainted[:] = 0
+
+        for node in cluster.nodes.values():
+            self.node_added_locked(node)
+        for job in cluster.jobs.values():
+            self.job_created_locked(job)
+        for key, pod in cluster.pods.items():
+            self.pod_created_locked(key, pod, pod.metadata.owner_uid)
+        # Node allocation came from the node objects (node_added_locked),
+        # which the cluster maintains; pod_created_locked deliberately
+        # does not re-add bound pods to it.
+
+    def snapshot_locked(self, cluster) -> dict:
+        """Canonical (row-number-free) view of the mirror, for equality
+        between an incrementally-maintained instance and a fresh rebuild."""
+        pods = {}
+        for key, row in self._pod_rows.items():
+            node = int(self.pod_node[row])
+            sel = int(self.pod_sel[row])
+            pods[key] = (
+                int(self.pod_phase[row]),
+                int(self.pod_ready[row]),
+                self._node_objs[node].name if node >= 0 else None,
+                int(self.pod_cidx[row]),
+                int(self.pod_restarts[row]),
+                self.strings.value_locked(sel) if sel >= 0 else None,
+            )
+        nodes = {
+            name: (
+                int(self.node_capacity[row]),
+                int(self.node_allocated[row]),
+                int(self.node_tainted[row]),
+            )
+            for name, row in self._node_rows.items()
+        }
+        jobs = {
+            uid: int(self.job_expected[row])
+            for uid, row in self._job_rows.items()
+        }
+        topologies = {}
+        for tk in cluster.domain_job_keys:
+            tab = self.topology_locked(cluster, tk)
+            topologies[tk] = {
+                value: int(tab.occ_count[i])
+                for value, i in tab.index.items()
+                if tab.occ_count[i]
+            }
+        job_key_rows = {
+            jk: sorted(int(self.pod_cidx[r]) for r in rows)
+            for jk, rows in self._jk_rows.items()
+            if rows
+        }
+        return {
+            "pods": pods,
+            "nodes": nodes,
+            "jobs": jobs,
+            "topologies": topologies,
+            "job_key_rows": job_key_rows,
+        }

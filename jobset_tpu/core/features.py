@@ -30,6 +30,13 @@ _DEFAULTS: dict[str, bool] = {
     # queues, and 429 + Retry-After load shedding in front of request
     # routing; /debug/*, /ha/* and lease/leader traffic stay exempt.
     "APIFlowControl": False,
+    # Array-backed hot cluster state (core/columnar.py, docs/columnar.md):
+    # packed int32 columns mirror pods/nodes/domain occupancy so the
+    # per-tick hot loops (gang-readiness aggregation, node-fit checks,
+    # free-domain scans) run vectorized instead of walking the Python
+    # object graph. Sampled at Cluster construction; decisions and event
+    # streams are byte-identical to the object-graph path.
+    "ColumnarCore": False,
 }
 
 _gates: dict[str, bool] = dict(_DEFAULTS)

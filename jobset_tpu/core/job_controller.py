@@ -28,10 +28,20 @@ class JobController:
         """Visit only jobs whose pods/spec changed since the last pass
         (cluster.dirty_job_uids — the watch-queue analog of the real k8s Job
         controller); jobs with admission-rejected pods stay queued so the
-        transient-rejection retry loop keeps running."""
+        transient-rejection retry loop keeps running.
+
+        With the columnar mirror attached (`ColumnarCore`), the per-pod
+        aggregation loops of every dirty job collapse into ONE whole-store
+        vectorized pass (ColumnarState.job_aggregates_locked) — the
+        gang-readiness scan — and `_sync_pods` consumes the precomputed
+        per-job view; the decision logic downstream is the identical
+        Python either way."""
         changed = False
         cluster = self.cluster
         dirty, cluster.dirty_job_uids = cluster.dirty_job_uids, set()
+        agg = None
+        if cluster.columnar is not None and dirty:
+            agg = cluster.columnar.job_aggregates_locked()
         retry: set[str] = set()
         for uid in sorted(dirty):
             key = cluster.jobs_by_uid.get(uid)
@@ -44,7 +54,7 @@ class JobController:
             if job.suspended():
                 changed |= self._sync_suspended(job)
                 continue
-            pods_changed, complete = self._sync_pods(job)
+            pods_changed, complete = self._sync_pods(job, agg)
             changed |= pods_changed
             if not complete:
                 retry.add(uid)
@@ -63,6 +73,8 @@ class JobController:
         if job.status.active != 0 or job.status.ready != 0:
             job.status.active = 0
             job.status.ready = 0
+            if self.cluster.columnar is not None:
+                self.cluster.columnar.job_counts_locked(job)
             changed = True
         return changed
 
@@ -71,12 +83,17 @@ class JobController:
         # and the solver's capacity feasibility (objects.py pods_expected).
         return job.pods_expected()
 
-    def _sync_pods(self, job: Job) -> tuple[bool, bool]:
+    def _sync_pods(self, job: Job, agg=None) -> tuple[bool, bool]:
         """One pass over the job's pod index: aggregate status counts AND
         create missing pods. Returns (changed, complete) where complete means
-        every desired pod exists (nothing left to retry)."""
+        every desired pod exists (nothing left to retry).
+
+        `agg` (a ColumnarState.job_aggregates_locked result) replaces the
+        per-pod aggregation loop with a precomputed per-job view — the same
+        five values the loop derives, computed vectorized over the whole
+        pod store at once. Everything downstream of the aggregation is the
+        identical code either way (the parity contract)."""
         cluster = self.cluster
-        desired = self._desired_indexes(job)
         active = ready = failed = 0
         # Completion credit is index-based and survives pod-record deletion
         # (drift enforcement may delete a Succeeded pod's record): the
@@ -85,24 +102,55 @@ class JobController:
         # live Succeeded pods, mirroring k8s's finalizer-backed accounting.
         succeeded_indexes: set[int] = set(job.status.succeeded_indexes)
         existing: set[int] = set(succeeded_indexes)
-        for key in cluster.pods_by_job_uid.get(job.metadata.uid, ()):
-            pod = cluster.pods.get(key)
-            if pod is None:
-                continue
-            phase = pod.status.phase
-            idx = pod.completion_index()
-            if phase in (POD_PENDING, POD_RUNNING):
-                active += 1
-                if pod.status.ready:
-                    ready += 1
-                if idx is not None:
-                    existing.add(idx)
-            elif phase == "Succeeded":
-                if idx is not None:
-                    succeeded_indexes.add(idx)
-                    existing.add(idx)
-            elif phase == POD_FAILED:
-                failed += 1
+        row = (
+            cluster.columnar.job_row_locked(job.metadata.uid)
+            if agg is not None
+            else None
+        )
+        if row is not None:
+            # min(parallelism, completions) from the job_expected column
+            # (synced at every job create/update) instead of the spec walk.
+            desired = int(cluster.columnar.job_expected[row])
+            active = int(agg.active[row])
+            ready = int(agg.ready[row])
+            failed = int(agg.failed[row])
+            if succeeded_indexes or agg.succ_count[row]:
+                succeeded_indexes.update(
+                    int(i) for i in agg.succeeded_idxs_locked(row)
+                )
+                existing = set(succeeded_indexes)
+                existing.update(
+                    int(i) for i in agg.existing_idxs_locked(row)
+                )
+                existing_count = len(existing)
+            else:
+                # Steady state (no completion credit anywhere): the
+                # distinct-index COUNT decides everything downstream; the
+                # actual index set is materialized lazily only if pods
+                # turn out to be missing.
+                existing = None
+                existing_count = int(agg.exist_count[row])
+        else:
+            desired = self._desired_indexes(job)
+            for key in cluster.pods_by_job_uid.get(job.metadata.uid, ()):
+                pod = cluster.pods.get(key)
+                if pod is None:
+                    continue
+                phase = pod.status.phase
+                idx = pod.completion_index()
+                if phase in (POD_PENDING, POD_RUNNING):
+                    active += 1
+                    if pod.status.ready:
+                        ready += 1
+                    if idx is not None:
+                        existing.add(idx)
+                elif phase == "Succeeded":
+                    if idx is not None:
+                        succeeded_indexes.add(idx)
+                        existing.add(idx)
+                elif phase == POD_FAILED:
+                    failed += 1
+            existing_count = len(existing)
         # Write the union back so the survival guarantee holds even for a
         # Succeeded pod whose index was never recorded via succeed_pod.
         job.status.succeeded_indexes |= succeeded_indexes
@@ -145,7 +193,11 @@ class JobController:
         # Leader (index 0) first: under exclusive placement follower admission
         # is gated on the leader being scheduled, so creating in index order
         # minimizes rejected attempts.
-        if len(existing) < desired:
+        if existing_count < desired:
+            if existing is None:
+                existing = {
+                    int(i) for i in agg.existing_idxs_locked(row)
+                }
             for idx in range(desired):
                 if idx in existing:
                     continue
@@ -194,6 +246,8 @@ class JobController:
                 job.status.succeeded,
                 job.status.failed,
             ) = new
+            if self.cluster.columnar is not None:
+                self.cluster.columnar.job_counts_locked(job)
             if job.status.start_time is None and active:
                 job.status.start_time = self.cluster.clock.now()
                 # activeDeadlineSeconds (k8s Job semantics, enforced by the

@@ -41,6 +41,10 @@ class Node:
 class PodStatus:
     phase: str = POD_PENDING
     ready: bool = False
+    # containerStatuses[].restartCount analog: in-place container restarts
+    # (Cluster.restart_pod_container) bump this without replacing the pod —
+    # the restartPolicy=OnFailure path, distinct from pod-level failure.
+    restarts: int = 0
     conditions: list[Condition] = field(default_factory=list)
 
 

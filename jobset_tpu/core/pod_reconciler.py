@@ -75,6 +75,25 @@ class PodReconciler:
         job_key = leader.labels.get(keys.JOB_KEY)
         if not job_key:
             return False
+
+        # Columnar fast path for the common verdict (everything placed
+        # right): the follower nodeSelector check runs as one vectorized
+        # compare over the interned-selector column instead of per-pod
+        # dict lookups. Deletion (the rare verdict) still walks objects.
+        col = cluster.columnar
+        if col is not None:
+            valid = col.followers_match_locked(
+                cluster, leader.metadata.namespace, job_key, leader_topology
+            )
+            if valid:
+                return False
+            if valid is not None:
+                return self._delete_follower_pods(
+                    cluster.pods_for_job_key(
+                        leader.metadata.namespace, job_key
+                    )
+                )
+
         pods = cluster.pods_for_job_key(leader.metadata.namespace, job_key)
 
         if self._placements_valid(pods, topology_key, leader_topology):

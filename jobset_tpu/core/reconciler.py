@@ -91,8 +91,20 @@ class JobSetReconciler:
         ctx = ReconcileCtx()
         now = cluster.clock.now()
 
-        owned = bucket_child_jobs(js, cluster.jobs_for_jobset(js))
-        statuses = self.calculate_replicated_job_statuses(js, owned)
+        # Child-job bucketing + per-ReplicatedJob status math: ONE
+        # vectorized columnar pass for large jobsets (the gang-readiness
+        # scan of the reconcile pump), the per-job Python loops otherwise.
+        # The columnar partition is stable over the same input list, so
+        # both paths build identical ChildJobs lists and statuses.
+        jobs = cluster.jobs_for_jobset(js)
+        owned = statuses = None
+        if cluster.columnar is not None and len(jobs) >= 16:
+            fast = cluster.columnar.bucket_and_statuses_locked(js, jobs)
+            if fast is not None:
+                owned, statuses = fast
+        if owned is None:
+            owned = bucket_child_jobs(js, jobs)
+            statuses = self.calculate_replicated_job_statuses(js, owned)
         self._update_replicated_job_statuses(js, statuses, ctx)
         # Flight recorder: detect the all-placed / all-ready transitions
         # off the statuses just computed (SLO phase marks; a few dict
@@ -170,12 +182,24 @@ class JobSetReconciler:
             rjob.name: ReplicatedJobStatus(name=rjob.name)
             for rjob in js.spec.replicated_jobs
         }
+        # Gang-readiness criterion: with the columnar mirror, the expected
+        # pod count comes from the job_expected column (maintained at
+        # create/update) instead of re-deriving min(parallelism,
+        # completions) from the spec on every reconcile of every job.
+        col = self.cluster.columnar
         for job in owned.active:
             rjob_name = job.labels.get(keys.REPLICATED_JOB_NAME_KEY, "")
             status = counts.get(rjob_name)
             if status is None:
                 continue
-            if job.status.succeeded + job.status.ready >= job.pods_expected():
+            expected = None
+            if col is not None:
+                row = col.job_row_locked(job.metadata.uid)
+                if row is not None:
+                    expected = int(col.job_expected[row])
+            if expected is None:
+                expected = job.pods_expected()
+            if job.status.succeeded + job.status.ready >= expected:
                 status.ready += 1
             if job.status.active > 0:
                 status.active += 1
@@ -398,12 +422,21 @@ class JobSetReconciler:
         ctx: ReconcileCtx,
         now: float,
     ) -> None:
+        in_order = in_order_startup_policy(js)
+        # Fast-out on the steady state: with no suspended job in any
+        # counted ReplicatedJob and no InOrder gating, the per-rjob loop
+        # below can only no-op its way to set_resumed — skip building the
+        # template/by-rjob maps. (Jobs with an unknown rjob label are
+        # never visited by the loop either, so the statuses' suspended
+        # counts decide this exactly.)
+        if not in_order and not any(s.suspended for s in statuses):
+            set_resumed(js, ctx, now)
+            return
+
         templates = {r.name: r.template.spec.template for r in js.spec.replicated_jobs}
         by_rjob: dict[str, list[Job]] = {}
         for job in active:
             by_rjob.setdefault(job.labels.get(keys.REPLICATED_JOB_NAME_KEY, ""), []).append(job)
-
-        in_order = in_order_startup_policy(js)
         for rjob in js.spec.replicated_jobs:
             status = next((s for s in statuses if s.name == rjob.name), None)
             if in_order and all_replicas_started(int(rjob.replicas), status):

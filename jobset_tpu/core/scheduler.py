@@ -76,7 +76,19 @@ class Scheduler:
         if topology_key and job_key:
             return self._find_node_in_allowed_domain(pod, topology_key, job_key)
 
-        # Plain pod: first fitting node, deterministic order.
+        # Plain pod: first fitting node, deterministic order. With the
+        # columnar mirror the O(nodes) Python scan becomes one vectorized
+        # free-and-untainted mask over the node columns — exact parity
+        # holds when neither selectors nor tolerations participate (the
+        # mirror models capacity and NoSchedule taints; anything richer
+        # falls through to the object scan).
+        col = self.cluster.columnar
+        if (
+            col is not None
+            and not pod.spec.node_selector
+            and not pod.spec.tolerations
+        ):
+            return col.first_fit_node_locked()
         for node in self.cluster.nodes.values():
             if self._node_fits(pod, node):
                 return node
@@ -128,6 +140,38 @@ class Scheduler:
             return None
 
         occupancy = self.cluster.domain_job_keys.get(topology_key, {})
+
+        # Columnar fast path: the candidate set — this key's own occupied
+        # domain, else every unoccupied domain in sorted order — comes from
+        # the incrementally-maintained occupancy-count vector and owner
+        # mirror instead of the O(domains) sorted scan per leader. Keys
+        # owning an unindexable domain value, or owning several domains
+        # (where the object path's candidate ORDER is occupancy insertion
+        # order, which the mirror does not preserve), fall back.
+        col = self.cluster.columnar
+        if col is not None:
+            tab = col.topology_locked(self.cluster, topology_key)
+            kid = col.strings.id_locked(job_key)
+            if kid < 0 or kid not in tab.foreign_owners:
+                own = tab.owner_domains.get(kid) if kid >= 0 else None
+                if own is None:
+                    candidates = col.free_domain_indexes_locked(tab)
+                elif len(own) == 1:
+                    candidates = list(own)
+                else:
+                    candidates = None
+                if candidates is not None:
+                    for di in candidates:
+                        value = tab.values[di]
+                        owners = occupancy.get(value, set())
+                        if owners - {job_key}:
+                            continue
+                        for node_row in tab.node_rows[di]:
+                            node = col.node_obj_locked(node_row)
+                            if self._node_fits(pod, node):
+                                return node
+                    return None
+
         domains = self.cluster.domain_nodes(topology_key)
 
         # Affinity: if pods with our job key are already bound somewhere, we

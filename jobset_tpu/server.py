@@ -86,7 +86,11 @@ def _pod_dict(pod) -> dict:
             "subdomain": pod.spec.subdomain,
             "nodeSelector": dict(pod.spec.node_selector),
         },
-        "status": {"phase": pod.status.phase, "ready": pod.status.ready},
+        "status": {
+            "phase": pod.status.phase,
+            "ready": pod.status.ready,
+            "restarts": pod.status.restarts,
+        },
     }
 
 

@@ -170,6 +170,7 @@ def pod_to_dict(pod: Pod) -> dict:
         "status": {
             "phase": pod.status.phase,
             "ready": pod.status.ready,
+            "restarts": pod.status.restarts,
             "conditions": _conditions_dict(pod.status.conditions),
         },
     }
@@ -183,6 +184,7 @@ def pod_from_dict(d: dict) -> Pod:
         status=PodStatus(
             phase=s["phase"],
             ready=s["ready"],
+            restarts=s.get("restarts", 0),
             conditions=_conditions_from(s.get("conditions") or ()),
         ),
     )
