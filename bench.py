@@ -2171,6 +2171,16 @@ def _bank_policy(result: dict) -> None:
     _bank_sidecar_key("policy", result)
 
 
+def _pct(samples, q: float) -> float:
+    """Ceil-rank (nearest-rank) percentile over raw samples — shared by
+    the HA and shard benches so their banked percentiles cannot drift."""
+    if not samples:
+        return float("nan")
+    ordered = sorted(samples)
+    rank = max(0, min(len(ordered) - 1, math.ceil(q * len(ordered)) - 1))
+    return ordered[rank]
+
+
 def run_ha_bench(args) -> dict:
     """Replicated-control-plane bench (docs/ha.md): a 3-replica in-process
     quorum under a sequential write storm with a seeded leader-kill storm
@@ -2253,13 +2263,7 @@ def run_ha_bench(args) -> dict:
         lost = [n for n in acked if f"default/{n}" not in final]
         unavailable_s = sum(failovers)
 
-        def pct(samples: list[float], q: float) -> float:
-            if not samples:
-                return float("nan")
-            ordered = sorted(samples)
-            rank = max(0, min(len(ordered) - 1,
-                              math.ceil(q * len(ordered)) - 1))
-            return ordered[rank]
+        pct = _pct
 
         return {
             "replicas": replicas,
@@ -2290,6 +2294,305 @@ def run_ha_bench(args) -> dict:
 
 def _bank_ha(result: dict) -> None:
     _bank_sidecar_key("ha", result)
+
+
+def run_shard_bench(args) -> dict:
+    """Sharded control-plane bench (`--ha --shards N`, docs/sharding.md):
+    three measurements over in-process planes with REAL per-record
+    fsyncs and per-shard quorum replication.
+
+    * **Scaling curve**: for n in (1, 2, 4, ...) up to N, an n-shard
+      plane behind one front door takes a fixed-width concurrent write
+      storm (8 writer threads, keys pre-bucketed per shard with the
+      map's own hash) — aggregate MAJORITY-ACKED writes/s per n. The
+      1-shard figure is the displaced single-WAL control plane; the
+      acceptance bar is >2x at 4 shards.
+    * **Region isolation** (at N): a full isolation of one non-front-
+      door home region for `isolation_s`, write attempts round-robin
+      across every shard through the window — per-shard availability
+      (shards quorum-homed in the dark region go unroutable; every
+      other shard must stay >99%).
+    * **Per-shard failover**: the victim shard's leader is hard-killed
+      `kills` times mid-storm; time from kill to that shard's next
+      clean ack (other shards keep serving throughout).
+
+    Every clean-acked write is verified present on its owning shard's
+    final leader (zero lost)."""
+    import http.client
+    import shutil
+    import tempfile
+    import threading
+
+    from jobset_tpu.api import serialization
+    from jobset_tpu.chaos.injector import FaultInjector
+    from jobset_tpu.chaos.net import PartitionPlan
+    from jobset_tpu.shard import ShardedControlPlane
+    from jobset_tpu.testing import make_jobset, make_replicated_job
+
+    total_writes = 240
+    writer_threads = 8
+    isolation_s = 4.0
+    kills = 3
+    seed = 37
+
+    template = serialization.to_dict(
+        make_jobset("template")
+        .replicated_job(
+            make_replicated_job("w").replicas(1)
+            .parallelism(1).completions(1).obj()
+        )
+        .suspend(True)
+        .obj()
+    )
+
+    def manifest_body(name: str) -> bytes:
+        doc = json.loads(json.dumps(template))
+        doc["metadata"]["name"] = name
+        return json.dumps(doc).encode()
+
+    api = "/apis/jobset.x-k8s.io/v1alpha2/namespaces/default/jobsets"
+
+    def one_write(conn, name: str):
+        """(clean_ack, status) over a kept-alive connection."""
+        body = manifest_body(name)
+        conn.request("POST", api, body,
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        resp.read()
+        return (
+            resp.status == 201 and not resp.getheader("Warning"),
+            resp.status,
+        )
+
+    def storm(plane, names: list) -> dict:
+        """Fixed-width concurrent storm through the front door; returns
+        aggregate acked/s + per-write latency percentiles."""
+        host, _, port = plane.address.rpartition(":")
+        cursor = {"i": 0}
+        cursor_lock = threading.Lock()
+        acked: list = []
+        latencies: list = []
+
+        def worker():
+            conn = http.client.HTTPConnection(host, int(port), timeout=30)
+            try:
+                while True:
+                    with cursor_lock:
+                        i = cursor["i"]
+                        if i >= len(names):
+                            return
+                        cursor["i"] = i + 1
+                    name = names[i]
+                    t0 = time.perf_counter()
+                    clean, _status = one_write(conn, name)
+                    dt = time.perf_counter() - t0
+                    with cursor_lock:
+                        if clean:
+                            acked.append(name)
+                            latencies.append(dt)
+            finally:
+                conn.close()
+
+        threads = [
+            threading.Thread(target=worker) for _ in range(writer_threads)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        return {
+            "acked": acked, "wall_s": wall, "latencies": latencies,
+        }
+
+    pct = _pct
+
+    shard_counts = sorted({
+        n for n in (1, 2, 4, args.shards) if 1 <= n <= args.shards
+    })
+    curve = []
+    for n in shard_counts:
+        base_dir = tempfile.mkdtemp(prefix=f"bench-shards-{n}-")
+        plane = ShardedControlPlane(
+            base_dir, shards=n, replicas_per_shard=3, seed=seed,
+            lease_duration=0.5, retry_period=0.1, tick_interval=0.05,
+        )
+        plane.start_supervisor()
+        try:
+            names = [
+                plane.map.key_for_shard(i % n, i, prefix="sc")
+                for i in range(total_writes)
+            ]
+            result = storm(plane, names)
+            # Zero-lost verification on each owning shard's leader.
+            lost = 0
+            finals = [
+                plane.shard_groups[s].leader().store
+                .serialized_state()["jobsets"]
+                for s in range(n)
+            ]
+            for name in result["acked"]:
+                shard = plane.map.shard_for("default", name)
+                if f"default/{name}" not in finals[shard]:
+                    lost += 1
+            curve.append({
+                "shards": n,
+                "writes": total_writes,
+                "acked": len(result["acked"]),
+                "lost_acked": lost,
+                "acked_writes_per_sec": round(
+                    len(result["acked"]) / result["wall_s"], 1
+                ),
+                "write_latency_ms": {
+                    "p50": round(pct(result["latencies"], 0.5) * 1e3, 2),
+                    "p99": round(pct(result["latencies"], 0.99) * 1e3, 2),
+                },
+            })
+        finally:
+            plane.stop()
+            shutil.rmtree(base_dir, ignore_errors=True)
+
+    # -- region isolation + failover at the full shard count ------------
+    n = args.shards
+    base_dir = tempfile.mkdtemp(prefix="bench-shards-iso-")
+    injector = FaultInjector(seed=seed)
+    PartitionPlan(seed=seed, injector=injector)
+    plane = ShardedControlPlane(
+        base_dir, shards=n, replicas_per_shard=3, seed=seed,
+        injector=injector,
+        lease_duration=0.5, retry_period=0.1, tick_interval=0.05,
+    )
+    plane.start_supervisor()
+    try:
+        host, _, port = plane.address.rpartition(":")
+        front = plane.topology.front_door_region
+        # A region isolation needs a home OUTSIDE the front-door region
+        # (cutting the front door's own region would sever the router
+        # itself). With --shards 1 — and seed-dependently at 2 — every
+        # shard may home with the front door; skip the phase then
+        # instead of crashing on an empty selection.
+        victim_region = next(
+            (plane.map.homes[s] for s in range(n)
+             if plane.map.homes[s] != front),
+            None,
+        )
+        homed: list = []
+        if victim_region is None:
+            availability = None
+            non_homed = []
+            region_isolation = {
+                "skipped": "every shard homes in the front-door region "
+                           f"({front}); no isolatable region",
+            }
+        else:
+            homed = plane.quorum_homed_in(victim_region)
+            attempts: dict = {s: 0 for s in range(n)}
+            clean_acks: dict = {s: 0 for s in range(n)}
+            plane.isolate_region(victim_region)
+            t0 = time.perf_counter()
+            conn = http.client.HTTPConnection(host, int(port), timeout=2)
+            i = 0
+            while time.perf_counter() - t0 < isolation_s:
+                shard = i % n
+                name = plane.map.key_for_shard(shard, 1000 + i,
+                                               prefix="iso")
+                try:
+                    clean, _status = one_write(conn, name)
+                except (OSError, http.client.HTTPException):
+                    conn.close()
+                    conn = http.client.HTTPConnection(host, int(port),
+                                                      timeout=2)
+                    clean = False
+                attempts[shard] += 1
+                if clean:
+                    clean_acks[shard] += 1
+                i += 1
+            conn.close()
+            plane.heal_region(victim_region)
+            availability = {
+                str(s): round(100.0 * clean_acks[s] / attempts[s], 2)
+                if attempts[s] else None
+                for s in range(n)
+            }
+            non_homed = [
+                availability[str(s)] for s in range(n) if s not in homed
+            ]
+            region_isolation = {
+                "region": victim_region,
+                "isolation_s": isolation_s,
+                "quorum_homed_shards": homed,
+                "write_availability_pct": availability,
+                "non_homed_min_availability_pct": (
+                    min(non_homed) if non_homed else None
+                ),
+            }
+
+        # Per-shard failover: kill a NON-degraded shard's leader (any
+        # shard when nothing was isolated) and time to its next clean
+        # ack (the supervisor thread drives the election).
+        failover_shard = next(
+            (s for s in range(n) if s not in homed), 0
+        )
+        group = plane.shard_groups[failover_shard]
+        failovers = []
+        for k in range(kills):
+            killed = group.kill_leader()
+            t_kill = time.perf_counter()
+            conn = http.client.HTTPConnection(host, int(port), timeout=2)
+            j = 0
+            while True:
+                name = plane.map.key_for_shard(
+                    failover_shard, 2000 + k * 100 + j, prefix="fo"
+                )
+                try:
+                    clean, _status = one_write(conn, name)
+                except (OSError, http.client.HTTPException):
+                    conn.close()
+                    conn = http.client.HTTPConnection(host, int(port),
+                                                      timeout=2)
+                    clean = False
+                if clean:
+                    failovers.append(time.perf_counter() - t_kill)
+                    break
+                if time.perf_counter() - t_kill > 60.0:
+                    # Bounded like every other wait in the shard plane:
+                    # a shard that never re-elects is a bench FAILURE,
+                    # not an infinite spin.
+                    raise RuntimeError(
+                        f"shard {failover_shard} never recovered from "
+                        f"kill {k} within 60s"
+                    )
+                j += 1
+                time.sleep(0.01)
+            conn.close()
+            group.rejoin(killed)
+    finally:
+        plane.stop()
+        shutil.rmtree(base_dir, ignore_errors=True)
+
+    base = curve[0]["acked_writes_per_sec"]
+    top = curve[-1]["acked_writes_per_sec"]
+    return {
+        "seed": seed,
+        "writer_threads": writer_threads,
+        "scaling_curve": curve,
+        "speedup_vs_one_shard": round(top / base, 2) if base else None,
+        "region_isolation": region_isolation,
+        "failover": {
+            "shard": failover_shard,
+            "kills": kills,
+            "per_shard_failover_ms": {
+                "p50": round(pct(failovers, 0.5) * 1e3, 1),
+                "p99": round(pct(failovers, 0.99) * 1e3, 1),
+                "samples": [round(f * 1e3, 1) for f in failovers],
+            },
+        },
+    }
+
+
+def _bank_shards(result: dict) -> None:
+    _bank_sidecar_key("shards", result)
 
 
 def run_partition_bench(args) -> dict:
@@ -3737,6 +4040,14 @@ def main() -> int:
              "BENCH_PLACEMENT_TPU_LAST.json under 'ha'",
     )
     parser.add_argument(
+        "--shards", type=int, default=0, metavar="N",
+        help="with --ha: run the SHARDED control-plane bench instead "
+             "(scaling curve 1..N shard groups, write availability "
+             "through a region isolation, per-shard failover latency) "
+             "and bank it into BENCH_PLACEMENT_TPU_LAST.json under "
+             "'shards'",
+    )
+    parser.add_argument(
         "--partition", action="store_true",
         help="run ONLY the partition-tolerance bench (3-replica quorum, "
              "10s leader isolation via the network fault model; majority-"
@@ -3810,6 +4121,19 @@ def main() -> int:
             "metric": "restart_recovery_throughput",
             "value": result["at_10k"]["objects_per_sec"],
             "unit": "objects/s",
+            "detail": result,
+        }))
+        return 0
+
+    if args.ha and args.shards:
+        # Sharded control plane (docs/sharding.md): pure control-plane
+        # bench, no accelerator (suspended gangs, greedy placement).
+        result = run_shard_bench(args)
+        _bank_shards(result)
+        print(json.dumps({
+            "metric": "shard_scaling_speedup",
+            "value": result["speedup_vs_one_shard"],
+            "unit": "x vs 1 shard",
             "detail": result,
         }))
         return 0

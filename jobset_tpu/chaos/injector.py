@@ -55,6 +55,11 @@ just names):
                        heal transitions land in this log as first-class
                        entries, so seeded-run byte-identity covers
                        recovery timing, not just fault onsets
+``shard.route``        sharded front door (shard/router.py): one arrival
+                       per dispatch to an owning shard's leader — any
+                       error kind makes that dispatch answer
+                       503 + shard-leader hint (the unroutable path, as
+                       if the shard were dark), ``latency`` delays it
 ================== ======================================================
 
 Spec grammar (CLI ``--inject`` / ``FaultInjector.from_spec``)::

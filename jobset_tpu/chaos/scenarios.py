@@ -29,6 +29,20 @@ from .injector import (
 _LIVE_PHASES = ("Pending", "Running")
 
 
+def _columnar_cluster(**kwargs):
+    """Scenario cluster factory with the ColumnarCore gate ON — the
+    docs/columnar.md graduation plan's step 2: the storm/chaos soaks run
+    on the array-backed core first, where every scenario's seeded
+    byte-identity assertion doubles as the columnar parity gate (the
+    gate is sampled at Cluster construction, so it must wrap HERE, not
+    at the scenario entry point — ReplicaSet promotions construct their
+    clusters on later call stacks)."""
+    from ..core import features, make_cluster
+
+    with features.gate("ColumnarCore", True):
+        return make_cluster(**kwargs)
+
+
 def pod_crash_burst(
     cluster,
     injector: FaultInjector,
@@ -185,7 +199,6 @@ def store_torn_writes(
     """
     import os
 
-    from ..core import make_cluster
     from ..store import Store, StoreError
     from ..testing import make_jobset, make_replicated_job
 
@@ -195,7 +208,9 @@ def store_torn_writes(
         injector = FaultInjector(seed=seed)
         if rate > 0:
             injector.add_rule("store.write", kind, rate=rate)
-        cluster = make_cluster()
+        # Columnar core ON (docs/columnar.md graduation plan): recovery
+        # byte-identity below is the parity assertion.
+        cluster = _columnar_cluster()
         store = Store(rate_dir, snapshot_interval=10**9, injector=injector)
         store.recover(cluster)
 
@@ -226,7 +241,7 @@ def store_torn_writes(
         # Hard-kill (no flush, no tail repair — per-record fsync is the
         # only durability), then cold-start recover.
         store.hard_kill()
-        fresh = make_cluster()
+        fresh = _columnar_cluster()
         recovered_store = Store(rate_dir)
         recovered_store.recover(fresh)
         recovered = recovered_store.serialized_state()
@@ -307,7 +322,10 @@ def policy_inference_faults(
         fallbacks0 = metrics.policy_fallbacks_total.total()
         decisions0 = metrics.policy_decisions_total.value("active")
         with features.gate("TPUPlacementSolver", True), \
-                features.gate("TPULearnedPlacer", True):
+                features.gate("TPULearnedPlacer", True), \
+                features.gate("ColumnarCore", True):
+            # Columnar core ON (docs/columnar.md graduation plan): the
+            # sweep's per-rate determinism assertions gate the mirror.
             cluster = make_cluster(placement=placement)
             cluster.add_topology(
                 topology_key, num_domains=domains,
@@ -495,6 +513,10 @@ def leader_kill(
         base_dir, n=replicas,
         lease_duration=0.5, retry_period=0.1, tick_interval=0.05,
         injector=injector,
+        # Columnar core ON for promoted leaders' clusters
+        # (docs/columnar.md graduation plan): the soak's byte-identity
+        # gate (kill vs no-kill final state) runs on the mirror.
+        cluster_factory=_columnar_cluster,
     ).start()
     try:
         result = _ha_write_storm(
@@ -599,7 +621,9 @@ def thundering_herd(
             rate=latency_fault_rate, delay_s=0.0,
         )
     flow = FlowController(levels=_herd_levels(), seed=seed)
-    cluster = make_cluster(clock=FakeClock())
+    # Columnar core ON (docs/columnar.md graduation plan): the storm's
+    # seeded byte-identity gate (tests/test_flow.py) runs on the mirror.
+    cluster = _columnar_cluster(clock=FakeClock())
     # Never started: requests are driven straight through _route (no
     # handler threads, no pump — the arrival order IS the program order).
     server = ControllerServer(
@@ -1272,3 +1296,377 @@ def follower_kill(
         }
     finally:
         replica_set.stop()
+
+# ---------------------------------------------------------------------------
+# Sharded control plane scenarios (jobset_tpu/shard, docs/sharding.md)
+# ---------------------------------------------------------------------------
+
+
+class ShardedHarness:
+    """Driver for the sharded region-fault scenarios: a
+    `shard.ShardedControlPlane` whose injector carries a seeded
+    `PartitionPlan`, plus history-recorded primitives in the two rv
+    scopes the cross-shard checker distinguishes — per-shard ops (keys
+    hash to a shard; rvs are that shard's journal) and router ops
+    (cross-shard merged LISTs; rvs are the front door's merged
+    journal). Writes are ack-gated like the PartitionHarness's, so the
+    committed history is a pure function of the operation sequence."""
+
+    ROUTER_KEY = "__router__"
+
+    def __init__(self, base_dir: str, seed: int = 31, shards: int = 2,
+                 read_fence: bool = True, spread_shards=()):
+        from ..shard import ShardedControlPlane
+        from ..verify import HistoryRecorder
+        from .net import PartitionPlan
+
+        self.seed = seed
+        self.injector = FaultInjector(seed=seed)
+        self.plan = PartitionPlan(seed=seed, injector=self.injector)
+        self.recorder = HistoryRecorder()
+        self.plane = ShardedControlPlane(
+            base_dir, shards=shards, replicas_per_shard=3, seed=seed,
+            injector=self.injector, lease_duration=0.4, retry_period=0.1,
+            tick_interval=0.05, read_fence=read_fence,
+            spread_shards=spread_shards,
+            # Columnar core ON (docs/columnar.md graduation plan): the
+            # scenario's seeded byte-identity gate runs on the mirror.
+            cluster_factory=_columnar_cluster,
+        )
+        # Per-shard register names: deterministic probes into each
+        # shard's keyspace.
+        self.registers = {
+            s: self.plane.map.key_for_shard(s, 0, prefix="reg")
+            for s in range(shards)
+        }
+
+    def stop(self) -> None:
+        self.plane.stop()
+
+    def scope_of(self, op: dict):
+        """The checker's shard_of: router-scope sentinel key, else the
+        owning shard of the op's `namespace/name` key."""
+        if op["key"] == self.ROUTER_KEY:
+            return "router"
+        ns, _, name = op["key"].partition("/")
+        return self.plane.map.shard_for(ns, name)
+
+    # -- primitives ---------------------------------------------------------
+
+    def write(self, session: str, name: str, labels=None,
+              update: bool = False, retry: bool = True,
+              deadline_s: float = 30.0):
+        """One recorded write via the FRONT DOOR, ack-gated like
+        PartitionHarness.write: retried (stepping the shard groups)
+        until a clean majority ack on the owning shard, a 409, or a
+        client error; retry=False records the single attempt. Returns
+        (status, attempts)."""
+        import time as _t
+
+        path = _API_JOBSETS + (f"/{name}" if update else "")
+        body = _suspended_gang_yaml(name, labels)
+        op = self.recorder.invoke(
+            session, "write", f"default/{name}",
+            value=(labels or {}).get("v"),
+        )
+        deadline = _t.monotonic() + deadline_s
+        attempts = 0
+        while True:
+            attempts += 1
+            status, _payload, headers = _http_call(
+                self.plane.address,
+                "PUT" if update else "POST", path, body,
+            )
+            ok = status is not None and 200 <= status < 300
+            clean = ok and not _header(headers, "Warning")
+            term, replica = _replication_identity(headers)
+            if clean or not retry or status == 409 or (
+                status is not None and 400 <= status < 500
+                and status != 409
+            ):
+                self.recorder.complete(
+                    op, ok or status == 409, status=status,
+                    term=term, replica=replica, acked=clean,
+                )
+                return status, attempts
+            if _t.monotonic() > deadline:
+                raise RuntimeError(
+                    f"write {name} never acknowledged within {deadline_s}s"
+                )
+            self.plane.step()
+            _t.sleep(0.02)
+
+    def read_shard(self, session: str, shard: int, server=None):
+        """One recorded SHARD-scope read: the shard's jobset collection
+        (register value + that shard's journal rv). Default goes over
+        HTTP to the shard group's stable serving address; `server`
+        targets a specific replica's in-process surface — the
+        zombie-deposed-leader read the fence exists for."""
+        register = self.registers[shard]
+        op = self.recorder.invoke(session, "read", f"default/{register}")
+        if server is not None:
+            result = server._route("GET", _API_JOBSETS, b"")
+            status, payload = result[0], result[1]
+            headers = dict(result[3]) if len(result) > 3 else {}
+        else:
+            status, payload, headers = _http_call(
+                self.plane.shard_groups[shard].address, "GET",
+                _API_JOBSETS,
+            )
+        ok = status is not None and 200 <= status < 300
+        rv = value = None
+        if ok and isinstance(payload, dict):
+            rv = payload.get("resourceVersion")
+            for item in payload.get("items", ()):
+                meta = item.get("metadata") or {}
+                if meta.get("name") == register:
+                    value = (meta.get("labels") or {}).get("v")
+        term, replica = _replication_identity(headers)
+        self.recorder.complete(
+            op, ok, status=status, value=value, rv=rv,
+            term=term, replica=replica,
+        )
+        return status, rv, value
+
+    def read_router(self, session: str):
+        """One recorded ROUTER-scope read: the cross-shard merged LIST
+        through the front door; the rv is the merged journal head — the
+        counter cross-shard session monotonicity is proven over."""
+        op = self.recorder.invoke(session, "read", self.ROUTER_KEY)
+        status, payload, _headers = _http_call(
+            self.plane.address, "GET", _API_JOBSETS
+        )
+        ok = status is not None and 200 <= status < 300
+        rv = None
+        if ok and isinstance(payload, dict):
+            rv = payload.get("resourceVersion")
+        self.recorder.complete(op, ok, status=status, rv=rv)
+        return status, rv
+
+    # -- topology / leadership control --------------------------------------
+
+    def await_leader(self, shard: int, other_than=None,
+                     deadline_s: float = 30.0):
+        import time as _t
+
+        group = self.plane.shard_groups[shard]
+        deadline = _t.monotonic() + deadline_s
+        while _t.monotonic() < deadline:
+            group.step()
+            leader = group.leader()
+            if leader is not None and leader is not other_than:
+                return leader
+            _t.sleep(0.03)
+        raise RuntimeError(f"shard {shard} never elected a leader")
+
+    def await_lost_quorum(self, replica, deadline_s: float = 30.0) -> None:
+        import time as _t
+
+        deadline = _t.monotonic() + deadline_s
+        while _t.monotonic() < deadline:
+            coordinator = replica.coordinator
+            if coordinator is None or any(coordinator.health_flags()):
+                return
+            _t.sleep(0.02)
+        raise RuntimeError("quorum loss never observed")
+
+    # -- verdict ------------------------------------------------------------
+
+    def result(self, scenario: str, extra=None) -> dict:
+        """Final per-shard state capture + the CROSS-SHARD checker
+        verdict (verify.check_sharded_history). Same byte-identity
+        artifact discipline as PartitionHarness.result."""
+        import json as _json
+
+        from ..verify import check_sharded_history
+
+        final_states: dict = {}
+        register_keys: dict = {}
+        leaders: dict = {}
+        for shard, group in enumerate(
+            self.plane.shard_groups[: self.plane.map.shards]
+        ):
+            leader = group.leader()
+            leaders[shard] = leader.replica_id
+            serialized = leader.store.serialized_state()["jobsets"]
+            register_key = f"default/{self.registers[shard]}"
+            register_keys[shard] = register_key
+            state = {}
+            for key, payload in serialized.items():
+                value = None
+                if key == register_key:
+                    manifest = _json.loads(payload).get("manifest") or {}
+                    meta = manifest.get("metadata") or {}
+                    value = (meta.get("labels") or {}).get("v")
+                state[key] = value
+            final_states[shard] = state
+        report = check_sharded_history(
+            self.recorder.snapshot(),
+            self.scope_of,
+            final_states=final_states,
+            register_keys=register_keys,
+        )
+        return {
+            "scenario": scenario,
+            "seed": self.seed,
+            "shards": self.plane.map.shards,
+            "homes": dict(self.plane.map.homes),
+            "leaders": {str(k): v for k, v in sorted(leaders.items())},
+            "history": self.recorder.normalized(),
+            "checker": report.to_dict(),
+            "injection_log": self.injector.log_snapshot(),
+            "final_keys": {
+                str(s): sorted(state) for s, state in final_states.items()
+            },
+            **(extra or {}),
+        }
+
+
+def region_shard_consistency(base_dir: str, seed: int = 31,
+                             read_fence: bool = True) -> dict:
+    """THE sharded region-fault scenario (docs/sharding.md): a 2-shard
+    plane over three regions, driven through one region isolation while
+    the cross-shard consistency checker records everything.
+
+    Shard 0 keeps the default latency-first placement (quorum-homed:
+    leader + majority co-located); shard 1 is placed durability-first
+    (SPREAD: one replica per region) so isolating its leader's region
+    severs the leader from an out-of-region majority — the minority-
+    leader situation the read fence exists for.
+
+    Phases:
+
+    1. Baseline: ledger writes + a per-shard register (v=1, v=2) on both
+       shards through the front door; cross-shard merged reads.
+    2. Region isolation: shard 1's home region is cut (plan-scheduled,
+       both directions, front door included) and shard placement
+       re-solves with the region priced out. A direct single-shot write
+       against the isolated leader answers 2xx + quorum Warning
+       (recorded indeterminate) and arms its idle-pump stepdown.
+    3. Failover + the teeth: shard 1's out-of-region majority elects a
+       successor and takes new writes (register v=3); shard 0 — homed
+       elsewhere — must ack its fault-window writes clean on the FIRST
+       attempt. The deposed leader's still-connected surface is then
+       asked for a read by a session that already saw v=3 — with the
+       read fence on it answers 503 and the cross-shard checker stays
+       green; with ``read_fence=False`` it serves the stale register
+       and the checker FAILS shard 1's linearizability/session
+       monotonicity — the teeth run.
+    4. Heal + reconcile: the region heals, placement re-solves back,
+       and the deposed replica's log converges to the new leader's
+       exact position (ghost tail truncated).
+    """
+    harness = ShardedHarness(base_dir, seed=seed, read_fence=read_fence,
+                             spread_shards=(1,))
+    try:
+        plane = harness.plane
+        teeth_shard, steady_shard = 1, 0
+        teeth_home = plane.map.homes[teeth_shard]
+        if teeth_home == plane.topology.front_door_region:
+            raise RuntimeError(
+                "seed places the teeth shard in the front-door region; "
+                "pick another seed"
+            )
+        # Phase 1: baseline on both shards + cross-shard reads.
+        for shard in (steady_shard, teeth_shard):
+            for i in range(2):
+                harness.write(
+                    "writer",
+                    plane.map.key_for_shard(shard, i, prefix="led"),
+                )
+            harness.write("writer", harness.registers[shard],
+                          labels={"v": "1"})
+            harness.write("writer", harness.registers[shard],
+                          labels={"v": "2"}, update=True)
+        harness.read_router("router-reader")
+        harness.read_shard("reader", teeth_shard)
+        group = plane.shard_groups[teeth_shard]
+        old = group.leader()
+        old_server = old.server
+        # Phase 2: isolate the teeth shard's home region (the leader is
+        # its only replica there — spread placement) and re-solve.
+        planned = plane.isolate_region(teeth_home, step=1)
+        # Single-shot write against the isolated leader's own surface:
+        # applies locally, cannot reach a quorum -> 2xx + Warning,
+        # recorded indeterminate; the pending unacked record arms the
+        # idle pump's quorum-failure stepdown.
+        warn_op = harness.recorder.invoke(
+            "writer", "write",
+            f"default/{plane.map.key_for_shard(teeth_shard, 9, prefix='warn')}",
+        )
+        status, _payload, headers = _http_call(
+            group.address, "POST", _API_JOBSETS,
+            _suspended_gang_yaml(
+                plane.map.key_for_shard(teeth_shard, 9, prefix="warn")
+            ),
+        )
+        term, replica = _replication_identity(headers)
+        harness.recorder.complete(
+            warn_op, status is not None and 200 <= (status or 0) < 300,
+            status=status, term=term, replica=replica,
+            acked=bool(status and 200 <= status < 300
+                       and not _header(headers, "Warning")),
+        )
+        harness.await_lost_quorum(old)
+        # Phase 3: failover to the out-of-region majority + the teeth.
+        new = harness.await_leader(teeth_shard, other_than=old)
+        steady_attempts = []
+        for i in range(2, 4):
+            _status, attempts = harness.write(
+                "writer",
+                plane.map.key_for_shard(steady_shard, i, prefix="led"),
+            )
+            steady_attempts.append(attempts)
+        harness.write("writer", harness.registers[teeth_shard],
+                      labels={"v": "3"}, update=True)
+        harness.read_router("router-reader")
+        harness.read_shard("reader", teeth_shard)
+        # THE zombie read: same session, after observing v=3, against
+        # the deposed leader's still-reachable surface.
+        harness.read_shard("reader", teeth_shard, server=old_server)
+        # Phase 4: heal, re-solve back, reconcile the deposed replica.
+        plane.heal_region(teeth_home, step=2)
+        victim = next(
+            r for r in group.replicas
+            if r.replica_id == old.replica_id
+        )
+        import time as _t
+
+        rejoin = None
+        deadline = _t.monotonic() + 30.0
+        while rejoin is None:
+            group.step()  # demotes the deposed leader once observed
+            if victim.log is not None:
+                from ..ha.replication import catch_up
+
+                try:
+                    rejoin = catch_up(
+                        victim.log, group.peers_for(victim),
+                        cluster_size=len(group.replicas),
+                    )
+                except Exception:
+                    rejoin = None
+            if rejoin is None:
+                if _t.monotonic() > deadline:
+                    raise RuntimeError("deposed replica never reconciled")
+                _t.sleep(0.03)
+        position = victim.log.position()
+        return harness.result("region_shard_consistency", extra={
+            "read_fence": read_fence,
+            "teeth_shard": teeth_shard,
+            "isolated_region": teeth_home,
+            "deposed": old.replica_id,
+            "new_leader": new.replica_id,
+            "steady_shard_attempts": steady_attempts,
+            "planned_homes_during_fault": {
+                str(k): v for k, v in sorted(planned.items())
+            },
+            "rejoin": rejoin,
+            "follower_position": position,
+            "converged": (
+                position["lastSeq"] == new.store.seq
+                and position["commitSeq"] == new.store.commit_seq
+            ),
+        })
+    finally:
+        harness.stop()

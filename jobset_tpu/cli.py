@@ -160,6 +160,17 @@ def _build_parser() -> argparse.ArgumentParser:
                    help="comma-separated peer replica addresses "
                         "(host:port of each OTHER replica's --addr) for "
                         "--replicate")
+    c.add_argument("--shards", type=int, default=0, metavar="N",
+                   help="run the SHARDED control plane (docs/sharding.md): "
+                        "N quorum-replicated shard groups (3 replicas "
+                        "each) behind this address as the routing front "
+                        "door; 0 = unsharded (default)")
+    c.add_argument("--shard-regions", default="region-a,region-b,region-c",
+                   help="comma-separated simulated region names for "
+                        "shard-home placement (first region hosts the "
+                        "front door)")
+    c.add_argument("--shard-replicas", type=int, default=3,
+                   help="replicas per shard group (--shards mode)")
     c.add_argument("--peer-timeout", type=float, default=5.0,
                    help="per-call timeout for replication RPCs to peers "
                         "(--replicate)")
@@ -330,6 +341,9 @@ def _cmd_controller(args) -> int:
     if args.replicate:
         return _cmd_controller_replicated(args)
 
+    if args.shards:
+        return _cmd_controller_sharded(args)
+
     if args.feature_gates:
         features.set_from_string(args.feature_gates)
 
@@ -399,6 +413,11 @@ def _cmd_controller(args) -> int:
             args.lease_identity or default_identity(),
             lease_duration=args.lease_duration,
             retry_period=args.lease_retry_period,
+            # Advertise the FULL route (scheme+host+port): the standby
+            # 503 fence's leader hint must be followable by a client
+            # that never saw this deployment's flags (and by the
+            # client's one-hop safe-GET redirect).
+            advertise=f"{'https' if tls_cert else 'http'}://{args.addr}",
         )
     flow = None
     if features.enabled("APIFlowControl"):
@@ -431,6 +450,70 @@ def _cmd_controller(args) -> int:
     server.stop()
     if store is not None:
         store.close()
+    return 0
+
+
+def _cmd_controller_sharded(args) -> int:
+    """`controller --shards N`: the sharded control plane in one process
+    (docs/sharding.md) — N quorum-replicated shard groups placed over
+    the simulated region topology, `--addr` serving as the routing
+    front door. Writes scale with shard count; `/debug/shards` shows
+    the map, `GET /debug/health` the per-shard routing state."""
+    from .core import features
+    from .flow import FlowController
+    from .shard import RegionTopology, ShardedControlPlane
+
+    if args.feature_gates:
+        features.set_from_string(args.feature_gates)
+    if args.log_json:
+        from .obs import configure_json_logging
+
+        configure_json_logging()
+    injector = None
+    if args.inject:
+        from . import chaos
+
+        chaos.configure(args.inject, seed=args.inject_seed)
+        from .chaos import get_injector
+
+        injector = get_injector()
+    if not args.data_dir:
+        print("--shards requires --data-dir (one subdirectory per "
+              "shard group)", file=sys.stderr)
+        return 2
+    if args.tls_cert or args.tls_key or args.tls_self_signed:
+        # Refuse loudly rather than silently serving plaintext: the
+        # sharded front door + shard surfaces do not speak TLS yet.
+        print("--shards does not support TLS yet (--tls-cert/--tls-key/"
+              "--tls-self-signed); terminate TLS in front of the front "
+              "door", file=sys.stderr)
+        return 2
+    regions = [
+        r.strip() for r in args.shard_regions.split(",") if r.strip()
+    ]
+    flow = None
+    if features.enabled("APIFlowControl"):
+        flow = FlowController(seed=args.flow_seed)
+    plane = ShardedControlPlane(
+        args.data_dir,
+        shards=args.shards,
+        replicas_per_shard=args.shard_replicas,
+        topology=RegionTopology(regions=regions, seed=args.inject_seed),
+        seed=args.inject_seed,
+        injector=injector,
+        lease_duration=min(args.lease_duration, 2.0),
+        retry_period=min(args.lease_retry_period, 0.5),
+        tick_interval=args.tick_interval,
+        address=args.addr,
+        flow=flow,
+    )
+    plane.start_supervisor()
+    print(f"sharded control plane: front door on http://{plane.address}, "
+          f"{args.shards} shard group(s) x {args.shard_replicas} "
+          f"replicas over regions {', '.join(regions)} "
+          f"(map at /debug/shards)", flush=True)
+    _wait_for_signal()
+    plane.stop()
     return 0
 
 
@@ -579,7 +662,9 @@ def _cmd_controller_replicated(args) -> int:
         identity,
         lease_duration=args.lease_duration,
         retry_period=args.lease_retry_period,
-        advertise=args.addr,
+        # Full route in the lease record: followable leader hints
+        # (the replicated path serves plain HTTP between replicas).
+        advertise=f"http://{args.addr}",
     )
 
     stopping: list = []

@@ -134,7 +134,9 @@ class _KeepAlivePool:
 
 class ApiError(Exception):
     def __init__(self, status: int, message: str,
-                 retry_after: Optional[float] = None):
+                 retry_after: Optional[float] = None,
+                 leader_address: Optional[str] = None,
+                 shard: Optional[int] = None):
         super().__init__(f"HTTP {status}: {message}")
         self.status = status
         self.message = message
@@ -143,6 +145,13 @@ class ApiError(Exception):
         # carry one so clients back off at the server's pace instead of
         # guessing.
         self.retry_after = retry_after
+        # Leader hint from a standby/follower 503 fence or a shard
+        # member's 421 misroute: the FULL advertised route
+        # (scheme://host:port) of whoever can actually serve this key —
+        # safe GETs follow it one hop (docs/sharding.md), callers of
+        # mutations decide for themselves.
+        self.leader_address = leader_address
+        self.shard = shard
 
 
 # Statuses a GET may safely retry: the request was never processed (503
@@ -312,11 +321,35 @@ class JobSetClient:
         Retry-After hint is honored (capped at RETRY_AFTER_CAP_S) instead
         of the jittered guess — the server knows its own queue pressure."""
         attempts = 1 + (self.retries if method == "GET" else 0)
+        followed_hint = False
         for attempt in range(attempts):
             hint = None
             try:
                 return self._transport_once(method, path, body, headers)
             except ApiError as exc:
+                # One-hop leader-hint redirect for safe GETs: a standby/
+                # follower fence 503 (or a shard 421) carrying the full
+                # advertised route is answered by asking THAT server
+                # directly, once — beats waiting out Retry-After rounds
+                # against a replica that told us who can serve. A failed
+                # hop falls back to the ordinary retry loop.
+                if (
+                    method == "GET"
+                    and not followed_hint
+                    and exc.status in self._HINT_FOLLOW_STATUSES
+                    and exc.leader_address
+                ):
+                    followed_hint = True
+                    try:
+                        return self._follow_leader_hint(
+                            method, path, headers, exc.leader_address
+                        )
+                    # ValueError: a malformed advertised route (urlsplit
+                    # port parse) — a bad hint must degrade to the
+                    # ordinary retry loop, never crash the GET.
+                    except (ApiError, urllib.error.URLError, OSError,
+                            ValueError):
+                        pass
                 if (
                     attempt + 1 >= attempts
                     or exc.status not in _RETRYABLE_STATUSES
@@ -364,6 +397,21 @@ class JobSetClient:
             pass
         return detail
 
+    @staticmethod
+    def _error_fields(data: bytes):
+        """(detail, leaderAddress, shard) from an error body: fence 503s
+        and shard 421s carry a followable full-route leader hint."""
+        detail = data.decode(errors="replace")
+        leader = shard = None
+        try:
+            doc = json.loads(detail)
+            detail = doc.get("error", detail)
+            leader = doc.get("leaderAddress") or None
+            shard = doc.get("shard")
+        except (json.JSONDecodeError, AttributeError):
+            pass
+        return detail, leader, shard
+
     def _transport_once(self, method: str, path: str, body, headers):
         """One HTTP round trip over the keep-alive pool; returns
         (parsed payload, response status)."""
@@ -374,15 +422,71 @@ class JobSetClient:
             method, path, body, headers
         )
         if status >= 400:
+            detail, leader, shard = self._error_fields(data)
             raise ApiError(
-                status, self._error_detail(data),
+                status, detail,
                 retry_after=_parse_retry_after(
                     resp_headers.get("Retry-After")
                 ),
+                leader_address=leader,
+                shard=shard,
             )
         return self._parse_payload(
             data, resp_headers.get("Content-Type", "")
         ), status
+
+    # Statuses whose leader hint a safe GET follows one hop: the standby
+    # /follower write-read fence (503) and a shard member's misroute
+    # (421 Misdirected Request).
+    _HINT_FOLLOW_STATUSES = frozenset({421, 503})
+
+    def _follow_leader_hint(self, method: str, path: str, headers,
+                            hint: str):
+        """ONE-hop redirect of a safe GET to a fence/misroute response's
+        advertised leader route (docs/sharding.md, docs/ha.md): a single
+        direct request against the full scheme://host:port hint — no
+        retries, no further hops (a second hint raises), mutations never
+        ride this path (re-sending a write to a second server on a
+        server-supplied hint is the caller's call, not the client's)."""
+        from urllib.parse import urlsplit
+
+        if "://" not in hint:
+            hint = f"{self._pool.scheme}://{hint}"
+        parts = urlsplit(hint)
+        # The hop is one delivery over the (chaos_src, hinted netloc)
+        # link of the network fault model, like any other round trip.
+        from .chaos import net as chaos_net
+
+        reason = chaos_net.check_link(self.chaos_src, parts.netloc)
+        if reason is not None:
+            raise urllib.error.URLError(reason)
+        import http.client
+
+        conn_cls = (
+            http.client.HTTPSConnection if parts.scheme == "https"
+            else http.client.HTTPConnection
+        )
+        kwargs = {"timeout": self.timeout}
+        if parts.scheme == "https" and self._ssl_context is not None:
+            kwargs["context"] = self._ssl_context
+        conn = conn_cls(parts.hostname or "127.0.0.1", parts.port,
+                        **kwargs)
+        try:
+            conn.request(method, path, headers=headers)
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status >= 400:
+                detail, leader, shard = self._error_fields(data)
+                raise ApiError(resp.status, detail,
+                               leader_address=leader, shard=shard)
+            return self._parse_payload(
+                data, resp.headers.get("Content-Type", "")
+            ), resp.status
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
 
     # -- jobsets ----------------------------------------------------------
 

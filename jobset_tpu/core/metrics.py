@@ -422,6 +422,36 @@ watch_frames_total = Counter(
     "answers; one frame carries N events against a shared rv floor)",
 )
 
+# Sharded control plane (jobset_tpu/shard, docs/sharding.md): keyspace
+# partitioning behind the routing front door.
+shard_count = Gauge(
+    "jobset_shard_count",
+    "Shards in the active shard map (the keyspace partition count the "
+    "front door routes by)",
+)
+shard_requests_total = Counter(
+    "jobset_shard_requests_total",
+    "Requests the front door dispatched to each shard group's leader",
+    label_names=("shard",),
+)
+shard_unroutable_total = Counter(
+    "jobset_shard_unroutable_total",
+    "Dispatches the front door answered 503 + shard-leader hint because "
+    "the owning shard was unreachable (no leader, region/link cut, or "
+    "an injected shard.route fault)",
+    label_names=("shard",),
+)
+shard_misroutes_total = Counter(
+    "jobset_shard_misroutes_total",
+    "Requests a shard member answered 421 + shard-leader hint because "
+    "the shard map assigns the key to a different shard",
+)
+shard_resolves_total = Counter(
+    "jobset_shard_resolves_total",
+    "Shard-home placement re-solves (topology changes: region "
+    "cut/heal) run through the assignment-solver cost model",
+)
+
 
 def set_build_info(version: str, backend: str, gates: str,
                    role: str = "single", term: int = 0) -> None:
@@ -457,6 +487,10 @@ ALL_COUNTERS = (
     http_encoding_total,
     http_batch_items_total,
     watch_frames_total,
+    shard_requests_total,
+    shard_unroutable_total,
+    shard_misroutes_total,
+    shard_resolves_total,
 )
 ALL_HISTOGRAMS = (
     reconcile_time_seconds,
@@ -485,6 +519,7 @@ ALL_GAUGES = (
     ha_follower_lag_records,
     policy_model_loaded,
     flow_inflight,
+    shard_count,
 )
 
 

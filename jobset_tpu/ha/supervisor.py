@@ -26,6 +26,7 @@ wall-clock failover numbers.
 from __future__ import annotations
 
 import os
+import threading
 from typing import Optional
 
 from ..core import make_cluster, metrics
@@ -103,6 +104,9 @@ class ReplicaSet:
         injector=None,
         cluster_factory=None,
         read_fence: bool = True,
+        name_prefix: str = "replica",
+        shard_id=None,
+        shard_map=None,
     ):
         self.base_dir = str(base_dir)
         self.clock = clock
@@ -115,14 +119,26 @@ class ReplicaSet:
         # stale-read hole so the consistency checker can prove it would
         # catch one.
         self.read_fence = read_fence
+        # Sharded control plane (docs/sharding.md): the shard this group
+        # owns and the map its promoted servers misroute-guard against
+        # (`name_prefix` keeps replica ids — the network fault model's
+        # link endpoints — distinct across co-resident shard groups).
+        self.shard_id = shard_id
+        self.shard_map = shard_map
         host, _, port = address.rpartition(":")
         self._host = host or "127.0.0.1"
         self.serving_port = int(port) if port else 0
+        # Serializes supervision entry points (step / kill / rejoin):
+        # the shard plane's background supervisor steps from its own
+        # thread while a bench or scenario driver kills/rejoins from
+        # another — an unserialized kill landing mid-promotion would
+        # tear the replica's log/store handoff.
+        self._supervise_lock = threading.Lock()
         lease_path = os.path.join(self.base_dir, "leader.lease")
         self.replicas = [
             Replica(
-                f"replica-{i}",
-                os.path.join(self.base_dir, f"replica-{i}"),
+                f"{name_prefix}-{i}",
+                os.path.join(self.base_dir, f"{name_prefix}-{i}"),
                 lease_path,
                 clock=clock,
                 lease_duration=lease_duration,
@@ -164,7 +180,13 @@ class ReplicaSet:
         """One supervision round: give every serverless alive replica a
         chance to take the (absent/expired/released) lease and promote.
         Returns the current leader, if any. Deterministic: replicas are
-        visited in id order, so seeded runs elect identical successors."""
+        visited in id order, so seeded runs elect identical successors.
+        Thread-safe against concurrent kill/rejoin drivers (the shard
+        plane's background supervisor)."""
+        with self._supervise_lock:
+            return self._step_locked()
+
+    def _step_locked(self) -> Optional[Replica]:
         current = self.leader()
         if current is not None:
             coordinator = current.coordinator
@@ -250,6 +272,12 @@ class ReplicaSet:
             snapshot_interval=self.snapshot_interval,
             injector=self.injector,
         )
+        # Visible to _abort_promotion IMMEDIATELY: a promotion that
+        # fails past this point must close this store (releasing its
+        # data-dir flock) before the follower log can be reopened —
+        # assigning only on success left the abort path leaking the
+        # flock and the replica permanently unpromotable.
+        replica.store = store
         cluster = (
             self.cluster_factory() if self.cluster_factory is not None
             else make_cluster()
@@ -262,6 +290,7 @@ class ReplicaSet:
             injector=self.injector,
         )
         coordinator.bind(store)
+        replica.coordinator = coordinator
         server = ControllerServer(
             f"{self._host}:{self.serving_port}",
             cluster=cluster,
@@ -271,10 +300,15 @@ class ReplicaSet:
             replication=coordinator,
             injector=self.injector,
             read_fence=self.read_fence,
+            shard_id=self.shard_id,
+            shard_map=self.shard_map,
         ).start()
         self.serving_port = server.port
-        replica.store = store
-        replica.coordinator = coordinator
+        # Advertise the FULL route (scheme+host+port) in the lease record
+        # from now on: a standby 503's leader hint must be followable by
+        # a client that never saw this deployment's flags — and, across
+        # shards, by one bounced off another shard's surface.
+        replica.elector.advertise = f"http://{self._host}:{server.port}"
         replica.server = server
         self._promotions += 1
         if self._promotions > 1:
@@ -310,46 +344,51 @@ class ReplicaSet:
 
     def kill_leader(self) -> str:
         """Crash the leader: listener gone, store fds dropped mid-state,
-        NO lease release — standbys take over only at lease expiry."""
-        replica = self.leader()
-        if replica is None:
-            raise RuntimeError("no leader to kill")
-        replica.alive = False
-        replica.server.crash()
-        replica.store.hard_kill()
-        replica.server = None
-        replica.coordinator = None
-        replica.store = None
-        return replica.replica_id
+        NO lease release — standbys take over only at lease expiry.
+        Serialized against step(): a kill landing mid-promotion would
+        tear the log/store handoff."""
+        with self._supervise_lock:
+            replica = self.leader()
+            if replica is None:
+                raise RuntimeError("no leader to kill")
+            replica.alive = False
+            replica.server.crash()
+            replica.store.hard_kill()
+            replica.server = None
+            replica.coordinator = None
+            replica.store = None
+            return replica.replica_id
 
     def kill_follower(self) -> str:
         """Crash the first alive follower (sorted id order, so seeded
         scenarios pick identical victims): its log fds drop mid-state and
         the leader sees it as lagging until rejoin()."""
-        for replica in self.replicas:
-            if replica.alive and replica.server is None:
-                replica.alive = False
-                replica.log.hard_kill()
-                replica.log = None
-                return replica.replica_id
-        raise RuntimeError("no follower to kill")
+        with self._supervise_lock:
+            for replica in self.replicas:
+                if replica.alive and replica.server is None:
+                    replica.alive = False
+                    replica.log.hard_kill()
+                    replica.log = None
+                    return replica.replica_id
+            raise RuntimeError("no follower to kill")
 
     def rejoin(self, replica_id: str) -> dict:
         """Bring a crashed replica back as a follower: re-open its log and
         reconcile it against the quorum (divergent unacked tail from its
         leadership, if any, is truncated here)."""
-        replica = next(
-            r for r in self.replicas if r.replica_id == replica_id
-        )
-        if replica.alive:
-            raise RuntimeError(f"{replica_id} is already alive")
-        replica.log = FollowerLog(replica.data_dir)
-        replica.alive = True
-        return catch_up(
-            replica.log,
-            self.peers_for(replica),
-            cluster_size=len(self.replicas),
-        )
+        with self._supervise_lock:
+            replica = next(
+                r for r in self.replicas if r.replica_id == replica_id
+            )
+            if replica.alive:
+                raise RuntimeError(f"{replica_id} is already alive")
+            replica.log = FollowerLog(replica.data_dir)
+            replica.alive = True
+            return catch_up(
+                replica.log,
+                self.peers_for(replica),
+                cluster_size=len(self.replicas),
+            )
 
     def stop(self) -> None:
         for replica in self.replicas:

@@ -250,10 +250,24 @@ class ControllerServer:
         replication=None,
         flow=None,
         read_fence: bool = True,
+        shard_router=None,
+        shard_id=None,
+        shard_map=None,
     ):
         if cluster is None:
             cluster = make_cluster(clock=Clock())
         self.cluster = cluster
+        # Sharded control plane (docs/sharding.md). A server carrying a
+        # `shard_router` is the ROUTING FRONT DOOR: after flow
+        # classification, jobset-keyed traffic dispatches to the owning
+        # shard group's leader, cross-shard lists/watches merge per-shard
+        # journals. A server carrying `shard_id` + `shard_map` is a SHARD
+        # MEMBER: requests for keys the map assigns elsewhere answer
+        # 421 + a shard-leader hint instead of acting on (or 404-ing
+        # about) state this shard does not own.
+        self.shard_router = shard_router
+        self.shard_id = shard_id
+        self.shard_map = shard_map
         # Chaos plane: `injector` (a chaos.FaultInjector) is consulted once
         # per API request at the `apiserver.request` injection point; None
         # falls through to the process-global injector (the CLI's --inject).
@@ -932,6 +946,34 @@ class ControllerServer:
                 del self._watch_events[: -self._watch_limit]
             self._watch_cond.notify_all()
 
+    def journal_tail(self, kind: str, after_rv: int):
+        """Journal pull for the shard router's cross-shard merge
+        (docs/sharding.md): `kind` events with after_rv < rv <= the
+        delivery floor, plus (floor, trimmed_rv). Bounded by the SAME
+        quorum delivery floor watchers get, so un-quorum-committed
+        events never cross the front door either. The journal is
+        rv-ascending, so the (after_rv, floor] window is bisected — the
+        router pulls on every routed write and watcher poll, and a full
+        4096-entry scan under the watch lock on each pull would contend
+        with this shard's own write/notify path."""
+        import bisect
+
+        with self._watch_cond:
+            floor = self._watch_delivery_rv()
+            lo = bisect.bisect_right(
+                self._watch_events, after_rv, key=lambda t: t[0]
+            )
+            hi = bisect.bisect_right(
+                self._watch_events, floor, key=lambda t: t[0]
+            )
+            events = [
+                (rv, event_ns, event)
+                for rv, event_kind, event_ns, event
+                in self._watch_events[lo:hi]
+                if event_kind == kind
+            ]
+            return events, floor, self._watch_trimmed_rv
+
     def _activate_watch_kind(self, kind: str) -> None:
         """First list/watch of a child kind: seed its snapshot from current
         state (no synthetic ADDED flood — the caller's list already reflects
@@ -1380,6 +1422,7 @@ class ControllerServer:
                      body_obj=None):
         from urllib.parse import parse_qs
 
+        full_path = path
         path, _, query = path.partition("?")
         params = parse_qs(query)
 
@@ -1389,6 +1432,18 @@ class ControllerServer:
             # Machine-readable wire schema: version byte, media type,
             # frame layout, kind-id registry (docs/protocol.md).
             return 200, wire.schema()
+        if path == "/debug/shards" and method == "GET":
+            # Shard map + per-shard route/leader state (docs/sharding.md):
+            # the front door serves its router's full view; a shard
+            # member serves the map it guards misroutes against.
+            if self.shard_router is not None:
+                return 200, self.shard_router.describe()
+            if self.shard_map is not None:
+                return 200, {
+                    "map": self.shard_map.to_dict(),
+                    "shardId": self.shard_id,
+                }
+            return 404, {"error": "this server is not sharded"}
         if path == "/leaderz":
             if self.elector is None:
                 return 200, {"leaderElection": False, "leading": True}
@@ -1540,6 +1595,21 @@ class ControllerServer:
                     timeout_s = float(params.get("timeoutSeconds", ["30"])[0])
                 except ValueError:
                     return 400, {"error": "bad watch parameters"}
+                if self.shard_router is not None:
+                    # Front door: cross-shard watches ride the router's
+                    # merged journal (jobsets only — child kinds are
+                    # watched against the owning shard's own surface,
+                    # which the hint machinery points at).
+                    if kind != "jobsets":
+                        return 400, {"error": (
+                            f"the front door merges jobsets watches "
+                            f"only; watch {kind} against the owning "
+                            f"shard (see /debug/shards)"
+                        )}
+                    return self.shard_router.watch(
+                        ns, rv, timeout_s,
+                        park=watch_park, retry_hint=watch_hint,
+                    )
                 if kind != "jobsets":
                     self._activate_watch_kind(kind)
                 return self._watch_resource(
@@ -1596,6 +1666,18 @@ class ControllerServer:
                     None,
                     {"Retry-After": "5"},
                 )
+
+        if self.shard_router is not None:
+            # Routing front door (docs/sharding.md): the flow plane
+            # classified/admitted this request in _route; everything
+            # that reaches here is keyed API traffic for the shards —
+            # dispatched to the owning group's leader, fanned out, or
+            # answered 503 + shard-leader hint when unroutable. The
+            # front door's own (empty) cluster never serves API state.
+            return self._route_sharded(
+                method, full_path, path, parts, params, body, body_obj,
+                headers,
+            )
 
         with self.lock:
             if path.startswith(self.API_PREFIX):
@@ -1725,6 +1807,13 @@ class ControllerServer:
             return 404, {"error": "unknown resource"}
         ns = parts[4]
         name = parts[6] if len(parts) > 6 else None
+        # Shard-member ownership guard (docs/sharding.md): a request for
+        # a key the map assigns elsewhere is misdirected, whatever the
+        # method — answer 421 + hint before touching (or 404-ing about)
+        # state this shard does not own.
+        misroute = self._misroute_check(ns, name)
+        if misroute is not None:
+            return misroute
 
         # Status subresource (the k8s /status endpoint): external
         # controllers of managedBy jobsets write status here.
@@ -1764,6 +1853,9 @@ class ControllerServer:
                 )
             except Exception as exc:
                 return 400, {"error": f"bad manifest: {exc}"}
+            misroute = self._misroute_check(ns, js.metadata.name)
+            if misroute is not None:
+                return misroute
             try:
                 created = self.cluster.create_jobset(js)
             except AdmissionError as exc:
@@ -1832,6 +1924,202 @@ class ControllerServer:
         return 405, {"error": f"{method} not allowed"}
 
     # ------------------------------------------------------------------
+    # Sharded routing (docs/sharding.md)
+    # ------------------------------------------------------------------
+
+    def _misroute_check(self, ns: str, name):
+        """Shard-member ownership guard: 421 Misdirected Request + a
+        followable shard-leader hint when the shard map assigns
+        `ns/name` to a different shard. Answering 404 (or worse,
+        acting) for a key this shard does not own would split one
+        object's history across two journals."""
+        if self.shard_map is None or self.shard_id is None or not name:
+            return None
+        owner = self.shard_map.shard_for(ns, name)
+        if owner == self.shard_id:
+            return None
+        metrics.shard_misroutes_total.inc()
+        return (
+            421,
+            {
+                "error": (
+                    f"jobset {ns}/{name} belongs to shard {owner}, not "
+                    f"this shard ({self.shard_id}); follow the "
+                    f"shard-leader hint"
+                ),
+                "shard": owner,
+                "leaderAddress": self.shard_map.address_of(owner) or None,
+            },
+            None,
+            {"X-Jobset-Shard": str(self.shard_id)},
+        )
+
+    def _route_sharded(self, method: str, full_path: str, path: str,
+                       parts: list[str], params: dict, body: bytes,
+                       body_obj, headers):
+        """Front-door routing of keyed API traffic (docs/sharding.md):
+        single-key jobset operations dispatch to the owning shard's
+        leader, collection GETs fan out and merge, batch verbs split by
+        owner, cluster-scoped resources (queues) live on the system
+        shard (0), and node writes broadcast so every shard group's
+        cluster schedules against the same node inventory."""
+        router = self.shard_router
+        if path.startswith(self.API_PREFIX):
+            if len(parts) >= 4 and parts[3] == "queues":
+                return router.dispatch(0, method, full_path, body,
+                                       headers=headers)
+            if len(parts) >= 6 and parts[3] == "namespaces":
+                ns = parts[4]
+                if len(parts) == 6 and parts[5].startswith("jobsets:"):
+                    return self._shard_batch(ns, parts[5], method,
+                                             full_path, body, body_obj,
+                                             headers)
+                if parts[5] == "jobsets":
+                    if len(parts) >= 7:
+                        shard = router.shard_for(ns, parts[6])
+                        return router.dispatch(shard, method, full_path,
+                                               body, headers=headers)
+                    if method == "GET":
+                        return router.merged_list(full_path,
+                                                  headers=headers)
+                    if method == "POST":
+                        doc = body_obj
+                        if doc is None:
+                            try:
+                                doc = self._load_manifest_body(body)
+                            except Exception as exc:  # noqa: BLE001 — client error
+                                return 400, {
+                                    "error": f"bad manifest: {exc}"
+                                }
+                        name = (
+                            (doc.get("metadata") or {}).get("name")
+                            if isinstance(doc, dict) else None
+                        )
+                        if not name:
+                            return 400, {
+                                "error": "manifest metadata.name required"
+                            }
+                        shard = router.shard_for(ns, name)
+                        return router.dispatch(shard, method, full_path,
+                                               body, headers=headers)
+                    return 405, {
+                        "error": f"{method} not allowed on collection"
+                    }
+            return 404, {"error": "unknown resource"}
+        if parts[:2] == ["api", "v1"]:
+            rest = parts[2:]
+            if rest[:1] == ["nodes"]:
+                if method == "GET":
+                    return router.dispatch(0, method, full_path, body,
+                                           headers=headers)
+                # Node writes broadcast: the node inventory is shared
+                # infrastructure every shard's scheduler consults; a
+                # failing shard fails the write (the client retries —
+                # node registration is idempotent per name).
+                result = None
+                for shard in sorted(router.handles):
+                    result = router.dispatch(shard, method, full_path,
+                                             body, headers=headers)
+                    if result[0] >= 400 and result[0] != 409:
+                        return result
+                return result if result is not None else (
+                    404, {"error": "no shards served"}
+                )
+            if method == "GET" and (
+                rest[:1] == ["events"]
+                or (len(rest) >= 3 and rest[0] == "namespaces")
+            ):
+                return router.merged_list(full_path, headers=headers)
+        return 404, {"error": f"no route for {method} {path}"}
+
+    def _shard_batch(self, ns: str, verb_part: str, method: str,
+                     full_path: str, body: bytes, body_obj, headers):
+        """Split a batch verb by owning shard, dispatch each sub-batch to
+        its shard leader, reassemble per-item results in input order —
+        per-item semantics survive the split (an unroutable shard fails
+        ONLY its own items, with the shard-leader hint in each slot)."""
+        verb = verb_part.partition(":")[2]
+        if method != "POST":
+            return 405, {"error": "batch verbs support POST only"}
+        if verb not in ("batchCreate", "batchStatus"):
+            return 404, {"error": f"unknown batch verb {verb!r}"}
+        doc = body_obj
+        if doc is None:
+            try:
+                doc = self._load_manifest_body(body)
+            except Exception as exc:  # noqa: BLE001 — client error
+                return 400, {"error": f"bad batch body: {exc}"}
+        if not isinstance(doc, dict) or not isinstance(
+            doc.get("items"), list
+        ):
+            return 400, {"error": "batch body must be a mapping with "
+                                  "an 'items' list"}
+        items = doc["items"]
+        if len(items) > self._BATCH_MAX_ITEMS:
+            return 413, {"error": (
+                f"batch of {len(items)} items exceeds the "
+                f"{self._BATCH_MAX_ITEMS}-item ceiling; split it"
+            )}
+        router = self.shard_router
+        groups: dict[int, list[int]] = {}
+        results: list = [None] * len(items)
+        for i, item in enumerate(items):
+            if verb == "batchCreate":
+                name = (
+                    (item.get("metadata") or {}).get("name")
+                    if isinstance(item, dict) else None
+                )
+            else:
+                name = item.get("name") if isinstance(item, dict) else None
+            if not name:
+                results[i] = {"code": 400,
+                              "error": "batch item needs a name"}
+                continue
+            groups.setdefault(router.shard_for(ns, name), []).append(i)
+        base = full_path.partition("?")[0]
+        warning = None
+        for shard in sorted(groups):
+            indexes = groups[shard]
+            sub: dict = {"items": [items[i] for i in indexes]}
+            if doc.get("view"):
+                sub["view"] = doc["view"]
+            # The sub-body is re-encoded JSON so Content-Type resets,
+            # but the caller's traceparent rides through: the shard-side
+            # spans must parent on the client's end-to-end trace exactly
+            # as single-key dispatches do.
+            sub_headers = (
+                {"traceparent": headers["traceparent"]}
+                if headers and headers.get("traceparent") else {}
+            )
+            resp = router.dispatch(
+                shard, "POST", base, json.dumps(sub).encode(),
+                headers=sub_headers,
+            )
+            if resp[0] != 200:
+                detail = (
+                    resp[1].get("error")
+                    if isinstance(resp[1], dict) else str(resp[1])
+                )
+                for i in indexes:
+                    results[i] = {
+                        "code": resp[0], "error": detail,
+                        **router.hint(shard),
+                    }
+                continue
+            # Propagate a shard's quorum Warning: a clean 2xx WITHOUT
+            # Warning IS the majority-acknowledged contract — a split
+            # batch must never launder a minority-side shard's
+            # Warning-acked items into a clean-looking response.
+            if len(resp) > 3 and resp[3].get("Warning"):
+                warning = resp[3]["Warning"]
+            for i, item_result in zip(indexes, resp[1].get("items") or []):
+                results[i] = item_result
+        payload = {"kind": "BatchResult", "items": results}
+        if warning is not None:
+            return 200, payload, None, {"Warning": warning}
+        return 200, payload
+
+    # ------------------------------------------------------------------
     # Batched verbs (docs/protocol.md)
     # ------------------------------------------------------------------
 
@@ -1859,6 +2147,10 @@ class ControllerServer:
                 except Exception as exc:  # noqa: BLE001 — per-item client error
                     results.append({"code": 400,
                                     "error": f"bad manifest: {exc}"})
+                    continue
+                misroute = self._misroute_check(ns, js.metadata.name)
+                if misroute is not None:
+                    results.append({"code": misroute[0], **misroute[1]})
                     continue
                 try:
                     created = self.cluster.create_jobset(js)
@@ -1892,6 +2184,10 @@ class ControllerServer:
             if not isinstance(item, dict) or not item.get("name"):
                 results.append({"code": 400,
                                 "error": "batch status item needs a name"})
+                continue
+            misroute = self._misroute_check(ns, item["name"])
+            if misroute is not None:
+                results.append({"code": misroute[0], **misroute[1]})
                 continue
             try:
                 status = serialization.status_from_dict(
@@ -2402,6 +2698,26 @@ class ControllerServer:
                 f"requeue" if contained else "reconcile pump healthy"
             ),
         }
+
+        if self.shard_router is not None:
+            shard_view = self.shard_router.describe()
+            dark = sorted(
+                s for s, info in shard_view["shards"].items()
+                if not info["serving"]
+            )
+            components["shards"] = {
+                "healthy": not dark,
+                "enabled": True,
+                "count": shard_view["map"]["shards"],
+                "epoch": shard_view["map"]["epoch"],
+                "shards": shard_view["shards"],
+                "plannedHomes": shard_view["plannedHomes"],
+                "message": (
+                    f"shard(s) {', '.join(dark)} have no serving leader"
+                    if dark else
+                    f"routing {shard_view['map']['shards']} shard group(s)"
+                ),
+            }
 
         injector = self.injector
         if injector is None:

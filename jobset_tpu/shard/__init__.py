@@ -1,0 +1,41 @@
+"""Sharded control plane (docs/sharding.md): horizontal write scaling.
+
+Replication (``jobset_tpu/ha``) made ONE quorum group survive node loss;
+this package partitions the keyspace into N independently-replicated
+shard groups behind one routing front door, so aggregate write
+throughput scales with shard count and a region fault degrades only the
+shards quorum-homed in that region.
+
+* :mod:`map` — the deterministic keyspace partitioner: ``ShardMap``
+  hashes ``namespace/name`` to a shard with a stable blake2b digest,
+  carries the epoch that invalidates pre-split watch positions, and
+  persists atomically through the store's snapshot-write ritual.
+* :mod:`topology` — the simulated region topology: named regions,
+  seeded pairwise latencies, one failure domain per region, plus the
+  region-isolation helper that drives ``chaos/net.py`` link cuts.
+* :mod:`placement` — shard-home assignment as a solver problem
+  (NL-CPS style): a shards x region-slots cost matrix (front-door
+  latency + failure-domain concentration) solved through the existing
+  ``placement.solver.AssignmentSolver`` machinery, re-solved on region
+  cut/heal.
+* :mod:`router` — the routing front door's core: per-key dispatch to
+  the owning shard group's leader, cross-shard list fan-out, and a
+  merged watch journal that honors each shard's quorum delivery floor.
+* :mod:`plane` — ``ShardedControlPlane``: N in-process
+  ``ha.ReplicaSet`` shard groups spread over the region topology, one
+  front-door ``ControllerServer``.
+"""
+
+from .map import ShardMap
+from .placement import solve_shard_homes
+from .plane import ShardedControlPlane
+from .router import ShardRouter
+from .topology import RegionTopology
+
+__all__ = [
+    "RegionTopology",
+    "ShardMap",
+    "ShardRouter",
+    "ShardedControlPlane",
+    "solve_shard_homes",
+]

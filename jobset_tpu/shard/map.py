@@ -1,0 +1,135 @@
+"""Deterministic keyspace partitioner: JobSet key -> shard.
+
+The map is a pure function of ``(seed, shards)``: ``shard_for`` hashes
+``namespace/name`` with a keyed blake2b digest (the same stable-hash
+discipline the flow plane's shuffle-sharding uses) and reduces modulo the
+shard count — no coordination, no lookup table, every router and every
+shard member computes the same owner independently. ``epoch`` increments
+on every re-partition (a split/merge that changes the shard count or the
+key->shard function), which is what lets the front door 410 any watch
+position minted before the split: a resume token must never silently
+straddle two journals (docs/sharding.md).
+
+Persistence rides the store's atomic snapshot-write ritual
+(``store.write_snapshot_file``: tmp + fsync + rename + dir fsync) into
+``shardmap.json`` next to the shard groups' data dirs, so a restarted
+front door recovers the exact partition (and epoch) it was serving.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Optional
+
+MAP_FILE = "shardmap.json"
+
+
+class ShardMap:
+    """Immutable-by-convention partition descriptor.
+
+    ``homes`` (shard -> region) and ``addresses`` (shard -> advertised
+    ``scheme://host:port`` route of the group's serving surface) are
+    placement/runtime annotations carried for hints and ``/debug/shards``;
+    routing itself depends only on (seed, shards).
+    """
+
+    def __init__(self, shards: int, seed: int = 0, epoch: int = 1,
+                 homes: Optional[dict] = None,
+                 addresses: Optional[dict] = None):
+        if shards < 1:
+            raise ValueError(f"shard count must be >= 1, got {shards}")
+        self.shards = int(shards)
+        self.seed = int(seed)
+        self.epoch = int(epoch)
+        self.homes: dict[int, str] = {
+            int(k): v for k, v in (homes or {}).items()
+        }
+        self.addresses: dict[int, str] = {
+            int(k): v for k, v in (addresses or {}).items()
+        }
+
+    # -- the partition function ---------------------------------------------
+
+    def shard_for(self, namespace: str, name: str) -> int:
+        """Owning shard of ``namespace/name``: keyed blake2b of the full
+        key, reduced modulo the shard count. Stable across processes and
+        Python versions (hashlib, never the salted builtin hash)."""
+        digest = hashlib.blake2b(
+            f"{namespace}/{name}".encode(),
+            digest_size=8,
+            key=f"shardmap-{self.seed}".encode(),
+        ).digest()
+        return int.from_bytes(digest, "big") % self.shards
+
+    def key_for_shard(self, shard: int, index: int,
+                      namespace: str = "default",
+                      prefix: str = "k") -> str:
+        """Deterministic probe for a name that hashes to ``shard`` (tests
+        and the bench pre-bucket their write keys per shard with this):
+        walks ``{prefix}-{index}-{n}`` until the digest lands."""
+        n = 0
+        while True:
+            name = f"{prefix}-{index:04d}-{n}"
+            if self.shard_for(namespace, name) == shard:
+                return name
+            n += 1
+
+    # -- runtime annotations -------------------------------------------------
+
+    def address_of(self, shard: int) -> str:
+        """Advertised full route (``scheme://host:port``) of the shard
+        group's serving surface — what misroute hints carry so a client
+        can actually follow them ("" when the plane never annotated)."""
+        return self.addresses.get(int(shard), "")
+
+    def resplit(self, shards: int) -> "ShardMap":
+        """New map over ``shards`` partitions at epoch+1 — the split/merge
+        migration input. Homes/addresses do NOT carry over: the new
+        partition re-solves placement and re-annotates."""
+        return ShardMap(shards, seed=self.seed, epoch=self.epoch + 1)
+
+    # -- wire / persistence --------------------------------------------------
+
+    def to_dict(self) -> dict:
+        return {
+            "shards": self.shards,
+            "seed": self.seed,
+            "epoch": self.epoch,
+            "homes": {str(k): v for k, v in sorted(self.homes.items())},
+            "addresses": {
+                str(k): v for k, v in sorted(self.addresses.items())
+            },
+        }
+
+    @classmethod
+    def from_dict(cls, doc: dict) -> "ShardMap":
+        return cls(
+            int(doc["shards"]),
+            seed=int(doc.get("seed", 0)),
+            epoch=int(doc.get("epoch", 1)),
+            homes=doc.get("homes") or {},
+            addresses=doc.get("addresses") or {},
+        )
+
+    def persist(self, base_dir: str) -> str:
+        """Durably write the map (store's atomic snapshot ritual, under
+        the MAP_FILE name) so a restarted front door serves the exact
+        partition + epoch it crashed with."""
+        from ..store.store import write_snapshot_file
+
+        write_snapshot_file(base_dir, self.to_dict(), filename=MAP_FILE)
+        return os.path.join(base_dir, MAP_FILE)
+
+    @classmethod
+    def load(cls, base_dir: str) -> Optional["ShardMap"]:
+        path = os.path.join(base_dir, MAP_FILE)
+        try:
+            with open(path) as f:
+                return cls.from_dict(json.load(f))
+        except (OSError, ValueError, KeyError):
+            return None
+
+
+__all__ = ["MAP_FILE", "ShardMap"]

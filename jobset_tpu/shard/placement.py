@@ -1,0 +1,123 @@
+"""Shard-home assignment as a solver problem (NL-CPS style).
+
+Each shard's quorum needs a home region (where its leader and replica
+majority live — docs/sharding.md "Quorum-per-shard topology"). The
+assignment minimizes, per shard:
+
+* **front-door latency**: every write's quorum round trip starts at the
+  router, so a home far from the front-door region taxes every request;
+* **failure-domain concentration**: each additional shard homed in the
+  same region raises the blast radius of one region isolation, so later
+  slots of a region cost progressively more.
+
+The cost surface is a ``shards x (regions * slots)`` matrix solved
+through the existing :class:`placement.solver.AssignmentSolver` — the
+same auction machinery that places gangs on domains — with a
+deterministic greedy argmin fallback over the identical matrix when the
+solver stack is unavailable (decisions coincide on these tiny, strictly
+slot-monotone surfaces; the parity test pins it). ``resolve`` is called
+again on every region cut/heal with the faulted regions priced at
++infinity, which is what "re-solved on topology change" means: the
+planned homes move off the dark region and come back when it heals.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+import numpy as np
+
+from .topology import RegionTopology
+
+# Concentration penalty per extra shard homed in one region, in the same
+# ms units as the latency column. Dominated by typical inter-region
+# latency spreads only after several shards stack up, so the solver
+# prefers nearby regions until concentration starts to bite — the
+# latency/failure-domain tradeoff the cost model exists to encode.
+CONCENTRATION_PENALTY_MS = 25.0
+
+
+def placement_cost(topology: RegionTopology, shards: int,
+                   excluded: Iterable[str] = ()) -> tuple[np.ndarray, list]:
+    """(cost matrix, slot->region list): one column per (region, slot)
+    with ceil(shards/regions) slots per region, latency from the
+    front-door region plus a per-slot concentration ramp; excluded
+    (faulted) regions cost +inf."""
+    regions = list(topology.regions)
+    slots_per_region = -(-shards // len(regions))  # ceil
+    slot_regions = [
+        region for region in regions for _ in range(slots_per_region)
+    ]
+    dark = set(excluded)
+    cost = np.empty((shards, len(slot_regions)), dtype=np.float64)
+    for column, region in enumerate(slot_regions):
+        slot = column % slots_per_region
+        if region in dark:
+            base = np.inf
+        else:
+            base = (
+                topology.latency_ms(topology.front_door_region, region)
+                + slot * CONCENTRATION_PENALTY_MS
+            )
+        cost[:, column] = base
+    return cost, slot_regions
+
+
+def _greedy_assign(cost: np.ndarray) -> list[int]:
+    """Deterministic argmin assignment over the shared cost matrix: each
+    shard (row order) takes the cheapest free column. On these surfaces
+    every row shares one column ordering, so greedy IS optimal — and it
+    doubles as the solver-stack-unavailable fallback."""
+    taken: set[int] = set()
+    out: list[int] = []
+    for row in range(cost.shape[0]):
+        best = min(
+            (c for c in range(cost.shape[1]) if c not in taken),
+            key=lambda c: (cost[row, c], c),
+        )
+        taken.add(best)
+        out.append(best)
+    return out
+
+
+def solve_shard_homes(topology: RegionTopology, shards: int,
+                      excluded: Iterable[str] = (),
+                      solver: Optional[object] = None) -> dict[int, str]:
+    """shard -> home region via the assignment solver (greedy fallback).
+
+    With every region excluded (total blackout) the exclusion is ignored:
+    a placement must always exist — the plan is advisory while the fault
+    persists."""
+    cost, slot_regions = placement_cost(topology, shards, excluded)
+    if not np.isfinite(cost).any():
+        cost, slot_regions = placement_cost(topology, shards, ())
+    # The auction benefit surface cannot hold inf: cap dark columns at a
+    # big-M strictly above any finite column so they are only ever chosen
+    # when nothing else exists.
+    finite = cost[np.isfinite(cost)]
+    big_m = (finite.max() if finite.size else 0.0) + 1e6
+    solvable = np.where(np.isfinite(cost), cost, big_m)
+    assignment = None
+    try:
+        if solver is None:
+            from ..placement.solver import AssignmentSolver
+
+            solver = AssignmentSolver()
+        assignment = solver.solve(solvable)
+    except Exception:
+        assignment = None
+    if assignment is None or any(
+        int(a) < 0 or int(a) >= len(slot_regions) for a in assignment
+    ):
+        assignment = _greedy_assign(solvable)
+    return {
+        shard: slot_regions[int(column)]
+        for shard, column in enumerate(assignment)
+    }
+
+
+__all__ = [
+    "CONCENTRATION_PENALTY_MS",
+    "placement_cost",
+    "solve_shard_homes",
+]

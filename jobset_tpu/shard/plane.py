@@ -1,0 +1,448 @@
+"""``ShardedControlPlane``: N quorum-replicated shard groups + front door.
+
+The in-process deployment shape of docs/sharding.md (the analog of N
+``controller --replicate`` quorums behind a routing VIP), built from the
+pieces the earlier planes proved: each shard group is an
+``ha.ReplicaSet`` — its own lease, quorum-replicated WAL, reconcile pump
+and watch journal — whose replicas are placed across the simulated
+region topology per the shard-home solve (leader + majority in the home
+region, the remainder in the next region over). The front door is an
+ordinary ``ControllerServer`` carrying a :class:`ShardRouter`: flow
+classification, then per-key dispatch.
+
+Region faults: ``isolate_region``/``heal_region`` translate one region
+fault into the directed link cuts of ``chaos/net.py`` (every boundary
+link, both directions, front door included) and re-run the placement
+solve with the dark region priced out — the planned homes move off the
+fault and return on heal (``jobset_shard_resolves_total``). The
+robustness contract: an isolation degrades ONLY the shards quorum-homed
+in that region; every other shard keeps acking majority writes.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+from ..core import make_cluster, metrics
+from .map import ShardMap
+from .placement import solve_shard_homes
+from .router import ShardHandle, ShardRouter
+from .topology import FRONT_DOOR_SRC, RegionTopology
+
+
+class ShardedControlPlane:
+    """N in-process shard groups, one shard map, one routing front door.
+
+    ``groups`` physical quorum groups are provisioned (default: the
+    initial shard count); the map may start smaller and ``resplit`` up
+    to ``groups`` later — the split migrates objects onto their new
+    owners and bumps the map epoch so pre-split watch positions 410.
+    """
+
+    def __init__(
+        self,
+        base_dir: str,
+        shards: int = 2,
+        groups: Optional[int] = None,
+        replicas_per_shard: int = 3,
+        topology: Optional[RegionTopology] = None,
+        seed: int = 0,
+        injector=None,
+        lease_duration: float = 0.4,
+        retry_period: float = 0.1,
+        tick_interval: float = 0.05,
+        read_fence: bool = True,
+        address: str = "127.0.0.1:0",
+        flow=None,
+        cluster_factory=None,
+        spread_shards=(),
+    ):
+        from ..ha import ReplicaSet
+        from ..server import ControllerServer
+
+        self.base_dir = str(base_dir)
+        self.groups = int(groups if groups is not None else shards)
+        if shards > self.groups:
+            raise ValueError(
+                f"map of {shards} shards needs >= {shards} groups "
+                f"(got {self.groups})"
+            )
+        self.injector = injector
+        self.topology = topology or RegionTopology(seed=seed)
+        # Recover the persisted partition (docs/sharding.md): a restart
+        # after a resplit must route by the exact shards/epoch it was
+        # serving — rebuilding at the constructor's shard count would
+        # resurrect the pre-split owners and split object histories. A
+        # persisted map with a different seed (or more shards than this
+        # deployment provisions) is a config change, not a recovery:
+        # the flags win and the stale file is overwritten below.
+        recovered = ShardMap.load(self.base_dir)
+        if (recovered is not None and recovered.seed == int(seed)
+                and recovered.shards <= self.groups):
+            self.map = ShardMap(recovered.shards, seed=seed,
+                                epoch=recovered.epoch)
+        else:
+            self.map = ShardMap(shards, seed=seed)
+        shards = self.map.shards
+        # Shard-home solve over every provisioned group (idle groups get
+        # homes too: a future resplit activates them in place).
+        self.homes = solve_shard_homes(self.topology, self.groups)
+        self.map.homes = {
+            s: self.homes[s] for s in range(self.map.shards)
+        }
+        self.replica_region: dict[str, str] = {}
+        self.shard_groups: list = []
+        # Shards placed durability-first (one replica per region) instead
+        # of latency-first (majority in the home region) — the other end
+        # of the placement cost tradeoff. A spread shard survives any
+        # single-region isolation by failing over to its out-of-region
+        # majority; a home-majority shard pays no cross-region quorum
+        # latency but goes dark with its home.
+        self.spread_shards = frozenset(int(s) for s in spread_shards)
+        majority = replicas_per_shard // 2 + 1
+        for g in range(self.groups):
+            home = self.homes[g]
+            if g in self.spread_shards:
+                regions = self._spread_regions(home, replicas_per_shard)
+            else:
+                regions = self._replica_regions(home, replicas_per_shard,
+                                                majority)
+            group = ReplicaSet(
+                os.path.join(self.base_dir, f"shard-{g}"),
+                n=replicas_per_shard,
+                name_prefix=f"s{g}r",
+                lease_duration=lease_duration,
+                retry_period=retry_period,
+                tick_interval=tick_interval,
+                injector=injector,
+                read_fence=read_fence,
+                cluster_factory=cluster_factory,
+                shard_id=g,
+                shard_map=self.map,
+            )
+            for replica, region in zip(group.replicas, regions):
+                self.topology.place(replica.replica_id, region)
+                self.replica_region[replica.replica_id] = region
+            group.start()
+            self.map.addresses[g] = f"http://{group.address}"
+            self.shard_groups.append(group)
+        self.router = ShardRouter(
+            self.map,
+            [
+                ShardHandle(g, group, address=f"http://{group.address}")
+                for g, group in enumerate(self.shard_groups)
+            ],
+            src=FRONT_DOOR_SRC,
+            injector=injector,
+        )
+        self.map.persist(self.base_dir)
+        self.front_door = ControllerServer(
+            address,
+            cluster=make_cluster(),
+            tick_interval=tick_interval,
+            injector=injector,
+            flow=flow,
+            shard_router=self.router,
+        ).start()
+        self._stop = threading.Event()
+        self._supervisor: Optional[threading.Thread] = None
+
+    def _replica_regions(self, home: str, n: int, majority: int) -> list:
+        """Per-replica regions for a group homed in `home`: the quorum
+        majority co-locates with the leader in the home region (every
+        write's quorum round trip stays intra-region — the latency side
+        of the placement tradeoff; this is exactly what makes the group
+        "quorum-homed" and the region its failure domain), the remainder
+        spreads over the following regions for durability."""
+        regions = [home] * majority
+        others = [r for r in self.topology.regions if r != home] or [home]
+        for i in range(n - majority):
+            regions.append(others[i % len(others)])
+        return regions
+
+    def _spread_regions(self, home: str, n: int) -> list:
+        """One replica per region, leader (replica 0) in the home — the
+        durability-first placement for spread shards."""
+        ordered = [home] + [
+            r for r in self.topology.regions if r != home
+        ]
+        return [ordered[i % len(ordered)] for i in range(n)]
+
+    @property
+    def address(self) -> str:
+        """The front door's serving address (host:port)."""
+        return self.front_door.address
+
+    # -- supervision ---------------------------------------------------------
+
+    def step(self) -> None:
+        """One supervision round over every shard group (elections,
+        demotions) — the deterministic-scenario driver; the background
+        supervisor calls the same thing on a cadence."""
+        for group in self.shard_groups:
+            group.step()
+
+    def start_supervisor(self, interval_s: float = 0.05) -> None:
+        """Background stepping for wall-clock deployments (bench, CLI):
+        failovers inside any shard group proceed without a driver."""
+        if self._supervisor is not None:
+            return
+
+        def loop():
+            while not self._stop.wait(interval_s):
+                try:
+                    self.step()
+                except Exception:
+                    import logging
+
+                    logging.getLogger("jobset_tpu.shard").exception(
+                        "shard supervisor step failed"
+                    )
+
+        thread = threading.Thread(target=loop, daemon=True,
+                                  name="shard-supervisor")
+        thread.start()
+        self._supervisor = thread
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._supervisor is not None:
+            self._supervisor.join(timeout=5.0)
+            self._supervisor = None
+        self.front_door.stop()
+        for group in self.shard_groups:
+            group.stop()
+
+    # -- region faults -------------------------------------------------------
+
+    def _plan(self):
+        from ..chaos import net as chaos_net
+
+        plan = chaos_net.get_plan(self.injector)
+        if plan is None:
+            raise RuntimeError(
+                "region faults need a PartitionPlan attached to the "
+                "plane's injector (chaos/net.py)"
+            )
+        return plan
+
+    def isolate_region(self, region: str, step: Optional[int] = None):
+        """Cut every directed link crossing the region boundary (the
+        region-isolation fault of docs/sharding.md's runbook) and
+        re-solve shard placement with the region priced out."""
+        plan = self._plan()
+        at = plan._current_step() if step is None else int(step)
+        for src, dst in self.topology.isolation_links(region):
+            plan.cut(src, dst, at=at)
+        plan.advance(at)
+        return self.resolve_placement(excluded={region})
+
+    def heal_region(self, region: str, step: Optional[int] = None):
+        """Heal the region's boundary links and re-solve placement."""
+        plan = self._plan()
+        at = plan._current_step() if step is None else int(step)
+        for src, dst in self.topology.isolation_links(region):
+            plan.heal(src, dst, at=at)
+        plan.advance(at)
+        return self.resolve_placement(excluded=set())
+
+    def resolve_placement(self, excluded=frozenset()) -> dict[int, str]:
+        """Re-run the shard-home solve against the current (possibly
+        faulted) topology — "re-solved on topology change". The result
+        is the PLANNED home set (replica quorums do not teleport; the
+        plan is what an operator-driven or future automated migration
+        would execute), surfaced at /debug/shards and counted."""
+        planned = solve_shard_homes(self.topology, self.groups,
+                                    excluded=excluded)
+        self.router.set_planned_homes({
+            s: planned[s] for s in range(self.map.shards)
+        })
+        metrics.shard_resolves_total.inc()
+        return planned
+
+    def quorum_homed_in(self, region: str) -> list[int]:
+        """Shards whose replica MAJORITY lives in `region` — the set a
+        region isolation degrades (the rest must keep acking)."""
+        out = []
+        for g in range(self.map.shards):
+            group = self.shard_groups[g]
+            majority = len(group.replicas) // 2 + 1
+            in_region = sum(
+                1 for r in group.replicas
+                if self.replica_region.get(r.replica_id) == region
+            )
+            if in_region >= majority:
+                out.append(g)
+        return out
+
+    # -- re-partitioning (split/merge migration) -----------------------------
+
+    def resplit(self, shards: int) -> dict:
+        """Re-partition the keyspace over `shards` of the provisioned
+        groups: objects whose owner changes are migrated (manifest
+        re-created on the new owner, deleted from the old — status is
+        reconciled afresh on the new owner, docs/sharding.md), the map
+        epoch bumps, and the router journal is wholly trimmed so every
+        pre-split watch position 410-relists into the owners' state."""
+        from ..api import serialization
+
+        if shards > self.groups:
+            raise ValueError(
+                f"cannot split to {shards} shards over {self.groups} "
+                f"provisioned groups"
+            )
+        new_map = self.map.resplit(shards)
+        moved = 0
+        # Fence front-door WRITES for the whole migration window: a
+        # write acked by an old owner AFTER its manifests were
+        # snapshotted would be stranded across the map swap (never
+        # migrated, unreachable under the new routing). Reads and
+        # lists keep serving; fenced writers retry after the hint.
+        self.router.fence_writes(True)
+        # (old_shard, new_shard, ns, name) copies landed so far — the
+        # rollback ledger for a mid-copy failure, the delete worklist on
+        # success.
+        copied: list[tuple[int, int, str, str]] = []
+        try:
+            # Lift the member misroute guards for the move window: the
+            # migration is the ONE actor legitimately touching both
+            # sides of a key's move (the old owner's DELETE and the new
+            # owner's POST would each 421 under either map).
+            for group in self.shard_groups:
+                group.shard_map = None
+                for replica in group.replicas:
+                    if replica.server is not None:
+                        replica.server.shard_map = None
+            # Phase 1 — COPY: every moving object is created on its new
+            # owner; nothing is deleted yet, so a failure anywhere in
+            # this phase rolls back by deleting the copies and the old
+            # map stays fully authoritative.
+            for g in range(self.map.shards):
+                leader = self.shard_groups[g].leader()
+                if leader is None:
+                    raise RuntimeError(
+                        f"shard {g} has no leader to migrate"
+                    )
+                server = leader.server
+                with server.lock:
+                    manifests = [
+                        serialization.to_dict(js)
+                        for _key, js in sorted(
+                            server.cluster.jobsets.items()
+                        )
+                    ]
+                for manifest in manifests:
+                    meta = manifest.get("metadata") or {}
+                    ns = meta.get("namespace") or "default"
+                    name = meta.get("name") or ""
+                    new_owner = new_map.shard_for(ns, name)
+                    if new_owner == g:
+                        continue
+                    target = self.shard_groups[new_owner].leader()
+                    if target is None:
+                        raise RuntimeError(
+                            f"shard {new_owner} has no leader to "
+                            f"migrate to"
+                        )
+                    import json as _json
+
+                    manifest.pop("status", None)
+                    path = (
+                        f"{server.API_PREFIX}/namespaces/{ns}/jobsets"
+                    )
+                    code, payload = target.server._route(
+                        "POST", path, _json.dumps(manifest).encode()
+                    )[:2]
+                    if code not in (201, 409):
+                        raise RuntimeError(
+                            f"migration of {ns}/{name} to shard "
+                            f"{new_owner} failed: HTTP {code} {payload}"
+                        )
+                    copied.append((g, new_owner, ns, name))
+            # Phase 2 — SWAP the authoritative map: every copy exists,
+            # so per-key routing by the new owners is correct from here
+            # (the router's own journal epoch flips LAST, below).
+            new_map.homes = {s: self.homes[s] for s in range(shards)}
+            new_map.addresses = {
+                s: f"http://{self.shard_groups[s].address}"
+                for s in range(shards)
+            }
+            self.map = new_map
+            # The ROUTER's per-key routing flips here too (its journal
+            # epoch flips at phase 4): phase 3 deletes the old-owner
+            # originals, so a front-door GET routed by the old map
+            # would 404 an object that lives on its new owner.
+            self.router.map = new_map
+            # Phase 3 — DELETE the old-owner shadows (unreachable via
+            # the API under the new map, but still consuming their old
+            # shard's reconcile). A failed delete is surfaced, never
+            # silently dropped: the partition is already correct, the
+            # shadow is garbage to retry.
+            shadows: list[str] = []
+            for g, _new_owner, ns, name in copied:
+                old_leader = self.shard_groups[g].leader()
+                path = (
+                    f"{self.front_door.API_PREFIX}/namespaces/{ns}"
+                    f"/jobsets/{name}"
+                )
+                code = (
+                    old_leader.server._route("DELETE", path, b"")[0]
+                    if old_leader is not None else 0
+                )
+                if code not in (200, 404):
+                    shadows.append(f"{ns}/{name}@shard{g}")
+                else:
+                    moved += 1
+            # Phase 4 — the router's journal epoch flips ONLY NOW, after
+            # every migration-induced journal event (the copies' ADDED
+            # on new owners, the shadows' DELETED on old owners) is in
+            # the past: cursors reseed at the post-migration heads, so
+            # the new journal carries NO migration noise. Trimming
+            # before the deletes let a watcher relist at the boundary
+            # and then receive the shadows' DELETED without ever having
+            # seen the copies' ADDED — a cache missing the moved
+            # objects until its next full resync.
+            self.router.resplit(new_map)
+            new_map.persist(self.base_dir)
+            result = {
+                "shards": shards, "epoch": new_map.epoch, "moved": moved,
+            }
+            if shadows:
+                result["shadow_copies"] = shadows
+            return result
+        except BaseException:
+            # Mid-copy failure (the old map is still authoritative —
+            # the guard below matters: once the swap happened the copies
+            # ARE the objects and must never be rolled back): delete the
+            # copies already landed on new owners, best-effort — they
+            # are duplicates of objects the old map still serves — so
+            # the restored old partition has no shadow state.
+            if self.map is not new_map:
+                for _g, new_owner, ns, name in copied:
+                    target = self.shard_groups[new_owner].leader()
+                    if target is not None:
+                        target.server._route(
+                            "DELETE",
+                            f"{self.front_door.API_PREFIX}/namespaces"
+                            f"/{ns}/jobsets/{name}",
+                            b"",
+                        )
+            raise
+        finally:
+            # ALWAYS restore the member misroute guards — to the new map
+            # on success, the old map on any migration failure. A failed
+            # resplit must never leave every shard accepting keys it
+            # does not own (the exact split-history hazard the 421 guard
+            # exists to prevent).
+            current = self.map
+            for group in self.shard_groups:
+                group.shard_map = current
+                for replica in group.replicas:
+                    if replica.server is not None:
+                        replica.server.shard_map = current
+            self.router.fence_writes(False)
+
+
+__all__ = ["ShardedControlPlane"]

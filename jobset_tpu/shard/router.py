@@ -1,0 +1,474 @@
+"""Routing front door core: per-key dispatch + merged watch journal.
+
+``ShardRouter`` is the piece ``ControllerServer`` consults when it is
+constructed as a front door (``shard_router=``, docs/sharding.md): the
+flow plane has already classified/admitted the request; the router then
+
+* resolves the owning shard of a ``namespace/name`` key through the
+  :class:`ShardMap` and **dispatches** to that shard group's current
+  leader server (in-process ``_route`` call — the same request pipeline
+  a direct client would hit: the shard's own fences, replication
+  quorum, Warning semantics all apply). Every dispatch is one delivery
+  over the network fault model's directed ``(front-door, leader)`` link
+  and one arrival at the ``shard.route`` chaos point, so region cuts
+  and injected routing faults degrade exactly the shards they name;
+* answers **503 + shard-leader hint** (Retry-After paced like every
+  other fence) when the owning shard has no reachable leader — the
+  client retries or follows the hint to the shard's own surface;
+* serves **cross-shard LISTs** by fanning out to every shard and
+  merging (a shard that cannot answer fails the list: a merged list
+  silently missing a shard would read as mass deletion to an informer);
+* maintains the **merged watch journal**: per-shard cursors pull each
+  shard's jobsets journal — bounded by that shard's quorum delivery
+  floor, so un-quorum-committed events never cross the front door — and
+  append into one router-rv-ordered journal that cross-shard watchers
+  long-poll. Router rvs are what cross-shard session monotonicity is
+  checked over (``verify.check_sharded_history``).
+
+Re-partitioning (``resplit``) swaps the map at a new epoch and marks the
+whole journal trimmed: every pre-split resume token answers 410 and the
+watcher relists into the owning shards' post-migration state — a watch
+may never silently straddle two journals.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ..core import metrics
+from .map import ShardMap
+from .topology import FRONT_DOOR_SRC
+
+# Bound on the merged journal (same order as the per-shard journals).
+ROUTER_JOURNAL_LIMIT = 4096
+
+
+class ShardHandle:
+    """One shard group as the router sees it: id, serving address, and a
+    live leader resolver. ``group`` is anything with ``.leader()``
+    returning an object carrying ``replica_id`` and ``server`` (the
+    in-process ``ha.ReplicaSet`` shape), or None while leaderless."""
+
+    def __init__(self, shard_id: int, group, address: str = ""):
+        self.shard_id = int(shard_id)
+        self.group = group
+        self.address = address
+
+    def leader(self):
+        """(replica_id, server) of the current leader, or (None, None)."""
+        replica = self.group.leader()
+        if replica is None or replica.server is None:
+            return None, None
+        return replica.replica_id, replica.server
+
+
+class ShardRouter:
+    """Key->shard dispatch plus the merged cross-shard journal."""
+
+    def __init__(self, shard_map: ShardMap, handles: list[ShardHandle],
+                 src: str = FRONT_DOOR_SRC, injector=None):
+        self.map = shard_map
+        self.handles: dict[int, ShardHandle] = {
+            h.shard_id: h for h in handles
+        }
+        self.src = src
+        self.injector = injector
+        # Serializes whole ingest passes (snapshot cursors -> pull shard
+        # journals -> append): concurrent pulls over the same cursors
+        # would merge every shard event twice. Ordered BEFORE
+        # _journal_lock (never acquired while holding it).
+        self._ingest_lock = threading.Lock()
+        # Re-partition write fence: while set, mutating dispatches answer
+        # 503 + Retry-After — a write landing on an old owner AFTER its
+        # manifests were snapshotted for migration would be stranded
+        # across the map swap (acked but never migrated). Reads/lists
+        # keep serving throughout. The in-flight counter closes the
+        # check-to-dispatch TOCTOU: fence_writes(True) DRAINS writers
+        # already past the check before the caller may snapshot.
+        self._write_fence = threading.Event()  # guarded-by: _flight_lock
+        self._flight_lock = threading.Condition()
+        self._inflight_writes = 0  # guarded-by: _flight_lock
+        # Merged-journal state, all guarded by this condition (router
+        # rvs, the event list, per-shard pull cursors, the trim floor).
+        self._journal_lock = threading.Condition()
+        self._events: list[tuple[int, str, dict]] = []  # guarded-by: _journal_lock
+        self._rv = 0  # guarded-by: _journal_lock
+        self._trimmed_rv = 0  # guarded-by: _journal_lock
+        self._cursors: dict[int, int] = {}  # guarded-by: _journal_lock
+        # Latest placement re-solve output (plane.resolve_placement):
+        # where the homes WOULD move given the current fault set.
+        self._planned_homes: dict[int, str] = {}  # guarded-by: _journal_lock
+        metrics.shard_count.set(self.map.shards)
+
+    def fence_writes(self, fenced: bool, drain_timeout_s: float = 30.0):
+        """Raise/lower the re-partition write fence (plane.resplit's
+        migration window). Raising it BLOCKS until every in-flight
+        mutating dispatch has completed: a writer that passed the fence
+        check before it was set must land (and be visible to the
+        migration's manifest snapshots) before this returns — otherwise
+        its clean-acked object could be stranded on an old owner."""
+        import time as _t
+
+        if not fenced:
+            with self._flight_lock:
+                self._write_fence.clear()
+            return
+        deadline = _t.monotonic() + drain_timeout_s
+        with self._flight_lock:
+            self._write_fence.set()
+            while self._inflight_writes > 0:
+                remaining = deadline - _t.monotonic()
+                if remaining <= 0:
+                    self._write_fence.clear()
+                    raise RuntimeError(
+                        f"{self._inflight_writes} in-flight write(s) "
+                        f"never drained within {drain_timeout_s}s; "
+                        f"write fence aborted"
+                    )
+                self._flight_lock.wait(remaining)
+
+    def active_shards(self) -> list[int]:
+        """Shard ids the CURRENT map can route to: provisioned-but-idle
+        groups past the map's shard count hold no objects and must not
+        fail cross-shard lists or cost journal pulls."""
+        return [s for s in sorted(self.handles) if s < self.map.shards]
+
+    def set_planned_homes(self, planned: dict[int, str]) -> None:
+        """Record the latest shard-home re-solve (surfaced at
+        /debug/shards as `plannedHomes`)."""
+        with self._journal_lock:
+            self._planned_homes = dict(planned)
+
+    # -- key routing ---------------------------------------------------------
+
+    def shard_for(self, namespace: str, name: str) -> int:
+        return self.map.shard_for(namespace, name)
+
+    def hint(self, shard: int) -> dict:
+        """The shard-leader hint misroute/unroutable answers carry: shard
+        id plus the group's advertised full route."""
+        handle = self.handles.get(int(shard))
+        address = self.map.address_of(shard) or (
+            handle.address if handle is not None else ""
+        )
+        return {"shard": int(shard), "leaderAddress": address or None}
+
+    # -- dispatch ------------------------------------------------------------
+
+    def dispatch(self, shard: int, method: str, path: str, body: bytes,
+                 headers: Optional[dict] = None):
+        """Forward one request to the owning shard's leader; returns the
+        shard server's full ``_route`` response tuple with the shard id
+        stamped (``X-Jobset-Shard``), or a 503 + hint when the shard is
+        unroutable (no leader, link cut, chaos fault)."""
+        from ..chaos import net as chaos_net
+        from ..chaos.injector import consult
+
+        mutating = method in ("POST", "PUT", "DELETE", "PATCH")
+        if mutating:
+            # Fence check + in-flight registration are ONE atomic step
+            # under the flight lock: a writer past this point is
+            # guaranteed visible to fence_writes' drain, so resplit's
+            # manifest snapshots can never miss a landing write.
+            with self._flight_lock:
+                if self._write_fence.is_set():
+                    return (
+                        503,
+                        {"error": "keyspace re-partition in progress; "
+                                  "writes are fenced until the "
+                                  "migration completes — retry"},
+                        None,
+                        {"Retry-After": "1"},
+                    )
+                self._inflight_writes += 1
+        try:
+            handle = self.handles.get(int(shard))
+            if handle is None:
+                return self._unroutable(
+                    shard, f"shard {shard} is not served"
+                )
+            fault = consult("shard.route", f"{method} shard={shard}",
+                            injector=self.injector)
+            if fault is not None and fault.kind != "latency":
+                return self._unroutable(
+                    shard, f"chaos shard.route: injected {fault.kind} "
+                           f"(seq {fault.seq})"
+                )
+            leader_id, server = handle.leader()
+            if server is None:
+                return self._unroutable(shard, "no leader elected")
+            reason = chaos_net.check_link(self.src, leader_id,
+                                          injector=self.injector)
+            if reason is not None:
+                return self._unroutable(shard, reason)
+            metrics.shard_requests_total.inc(str(shard))
+            result = server._route(method, path, body,
+                                   headers=headers or {})
+            if mutating:
+                # A routed write journaled events on ITS shard only:
+                # pull just that shard through so parked cross-shard
+                # watchers wake immediately — a full all-shards fan-out
+                # here would serialize every writer thread on the
+                # ingest lock doing O(shards) journal scans per write,
+                # the exact contention the sharding exists to remove
+                # (watch polls still sweep every shard on their own
+                # cadence).
+                self.ingest(only_shard=shard)
+            return self._stamp_shard(result, shard)
+        finally:
+            if mutating:
+                with self._flight_lock:
+                    self._inflight_writes -= 1
+                    self._flight_lock.notify_all()
+
+    def _unroutable(self, shard: int, reason: str):
+        metrics.shard_unroutable_total.inc(str(int(shard)))
+        return (
+            503,
+            {
+                "error": (
+                    f"shard {shard} is unroutable from the front door "
+                    f"({reason}); retry, or follow the shard-leader hint"
+                ),
+                **self.hint(shard),
+            },
+            None,
+            {"Retry-After": "1", "X-Jobset-Shard": str(int(shard))},
+        )
+
+    @staticmethod
+    def _stamp_shard(result, shard: int):
+        code, payload = result[0], result[1]
+        ctype = result[2] if len(result) > 2 else None
+        extra = dict(result[3]) if len(result) > 3 else {}
+        extra.setdefault("X-Jobset-Shard", str(int(shard)))
+        return (code, payload, ctype, extra)
+
+    # -- cross-shard list ----------------------------------------------------
+
+    def merged_list(self, method_path: str, headers: Optional[dict] = None,
+                    items_key: str = "items"):
+        """Fan a GET out to every shard's leader and merge the item lists
+        (sorted by (namespace, name) for a deterministic wire order).
+        Any unroutable or failing shard fails the WHOLE list with its
+        hint: a partial merged list would read as mass deletion to a
+        relisting informer.
+
+        The merged resourceVersion is the router journal head captured
+        BEFORE the per-shard GETs: a write landing mid-fan-out then
+        appears in the items but not under the token, so the subsequent
+        watch re-delivers it (a duplicate upsert — harmless to an
+        informer). Capturing the head AFTER the GETs would invert that:
+        items could MISS a write whose event the token already covers,
+        and the informer would never see it — the list-then-watch gap."""
+        self.ingest()
+        with self._journal_lock:
+            rv = self._rv
+        merged: list[dict] = []
+        for shard in self.active_shards():
+            result = self.dispatch(shard, "GET", method_path, b"",
+                                   headers=headers)
+            if result[0] != 200:
+                return result
+            payload = result[1]
+            merged.extend(payload.get(items_key) or [])
+        merged.sort(key=lambda obj: (
+            ((obj.get("metadata") or {}).get("namespace") or ""),
+            ((obj.get("metadata") or {}).get("name") or ""),
+        ))
+        return 200, {items_key: merged, "resourceVersion": rv}
+
+    # -- merged watch journal ------------------------------------------------
+
+    def ingest(self, only_shard=None) -> int:
+        """Pull each shard's new jobsets journal events (bounded by that
+        shard's quorum delivery floor) and append them to the merged
+        journal under fresh router rvs. Shard reads happen OUTSIDE the
+        router condition (lock-order discipline: never hold `_journal_lock`
+        into a shard's `_watch_cond`); the append is one locked pass.
+        The WHOLE pull-then-append runs under `_ingest_lock`: writer
+        handlers and watcher polls all call here concurrently, and two
+        pulls snapshotting the same cursors would each fetch the same
+        shard events and append them twice. `only_shard` restricts the
+        pull to one shard (the write path's targeted wake-up). Returns
+        the number of events merged."""
+        with self._ingest_lock:
+            return self._ingest_exclusive(only_shard=only_shard)
+
+    def _ingest_exclusive(self, only_shard=None) -> int:
+        pulled: list[tuple[int, bool, list]] = []
+        with self._journal_lock:
+            cursors = dict(self._cursors)
+        targets = (
+            [int(only_shard)] if only_shard is not None
+            and int(only_shard) in self.handles
+            else self.active_shards()
+        )
+        for shard in targets:
+            handle = self.handles[shard]
+            _leader_id, server = handle.leader()
+            if server is None:
+                continue
+            cursor = cursors.get(shard, 0)
+            events, floor, trimmed = server.journal_tail("jobsets", cursor)
+            gap = cursor < trimmed and cursor > 0
+            pulled.append((shard, gap, [
+                (ns, event) for _rv, ns, event in events
+            ]))
+            cursors[shard] = max(cursor, floor)
+        merged = 0
+        with self._journal_lock:
+            for shard, gap, events in pulled:
+                if gap:
+                    # The shard's journal trimmed past our cursor: events
+                    # were lost to the merge. Honest answer: declare the
+                    # whole merged journal trimmed so every watcher 410s
+                    # and relists — never silently skip a gap. Advance
+                    # PAST the head first: a caught-up watcher holds
+                    # exactly the head as its token, and `head < trimmed`
+                    # is what sends it to relist (the same off-by-one
+                    # resplit() guards against).
+                    self._rv += 1
+                    self._trimmed_rv = self._rv
+                for ns, event in events:
+                    self._rv += 1
+                    self._events.append((self._rv, ns, event))
+                    merged += 1
+                self._cursors[shard] = cursors[shard]
+            if len(self._events) > ROUTER_JOURNAL_LIMIT:
+                trimmed_events = self._events[:-ROUTER_JOURNAL_LIMIT]
+                self._trimmed_rv = trimmed_events[-1][0]
+                del self._events[:-ROUTER_JOURNAL_LIMIT]
+            if merged:
+                self._journal_lock.notify_all()
+        return merged
+
+    def watch(self, ns: str, resource_version: int, timeout_s: float,
+              park: bool = True, retry_hint: float = 1.0,
+              poll_interval_s: float = 0.05):
+        """Cross-shard jobsets long-poll against the merged journal, with
+        the same 410/partial-batch contract as a single server's watch.
+        The loop re-ingests on each wake: routed writes notify
+        immediately; leader-pump-driven changes surface within the poll
+        interval."""
+        import time as _t
+
+        deadline = _t.monotonic() + max(0.0, min(timeout_s, 300.0))
+        while True:
+            self.ingest()
+            with self._journal_lock:
+                if resource_version < self._trimmed_rv:
+                    return 410, {
+                        "error": "resourceVersion predates the current "
+                                 "shard journal (trimmed or re-split); "
+                                 "relist",
+                        "resourceVersion": self._rv,
+                    }
+                if resource_version > self._rv:
+                    return 410, {
+                        "error": "resourceVersion is ahead of this "
+                                 "front door; relist",
+                        "resourceVersion": self._rv,
+                    }
+                batch = [
+                    {"resourceVersion": rv, **event}
+                    for rv, event_ns, event in self._events
+                    if rv > resource_version and event_ns == ns
+                ]
+                head = self._rv
+                if batch:
+                    result = {"events": batch, "resourceVersion": head}
+                    if not park:
+                        result["retryAfterSeconds"] = retry_hint
+                    return 200, result
+                if not park:
+                    return 200, {
+                        "events": [], "resourceVersion": head,
+                        "retryAfterSeconds": retry_hint,
+                    }
+                remaining = deadline - _t.monotonic()
+                if remaining <= 0:
+                    return 200, {"events": [], "resourceVersion": head}
+                self._journal_lock.wait(min(remaining, poll_interval_s))
+
+    # -- re-partitioning -----------------------------------------------------
+
+    def resplit(self, new_map: ShardMap) -> None:
+        """Swap in a new partition epoch: the merged journal is wholly
+        trimmed (every pre-split rv answers 410 -> relist into the
+        post-migration owners) and cursors restart at each shard's
+        current head so the new journal carries only post-split
+        events."""
+        # Plain LOCK-FREE reference swap: routing reads `self.map`
+        # without locking (the map object is immutable by convention; a
+        # reference swap is atomic), and the caller (the plane) only
+        # calls resplit once migration has finished, so either map
+        # routes correctly during the swap window.
+        self.map = new_map
+        # Under the ingest lock: an ingest pass concurrent with the trim
+        # could append pre-split events (pulled with pre-split cursors)
+        # AFTER the trim, leaking old-owner state past the 410 boundary
+        # — waiting it out here means anything it appended is cleared
+        # below.
+        with self._ingest_lock:
+            # Heads over the NEW map's active set (self.map was swapped
+            # above): a split UP must seed cursors for newly-activated
+            # groups so their post-split events merge from here on.
+            heads: dict[int, int] = {}
+            for shard in self.active_shards():
+                _leader_id, server = self.handles[shard].leader()
+                if server is not None:
+                    _events, floor, _trimmed = server.journal_tail(
+                        "jobsets", 1 << 62
+                    )
+                    heads[shard] = floor
+            with self._journal_lock:
+                self._events.clear()
+                # Advance PAST the old head before trimming: a caught-up
+                # watcher holds exactly the old head as its resume
+                # token, and `head < trimmed` is what sends it to relist
+                # — trimming AT the head would keep serving the
+                # pre-split position.
+                self._rv += 1
+                self._trimmed_rv = self._rv
+                for shard, head in heads.items():
+                    self._cursors[shard] = head
+                self._journal_lock.notify_all()
+        metrics.shard_count.set(new_map.shards)
+
+    # -- introspection -------------------------------------------------------
+
+    def describe(self) -> dict:
+        """The /debug/shards payload: map, per-shard leader/route state,
+        merged-journal position."""
+        shards = {}
+        for shard in sorted(self.handles):
+            handle = self.handles[shard]
+            leader_id, server = handle.leader()
+            shards[str(shard)] = {
+                "home": self.map.homes.get(shard),
+                "address": self.map.address_of(shard) or handle.address,
+                "leader": leader_id,
+                "serving": server is not None,
+            }
+        with self._journal_lock:
+            journal = {
+                "resourceVersion": self._rv,
+                "trimmedResourceVersion": self._trimmed_rv,
+                "cursors": {
+                    str(k): v for k, v in sorted(self._cursors.items())
+                },
+            }
+            planned = {
+                str(k): v for k, v in sorted(self._planned_homes.items())
+            }
+        return {
+            "map": self.map.to_dict(),
+            "shards": shards,
+            "plannedHomes": planned,
+            "journal": journal,
+        }
+
+
+__all__ = ["ROUTER_JOURNAL_LIMIT", "ShardHandle", "ShardRouter"]

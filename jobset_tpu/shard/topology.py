@@ -1,0 +1,108 @@
+"""Simulated region topology for shard placement and region faults.
+
+Regions are the failure domains of the sharded control plane: each shard
+group's replicas live in regions, and ``chaos/net.py`` region isolations
+cut every directed link crossing a region boundary. Pairwise latencies
+are seeded and symmetric (a pure function of ``(seed, region pair)``) so
+the placement solve — and therefore the shard map — is byte-identical
+across runs; the front door sits in a designated region (default: the
+first), which is where the latency column of the placement cost comes
+from.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Iterable, Optional
+
+# The front door's identity on the network fault model's directed links
+# (chaos/net.py): every router dispatch is one delivery over
+# (FRONT_DOOR_SRC, shard-leader replica id).
+FRONT_DOOR_SRC = "front-door"
+
+
+def _pair_latency_ms(seed: int, a: str, b: str) -> float:
+    """Deterministic inter-region latency in ms: 10..59 ms drawn from a
+    keyed digest of the (sorted) pair — stable, symmetric, never the
+    process RNG."""
+    lo, hi = sorted((a, b))
+    digest = hashlib.blake2b(
+        f"{lo}|{hi}".encode(), digest_size=4,
+        key=f"region-latency-{seed}".encode(),
+    ).digest()
+    return 10.0 + int.from_bytes(digest, "big") % 50
+
+
+class RegionTopology:
+    """Named regions + seeded pairwise latencies + the actor->region map.
+
+    ``place(actor, region)`` registers a control-plane actor (a shard
+    replica id, the front door) in a region; ``isolation_links(region)``
+    yields every directed cross-boundary link a region isolation must
+    cut — the single definition the scenarios AND ``bench --ha --shards``
+    both drive, so they measure the same fault.
+    """
+
+    def __init__(self, regions: Iterable[str] = ("region-a", "region-b",
+                                                 "region-c"),
+                 seed: int = 0, front_door_region: Optional[str] = None):
+        self.regions = list(regions)
+        if not self.regions:
+            raise ValueError("a topology needs at least one region")
+        self.seed = int(seed)
+        self.front_door_region = front_door_region or self.regions[0]
+        # actor id -> region; the front door registers itself too, so a
+        # front-door-region isolation is expressible.
+        self.actor_region: dict[str, str] = {
+            FRONT_DOOR_SRC: self.front_door_region
+        }
+
+    def place(self, actor: str, region: str) -> None:
+        if region not in self.regions:
+            raise ValueError(
+                f"unknown region {region!r} (regions: {self.regions})"
+            )
+        self.actor_region[actor] = region
+
+    def latency_ms(self, a: str, b: str) -> float:
+        """Symmetric inter-region latency (0 within a region)."""
+        if a == b:
+            return 0.0
+        return _pair_latency_ms(self.seed, a, b)
+
+    def actors_in(self, region: str) -> list[str]:
+        return sorted(
+            actor for actor, r in self.actor_region.items() if r == region
+        )
+
+    def isolation_links(self, region: str) -> list[tuple[str, str]]:
+        """Every directed link a full isolation of `region` severs: both
+        directions between each actor inside and each actor outside,
+        sorted for deterministic cut scheduling."""
+        inside = set(self.actors_in(region))
+        outside = [
+            actor for actor in sorted(self.actor_region)
+            if actor not in inside
+        ]
+        links: list[tuple[str, str]] = []
+        for a in sorted(inside):
+            for b in outside:
+                links.append((a, b))
+                links.append((b, a))
+        return links
+
+    def to_dict(self) -> dict:
+        return {
+            "regions": list(self.regions),
+            "seed": self.seed,
+            "frontDoorRegion": self.front_door_region,
+            "latencyMs": {
+                f"{a}|{b}": self.latency_ms(a, b)
+                for i, a in enumerate(self.regions)
+                for b in self.regions[i + 1:]
+            },
+            "actors": dict(sorted(self.actor_region.items())),
+        }
+
+
+__all__ = ["FRONT_DOOR_SRC", "RegionTopology"]

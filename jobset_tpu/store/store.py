@@ -45,12 +45,14 @@ WAL_FILE = "wal.log"
 KINDS = tuple(CODECS)
 
 
-def write_snapshot_file(data_dir: str, doc: dict) -> None:
+def write_snapshot_file(data_dir: str, doc: dict,
+                        filename: str = SNAPSHOT_FILE) -> None:
     """Atomically persist a snapshot document: write-temp, fsync, rename
-    over SNAPSHOT_FILE, fsync the directory — crash-safe at every
-    interleaving. Shared by Store.compact and the HA FollowerLog
-    (install + self-compaction) so the ritual cannot drift."""
-    snapshot_path = os.path.join(data_dir, SNAPSHOT_FILE)
+    over `filename` (default SNAPSHOT_FILE), fsync the directory —
+    crash-safe at every interleaving. Shared by Store.compact, the HA
+    FollowerLog (install + self-compaction) and the shard plane's
+    ShardMap persistence so the ritual cannot drift."""
+    snapshot_path = os.path.join(data_dir, filename)
     tmp_path = snapshot_path + ".tmp"
     try:
         with open(tmp_path, "w") as f:

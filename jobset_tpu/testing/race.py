@@ -25,7 +25,9 @@ block:
 Default watch set: the tree's hot threaded classes — ``Counter``,
 ``Gauge``, ``Histogram`` (core/metrics), ``FlowController`` (flow),
 ``FaultInjector`` (chaos), ``Store`` (store), ``ReplicationCoordinator``
-/ ``FollowerLog`` (ha), ``ControllerServer`` (server). Instances are
+/ ``FollowerLog`` (ha), ``ControllerServer`` (server), ``ShardRouter``
+(shard — the merged-journal state the front door's handler threads and
+the watch pollers share). Instances are
 tracked when constructed **inside** the harness (construct the system
 under test within the ``with`` block); pre-existing instances can be
 ``adopt()``-ed, which also swaps their untracked lock attributes for
@@ -395,6 +397,14 @@ def default_watchlist() -> dict[type, frozenset]:
 
     add(_server, ("_watch_events", "_watch_rv", "_watch_trimmed_rv",
                   "_quorum_rv", "_events_cursor"))
+
+    def _shard_router():
+        from ..shard.router import ShardRouter
+
+        return ShardRouter
+
+    add(_shard_router, ("_events", "_rv", "_trimmed_rv", "_cursors",
+                        "_planned_homes"))
     return out
 
 

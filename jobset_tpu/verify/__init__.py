@@ -25,9 +25,21 @@ guarantees"):
 The partition scenarios (`chaos/scenarios.py`) run the checker as their
 acceptance gate; a deliberately fence-disabled run FAILS it, which is the
 proof the checker has teeth.
+
+`check_sharded_history` generalizes the checker to the sharded control
+plane (docs/sharding.md): every invariant per shard (each shard group is
+its own quorum with its own rv counter) plus cross-shard session
+monotonicity over the front door's merged-journal rvs — the seeded
+region-cut scenario runs it as the gate, and its fence-disabled run
+fails it too.
 """
 
-from .checker import CheckReport, check_history
+from .checker import CheckReport, check_history, check_sharded_history
 from .history import HistoryRecorder
 
-__all__ = ["CheckReport", "HistoryRecorder", "check_history"]
+__all__ = [
+    "CheckReport",
+    "HistoryRecorder",
+    "check_history",
+    "check_sharded_history",
+]

@@ -276,6 +276,75 @@ def _check_linearizable(report, ops, register_key, initial_value) -> None:
         )
 
 
+# -- cross-shard mode (docs/sharding.md) -------------------------------------
+
+
+def check_sharded_history(
+    ops: list[dict],
+    shard_of,
+    final_states: Optional[dict] = None,
+    register_keys: Optional[dict] = None,
+    initial_value: Optional[str] = None,
+) -> CheckReport:
+    """The checker generalized to a sharded control plane: per-shard
+    guarantees plus cross-shard session monotonicity through the router.
+
+    ``shard_of(op)`` maps each op to its scope: an int shard id (the op
+    targeted one shard's keyspace — directly or via the front door's
+    per-key dispatch), or the string ``"router"`` for cross-shard
+    operations (merged LISTs/watches) whose resourceVersions are ROUTER
+    rvs. Scopes must not mix: shard rvs and router rvs are different
+    counters, and a monotonicity check across them would be comparing
+    clocks.
+
+    Per shard: all four single-quorum invariants (durability against
+    that shard's final state, one unfenced leader per term,
+    session-monotonic shard rvs, register linearizability over
+    ``register_keys[shard]``), reported under ``shard{N}:{invariant}``.
+    Router scope: session monotonicity over router rvs — the cross-shard
+    guarantee the merged journal's single rv counter exists to provide
+    (a session that saw merged position R may never be served merged
+    state older than R, whichever shards contributed).
+
+    The combined report is green only when every sub-invariant holds —
+    so a fence-disabled run that lets one shard's deposed leader serve a
+    stale read fails THIS checker too (the teeth contract of
+    docs/sharding.md)."""
+    report = CheckReport()
+    scopes: dict = {}
+    for op in ops:
+        scopes.setdefault(shard_of(op), []).append(op)
+    router_ops = scopes.pop("router", [])
+    for shard in sorted(scopes):
+        sub = check_history(
+            scopes[shard],
+            final_state=(final_states or {}).get(shard),
+            register_key=(register_keys or {}).get(shard),
+            initial_value=initial_value,
+        )
+        for name, verdict in sub.invariants.items():
+            report.invariants[f"shard{shard}:{name}"] = verdict
+        for violation in sub.violations:
+            report.violations.append({**violation, "shard": shard})
+        if not sub.ok:
+            report.ok = False
+        for key, value in sub.stats.items():
+            report.stats[key] = report.stats.get(key, 0) + value
+    _check_session_monotonic(router_report := CheckReport(), router_ops)
+    verdict = router_report.invariants.get(
+        "session_monotonic", {"ok": True, "checked": 0}
+    )
+    report.invariants["cross_shard_session_monotonic"] = verdict
+    for violation in router_report.violations:
+        report.violations.append({**violation, "shard": "router",
+                                  "invariant": "cross_shard_session_monotonic"})
+    if not router_report.ok:
+        report.ok = False
+    report.stats["router_ops"] = len(router_ops)
+    report.stats["shards"] = len(scopes)
+    return report
+
+
 def _wing_gong(entries, initial_value):
     """Wing & Gong search; True / False / "window" (frontier too wide).
 
@@ -320,4 +389,9 @@ def _wing_gong(entries, initial_value):
     return ok
 
 
-__all__ = ["CheckReport", "MAX_WINDOW", "check_history"]
+__all__ = [
+    "CheckReport",
+    "MAX_WINDOW",
+    "check_history",
+    "check_sharded_history",
+]
