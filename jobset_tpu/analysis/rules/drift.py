@@ -22,6 +22,12 @@ the two may not diverge in either direction.
   classification row still covers a served route. Coverage semantics
   come from the runtime's own ``pattern_covers`` (a pure function), so
   the check and the admission path cannot drift.
+* **DRF005** — every alert rule in the telemetry plane's default rule
+  set (``obs/alerts.py::DEFAULT_RULE_SET`` ``"alert"`` entries) has a
+  table row in the "Telemetry & alerting" section of
+  ``docs/observability.md``; every alert name documented there still
+  exists in the default set. Operators triage from that table — a stale
+  name sends them hunting for a rule that no longer fires.
 
 All of them parse the AST rather than importing the scanned modules, so
 the rules also run against fixture trees and never execute project code.
@@ -36,7 +42,7 @@ from typing import Iterator
 
 from ..engine import Finding, register
 
-_METRIC_CLASSES = ("Counter", "Gauge", "Histogram")
+_METRIC_CLASSES = ("Counter", "Gauge", "CallbackGauge", "Histogram")
 _POINT_CALLS = ("check", "consult", "add_rule")
 _POINT_RE = re.compile(r"``([a-z_]+\.[a-z_]+)``")
 _DOC_ROW_RE = re.compile(r"^\|\s*`([A-Za-z0-9_]+)`", re.MULTILINE)
@@ -435,6 +441,89 @@ def classified_routes(root: pathlib.Path) -> dict[str, tuple[str, int]]:
                     elt.elts[0].value, (elt.elts[1].value, elt.lineno)
                 )
     return rows
+
+
+# -- DRF005: default alert rules ---------------------------------------------
+
+
+def declared_alert_rules(root: pathlib.Path) -> dict[str, int]:
+    """alert name -> line of its ``"alert": "..."`` entry inside the
+    DEFAULT_RULE_SET literal of obs/alerts.py (static parse — the rule
+    set is a pure literal by contract, so the dict walk sees every
+    name)."""
+    src = root / "jobset_tpu" / "obs" / "alerts.py"
+    tree = _parse(src)
+    if tree is None:
+        return {}
+    alerts: dict[str, int] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        value = getattr(node, "value", None)
+        if not isinstance(value, ast.Dict):
+            continue
+        targets = (
+            node.targets if isinstance(node, ast.Assign)
+            else [node.target]
+        )
+        if "DEFAULT_RULE_SET" not in {
+            t.id for t in targets if isinstance(t, ast.Name)
+        }:
+            continue
+        for sub in ast.walk(value):
+            if not isinstance(sub, ast.Dict):
+                continue
+            for key, val in zip(sub.keys, sub.values):
+                if (
+                    isinstance(key, ast.Constant)
+                    and key.value == "alert"
+                    and isinstance(val, ast.Constant)
+                    and isinstance(val.value, str)
+                ):
+                    alerts.setdefault(val.value, key.lineno)
+    return alerts
+
+
+@register
+class AlertRuleDocDriftRule:
+    NAME = "DRF005"
+    DESCRIPTION = (
+        "alert rule in obs/alerts.py::DEFAULT_RULE_SET without a "
+        "docs/observability.md 'Telemetry & alerting' table row (or a "
+        "documented alert name no default rule defines)"
+    )
+
+    def check_project(self, root: pathlib.Path) -> Iterator[Finding]:
+        declared = declared_alert_rules(root)
+        if not declared:
+            return
+        docs = root / "docs" / "observability.md"
+        documented = _section_rows(docs, "Telemetry & alerting")
+        for name, line in sorted(declared.items()):
+            if name not in documented:
+                yield Finding(
+                    rule=self.NAME,
+                    path=_rel(
+                        root / "jobset_tpu" / "obs" / "alerts.py", root
+                    ),
+                    line=line,
+                    message=(
+                        f"default alert rule `{name}` has no row in the "
+                        "'Telemetry & alerting' table of "
+                        "docs/observability.md — operators triage from "
+                        "that table"
+                    ),
+                )
+        for name, line in sorted(documented.items()):
+            if name not in declared:
+                yield Finding(
+                    rule=self.NAME, path=_rel(docs, root), line=line,
+                    message=(
+                        f"docs/observability.md documents alert `{name}` "
+                        "but DEFAULT_RULE_SET defines no such rule — "
+                        "stale triage row, drop or fix it"
+                    ),
+                )
 
 
 @register

@@ -424,14 +424,22 @@ def ha_write_attempt(address: str, name: str, timeout: float = 5.0):
 
 
 def _ha_write_storm(replica_set, writes: int, kill_after: Optional[int],
-                    kill, clock=None, start: int = 0) -> dict:
+                    kill, clock=None, start: int = 0,
+                    on_ack=None) -> dict:
     """Sequential suspended-JobSet creates against the replica set's
     serving address, retrying through failovers. `kill(replica_set)` fires
     after the `kill_after`-th CLEAN acknowledgement (a 2xx without a
     Warning header — the majority-acknowledged contract). Sequential,
     ack-gated writes keep every uid/resourceVersion assignment — and
     every per-point chaos arrival — a pure function of the write index,
-    which is what makes two seeded runs byte-identical."""
+    which is what makes two seeded runs byte-identical.
+
+    ``on_ack(name, latency_s, write_retries)`` fires after every clean
+    acknowledgement with the client-observed wall ack latency (first
+    attempt -> clean 201) and the number of failed attempts this write
+    rode through — the telemetry teeth's SLO observation point
+    (``write_retries`` is the deterministic signal: wall latency across
+    a failover depends on the lease's renewal phase)."""
     import time as _t
 
     def attempt(name: str):
@@ -444,10 +452,14 @@ def _ha_write_storm(replica_set, writes: int, kill_after: Optional[int],
     for i in range(start, start + writes):
         name = f"ha-{i:03d}"
         outage_started = None
+        write_started = _t.monotonic()
+        write_retries = 0
+        acked_clean = False
         while True:
             status, warning = attempt(name)
             if status == 201 and warning is None:
                 acked.append(name)
+                acked_clean = True
                 break
             if status == 409:
                 # A retried create that actually landed before the ack was
@@ -455,6 +467,7 @@ def _ha_write_storm(replica_set, writes: int, kill_after: Optional[int],
                 # clean ack (same commit stream) covers its durability.
                 break
             retries += 1
+            write_retries += 1
             if outage_started is None:
                 outage_started = _t.monotonic()
             replica_set.step()
@@ -463,6 +476,8 @@ def _ha_write_storm(replica_set, writes: int, kill_after: Optional[int],
             _t.sleep(0.02)
         if outage_started is not None:
             unavailable_s += _t.monotonic() - outage_started
+        if acked_clean and on_ack is not None:
+            on_ack(name, _t.monotonic() - write_started, write_retries)
         if (
             kill_after is not None
             and (i - start) + 1 == kill_after
@@ -498,8 +513,25 @@ def leader_kill(
     Returns the acked-write list, the final serialized store state of the
     surviving leader, and the injector's log — a run with `kill=False` is
     the no-kill baseline the caller asserts byte-identity against (zero
-    majority-acknowledged JobSets lost)."""
+    majority-acknowledged JobSets lost).
+
+    The telemetry plane rides along as teeth (docs/observability.md): a
+    ``Telemetry`` on its OWN FakeClock ticks once per acknowledged write,
+    each ack observed into ``jobset_slo_time_to_admission_seconds`` as
+    its MODELED client latency — 0 for a write acked on the first
+    attempt (in-process acks are instant at storm timescale), the lease
+    duration for a write that rode the failover (the client's exposure
+    window; wall retry timing depends on the lease's renewal phase, and
+    seeded teeth must classify good/bad identically on every run). A
+    kill run therefore fires ``JobSetControlPlaneFailover`` plus the SLO
+    fast-burn alert while the ``kill=False`` baseline fires NOTHING, and
+    the ``alerts`` transition log in the result is byte-identical across
+    two seeded runs (transition timestamps are FakeClock tick
+    indices)."""
+    from ..core import metrics
     from ..ha import ReplicaSet
+    from ..obs.tsdb import Telemetry
+    from ..utils.clock import FakeClock
 
     injector = FaultInjector(seed=seed)
     if stream_latency_rate > 0:
@@ -518,11 +550,28 @@ def leader_kill(
         # gate (kill vs no-kill final state) runs on the mirror.
         cluster_factory=_columnar_cluster,
     ).start()
+    tel_clock = FakeClock(0.0)
+    telemetry = Telemetry(clock=tel_clock, interval=1.0)
     try:
+        # Baseline sample at t=0, then one tick per acked write at t=1,
+        # 2, ... — tick times are write indices, not wall time, so the
+        # alert transition log is a pure function of the seed.
+        telemetry.tick()
+
+        lease_duration = replica_set.replicas[0].elector.lease_duration
+
+        def on_ack(name: str, latency_s: float, write_retries: int) -> None:
+            metrics.slo_time_to_admission_seconds.observe(
+                0.0 if write_retries == 0 else lease_duration
+            )
+            tel_clock.advance(1.0)
+            telemetry.tick()
+
         result = _ha_write_storm(
             replica_set, writes,
             kill_after if kill else None,
             lambda rs: rs.kill_leader(),
+            on_ack=on_ack,
         )
         leader = replica_set.leader()
         result.update({
@@ -536,6 +585,8 @@ def leader_kill(
             "commit_seq": leader.store.commit_seq,
             "resource_version": leader.store.resource_version,
             "injection_log": injector.log_snapshot(),
+            "alerts": telemetry.alerts.transition_log(),
+            "alerts_firing": telemetry.alerts.firing(),
         })
         return result
     finally:
@@ -609,6 +660,7 @@ def thundering_herd(
     from ..api import serialization
     from ..core import make_cluster
     from ..flow import FlowController
+    from ..obs.tsdb import Telemetry
     from ..server import ControllerServer
     from ..testing import make_jobset, make_replicated_job
     from ..utils.clock import FakeClock
@@ -632,6 +684,12 @@ def thundering_herd(
     )
     api = f"{server.API_PREFIX}/namespaces/default/jobsets"
     rng = random.Random(seed)
+    # Telemetry teeth on the SAME virtual clock: one tick per arrival at
+    # 0.25 s spacing (4 arrivals/s — herd pacing, so the storm's shed
+    # rate clears the default alert's 1/s threshold while the recover
+    # tail keeps it firing inside the 60 s rate window). Every tick time,
+    # sample, and alert transition is a pure function of the seed.
+    telemetry = Telemetry(clock=cluster.clock, interval=0.25)
 
     def jobset_body(name: str, priority) -> bytes:
         js = (
@@ -687,8 +745,11 @@ def thundering_herd(
         per[status] = per.get(status, 0) + 1
         if op.startswith("create"):
             (acked_creates if status == 201 else shed_creates).append(name)
+        cluster.clock.advance(0.25)
+        telemetry.tick()
 
     try:
+        telemetry.tick()  # t=0 baseline sample before the storm
         held_low = flow.hold("workload-low", 2)
         held_high = flow.hold("workload-high", 2)
         held_watch = flow.hold("watch", 1)
@@ -744,6 +805,8 @@ def thundering_herd(
         "decision_log": flow.log_snapshot(),
         "injection_log": injector.log_snapshot(),
         "final_state": final_state,
+        "alerts": telemetry.alerts.transition_log(),
+        "alerts_firing": telemetry.alerts.firing(),
     }
 
 

@@ -171,6 +171,21 @@ def _build_parser() -> argparse.ArgumentParser:
                         "front door)")
     c.add_argument("--shard-replicas", type=int, default=3,
                    help="replicas per shard group (--shards mode)")
+    c.add_argument("--telemetry", action="store_true",
+                   help="enable the embedded telemetry TSDB + rule "
+                        "engine: the registry is sampled every "
+                        "--telemetry-interval seconds, recording/alert "
+                        "rules evaluate each tick, and /debug/tsdb + "
+                        "/debug/alerts serve the history "
+                        "(docs/observability.md)")
+    c.add_argument("--telemetry-interval", type=float, default=5.0,
+                   metavar="SECONDS",
+                   help="sampler tick interval for --telemetry")
+    c.add_argument("--rules", default="", metavar="FILE",
+                   help="recording + alert rule file (YAML/JSON, the "
+                        "Prometheus groups/rules shape) for --telemetry; "
+                        "default: the built-in rule set (failover, "
+                        "shed-rate, SLO burn-rate alerts)")
     c.add_argument("--peer-timeout", type=float, default=5.0,
                    help="per-call timeout for replication RPCs to peers "
                         "(--replicate)")
@@ -226,6 +241,29 @@ def _build_parser() -> argparse.ArgumentParser:
     db.add_argument("output", metavar="OUT.tgz",
                     help="path of the .tgz bundle to write")
     _add_server_flag(db)
+
+    top = sub.add_parser(
+        "top",
+        help="current rates from the controller's telemetry TSDB "
+             "(requires a controller running with --telemetry)",
+    )
+    top.add_argument("resource", choices=["jobsets", "shards"])
+    top.add_argument("--window", default="300s",
+                     help="rate window (default 300s)")
+    _add_server_flag(top)
+
+    tr = sub.add_parser(
+        "traces",
+        help="recent finished traces from GET /debug/traces",
+    )
+    tr.add_argument("--limit", type=int, default=10,
+                    help="max traces (0 = the whole ring)")
+    tr.add_argument("--phase", default="",
+                    help="only traces containing a span with this name "
+                         "(e.g. queue.admission, placement.solve)")
+    tr.add_argument("-o", "--output", choices=["wide", "json"],
+                    default="wide")
+    _add_server_flag(tr)
 
     d = sub.add_parser("delete", help="delete a jobset")
     d.add_argument("name")
@@ -426,10 +464,12 @@ def _cmd_controller(args) -> int:
         from .flow import FlowController
 
         flow = FlowController(seed=args.flow_seed)
+    telemetry = _make_telemetry(args, cluster)
     server = ControllerServer(args.addr, cluster=cluster,
                               tick_interval=args.tick_interval,
                               tls_cert=tls_cert, tls_key=tls_key,
                               elector=elector, flow=flow,
+                              telemetry=telemetry,
                               # Separate-process replicas have private
                               # state: a standby must not accept writes the
                               # leader would never observe.
@@ -440,12 +480,16 @@ def _cmd_controller(args) -> int:
           + (f", leader-elect as {elector.identity}" if elector else "")
           + (f", data-dir {args.data_dir}" if store is not None else "")
           + (", flow-control on" if flow is not None else "")
+          + (f", telemetry every {args.telemetry_interval:g}s"
+             if telemetry is not None else "")
           + ")",
           flush=True)
     _wait_for_signal()
     # Graceful drain (SIGTERM/Ctrl-C): fence writes (503 + Retry-After),
     # run one final pump, flush/fsync the WAL, release the leader lease —
     # then close the listener and exit 0.
+    if telemetry is not None:
+        telemetry.stop()
     server.drain()
     server.stop()
     if store is not None:
@@ -507,14 +551,42 @@ def _cmd_controller_sharded(args) -> int:
         address=args.addr,
         flow=flow,
     )
+    # Telemetry hangs off the front door (no cluster of its own): the
+    # sampler sees the process-global registry — which IS the whole
+    # fleet's, all shards being in-process — and /debug/tsdb?view=fleet
+    # federates per-replica series through the router regardless.
+    telemetry = _make_telemetry(args, None)
+    if telemetry is not None:
+        plane.front_door.telemetry = telemetry
     plane.start_supervisor()
     print(f"sharded control plane: front door on http://{plane.address}, "
           f"{args.shards} shard group(s) x {args.shard_replicas} "
           f"replicas over regions {', '.join(regions)} "
-          f"(map at /debug/shards)", flush=True)
+          f"(map at /debug/shards"
+          + (", telemetry at /debug/tsdb" if telemetry is not None else "")
+          + ")", flush=True)
     _wait_for_signal()
+    if telemetry is not None:
+        telemetry.stop()
     plane.stop()
     return 0
+
+
+def _make_telemetry(args, cluster):
+    """Build + start the wall-clock telemetry plane when --telemetry is
+    set (None otherwise). ``cluster`` receives alert transition events;
+    the live paths run real Clock()s, so the sampler thread drives
+    ticks."""
+    if not getattr(args, "telemetry", False):
+        return None
+    from .obs.tsdb import Telemetry
+
+    return Telemetry(
+        clock=cluster.clock if cluster is not None else None,
+        interval=args.telemetry_interval,
+        cluster=cluster,
+        rules_path=args.rules or None,
+    ).start()
 
 
 def _make_controller_cluster(args):
@@ -670,6 +742,13 @@ def _cmd_controller_replicated(args) -> int:
     stopping: list = []
     signal.signal(signal.SIGTERM, lambda *a: stopping.append(1))
 
+    # One telemetry plane for the replica's whole lifetime: the TSDB
+    # rides through standby<->leader transitions (that history — the
+    # failover spike, the burn window around it — is exactly what it
+    # exists to keep). Alert events are pointed at whichever cluster is
+    # currently serving, at each promotion.
+    telemetry = _make_telemetry(args, None)
+
     def start_standby(log):
         server = ControllerServer(
             args.addr,
@@ -678,6 +757,7 @@ def _cmd_controller_replicated(args) -> int:
             elector=elector,
             standby_accepts_writes=False,
             replication=log,
+            telemetry=telemetry,
         ).start()
         print(f"replica {identity} standing by on {server.address} "
               f"(quorum {majority_of(cluster_size)}/{cluster_size}, peers: "
@@ -754,6 +834,10 @@ def _cmd_controller_replicated(args) -> int:
                 from .core import metrics as _metrics
 
                 _metrics.ha_failovers_total.inc()
+            if telemetry is not None:
+                # Alert transitions record events into whichever cluster
+                # is serving; repoint at the fresh promotion replay.
+                telemetry.alerts.cluster = cluster
             server = ControllerServer(
                 args.addr,
                 cluster=cluster,
@@ -761,6 +845,7 @@ def _cmd_controller_replicated(args) -> int:
                 elector=elector,
                 standby_accepts_writes=False,
                 replication=coordinator,
+                telemetry=telemetry,
             ).start()
             print(f"replica {identity} LEADING on {server.address} "
                   f"(term {elector.term}, {rstats.get('objects', 0)} "
@@ -772,6 +857,8 @@ def _cmd_controller_replicated(args) -> int:
                 if coordinator.fenced or coordinator.lost_quorum:
                     break
             if stopping:
+                if telemetry is not None:
+                    telemetry.stop()
                 server.drain()
                 server.stop()
                 store.close()
@@ -790,6 +877,8 @@ def _cmd_controller_replicated(args) -> int:
             standby = start_standby(follower_log)
     except KeyboardInterrupt:
         pass
+    if telemetry is not None:
+        telemetry.stop()
     standby.stop()
     follower_log.close()
     return 0
@@ -1303,6 +1392,93 @@ def _cmd_lint(args) -> int:
     return 1 if report.visible else 0
 
 
+def _cmd_top(args) -> int:
+    """`top jobsets|shards`: current rates out of the controller's
+    embedded TSDB — PromQL-lite instant queries against /debug/tsdb
+    (docs/observability.md), rendered kubectl-top style."""
+    from .client import ApiError
+
+    client = _client(args)
+    w = args.window
+    if args.resource == "jobsets":
+        key = "jobset"
+        columns = [
+            ("RESTARTS/S", f"sum by (jobset) (rate(jobset_restarts_total[{w}]))"),
+            ("COMPLETED/S", f"sum by (jobset) (rate(jobset_completed_total[{w}]))"),
+            ("FAILED/S", f"sum by (jobset) (rate(jobset_failed_total[{w}]))"),
+        ]
+    else:
+        key = "shard"
+        columns = [
+            ("REQUESTS/S", f"sum by (shard) (rate(jobset_shard_requests_total[{w}]))"),
+            ("UNROUTABLE/S", f"sum by (shard) (rate(jobset_shard_unroutable_total[{w}]))"),
+        ]
+    rows: dict[str, dict[str, float]] = {}
+    try:
+        for title, query in columns:
+            for item in client.tsdb(query=query).get("result", []):
+                name = item["labels"].get(key, "") or "(none)"
+                rows.setdefault(name, {})[title] = item["value"]
+    except ApiError as exc:
+        if exc.status == 404:
+            print("telemetry is not enabled on this controller "
+                  "(start it with --telemetry)", file=sys.stderr)
+            return 1
+        if exc.status == 400:
+            print(f"query rejected: {exc.message}", file=sys.stderr)
+            return 1
+        raise
+    header = f"{key.upper():24} " + " ".join(
+        f"{title:>12}" for title, _ in columns
+    )
+    print(header)
+    # Hottest first: sort by the first column's rate, then name.
+    first = columns[0][0]
+    for name in sorted(rows, key=lambda n: (-rows[n].get(first, 0.0), n)):
+        print(f"{name:24} " + " ".join(
+            f"{rows[name].get(title, 0.0):>12.3f}" for title, _ in columns
+        ))
+    if not rows:
+        print(f"(no {key} series in the TSDB yet — rates appear one "
+              f"sampler tick after activity)")
+    return 0
+
+
+def _cmd_traces(args) -> int:
+    """`traces`: recent finished traces from /debug/traces, with the
+    server-side --limit/--phase filters passed through."""
+    data = _client(args).traces(limit=args.limit, phase=args.phase or None)
+    if args.output == "json":
+        print(json.dumps(data, indent=2))
+        return 0
+    traces = data.get("traces", [])
+    print(f"{'TRACE':18} {'ROOT':28} {'SPANS':>5} {'DURATION':>10}")
+    for trace in traces:
+        spans = trace.get("spans", [])
+        root = next(
+            (s for s in spans if not s.get("parent_span_id")),
+            spans[0] if spans else {},
+        )
+        # Trace duration = the whole span envelope, not just the root
+        # (a recovery trace roots fast and tails long).
+        start = min((s["start_unix_s"] for s in spans), default=0.0)
+        end = max(
+            (s["start_unix_s"] + s["duration_ms"] / 1000.0 for s in spans),
+            default=0.0,
+        )
+        print(f"{trace.get('trace_id', '')[:16]:18} "
+              f"{root.get('name', '-'):28} {len(spans):>5} "
+              f"{(end - start) * 1000:>8.2f}ms")
+    dropped = data.get("dropped_spans", 0)
+    if dropped:
+        print(f"({dropped} spans dropped by the bounded ring)")
+    if not traces:
+        print("(no finished traces"
+              + (f" with a {args.phase!r} span" if args.phase else "")
+              + ")")
+    return 0
+
+
 _COMMANDS = {
     "controller": _cmd_controller,
     "lint": _cmd_lint,
@@ -1318,6 +1494,8 @@ _COMMANDS = {
     "label-nodes": _cmd_label_nodes,
     "worker": _cmd_worker,
     "policy": _cmd_policy,
+    "top": _cmd_top,
+    "traces": _cmd_traces,
 }
 
 

@@ -15,6 +15,7 @@ import random
 import threading
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
 from typing import Optional
 
@@ -884,10 +885,49 @@ class JobSetClient:
         an overall healthy/degraded verdict."""
         return self._request("GET", "/debug/health")
 
-    def traces(self, limit: int = 64) -> dict:
+    def traces(self, limit: int = 64, phase: Optional[str] = None) -> dict:
         """`/debug/traces`: recent finished traces (limit=0 for the whole
-        ring) plus the dropped-span counter."""
-        return self._request("GET", f"/debug/traces?limit={int(limit)}")
+        ring) plus the dropped-span counter. ``phase`` keeps only traces
+        containing a span of that name (limit applies after the filter)."""
+        path = f"/debug/traces?limit={int(limit)}"
+        if phase is not None:
+            path += f"&phase={urllib.parse.quote(phase)}"
+        return self._request("GET", path)
+
+    def tsdb(self, query: Optional[str] = None,
+             start: Optional[float] = None, end: Optional[float] = None,
+             name: Optional[str] = None) -> dict:
+        """`/debug/tsdb`: with ``query``, a PromQL-lite evaluation
+        (instant at the telemetry clock's now, or a stepped range when
+        ``start``/``end`` are given); without, the deterministic series
+        dump the debug bundle captures."""
+        params = []
+        if query is not None:
+            params.append(f"query={urllib.parse.quote(query)}")
+        if start is not None:
+            params.append(f"start={start:g}")
+        if end is not None:
+            params.append(f"end={end:g}")
+        if name is not None:
+            params.append(f"name={urllib.parse.quote(name)}")
+        path = "/debug/tsdb"
+        if params:
+            path += "?" + "&".join(params)
+        return self._request("GET", path)
+
+    def fleet_series(self, name: Optional[str] = None) -> dict:
+        """`/debug/tsdb?view=fleet`: the shard front door's federated
+        fleet view — every shard replica's current series merged and
+        stamped with shard/replica/role labels."""
+        path = "/debug/tsdb?view=fleet"
+        if name is not None:
+            path += f"&name={urllib.parse.quote(name)}"
+        return self._request("GET", path)
+
+    def alerts(self) -> dict:
+        """`/debug/alerts`: configured alert rules, active
+        pending/firing alerts, and the bounded transition log."""
+        return self._request("GET", "/debug/alerts")
 
 
 # ---------------------------------------------------------------------------

@@ -19,6 +19,7 @@ from __future__ import annotations
 import math
 import threading
 import time
+import weakref
 from collections import defaultdict
 
 from ..obs.trace import current_trace_id
@@ -70,6 +71,76 @@ class Gauge:
     def value(self, *labels) -> float:
         with self._lock:
             return self._values.get(labels, 0.0)
+
+    def collect(self) -> list[tuple[tuple, float]]:
+        """Sorted (labels, value) snapshot — the one seam both the text
+        exposition and the telemetry sampler read through, so a subclass
+        that pulls its value at collect time changes every consumer at
+        once."""
+        with self._lock:
+            return sorted(self._values.items())
+
+
+class CallbackGauge(Gauge):
+    """Gauge whose value is pulled from its owner at collect time (scrape
+    or TSDB sample) instead of pushed at every mutation site.
+
+    Push-site gauges go stale between pushes and force the owning
+    subsystem to remember every code path that changes the value (the WAL
+    gauge had four push sites; a forgotten one is a silent staleness
+    window). ``bind(owner, provider)`` registers ``provider(owner)`` as
+    the authoritative source; the owner is held by weakref so a dead
+    subsystem silently unbinds instead of keeping itself alive through
+    the process-global registry. The provider may return a scalar (for
+    unlabeled gauges) or an iterable of ``(labels_tuple, value)`` pairs.
+    Pushed values remain the fallback while unbound."""
+
+    def __init__(self, name: str, help_text: str = "", label_names: tuple = ()):
+        super().__init__(name, help_text, label_names)
+        self._owner = None  # guarded-by: _lock (slot swap only)
+        self._provider = None  # guarded-by: _lock (slot swap only)
+
+    def bind(self, owner, provider) -> None:
+        ref = weakref.ref(owner)
+        with self._lock:
+            self._owner = ref
+            self._provider = provider
+
+    def unbind(self, owner=None) -> None:
+        """Drop the binding (only if still owned by ``owner`` when given)."""
+        with self._lock:
+            if owner is not None and self._owner is not None:
+                if self._owner() is not owner:
+                    return
+            self._owner = None
+            self._provider = None
+
+    def collect(self) -> list[tuple[tuple, float]]:
+        # Snapshot the binding under the lock but invoke the provider
+        # OUTSIDE it: providers read live subsystem state and must not
+        # couple this gauge's lock into subsystem lock orders.
+        with self._lock:
+            ref, provider = self._owner, self._provider
+            pushed = sorted(self._values.items())
+        owner = ref() if ref is not None else None
+        if provider is None or owner is None:
+            return pushed
+        try:
+            pulled = provider(owner)
+        except Exception:
+            # A mid-teardown owner must degrade the scrape, not 500 it.
+            return pushed
+        if pulled is None:
+            return pushed
+        if isinstance(pulled, (int, float)):
+            return [((), float(pulled))]
+        return sorted((tuple(labels), float(v)) for labels, v in pulled)
+
+    def value(self, *labels) -> float:
+        for got, v in self.collect():
+            if got == labels:
+                return v
+        return 0.0
 
 
 class Histogram:
@@ -234,14 +305,17 @@ chaos_partition_blocked_total = Counter(
 )
 # Gang admission queue plane (queue/manager.py): workload population per
 # queue plus the preemption counter the eviction path bumps.
-queue_pending_workloads = Gauge(
+queue_pending_workloads = CallbackGauge(
     "jobset_queue_pending_workloads",
-    "Queue-managed JobSets waiting for admission, per queue",
+    "Queue-managed JobSets waiting for admission, per queue "
+    "(collect-time callback: counted from the live queue manager at "
+    "scrape, never pushed)",
     label_names=("queue",),
 )
-queue_admitted_workloads = Gauge(
+queue_admitted_workloads = CallbackGauge(
     "jobset_queue_admitted_workloads",
-    "Queue-managed JobSets currently admitted (holding quota), per queue",
+    "Queue-managed JobSets currently admitted (holding quota), per queue "
+    "(collect-time callback)",
     label_names=("queue",),
 )
 queue_preemptions_total = Counter(
@@ -253,10 +327,11 @@ queue_preemptions_total = Counter(
 # Durable control-plane store (store/ subsystem, docs/persistence.md):
 # WAL growth, compaction/recovery latency, and the commit/error counters
 # the chaos plane's store.write faults exercise.
-store_wal_bytes = Gauge(
+store_wal_bytes = CallbackGauge(
     "jobset_store_wal_bytes",
     "Durable byte size of the current write-ahead log segment (drops to 0 "
-    "at each snapshot compaction)",
+    "at each snapshot compaction; collect-time callback bound to the "
+    "serving store)",
 )
 store_commits_total = Counter(
     "jobset_store_commits_total",
@@ -452,6 +527,39 @@ shard_resolves_total = Counter(
     "cut/heal) run through the assignment-solver cost model",
 )
 
+# Telemetry time-series plane (jobset_tpu/obs/tsdb.py + rules.py +
+# alerts.py, docs/observability.md): the embedded TSDB that samples this
+# registry on the cluster clock, and the alert state machine it drives.
+telemetry_samples_total = Counter(
+    "jobset_telemetry_samples_total",
+    "Samples appended to the embedded TSDB across all series (one per "
+    "series per sampler tick)",
+    label_names=(),
+)
+telemetry_rule_evals_total = Counter(
+    "jobset_telemetry_rule_evals_total",
+    "Recording + alert rule evaluation passes run by the telemetry "
+    "plane's rule engine (one per sampler tick with rules loaded)",
+    label_names=(),
+)
+telemetry_series = CallbackGauge(
+    "jobset_telemetry_series",
+    "Live series count held by the embedded TSDB (collect-time callback "
+    "bound to the store; 0 when telemetry is disabled)",
+)
+alerts_firing = Gauge(
+    "jobset_alerts_firing",
+    "1 per alert rule currently firing, 0 once it resolves (rows appear "
+    "on the first transition; GET /debug/alerts carries the full state)",
+    label_names=("alertname",),
+)
+alerts_transitions_total = Counter(
+    "jobset_alerts_transitions_total",
+    "Alert state-machine transitions per alert rule and entered state "
+    "(pending/firing/resolved)",
+    label_names=("alertname", "state"),
+)
+
 
 def set_build_info(version: str, backend: str, gates: str,
                    role: str = "single", term: int = 0) -> None:
@@ -491,6 +599,9 @@ ALL_COUNTERS = (
     shard_unroutable_total,
     shard_misroutes_total,
     shard_resolves_total,
+    telemetry_samples_total,
+    telemetry_rule_evals_total,
+    alerts_transitions_total,
 )
 ALL_HISTOGRAMS = (
     reconcile_time_seconds,
@@ -520,7 +631,66 @@ ALL_GAUGES = (
     policy_model_loaded,
     flow_inflight,
     shard_count,
+    telemetry_series,
+    alerts_firing,
 )
+
+# Histograms whose full bucket ladders are sampled into the telemetry
+# TSDB (histogram_quantile()/slo_burn_rate() need the cumulative bucket
+# series over time). Every histogram's _sum/_count is always sampled;
+# sampling all 34 buckets of all nine families would triple the series
+# population for ladders nothing queries, so the bucket set is opt-in.
+SAMPLED_BUCKET_HISTOGRAMS = (
+    reconcile_time_seconds,
+    slo_time_to_admission_seconds,
+    slo_time_to_ready_seconds,
+    slo_restart_recovery_seconds,
+    flow_queue_wait_seconds,
+)
+
+
+def sample_registry() -> list[tuple[str, tuple, float]]:
+    """One flat sample of the whole registry for the telemetry TSDB:
+    ``(series_name, ((label, value), ...), sample_value)`` triples, in
+    registry order with children label-sorted — the same deterministic
+    order the text exposition renders.
+
+    Unlabeled counters with no increments yet are sampled at 0 (matching
+    the exposition's ``{name} 0`` row) so delta functions see the series
+    from the first tick rather than at its first increment; labeled
+    families simply have no children to sample until one appears."""
+    out: list[tuple[str, tuple, float]] = []
+    for c in ALL_COUNTERS:
+        with c._lock:
+            values = sorted(c._values.items())
+        if not values and not c.label_names:
+            out.append((c.name, (), 0.0))
+        for labels, value in values:
+            out.append((c.name, tuple(zip(c.label_names, labels)), value))
+    for g in ALL_GAUGES:
+        values = g.collect()
+        if not values and not g.label_names:
+            out.append((g.name, (), 0.0))
+        for labels, value in values:
+            out.append((g.name, tuple(zip(g.label_names, labels)), value))
+    for h in ALL_HISTOGRAMS:
+        with h._lock:
+            counts, total, n = list(h.counts), h.sum, h.n
+        if h in SAMPLED_BUCKET_HISTOGRAMS:
+            cumulative = 0
+            for bound, count in zip(h.buckets, counts):
+                cumulative += count
+                out.append(
+                    (f"{h.name}_bucket", (("le", f"{bound:g}"),),
+                     float(cumulative))
+                )
+            out.append(
+                (f"{h.name}_bucket", (("le", "+Inf"),),
+                 float(cumulative + counts[-1]))
+            )
+        out.append((f"{h.name}_sum", (), float(total)))
+        out.append((f"{h.name}_count", (), float(n)))
+    return out
 
 
 def _render_exemplar(exemplar: tuple[str, float, float] | None) -> str:
@@ -571,8 +741,7 @@ def render_prometheus(openmetrics: bool = False) -> str:
     for g in ALL_GAUGES:
         lines.append(f"# HELP {g.name} {g.help}")
         lines.append(f"# TYPE {g.name} gauge")
-        with g._lock:
-            values = sorted(g._values.items())
+        values = g.collect()
         if not values:
             lines.append(f"{g.name} 0")
         for labels, value in values:
@@ -625,6 +794,13 @@ def reset() -> None:
     for gauge in ALL_GAUGES:
         with gauge._lock:
             gauge._values.clear()
+            if isinstance(gauge, CallbackGauge):
+                # Drop bindings too: a provider left behind by a previous
+                # case's (dead but uncollected) subsystem would leak its
+                # values into the next case's scrape. Live subsystems are
+                # constructed per test and re-bind on construction.
+                gauge._owner = None
+                gauge._provider = None
     for hist in ALL_HISTOGRAMS:
         with hist._lock:
             hist.counts = [0] * len(hist.counts)

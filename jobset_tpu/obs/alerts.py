@@ -1,0 +1,274 @@
+"""Alert state machine + the default rule set for the telemetry plane.
+
+``AlertManager`` runs the declarative alert rules (``obs/rules.py``)
+against the embedded TSDB each telemetry tick and drives each
+(alertname, labelset) through the Prometheus state machine:
+
+    inactive -> pending (expr true, ``for:`` not yet elapsed)
+             -> firing  (expr true for >= ``for:``)
+             -> resolved (expr false again) -> inactive
+
+Every transition is appended to a bounded log (``GET /debug/alerts``,
+debug bundles), bumps ``jobset_alerts_transitions_total`` /
+``jobset_alerts_firing``, and — when a cluster is attached — lands as a
+first-class cluster event (kind ``Alert``), so alert flaps interleave
+into per-JobSet timelines next to the reconcile/chaos entries that
+caused them. A pending alert whose expression goes false before ``for:``
+elapses returns to inactive silently (the Prometheus behavior: it never
+fired, so there is nothing to resolve).
+
+Transition timestamps come from the telemetry tick's clock — virtual in
+simulation, so the whole log is byte-identical across seeded runs (the
+chaos teeth in ``chaos/scenarios.py`` assert exactly that).
+
+The default rule set below is the drift-checked source of truth: lint
+rule DRF005 (``analysis/rules/drift.py``) fails the tier-1 gate if
+docs/observability.md names an alert that does not exist here, or here
+gains an alert the docs never mention.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+from ..api import keys
+
+STATE_PENDING = "pending"
+STATE_FIRING = "firing"
+STATE_RESOLVED = "resolved"
+
+# SLO burn-rate objective for the admission-path latency SLO: creation ->
+# admission acknowledged within OBJECTIVE_S at TARGET availability. The
+# objective snaps up to the enclosing histogram bucket bound (0.256 s on
+# the half-power-of-two ladder).
+SLO_ADMISSION_OBJECTIVE_S = 0.25
+SLO_ADMISSION_TARGET = 0.99
+
+# The default rule set (a plain literal: DRF005 parses it statically).
+# Burn-rate alerts follow the SRE-workbook multi-window shape: the fast
+# pair (short + long window, high factor) catches cliff burns within a
+# minute; the slow pair (longer windows, low factor) catches simmering
+# burns without paging on blips. Windows are sized for the sim's 1 s
+# virtual ticks and a live controller's 5 s interval alike.
+DEFAULT_RULE_SET = {
+    "groups": [
+        {
+            "name": "jobset-telemetry-defaults",
+            "rules": [
+                {
+                    "record": "jobset:flow_rejected:rate1m",
+                    "expr": "sum(rate(jobset_flow_rejected_total[60s]))",
+                },
+                {
+                    "record": "jobset:restarts:rate5m",
+                    "expr":
+                        "sum by (jobset) "
+                        "(rate(jobset_restarts_total[300s]))",
+                },
+                {
+                    "alert": "JobSetControlPlaneFailover",
+                    "expr": "increase(jobset_ha_failovers_total[300s]) > 0",
+                    "for": "0s",
+                    "labels": {"severity": "page"},
+                    "annotations": {
+                        "summary":
+                            "a standby replica completed leader failover "
+                            "in the last 5m",
+                    },
+                },
+                {
+                    "alert": "JobSetFlowShedRateHigh",
+                    "expr":
+                        "sum(rate(jobset_flow_rejected_total[60s])) > 1",
+                    "for": "0s",
+                    "labels": {"severity": "ticket"},
+                    "annotations": {
+                        "summary":
+                            "the flow-control plane is shedding more than "
+                            "1 req/s (429/watch_busy) over the last minute",
+                    },
+                },
+                {
+                    "alert": "JobSetSLOAdmissionFastBurn",
+                    "expr":
+                        "slo_burn_rate(jobset_slo_time_to_admission_seconds"
+                        ", 0.25, 0.99, 60s) > 2 and "
+                        "slo_burn_rate(jobset_slo_time_to_admission_seconds"
+                        ", 0.25, 0.99, 300s) > 2",
+                    "for": "0s",
+                    "labels": {"severity": "page"},
+                    "annotations": {
+                        "summary":
+                            "admission latency is burning the 99% SLO "
+                            "error budget at >2x in both the 1m and 5m "
+                            "windows",
+                    },
+                },
+                {
+                    "alert": "JobSetSLOAdmissionSlowBurn",
+                    "expr":
+                        "slo_burn_rate(jobset_slo_time_to_admission_seconds"
+                        ", 0.25, 0.99, 600s) > 1 and "
+                        "slo_burn_rate(jobset_slo_time_to_admission_seconds"
+                        ", 0.25, 0.99, 1800s) > 1",
+                    "for": "60s",
+                    "labels": {"severity": "ticket"},
+                    "annotations": {
+                        "summary":
+                            "admission latency has burned the 99% SLO "
+                            "error budget at >1x for 10m+ (slow burn)",
+                    },
+                },
+            ],
+        }
+    ]
+}
+
+
+def default_rules():
+    """The built-in recording + alert rules (parsed fresh per call so a
+    Telemetry instance can mutate its copy without aliasing)."""
+    from .rules import load_rules_dict
+
+    return load_rules_dict(DEFAULT_RULE_SET)
+
+
+def _label_key(labels: dict) -> tuple:
+    return tuple(sorted(labels.items()))
+
+
+class AlertManager:
+    """Pending/firing/resolved state per (alertname, labelset), with a
+    bounded transition log. Thread-safe: the sampler thread evaluates
+    while HTTP handlers read state; effects that take other locks
+    (metrics, cluster events) run OUTSIDE the manager lock so it never
+    couples into subsystem lock orders."""
+
+    def __init__(self, rules=None, cluster=None,
+                 max_transitions: int = 4096):
+        self.rules = list(rules or [])
+        self.cluster = cluster
+        self._active: dict[tuple, dict] = {}  # guarded-by: _lock
+        self._transitions: deque = deque(  # guarded-by: _lock
+            maxlen=max_transitions
+        )
+        self._lock = threading.Lock()
+
+    def evaluate(self, tsdb, now: float) -> None:
+        from .rules import evaluate as eval_expr
+
+        for rule in self.rules:
+            vec = eval_expr(rule.ast, tsdb, now)
+            self.observe(rule, vec, now)
+
+    def observe(self, rule, vec, now: float) -> None:
+        """Fold one rule's instant-vector result into the state machine.
+        A non-empty result means "true" for each labelset it carries."""
+        current = {_label_key(labels): (labels, value)
+                   for labels, value in vec}
+        emitted: list[tuple[str, dict, float | None]] = []
+        with self._lock:
+            for lkey, (labels, value) in sorted(current.items()):
+                key = (rule.name, lkey)
+                entry = self._active.get(key)
+                if entry is None:
+                    state = (STATE_FIRING if rule.for_s <= 0
+                             else STATE_PENDING)
+                    self._active[key] = {
+                        "rule": rule, "labels": dict(labels),
+                        "state": state, "since": now, "value": value,
+                    }
+                    emitted.append((state, dict(labels), value))
+                else:
+                    entry["value"] = value
+                    if (entry["state"] == STATE_PENDING
+                            and now - entry["since"] >= rule.for_s):
+                        entry["state"] = STATE_FIRING
+                        entry["since"] = now
+                        emitted.append(
+                            (STATE_FIRING, dict(entry["labels"]), value)
+                        )
+            stale = [
+                key for key in self._active
+                if key[0] == rule.name and key[1] not in current
+            ]
+            for key in sorted(stale):
+                entry = self._active.pop(key)
+                if entry["state"] == STATE_FIRING:
+                    emitted.append(
+                        (STATE_RESOLVED, dict(entry["labels"]), None)
+                    )
+                # pending -> inactive: never fired, nothing to resolve.
+            for state, labels, value in emitted:
+                self._transitions.append({
+                    "ts": now,
+                    "alert": rule.name,
+                    "state": state,
+                    "labels": labels,
+                })
+            still_firing = any(
+                key[0] == rule.name
+                and entry["state"] == STATE_FIRING
+                for key, entry in self._active.items()
+            )
+        if not emitted:
+            return
+        from ..core import metrics
+
+        for state, labels, value in emitted:
+            metrics.alerts_transitions_total.inc(rule.name, state)
+        metrics.alerts_firing.set(1.0 if still_firing else 0.0, rule.name)
+        if self.cluster is not None:
+            for state, labels, value in emitted:
+                etype = (keys.EVENT_WARNING if state == STATE_FIRING
+                         else keys.EVENT_NORMAL)
+                detail = (
+                    "".join(
+                        f" {k}={v}" for k, v in sorted(labels.items())
+                    )
+                    or ""
+                )
+                self.cluster.record_event(
+                    "Alert", rule.name, etype,
+                    f"Alert{state.capitalize()}",
+                    f"{rule.name} {state} ({rule.expr}){detail}",
+                )
+
+    # -- read surface ----------------------------------------------------
+
+    def state(self) -> dict:
+        """``GET /debug/alerts`` payload: configured rules, active
+        alerts, and the transition log — all deterministically ordered."""
+        with self._lock:
+            active = [
+                {
+                    "alert": name,
+                    "state": entry["state"],
+                    "since": entry["since"],
+                    "labels": dict(entry["labels"]),
+                    "value": entry["value"],
+                }
+                for (name, _), entry in sorted(
+                    self._active.items(),
+                    key=lambda item: (item[0][0], item[0][1]),
+                )
+            ]
+            transitions = list(self._transitions)
+        return {
+            "rules": [r.to_dict() for r in self.rules],
+            "active": active,
+            "transitions": transitions,
+        }
+
+    def transition_log(self) -> list[dict]:
+        with self._lock:
+            return list(self._transitions)
+
+    def firing(self) -> list[str]:
+        """Names of rules with at least one firing labelset, sorted."""
+        with self._lock:
+            return sorted({
+                key[0] for key, entry in self._active.items()
+                if entry["state"] == STATE_FIRING
+            })

@@ -15,6 +15,11 @@ controller — and writes one ``.tgz``:
 * ``jobsets.json``    — every JobSet manifest (status included);
 * ``timelines.json``  — one flight-recorder timeline per JobSet, keyed
   ``namespace/name``;
+* ``tsdb.json``       — the telemetry plane's full series dump
+  (``{"enabled": false}`` when the controller runs without
+  ``--telemetry``);
+* ``alerts.json``     — alert rules, active alerts, and the transition
+  log (same ``enabled`` convention);
 * ``metrics.prom``    — a raw Prometheus text scrape.
 
 ``load_bundle(path)`` round-trips the tarball back into a dict of parsed
@@ -37,9 +42,12 @@ BUNDLE_FORMAT = 1
 # bumps are additive (1.1 added per-timeline `placements` records; 1.2
 # added the manifest `lint` block; 1.3 added the race-rule counts
 # (RACE001-003) and per-rule `timingMs` inside that block — the race-
-# detection plane's debt is now part of every postmortem).
+# detection plane's debt is now part of every postmortem; 1.4 added
+# `tsdb.json` + `alerts.json`, the telemetry plane's full snapshot and
+# alert state/transition log, `{"enabled": false}` when the controller
+# runs without --telemetry).
 # Bundles written before the stamp existed are treated as "1.0".
-BUNDLE_SCHEMA_VERSION = "1.3"
+BUNDLE_SCHEMA_VERSION = "1.4"
 
 _JSON_MEMBERS = (
     "manifest.json",
@@ -49,6 +57,8 @@ _JSON_MEMBERS = (
     "events.json",
     "jobsets.json",
     "timelines.json",
+    "tsdb.json",
+    "alerts.json",
 )
 
 
@@ -76,6 +86,21 @@ def write_bundle(client, path: str) -> dict:
         "traces.json": client.traces(limit=0),
         "events.json": client.events(),
     }
+
+    # Telemetry plane (schemaVersion 1.4): the TSDB series dump and the
+    # alert state + transition log. A controller running without
+    # --telemetry answers 404 on both — the members still exist so
+    # consumers can distinguish "telemetry off" from "pre-1.4 bundle".
+    for member, fetch in (
+        ("tsdb.json", client.tsdb),
+        ("alerts.json", client.alerts),
+    ):
+        try:
+            payloads[member] = {"enabled": True, **fetch()}
+        except ApiError as exc:
+            if exc.status != 404:
+                raise
+            payloads[member] = {"enabled": False}
 
     jobsets: list[dict] = []
     timelines: dict[str, dict] = {}

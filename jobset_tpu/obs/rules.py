@@ -1,0 +1,483 @@
+"""PromQL-lite rule engine for the telemetry plane.
+
+A small, deterministic expression language evaluated against the
+embedded TSDB (``obs/tsdb.py``) — enough PromQL to express the rules a
+control plane actually gates on, nothing more:
+
+* selectors — ``jobset_flow_rejected_total{level="workload-low"}`` and
+  range selectors ``...[60s]``
+* ``rate(v[w])`` / ``increase(v[w])`` — counter-reset corrected; a
+  series born inside the window is credited from 0 (see
+  ``TimeSeriesStore.window``)
+* ``histogram_quantile(q, expr)`` over ``_bucket`` series
+* ``slo_burn_rate(family, objective_s, target, window)`` — the SRE-
+  workbook burn rate: (bad fraction over window) / (1 - target), where
+  "bad" is observations of histogram ``family`` above ``objective_s``
+  (snapped to the enclosing bucket bound)
+* aggregation — ``sum|max|avg|min [by (l1, l2)] (expr)``
+* scalar comparison filters — ``expr > 2`` keeps vector elements whose
+  value passes (Prometheus semantics: an empty result means "nothing
+  firing")
+* ``and`` — vector intersection on label sets (multi-window burn rules)
+
+Declarative rule files (YAML or JSON, the Prometheus shape)::
+
+    groups:
+      - rules:
+          - record: jobset:flow_rejected:rate1m
+            expr: sum(rate(jobset_flow_rejected_total[60s]))
+          - alert: JobSetFlowShedRateHigh
+            expr: sum(rate(jobset_flow_rejected_total[60s])) > 1
+            for: 0s
+            labels: {severity: page}
+            annotations: {summary: "..."}
+
+Everything evaluates at an explicit ``now`` with pure float arithmetic
+over decoded samples — two seeded runs produce byte-identical results.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+
+# Staleness lookback for instant selectors (Prometheus' 5 m default).
+DEFAULT_LOOKBACK_S = 300.0
+
+_AGG_OPS = ("sum", "max", "avg", "min")
+_CMP_OPS = (">=", "<=", "==", "!=", ">", "<")
+
+_TOKEN_RE = re.compile(
+    r"\s*(?:"
+    r"(?P<duration>\d+(?:\.\d+)?(?:ms|s|m|h|d))"
+    r"|(?P<number>\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)"
+    r"|(?P<name>[A-Za-z_:][A-Za-z0-9_:]*)"
+    r"|(?P<string>\"[^\"]*\"|'[^']*')"
+    r"|(?P<op>>=|<=|==|!=|[><(){}\[\],=])"
+    r")"
+)
+
+_DURATION_UNITS = {"ms": 0.001, "s": 1.0, "m": 60.0, "h": 3600.0,
+                   "d": 86400.0}
+
+
+class RuleError(ValueError):
+    """Malformed expression or rule file."""
+
+
+def parse_duration(text: str) -> float:
+    m = re.fullmatch(r"(\d+(?:\.\d+)?)(ms|s|m|h|d)?", str(text).strip())
+    if not m:
+        raise RuleError(f"bad duration {text!r}")
+    return float(m.group(1)) * _DURATION_UNITS.get(m.group(2) or "s", 1.0)
+
+
+def _tokenize(text: str) -> list[tuple[str, str]]:
+    tokens, pos = [], 0
+    while pos < len(text):
+        m = _TOKEN_RE.match(text, pos)
+        if m is None or m.end() == pos:
+            if text[pos:].strip():
+                raise RuleError(
+                    f"unexpected character {text[pos:].strip()[0]!r} in "
+                    f"expression {text!r}"
+                )
+            break
+        pos = m.end()
+        for kind in ("duration", "number", "name", "string", "op"):
+            val = m.group(kind)
+            if val is not None:
+                tokens.append((kind, val))
+                break
+    return tokens
+
+
+class _Parser:
+    def __init__(self, text: str):
+        self.text = text
+        self.tokens = _tokenize(text)
+        self.pos = 0
+
+    def peek(self):
+        return self.tokens[self.pos] if self.pos < len(self.tokens) else None
+
+    def next(self):
+        tok = self.peek()
+        if tok is None:
+            raise RuleError(f"unexpected end of expression {self.text!r}")
+        self.pos += 1
+        return tok
+
+    def expect(self, value: str):
+        tok = self.next()
+        if tok[1] != value:
+            raise RuleError(
+                f"expected {value!r}, got {tok[1]!r} in {self.text!r}"
+            )
+        return tok
+
+    # expr := cmp ('and' cmp)*
+    def parse(self):
+        node = self._cmp()
+        while True:
+            tok = self.peek()
+            if tok and tok[0] == "name" and tok[1] == "and":
+                self.next()
+                node = ("and", node, self._cmp())
+            else:
+                break
+        return node
+
+    def _cmp(self):
+        node = self._primary()
+        tok = self.peek()
+        if tok and tok[0] == "op" and tok[1] in _CMP_OPS:
+            op = self.next()[1]
+            rhs = self.next()
+            if rhs[0] not in ("number", "duration"):
+                raise RuleError(
+                    f"comparison needs a scalar rhs in {self.text!r}"
+                )
+            node = ("cmp", op, node, float(rhs[1].rstrip("smhd")
+                                           if rhs[0] == "duration"
+                                           else rhs[1]))
+        return node
+
+    def _primary(self):
+        tok = self.next()
+        if tok[0] == "number":
+            return ("scalar", float(tok[1]))
+        if tok[0] == "op" and tok[1] == "(":
+            node = self.parse()
+            self.expect(")")
+            return node
+        if tok[0] != "name":
+            raise RuleError(f"unexpected {tok[1]!r} in {self.text!r}")
+        name = tok[1]
+        if name in _AGG_OPS:
+            by = ()
+            nxt = self.peek()
+            if nxt and nxt[0] == "name" and nxt[1] == "by":
+                self.next()
+                self.expect("(")
+                labels = []
+                while True:
+                    labels.append(self.next()[1])
+                    if self.peek() and self.peek()[1] == ",":
+                        self.next()
+                        continue
+                    break
+                self.expect(")")
+                by = tuple(labels)
+            self.expect("(")
+            inner = self.parse()
+            self.expect(")")
+            return ("agg", name, by, inner)
+        if name in ("rate", "increase"):
+            self.expect("(")
+            inner = self._primary()
+            self.expect(")")
+            if inner[0] != "range":
+                raise RuleError(
+                    f"{name}() needs a range selector like v[60s] in "
+                    f"{self.text!r}"
+                )
+            return (name, inner)
+        if name == "histogram_quantile":
+            self.expect("(")
+            q = self.next()
+            if q[0] != "number":
+                raise RuleError("histogram_quantile needs a scalar q")
+            self.expect(",")
+            inner = self.parse()
+            self.expect(")")
+            return ("quantile", float(q[1]), inner)
+        if name == "slo_burn_rate":
+            self.expect("(")
+            family = self.next()
+            if family[0] != "name":
+                raise RuleError("slo_burn_rate needs a histogram family")
+            self.expect(",")
+            objective = float(self.next()[1])
+            self.expect(",")
+            target = float(self.next()[1])
+            self.expect(",")
+            window_tok = self.next()
+            window = parse_duration(window_tok[1])
+            self.expect(")")
+            return ("burn", family[1], objective, target, window)
+        # plain selector: name{matchers}[window]
+        matchers: dict[str, str] = {}
+        nxt = self.peek()
+        if nxt and nxt[1] == "{":
+            self.next()
+            while self.peek() and self.peek()[1] != "}":
+                label = self.next()[1]
+                self.expect("=")
+                value = self.next()
+                if value[0] != "string":
+                    raise RuleError(
+                        f"matcher value must be quoted in {self.text!r}"
+                    )
+                matchers[label] = value[1][1:-1]
+                if self.peek() and self.peek()[1] == ",":
+                    self.next()
+            self.expect("}")
+        nxt = self.peek()
+        if nxt and nxt[1] == "[":
+            self.next()
+            window_tok = self.next()
+            window = parse_duration(window_tok[1])
+            self.expect("]")
+            return ("range", name, matchers, window)
+        return ("selector", name, matchers)
+
+
+def parse(text: str):
+    """Parse one expression into an AST (nested tuples)."""
+    parser = _Parser(text)
+    node = parser.parse()
+    if parser.peek() is not None:
+        raise RuleError(
+            f"trailing tokens after expression: {parser.peek()[1]!r} in "
+            f"{text!r}"
+        )
+    return node
+
+
+# ---------------------------------------------------------------------------
+# Evaluation
+# ---------------------------------------------------------------------------
+
+
+def _counter_delta(samples: list, born_in_window: bool) -> float:
+    """Counter increase over the window with reset correction; a series
+    born inside the window is credited from 0."""
+    delta = 0.0
+    prev = samples[0][1]
+    for _, v in samples[1:]:
+        delta += (v - prev) if v >= prev else v
+        prev = v
+    if born_in_window:
+        delta += samples[0][1]
+    return delta
+
+
+def _match_key(labels: dict) -> tuple:
+    return tuple(sorted(labels.items()))
+
+
+def evaluate(node, tsdb, now: float,
+             lookback: float = DEFAULT_LOOKBACK_S) -> list:
+    """Evaluate an AST at instant ``now`` -> instant vector
+    ``[(labels_dict, value), ...]`` in deterministic (sorted) order."""
+    kind = node[0]
+    if kind == "scalar":
+        return [({}, node[1])]
+    if kind == "selector":
+        _, name, matchers = node
+        return tsdb.instant(name, matchers, now, lookback)
+    if kind == "range":
+        raise RuleError("range selector needs rate()/increase() around it")
+    if kind in ("rate", "increase"):
+        _, name, matchers, window = node[1]
+        out = []
+        for labels, samples, born in tsdb.window(name, matchers, now,
+                                                 window):
+            delta = _counter_delta(samples, born)
+            out.append((labels, delta / window if kind == "rate"
+                        else delta))
+        return out
+    if kind == "agg":
+        _, op, by, inner = node
+        vec = evaluate(inner, tsdb, now, lookback)
+        groups: dict[tuple, list[float]] = {}
+        for labels, value in vec:
+            key = tuple((l, labels.get(l, "")) for l in by)
+            groups.setdefault(key, []).append(value)
+        out = []
+        for key in sorted(groups):
+            vals = groups[key]
+            if op == "sum":
+                value = sum(vals)
+            elif op == "max":
+                value = max(vals)
+            elif op == "min":
+                value = min(vals)
+            else:
+                value = sum(vals) / len(vals)
+            out.append((dict(key), value))
+        return out
+    if kind == "cmp":
+        _, op, inner, threshold = node
+        vec = evaluate(inner, tsdb, now, lookback)
+        keep = {
+            ">": lambda v: v > threshold,
+            "<": lambda v: v < threshold,
+            ">=": lambda v: v >= threshold,
+            "<=": lambda v: v <= threshold,
+            "==": lambda v: v == threshold,
+            "!=": lambda v: v != threshold,
+        }[op]
+        return [(labels, v) for labels, v in vec if keep(v)]
+    if kind == "and":
+        _, left, right = node
+        lvec = evaluate(left, tsdb, now, lookback)
+        rkeys = {_match_key(labels)
+                 for labels, _ in evaluate(right, tsdb, now, lookback)}
+        return [(labels, v) for labels, v in lvec
+                if _match_key(labels) in rkeys]
+    if kind == "quantile":
+        _, q, inner = node
+        vec = evaluate(inner, tsdb, now, lookback)
+        return _histogram_quantile(q, vec)
+    if kind == "burn":
+        _, family, objective, target, window = node
+        return _slo_burn_rate(tsdb, now, family, objective, target, window)
+    raise RuleError(f"unknown node kind {kind!r}")
+
+
+def _histogram_quantile(q: float, vec: list) -> list:
+    """phi-quantile over ``_bucket`` elements (le label), grouped by the
+    remaining labels — the Prometheus estimator: upper bound of the
+    first bucket whose cumulative count crosses q*total."""
+    groups: dict[tuple, list[tuple[float, float]]] = {}
+    for labels, value in vec:
+        le = labels.get("le")
+        if le is None:
+            continue
+        bound = float("inf") if le == "+Inf" else float(le)
+        rest = tuple(sorted(
+            (k, v) for k, v in labels.items() if k != "le"
+        ))
+        groups.setdefault(rest, []).append((bound, value))
+    out = []
+    for rest in sorted(groups):
+        buckets = sorted(groups[rest])
+        total = buckets[-1][1] if buckets else 0.0
+        if total <= 0:
+            continue
+        target = q * total
+        value = buckets[-1][0]
+        for bound, cumulative in buckets:
+            if cumulative >= target:
+                value = bound
+                break
+        out.append((dict(rest), value))
+    return out
+
+
+def _slo_burn_rate(tsdb, now: float, family: str, objective: float,
+                   target: float, window: float) -> list:
+    """Burn rate of histogram ``family`` against ``objective`` seconds at
+    ``target`` availability over ``window``: bad-fraction / error-budget.
+    The objective snaps to the smallest bucket bound >= objective (bucket
+    ladders quantize; docs/observability.md)."""
+    buckets = tsdb.window(f"{family}_bucket", {}, now, window)
+    counts = tsdb.window(f"{family}_count", {}, now, window)
+    # Group buckets by non-le labels, picking the snapped objective bound.
+    good: dict[tuple, float] = {}
+    for labels, samples, born in buckets:
+        le = labels.get("le", "")
+        bound = float("inf") if le == "+Inf" else float(le)
+        if bound < objective:
+            continue
+        rest = tuple(sorted(
+            (k, v) for k, v in labels.items() if k != "le"
+        ))
+        prev = good.get(rest)
+        if prev is None or bound < prev[0]:
+            good[rest] = (bound, _counter_delta(samples, born))
+    out = []
+    budget = max(1e-9, 1.0 - target)
+    for labels, samples, born in sorted(
+        counts, key=lambda item: _match_key(item[0])
+    ):
+        rest = _match_key(labels)
+        total = _counter_delta(samples, born)
+        if total <= 0:
+            out.append((labels, 0.0))
+            continue
+        good_delta = good.get(rest, (None, 0.0))[1]
+        bad_ratio = max(0.0, (total - good_delta) / total)
+        out.append((labels, bad_ratio / budget))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Declarative rules
+# ---------------------------------------------------------------------------
+
+
+class RecordingRule:
+    def __init__(self, name: str, expr: str):
+        self.name = name
+        self.expr = expr
+        self.ast = parse(expr)
+
+    def to_dict(self) -> dict:
+        return {"record": self.name, "expr": self.expr}
+
+
+class AlertRule:
+    def __init__(self, name: str, expr: str, for_s: float = 0.0,
+                 labels: dict | None = None,
+                 annotations: dict | None = None):
+        self.name = name
+        self.expr = expr
+        self.ast = parse(expr)
+        self.for_s = float(for_s)
+        self.labels = dict(labels or {})
+        self.annotations = dict(annotations or {})
+
+    def to_dict(self) -> dict:
+        return {
+            "alert": self.name,
+            "expr": self.expr,
+            "for": self.for_s,
+            "labels": dict(self.labels),
+            "annotations": dict(self.annotations),
+        }
+
+
+def load_rules_dict(doc: dict) -> tuple[list[RecordingRule],
+                                        list[AlertRule]]:
+    """Parse the Prometheus rule-file shape (``groups: [{rules: [...]}]``
+    or a bare ``rules:`` list) into rule objects."""
+    if not isinstance(doc, dict):
+        raise RuleError("rule file must be a mapping")
+    if "groups" in doc:
+        entries = []
+        for group in doc.get("groups") or []:
+            entries.extend(group.get("rules") or [])
+    else:
+        entries = doc.get("rules") or []
+    recording, alerts = [], []
+    for entry in entries:
+        if "record" in entry:
+            recording.append(RecordingRule(entry["record"], entry["expr"]))
+        elif "alert" in entry:
+            alerts.append(AlertRule(
+                entry["alert"], entry["expr"],
+                for_s=parse_duration(entry.get("for", 0)),
+                labels=entry.get("labels"),
+                annotations=entry.get("annotations"),
+            ))
+        else:
+            raise RuleError(
+                f"rule entry needs 'record' or 'alert': {entry!r}"
+            )
+    return recording, alerts
+
+
+def load_rules_file(path: str) -> tuple[list[RecordingRule],
+                                        list[AlertRule]]:
+    with open(path) as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        import yaml
+
+        doc = yaml.safe_load(text)
+    return load_rules_dict(doc)

@@ -1,0 +1,322 @@
+"""Embedded telemetry time-series store (the in-process TSDB).
+
+The metrics registry, SLO tracker, and ``/debug/health`` are all
+point-in-time snapshots — nothing could answer "what was the write p99
+doing in the 30 s before that failover?" or fire on an SLO burn. This
+module closes that gap without external dependencies:
+
+* ``TimeSeriesStore`` — a bounded per-series chunk store. Timestamps are
+  delta-of-delta encoded and values are Gorilla-style XOR-of-bits
+  encoded (``array``-backed, so a sealed 120-sample chunk is two flat
+  arrays, not 120 dicts). Retention is a ring of chunks per series: when
+  a series exceeds its sample budget the oldest sealed chunk drops
+  whole. Value decode is bit-exact; timestamp decode is
+  encoder/decoder-lockstep (the encoder advances its own state through
+  the reconstructed floats, so decode always reproduces exactly what
+  queries saw at append time — deterministic across runs by
+  construction).
+* ``Telemetry`` — the plane driver: samples the ENTIRE metrics registry
+  (``core.metrics.sample_registry()``, including the SLO histograms'
+  bucket ladders) on the cluster's injectable clock, then runs the
+  recording + alert rules (``obs/rules.py``, ``obs/alerts.py``) against
+  the store. Under a ``FakeClock`` every tick is a deterministic,
+  seeded-byte-identical function of the cluster's history; under a wall
+  clock ``start()`` runs the same tick on a daemon sampler thread.
+
+Everything here is stdlib-only; sampling ~350 series is a few dict ops
+and two array appends per series per tick.
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+from array import array
+
+from ..utils.clock import Clock
+
+# Samples per chunk before it seals and a fresh one opens. 120 samples
+# at the default 5 s interval = 10 minutes per chunk, so retention
+# trimming (whole-chunk drops) has 10-minute granularity.
+CHUNK_SAMPLES = 120
+
+# Default per-series retention in samples (~3.5 h at 5 s interval).
+DEFAULT_RETENTION_SAMPLES = 2520
+
+
+def _bits(v: float) -> int:
+    return struct.unpack("<Q", struct.pack("<d", v))[0]
+
+
+def _unbits(b: int) -> float:
+    return struct.unpack("<d", struct.pack("<Q", b))[0]
+
+
+class Chunk:
+    """One sealed-or-open run of samples: first (t, v) stored verbatim,
+    then delta-of-delta timestamps (``array('d')``) and XORed value bits
+    (``array('Q')``). Append-only; readers iterate a decoded copy."""
+
+    __slots__ = ("t0", "v0", "_dods", "_xors", "_t", "_dt", "_vbits",
+                 "count")
+
+    def __init__(self, t: float, v: float):
+        self.t0 = t
+        self.v0 = v
+        self._dods = array("d")
+        self._xors = array("Q")
+        # Encoder state tracks the RECONSTRUCTED floats (what a decoder
+        # will compute), so encode and decode can never drift apart.
+        self._t = t
+        self._dt = 0.0
+        self._vbits = _bits(v)
+        self.count = 1
+
+    def append(self, t: float, v: float) -> None:
+        dod = (t - self._t) - self._dt
+        self._dods.append(dod)
+        self._dt += dod
+        self._t += self._dt
+        bits = _bits(v)
+        self._xors.append(bits ^ self._vbits)
+        self._vbits = bits
+        self.count += 1
+
+    def samples(self) -> list[tuple[float, float]]:
+        out = [(self.t0, self.v0)]
+        t, dt, vbits = self.t0, 0.0, _bits(self.v0)
+        for dod, xor in zip(self._dods, self._xors):
+            dt += dod
+            t += dt
+            vbits ^= xor
+            out.append((t, _unbits(vbits)))
+        return out
+
+    @property
+    def last_t(self) -> float:
+        return self._t
+
+
+class Series:
+    __slots__ = ("name", "labels", "born_ts", "chunks", "count")
+
+    def __init__(self, name: str, labels: tuple, born_ts: float):
+        self.name = name
+        self.labels = labels  # sorted tuple of (label, value) pairs
+        self.born_ts = born_ts  # first-ever sample time (birth-from-zero)
+        self.chunks: list[Chunk] = []
+        self.count = 0
+
+    def append(self, t: float, v: float, retention: int) -> None:
+        if not self.chunks or self.chunks[-1].count >= CHUNK_SAMPLES:
+            self.chunks.append(Chunk(t, v))
+        else:
+            self.chunks[-1].append(t, v)
+        self.count += 1
+        while self.count > retention and len(self.chunks) > 1:
+            self.count -= self.chunks.pop(0).count
+
+    def samples(self, start: float | None = None,
+                end: float | None = None) -> list[tuple[float, float]]:
+        out: list[tuple[float, float]] = []
+        for chunk in self.chunks:
+            if start is not None and chunk.last_t < start:
+                continue
+            for t, v in chunk.samples():
+                if start is not None and t < start:
+                    continue
+                if end is not None and t > end:
+                    break
+                out.append((t, v))
+        return out
+
+    def latest(self) -> tuple[float, float] | None:
+        if not self.chunks:
+            return None
+        return self.chunks[-1].samples()[-1]
+
+
+class TimeSeriesStore:
+    """Bounded map of ``(name, labels) -> Series``. Thread-safe: the
+    sampler thread appends while HTTP handler threads query."""
+
+    def __init__(self, retention_samples: int = DEFAULT_RETENTION_SAMPLES):
+        self.retention_samples = int(retention_samples)
+        self._series: dict[tuple, Series] = {}  # guarded-by: _lock
+        self._first_ts: float | None = None  # guarded-by: _lock
+        self._lock = threading.Lock()
+
+    def append(self, name: str, labels: tuple, t: float, v: float) -> None:
+        labels = tuple(sorted(labels))
+        key = (name, labels)
+        with self._lock:
+            if self._first_ts is None:
+                self._first_ts = t
+            series = self._series.get(key)
+            if series is None:
+                series = self._series[key] = Series(name, labels, t)
+            series.append(t, v, self.retention_samples)
+
+    def series_count(self) -> int:
+        with self._lock:
+            return len(self._series)
+
+    def sample_count(self) -> int:
+        with self._lock:
+            return sum(s.count for s in self._series.values())
+
+    def _select(self, name: str, matchers: dict) -> list[Series]:
+        with self._lock:
+            picked = [
+                s for (n, _), s in sorted(self._series.items())
+                if n == name
+            ]
+        if matchers:
+            items = set(matchers.items())
+            picked = [s for s in picked if items.issubset(set(s.labels))]
+        return picked
+
+    # -- query surface (rules engine + /debug/tsdb) ----------------------
+
+    def instant(self, name: str, matchers: dict, now: float,
+                lookback: float) -> list[tuple[dict, float]]:
+        """Last sample per matching series within the staleness lookback."""
+        out = []
+        for s in self._select(name, matchers):
+            last = s.latest()
+            if last is not None and now - lookback <= last[0] <= now:
+                out.append((dict(s.labels), last[1]))
+        return out
+
+    def window(self, name: str, matchers: dict, now: float,
+               window: float) -> list[tuple[dict, list, bool]]:
+        """Range selector: per matching series, the samples in
+        ``(now-window, now]`` plus a born-in-window flag (a counter
+        series that first appeared inside the window implicitly rose
+        from 0 — rate()/increase() credit its first value as delta, so
+        two seeded runs agree even when one inherits the series from
+        earlier process history and the other creates it mid-window).
+
+        A series born on the store's very first sample tick gets NO
+        birth credit: its first value is inherited process-global
+        registry state (a previous run's accumulation), not growth this
+        store witnessed — crediting it would fire delta alerts at t0 of
+        every second seeded run."""
+        start = now - window
+        with self._lock:
+            first_ts = self._first_ts
+        out = []
+        for s in self._select(name, matchers):
+            samples = [
+                (t, v) for t, v in s.samples(start=start, end=now)
+                if t > start
+            ]
+            if samples:
+                born = s.born_ts > start and s.born_ts != first_ts
+                out.append((dict(s.labels), samples, born))
+        return out
+
+    def snapshot(self, start: float | None = None,
+                 end: float | None = None) -> dict:
+        """Deterministic JSON-able dump (debug bundles, byte-identity
+        tests): series sorted by (name, labels), decoded samples."""
+        with self._lock:
+            series = sorted(self._series.items())
+        return {
+            "retentionSamples": self.retention_samples,
+            "series": [
+                {
+                    "name": s.name,
+                    "labels": dict(s.labels),
+                    "samples": [
+                        [t, v] for t, v in s.samples(start=start, end=end)
+                    ],
+                }
+                for _, s in series
+            ],
+        }
+
+
+class Telemetry:
+    """The telemetry plane: TSDB + rule engine + alert manager, ticked on
+    the injectable clock.
+
+    ``tick()`` is the whole plane: sample the registry into the TSDB,
+    evaluate recording rules (results append back into the TSDB as
+    first-class series), evaluate alert rules into the alert state
+    machine. In simulation the harness calls ``tick()`` at script points
+    on a ``FakeClock`` — byte-identical across seeded runs. On a live
+    controller ``start()`` drives the same tick from a daemon sampler
+    thread every ``interval`` wall seconds."""
+
+    def __init__(self, clock: Clock | None = None, interval: float = 5.0,
+                 cluster=None, rules_path: str | None = None,
+                 retention_samples: int = DEFAULT_RETENTION_SAMPLES,
+                 use_default_rules: bool = True):
+        from ..core import metrics
+        from .alerts import AlertManager, default_rules
+        from .rules import load_rules_file
+
+        self.clock = clock or Clock()
+        self.interval = float(interval)
+        self.tsdb = TimeSeriesStore(retention_samples=retention_samples)
+        metrics.telemetry_series.bind(
+            self.tsdb, lambda store: store.series_count()
+        )
+        if rules_path is not None:
+            self.recording_rules, alert_rules = load_rules_file(rules_path)
+        elif use_default_rules:
+            self.recording_rules, alert_rules = default_rules()
+        else:
+            self.recording_rules, alert_rules = [], []
+        self.alerts = AlertManager(rules=alert_rules, cluster=cluster)
+        self._tick_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    @property
+    def alert_rules(self):
+        return self.alerts.rules
+
+    def tick(self, now: float | None = None) -> None:
+        """One sampler pass. Serialized: the sampler thread and any
+        synchronous caller (tests, drain paths) must not interleave two
+        passes, or rule evals would see half a tick's samples."""
+        from ..core import metrics
+        from .rules import evaluate
+
+        if now is None:
+            now = self.clock.now()
+        with self._tick_lock:
+            samples = metrics.sample_registry()
+            for name, labels, value in samples:
+                self.tsdb.append(name, labels, now, value)
+            metrics.telemetry_samples_total.inc(amount=float(len(samples)))
+            for rule in self.recording_rules:
+                for labels, value in evaluate(rule.ast, self.tsdb, now):
+                    self.tsdb.append(
+                        rule.name, tuple(sorted(labels.items())), now, value
+                    )
+            self.alerts.evaluate(self.tsdb, now)
+            if self.recording_rules or self.alerts.rules:
+                metrics.telemetry_rule_evals_total.inc()
+
+    # -- wall-clock sampler thread (live controllers) --------------------
+
+    def start(self) -> "Telemetry":
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="telemetry-sampler", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            self.tick()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.interval + 5.0)
+            self._thread = None

@@ -132,11 +132,20 @@ class QueueManager:
         # without persistence every pass would grant a fresh backfill
         # budget and the depth bound would be meaningless.
         self._backfill_state: dict[str, tuple[str, int]] = {}
-        # Queue names with live gauge rows: rows for vanished queues (queue
-        # deleted AND its last workload gone) must be zeroed, not
-        # abandoned at their last value.
-        self._gauge_queues: set[str] = set()
         cluster.queue_manager = self
+        # Collect-time backlog gauges: /metrics and the telemetry sampler
+        # pull live per-queue counts from this manager instead of racing
+        # push sites scattered across CRUD/admission/evict paths (which
+        # also needed a vanished-queue zeroing sweep — a deleted queue now
+        # simply stops exporting rows). Weakref-bound, last manager wins.
+        from ..core import metrics
+
+        metrics.queue_pending_workloads.bind(
+            self, lambda m: m._workload_counts(PENDING)
+        )
+        metrics.queue_admitted_workloads.bind(
+            self, lambda m: m._workload_counts(ADMITTED)
+        )
 
     # ------------------------------------------------------------------
     # Queue CRUD (server endpoints call these under the cluster lock)
@@ -151,7 +160,6 @@ class QueueManager:
         if errs:
             raise AdmissionError("; ".join(errs))
         self.queues[q.name] = q
-        self._update_gauges()
         return q
 
     def update_queue(self, q: Queue) -> Queue:
@@ -173,10 +181,9 @@ class QueueManager:
         del self.queues[name]
         # Admitted workloads keep running (their quota simply stops being
         # tracked); pending ones wait for the queue to reappear — the same
-        # inadmissible-not-rejected stance Kueue takes. The gauge refresh
-        # zeroes the rows once nothing references the name (its vanished-
-        # queue sweep), so deleted queues never export phantom workloads.
-        self._update_gauges()
+        # inadmissible-not-rejected stance Kueue takes. The collect-time
+        # gauges stop exporting the name's rows once nothing references
+        # it, so deleted queues never report phantom workloads.
 
     def get_queue(self, name: str) -> Optional[Queue]:
         return self.queues.get(name)
@@ -230,7 +237,6 @@ class QueueManager:
             f"workload queued in {wl.queue} (request {_fmt(wl.request)})",
             namespace=js.metadata.namespace,
         )
-        self._update_gauges()
 
     def enforce_update(self, old: JobSet, new: JobSet) -> None:
         """Suspend is controller-owned for queue-managed JobSets: a spec
@@ -251,7 +257,6 @@ class QueueManager:
                     "voluntarily suspended; quota released and requeued",
                     namespace=new.metadata.namespace,
                 )
-                self._update_gauges()
             else:
                 new.spec.suspend = False
         else:
@@ -260,8 +265,7 @@ class QueueManager:
     def forget(self, uid: str) -> None:
         """Drop the workload record (JobSet deleted): quota frees on the
         next admission pass."""
-        if self.workloads.pop(uid, None) is not None:
-            self._update_gauges()
+        self.workloads.pop(uid, None)
 
     def manages(self, uid: str) -> bool:
         return uid in self.workloads
@@ -286,7 +290,6 @@ class QueueManager:
             max((wl.arrival for wl in self.workloads.values()), default=0),
         )
         self._backfill_state.clear()
-        self._update_gauges()
 
     # ------------------------------------------------------------------
     # Admission pass (cluster tick, before the reconcile drain)
@@ -333,7 +336,6 @@ class QueueManager:
             key=lambda w: w.arrival,
         )
         if not candidates:
-            self._update_gauges()
             return changed
 
         # 3. ONE batched scoring call over every pending candidate
@@ -351,7 +353,6 @@ class QueueManager:
             result = score(snapshot)
             admission_span.set_attribute("scorer_backend", result.backend)
             changed |= self._select(candidates, usage, snapshot, result, now)
-        self._update_gauges()
         return changed
 
     # -- snapshot / usage ------------------------------------------------
@@ -716,28 +717,19 @@ class QueueManager:
         if wl is None or wl.state != ADMITTED:
             return False
         self._evict(wl, self.cluster.clock.now(), reason, message)
-        self._update_gauges()
         return True
 
     # -- observability ----------------------------------------------------
 
-    def _update_gauges(self) -> None:
-        from ..core import metrics
-
-        counts: dict[str, list[int]] = {
-            name: [0, 0] for name in self.queues
-        }
+    def _workload_counts(self, state: str) -> list[tuple[tuple, int]]:
+        """CallbackGauge provider: per-queue workload count in ``state``,
+        a row per known queue (0 rows included so a drained queue reads 0
+        rather than vanishing while it still exists)."""
+        counts: dict[str, int] = {name: 0 for name in self.queues}
         for wl in self.workloads.values():
-            slot = counts.setdefault(wl.queue, [0, 0])
-            slot[0 if wl.state == PENDING else 1] += 1
-        # Zero rows whose queue vanished since the last update so /metrics
-        # never reports phantom workloads for a deleted queue.
-        for name in self._gauge_queues - set(counts):
-            counts[name] = [0, 0]
-        self._gauge_queues = {n for n, c in counts.items() if c != [0, 0]}
-        for name, (pending, admitted) in counts.items():
-            metrics.queue_pending_workloads.set(pending, name)
-            metrics.queue_admitted_workloads.set(admitted, name)
+            if wl.state == state:
+                counts[wl.queue] = counts.get(wl.queue, 0) + 1
+        return [((name,), n) for name, n in counts.items()]
 
 
 def _fmt(request: dict[str, float]) -> str:

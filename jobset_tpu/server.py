@@ -253,10 +253,17 @@ class ControllerServer:
         shard_router=None,
         shard_id=None,
         shard_map=None,
+        telemetry=None,
     ):
         if cluster is None:
             cluster = make_cluster(clock=Clock())
         self.cluster = cluster
+        # Telemetry plane (obs/tsdb.py, docs/observability.md): an
+        # obs.tsdb.Telemetry whose TSDB + alert state back /debug/tsdb
+        # and /debug/alerts. None = endpoints answer 404 (--telemetry
+        # off); the caller owns the sampler lifecycle (CLI start/stop,
+        # scenario harnesses tick synchronously on the virtual clock).
+        self.telemetry = telemetry
         # Sharded control plane (docs/sharding.md). A server carrying a
         # `shard_router` is the ROUTING FRONT DOOR: after flow
         # classification, jobset-keyed traffic dispatches to the owning
@@ -1417,6 +1424,89 @@ class ControllerServer:
             if flow_ticket is not None:
                 self.flow.release(flow_ticket)
 
+    def _debug_tsdb(self, params: dict):
+        """GET /debug/tsdb — the telemetry store's query surface.
+
+        * ``?query=EXPR`` — PromQL-lite instant evaluation at the
+          telemetry clock's now; add ``&start=..&end=..`` for a range
+          evaluation stepped at the sampler interval (a matrix).
+        * ``?view=fleet[&name=FAMILY]`` — the shard front door's
+          federated fleet view: every shard replica's current series
+          merged, stamped ``{shard, replica, role}``.
+        * no params — full deterministic series dump (debug bundles);
+          ``start``/``end`` bound the dump, ``name`` filters families.
+        """
+        unknown = sorted(
+            set(params) - {"query", "start", "end", "view", "name"}
+        )
+        if unknown:
+            return 400, {
+                "error": f"unknown parameter {unknown[0]!r} "
+                         "(want query, start, end, view, name)"
+            }
+        view = params.get("view", [None])[0]
+        name = params.get("name", [None])[0]
+        if view is not None:
+            if view != "fleet":
+                return 400, {"error": f"unknown view {view!r} (want fleet)"}
+            if self.shard_router is None:
+                return 400, {
+                    "error": "view=fleet needs the shard front door "
+                             "(--shards)"
+                }
+            return 200, self.shard_router.federate(name=name)
+        if self.telemetry is None:
+            return 404, {"error": "telemetry not enabled (--telemetry)"}
+        try:
+            start = (float(params["start"][0])
+                     if "start" in params else None)
+            end = float(params["end"][0]) if "end" in params else None
+        except ValueError:
+            return 400, {"error": "bad start/end parameter"}
+        query = params.get("query", [None])[0]
+        if query is None:
+            snapshot = self.telemetry.tsdb.snapshot(start=start, end=end)
+            if name is not None:
+                snapshot["series"] = [
+                    s for s in snapshot["series"] if s["name"] == name
+                ]
+            return 200, snapshot
+        from .obs import rules as obs_rules
+
+        try:
+            ast = obs_rules.parse(query)
+            tsdb = self.telemetry.tsdb
+            if start is not None and end is not None:
+                step = max(self.telemetry.interval, 1e-9)
+                matrix: dict = {}
+                t = start
+                while t <= end + 1e-9:
+                    for labels, value in obs_rules.evaluate(ast, tsdb, t):
+                        key = tuple(sorted(labels.items()))
+                        matrix.setdefault(key, []).append([t, value])
+                    t += step
+                return 200, {
+                    "query": query,
+                    "start": start,
+                    "end": end,
+                    "step": step,
+                    "result": [
+                        {"labels": dict(key), "values": values}
+                        for key, values in sorted(matrix.items())
+                    ],
+                }
+            now = self.telemetry.clock.now()
+            return 200, {
+                "query": query,
+                "time": now,
+                "result": [
+                    {"labels": labels, "value": value}
+                    for labels, value in obs_rules.evaluate(ast, tsdb, now)
+                ],
+            }
+        except obs_rules.RuleError as exc:
+            return 400, {"error": str(exc)}
+
     def _route_inner(self, method: str, path: str, body: bytes, headers=None,
                      watch_park: bool = True, watch_hint: float = 1.0,
                      body_obj=None):
@@ -1476,16 +1566,49 @@ class ControllerServer:
             return 200, metrics.render_prometheus()
         if path == "/debug/traces":
             # Recent finished traces from the in-process tracer's ring
-            # buffer (newest last). ?limit=N bounds the response; spans
-            # carry name/ids/duration/attributes (obs/trace.py to_dict).
+            # buffer (newest last). ?limit=N bounds the response and
+            # ?phase= keeps only traces containing a span of that name
+            # (limit applies AFTER the phase filter, so "the last 5
+            # queue.admission traces" is expressible); spans carry
+            # name/ids/duration/attributes (obs/trace.py to_dict).
+            unknown = sorted(set(params) - {"limit", "phase"})
+            if unknown:
+                return 400, {
+                    "error": f"unknown parameter {unknown[0]!r} "
+                             "(want limit, phase)"
+                }
             try:
                 limit = int(params.get("limit", ["64"])[0])
             except ValueError:
                 return 400, {"error": "bad limit parameter"}
+            phase = params.get("phase", [None])[0]
+            if phase is None:
+                traces = obs_trace.TRACER.finished_traces(limit=limit)
+            else:
+                traces = [
+                    t for t in obs_trace.TRACER.finished_traces(limit=0)
+                    if any(s.get("name") == phase
+                           for s in t.get("spans", []))
+                ]
+                if limit > 0:
+                    traces = traces[-limit:]
             return 200, {
-                "traces": obs_trace.TRACER.finished_traces(limit=limit),
+                "traces": traces,
                 "dropped_spans": obs_trace.TRACER.dropped_spans,
             }
+        if path == "/debug/tsdb" and method == "GET":
+            return self._debug_tsdb(params)
+        if path == "/debug/alerts" and method == "GET":
+            if params:
+                return 400, {
+                    "error": f"unknown parameter "
+                             f"{sorted(params)[0]!r} (none accepted)"
+                }
+            if self.telemetry is None:
+                return 404, {
+                    "error": "telemetry not enabled (--telemetry)"
+                }
+            return 200, self.telemetry.alerts.state()
         if path == "/debug/slo" and method == "GET":
             # Lifecycle SLO percentile summary (docs/observability.md):
             # time-to-admission / time-to-ready / restart-recovery from the

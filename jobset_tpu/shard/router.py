@@ -470,5 +470,63 @@ class ShardRouter:
             "journal": journal,
         }
 
+    def federate(self, name: Optional[str] = None) -> dict:
+        """Fleet telemetry view (``GET /debug/tsdb?view=fleet``): one
+        current sample of every shard replica's metrics surface, each
+        series stamped with ``shard``/``replica``/``role`` labels so the
+        merged view joins per-replica without name collisions.
+
+        Per replica: a synthetic ``up`` series (1 alive / 0 dead) always;
+        the full registry sample for the shard leader (in-process planes
+        share one registry — the leader's scrape surface IS the process
+        registry, exactly what ``GET /metrics`` on that shard serves);
+        and the replication-position gauges for followers, read from
+        their follower logs (followers have no HTTP surface in-process —
+        their positions are the telemetry they objectively own).
+        ``name`` filters to one series family."""
+        from ..core import metrics as core_metrics
+
+        series: list[dict] = []
+
+        def emit(stamp: dict, family: str, labels: dict, value) -> None:
+            if name is not None and family != name:
+                return
+            merged = dict(labels)
+            merged.update(stamp)
+            series.append(
+                {"name": family, "labels": merged, "value": float(value)}
+            )
+
+        for shard_id in sorted(self.handles):
+            handle = self.handles[shard_id]
+            replicas = getattr(handle.group, "replicas", None) or []
+            for replica in replicas:
+                if not getattr(replica, "alive", False):
+                    role = "down"
+                elif getattr(replica, "is_leader", False):
+                    role = "leader"
+                else:
+                    role = "follower"
+                stamp = {
+                    "shard": str(shard_id),
+                    "replica": replica.replica_id,
+                    "role": role,
+                }
+                emit(stamp, "up", {}, 1.0 if role != "down" else 0.0)
+                if role == "leader":
+                    for fam, labels, value in core_metrics.sample_registry():
+                        emit(stamp, fam, dict(labels), value)
+                elif role == "follower":
+                    log = getattr(replica, "log", None)
+                    if log is not None:
+                        emit(stamp, "jobset_ha_commit_seq", {},
+                             log.commit_seq)
+                        emit(stamp, "jobset_ha_term", {}, log.term)
+        return {
+            "view": "fleet",
+            "shards": len(self.handles),
+            "series": series,
+        }
+
 
 __all__ = ["ROUTER_JOURNAL_LIMIT", "ShardHandle", "ShardRouter"]

@@ -161,6 +161,14 @@ class Store:
         # point.
         self.last_jobset_commit: dict[str, dict] = {}
         self._load()
+        # Collect-time WAL-size gauge: the scrape pulls wal.size from the
+        # most recently opened store (the serving one — replicas only open
+        # a Store once they lead) instead of racing four push sites whose
+        # last write could be a follower's. Weakref-bound: a closed store
+        # silently unbinds.
+        from ..core import metrics
+
+        metrics.store_wal_bytes.bind(self, lambda s: s.wal.size)
 
     # ------------------------------------------------------------------
     # Cold-start load (files -> self._state)
@@ -232,9 +240,6 @@ class Store:
         # new leader commits its recovered tail by replicating past it —
         # the Raft convention of committing prior-term entries implicitly.
         self.commit_seq = self._seq
-        from ..core import metrics
-
-        metrics.store_wal_bytes.set(self.wal.size)
 
     @property
     def resource_version(self) -> int:
@@ -416,7 +421,6 @@ class Store:
         self._commits_since_snapshot += 1
         self.retry_pending = False
         metrics.store_commits_total.inc()
-        metrics.store_wal_bytes.set(self.wal.size)
         if not self.replicated:
             # Replicated leaders compact via maybe_compact() AFTER the
             # quorum acks this record: a snapshot must only ever fold
@@ -472,9 +476,6 @@ class Store:
         """Truncate a torn tail left by a failed append; the un-journaled
         diff stays pending and the next commit() retries it."""
         self.wal.repair()
-        from ..core import metrics
-
-        metrics.store_wal_bytes.set(self.wal.size)
 
     def compact(self) -> None:
         """Fold the WAL into a fresh full snapshot: write-temp, fsync,
@@ -488,13 +489,15 @@ class Store:
         self.wal.reset()
         self._commits_since_snapshot = 0
         metrics.store_snapshot_seconds.observe(time.perf_counter() - t0)
-        metrics.store_wal_bytes.set(self.wal.size)
 
     def flush(self) -> None:
         """fsync the WAL (drain path; appends already fsync per record)."""
         self.wal.flush()
 
     def close(self) -> None:
+        from ..core import metrics
+
+        metrics.store_wal_bytes.unbind(self)
         self.wal.close()
         if self._lock_fd is not None:
             os.close(self._lock_fd)  # releases the flock

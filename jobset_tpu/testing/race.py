@@ -405,6 +405,22 @@ def default_watchlist() -> dict[type, frozenset]:
 
     add(_shard_router, ("_events", "_rv", "_trimmed_rv", "_cursors",
                         "_planned_homes"))
+
+    def _tsdb():
+        from ..obs.tsdb import TimeSeriesStore
+
+        return TimeSeriesStore
+
+    # The wall sampler thread appends while HTTP handlers query/snapshot.
+    add(_tsdb, ("_series", "_first_ts"))
+
+    def _alert_manager():
+        from ..obs.alerts import AlertManager
+
+        return AlertManager
+
+    # evaluate() (sampler tick) vs state()/transition_log() (handlers).
+    add(_alert_manager, ("_active", "_transitions"))
     return out
 
 

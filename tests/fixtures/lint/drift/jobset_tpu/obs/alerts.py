@@ -1,0 +1,28 @@
+"""Drift fixture for DRF005: one alert documented in
+docs/observability.md (stays green), one missing its doc row (fires),
+while the docs table carries one stale name (fires the other way).
+Recording rules must be ignored entirely."""
+
+DEFAULT_RULE_SET = {
+    "groups": [
+        {
+            "name": "fixture-defaults",
+            "rules": [
+                {
+                    "record": "fixture:ignored:rate1m",
+                    "expr": "sum(rate(fixture_total[60s]))",
+                },
+                {
+                    "alert": "FixtureDocumentedAlert",
+                    "expr": "increase(fixture_total[300s]) > 0",
+                    "for": "0s",
+                },
+                {
+                    "alert": "FixtureUndocumentedAlert",
+                    "expr": "sum(rate(fixture_total[60s])) > 1",
+                    "for": "60s",
+                },
+            ],
+        }
+    ]
+}

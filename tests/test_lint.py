@@ -298,6 +298,11 @@ def test_drift_fires_in_both_directions():
     drf4 = [f.message for f in visible(report, "DRF004")]
     assert any("/fixture/unclassified" in m for m in drf4), messages
     assert any("/fixture/stale" in m for m in drf4), messages
+    drf5 = [f.message for f in visible(report, "DRF005")]
+    assert any("FixtureUndocumentedAlert" in m for m in drf5), messages
+    assert any("FixtureStaleAlert" in m for m in drf5), messages
+    # Recording rules carry no alert name and must not be scanned.
+    assert not any("fixture:ignored" in m for m in drf5), messages
 
 
 def test_drift_route_discovery_sees_every_route_shape():
@@ -335,6 +340,7 @@ def test_drift_documented_entries_are_clean():
     for clean_name in (
         "fixture_documented_total",
         "FixtureDocumentedGate",
+        "FixtureDocumentedAlert",
         "'fixture.documented'",
         "'/fixture/classified'",
         "'/fixture/sub/'",
@@ -348,6 +354,7 @@ def test_drift_documented_entries_are_clean():
 def test_drift_rows_outside_feature_gates_section_ignored():
     report = fixture_engine("drift").run([])
     assert not any("NotAGateRow" in f.message for f in report.visible)
+    assert not any("NotAnAlertRow" in f.message for f in report.visible)
 
 
 # ---------------------------------------------------------------------------
