@@ -2752,6 +2752,185 @@ def _bank_shards(result: dict) -> None:
     _bank_sidecar_key("shards", result)
 
 
+def run_migrate_bench(args) -> dict:
+    """Self-driving migration bench (`--migrate`, docs/sharding.md
+    "Replica migration"): homed-shard write availability THROUGH a
+    region isolation, static plane vs `--auto-migrate` plane.
+
+    Both planes are built identically (same seed, same topology, same
+    home-majority placement) and driven through the same campaign: cut
+    the victim shard's home region, then attempt writes to that shard
+    through the front door for the whole window. The static plane's
+    quorum-homed shard is CP-dark for the duration (the banked shards
+    bench's 0% homed figure); the migrating plane's joint-consensus
+    walk re-homes the quorum out of the dark region mid-window, so
+    availability recovers while the region is still cut. The banked
+    contract: migrating homed-shard availability strictly above the
+    static figure, zero lost acked writes in both modes."""
+    import http.client
+    import shutil
+    import tempfile
+
+    from jobset_tpu.api import serialization
+    from jobset_tpu.chaos.injector import FaultInjector
+    from jobset_tpu.chaos.net import PartitionPlan
+    from jobset_tpu.shard import ShardedControlPlane
+    from jobset_tpu.testing import make_jobset, make_replicated_job
+
+    # Seed 31 is the rolling-campaign seed: with 2 shards the victim
+    # shard homes OUTSIDE the front-door region, so its home can be cut
+    # without severing the router itself.
+    seed = 31
+    window_s = 10.0
+    api = "/apis/jobset.x-k8s.io/v1alpha2/namespaces/default/jobsets"
+
+    template = serialization.to_dict(
+        make_jobset("template")
+        .replicated_job(
+            make_replicated_job("w").replicas(1)
+            .parallelism(1).completions(1).obj()
+        )
+        .suspend(True)
+        .obj()
+    )
+
+    def one_write(conn, name: str):
+        doc = json.loads(json.dumps(template))
+        doc["metadata"]["name"] = name
+        conn.request("POST", api, json.dumps(doc).encode(),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        resp.read()
+        return (
+            resp.status == 201 and not resp.getheader("Warning"),
+            resp.status,
+        )
+
+    def campaign(auto_migrate: bool) -> dict:
+        base_dir = tempfile.mkdtemp(
+            prefix=f"bench-migrate-{'auto' if auto_migrate else 'static'}-"
+        )
+        injector = FaultInjector(seed=seed)
+        PartitionPlan(seed=seed, injector=injector)
+        plane = ShardedControlPlane(
+            base_dir, shards=2, replicas_per_shard=3, seed=seed,
+            injector=injector, auto_migrate=auto_migrate,
+            placement_stickiness_ms=100.0 if auto_migrate else 0.0,
+            migration_hysteresis_steps=2,
+            lease_duration=0.5, retry_period=0.1, tick_interval=0.05,
+        )
+        plane.start_supervisor()
+        try:
+            front = plane.topology.front_door_region
+            victim = next(
+                (s for s in range(plane.map.shards)
+                 if plane.map.homes[s] != front),
+                None,
+            )
+            if victim is None:
+                return {"skipped": "every shard homes in the front-door "
+                                   f"region ({front})"}
+            region = plane.map.homes[victim]
+            host, _, port = plane.address.rpartition(":")
+            conn = http.client.HTTPConnection(host, int(port), timeout=2)
+            acked: list = []
+            # Warmup: the homed shard acks clean before the cut.
+            for i in range(2):
+                name = plane.map.key_for_shard(victim, i, prefix="mw")
+                clean, status = one_write(conn, name)
+                if not clean:
+                    raise RuntimeError(
+                        f"warmup write {i} failed pre-cut: HTTP {status}"
+                    )
+                acked.append(name)
+            plane.isolate_region(region)
+            attempts, clean_acks = 0, 0
+            first_ack_s = None
+            t0 = time.perf_counter()
+            i = 100
+            while time.perf_counter() - t0 < window_s:
+                name = plane.map.key_for_shard(victim, i, prefix="mig")
+                try:
+                    clean, _status = one_write(conn, name)
+                except (OSError, http.client.HTTPException):
+                    conn.close()
+                    conn = http.client.HTTPConnection(host, int(port),
+                                                      timeout=2)
+                    clean = False
+                attempts += 1
+                if clean:
+                    clean_acks += 1
+                    acked.append(name)
+                    if first_ack_s is None:
+                        first_ack_s = time.perf_counter() - t0
+                i += 1
+                time.sleep(0.02)
+            conn.close()
+            plane.heal_region(region)
+            # Let the plane settle (election post-heal; with migration,
+            # the controller's convergence gate) before the zero-lost
+            # audit against the final leader.
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                group = plane.shard_groups[victim]
+                settled = (not auto_migrate) or plane.migrations.settled()
+                if group.leader() is not None and settled:
+                    break
+                time.sleep(0.05)
+            leader = plane.shard_groups[victim].leader()
+            if leader is None:
+                raise RuntimeError(
+                    f"shard {victim} never re-elected after the heal"
+                )
+            final = leader.store.serialized_state()["jobsets"]
+            lost = [n for n in acked if f"default/{n}" not in final]
+            out = {
+                "victim_shard": victim,
+                "victim_region": region,
+                "attempts": attempts,
+                "clean_acks": clean_acks,
+                "homed_availability_pct": round(
+                    100.0 * clean_acks / attempts, 2
+                ) if attempts else None,
+                "time_to_first_ack_s": (
+                    round(first_ack_s, 2) if first_ack_s is not None
+                    else None
+                ),
+                "lost_acked": len(lost),
+            }
+            if auto_migrate:
+                desc = plane.migrations.describe()
+                out["moves"] = len(desc["history"])
+                out["move_outcomes"] = [
+                    m["outcome"] for m in desc["history"]
+                ]
+                out["settled"] = desc["settled"]
+            return out
+        finally:
+            plane.stop()
+            shutil.rmtree(base_dir, ignore_errors=True)
+
+    static = campaign(auto_migrate=False)
+    migrating = campaign(auto_migrate=True)
+    gain = None
+    if not static.get("skipped") and not migrating.get("skipped"):
+        gain = round(
+            (migrating["homed_availability_pct"] or 0.0)
+            - (static["homed_availability_pct"] or 0.0), 2
+        )
+    return {
+        "seed": seed,
+        "window_s": window_s,
+        "static": static,
+        "migrating": migrating,
+        "availability_gain_pct": gain,
+    }
+
+
+def _bank_migrate(result: dict) -> None:
+    _bank_sidecar_key("migrate", result)
+
+
 def run_partition_bench(args) -> dict:
     """Partition-tolerance bench (docs/ha.md "Consistency guarantees"):
     a 3-replica set under a real leader isolation.
@@ -4205,6 +4384,14 @@ def main() -> int:
              "'shards'",
     )
     parser.add_argument(
+        "--migrate", action="store_true",
+        help="run ONLY the self-driving migration bench (2-shard plane, "
+             "home-region isolation; homed-shard write availability "
+             "through the window, static vs --auto-migrate joint-"
+             "consensus re-homing, zero lost acked writes) and bank it "
+             "into BENCH_PLACEMENT_TPU_LAST.json under 'migrate'",
+    )
+    parser.add_argument(
         "--partition", action="store_true",
         help="run ONLY the partition-tolerance bench (3-replica quorum, "
              "10s leader isolation via the network fault model; majority-"
@@ -4314,6 +4501,19 @@ def main() -> int:
             "metric": "shard_scaling_speedup",
             "value": result["speedup_vs_one_shard"],
             "unit": "x vs 1 shard",
+            "detail": result,
+        }))
+        return 0
+
+    if args.migrate:
+        # Pure control-plane bench: the walk runs over in-process quorum
+        # groups (suspended gangs, greedy placement), no accelerator.
+        result = run_migrate_bench(args)
+        _bank_migrate(result)
+        print(json.dumps({
+            "metric": "migrate_homed_availability",
+            "value": result["migrating"].get("homed_availability_pct"),
+            "unit": "% through a home-region cut",
             "detail": result,
         }))
         return 0

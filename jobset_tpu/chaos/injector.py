@@ -60,6 +60,14 @@ just names):
                        error kind makes that dispatch answer
                        503 + shard-leader hint (the unroutable path, as
                        if the shard were dark), ``latency`` delays it
+``shard.migrate``      migration controller (shard/migrate.py): one
+                       arrival per controller step of an ACTIVE
+                       joint-consensus move — ``stall`` holds the walk
+                       a step, ``break`` fails the current learner-sync
+                       attempt (retried next step, bounded by the sync
+                       budget), ``abort`` (or any other error kind)
+                       triggers the abort-unwind back to the pre-move
+                       membership
 ================== ======================================================
 
 Spec grammar (CLI ``--inject`` / ``FaultInjector.from_spec``)::

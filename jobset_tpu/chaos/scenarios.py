@@ -1060,7 +1060,7 @@ class PartitionHarness:
             _t.sleep(0.03)
         raise RuntimeError("a leader kept serving past the deadline")
 
-    def reconcile(self, replica) -> dict:
+    def reconcile_replica(self, replica) -> dict:
         """Post-heal log reconciliation of a (demoted or lagging)
         follower against the quorum — the rejoin path: divergent tails
         from its deposed epoch are truncated, the quorum's tail copied."""
@@ -1161,7 +1161,7 @@ def leader_isolated(base_dir: str, seed: int = 13,
         harness.read("reader", server=old_server)
         # Heal and reconcile the deposed leader to the exact quorum log.
         harness.plan.heal_all(step=2)
-        rejoin = harness.reconcile(old)
+        rejoin = harness.reconcile_replica(old)
         position = old.log.position()
         return harness.result("leader_isolated", extra={
             "read_fence": read_fence,
@@ -1289,7 +1289,7 @@ def asymmetric_link(base_dir: str, seed: int = 23,
         # The healthy reverse direction: the victim pulls the missing
         # tail itself (catch-up probes leader + other follower — its own
         # outbound links are NOT cut).
-        pull = harness.reconcile(victim)
+        pull = harness.reconcile_replica(victim)
         pulled_position = victim.log.position()
         harness.plan.heal_all(step=2)
         harness.write("writer", "asym-final")
@@ -1378,7 +1378,10 @@ class ShardedHarness:
     ROUTER_KEY = "__router__"
 
     def __init__(self, base_dir: str, seed: int = 31, shards: int = 2,
-                 read_fence: bool = True, spread_shards=()):
+                 read_fence: bool = True, spread_shards=(),
+                 auto_migrate: bool = False,
+                 placement_stickiness_ms: float = 0.0,
+                 migration_hysteresis_steps: int = 2):
         from ..shard import ShardedControlPlane
         from ..verify import HistoryRecorder
         from .net import PartitionPlan
@@ -1395,6 +1398,9 @@ class ShardedHarness:
             # Columnar core ON (docs/columnar.md graduation plan): the
             # scenario's seeded byte-identity gate runs on the mirror.
             cluster_factory=_columnar_cluster,
+            auto_migrate=auto_migrate,
+            placement_stickiness_ms=placement_stickiness_ms,
+            migration_hysteresis_steps=migration_hysteresis_steps,
         )
         # Per-shard register names: deterministic probes into each
         # shard's keyspace.
@@ -1563,11 +1569,18 @@ class ShardedHarness:
                     value = (meta.get("labels") or {}).get("v")
                 state[key] = value
             final_states[shard] = state
+        memberships = {
+            shard: [list(s) for s in group.membership_log]
+            for shard, group in enumerate(
+                self.plane.shard_groups[: self.plane.map.shards]
+            )
+        }
         report = check_sharded_history(
             self.recorder.snapshot(),
             self.scope_of,
             final_states=final_states,
             register_keys=register_keys,
+            memberships=memberships,
         )
         return {
             "scenario": scenario,
@@ -1580,6 +1593,9 @@ class ShardedHarness:
             "injection_log": self.injector.log_snapshot(),
             "final_keys": {
                 str(s): sorted(state) for s, state in final_states.items()
+            },
+            "memberships": {
+                str(k): v for k, v in sorted(memberships.items())
             },
             **(extra or {}),
         }
@@ -1729,6 +1745,279 @@ def region_shard_consistency(base_dir: str, seed: int = 31,
             "converged": (
                 position["lastSeq"] == new.store.seq
                 and position["commitSeq"] == new.store.commit_seq
+            ),
+        })
+    finally:
+        harness.stop()
+
+
+def _await_migrations_settled(harness, tag: str,
+                              deadline_s: float = 90.0) -> None:
+    """Drive ``plane.step()`` until the migration controller reports no
+    active move AND every shard satisfies the walk-completion rule. The
+    step COUNT to convergence is timing-dependent (elections wait out
+    lease expiry) but never enters the byte-identity artifact: the
+    ``shard.migrate`` point draws no RNG while the scenario schedules
+    no rules there, so extra steps leave the injection log untouched."""
+    import time as _t
+
+    deadline = _t.monotonic() + deadline_s
+    while not harness.plane.migrations.settled():
+        if _t.monotonic() > deadline:
+            raise RuntimeError(
+                f"{tag}: migration walks never settled "
+                f"({harness.plane.migrations.describe()['active']})"
+            )
+        harness.plane.step()
+        _t.sleep(0.02)
+
+
+def rolling_region_outage(base_dir: str, seed: int = 31,
+                          read_fence: bool = True,
+                          teeth_kill: bool = False) -> dict:
+    """The self-driving migration campaign (docs/sharding.md): a
+    2-shard plane with ``--auto-migrate`` semantics rolls through TWO
+    region outages, and the joint-consensus walk carries each shard's
+    quorum out of every dark region while ack-gated writes keep
+    flowing. Same checker, same artifact discipline as
+    ``region_shard_consistency`` — plus the membership invariants
+    (consecutive voting sets differ by one replica; consecutive
+    majorities always intersect).
+
+    Round 1 — the DARK-MINORITY-LEADER cut: shard 1 is spread (one
+    replica per region) and its home-region leader is the only replica
+    behind the cut. The leader steps down on quorum loss, the
+    out-of-region majority elects, and ONE move (evacuate the stranded
+    voter into a learner at the re-solved home) re-homes the quorum.
+    The fence teeth ride along exactly as in the single-cut scenario: a
+    session that observed v=3 zombie-reads the deposed leader's
+    still-connected surface before the walk retires it.
+
+    Round 2 — the DARK-MAJORITY cut: the NEW home region (now holding
+    the shard's majority) is cut. A reachable leader cannot commit; the
+    dark-region replica that takes over CAN (its same-region peer +
+    post-cut learners), so the walk proceeds *from inside the dark
+    region* and retires the dark leader last — the availability clause.
+    The proof is a plain BLOCKING front-door write: it retries
+    (stepping the plane, hence the walk) until the walk lands
+    leadership back in a reachable region and the write acks clean.
+
+    Hysteresis teeth on every heal: placement re-solves with
+    ``stickiness_ms`` discounting the incumbent home, so healing a
+    region must trigger ZERO new moves — asserted by comparing
+    migration-history length across each heal.
+
+    ``teeth_kill=True`` hard-kills the walking leader mid-move in
+    round 1 (learner added, victim not yet retired). The term fence
+    aborts the move on the next observed leader, the unwind retires
+    the learner (never a ghost voter), and — after the heal restores a
+    committable quorum — a fresh walk completes and the checker stays
+    green."""
+    harness = ShardedHarness(
+        base_dir, seed=seed, read_fence=read_fence, spread_shards=(1,),
+        auto_migrate=True, placement_stickiness_ms=100.0,
+        migration_hysteresis_steps=2,
+    )
+    import time as _t
+
+    try:
+        plane = harness.plane
+        teeth_shard, steady_shard = 1, 0
+        first_home = plane.map.homes[teeth_shard]
+        if first_home == plane.topology.front_door_region:
+            raise RuntimeError(
+                "seed places the teeth shard in the front-door region; "
+                "pick another seed"
+            )
+        # Phase 1: baseline on both shards + cross-shard reads.
+        for shard in (steady_shard, teeth_shard):
+            for i in range(2):
+                harness.write(
+                    "writer",
+                    plane.map.key_for_shard(shard, i, prefix="led"),
+                )
+            harness.write("writer", harness.registers[shard],
+                          labels={"v": "1"})
+            harness.write("writer", harness.registers[shard],
+                          labels={"v": "2"}, update=True)
+        harness.read_router("router-reader")
+        harness.read_shard("reader", teeth_shard)
+
+        group = plane.shard_groups[teeth_shard]
+        rounds = []
+        killed = None
+
+        # ---- Round 1: cut the spread shard's home (dark minority
+        # leader — the fence teeth round).
+        cut1 = plane.homes[teeth_shard]
+        old = group.leader()
+        old_server = old.server
+        planned1 = plane.isolate_region(cut1, step=1)
+        warn_name = plane.map.key_for_shard(teeth_shard, 9, prefix="warn")
+        warn_op = harness.recorder.invoke(
+            "writer", "write", f"default/{warn_name}",
+        )
+        status, _payload, headers = _http_call(
+            group.address, "POST", _API_JOBSETS,
+            _suspended_gang_yaml(warn_name),
+        )
+        term, replica = _replication_identity(headers)
+        harness.recorder.complete(
+            warn_op, status is not None and 200 <= (status or 0) < 300,
+            status=status, term=term, replica=replica,
+            acked=bool(status and 200 <= status < 300
+                       and not _header(headers, "Warning")),
+        )
+        harness.await_lost_quorum(old)
+        new = harness.await_leader(teeth_shard, other_than=old)
+        harness.write("writer", harness.registers[teeth_shard],
+                      labels={"v": "3"}, update=True)
+        harness.read_shard("reader", teeth_shard)
+        # THE zombie read: before any plane.step() can retire the
+        # deposed leader, a session that saw v=3 asks its surface.
+        harness.read_shard("reader", teeth_shard, server=old_server)
+
+        if teeth_kill:
+            # Drive the walk to its mid-step (learner added, victim
+            # still a voter) and crash the walking leader. The fence
+            # must abort-unwind the move; the cut + the crash together
+            # leave NO committable quorum until the heal.
+            deadline = _t.monotonic() + 60.0
+            while True:
+                plane.step()
+                active = plane.migrations.describe()["active"]
+                move = active.get(str(teeth_shard))
+                if move and move.get("learner"):
+                    break
+                if _t.monotonic() > deadline:
+                    raise RuntimeError("walk never reached its mid-step")
+                _t.sleep(0.02)
+            killed = group.kill_leader()
+        else:
+            # Live writes ride through the walk: two while it runs, two
+            # after it settles.
+            for i in range(2, 4):
+                harness.write(
+                    "writer",
+                    plane.map.key_for_shard(teeth_shard, i, prefix="led"),
+                )
+            _await_migrations_settled(harness, "round1")
+            for i in range(4, 6):
+                harness.write(
+                    "writer",
+                    plane.map.key_for_shard(teeth_shard, i, prefix="led"),
+                )
+            voter_regions = {
+                r.replica_id: plane.replica_region.get(r.replica_id)
+                for r in group.replicas
+            }
+            if cut1 in voter_regions.values():
+                raise RuntimeError(
+                    f"round 1 left a voter in the dark region: "
+                    f"{voter_regions}"
+                )
+        history_before_heal = len(
+            plane.migrations.describe()["history"]
+        )
+        plane.heal_region(cut1, step=2)
+        _await_migrations_settled(harness, "heal1")
+        rounds.append({
+            "cut": cut1,
+            "home_after": plane.homes[teeth_shard],
+            "moves_on_heal": (
+                len(plane.migrations.describe()["history"])
+                - history_before_heal
+            ),
+        })
+
+        if not teeth_kill:
+            # ---- Round 2: cut the NEW home (dark majority — the
+            # availability round).
+            cut2 = plane.homes[teeth_shard]
+            old2 = group.leader()
+            plane.isolate_region(cut2, step=3)
+            warn2 = plane.map.key_for_shard(teeth_shard, 8, prefix="warn")
+            warn_op2 = harness.recorder.invoke(
+                "writer", "write", f"default/{warn2}",
+            )
+            status2, _payload2, headers2 = _http_call(
+                group.address, "POST", _API_JOBSETS,
+                _suspended_gang_yaml(warn2),
+            )
+            term2, replica2 = _replication_identity(headers2)
+            harness.recorder.complete(
+                warn_op2,
+                status2 is not None and 200 <= (status2 or 0) < 300,
+                status=status2, term=term2, replica=replica2,
+                acked=bool(status2 and 200 <= status2 < 300
+                           and not _header(headers2, "Warning")),
+            )
+            harness.await_lost_quorum(old2)
+            harness.await_leader(teeth_shard, other_than=old2)
+            # THE availability proof: a blocking front-door write. Its
+            # retry loop steps the plane — driving the walk out of the
+            # dark region — and returns only on a CLEAN majority ack,
+            # which requires leadership back in a reachable region.
+            blocking_status, blocking_attempts = harness.write(
+                "writer",
+                plane.map.key_for_shard(teeth_shard, 6, prefix="led"),
+            )
+            _await_migrations_settled(harness, "round2")
+            harness.write("writer", harness.registers[teeth_shard],
+                          labels={"v": "4"}, update=True)
+            steady_attempts = []
+            for i in range(2, 4):
+                _s, attempts = harness.write(
+                    "writer",
+                    plane.map.key_for_shard(steady_shard, i, prefix="led"),
+                )
+                steady_attempts.append(attempts)
+            history_before_heal = len(
+                plane.migrations.describe()["history"]
+            )
+            plane.heal_region(cut2, step=4)
+            _await_migrations_settled(harness, "heal2")
+            rounds.append({
+                "cut": cut2,
+                "home_after": plane.homes[teeth_shard],
+                "moves_on_heal": (
+                    len(plane.migrations.describe()["history"])
+                    - history_before_heal
+                ),
+            })
+            harness.read_shard("reader", teeth_shard)
+            harness.read_router("router-reader")
+        else:
+            blocking_status, blocking_attempts = None, None
+            steady_attempts = []
+            # Post-heal, post-kill: the walk must have restarted and
+            # re-homed the shard despite the crashed voter.
+            harness.write("writer", harness.registers[teeth_shard],
+                          labels={"v": "4"}, update=True)
+            harness.read_shard("reader", teeth_shard)
+
+        migrations = plane.migrations.describe()
+        ghost_learners = [r.replica_id for r in group.learners]
+        return harness.result("rolling_region_outage", extra={
+            "read_fence": read_fence,
+            "teeth_kill": teeth_kill,
+            "teeth_shard": teeth_shard,
+            "rounds": rounds,
+            "deposed": old.replica_id,
+            "new_leader": new.replica_id,
+            "killed": killed,
+            "planned_homes_round1": {
+                str(k): v for k, v in sorted(planned1.items())
+            },
+            "blocking_write": {
+                "status": blocking_status,
+                "attempts": blocking_attempts,
+            },
+            "steady_shard_attempts": steady_attempts,
+            "migrations": migrations,
+            "ghost_learners": ghost_learners,
+            "retired": sorted(
+                r.replica_id for r in group.retired
             ),
         })
     finally:

@@ -171,6 +171,13 @@ def _build_parser() -> argparse.ArgumentParser:
                         "front door)")
     c.add_argument("--shard-replicas", type=int, default=3,
                    help="replicas per shard group (--shards mode)")
+    c.add_argument("--auto-migrate", action="store_true",
+                   help="self-driving shard migration (--shards mode, "
+                        "docs/sharding.md): every placement re-solve "
+                        "feeds the migration controller, which executes "
+                        "home changes as joint-consensus replica walks "
+                        "(add learner -> sync -> promote -> retire); "
+                        "watch progress at /debug/migrations")
     c.add_argument("--telemetry", action="store_true",
                    help="enable the embedded telemetry TSDB + rule "
                         "engine: the registry is sampled every "
@@ -550,6 +557,7 @@ def _cmd_controller_sharded(args) -> int:
         tick_interval=args.tick_interval,
         address=args.addr,
         flow=flow,
+        auto_migrate=bool(getattr(args, "auto_migrate", False)),
     )
     # Telemetry hangs off the front door (no cluster of its own): the
     # sampler sees the process-global registry — which IS the whole

@@ -526,6 +526,19 @@ shard_resolves_total = Counter(
     "Shard-home placement re-solves (topology changes: region "
     "cut/heal) run through the assignment-solver cost model",
 )
+shard_migrations_total = Counter(
+    "jobset_shard_migrations_total",
+    "Joint-consensus migration phase transitions per phase "
+    "(add/sync/promote/retire/complete) and outcome (ok/abort/noquorum) "
+    "— the MigrationController's walk ledger (docs/sharding.md)",
+    label_names=("phase", "outcome"),
+)
+shard_learner_lag_records = Gauge(
+    "jobset_shard_learner_lag_records",
+    "Leader's view of each non-voting learner replica's replication lag "
+    "in WAL records (0 = caught up, the promotion gate of a migration)",
+    label_names=("peer",),
+)
 
 # Telemetry time-series plane (jobset_tpu/obs/tsdb.py + rules.py +
 # alerts.py, docs/observability.md): the embedded TSDB that samples this
@@ -599,6 +612,7 @@ ALL_COUNTERS = (
     shard_unroutable_total,
     shard_misroutes_total,
     shard_resolves_total,
+    shard_migrations_total,
     telemetry_samples_total,
     telemetry_rule_evals_total,
     alerts_transitions_total,
@@ -631,6 +645,7 @@ ALL_GAUGES = (
     policy_model_loaded,
     flow_inflight,
     shard_count,
+    shard_learner_lag_records,
     telemetry_series,
     alerts_firing,
 )

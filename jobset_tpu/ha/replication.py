@@ -424,6 +424,7 @@ class FollowerLog:
             rv = int(doc.get("rv", 0))
             counters = dict(doc.get("counters") or {})
             last_term = int(doc.get("lastTerm", 0))
+            membership = doc.get("membership")
             for entry in committed:
                 record = json.loads(entry["payload"])
                 for op in record.get("ops", ()):
@@ -434,6 +435,12 @@ class FollowerLog:
                 rv = int(record.get("rv", rv))
                 counters = dict(record.get("counters") or counters)
                 last_term = int(record.get("term", last_term))
+                if "membership" in record:
+                    # Membership-change records (docs/sharding.md
+                    # "Replica migration") fold like any other: the last
+                    # committed voting set survives compaction, so a
+                    # promotion from this mirror still sees it.
+                    membership = record["membership"]
             new_doc = {
                 "seq": committed[-1]["seq"],
                 "rv": rv,
@@ -441,6 +448,8 @@ class FollowerLog:
                 "state": state,
                 "lastTerm": last_term,
             }
+            if membership is not None:
+                new_doc["membership"] = membership
             write_snapshot_file(self.data_dir, new_doc)
             tail = [e for e in self.records if e["seq"] > self.commit_seq]
             self.wal.reset()
@@ -716,9 +725,16 @@ class ReplicationCoordinator:
         term: int = 0,
         stepdown_after: int = 5,
         injector=None,
+        learners: Optional[list] = None,
     ):
         self.identity = identity
         self.peers = list(peers)
+        # Non-voting learner peers (docs/sharding.md "Replica
+        # migration"): shipped every frame exactly like voters but NEVER
+        # counted toward quorum — cluster_size/majority see voters only,
+        # so a learner can lag, stall, or die without moving the commit
+        # index or the stepdown math.
+        self.learners = list(learners or [])
         self.term = int(term)
         self.stepdown_after = max(1, int(stepdown_after))
         self.injector = injector
@@ -903,6 +919,14 @@ class ReplicationCoordinator:
                 acks += 1
             lag = entry["seq"] - self._peer_acked.get(peer.id, 0)
             metrics.ha_follower_lag_records.set(max(0, lag), peer.id)
+        for peer in self.learners:
+            # Learners ride the same ship path (position probe, resend
+            # buffer, snapshot install) but their acks are observability
+            # only — `acks` is untouched, so the quorum below is proven
+            # over voters alone.
+            self._ship(peer, entry["seq"])
+            lag = entry["seq"] - self._peer_acked.get(peer.id, 0)
+            metrics.shard_learner_lag_records.set(max(0, lag), peer.id)
         with self._flags_lock:
             quorum = acks >= self.majority and not self.fenced
             if quorum:
@@ -918,6 +942,35 @@ class ReplicationCoordinator:
         else:
             metrics.ha_quorum_failures_total.inc()
         return quorum
+
+    # -- membership (joint-consensus walk support) --------------------------
+
+    def set_membership(self, peers: list, learners: list = ()) -> None:
+        """Swap the voter/learner peer sets in one step (the supervisor's
+        add-learner/promote/retire transitions). Under the store guard:
+        the commit path iterates both lists while shipping, and the
+        migration controller mutates them from its own step thread —
+        swapping mid-ship would let a ship round count a half-applied
+        voting set toward majority."""
+        with self._store_guard():
+            self.peers = list(peers)
+            self.learners = list(learners)
+
+    def sync_learner(self, peer_id: str) -> int:
+        """Drive one ship round for the named learner and return its lag
+        in records (0 = caught up to the leader's head). The promotion
+        gate: a learner enters the voting set only at lag 0, so the
+        joint quorum never counts a replica that could not yet prove it
+        holds every acknowledged frame."""
+        with self._store_guard():
+            head = self.store.seq if self.store else 0
+            for peer in self.learners:
+                if peer.id != peer_id:
+                    continue
+                if self._ship(peer, head):
+                    return 0
+                return max(1, head - self._peer_acked.get(peer.id, 0))
+        raise ReplicationError(f"no learner peer {peer_id!r} attached")
 
     # -- introspection / catch-up source ------------------------------------
 

@@ -135,6 +135,12 @@ class ReplicaSet:
         # tear the replica's log/store handoff.
         self._supervise_lock = threading.Lock()
         lease_path = os.path.join(self.base_dir, "leader.lease")
+        # Kept for learner provisioning (add_learner mints replicas on
+        # the same naming/lease/timing scheme the constructor used).
+        self._name_prefix = name_prefix
+        self._lease_path = lease_path
+        self._lease_duration = lease_duration
+        self._retry_period = retry_period
         self.replicas = [
             Replica(
                 f"{name_prefix}-{i}",
@@ -147,6 +153,20 @@ class ReplicaSet:
             )
             for i in range(n)
         ]
+        # Joint-consensus membership state (docs/sharding.md "Replica
+        # migration"): learners replicate but never vote and never
+        # contend for the lease (step() visits self.replicas only);
+        # retired replicas are out of the group entirely, their
+        # data-dir locks released so the dirs are reusable.
+        self.learners: list[Replica] = []
+        self.retired: list[Replica] = []
+        # Every voting set this supervisor has installed, in order — the
+        # in-process mirror of Store.membership_log the verifier's
+        # single-change/quorum-overlap invariants check.
+        self.membership_log: list[list[str]] = [
+            sorted(r.replica_id for r in self.replicas)
+        ]
+        self._member_seq = n
         self._promotions = 0
 
     # ------------------------------------------------------------------
@@ -155,11 +175,28 @@ class ReplicaSet:
         # src identity makes every peer call one delivery over the
         # directed (src, dst) link of the network fault model: a cut
         # link refuses in-process exactly as HttpPeer would cross-process.
+        # Lock-free snapshot read: every MUTATION of self.replicas lives
+        # in a *_locked body under _supervise_lock; readers see either
+        # the pre- or post-change list (CPython list reads are atomic).
         return [
             LocalPeer(r.replica_id, r, src=replica.replica_id,
                       injector=self.injector)
             for r in self.replicas if r is not replica
         ]
+
+    def learner_peers_for(self, replica: Replica) -> list[LocalPeer]:
+        """LocalPeer transports for every learner, as seen from
+        `replica` (the leader): same directed-link fault model as
+        peers_for, but these are handed to the coordinator's `learners`
+        list — shipped, never counted."""
+        return [
+            LocalPeer(r.replica_id, r, src=replica.replica_id,
+                      injector=self.injector)
+            for r in self.learners
+        ]
+
+    def voter_ids(self) -> list[str]:
+        return sorted(r.replica_id for r in self.replicas)
 
     def leader(self) -> Optional[Replica]:
         for r in self.replicas:
@@ -197,10 +234,13 @@ class ReplicaSet:
                 # has a serving HTTP surface; without demotion it would
                 # shadow every standby forever. Tear it back to follower
                 # and fall through to the election below.
-                self.demote(current)
+                self._demote_locked(current)
             else:
                 return current
-        for replica in self.replicas:
+        # Snapshot the list: a successful promotion may adopt a durable
+        # voting set recorded mid-migration (WAL membership records),
+        # which edits self.replicas under our feet.
+        for replica in list(self.replicas):
             if not replica.alive or replica.server is not None:
                 continue
             if not replica.elector.ensure():
@@ -211,7 +251,7 @@ class ReplicaSet:
                 # need a deterministic winner.
                 return None
             try:
-                self.promote(replica)
+                self._promote_locked(replica)
             except NoQuorumError:
                 # Cannot prove we'd see every acknowledged write: hand the
                 # lease back and let the next candidate try this round.
@@ -247,7 +287,7 @@ class ReplicaSet:
         if replica.log is None:
             replica.log = FollowerLog(replica.data_dir)
 
-    def promote(self, replica: Replica) -> dict:
+    def _promote_locked(self, replica: Replica) -> dict:
         """Follower -> leader: catch up against a quorum, replay the
         committed log into a fresh Cluster via Store.recover, and take
         over the serving port (resourceVersion/uid continuity comes from
@@ -283,11 +323,20 @@ class ReplicaSet:
             else make_cluster()
         )
         store.recover(cluster)
+        if store.membership is not None:
+            # The durable voting set outranks our in-memory lists: a
+            # crash mid-migration may have committed a membership record
+            # (learner promoted / replica retired) whose supervisor-side
+            # bookkeeping died with the old leader. Reconcile BEFORE
+            # building the coordinator so its quorum math runs over the
+            # voting set recovery proved.
+            self._adopt_membership_locked(replica, store.membership)
         coordinator = ReplicationCoordinator(
             replica.replica_id,
             self.peers_for(replica),
             term=replica.elector.term,
             injector=self.injector,
+            learners=self.learner_peers_for(replica),
         )
         coordinator.bind(store)
         replica.coordinator = coordinator
@@ -315,7 +364,7 @@ class ReplicaSet:
             metrics.ha_failovers_total.inc()
         return stats
 
-    def demote(self, replica: Replica) -> None:
+    def _demote_locked(self, replica: Replica) -> None:
         """Leader -> follower (lost quorum / fenced): stop serving, close
         the store, and mirror again. The lease was already released by
         the pump's stepdown; stop(release_lease=False) keeps it that way
@@ -390,7 +439,201 @@ class ReplicaSet:
                 cluster_size=len(self.replicas),
             )
 
+    # ------------------------------------------------------------------
+    # Joint-consensus membership (docs/sharding.md "Replica migration")
+    # ------------------------------------------------------------------
+
+    def _close_out(self, replica: Replica) -> None:
+        """Release a retiring replica's process-local resources: stop
+        serving, close store/log — which releases the data-dir flocks,
+        so the dir is immediately reusable — and drop liveness so any
+        stale LocalPeer reference gets ConnectionError."""
+        if replica.server is not None:
+            replica.server.stop(release_lease=False)
+            replica.server = None
+        if replica.store is not None:
+            replica.store.close()
+            replica.store = None
+        replica.coordinator = None
+        if replica.log is not None:
+            replica.log.close()
+            replica.log = None
+        replica.alive = False
+
+    def _adopt_membership_locked(
+        self, leader: Replica, voters: list[str]
+    ) -> None:
+        """Reconcile the in-memory lists against a durable voting set
+        recovered from the WAL (under _supervise_lock, from promote()):
+        learners named in the set were promoted before the crash;
+        voters absent from it were retired. The promoting replica
+        itself is never removed — it holds the lease, and a set
+        excluding it would mean its own retirement committed, in which
+        case its lease would already be released."""
+        target = set(voters)
+        if set(self.voter_ids()) == target:
+            return
+        for r in [r for r in self.learners if r.replica_id in target]:
+            self.learners.remove(r)
+            self.replicas.append(r)
+        for r in [r for r in self.replicas
+                  if r.replica_id not in target and r is not leader]:
+            self.replicas.remove(r)
+            self._close_out(r)
+            self.retired.append(r)
+        self.replicas.sort(key=lambda r: r.replica_id)
+        self.membership_log.append(sorted(target))
+
+    def _commit_membership_locked(self, leader: Replica) -> bool:
+        """Durably record the CURRENT voting set: install it on the
+        leader's coordinator (Raft's new-configuration-applies-on-append
+        rule — quorum math switches to the new set immediately), append
+        one membership record to the leader's WAL, and replicate it.
+        Under the leader's cluster lock so the record interleaves
+        atomically with the commit path's own append+ship rounds.
+        Returns the replication quorum bool."""
+        voters = self.voter_ids()
+        self.membership_log.append(list(voters))
+        leader.coordinator.set_membership(
+            self.peers_for(leader),
+            self.learner_peers_for(leader),
+        )
+        store, coordinator = leader.store, leader.coordinator
+        with store.cluster.lock:
+            store.commit_membership(voters)
+            return coordinator.replicate()
+
+    def add_learner(self) -> Replica:
+        """Provision a fresh replica as a non-voting learner — the first
+        step of a joint-consensus home move. It mirrors the leader's log
+        (the coordinator's learner ship loop) but never votes, never
+        counts toward majority, and never contends for the lease
+        (step() visits self.replicas only)."""
+        with self._supervise_lock:
+            return self._add_learner_locked()
+
+    def _add_learner_locked(self) -> Replica:
+        leader = self.leader()
+        if leader is None or leader.coordinator is None:
+            raise RuntimeError("add_learner requires a serving leader")
+        replica_id = f"{self._name_prefix}-{self._member_seq}"
+        self._member_seq += 1
+        learner = Replica(
+            replica_id,
+            os.path.join(self.base_dir, replica_id),
+            self._lease_path,
+            clock=self.clock,
+            lease_duration=self._lease_duration,
+            retry_period=self._retry_period,
+            injector=self.injector,
+        )
+        self.learners.append(learner)
+        leader.coordinator.set_membership(
+            self.peers_for(leader),
+            self.learner_peers_for(leader),
+        )
+        return learner
+
+    def sync_learner(self, replica_id: str) -> int:
+        """One learner catch-up round via the leader's coordinator;
+        returns the remaining lag in records (0 = caught up to the
+        leader's head, the promotion gate)."""
+        with self._supervise_lock:
+            leader = self.leader()
+            if leader is None or leader.coordinator is None:
+                raise RuntimeError("sync_learner requires a serving leader")
+            return leader.coordinator.sync_learner(replica_id)
+
+    def promote_learner(self, replica_id: str) -> bool:
+        """Learner -> voter: one single-change joint-consensus step. The
+        caller has proven lag == 0 (sync_learner); consecutive voting
+        sets differ by exactly one replica, so any majority of the new
+        set intersects any majority of the old — quorum safety holds at
+        every interleaving, including a crash before the membership
+        record lands on a majority. Returns that record's quorum bool."""
+        with self._supervise_lock:
+            return self._promote_learner_locked(replica_id)
+
+    def _promote_learner_locked(self, replica_id: str) -> bool:
+        leader = self.leader()
+        if leader is None or leader.coordinator is None:
+            raise RuntimeError(
+                "promote_learner requires a serving leader"
+            )
+        learner = next(
+            (r for r in self.learners if r.replica_id == replica_id),
+            None,
+        )
+        if learner is None:
+            raise RuntimeError(f"no learner {replica_id!r} to promote")
+        self.learners.remove(learner)
+        self.replicas.append(learner)
+        self.replicas.sort(key=lambda r: r.replica_id)
+        return self._commit_membership_locked(leader)
+
+    def retire_replica(self, replica_id: str) -> bool:
+        """Remove a replica from the group — the demote-and-retire end
+        of a move, or the abort-unwind of a half-done one. Learners
+        detach with no membership record (they were never voters).
+        Voters leave via a single-change membership record committed by
+        the leader; when the retiree IS the leader it commits its own
+        removal first (a Raft leader may commit an entry removing
+        itself), then steps down and releases the lease so a remaining
+        voter takes over. Closing the retiree releases its data-dir
+        flock, so the dir is immediately reusable. Returns the
+        membership record's quorum bool (True for a learner detach)."""
+        with self._supervise_lock:
+            return self._retire_replica_locked(replica_id)
+
+    def _retire_replica_locked(self, replica_id: str) -> bool:
+        learner = next(
+            (r for r in self.learners if r.replica_id == replica_id),
+            None,
+        )
+        if learner is not None:
+            self.learners.remove(learner)
+            self._close_out(learner)
+            self.retired.append(learner)
+            leader = self.leader()
+            if leader is not None and leader.coordinator is not None:
+                leader.coordinator.set_membership(
+                    self.peers_for(leader),
+                    self.learner_peers_for(leader),
+                )
+            return True
+        replica = next(
+            (r for r in self.replicas if r.replica_id == replica_id),
+            None,
+        )
+        if replica is None:
+            raise RuntimeError(f"no replica {replica_id!r} to retire")
+        if len(self.replicas) <= 1:
+            raise RuntimeError("refusing to retire the last voter")
+        leader = self.leader()
+        self.replicas.remove(replica)
+        if replica is leader:
+            ok = self._commit_membership_locked(replica)
+            self._close_out(replica)
+            replica.elector.release()
+            self.retired.append(replica)
+            return ok
+        ok = True
+        if leader is not None and leader.coordinator is not None:
+            ok = self._commit_membership_locked(leader)
+        else:
+            # Leaderless: record the set in-memory only; the next
+            # promotion recovers whatever membership records exist
+            # and _adopt_membership reconciles the rest.
+            self.membership_log.append(self.voter_ids())
+        self._close_out(replica)
+        self.retired.append(replica)
+        return ok
+
     def stop(self) -> None:
+        for replica in self.learners:
+            if replica.log is not None:
+                replica.log.close()
+                replica.log = None
         for replica in self.replicas:
             if replica.server is not None:
                 try:

@@ -67,6 +67,12 @@ DEFAULT_RULE_SET = {
                         "(rate(jobset_restarts_total[300s]))",
                 },
                 {
+                    "record": "jobset:shard_migration_aborts:rate5m",
+                    "expr":
+                        "sum(rate(jobset_shard_migrations_total"
+                        "{outcome=\"abort\"}[300s]))",
+                },
+                {
                     "alert": "JobSetControlPlaneFailover",
                     "expr": "increase(jobset_ha_failovers_total[300s]) > 0",
                     "for": "0s",
@@ -87,6 +93,36 @@ DEFAULT_RULE_SET = {
                         "summary":
                             "the flow-control plane is shedding more than "
                             "1 req/s (429/watch_busy) over the last minute",
+                    },
+                },
+                {
+                    "alert": "JobSetShardQuorumDegraded",
+                    "expr":
+                        "increase("
+                        "jobset_ha_quorum_failures_total[60s]) > 0",
+                    "for": "0s",
+                    "labels": {"severity": "page"},
+                    "annotations": {
+                        "summary":
+                            "a shard leader failed to reach replication "
+                            "quorum in the last minute — a region cut or "
+                            "an in-flight replica migration has degraded "
+                            "a voting set (see /debug/migrations)",
+                    },
+                },
+                {
+                    "alert": "JobSetShardMigrationAborting",
+                    "expr":
+                        "sum(rate(jobset_shard_migrations_total"
+                        "{outcome=\"abort\"}[300s])) > 0",
+                    "for": "0s",
+                    "labels": {"severity": "ticket"},
+                    "annotations": {
+                        "summary":
+                            "replica migrations are abort-unwinding "
+                            "(term fence trips or membership commits "
+                            "missing quorum) — the shard plane is "
+                            "churning instead of converging",
                     },
                 },
                 {

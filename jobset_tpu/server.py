@@ -981,6 +981,32 @@ class ControllerServer:
             ]
             return events, floor, self._watch_trimmed_rv
 
+    def journal_tail_kinds(self, kinds, after_rv: int):
+        """Multi-kind journal pull (the front door's merged child-kind
+        watch): like ``journal_tail`` but returns ``(rv, kind, ns,
+        event)`` for every requested kind in ONE pass over the shared
+        rv-ordered journal — the router keeps a single per-shard cursor,
+        and pulling kinds separately against it would advance the
+        cursor past one kind's events while fetching another's."""
+        import bisect
+
+        wanted = set(kinds)
+        with self._watch_cond:
+            floor = self._watch_delivery_rv()
+            lo = bisect.bisect_right(
+                self._watch_events, after_rv, key=lambda t: t[0]
+            )
+            hi = bisect.bisect_right(
+                self._watch_events, floor, key=lambda t: t[0]
+            )
+            events = [
+                (rv, event_kind, event_ns, event)
+                for rv, event_kind, event_ns, event
+                in self._watch_events[lo:hi]
+                if event_kind in wanted
+            ]
+            return events, floor, self._watch_trimmed_rv
+
     def _activate_watch_kind(self, kind: str) -> None:
         """First list/watch of a child kind: seed its snapshot from current
         state (no synthetic ADDED flood — the caller's list already reflects
@@ -1534,6 +1560,15 @@ class ControllerServer:
                     "shardId": self.shard_id,
                 }
             return 404, {"error": "this server is not sharded"}
+        if path == "/debug/migrations" and method == "GET":
+            # Live replica-migration view (docs/sharding.md): desired
+            # homes, confirmation streaks, in-flight walks and the
+            # bounded history of completed/aborted moves.
+            migrations = getattr(self.shard_router, "migrations", None)
+            if migrations is None:
+                return 404, {"error": "this server is not a migrating "
+                                      "front door"}
+            return 200, migrations.describe()
         if path == "/leaderz":
             if self.elector is None:
                 return 200, {"leaderElection": False, "leading": True}
@@ -1720,17 +1755,21 @@ class ControllerServer:
                     return 400, {"error": "bad watch parameters"}
                 if self.shard_router is not None:
                     # Front door: cross-shard watches ride the router's
-                    # merged journal (jobsets only — child kinds are
-                    # watched against the owning shard's own surface,
-                    # which the hint machinery points at).
-                    if kind != "jobsets":
+                    # merged journal — jobsets and their child kinds
+                    # (jobs/pods/services) alike, so an informer never
+                    # has to chase a shard home across a replica
+                    # migration. The cluster-scoped event stream stays
+                    # shard-local: events are unkeyed (no owning shard)
+                    # and append-only, so a merged stream could not
+                    # honor the 410/relist contract.
+                    if kind == "events":
                         return 400, {"error": (
-                            f"the front door merges jobsets watches "
-                            f"only; watch {kind} against the owning "
-                            f"shard (see /debug/shards)"
+                            "the front door does not merge event "
+                            "streams; watch events against a shard's "
+                            "own surface (see /debug/shards)"
                         )}
                     return self.shard_router.watch(
-                        ns, rv, timeout_s,
+                        ns, rv, timeout_s, kind=kind,
                         park=watch_park, retry_hint=watch_hint,
                     )
                 if kind != "jobsets":
@@ -2152,6 +2191,13 @@ class ControllerServer:
                 rest[:1] == ["events"]
                 or (len(rest) >= 3 and rest[0] == "namespaces")
             ):
+                if len(rest) >= 3 and rest[2] in ("pods", "jobs",
+                                                  "services"):
+                    # Child-kind list: admit the kind into the merged
+                    # journal BEFORE the list's rv token is captured —
+                    # that is what closes the front door's list-then-
+                    # watch gap for informers of child kinds.
+                    router.activate_kind(rest[2])
                 return router.merged_list(full_path, headers=headers)
         return 404, {"error": f"no route for {method} {path}"}
 

@@ -1,0 +1,409 @@
+"""Self-driving shard migration: joint-consensus replica moves.
+
+The shard plane's placement solve (``resolve_placement``) produces
+*planned* homes; this module closes the loop ROADMAP item 2 names by
+executing a planned home change as an **add-then-remove walk** over the
+existing ``ReplicaSet`` machinery, one replica in flight per shard:
+
+1. **add** — a fresh replica joins the group in the target region as a
+   non-voting *learner* (``ReplicaSet.add_learner``): the leader ships it
+   every frame, it never votes, never counts toward majority, never
+   contends for the lease;
+2. **sync** — the learner streams to the exact log position
+   (``sync_learner`` returns the remaining lag; the gate is lag == 0);
+3. **promote** — the learner enters the voting set via one single-change
+   membership record (``promote_learner``): consecutive voting sets
+   differ by one replica, so any majority of the new set intersects any
+   majority of the old — quorum is provably intact at every
+   interleaving, including a crash anywhere mid-walk;
+4. **retire** — the victim replica leaves via the inverse single-change
+   record (``retire_replica``); its store/log close, releasing the
+   data-dir flock, and its region placement is forgotten.
+
+Every move is **term-fenced**: the leader term observed when the move
+began is pinned, and any step that finds a different leader term
+abort-unwinds the move back to the pre-move membership (retiring the
+learner — or, past promote, the just-promoted voter — is itself a
+single-change, so the unwind keeps the same quorum-overlap proof).
+While the group is leaderless the walk simply waits: neither fencing
+nor progress fires without a leader to observe.
+
+Walks are enqueued from the plane's re-solve trigger with **hysteresis**
+(two layers: the solver's ``stickiness_ms`` discount keeps marginally-
+cheaper alternatives from uprooting a settled quorum, and the
+controller's confirmation streak requires the same desired home for
+``hysteresis_steps`` consecutive steps before a move starts — a
+flapping link resets the streak and never thrashes replicas).
+
+A shard's walk is COMPLETE only when a replica majority lives in the
+desired home region AND no voter — the leader included, moved last —
+remains in an excluded (dark) region. The second clause is the
+availability half of the contract: a dark-region leader can keep its
+quorum through learners placed after the cut (their links were never
+scheduled), yet the front door still cannot reach it; only retiring it
+forces an election that lands leadership on a reachable voter.
+
+Chaos: each step of an ACTIVE move is one arrival at the
+``shard.migrate`` injection point — ``stall`` holds the walk a step,
+``break`` fails the current learner-sync attempt, ``abort`` (or any
+other error kind) triggers the abort-unwind.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+PHASE_ADD = "add"
+PHASE_SYNC = "sync"
+PHASE_PROMOTE = "promote"
+PHASE_RETIRE = "retire"
+
+# Completed/aborted move records kept for /debug/migrations.
+HISTORY_LIMIT = 256
+
+
+class MigrationController:
+    """Executes planned shard-home changes as joint-consensus walks.
+
+    Driven by ``step()`` from the plane's supervision cadence (the same
+    deterministic driver scenarios use); fed by ``note_plan()`` from
+    every placement re-solve. One replica move in flight per shard;
+    one phase transition per step, so seeded chaos interleaves with
+    every intermediate membership."""
+
+    def __init__(self, plane, hysteresis_steps: int = 2,
+                 max_sync_steps: int = 400, injector=None):
+        self.plane = plane
+        self.hysteresis_steps = max(1, int(hysteresis_steps))
+        # Sync attempts before the walk gives up (a learner that cannot
+        # reach log position — its stream chaos-broken every step —
+        # must unwind, not hold the shard's move slot forever).
+        self.max_sync_steps = max(1, int(max_sync_steps))
+        self.injector = injector
+        # Serializes walk advancement (step/abort): the plane's
+        # background supervisor steps from its thread while a scenario
+        # driver steps inline.
+        self._step_lock = threading.Lock()
+        # Leaf lock for the watched state below — never held across a
+        # group/coordinator call (those take the supervise/cluster
+        # locks; holding ours across them would order-invert against
+        # describe() readers).
+        self._lock = threading.Lock()
+        self._desired: dict[int, str] = {}  # guarded-by: _lock
+        self._excluded: frozenset = frozenset()  # guarded-by: _lock
+        self._streak: dict[int, int] = {}  # guarded-by: _lock
+        self._active: dict[int, dict] = {}  # guarded-by: _lock
+        self._history: list[dict] = []  # guarded-by: _lock
+
+    # -- plan intake ---------------------------------------------------------
+
+    def note_plan(self, planned: dict[int, str], excluded=frozenset()) -> None:
+        """Record the latest placement solve. A shard whose desired home
+        CHANGED restarts its confirmation streak — the hysteresis that
+        keeps flapping links from thrashing replicas."""
+        with self._lock:
+            for shard, home in planned.items():
+                if self._desired.get(shard) != home:
+                    self._streak[shard] = 0
+                self._desired[shard] = home
+            self._excluded = frozenset(excluded)
+
+    # -- introspection -------------------------------------------------------
+
+    def settled(self) -> bool:
+        """True when no move is in flight and every shard with a known
+        desired home satisfies the walk-completion rule (majority in
+        the desired home, no voter in an excluded region) — the
+        scenario driver's convergence gate."""
+        with self._lock:
+            if self._active:
+                return False
+            desired = dict(self._desired)
+            excluded = self._excluded
+        return all(
+            not self._walk_needed(shard, home, excluded)
+            for shard, home in desired.items()
+        )
+
+    def describe(self) -> dict:
+        """/debug/migrations payload: live moves, confirmation streaks,
+        and the bounded history of completed/aborted walks."""
+        settled = self.settled()
+        with self._lock:
+            return {
+                "settled": settled,
+                "hysteresisSteps": self.hysteresis_steps,
+                "desired": {str(k): v for k, v in sorted(
+                    self._desired.items())},
+                "excludedRegions": sorted(self._excluded),
+                "streaks": {str(k): v for k, v in sorted(
+                    self._streak.items())},
+                "active": {
+                    str(k): dict(m) for k, m in sorted(self._active.items())
+                },
+                "history": [dict(m) for m in self._history[-32:]],
+            }
+
+    # -- placement accounting ------------------------------------------------
+
+    def _voter_regions(self, shard: int) -> dict[str, Optional[str]]:
+        group = self.plane.shard_groups[shard]
+        return {
+            r.replica_id: self.plane.replica_region.get(r.replica_id)
+            for r in group.replicas
+        }
+
+    def _walk_needed(self, shard: int, desired: str,
+                     excluded: frozenset) -> bool:
+        regions = self._voter_regions(shard)
+        majority = len(regions) // 2 + 1
+        in_target = sum(1 for reg in regions.values() if reg == desired)
+        stranded = any(reg in excluded for reg in regions.values())
+        return in_target < majority or stranded
+
+    def _pick_victim(self, shard: int, desired: Optional[str],
+                     excluded: frozenset) -> Optional[str]:
+        """The replica this move evacuates: excluded-region voters
+        first, non-leaders before the leader (the leader moves LAST so
+        the group keeps a committing leader through every earlier
+        step), then — with no stranded voters — the first voter outside
+        the desired home (gathering the majority). Sorted ids keep
+        seeded runs picking identical victims."""
+        group = self.plane.shard_groups[shard]
+        leader = group.leader()
+        leader_id = leader.replica_id if leader is not None else None
+        regions = self._voter_regions(shard)
+        stranded = sorted(
+            rid for rid, reg in regions.items() if reg in excluded
+        )
+        if stranded:
+            non_leader = [rid for rid in stranded if rid != leader_id]
+            return non_leader[0] if non_leader else stranded[0]
+        outside = sorted(
+            rid for rid, reg in regions.items()
+            if reg != desired and rid != leader_id
+        )
+        if outside:
+            return outside[0]
+        return leader_id if regions.get(leader_id) != desired else None
+
+    def _pick_target_region(self, shard: int, desired: str,
+                            excluded: frozenset) -> str:
+        """Where this move's learner lands: the desired home while the
+        majority is still being gathered; afterwards (evacuating
+        stragglers) the first healthy non-home region, preserving the
+        out-of-region durability replica."""
+        regions = self._voter_regions(shard)
+        majority = len(regions) // 2 + 1
+        in_target = sum(1 for reg in regions.values() if reg == desired)
+        if in_target < majority:
+            return desired
+        for region in self.plane.topology.regions:
+            if region not in excluded and region != desired:
+                return region
+        return desired
+
+    # -- the walk ------------------------------------------------------------
+
+    def step(self) -> None:
+        """One controller round: advance every active move by at most
+        one phase; start a move for any shard whose desired home has
+        held for `hysteresis_steps` consecutive rounds."""
+        with self._step_lock:
+            with self._lock:
+                desired = dict(self._desired)
+                excluded = self._excluded
+                active_shards = set(self._active)
+            for shard in range(self.plane.map.shards):
+                if shard in active_shards:
+                    self._advance(shard, excluded)
+                    continue
+                home = desired.get(shard)
+                if home is None:
+                    continue
+                if not self._walk_needed(shard, home, excluded):
+                    with self._lock:
+                        self._streak[shard] = 0
+                    continue
+                with self._lock:
+                    self._streak[shard] = self._streak.get(shard, 0) + 1
+                    confirmed = self._streak[shard] >= self.hysteresis_steps
+                if not confirmed:
+                    continue
+                victim = self._pick_victim(shard, home, excluded)
+                if victim is None:
+                    continue
+                move = {
+                    "shard": shard,
+                    "phase": PHASE_ADD,
+                    "victim": victim,
+                    "targetRegion": self._pick_target_region(
+                        shard, home, excluded
+                    ),
+                    "desiredHome": home,
+                    "learner": None,
+                    "term": None,
+                    "syncSteps": 0,
+                }
+                with self._lock:
+                    self._active[shard] = move
+                self._advance(shard, excluded)
+
+    def _advance(self, shard: int, excluded: frozenset) -> None:
+        from ..chaos.injector import consult
+        from ..core import metrics
+
+        with self._lock:
+            move = self._active.get(shard)
+        if move is None:
+            return
+        group = self.plane.shard_groups[shard]
+        leader = group.leader()
+        if leader is None:
+            # Leaderless: neither progress nor fencing — the term fence
+            # only fires against an OBSERVED new leader, and every
+            # transition below needs a committing leader anyway.
+            return
+        term = leader.elector.term
+        if move["term"] is not None and term != move["term"]:
+            # A different epoch took over mid-walk: the move's quorum
+            # reasoning belonged to the fenced term. Unwind.
+            self._abort(move, f"term fence: {move['term']} -> {term}")
+            return
+        fault = consult(
+            "shard.migrate",
+            f"shard={shard} phase={move['phase']}",
+            injector=self.injector,
+        )
+        if fault is not None:
+            if fault.kind == "stall":
+                return  # the walk holds this step
+            if fault.kind == "break" and move["phase"] == PHASE_SYNC:
+                move = dict(move, syncSteps=move["syncSteps"] + 1)
+                if move["syncSteps"] >= self.max_sync_steps:
+                    self._abort(move, "learner stream broken past budget")
+                    return
+                with self._lock:
+                    self._active[shard] = move
+                return  # this sync attempt failed; retry next step
+            self._abort(move, f"chaos {fault.kind}")
+            return
+        phase = move["phase"]
+        try:
+            if phase == PHASE_ADD:
+                coord = leader.coordinator
+                if coord is None or (
+                    coord.store is not None
+                    and coord.store.last_record is not None
+                    and not coord.replicate()
+                ):
+                    # The leader cannot currently commit its own head —
+                    # a dark MINORITY leader whose voters are all behind
+                    # the cut. Minting a learner now would burn it on a
+                    # doomed promote record, so the move holds until a
+                    # committing leader exists (the dark one steps down
+                    # on quorum loss and a reachable voter takes over).
+                    # A dark MAJORITY leader passes this probe through
+                    # its same-region peers and proceeds to walk itself
+                    # out — the availability clause stays intact.
+                    return
+                learner = group.add_learner()
+                region = move["targetRegion"]
+                self.plane.topology.place(learner.replica_id, region)
+                self.plane.replica_region[learner.replica_id] = region
+                move = dict(move, learner=learner.replica_id,
+                            term=term, phase=PHASE_SYNC)
+                metrics.shard_migrations_total.inc(PHASE_ADD, "ok")
+            elif phase == PHASE_SYNC:
+                lag = group.sync_learner(move["learner"])
+                move = dict(move, syncSteps=move["syncSteps"] + 1)
+                if lag == 0:
+                    move = dict(move, phase=PHASE_PROMOTE)
+                    metrics.shard_migrations_total.inc(PHASE_SYNC, "ok")
+                elif move["syncSteps"] >= self.max_sync_steps:
+                    self._abort(move, f"sync stuck at lag {lag}")
+                    return
+            elif phase == PHASE_PROMOTE:
+                if not group.promote_learner(move["learner"]):
+                    self._abort(
+                        move, "membership record missed quorum at promote"
+                    )
+                    return
+                move = dict(move, phase=PHASE_RETIRE)
+                metrics.shard_migrations_total.inc(PHASE_PROMOTE, "ok")
+            elif phase == PHASE_RETIRE:
+                ok = group.retire_replica(move["victim"])
+                self.plane.topology.unplace(move["victim"])
+                self.plane.replica_region.pop(move["victim"], None)
+                metrics.shard_migrations_total.inc(
+                    PHASE_RETIRE, "ok" if ok else "noquorum"
+                )
+                self._complete(move)
+                return
+        except Exception as exc:
+            self._abort(move, f"{phase} failed: {exc}")
+            return
+        with self._lock:
+            self._active[shard] = move
+
+    def _complete(self, move: dict) -> None:
+        from ..core import metrics
+
+        shard = move["shard"]
+        done = dict(move, phase="done", outcome="completed")
+        with self._lock:
+            self._active.pop(shard, None)
+            self._streak[shard] = 0
+            self._history = (self._history + [done])[-HISTORY_LIMIT:]
+            desired = self._desired.get(shard)
+            excluded = self._excluded
+        metrics.shard_migrations_total.inc("complete", "ok")
+        if desired is not None and not self._walk_needed(
+            shard, desired, excluded
+        ):
+            # The WALK (possibly several moves) is done: the planned
+            # home is now the actual home — adopt it so /debug/shards,
+            # quorum_homed_in and the next solve's stickiness all see
+            # the migrated placement.
+            self.plane.homes[shard] = desired
+            self.plane.map.homes[shard] = desired
+
+    def _abort(self, move: dict, reason: str) -> None:
+        """Unwind to the pre-move membership: detach the learner — or,
+        past promote, retire the just-promoted voter (the inverse
+        single-change) — and release the shard's move slot. The victim
+        replica was never touched before retire, so pre-move membership
+        is restored exactly."""
+        from ..core import metrics
+
+        shard = move["shard"]
+        learner = move.get("learner")
+        if learner is not None:
+            try:
+                self.plane.shard_groups[shard].retire_replica(learner)
+            except Exception:
+                import logging
+
+                logging.getLogger("jobset_tpu.shard").exception(
+                    "abort-unwind of shard %s move (learner %s) failed",
+                    shard, learner,
+                )
+            self.plane.topology.unplace(learner)
+            self.plane.replica_region.pop(learner, None)
+        metrics.shard_migrations_total.inc(move["phase"], "abort")
+        done = dict(move, outcome="aborted", reason=reason)
+        with self._lock:
+            self._active.pop(shard, None)
+            self._streak[shard] = 0
+            self._history = (self._history + [done])[-HISTORY_LIMIT:]
+
+
+__all__ = [
+    "HISTORY_LIMIT",
+    "MigrationController",
+    "PHASE_ADD",
+    "PHASE_PROMOTE",
+    "PHASE_RETIRE",
+    "PHASE_SYNC",
+]

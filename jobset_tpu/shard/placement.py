@@ -82,15 +82,32 @@ def _greedy_assign(cost: np.ndarray) -> list[int]:
 
 def solve_shard_homes(topology: RegionTopology, shards: int,
                       excluded: Iterable[str] = (),
-                      solver: Optional[object] = None) -> dict[int, str]:
+                      solver: Optional[object] = None,
+                      current: Optional[dict[int, str]] = None,
+                      stickiness_ms: float = 0.0) -> dict[int, str]:
     """shard -> home region via the assignment solver (greedy fallback).
 
     With every region excluded (total blackout) the exclusion is ignored:
     a placement must always exist — the plan is advisory while the fault
-    persists."""
+    persists.
+
+    `current`/`stickiness_ms` is the anti-thrash hysteresis knob
+    (docs/sharding.md "Replica migration"): each shard's CURRENT home
+    columns are discounted by `stickiness_ms`, so a marginally-cheaper
+    alternative (a latency spread smaller than the stickiness) never
+    uproots a settled quorum — only a real event (the home going dark
+    prices it at +inf, which no discount rescues) moves the plan. The
+    default 0.0 keeps the plain solve byte-identical with prior builds."""
     cost, slot_regions = placement_cost(topology, shards, excluded)
     if not np.isfinite(cost).any():
         cost, slot_regions = placement_cost(topology, shards, ())
+    if current and stickiness_ms > 0.0:
+        for shard, home in current.items():
+            if not 0 <= int(shard) < cost.shape[0]:
+                continue
+            for column, region in enumerate(slot_regions):
+                if region == home and np.isfinite(cost[int(shard), column]):
+                    cost[int(shard), column] -= float(stickiness_ms)
     # The auction benefit surface cannot hold inf: cap dark columns at a
     # big-M strictly above any finite column so they are only ever chosen
     # when nothing else exists.

@@ -58,9 +58,13 @@ class ShardedControlPlane:
         flow=None,
         cluster_factory=None,
         spread_shards=(),
+        auto_migrate: bool = False,
+        placement_stickiness_ms: float = 0.0,
+        migration_hysteresis_steps: int = 2,
     ):
         from ..ha import ReplicaSet
         from ..server import ControllerServer
+        from .migrate import MigrationController
 
         self.base_dir = str(base_dir)
         self.groups = int(groups if groups is not None else shards)
@@ -71,6 +75,19 @@ class ShardedControlPlane:
             )
         self.injector = injector
         self.topology = topology or RegionTopology(seed=seed)
+        # Self-driving migration (docs/sharding.md "Replica migration"):
+        # when auto_migrate is on, every supervision step also advances
+        # the MigrationController's joint-consensus walks toward the
+        # latest planned homes. The stickiness discount and the
+        # controller's confirmation streak are the two hysteresis layers
+        # that keep flapping links from thrashing replicas. Both default
+        # off/0 so static deployments behave byte-identically.
+        self.auto_migrate = bool(auto_migrate)
+        self.placement_stickiness_ms = float(placement_stickiness_ms)
+        # Regions currently under an isolation fault — maintained by
+        # isolate_region/heal_region, consumed by every re-solve and by
+        # the controller's stranded-voter accounting.
+        self.excluded: set[str] = set()
         # Recover the persisted partition (docs/sharding.md): a restart
         # after a resplit must route by the exact shards/epoch it was
         # serving — rebuilding at the constructor's shard count would
@@ -138,6 +155,12 @@ class ShardedControlPlane:
             injector=injector,
         )
         self.map.persist(self.base_dir)
+        self.migrations = MigrationController(
+            self, hysteresis_steps=migration_hysteresis_steps,
+            injector=injector,
+        )
+        # /debug/migrations is served by the front door off the router.
+        self.router.migrations = self.migrations
         self.front_door = ControllerServer(
             address,
             cluster=make_cluster(),
@@ -180,9 +203,14 @@ class ShardedControlPlane:
     def step(self) -> None:
         """One supervision round over every shard group (elections,
         demotions) — the deterministic-scenario driver; the background
-        supervisor calls the same thing on a cadence."""
+        supervisor calls the same thing on a cadence. With auto_migrate
+        the migration controller walks one phase per round too, so live
+        writers retrying through step() are exactly what drives a shard
+        out of a dark region."""
         for group in self.shard_groups:
             group.step()
+        if self.auto_migrate:
+            self.migrations.step()
 
     def start_supervisor(self, interval_s: float = 0.05) -> None:
         """Background stepping for wall-clock deployments (bench, CLI):
@@ -237,7 +265,8 @@ class ShardedControlPlane:
         for src, dst in self.topology.isolation_links(region):
             plan.cut(src, dst, at=at)
         plan.advance(at)
-        return self.resolve_placement(excluded={region})
+        self.excluded.add(region)
+        return self.resolve_placement(excluded=set(self.excluded))
 
     def heal_region(self, region: str, step: Optional[int] = None):
         """Heal the region's boundary links and re-solve placement."""
@@ -246,7 +275,8 @@ class ShardedControlPlane:
         for src, dst in self.topology.isolation_links(region):
             plan.heal(src, dst, at=at)
         plan.advance(at)
-        return self.resolve_placement(excluded=set())
+        self.excluded.discard(region)
+        return self.resolve_placement(excluded=set(self.excluded))
 
     def resolve_placement(self, excluded=frozenset()) -> dict[int, str]:
         """Re-run the shard-home solve against the current (possibly
@@ -254,11 +284,18 @@ class ShardedControlPlane:
         is the PLANNED home set (replica quorums do not teleport; the
         plan is what an operator-driven or future automated migration
         would execute), surfaced at /debug/shards and counted."""
-        planned = solve_shard_homes(self.topology, self.groups,
-                                    excluded=excluded)
+        planned = solve_shard_homes(
+            self.topology, self.groups, excluded=excluded,
+            current=self.homes,
+            stickiness_ms=self.placement_stickiness_ms,
+        )
         self.router.set_planned_homes({
             s: planned[s] for s in range(self.map.shards)
         })
+        self.migrations.note_plan(
+            {s: planned[s] for s in range(self.map.shards)},
+            excluded=frozenset(excluded),
+        )
         metrics.shard_resolves_total.inc()
         return planned
 

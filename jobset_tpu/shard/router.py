@@ -19,11 +19,15 @@ flow plane has already classified/admitted the request; the router then
   merging (a shard that cannot answer fails the list: a merged list
   silently missing a shard would read as mass deletion to an informer);
 * maintains the **merged watch journal**: per-shard cursors pull each
-  shard's jobsets journal — bounded by that shard's quorum delivery
-  floor, so un-quorum-committed events never cross the front door — and
-  append into one router-rv-ordered journal that cross-shard watchers
-  long-poll. Router rvs are what cross-shard session monotonicity is
-  checked over (``verify.check_sharded_history``).
+  shard's journal — bounded by that shard's quorum delivery floor, so
+  un-quorum-committed events never cross the front door — and append
+  into one router-rv-ordered journal that cross-shard watchers
+  long-poll. Jobsets always merge; child kinds (jobs/pods/services)
+  join on first front-door list/watch (``activate_kind``) and are
+  re-activated on every shard leader at each ingest, so a replica
+  migration's new leader keeps journaling them. Router rvs are what
+  cross-shard session monotonicity is checked over
+  (``verify.check_sharded_history``).
 
 Re-partitioning (``resplit``) swaps the map at a new epoch and marks the
 whole journal trimmed: every pre-split resume token answers 410 and the
@@ -91,14 +95,33 @@ class ShardRouter:
         self._inflight_writes = 0  # guarded-by: _flight_lock
         # Merged-journal state, all guarded by this condition (router
         # rvs, the event list, per-shard pull cursors, the trim floor).
+        # Events carry their kind: child kinds (jobs/pods/services)
+        # merge into the SAME router-rv-ordered journal as jobsets once
+        # activated, so one cursor per shard covers every kind.
         self._journal_lock = threading.Condition()
-        self._events: list[tuple[int, str, dict]] = []  # guarded-by: _journal_lock
+        self._events: list[tuple[int, str, str, dict]] = []  # guarded-by: _journal_lock
         self._rv = 0  # guarded-by: _journal_lock
         self._trimmed_rv = 0  # guarded-by: _journal_lock
         self._cursors: dict[int, int] = {}  # guarded-by: _journal_lock
+        # Kinds the merged journal carries. Child kinds join on first
+        # front-door list/watch (activate_kind) and are re-activated on
+        # every shard leader at each ingest — a replica-migration or
+        # failover hands the shard to a leader that has never journaled
+        # them.
+        self._kinds: set[str] = {"jobsets"}  # guarded-by: _journal_lock
+        # Last leader seen per shard. While child kinds are merged, a
+        # leader change trims the whole journal: the new leader only
+        # journals child deltas from its activation on, so a watcher
+        # resuming across the gap could go silently stale — 410/relist
+        # is the honest answer (informer level-triggered contract).
+        self._leaders: dict[int, Optional[str]] = {}  # guarded-by: _journal_lock
         # Latest placement re-solve output (plane.resolve_placement):
         # where the homes WOULD move given the current fault set.
         self._planned_homes: dict[int, str] = {}  # guarded-by: _journal_lock
+        # The plane's MigrationController (set by ShardedControlPlane):
+        # /debug/migrations serves its describe() through the front
+        # door.
+        self.migrations = None
         metrics.shard_count.set(self.map.shards)
 
     def fence_writes(self, fenced: bool, drain_timeout_s: float = 30.0):
@@ -139,6 +162,23 @@ class ShardRouter:
         /debug/shards as `plannedHomes`)."""
         with self._journal_lock:
             self._planned_homes = dict(planned)
+
+    def activate_kind(self, kind: str) -> None:
+        """Admit a child kind (jobs/pods/services) into the merged
+        journal: activate its shard-side journaling on every current
+        leader, then start carrying its events under router rvs. Called
+        from the front door's child list AND watch paths — activating
+        at list time is what closes the list-then-watch gap (events
+        landing between the two merge under rvs ABOVE the list's
+        token, so the watch re-delivers instead of missing them)."""
+        with self._journal_lock:
+            if kind in self._kinds:
+                return
+            self._kinds.add(kind)
+        for shard in self.active_shards():
+            _leader_id, server = self.handles[shard].leader()
+            if server is not None:
+                server._activate_watch_kind(kind)
 
     # -- key routing ---------------------------------------------------------
 
@@ -300,6 +340,9 @@ class ShardRouter:
         pulled: list[tuple[int, bool, list]] = []
         with self._journal_lock:
             cursors = dict(self._cursors)
+            kinds = set(self._kinds)
+            leaders = dict(self._leaders)
+        child_kinds = kinds - {"jobsets"}
         targets = (
             [int(only_shard)] if only_shard is not None
             and int(only_shard) in self.handles
@@ -307,14 +350,34 @@ class ShardRouter:
         )
         for shard in targets:
             handle = self.handles[shard]
-            _leader_id, server = handle.leader()
+            leader_id, server = handle.leader()
             if server is None:
                 continue
+            leader_changed = (
+                shard in leaders and leaders[shard] != leader_id
+            )
+            leaders[shard] = leader_id
+            if child_kinds:
+                # Idempotent re-activation on EVERY pull: a post-
+                # failover or post-migration leader has never journaled
+                # the merged child kinds, and the merge would silently
+                # drop their deltas otherwise.
+                for kind in child_kinds:
+                    server._activate_watch_kind(kind)
             cursor = cursors.get(shard, 0)
-            events, floor, trimmed = server.journal_tail("jobsets", cursor)
+            events, floor, trimmed = server.journal_tail_kinds(
+                kinds, cursor
+            )
             gap = cursor < trimmed and cursor > 0
+            if leader_changed and child_kinds:
+                # Child deltas between the handover and this activation
+                # never journaled anywhere: resuming a child watcher
+                # across that gap could leave it silently stale (a
+                # deletion it will never hear about). Trim -> 410 ->
+                # relist.
+                gap = True
             pulled.append((shard, gap, [
-                (ns, event) for _rv, ns, event in events
+                (kind, ns, event) for _rv, kind, ns, event in events
             ]))
             cursors[shard] = max(cursor, floor)
         merged = 0
@@ -331,11 +394,12 @@ class ShardRouter:
                     # resplit() guards against).
                     self._rv += 1
                     self._trimmed_rv = self._rv
-                for ns, event in events:
+                for kind, ns, event in events:
                     self._rv += 1
-                    self._events.append((self._rv, ns, event))
+                    self._events.append((self._rv, kind, ns, event))
                     merged += 1
                 self._cursors[shard] = cursors[shard]
+            self._leaders.update(leaders)
             if len(self._events) > ROUTER_JOURNAL_LIMIT:
                 trimmed_events = self._events[:-ROUTER_JOURNAL_LIMIT]
                 self._trimmed_rv = trimmed_events[-1][0]
@@ -346,14 +410,16 @@ class ShardRouter:
 
     def watch(self, ns: str, resource_version: int, timeout_s: float,
               park: bool = True, retry_hint: float = 1.0,
-              poll_interval_s: float = 0.05):
-        """Cross-shard jobsets long-poll against the merged journal, with
-        the same 410/partial-batch contract as a single server's watch.
-        The loop re-ingests on each wake: routed writes notify
-        immediately; leader-pump-driven changes surface within the poll
-        interval."""
+              poll_interval_s: float = 0.05, kind: str = "jobsets"):
+        """Cross-shard long-poll against the merged journal — jobsets
+        and activated child kinds alike — with the same 410/partial-
+        batch contract as a single server's watch. The loop re-ingests
+        on each wake: routed writes notify immediately; leader-pump-
+        driven changes surface within the poll interval."""
         import time as _t
 
+        if kind != "jobsets":
+            self.activate_kind(kind)
         deadline = _t.monotonic() + max(0.0, min(timeout_s, 300.0))
         while True:
             self.ingest()
@@ -373,8 +439,9 @@ class ShardRouter:
                     }
                 batch = [
                     {"resourceVersion": rv, **event}
-                    for rv, event_ns, event in self._events
+                    for rv, event_kind, event_ns, event in self._events
                     if rv > resource_version and event_ns == ns
+                    and event_kind == kind
                 ]
                 head = self._rv
                 if batch:
@@ -459,6 +526,7 @@ class ShardRouter:
                 "cursors": {
                     str(k): v for k, v in sorted(self._cursors.items())
                 },
+                "kinds": sorted(self._kinds),
             }
             planned = {
                 str(k): v for k, v in sorted(self._planned_homes.items())

@@ -64,6 +64,12 @@ class RegionTopology:
             )
         self.actor_region[actor] = region
 
+    def unplace(self, actor: str) -> None:
+        """Forget a retired actor: subsequent region isolations no longer
+        schedule cuts for its links (a migrated-away replica's id must
+        not keep inflating the deterministic cut schedule)."""
+        self.actor_region.pop(actor, None)
+
     def latency_ms(self, a: str, b: str) -> float:
         """Symmetric inter-region latency (0 within a region)."""
         if a == b:

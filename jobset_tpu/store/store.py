@@ -146,6 +146,17 @@ class Store:
         # record — the frame-shipping handle the replication layer streams
         # to followers.
         self.last_record: Optional[tuple[dict, bytes]] = None
+        # Replication-group voting set (docs/sharding.md "Replica
+        # migration"): None until the first membership-change record is
+        # committed (a static group never pays the key). Journaled so a
+        # recovery mid-migration sees exactly the voting set the
+        # joint-consensus walk had reached — the supervisor reconciles
+        # its replica lists against this after Store.recover.
+        self.membership: Optional[list[str]] = None
+        # Every voting set this log has ever committed, in order — the
+        # membership history verify.check_sharded_history proves the
+        # single-change/quorum-overlap invariants over.
+        self.membership_log: list[list[str]] = []
         self._commits_since_snapshot = 0
         self.torn_tail_recovered = False
         self.wal_records_replayed = 0
@@ -185,6 +196,9 @@ class Store:
             self._rv = doc.get("rv", 0)
             self.last_record_term = int(doc.get("lastTerm", 0))
             self._counters = dict(doc.get("counters") or self._counters)
+            if doc.get("membership") is not None:
+                self.membership = list(doc["membership"])
+                self.membership_log.append(list(doc["membership"]))
             for kind in KINDS:
                 self._state[kind] = dict(
                     doc.get("state", {}).get(kind) or {}
@@ -222,6 +236,9 @@ class Store:
                         }
                     else:
                         self.last_jobset_commit.pop(op[2], None)
+            if "membership" in record:
+                self.membership = list(record["membership"])
+                self.membership_log.append(list(record["membership"]))
             self._seq = seq
             self._rv = max(self._rv, record.get("rv", 0))
             self._counters = dict(record.get("counters") or self._counters)
@@ -431,6 +448,46 @@ class Store:
             self.maybe_compact()
         return self._seq
 
+    def commit_membership(self, voters: list[str]) -> int:
+        """Journal a membership-change record: the voting set after one
+        single-replica joint-consensus step (docs/sharding.md "Replica
+        migration"). Unlike commit() this always appends — the record IS
+        the change, there is no object diff to detect — and carries
+        ``ops: []`` so recovery replays it as a pure membership update.
+        Returns the committed seq; raises StoreWriteError on append
+        failure (the voting set is NOT adopted, the caller unwinds)."""
+        from ..core import metrics
+
+        voters = sorted(voters)
+        record = {
+            "seq": self._seq + 1,
+            "rv": self._rv,
+            "counters": dict(self._counters),
+            "ops": [],
+            "membership": voters,
+        }
+        if self.term:
+            record["term"] = self.term
+        payload = canonical(record).encode()
+        try:
+            self.wal.append(payload, detail=f"seq={record['seq']} membership")
+        except Exception:
+            self.retry_pending = True
+            raise
+        self._seq = record["seq"]
+        self.last_record = (record, payload)
+        if self.term:
+            self.last_record_term = self.term
+        if not self.replicated:
+            self.commit_seq = self._seq
+        self.membership = voters
+        self.membership_log.append(list(voters))
+        self._commits_since_snapshot += 1
+        metrics.store_commits_total.inc()
+        if not self.replicated:
+            self.maybe_compact()
+        return self._seq
+
     def maybe_compact(self) -> None:
         """Compact when due — and, under replication, only once the
         quorum commit index has caught up to the local log (committed
@@ -462,7 +519,7 @@ class Store:
         """The full-state snapshot document (what compact() persists and
         what the replication layer installs on a follower too far behind
         the leader's resend buffer)."""
-        return {
+        doc = {
             "seq": self._seq,
             "rv": self._rv,
             "counters": self._counters,
@@ -471,6 +528,11 @@ class Store:
             # compares lastTerm/lastSeq; plain recovery ignores it).
             "lastTerm": self.last_record_term,
         }
+        if self.membership is not None:
+            # Key omitted for static groups so pre-migration snapshots
+            # stay byte-identical with older builds.
+            doc["membership"] = self.membership
+        return doc
 
     def repair(self) -> None:
         """Truncate a torn tail left by a failed append; the un-journaled

@@ -421,6 +421,15 @@ def default_watchlist() -> dict[type, frozenset]:
 
     # evaluate() (sampler tick) vs state()/transition_log() (handlers).
     add(_alert_manager, ("_active", "_transitions"))
+
+    def _migrations():
+        from ..shard.migrate import MigrationController
+
+        return MigrationController
+
+    # step() (plane supervisor thread) vs note_plan()/describe()
+    # (re-solve trigger + /debug/migrations handlers).
+    add(_migrations, ("_desired", "_streak", "_active", "_history"))
     return out
 
 

@@ -285,6 +285,7 @@ def check_sharded_history(
     final_states: Optional[dict] = None,
     register_keys: Optional[dict] = None,
     initial_value: Optional[str] = None,
+    memberships: Optional[dict] = None,
 ) -> CheckReport:
     """The checker generalized to a sharded control plane: per-shard
     guarantees plus cross-shard session monotonicity through the router.
@@ -309,7 +310,18 @@ def check_sharded_history(
     The combined report is green only when every sub-invariant holds —
     so a fence-disabled run that lets one shard's deposed leader serve a
     stale read fails THIS checker too (the teeth contract of
-    docs/sharding.md)."""
+    docs/sharding.md).
+
+    ``memberships`` (optional): shard -> ordered list of voting sets
+    (each a list of replica ids — ``ReplicaSet.membership_log`` or the
+    recovered ``Store.membership_log``). Two membership-aware quorum
+    invariants are proven per shard (docs/sharding.md "Replica
+    migration"): **membership-single-change** — consecutive voting sets
+    differ by exactly one replica (the joint-consensus walk never jumps
+    configurations) — and **membership-quorum-overlap** — for every
+    consecutive pair, a majority of the old set plus a majority of the
+    new exceeds their union (any two quorums across the change share a
+    replica, so no two leaders can commit disjoint histories mid-move)."""
     report = CheckReport()
     scopes: dict = {}
     for op in ops:
@@ -330,6 +342,8 @@ def check_sharded_history(
             report.ok = False
         for key, value in sub.stats.items():
             report.stats[key] = report.stats.get(key, 0) + value
+    for shard in sorted(memberships or {}):
+        _check_memberships(report, shard, memberships[shard])
     _check_session_monotonic(router_report := CheckReport(), router_ops)
     verdict = router_report.invariants.get(
         "session_monotonic", {"ok": True, "checked": 0}
@@ -343,6 +357,37 @@ def check_sharded_history(
     report.stats["router_ops"] = len(router_ops)
     report.stats["shards"] = len(scopes)
     return report
+
+
+def _check_memberships(report: CheckReport, shard, sets: list) -> None:
+    """Membership-aware quorum accounting over one shard's voting-set
+    history (see check_sharded_history)."""
+    checked = max(0, len(sets) - 1)
+    single_name = f"shard{shard}:membership-single-change"
+    overlap_name = f"shard{shard}:membership-quorum-overlap"
+    report.invariants[single_name] = {"ok": True, "checked": checked}
+    report.invariants[overlap_name] = {"ok": True, "checked": checked}
+    for i in range(1, len(sets)):
+        old, new = set(sets[i - 1]), set(sets[i])
+        if len(old ^ new) != 1:
+            report._fail(
+                single_name,
+                f"voting sets {sorted(old)} -> {sorted(new)} change "
+                f"{len(old ^ new)} replicas at once — the joint-consensus "
+                f"walk must move exactly one replica per step",
+                shard=shard, index=i,
+            )
+        maj_old = len(old) // 2 + 1
+        maj_new = len(new) // 2 + 1
+        if maj_old + maj_new <= len(old | new):
+            report._fail(
+                overlap_name,
+                f"voting sets {sorted(old)} -> {sorted(new)}: majorities "
+                f"({maj_old}+{maj_new}) do not overlap across the union "
+                f"of {len(old | new)} replicas — two disjoint quorums "
+                f"could commit divergent histories mid-change",
+                shard=shard, index=i,
+            )
 
 
 def _wing_gong(entries, initial_value):

@@ -5,6 +5,9 @@
 * ``fixture.net_documented`` — consulted in net.py through a
   module-level constant (the chaos/net.py shape): the constant's
   literal mention keeps this row green.
+* ``fixture.migrate_documented`` — consulted from a controller method
+  with an f-string detail and an ``injector=`` kwarg (the
+  shard/migrate.py shape): the literal first arg keeps this row green.
 """
 
 
@@ -13,5 +16,5 @@ class Injector:
         return bool(point)
 
 
-def consult(point: str):
+def consult(point: str, *args, **kwargs):
     return None

@@ -1,0 +1,20 @@
+"""DRF003 fixture for the migration controller's call shape
+(shard/migrate.py): the point is a literal first arg, the detail an
+f-string, and the injector travels as a keyword — the consulted-
+direction scan keys on the literal alone, so the documented row stays
+green and an undocumented point in the same shape still fires."""
+
+from ..chaos.injector import consult
+
+
+class Controller:
+    def __init__(self, injector=None):
+        self.injector = injector
+
+    def advance(self, shard: int, phase: str):
+        fault = consult(
+            "fixture.migrate_documented",
+            f"shard={shard} phase={phase}",
+            injector=self.injector,
+        )
+        return fault

@@ -295,6 +295,9 @@ def test_drift_fires_in_both_directions():
     # its documented row green via the constant's literal mention.
     assert any("fixture.net_undocumented" in m for m in drf3), messages
     assert not any("fixture.net_documented" in m for m in drf3), messages
+    # The shard/migrate.py call shape: literal point + f-string detail +
+    # injector kwarg resolves to its documented row.
+    assert not any("fixture.migrate_documented" in m for m in drf3), messages
     drf4 = [f.message for f in visible(report, "DRF004")]
     assert any("/fixture/unclassified" in m for m in drf4), messages
     assert any("/fixture/stale" in m for m in drf4), messages

@@ -1,0 +1,559 @@
+"""Self-driving shard migration (jobset_tpu/shard/migrate.py,
+docs/sharding.md "Replica migration").
+
+The contracts proven here are the tentpole's acceptance criteria:
+
+* the joint-consensus walk itself: add a non-voting learner, stream it
+  to the leader's exact log position, promote only at lag 0, retire the
+  victim — every consecutive voting-set pair differs by ONE replica, so
+  quorum majorities provably overlap at every step (the membership
+  invariants the cross-shard checker enforces);
+* hysteresis: a flapping planned home resets the confirmation streak
+  and never starts a walk;
+* the ``shard.migrate`` chaos point: ``stall`` holds the walk, ``abort``
+  unwinds it to the pre-move membership (and a later round completes
+  cleanly), a chronically ``break``-ing learner stream aborts past the
+  sync budget — never a ghost learner, never a torn voting set;
+* retirement releases the victim's data-dir flock (the dir is reusable
+  immediately, not at process exit);
+* the seeded ``rolling_region_outage`` campaign: two region cuts, the
+  walk re-homes the quorum out of each dark region under live writes,
+  zero acked-write loss, byte-identical artifacts across seeded runs,
+  the fence-disabled run FAILS the checker, and the mid-walk
+  leader-kill (teeth) run still comes out green;
+* the surfaces: ``/debug/migrations`` on the front door, the
+  ``--auto-migrate`` CLI flag, and cross-shard child-kind watch
+  continuity across a migration (410 -> relist, never silently stale).
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from jobset_tpu.chaos.injector import FaultInjector
+from jobset_tpu.chaos.scenarios import rolling_region_outage
+from jobset_tpu.ha import ReplicaSet
+from jobset_tpu.ha.replication import FollowerLog
+from jobset_tpu.shard import ShardedControlPlane
+from jobset_tpu.shard.migrate import MigrationController
+from jobset_tpu.store import StoreError
+from jobset_tpu.verify import check_sharded_history
+
+pytestmark = [pytest.mark.migration, pytest.mark.shard]
+
+_API = "/apis/jobset.x-k8s.io/v1alpha2/namespaces/default/jobsets"
+
+
+def _gang(name: str) -> dict:
+    return {
+        "apiVersion": "jobset.x-k8s.io/v1alpha2",
+        "kind": "JobSet",
+        "metadata": {"name": name},
+        "spec": {
+            "suspend": True,
+            "replicatedJobs": [{
+                "name": "w",
+                "replicas": 1,
+                "template": {
+                    "spec": {
+                        "parallelism": 1,
+                        "completions": 1,
+                        "template": {"spec": {"containers": [
+                            {"name": "c", "image": "img"},
+                        ]}},
+                    },
+                },
+            }],
+        },
+    }
+
+
+def _http(address: str, method: str, path: str, body=None):
+    req = urllib.request.Request(
+        f"http://{address}{path}",
+        data=json.dumps(body).encode() if body is not None else None,
+        method=method,
+        headers={"Content-Type": "application/json"} if body else {},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, json.loads(resp.read()), dict(resp.headers)
+    except urllib.error.HTTPError as exc:
+        data = exc.read()
+        try:
+            payload = json.loads(data)
+        except ValueError:
+            payload = {"raw": data.decode(errors="replace")}
+        return exc.code, payload, dict(exc.headers)
+
+
+def _assert_single_change(membership_log):
+    """Every consecutive voting-set pair differs by exactly one replica
+    (the local mirror of the checker's membership invariant)."""
+    for i in range(1, len(membership_log)):
+        old, new = set(membership_log[i - 1]), set(membership_log[i])
+        assert len(old ^ new) == 1, (
+            f"membership step {i}: {sorted(old)} -> {sorted(new)}"
+        )
+
+
+@pytest.fixture
+def walk_plane(tmp_path):
+    """A manually-stepped 1-shard plane (no background supervisor): the
+    scenario-driver shape, so each test advances the walk one
+    deterministic phase at a time with its own MigrationController."""
+    plane = ShardedControlPlane(
+        str(tmp_path), shards=1, replicas_per_shard=3, seed=7,
+        lease_duration=5.0, retry_period=0.1, tick_interval=0.05,
+    )
+    try:
+        deadline = time.monotonic() + 30.0
+        while plane.shard_groups[0].leader() is None:
+            assert time.monotonic() < deadline, "no initial leader"
+            plane.step()
+            time.sleep(0.01)
+        yield plane
+    finally:
+        plane.stop()
+
+
+def _drive(plane, ctrl, done, deadline_s=60.0, label="walk"):
+    deadline = time.monotonic() + deadline_s
+    while not done():
+        assert time.monotonic() < deadline, (
+            f"{label} never converged: {ctrl.describe()}"
+        )
+        plane.step()
+        ctrl.step()
+        time.sleep(0.005)
+
+
+# ---------------------------------------------------------------------------
+# The walk: add -> sync -> promote -> retire over live membership
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.timeout(120)
+def test_walk_rehomes_quorum_one_single_change_at_a_time(walk_plane):
+    plane = walk_plane
+    group = plane.shard_groups[0]
+    assert plane.homes[0] == "region-a"
+    voters_before = group.voter_ids()
+
+    ctrl = MigrationController(plane, hysteresis_steps=1)
+    ctrl.note_plan({0: "region-b"})
+    _drive(plane, ctrl, ctrl.settled)
+
+    # The quorum majority now lives in the desired home; the walk
+    # adopted it as the actual home (map, plane and the next solve's
+    # stickiness all see the migrated placement).
+    regions = [
+        plane.replica_region[r.replica_id] for r in group.replicas
+    ]
+    assert sum(1 for reg in regions if reg == "region-b") >= 2, regions
+    assert plane.homes[0] == "region-b"
+    assert plane.map.homes[0] == "region-b"
+    # One replica moved: one learner promoted in, one voter retired out,
+    # via single-change membership records only.
+    assert group.voter_ids() != voters_before
+    assert len(group.voter_ids()) == len(voters_before)
+    _assert_single_change(group.membership_log)
+    assert not group.learners  # never a ghost learner
+    assert [r.replica_id for r in group.retired]
+    history = ctrl.describe()["history"]
+    assert history and history[-1]["outcome"] == "completed"
+
+
+@pytest.mark.timeout(120)
+def test_hysteresis_a_flapping_plan_never_starts_a_walk(walk_plane):
+    plane = walk_plane
+    ctrl = MigrationController(plane, hysteresis_steps=3)
+    for _ in range(6):
+        # The desired home flaps every round: the confirmation streak
+        # resets on each change and never reaches hysteresis_steps.
+        ctrl.note_plan({0: "region-b"})
+        ctrl.step()
+        ctrl.note_plan({0: "region-c"})
+        ctrl.step()
+    desc = ctrl.describe()
+    assert desc["active"] == {}
+    assert desc["history"] == []
+    assert all(v < 3 for v in desc["streaks"].values()), desc["streaks"]
+    _assert_single_change(plane.shard_groups[0].membership_log)
+    assert len(plane.shard_groups[0].membership_log) == 1  # untouched
+
+
+# ---------------------------------------------------------------------------
+# The shard.migrate chaos point: stall / abort / broken learner stream
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.timeout(120)
+def test_chaos_stall_holds_the_walk_then_it_proceeds(walk_plane):
+    plane = walk_plane
+    inj = FaultInjector(seed=0)
+    inj.add_rule("shard.migrate", "stall", rate=1.0, times=3)
+    ctrl = MigrationController(plane, hysteresis_steps=1, injector=inj)
+    ctrl.note_plan({0: "region-b"})
+    for _ in range(3):
+        plane.step()
+        ctrl.step()
+    # Three stalled steps: the move is active but never left phase add.
+    move = ctrl.describe()["active"]["0"]
+    assert move["phase"] == "add" and move["learner"] is None
+    # The rule is exhausted: the held walk now runs to completion.
+    _drive(plane, ctrl, ctrl.settled, label="post-stall walk")
+    assert plane.homes[0] == "region-b"
+    assert not plane.shard_groups[0].learners
+
+
+@pytest.mark.timeout(120)
+def test_chaos_abort_unwinds_then_a_fresh_walk_completes(walk_plane):
+    plane = walk_plane
+    group = plane.shard_groups[0]
+    inj = FaultInjector(seed=0)
+    inj.add_rule("shard.migrate", "abort", rate=1.0, times=1)
+    ctrl = MigrationController(plane, hysteresis_steps=1, injector=inj)
+    ctrl.note_plan({0: "region-b"})
+    plane.step()
+    ctrl.step()
+    # The first arrival aborted the move: unwound to the pre-move
+    # membership, nothing half-done left behind.
+    desc = ctrl.describe()
+    assert desc["active"] == {}
+    assert desc["history"][-1]["outcome"] == "aborted"
+    assert "chaos abort" in desc["history"][-1]["reason"]
+    assert not group.learners
+    assert len(group.membership_log) == 1
+    # The abort released the shard's move slot: the next rounds start a
+    # fresh walk that completes.
+    _drive(plane, ctrl, ctrl.settled, label="post-abort walk")
+    assert plane.homes[0] == "region-b"
+    assert ctrl.describe()["history"][-1]["outcome"] == "completed"
+    _assert_single_change(group.membership_log)
+
+
+@pytest.mark.timeout(120)
+def test_chaos_broken_learner_stream_aborts_past_budget(walk_plane):
+    plane = walk_plane
+    group = plane.shard_groups[0]
+    inj = FaultInjector(seed=0)
+    ctrl = MigrationController(
+        plane, hysteresis_steps=1, max_sync_steps=2, injector=inj,
+    )
+    ctrl.note_plan({0: "region-b"})
+    plane.step()
+    ctrl.step()
+    move = ctrl.describe()["active"]["0"]
+    assert move["phase"] == "sync" and move["learner"]
+    # Every sync attempt now fails: the walk must give up at the budget
+    # and unwind — the learner is retired, never a voter.
+    inj.add_rule("shard.migrate", "break", rate=1.0)
+    for _ in range(2):
+        plane.step()
+        ctrl.step()
+    desc = ctrl.describe()
+    assert desc["active"] == {}
+    assert desc["history"][-1]["outcome"] == "aborted"
+    assert "broken past budget" in desc["history"][-1]["reason"]
+    assert not group.learners
+    assert move["learner"] not in group.voter_ids()
+    # Heal the stream: a later walk completes.
+    inj.clear("shard.migrate")
+    _drive(plane, ctrl, ctrl.settled, label="post-break walk")
+    assert plane.homes[0] == "region-b"
+
+
+# ---------------------------------------------------------------------------
+# Retirement releases the data-dir flock (satellite regression)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.timeout(120)
+def test_retire_releases_data_dir_flock_immediately(tmp_path):
+    rs = ReplicaSet(
+        str(tmp_path), n=3,
+        lease_duration=5.0, retry_period=0.1, tick_interval=0.05,
+    ).start()
+    try:
+        deadline = time.monotonic() + 30.0
+        while rs.leader() is None:
+            assert time.monotonic() < deadline
+            rs.step()
+            time.sleep(0.01)
+        victim = next(r for r in rs.replicas if r is not rs.leader())
+        data_dir = victim.data_dir
+        # While the replica is a live voter its dir is exclusively
+        # flocked (one replica per data dir).
+        with pytest.raises(StoreError):
+            FollowerLog(data_dir)
+        assert rs.retire_replica(victim.replica_id)
+        # Retirement released the flock at retire time — NOT at process
+        # exit — so the dir is immediately reusable.
+        reopened = FollowerLog(data_dir)
+        reopened.close()
+        assert victim.replica_id not in rs.voter_ids()
+        _assert_single_change(rs.membership_log)
+    finally:
+        rs.stop()
+
+
+# ---------------------------------------------------------------------------
+# Checker teeth: the membership invariants
+# ---------------------------------------------------------------------------
+
+
+def _op(op_id, session, kind, key, invoke, response, ok=True, rv=None,
+        value=None, acked=False, status=200, term=0, replica="r"):
+    return {
+        "id": op_id, "session": session, "kind": kind, "key": key,
+        "value": value, "invoke": invoke, "response": response,
+        "ok": ok, "status": status, "rv": rv, "term": term,
+        "replica": replica, "acked": acked,
+    }
+
+
+def _scope_by_prefix(op):
+    if op["key"] == "__router__":
+        return "router"
+    return int(op["key"].split("/")[1][1])  # "default/sN-..." -> N
+
+
+def test_checker_membership_invariants_green_on_a_proper_walk():
+    ops = [
+        _op(0, "w", "write", "default/s1-a", 1, 2, value="1", acked=True),
+    ]
+    report = check_sharded_history(
+        ops, _scope_by_prefix,
+        final_states={1: {"default/s1-a": "1"}},
+        register_keys={1: "default/s1-a"},
+        # add-then-remove: every consecutive pair differs by one.
+        memberships={1: [["a", "b", "c"], ["a", "b", "c", "d"],
+                         ["b", "c", "d"]]},
+    )
+    assert report.ok, report.violations
+    assert report.invariants["shard1:membership-single-change"]["ok"]
+    assert report.invariants["shard1:membership-single-change"][
+        "checked"] == 2
+    assert report.invariants["shard1:membership-quorum-overlap"]["ok"]
+
+
+def test_checker_membership_invariants_fail_a_two_replica_swap():
+    """Swapping two replicas in ONE membership record is exactly the
+    split-brain hazard joint consensus exists to prevent: {a,b,c} ->
+    {a,d,e} lets majority {b,c} of the old set and majority {d,e} of
+    the new commit divergent histories. The checker must refuse it."""
+    ops = [
+        _op(0, "w", "write", "default/s1-a", 1, 2, value="1", acked=True),
+    ]
+    report = check_sharded_history(
+        ops, _scope_by_prefix,
+        final_states={1: {"default/s1-a": "1"}},
+        register_keys={1: "default/s1-a"},
+        memberships={1: [["a", "b", "c"], ["a", "d", "e"]]},
+    )
+    assert not report.ok
+    assert not report.invariants["shard1:membership-single-change"]["ok"]
+    assert not report.invariants["shard1:membership-quorum-overlap"]["ok"]
+    assert any(v.get("shard") == 1 for v in report.violations)
+
+
+# ---------------------------------------------------------------------------
+# Surfaces: /debug/migrations, --auto-migrate, child-kind continuity
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.timeout(120)
+def test_debug_migrations_front_door_only(walk_plane):
+    plane = walk_plane
+    status, payload, _headers = _http(
+        plane.address, "GET", "/debug/migrations"
+    )
+    assert status == 200
+    assert payload["settled"] is True
+    for key in ("desired", "streaks", "active", "history"):
+        assert key in payload
+    # A shard member's own surface is not a migrating front door.
+    status, payload, _headers = _http(
+        plane.shard_groups[0].address, "GET", "/debug/migrations"
+    )
+    assert status == 404
+    assert "front door" in payload["error"]
+
+
+def test_auto_migrate_cli_flag_parses():
+    from jobset_tpu.cli import _build_parser
+
+    parser = _build_parser()
+    args = parser.parse_args(["controller", "--shards", "2",
+                              "--auto-migrate"])
+    assert args.auto_migrate is True
+    args = parser.parse_args(["controller", "--shards", "2"])
+    assert args.auto_migrate is False
+
+
+@pytest.mark.timeout(240)
+def test_child_kind_watch_continuity_across_leader_migration(walk_plane):
+    """An informer of a child kind never goes silently stale across a
+    migration that retires the leader: its resume token answers 410, it
+    relists, and the relisted state carries every pre-walk write."""
+    plane = walk_plane
+    group = plane.shard_groups[0]
+
+    status, _payload, _headers = _http(
+        plane.address, "POST", _API, _gang("mig-watch-a")
+    )
+    assert status == 201
+    # Activate a child kind on the merged journal, then capture a
+    # pre-migration resume token (the list also records the current
+    # shard leader in the router's cursor state).
+    status, _payload, _headers = _http(
+        plane.address, "GET", "/api/v1/namespaces/default/pods"
+    )
+    assert status == 200
+    status, listed, _headers = _http(plane.address, "GET", _API)
+    assert status == 200
+    pre_rv = listed["resourceVersion"]
+    # The cluster-scoped event stream stays shard-local: no merged
+    # journal can honor its relist contract, so the front door says so.
+    status, payload, _headers = _http(
+        plane.address, "GET",
+        "/api/v1/events?watch=1&resourceVersion=0&timeoutSeconds=0.2",
+    )
+    assert status == 400
+    assert "/debug/shards" in payload["error"]
+
+    # Walk the shard out of the leader's region: with region-a excluded
+    # every region-a voter is stranded, and the leader moves LAST —
+    # the walk ends by retiring it, forcing a leader change.
+    old_leader = group.leader().replica_id
+    ctrl = MigrationController(plane, hysteresis_steps=1)
+    ctrl.note_plan({0: "region-b"}, excluded=frozenset({"region-a"}))
+    _drive(
+        plane, ctrl,
+        lambda: ctrl.settled() and group.leader() is not None,
+        deadline_s=120.0, label="leader-retiring walk",
+    )
+    assert group.leader().replica_id != old_leader
+    assert old_leader not in group.voter_ids()
+
+    # The pre-migration resume token must 410 (the new leader never
+    # journaled the child kinds before its activation — resuming across
+    # that gap could hide a deletion forever), and the relist converges
+    # on the migrated shard's state with every pre-walk write intact.
+    status, payload, _headers = _http(
+        plane.address, "GET",
+        f"{_API}?watch=1&resourceVersion={pre_rv}&timeoutSeconds=2",
+    )
+    assert status == 410
+    status, relisted, _headers = _http(plane.address, "GET", _API)
+    assert status == 200
+    names = {item["metadata"]["name"] for item in relisted["items"]}
+    assert "mig-watch-a" in names
+    # And the child-kind watch picks back up at the fresh token.
+    status, payload, _headers = _http(
+        plane.address, "GET",
+        "/api/v1/namespaces/default/pods?watch=1"
+        f"&resourceVersion={relisted['resourceVersion']}"
+        "&timeoutSeconds=0.2",
+    )
+    assert status == 200
+    assert "events" in payload
+
+
+# ---------------------------------------------------------------------------
+# The seeded rolling campaign (the acceptance gate + the teeth)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.timeout(300)
+def test_rolling_region_outage_green_and_migration_contract(tmp_path):
+    res = rolling_region_outage(str(tmp_path), seed=31)
+    assert res["checker"]["ok"], res["checker"]["violations"]
+    # Two rounds, and each walk re-homed the shard OUT of the dark
+    # region; the hysteresis teeth: healing a region moves nothing.
+    assert len(res["rounds"]) == 2
+    for rnd in res["rounds"]:
+        assert rnd["home_after"] != rnd["cut"]
+        assert rnd["moves_on_heal"] == 0
+    # The availability clause: the blocking write through the dark-
+    # majority round acked clean once the walk landed leadership back
+    # in a reachable region — and it needed the walk (retries > 1).
+    assert res["blocking_write"]["status"] == 201
+    assert res["blocking_write"]["attempts"] > 1
+    # The steady shard never noticed either cut.
+    assert res["steady_shard_attempts"] == [1, 1]
+    # Walk hygiene: no ghost learner survived, replicas really retired.
+    assert res["ghost_learners"] == []
+    assert res["retired"]
+    # The membership invariants ran and held on the migrated shard.
+    for shard in ("0", "1"):
+        for inv in ("membership-single-change", "membership-quorum-overlap"):
+            assert res["checker"]["invariants"][f"shard{shard}:{inv}"][
+                "ok"]
+    teeth = str(res["teeth_shard"])
+    assert res["checker"]["invariants"][
+        f"shard{teeth}:membership-single-change"]["checked"] > 0
+    assert res["migrations"]["settled"] is True
+
+
+@pytest.mark.timeout(300)
+def test_rolling_region_outage_fence_disabled_fails_checker(tmp_path):
+    """The teeth: with the read fence off, the deposed leader's zombie
+    register read breaks the migrated shard's linearizability — the
+    campaign's green gate is the checker, and the checker bites."""
+    res = rolling_region_outage(str(tmp_path), seed=31, read_fence=False)
+    assert not res["checker"]["ok"]
+    failing = {
+        name for name, inv in res["checker"]["invariants"].items()
+        if not inv["ok"]
+    }
+    assert any(name.startswith("shard1:") for name in failing)
+    # The membership discipline held even in the failing run: the walk
+    # itself never tears a voting set — the fence hole is a READ bug.
+    for inv in ("membership-single-change", "membership-quorum-overlap"):
+        assert res["checker"]["invariants"][f"shard1:{inv}"]["ok"]
+
+
+@pytest.mark.timeout(300)
+def test_rolling_region_outage_leader_kill_mid_walk_stays_green(tmp_path):
+    """Crash-recovery teeth: hard-kill the walking leader at the walk's
+    mid-step (learner added, victim still a voter). The term fence
+    aborts the orphaned move, the unwind retires the learner — never a
+    ghost voter acking toward quorum — and after the heal a fresh walk
+    re-homes the shard with the checker green."""
+    res = rolling_region_outage(str(tmp_path), seed=31, teeth_kill=True)
+    assert res["checker"]["ok"], res["checker"]["violations"]
+    assert res["killed"] is not None
+    # The killed leader is out of the final voting set, and no
+    # half-added learner survived anywhere.
+    assert res["killed"] not in res["memberships"]["1"][-1]
+    assert res["ghost_learners"] == []
+    # The fence fired: at least one move in the history aborted, and
+    # the LAST word on the teeth shard is a completed walk.
+    outcomes = [m["outcome"] for m in res["migrations"]["history"]]
+    assert "aborted" in outcomes
+    assert outcomes[-1] == "completed"
+    for inv in ("membership-single-change", "membership-quorum-overlap"):
+        assert res["checker"]["invariants"][f"shard1:{inv}"]["ok"]
+    # Unlike the live-write run (zero moves on heal), the recovery walk
+    # here NEEDS the heal: the cut plus the crash left no committable
+    # quorum, so the completing walk lands after it.
+    assert res["rounds"][0]["moves_on_heal"] >= 1
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(600)
+def test_rolling_region_outage_byte_identity(tmp_path):
+    """Two seeded runs produce byte-identical artifacts — history,
+    checker verdict, injection log, final keys, homes, leaders AND the
+    full membership history of every shard."""
+    a = rolling_region_outage(str(tmp_path / "a"), seed=31)
+    b = rolling_region_outage(str(tmp_path / "b"), seed=31)
+    for field in ("history", "checker", "injection_log", "final_keys",
+                  "homes", "leaders", "memberships"):
+        assert json.dumps(a[field], sort_keys=True) == \
+            json.dumps(b[field], sort_keys=True), field

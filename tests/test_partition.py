@@ -756,7 +756,7 @@ def test_informer_across_partition_heal_never_serves_minority_state(
         # Heal; the deposed leader rejoins and truncates its ghost tail —
         # the minority write must stay gone everywhere.
         harness.plan.heal_all(step=2)
-        rejoin = harness.reconcile(old)
+        rejoin = harness.reconcile_replica(old)
         assert rejoin["truncated"] >= 1 or rejoin["snapshotInstalled"]
         harness.write("w", "post-1")
         deadline = time.monotonic() + 15

@@ -306,11 +306,14 @@ def test_pending_blip_never_fires_and_leaves_no_resolved():
 def test_default_rule_set_loads_and_names_match_docs_table():
     recording, alerts = default_rules()
     assert {r.name for r in recording} == {
-        "jobset:flow_rejected:rate1m", "jobset:restarts:rate5m"
+        "jobset:flow_rejected:rate1m", "jobset:restarts:rate5m",
+        "jobset:shard_migration_aborts:rate5m",
     }
     assert [a.name for a in alerts] == [
         "JobSetControlPlaneFailover",
         "JobSetFlowShedRateHigh",
+        "JobSetShardQuorumDegraded",
+        "JobSetShardMigrationAborting",
         "JobSetSLOAdmissionFastBurn",
         "JobSetSLOAdmissionSlowBurn",
     ]
