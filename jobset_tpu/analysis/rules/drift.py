@@ -42,7 +42,9 @@ from typing import Iterator
 
 from ..engine import Finding, register
 
-_METRIC_CLASSES = ("Counter", "Gauge", "CallbackGauge", "Histogram")
+_METRIC_CLASSES = (
+    "Counter", "Gauge", "CallbackGauge", "Histogram", "LabeledHistogram",
+)
 _POINT_CALLS = ("check", "consult", "add_rule")
 _POINT_RE = re.compile(r"``([a-z_]+\.[a-z_]+)``")
 _DOC_ROW_RE = re.compile(r"^\|\s*`([A-Za-z0-9_]+)`", re.MULTILINE)

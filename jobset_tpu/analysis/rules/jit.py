@@ -44,6 +44,10 @@ from ..engine import Finding, ModuleContext, dotted_name, register
 # deliberately NOT listed — loading is allowed to touch the host).
 HOT_MODULES = frozenset((
     "jobset_tpu/core/columnar.py",
+    # The profiler modules run on every sample / every contended acquire
+    # — hotter than any solve path, so the same no-host-sync bar applies.
+    "jobset_tpu/obs/contention.py",
+    "jobset_tpu/obs/profile.py",
     "jobset_tpu/placement/provider.py",
     "jobset_tpu/placement/solver.py",
     "jobset_tpu/policy/model.py",

@@ -623,6 +623,7 @@ def thundering_herd(
     tenants: int = 6,
     seed: int = 23,
     latency_fault_rate: float = 0.1,
+    profiled: bool = False,
 ) -> dict:
     """Seeded overload storm against a flow-controlled controller server
     (the flow plane's acceptance scenario, driven by ``bench.py
@@ -654,6 +655,14 @@ def thundering_herd(
     are byte-identical (``tests/test_flow.py`` asserts it), and no
     429'd create may leave an object behind (``leaked_shed_objects``
     must come back empty).
+
+    ``profiled=True`` runs the storm with the whole continuous-profiling
+    plane attached — live stack sampler, contention-instrumented
+    server/cluster locks, ``/debug/profile`` read at the end — and
+    returns the (wall-clock-dependent) liveness evidence under a
+    ``profile`` key. Everything OUTSIDE that key stays byte-identical
+    to an unprofiled run: the profiler only reads frames and times lock
+    waits, it never touches decision state.
     """
     import random
 
@@ -682,6 +691,22 @@ def thundering_herd(
         cluster=cluster, tick_interval=3600.0,
         injector=injector, flow=flow,
     )
+    profiler = contention_prof = None
+    locks_instrumented: list[str] = []
+    if profiled:
+        from ..obs.contention import ContentionProfiler
+        from ..obs.profile import StackProfiler
+
+        # Install BEFORE any driving: the server is never start()ed, so
+        # no thread has touched its locks yet (the race harness's swap
+        # rule), and the lock-wait histograms cover the whole storm.
+        contention_prof = ContentionProfiler()
+        locks_instrumented = sorted(
+            contention_prof.instrument(cluster, "cluster")
+            + contention_prof.instrument(server, "server")
+        )
+        server.profiler = profiler = StackProfiler(hz=200.0)
+        profiler.start()
     api = f"{server.API_PREFIX}/namespaces/default/jobsets"
     rng = random.Random(seed)
     # Telemetry teeth on the SAME virtual clock: one tick per arrival at
@@ -766,8 +791,28 @@ def thundering_herd(
         for _ in range(max(1, arrivals // 3)):
             drive("recover")
     finally:
+        if profiler is not None:
+            profiler.stop()
         server._stop.set()
         server._httpd.server_close()
+
+    profile_block = None
+    if profiled:
+        # The liveness evidence the profiling soak gates on: the debug
+        # surface answered, stacks were sampled, the lock and JIT
+        # telemetry rode along. Wall-clock-dependent by nature — callers
+        # comparing byte-identity must pop this key first.
+        resp = server._route("GET", "/debug/profile", b"")
+        payload = resp[1] if resp[0] == 200 else {}
+        profile_block = {
+            "status": resp[0],
+            "samples": payload.get("samples", 0),
+            "roles": sorted(payload.get("roles", {})),
+            "locks_instrumented": locks_instrumented,
+            "lock_waits": sorted(payload.get("locks", {})),
+            "jit_kernels": sorted(payload.get("jit", {})),
+        }
+        contention_prof.uninstall()
 
     with server.lock:
         leaked = [
@@ -807,6 +852,7 @@ def thundering_herd(
         "final_state": final_state,
         "alerts": telemetry.alerts.transition_log(),
         "alerts_firing": telemetry.alerts.firing(),
+        **({"profile": profile_block} if profile_block is not None else {}),
     }
 
 

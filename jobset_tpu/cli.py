@@ -193,6 +193,20 @@ def _build_parser() -> argparse.ArgumentParser:
                         "Prometheus groups/rules shape) for --telemetry; "
                         "default: the built-in rule set (failover, "
                         "shed-rate, SLO burn-rate alerts)")
+    c.add_argument("--profile", action="store_true",
+                   help="enable the continuous profiling plane "
+                        "(docs/observability.md): a sampling stack "
+                        "profiler walks every thread --profile-hz times a "
+                        "second into a bounded flamegraph trie, lock "
+                        "acquire-waits are timed into "
+                        "jobset_lock_wait_seconds{lock}, and GET "
+                        "/debug/profile serves folded stacks + hotspot "
+                        "tables (also: `jobset-tpu top hotspots`)")
+    c.add_argument("--profile-hz", type=float, default=67.0, metavar="HZ",
+                   help="stack sampling rate for --profile (default 67 — "
+                        "deliberately not a divisor of common tick "
+                        "intervals, so the sampler never walks in "
+                        "lockstep with the pump)")
     c.add_argument("--peer-timeout", type=float, default=5.0,
                    help="per-call timeout for replication RPCs to peers "
                         "(--replicate)")
@@ -254,9 +268,12 @@ def _build_parser() -> argparse.ArgumentParser:
         help="current rates from the controller's telemetry TSDB "
              "(requires a controller running with --telemetry)",
     )
-    top.add_argument("resource", choices=["jobsets", "shards"])
+    top.add_argument("resource", choices=["jobsets", "shards", "hotspots"],
+                     help="jobsets/shards need --telemetry on the "
+                          "controller; hotspots needs --profile (the "
+                          "sampling stack profiler's self-time table)")
     top.add_argument("--window", default="300s",
-                     help="rate window (default 300s)")
+                     help="rate window (default 300s; jobsets/shards only)")
     _add_server_flag(top)
 
     tr = sub.add_parser(
@@ -472,15 +489,27 @@ def _cmd_controller(args) -> int:
 
         flow = FlowController(seed=args.flow_seed)
     telemetry = _make_telemetry(args, cluster)
+    profiler = _make_profiler(args)
     server = ControllerServer(args.addr, cluster=cluster,
                               tick_interval=args.tick_interval,
                               tls_cert=tls_cert, tls_key=tls_key,
                               elector=elector, flow=flow,
-                              telemetry=telemetry,
+                              telemetry=telemetry, profiler=profiler,
                               # Separate-process replicas have private
                               # state: a standby must not accept writes the
                               # leader would never observe.
-                              standby_accepts_writes=False).start()
+                              standby_accepts_writes=False)
+    if profiler is not None:
+        # Swap the serving objects' locks for TimedLocks BEFORE start()
+        # spawns the pump — the race harness's rule (swap only before
+        # threads run) applies to production instrumentation too.
+        from .obs.contention import ContentionProfiler
+
+        contention = ContentionProfiler()
+        contention.instrument(cluster, "cluster")
+        contention.instrument(server, "server")
+        profiler.start()
+    server.start()
     scheme = "https" if server.tls else "http"
     print(f"controller listening on {scheme}://{server.address} "
           f"(solver={'sidecar ' + args.solver_addr if args.solver_addr else 'in-process'}"
@@ -489,12 +518,16 @@ def _cmd_controller(args) -> int:
           + (", flow-control on" if flow is not None else "")
           + (f", telemetry every {args.telemetry_interval:g}s"
              if telemetry is not None else "")
+          + (f", profiling at {args.profile_hz:g}Hz"
+             if profiler is not None else "")
           + ")",
           flush=True)
     _wait_for_signal()
     # Graceful drain (SIGTERM/Ctrl-C): fence writes (503 + Retry-After),
     # run one final pump, flush/fsync the WAL, release the leader lease —
     # then close the listener and exit 0.
+    if profiler is not None:
+        profiler.stop()
     if telemetry is not None:
         telemetry.stop()
     server.drain()
@@ -566,14 +599,26 @@ def _cmd_controller_sharded(args) -> int:
     telemetry = _make_telemetry(args, None)
     if telemetry is not None:
         plane.front_door.telemetry = telemetry
+    # The stack profiler hangs off the front door too: all shards are
+    # in-process, so one sampler sees the whole fleet's threads. (Lock
+    # instrumentation is skipped here — shard replica threads are
+    # already running by construction time, and the swap is only safe
+    # before threads touch the locks.)
+    profiler = _make_profiler(args)
+    if profiler is not None:
+        plane.front_door.profiler = profiler
+        profiler.start()
     plane.start_supervisor()
     print(f"sharded control plane: front door on http://{plane.address}, "
           f"{args.shards} shard group(s) x {args.shard_replicas} "
           f"replicas over regions {', '.join(regions)} "
           f"(map at /debug/shards"
           + (", telemetry at /debug/tsdb" if telemetry is not None else "")
+          + (", profiling at /debug/profile" if profiler is not None else "")
           + ")", flush=True)
     _wait_for_signal()
+    if profiler is not None:
+        profiler.stop()
     if telemetry is not None:
         telemetry.stop()
     plane.stop()
@@ -595,6 +640,18 @@ def _make_telemetry(args, cluster):
         cluster=cluster,
         rules_path=args.rules or None,
     ).start()
+
+
+def _make_profiler(args):
+    """Build the continuous stack profiler when --profile is set (None
+    otherwise). NOT started here: the caller starts it after wiring —
+    lock instrumentation (obs/contention.py) must precede thread
+    startup, and the sampler should never see a half-built server."""
+    if not getattr(args, "profile", False):
+        return None
+    from .obs.profile import StackProfiler
+
+    return StackProfiler(hz=args.profile_hz)
 
 
 def _make_controller_cluster(args):
@@ -1407,6 +1464,8 @@ def _cmd_top(args) -> int:
     from .client import ApiError
 
     client = _client(args)
+    if args.resource == "hotspots":
+        return _top_hotspots(client)
     w = args.window
     if args.resource == "jobsets":
         key = "jobset"
@@ -1449,6 +1508,33 @@ def _cmd_top(args) -> int:
     if not rows:
         print(f"(no {key} series in the TSDB yet — rates appear one "
               f"sampler tick after activity)")
+    return 0
+
+
+def _top_hotspots(client) -> int:
+    """`top hotspots`: the sampling profiler's self-time table from
+    GET /debug/profile (requires a controller running with --profile).
+    SELF% is the share of all samples whose leaf frame was this one —
+    where the controller actually spends its wall-clock."""
+    from .client import ApiError
+
+    try:
+        data = client.profile(top=15)
+    except ApiError as exc:
+        if exc.status == 404:
+            print("profiling is not enabled on this controller "
+                  "(start it with --profile)", file=sys.stderr)
+            return 1
+        raise
+    rows = data.get("top", [])
+    print(f"{'SELF%':>6} {'SELF':>8} {'TOTAL':>8} FRAME")
+    for row in rows:
+        print(f"{row['self_pct']:>6.1f} {row['self']:>8} "
+              f"{row['total']:>8} {row['frame']}")
+    if not rows:
+        print(f"(no stacks sampled yet — {data.get('samples', 0)} "
+              f"samples so far; the table fills within a second of "
+              f"controller activity)")
     return 0
 
 

@@ -929,6 +929,21 @@ class JobSetClient:
         pending/firing alerts, and the bounded transition log."""
         return self._request("GET", "/debug/alerts")
 
+    def profile(self, top: Optional[int] = None):
+        """`/debug/profile`: the continuous-profiling plane — sampler
+        state, thread-role sample counts, hottest frames, folded stacks,
+        the per-interval aggregate ring, JIT cache stats, and per-lock
+        contention stats. ``top`` bounds the hottest-frames table."""
+        path = "/debug/profile"
+        if top is not None:
+            path += f"?top={int(top)}"
+        return self._request("GET", path)
+
+    def profile_folded(self) -> str:
+        """`/debug/profile?format=folded`: bare folded-stack lines —
+        flamegraph.pl input."""
+        return self._request("GET", "/debug/profile?format=folded")
+
 
 # ---------------------------------------------------------------------------
 # Watch + informer (client-go informers/listers analog,

@@ -23,6 +23,7 @@ controller -> scheduler -> Pod reconciler, until a fixed point.
 
 from __future__ import annotations
 
+import time as _time
 from collections import deque
 from typing import Callable, Optional
 
@@ -30,6 +31,7 @@ from ..api import keys
 from ..api.defaulting import apply_defaults
 from ..api.types import Condition, JobSet, JobSetStatus, Taint
 from ..api.validation import validate_create, validate_update
+from ..obs import trace as obs_trace
 from ..obs.trace import current_trace_id
 from ..utils.clock import Clock, FakeClock
 from .objects import (
@@ -1002,8 +1004,26 @@ class Cluster:
         self.requeue_after[key] = max(self.requeue_after.get(key, 0.0), fire)
         return True
 
+    def _observe_phase(self, phase: str, elapsed_s: float) -> None:
+        """Per-tick phase attribution (docs/observability.md "Continuous
+        profiling"): always into the ``jobset_tick_phase_seconds``
+        histogram (which the telemetry TSDB samples), plus a synthesized
+        ``tick.{phase}`` span while the bench's duration log is recording
+        — an always-on span feed would flood the finished-trace ring in
+        live servers, so the histogram is the steady-state surface."""
+        from . import metrics
+
+        metrics.tick_phase_seconds.observe(elapsed_s, phase)
+        if obs_trace.duration_log_enabled():
+            obs_trace.TRACER.record_span(f"tick.{phase}", elapsed_s)
+
     def tick(self) -> bool:
         """One control-plane pass; returns True if anything changed."""
+        # Phase boundaries are timed with perf_counter (latency
+        # measurement, not decision state — the virtual clock still
+        # drives every semantic decision below).
+        _pc = _time.perf_counter
+        _t = _pc()
         changed = False
         while self._next_tick_queue:
             self.enqueue_reconcile(*self._next_tick_queue.popleft())
@@ -1039,11 +1059,18 @@ class Cluster:
                 )
                 changed = True
 
+        _now = _pc()
+        self._observe_phase("requeue", _now - _t)
+        _t = _now
+
         # 0c. Gang admission plane: one batched admission pass (admit /
         # preempt / backfill) whose suspend-flag flips are consumed by
         # this same tick's reconcile drain below.
         if self.queue_manager is not None:
             changed |= self.queue_manager.sync()
+        _now = _pc()
+        self._observe_phase("queue_sync", _now - _t)
+        _t = _now
 
         # 1. JobSet reconciler drains the work queue.
         while self.reconcile_queue:
@@ -1079,14 +1106,23 @@ class Cluster:
         # solver dispatch (the storm path); plans land before the next
         # tick's creation passes consume them.
         self._drain_prepare_requests()
+        _now = _pc()
+        self._observe_phase("reconcile", _now - _t)
+        _t = _now
 
         # 2. Simulated Job controller creates pods / aggregates status.
         if self.job_controller is not None:
             changed |= self.job_controller.sync()
+        _now = _pc()
+        self._observe_phase("job_sync", _now - _t)
+        _t = _now
 
         # 3. Scheduler binds pending pods.
         if self.scheduler is not None:
             changed |= self.scheduler.schedule_pending()
+        _now = _pc()
+        self._observe_phase("scheduler", _now - _t)
+        _t = _now
 
         # 4. kubelet analog: pods bound since the last pass become
         # running/ready, and in-place container restarts recover
@@ -1136,10 +1172,14 @@ class Cluster:
         if col is not None:
             col.set_phase_rows_locked(advanced, POD_RUNNING, ready=True)
             col.set_ready_rows_locked(recovered, ready=True)
+        _now = _pc()
+        self._observe_phase("sync_pods", _now - _t)
+        _t = _now
 
         # 5. Pod reconciler enforces exclusive-placement drift.
         if self.pod_reconciler is not None:
             changed |= self.pod_reconciler.sync()
+        self._observe_phase("pod_sync", _pc() - _t)
 
         # 6. One bounded between-tick wait when a reconcile parked on an
         # in-flight placement solve this tick: the device makes progress

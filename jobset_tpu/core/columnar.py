@@ -41,6 +41,7 @@ from typing import Optional
 import numpy as np
 
 from ..api import keys
+from ..obs import profile
 from .objects import (
     POD_FAILED,
     POD_PENDING,
@@ -109,7 +110,10 @@ def _agg_kernel(P: int, J: int):
         )
         return active, ready_c, failed
 
-    return kernel
+    return profile.timed_compile("columnar_agg", kernel)
+
+
+profile.KERNEL_CACHES.register("columnar_agg", _agg_kernel)
 
 
 class StringTable:
@@ -694,9 +698,14 @@ class ColumnarState:
             Pc = self.pod_phase.shape[0]
             Jc = self.job_expected.shape[0]
             kernel = _agg_kernel(Pc, Jc)
+            profile.note_transfer(
+                "columnar_agg", "h2d",
+                self.pod_job[:Pc], self.pod_phase[:Pc], self.pod_ready[:Pc],
+            )
             a, r, f = kernel(
                 self.pod_job[:Pc], self.pod_phase[:Pc], self.pod_ready[:Pc]
             )
+            profile.note_transfer("columnar_agg", "d2h", a, r, f)
             active = np.asarray(a, np.int64)[:J]
             ready_c = np.asarray(r, np.int64)[:J]
             failed = np.asarray(f, np.int64)[:J]

@@ -228,6 +228,64 @@ class Histogram:
         return raw[rank]
 
 
+class LabeledHistogram:
+    """A labeled vector of :class:`Histogram` children, keyed by label
+    tuple — the histogram analog of a labeled Counter/Gauge family
+    (`jobset_lock_wait_seconds{lock=...}`-shaped). Children are created
+    on first observe and live for the process (label cardinality is
+    bounded by construction: lock names, kernel names, tick phases —
+    never user input). The child map swap is guarded; each child then
+    guards its own bucket state, so two labelsets never contend on one
+    lock the way a shared-dict design would."""
+
+    def __init__(self, name: str, help_text: str = "",
+                 label_names: tuple = ("name",), num_buckets: int = 33):
+        self.name = name
+        self.help = help_text
+        self.label_names = label_names
+        self.num_buckets = num_buckets
+        self._children: dict[tuple, Histogram] = {}  # guarded-by: _lock
+        self._lock = threading.Lock()
+
+    def child(self, *labels) -> Histogram:
+        with self._lock:
+            h = self._children.get(labels)
+            if h is None:
+                h = self._children[labels] = Histogram(
+                    self.name, self.help, num_buckets=self.num_buckets
+                )
+            return h
+
+    def observe(self, seconds: float, *labels,
+                trace_id: str | None = None) -> None:
+        self.child(*labels).observe(seconds, trace_id=trace_id)
+
+    def children(self) -> list[tuple[tuple, Histogram]]:
+        with self._lock:
+            return sorted(self._children.items())
+
+    def count(self, *labels) -> int:
+        with self._lock:
+            h = self._children.get(labels)
+        if h is None:
+            return 0
+        with h._lock:
+            return h.n
+
+    def total(self, *labels) -> float:
+        with self._lock:
+            h = self._children.get(labels)
+        if h is None:
+            return 0.0
+        with h._lock:
+            return h.sum
+
+    def percentile(self, q: float, *labels) -> float:
+        with self._lock:
+            h = self._children.get(labels)
+        return h.percentile(q) if h is not None else math.nan
+
+
 # Registry (one per process, like the controller-runtime registry).
 jobset_completed_total = Counter(
     "jobset_completed_total", "Number of JobSets completed, per jobset"
@@ -572,6 +630,83 @@ alerts_transitions_total = Counter(
     "(pending/firing/resolved)",
     label_names=("alertname", "state"),
 )
+telemetry_tick_errors_total = Counter(
+    "jobset_telemetry_tick_errors_total",
+    "Telemetry sampler ticks where a stage (registry sample, recording "
+    "rules, alert evaluation) raised and was contained per stage — the "
+    "sampler thread survives and the next tick runs",
+    label_names=("stage",),
+)
+
+# Continuous-profiling plane (jobset_tpu/obs/profile.py + contention.py,
+# docs/observability.md "Continuous profiling"): the sampling stack
+# profiler, lock acquire-wait timing, JIT/kernel compile observability,
+# and per-tick phase attribution.
+lock_wait_seconds = LabeledHistogram(
+    "jobset_lock_wait_seconds",
+    "Acquire-wait observed on each instrumented named lock (only waits "
+    "that actually contended — uncontended fast-path acquires are not "
+    "observed)",
+    label_names=("lock",),
+)
+tick_phase_seconds = LabeledHistogram(
+    "jobset_tick_phase_seconds",
+    "Wall time per reconcile-pump phase per tick (queue_sync, "
+    "reconcile, job_sync, scheduler, sync_pods, pod_sync, "
+    "watch_refresh, store_commit, telemetry) — the attribution row "
+    "behind `bench --scale` regressions",
+    label_names=("phase",),
+)
+jit_compiles_total = Counter(
+    "jobset_jit_compiles_total",
+    "First-call JIT compilations per kernel family (solver, queue "
+    "scorer, columnar aggregates, policy MLP) — each cache-miss "
+    "specialization traced+lowered exactly once",
+    label_names=("kernel",),
+)
+jit_compile_seconds = LabeledHistogram(
+    "jobset_jit_compile_seconds",
+    "Wall time of each kernel's first (compiling) invocation per "
+    "kernel family — the trace+lower+compile cost the bucket caches "
+    "amortize",
+    label_names=("kernel",),
+)
+jit_cache_hits = CallbackGauge(
+    "jobset_jit_cache_hits",
+    "lru_cache hits on each compile-once kernel factory (collect-time "
+    "callback into functools cache_info)",
+    label_names=("kernel",),
+)
+jit_cache_misses = CallbackGauge(
+    "jobset_jit_cache_misses",
+    "lru_cache misses on each compile-once kernel factory — each miss "
+    "is a new bucket specialization paying a compile",
+    label_names=("kernel",),
+)
+jit_transfer_bytes_total = Counter(
+    "jobset_jit_transfer_bytes_total",
+    "Host<->device bytes moved at instrumented kernel boundaries per "
+    "kernel family and direction (h2d/d2h), estimated from array "
+    "shapes/dtypes at the call site",
+    label_names=("kernel", "direction"),
+)
+profile_samples_total = Counter(
+    "jobset_profile_samples_total",
+    "Stack samples folded into the profiler's aggregation trie (one "
+    "per sampled thread per sampler pass)",
+    label_names=(),
+)
+profile_overruns_total = Counter(
+    "jobset_profile_overruns_total",
+    "Sampler passes that took longer than the sampling period — the "
+    "duty-cycle contract (<=3%) is at risk when this grows",
+    label_names=(),
+)
+profile_trie_nodes = CallbackGauge(
+    "jobset_profile_trie_nodes",
+    "Live frame nodes in the profiler's bounded aggregation trie "
+    "(collect-time callback; 0 when profiling is disabled)",
+)
 
 
 def set_build_info(version: str, backend: str, gates: str,
@@ -616,6 +751,11 @@ ALL_COUNTERS = (
     telemetry_samples_total,
     telemetry_rule_evals_total,
     alerts_transitions_total,
+    telemetry_tick_errors_total,
+    jit_compiles_total,
+    jit_transfer_bytes_total,
+    profile_samples_total,
+    profile_overruns_total,
 )
 ALL_HISTOGRAMS = (
     reconcile_time_seconds,
@@ -648,6 +788,14 @@ ALL_GAUGES = (
     shard_learner_lag_records,
     telemetry_series,
     alerts_firing,
+    jit_cache_hits,
+    jit_cache_misses,
+    profile_trie_nodes,
+)
+ALL_LABELED_HISTOGRAMS = (
+    lock_wait_seconds,
+    tick_phase_seconds,
+    jit_compile_seconds,
 )
 
 # Histograms whose full bucket ladders are sampled into the telemetry
@@ -705,6 +853,16 @@ def sample_registry() -> list[tuple[str, tuple, float]]:
             )
         out.append((f"{h.name}_sum", (), float(total)))
         out.append((f"{h.name}_count", (), float(n)))
+    for lh in ALL_LABELED_HISTOGRAMS:
+        # Per-child _sum/_count only (no bucket ladders in the TSDB:
+        # rate(..._sum)/rate(..._count) is what the contention alert and
+        # phase attribution query; ladders stay on /metrics).
+        for labels, h in lh.children():
+            pairs = tuple(zip(lh.label_names, labels))
+            with h._lock:
+                total, n = h.sum, h.n
+            out.append((f"{lh.name}_sum", pairs, float(total)))
+            out.append((f"{lh.name}_count", pairs, float(n)))
     return out
 
 
@@ -786,6 +944,28 @@ def render_prometheus(openmetrics: bool = False) -> str:
         )
         lines.append(f"{h.name}_sum {total}")
         lines.append(f"{h.name}_count {n}")
+    for lh in ALL_LABELED_HISTOGRAMS:
+        lines.append(f"# HELP {lh.name} {lh.help}")
+        lines.append(f"# TYPE {lh.name} histogram")
+        for labels, h in lh.children():
+            pairs = ",".join(
+                f'{n_}="{v}"' for n_, v in zip(lh.label_names, labels)
+            )
+            with h._lock:
+                counts, total, n = list(h.counts), h.sum, h.n
+            cumulative = 0
+            for bound, count in zip(h.buckets, counts):
+                cumulative += count
+                lines.append(
+                    f'{lh.name}_bucket{{{pairs},le="{bound:g}"}} '
+                    f"{cumulative}"
+                )
+            cumulative += counts[-1]
+            lines.append(
+                f'{lh.name}_bucket{{{pairs},le="+Inf"}} {cumulative}'
+            )
+            lines.append(f"{lh.name}_sum{{{pairs}}} {total}")
+            lines.append(f"{lh.name}_count{{{pairs}}} {n}")
     if openmetrics:
         lines.append("# EOF")
     return "\n".join(lines) + "\n"
@@ -824,3 +1004,9 @@ def reset() -> None:
             hist.exemplars.clear()
             if hist.raw is not None:
                 hist.raw = []
+    for lh in ALL_LABELED_HISTOGRAMS:
+        with lh._lock:
+            # Drop children outright (not just zero them): label sets
+            # are per-case state (lock names, kernel shapes) and a
+            # leftover child would surface phantom series next case.
+            lh._children.clear()

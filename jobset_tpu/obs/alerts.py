@@ -126,6 +126,21 @@ DEFAULT_RULE_SET = {
                     },
                 },
                 {
+                    "alert": "JobSetLockContentionHigh",
+                    "expr":
+                        "sum by (lock) "
+                        "(rate(jobset_lock_wait_seconds_sum[60s])) > 0.2",
+                    "for": "0s",
+                    "labels": {"severity": "ticket"},
+                    "annotations": {
+                        "summary":
+                            "threads are spending >20% of wall-clock "
+                            "waiting on one instrumented lock (continuous "
+                            "profiling plane, --profile) — check "
+                            "/debug/profile for the holder's hotspots",
+                    },
+                },
+                {
                     "alert": "JobSetSLOAdmissionFastBurn",
                     "expr":
                         "slo_burn_rate(jobset_slo_time_to_admission_seconds"

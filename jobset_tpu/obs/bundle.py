@@ -20,6 +20,9 @@ controller — and writes one ``.tgz``:
   ``--telemetry``);
 * ``alerts.json``     — alert rules, active alerts, and the transition
   log (same ``enabled`` convention);
+* ``profile.json``    — the continuous profiler's hotspot/lock/JIT
+  snapshot from `/debug/profile` (``{"enabled": false}`` without
+  ``--profile``);
 * ``metrics.prom``    — a raw Prometheus text scrape.
 
 ``load_bundle(path)`` round-trips the tarball back into a dict of parsed
@@ -45,9 +48,11 @@ BUNDLE_FORMAT = 1
 # detection plane's debt is now part of every postmortem; 1.4 added
 # `tsdb.json` + `alerts.json`, the telemetry plane's full snapshot and
 # alert state/transition log, `{"enabled": false}` when the controller
-# runs without --telemetry).
+# runs without --telemetry; 1.5 added `profile.json`, the continuous
+# profiler's hotspot/lock/JIT snapshot, same `enabled` convention for
+# controllers running without --profile).
 # Bundles written before the stamp existed are treated as "1.0".
-BUNDLE_SCHEMA_VERSION = "1.4"
+BUNDLE_SCHEMA_VERSION = "1.5"
 
 _JSON_MEMBERS = (
     "manifest.json",
@@ -59,6 +64,7 @@ _JSON_MEMBERS = (
     "timelines.json",
     "tsdb.json",
     "alerts.json",
+    "profile.json",
 )
 
 
@@ -94,6 +100,10 @@ def write_bundle(client, path: str) -> dict:
     for member, fetch in (
         ("tsdb.json", client.tsdb),
         ("alerts.json", client.alerts),
+        # Profiling plane (schemaVersion 1.5): hotspot trie + lock-wait +
+        # JIT-cache snapshot; 404 means the controller runs without
+        # --profile.
+        ("profile.json", client.profile),
     ):
         try:
             payloads[member] = {"enabled": True, **fetch()}

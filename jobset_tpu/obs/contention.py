@@ -1,0 +1,137 @@
+"""Lock-contention profiler: acquire-wait timing on named locks.
+
+The race harness (``testing/race.py``) already owns the only sanctioned
+way to interpose on the tree's locks — swap an instance's Lock/RLock
+attributes for wrappers before its threads start. This module reuses
+that seam (:func:`testing.race.swap_lock_attrs`) for production
+telemetry instead of test-time race detection: each instrumented lock
+becomes a :class:`TimedLock` that times *contended* acquires into
+``jobset_lock_wait_seconds{lock}`` (docs/metrics.md), which the
+telemetry TSDB samples every tick and the default
+``JobSetLockContentionHigh`` alert watches (docs/observability.md).
+
+Measurement discipline:
+
+* Only contended acquires are observed. The fast path is a single
+  non-blocking ``acquire(False)`` — an uncontended lock costs one extra
+  C call and produces no sample, so the histogram answers "how long do
+  waiters wait", not "how often is the lock taken" (that would bury the
+  signal under millions of zero rows and add a clock read per acquire).
+* Waits are timed with ``time.perf_counter`` — latency measurement,
+  never decision state, so the seeded planes stay DET001-green.
+* Installation follows the race harness's rule: swap only before the
+  owning object's threads run (``instrument()`` at construction/wiring
+  time, e.g. ``controller --profile`` before ``serve()``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..core import metrics
+from ..testing.race import swap_lock_attrs
+
+
+class TimedLock:
+    """Lock/RLock wrapper that observes contended acquire-waits.
+
+    Works for both lock types: the reentrant re-acquire of an RLock by
+    its holder succeeds on the non-blocking fast path, so reentrancy
+    never records a phantom wait. Presents the full lock surface
+    (context manager, ``locked()``, ``_at_fork_reinit``) so it drops in
+    anywhere the bare primitive lived."""
+
+    __slots__ = ("_inner", "_name")
+
+    def __init__(self, inner, name: str):
+        self._inner = inner
+        self._name = name
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        if self._inner.acquire(False):
+            return True
+        if not blocking:
+            return False
+        t0 = time.perf_counter()
+        got = self._inner.acquire(True, timeout)
+        metrics.lock_wait_seconds.observe(
+            time.perf_counter() - t0, self._name
+        )
+        return got
+
+    def release(self):
+        self._inner.release()
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def _at_fork_reinit(self):  # pragma: no cover - forking servers only
+        self._inner._at_fork_reinit()
+
+
+class ContentionProfiler:
+    """Registry of instrumented objects; ``instrument(obj, prefix)``
+    swaps every bare Lock/RLock attribute for a :class:`TimedLock`
+    named ``{prefix}.{attr}`` and remembers the original so
+    ``uninstall()`` can restore it (test hygiene — live controllers
+    keep the wrappers for the process lifetime)."""
+
+    def __init__(self):
+        self._installed: list[tuple[object, str, object]] = []  # guarded-by: _lock
+        self._lock = threading.Lock()
+
+    def instrument(self, obj, prefix: str) -> list[str]:
+        """Returns the instrumented lock names (``prefix.attr``)."""
+        swapped = swap_lock_attrs(
+            obj, lambda name, value: TimedLock(value, f"{prefix}.{name}")
+        )
+        with self._lock:
+            for name, original in swapped:
+                self._installed.append((obj, name, original))
+        return [f"{prefix}.{name}" for name, _ in swapped]
+
+    def uninstall(self) -> None:
+        with self._lock:
+            installed, self._installed = self._installed, []
+        for obj, name, original in reversed(installed):
+            object.__setattr__(obj, name, original)
+
+    def names(self) -> list[str]:
+        """Instrumented lock names as exported (sorted, for /debug)."""
+        with self._lock:
+            return sorted(
+                getattr(obj, name)._name
+                for obj, name, _ in self._installed
+                if isinstance(getattr(obj, name, None), TimedLock)
+            )
+
+    def snapshot(self) -> dict[str, dict]:
+        return snapshot()
+
+
+def snapshot() -> dict[str, dict]:
+    """Per-lock wait stats for /debug/profile: contended-acquire count,
+    total wait, and p99 from the histogram ladder. Reads the process-
+    global ``jobset_lock_wait_seconds`` family, so it covers every
+    installed TimedLock regardless of which profiler installed it."""
+    out: dict[str, dict] = {}
+    for labels, hist in metrics.lock_wait_seconds.children():
+        with hist._lock:
+            n, total = hist.n, hist.sum
+        out[labels[0]] = {
+            "waits": n,
+            "wait_seconds_total": total,
+            "p99_s": metrics.lock_wait_seconds.percentile(
+                0.99, *labels
+            ) if n else 0.0,
+        }
+    return out

@@ -1,0 +1,487 @@
+"""Continuous profiling plane: sampled stacks + JIT/kernel telemetry.
+
+Two halves live here (docs/observability.md "Continuous profiling"):
+
+* :class:`StackProfiler` — a sampling wall-clock profiler. A daemon
+  thread walks ``sys._current_frames()`` at a configurable rate
+  (``controller --profile [--profile-hz]``) and folds each thread's
+  stack into a bounded aggregation trie rooted at the thread's *role*
+  (pump / handler / sampler / replication / drain / main), so the
+  flamegraph reads as "where does each control-plane thread spend its
+  time" rather than one undifferentiated blob. ``GET /debug/profile``
+  serves the trie as folded-stack lines (flamegraph.pl input) and a
+  top-N self/total table, plus a ring of per-interval aggregates so a
+  transient stall is still attributable after it passes.
+
+  Telemetry-plane discipline applies: the clock is injectable and
+  ``sample(now=, frames=)`` is a synchronous path that takes synthetic
+  stacks, so tests exercise fold/ring/bound logic deterministically —
+  no wall reads (DET001), no sleeps, no real threads required.
+
+* JIT/kernel observability — the runtime teeth for JIT002/JIT004. The
+  compile-once bucket factories (solver, queue scorer, columnar
+  aggregates, policy MLP) wrap their freshly-jitted kernels in
+  :func:`timed_compile` (first invocation per specialization = the
+  trace+lower+compile cost, observed into ``jobset_jit_compile_seconds``
+  and counted in ``jobset_jit_compiles_total``) and register their
+  ``lru_cache`` handles with :data:`KERNEL_CACHES` so the
+  ``jobset_jit_cache_{hits,misses}`` gauges read ``cache_info()`` at
+  collect time. :func:`note_transfer` accounts host<->device bytes at
+  instrumented call sites (``jobset_jit_transfer_bytes_total``).
+
+Overhead contract: sampling at the default 67 Hz must cost <=3% of a
+core (``bench.py --profile`` banks the measured duty cycle); a sampler
+pass that overruns its period bumps ``jobset_profile_overruns_total``.
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+import threading
+import time
+from collections import deque
+
+from ..core import metrics
+
+# Default sampling rate. 67 Hz (15 ms period) rather than a round 100:
+# prime-ish rates avoid lockstep aliasing with the pump's own periodic
+# work (a 100 Hz sampler over a 10 ms-quantized loop samples the same
+# phase forever and the profile lies).
+DEFAULT_HZ = 67.0
+
+# Trie bound: past this many frame nodes new stack suffixes stop
+# growing the trie (counts still land on the deepest existing node) and
+# the drop is surfaced in describe(). 64k nodes is ~an order of
+# magnitude above what the full tier-1 suite's stacks produce.
+DEFAULT_MAX_NODES = 65536
+
+# Stack depth cap per sample: deeper frames (recursive solver descent,
+# pytest internals in tests) fold into their 128-frame prefix.
+MAX_STACK_DEPTH = 128
+
+# Per-interval aggregate ring: at 10 s per interval and 180 slots the
+# ring holds 30 minutes of "what was hot then" history.
+DEFAULT_INTERVAL_S = 10.0
+DEFAULT_RING_SLOTS = 180
+
+THREAD_NAME = "profile-sampler"
+
+# Thread-name substring -> role label, first match wins. Order matters:
+# the sampler must recognize (and skip) itself before the generic
+# "sampler" suffix match, and explicit names beat the CPython default
+# "Thread-N" handler pattern.
+_ROLE_PATTERNS = (
+    (THREAD_NAME, "profiler"),
+    ("telemetry-sampler", "sampler"),
+    ("pump", "pump"),
+    ("replic", "replication"),
+    ("shard-supervisor", "replication"),
+    ("drain", "drain"),
+    ("Thread-", "handler"),
+    ("MainThread", "main"),
+)
+
+
+def thread_role(name: str) -> str:
+    for pattern, role in _ROLE_PATTERNS:
+        if pattern in name:
+            return role
+    return "other"
+
+
+def _frame_label(frame) -> str:
+    """Stable per-function label: ``path/tail.py:function``. Aggregating
+    by function (not line) keeps the trie small and the flamegraph
+    readable; co_filename is trimmed to its last two components so
+    labels survive venv/site-packages prefix churn across hosts."""
+    code = frame.f_code
+    parts = code.co_filename.replace("\\", "/").rsplit("/", 2)
+    tail = "/".join(parts[-2:])
+    return f"{tail}:{code.co_name}"
+
+
+class _Node:
+    __slots__ = ("children", "self_count", "total_count")
+
+    def __init__(self):
+        self.children: dict[str, _Node] = {}
+        self.self_count = 0
+        self.total_count = 0
+
+
+class StackProfiler:
+    """Bounded folding-trie stack sampler with an injectable clock.
+
+    Live path: ``start()`` spawns the daemon sampler thread; each pass
+    snapshots ``sys._current_frames()``, resolves thread names to roles,
+    and folds every stack (outermost frame first) under its role root.
+    Deterministic path: ``sample(now=..., frames=[(name, [label, ...]),
+    ...])`` performs one synchronous pass with synthetic stacks and an
+    explicit timestamp — the tests' only entry point.
+    """
+
+    def __init__(self, hz: float = DEFAULT_HZ, clock=None,
+                 max_nodes: int = DEFAULT_MAX_NODES,
+                 interval_s: float = DEFAULT_INTERVAL_S,
+                 ring_slots: int = DEFAULT_RING_SLOTS):
+        self.hz = max(float(hz), 0.1)
+        # time.monotonic, not time.time: interval bookkeeping is latency
+        # measurement, never decision state, and must not jump with NTP.
+        self.clock = clock if clock is not None else time.monotonic
+        self.max_nodes = max_nodes
+        self.interval_s = interval_s
+        self._root = _Node()  # guarded-by: _data_lock
+        self._node_count = 0  # guarded-by: _data_lock
+        self._dropped_frames = 0  # guarded-by: _data_lock
+        self._samples = 0  # guarded-by: _data_lock
+        self._interval_counts: dict[str, int] = {}  # guarded-by: _data_lock
+        self._interval_start: float | None = None  # guarded-by: _data_lock
+        self._interval_samples = 0  # guarded-by: _data_lock
+        self._ring: deque = deque(maxlen=ring_slots)  # guarded-by: _data_lock
+        self._data_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        metrics.profile_trie_nodes.bind(self, StackProfiler._collect_nodes)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "StackProfiler":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name=THREAD_NAME, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=5.0)
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def _run(self) -> None:
+        period = 1.0 / self.hz
+        busy = 0.0
+        while not self._stop.wait(max(0.0, period - busy)):
+            t0 = time.perf_counter()
+            try:
+                self.sample()
+            except Exception:
+                # A torn frame snapshot (thread died mid-walk) must not
+                # kill the sampler; the next pass resamples.
+                metrics.telemetry_tick_errors_total.inc("profile_sample")
+            busy = time.perf_counter() - t0
+            if busy > period:
+                metrics.profile_overruns_total.inc()
+
+    def _collect_nodes(self) -> float:
+        with self._data_lock:
+            return float(self._node_count)
+
+    # -- sampling ----------------------------------------------------------
+
+    def sample(self, now: float | None = None, frames=None) -> int:
+        """One sampler pass. Returns the number of stacks folded."""
+        if now is None:
+            now = self.clock()
+        if frames is None:
+            frames = self._live_frames()
+        folded = 0
+        with self._data_lock:
+            if self._interval_start is None:
+                self._interval_start = now
+            elif now - self._interval_start >= self.interval_s:
+                self._roll_interval_locked(now)
+            for name, stack in frames:
+                role = thread_role(name)
+                if role == "profiler":
+                    continue
+                self._fold_locked(role, stack)
+                folded += 1
+            self._samples += folded
+            self._interval_samples += folded
+        if folded:
+            metrics.profile_samples_total.inc(amount=float(folded))
+        return folded
+
+    def _live_frames(self) -> list[tuple[str, list[str]]]:
+        names = {t.ident: t.name for t in threading.enumerate()}
+        out = []
+        for tid, frame in sys._current_frames().items():
+            stack: list[str] = []
+            f = frame
+            while f is not None and len(stack) < MAX_STACK_DEPTH:
+                stack.append(_frame_label(f))
+                f = f.f_back
+            stack.reverse()  # outermost first: trie roots at thread entry
+            out.append((names.get(tid, f"thread-{tid}"), stack))
+        out.sort()
+        return out
+
+    def _fold_locked(self, role: str, stack) -> None:
+        node = self._root
+        node.total_count += 1
+        for label in (role, *stack):
+            child = node.children.get(label)
+            if child is None:
+                if self._node_count >= self.max_nodes:
+                    # Bounded: credit the deepest existing node's self
+                    # time and record the truncation.
+                    self._dropped_frames += 1
+                    break
+                child = node.children[label] = _Node()
+                self._node_count += 1
+            node = child
+            node.total_count += 1
+        node.self_count += 1
+        leaf = stack[-1] if stack else role
+        self._interval_counts[f"{role};{leaf}"] = (
+            self._interval_counts.get(f"{role};{leaf}", 0) + 1
+        )
+
+    def _roll_interval_locked(self, now: float) -> None:
+        top = sorted(
+            self._interval_counts.items(), key=lambda kv: (-kv[1], kv[0])
+        )[:10]
+        self._ring.append({
+            "start": self._interval_start,
+            "end": now,
+            "samples": self._interval_samples,
+            "top": [{"frame": k, "self": v} for k, v in top],
+        })
+        self._interval_counts = {}
+        self._interval_start = now
+        self._interval_samples = 0
+
+    # -- read surface ------------------------------------------------------
+
+    def folded(self) -> str:
+        """flamegraph.pl input: one ``role;frame;...;frame count`` line
+        per trie path with nonzero self count, deterministically sorted."""
+        lines: list[str] = []
+        with self._data_lock:
+            stack: list[tuple[_Node, tuple[str, ...]]] = [(self._root, ())]
+            while stack:
+                node, path = stack.pop()
+                if node.self_count and path:
+                    lines.append(f"{';'.join(path)} {node.self_count}")
+                for label in sorted(node.children, reverse=True):
+                    stack.append((node.children[label], path + (label,)))
+        return "\n".join(sorted(lines))
+
+    def top(self, n: int = 10) -> list[dict]:
+        """Hottest frames by self count (total = inclusive count), the
+        ``jobset-tpu top hotspots`` table."""
+        agg: dict[str, list[int]] = {}
+        with self._data_lock:
+            stack: list[tuple[_Node, int]] = [(self._root, 0)]
+            while stack:
+                node, depth = stack.pop()
+                for label, child in node.children.items():
+                    # depth 1 == the role root; skip it in the frame table.
+                    if depth >= 1:
+                        row = agg.setdefault(label, [0, 0])
+                        row[0] += child.self_count
+                        row[1] += child.total_count
+                    stack.append((child, depth + 1))
+            samples = self._samples
+        rows = [
+            {"frame": label, "self": s, "total": t,
+             "self_pct": round(100.0 * s / samples, 2) if samples else 0.0}
+            for label, (s, t) in agg.items()
+        ]
+        rows.sort(key=lambda r: (-r["self"], -r["total"], r["frame"]))
+        return rows[:n]
+
+    def roles(self) -> dict[str, int]:
+        """Samples folded under each thread-role root, sorted by role."""
+        with self._data_lock:
+            return {
+                label: child.total_count
+                for label, child in sorted(self._root.children.items())
+            }
+
+    def describe(self, top_n: int = 25) -> dict:
+        """``GET /debug/profile`` payload."""
+        with self._data_lock:
+            samples = self._samples
+            nodes = self._node_count
+            dropped = self._dropped_frames
+            intervals = list(self._ring)
+        return {
+            "running": self.running,
+            "hz": self.hz,
+            "interval_s": self.interval_s,
+            "samples": samples,
+            "trie_nodes": nodes,
+            "max_nodes": self.max_nodes,
+            "dropped_frames": dropped,
+            "roles": self.roles(),
+            "top": self.top(top_n),
+            "folded": self.folded(),
+            "intervals": intervals,
+        }
+
+    def reset(self) -> None:
+        with self._data_lock:
+            self._root = _Node()
+            self._node_count = 0
+            self._dropped_frames = 0
+            self._samples = 0
+            self._interval_counts = {}
+            self._interval_start = None
+            self._interval_samples = 0
+            self._ring.clear()
+
+
+# -- JIT/kernel observability ---------------------------------------------
+
+
+class KernelCacheRegistry:
+    """Named ``lru_cache`` handles of the compile-once kernel factories,
+    bound to the ``jobset_jit_cache_{hits,misses}`` callback gauges so a
+    scrape reads live ``cache_info()`` — no push sites to forget."""
+
+    def __init__(self):
+        self._caches: dict[str, object] = {}  # guarded-by: _lock
+        self._lock = threading.Lock()
+
+    def register(self, kernel: str, cached_factory) -> None:
+        with self._lock:
+            self._caches[kernel] = cached_factory
+        # (Re)bind on every registration: metrics.reset() in test
+        # teardown drops bindings, and the next factory import restores
+        # them here.
+        metrics.jit_cache_hits.bind(self, KernelCacheRegistry._hits)
+        metrics.jit_cache_misses.bind(self, KernelCacheRegistry._misses)
+
+    def _info(self) -> list[tuple[str, object]]:
+        with self._lock:
+            items = sorted(self._caches.items())
+        out = []
+        for kernel, factory in items:
+            info = getattr(factory, "cache_info", None)
+            if info is not None:
+                out.append((kernel, info()))
+        return out
+
+    def _hits(self) -> list[tuple[tuple, float]]:
+        return [((kernel,), float(info.hits))
+                for kernel, info in self._info()]
+
+    def _misses(self) -> list[tuple[tuple, float]]:
+        return [((kernel,), float(info.misses))
+                for kernel, info in self._info()]
+
+    def snapshot(self) -> dict[str, dict]:
+        """Per-kernel cache stats for /debug/profile consumers."""
+        return {
+            kernel: {
+                "hits": info.hits, "misses": info.misses,
+                "maxsize": info.maxsize, "currsize": info.currsize,
+            }
+            for kernel, info in self._info()
+        }
+
+
+KERNEL_CACHES = KernelCacheRegistry()
+
+
+def timed_compile(kernel: str, fn):
+    """Wrap a freshly-jitted kernel so its first invocation — the one
+    that traces, lowers, and compiles — is timed into
+    ``jobset_jit_compile_seconds{kernel}`` and counted in
+    ``jobset_jit_compiles_total{kernel}``. Factories call this per
+    specialization (inside the lru_cached body), so every bucket miss
+    surfaces its real compile cost; steady-state calls pay one boolean
+    check."""
+    state = {"pending": True}
+    lock = threading.Lock()
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        with lock:
+            first, state["pending"] = state["pending"], False
+        if not first:
+            return fn(*args, **kwargs)
+        t0 = time.perf_counter()
+        out = fn(*args, **kwargs)
+        _block(out)
+        elapsed = time.perf_counter() - t0
+        metrics.jit_compiles_total.inc(kernel)
+        metrics.jit_compile_seconds.observe(elapsed, kernel)
+        return out
+
+    return wrapper
+
+
+_SEEN_SHAPES: dict[str, set] = {}  # guarded-by: _SEEN_LOCK
+_SEEN_LOCK = threading.Lock()
+
+
+def jit_shape_call(kernel: str, fn, *args, **kwargs):
+    """Call a module-level ``@jax.jit`` kernel, treating the first call
+    per (shapes, dtypes, kwargs) signature as its compile — the same key
+    jax's own compilation cache uses — and timing it into the
+    ``jobset_jit_*`` families. The lru_cached bucket factories use
+    :func:`timed_compile` instead (one fresh callable per
+    specialization); this is for kernels whose cache lives inside
+    ``jax.jit`` itself (the solver's module-level auctions). Host-side
+    call sites only: inside a trace the side effects would replay."""
+    sig_parts: list = []
+    for a in args:
+        shape = getattr(a, "shape", None)
+        if shape is not None:
+            sig_parts.append((tuple(shape), str(getattr(a, "dtype", ""))))
+        else:
+            sig_parts.append(repr(a))
+    sig = (tuple(sig_parts), tuple(sorted(kwargs.items())))
+    with _SEEN_LOCK:
+        seen = _SEEN_SHAPES.setdefault(kernel, set())
+        first = sig not in seen
+        seen.add(sig)
+    if not first:
+        return fn(*args, **kwargs)
+    t0 = time.perf_counter()
+    out = fn(*args, **kwargs)
+    _block(out)
+    elapsed = time.perf_counter() - t0
+    metrics.jit_compiles_total.inc(kernel)
+    metrics.jit_compile_seconds.observe(elapsed, kernel)
+    return out
+
+
+def _block(out) -> None:
+    """Best-effort device sync so first-call timing covers the compile
+    AND its execution rather than the async dispatch. Duck-typed: no
+    jax import here (the factories gate jax themselves)."""
+    if isinstance(out, (tuple, list)):
+        for item in out:
+            _block(item)
+        return
+    block = getattr(out, "block_until_ready", None)
+    if callable(block):
+        try:
+            block()
+        except Exception:
+            pass
+
+
+def note_transfer(kernel: str, direction: str, *arrays) -> None:
+    """Account host<->device bytes at a kernel boundary
+    (``direction`` is ``h2d`` or ``d2h``), estimated from ``nbytes`` of
+    the arrays actually crossing it."""
+    total = 0
+    for a in arrays:
+        total += int(getattr(a, "nbytes", 0) or 0)
+    if total:
+        metrics.jit_transfer_bytes_total.inc(
+            kernel, direction, amount=float(total)
+        )

@@ -374,6 +374,17 @@ class Tracer:
 TRACER = Tracer()
 
 
+def duration_log_enabled() -> bool:
+    """True while the global tracer's duration log is recording (bench
+    runs). The per-tick phase attribution gates its `tick.*` span
+    synthesis on this: each phase span roots a fresh trace, and an
+    always-on feed would flood the finished-trace ring in live servers
+    — the metrics histogram (`jobset_tick_phase_seconds`) is the
+    always-on surface instead."""
+    with TRACER._lock:
+        return TRACER._duration_log is not None
+
+
 def span(
     name: str,
     attributes: Optional[dict] = None,

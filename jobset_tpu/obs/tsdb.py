@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import struct
 import threading
+import time
 from array import array
 
 from ..utils.clock import Clock
@@ -281,23 +282,43 @@ class Telemetry:
     def tick(self, now: float | None = None) -> None:
         """One sampler pass. Serialized: the sampler thread and any
         synchronous caller (tests, drain paths) must not interleave two
-        passes, or rule evals would see half a tick's samples."""
+        passes, or rule evals would see half a tick's samples.
+
+        Each stage is error-contained: a raising CallbackGauge provider
+        outside collect()'s own containment (the registry sampler path),
+        a rule whose expression trips on a malformed series, or an alert
+        effect that throws must degrade THAT stage of THIS tick — never
+        kill the sampler thread. Contained errors are counted per stage
+        in ``jobset_telemetry_tick_errors_total`` so the degradation is
+        itself observable (the plane must not fail silently)."""
         from ..core import metrics
         from .rules import evaluate
 
         if now is None:
             now = self.clock.now()
         with self._tick_lock:
-            samples = metrics.sample_registry()
-            for name, labels, value in samples:
-                self.tsdb.append(name, labels, now, value)
-            metrics.telemetry_samples_total.inc(amount=float(len(samples)))
-            for rule in self.recording_rules:
-                for labels, value in evaluate(rule.ast, self.tsdb, now):
-                    self.tsdb.append(
-                        rule.name, tuple(sorted(labels.items())), now, value
-                    )
-            self.alerts.evaluate(self.tsdb, now)
+            try:
+                samples = metrics.sample_registry()
+                for name, labels, value in samples:
+                    self.tsdb.append(name, labels, now, value)
+                metrics.telemetry_samples_total.inc(
+                    amount=float(len(samples))
+                )
+            except Exception:
+                metrics.telemetry_tick_errors_total.inc("sample")
+            try:
+                for rule in self.recording_rules:
+                    for labels, value in evaluate(rule.ast, self.tsdb, now):
+                        self.tsdb.append(
+                            rule.name, tuple(sorted(labels.items())),
+                            now, value,
+                        )
+            except Exception:
+                metrics.telemetry_tick_errors_total.inc("rules")
+            try:
+                self.alerts.evaluate(self.tsdb, now)
+            except Exception:
+                metrics.telemetry_tick_errors_total.inc("alerts")
             if self.recording_rules or self.alerts.rules:
                 metrics.telemetry_rule_evals_total.inc()
 
@@ -312,8 +333,27 @@ class Telemetry:
         return self
 
     def _run(self) -> None:
+        # The "telemetry" phase row is observed HERE, not inside tick():
+        # synchronous sim-driven ticks must stay byte-identical across
+        # seeded runs, and a perf_counter-valued series sampled into the
+        # TSDB on the very next tick would break that contract. Live
+        # sampler passes have no such contract.
         while not self._stop.wait(self.interval):
-            self.tick()
+            t0 = time.perf_counter()
+            try:
+                self.tick()
+                from ..core import metrics
+
+                metrics.tick_phase_seconds.observe(
+                    time.perf_counter() - t0, "telemetry"
+                )
+            except Exception:
+                # Belt and braces over tick()'s per-stage containment: a
+                # failure OUTSIDE the contained stages (the clock itself,
+                # a histogram observe) still must not kill the sampler.
+                from ..core import metrics
+
+                metrics.telemetry_tick_errors_total.inc("tick")
 
     def stop(self) -> None:
         self._stop.set()

@@ -47,6 +47,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..core import metrics
+from ..obs import profile
 from ..obs import trace as obs_trace
 
 # Cost scale: costs are small non-negative ints; benefit = (COST_CAP - cost).
@@ -921,12 +922,16 @@ class AssignmentSolver:
                         feasible, COST_CAP - clipped, NEG_INF
                     )
                     benefit_scaled = jnp.asarray(benefit * scale)
+                    profile.note_transfer(
+                        "solver_auction", "h2d", benefit_scaled
+                    )
                 cache = _note_compile(
                     _compile_cache_key("auction", jobs_p, domains_p, max_iters)
                 )
                 with obs_trace.span("solver.dispatch", {"compile_cache": cache}):
-                    assignment, _, iters = _auction(
-                        benefit_scaled, jnp.float32(1.0), max_iters=max_iters
+                    assignment, _, iters = profile.jit_shape_call(
+                        "solver_auction", _auction,
+                        benefit_scaled, jnp.float32(1.0), max_iters=max_iters,
                     )
             pending = PendingSolve(
                 assignment, iters, num_jobs, num_domains, t0,
@@ -1002,11 +1007,15 @@ class AssignmentSolver:
                         jnp.asarray(pad(np.asarray(occupied, bool), domains_p, True)),
                         jnp.asarray(pad(np.asarray(own_domain, np.int32), jobs_p, -1)),
                     )
+                    profile.note_transfer(
+                        "solver_auction_structured", "h2d", *operands
+                    )
                 cache = _note_compile(_compile_cache_key(
                     "auction_structured", jobs_p, domains_p, max_iters
                 ))
                 with obs_trace.span("solver.dispatch", {"compile_cache": cache}):
-                    assignment, iters = _auction_structured(
+                    assignment, iters = profile.jit_shape_call(
+                        "solver_auction_structured", _auction_structured,
                         *operands,
                         jnp.int32(num_domains),
                         max_iters=max_iters,
@@ -1112,7 +1121,9 @@ class AssignmentSolver:
                     domains_p, self.max_iters,
                 ))
                 with obs_trace.span("solver.dispatch", {"compile_cache": cache}):
-                    assignment, iters = _auction_structured_batch(
+                    assignment, iters = profile.jit_shape_call(
+                        "solver_auction_structured_batch",
+                        _auction_structured_batch,
                         operands["load"], operands["free"],
                         operands["pods_needed"], operands["sticky"],
                         operands["occupied"], operands["own_domain"],
@@ -1218,6 +1229,9 @@ class AssignmentSolver:
                         feasibles, COST_CAP - clipped, NEG_INF
                     )
                     benefit_scaled = jnp.asarray(benefit * scale)
+                    profile.note_transfer(
+                        "solver_auction_batch", "h2d", benefit_scaled
+                    )
                 cache = _note_compile(_compile_cache_key(
                     "auction_batch", batch, jobs_p, domains_p, self.max_iters
                 ))
@@ -1225,7 +1239,8 @@ class AssignmentSolver:
                     "solver.dispatch", {"compile_cache": cache}
                 ):
                     assignments = np.asarray(
-                        _auction_batch(
+                        profile.jit_shape_call(
+                            "solver_auction_batch", _auction_batch,
                             benefit_scaled, jnp.float32(1.0),
                             max_iters=self.max_iters,
                         )

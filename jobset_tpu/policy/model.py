@@ -29,6 +29,7 @@ from typing import Optional
 
 import numpy as np
 
+from ..obs import profile
 from .features import FEATURE_DIM, FEATURE_NAMES, DomainHistory
 
 # Checkpoint schema major version: load_checkpoint rejects anything else.
@@ -123,7 +124,10 @@ def _kernel(rows_p: int, dims: tuple[int, ...]):
                 h = jax.nn.relu(h)
         return h[:, 0]
 
-    return kernel
+    return profile.timed_compile("policy_mlp", kernel)
+
+
+profile.KERNEL_CACHES.register("policy_mlp", _kernel)
 
 
 def score(

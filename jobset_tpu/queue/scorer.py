@@ -32,6 +32,7 @@ from dataclasses import dataclass
 import numpy as np
 
 from ..core import features
+from ..obs import profile
 
 
 def _round_up_pow2(n: int, minimum: int = 8) -> int:
@@ -175,7 +176,10 @@ def _kernel(P: int, Q: int, C: int, R: int):
         # gathered so the selection sort consumes one [P] vector.
         return feasible, share, share[qi]
 
-    return kernel
+    return profile.timed_compile("queue_scorer", kernel)
+
+
+profile.KERNEL_CACHES.register("queue_scorer", _kernel)
 
 
 # Compile-once high-water candidate buckets (the policy plane's
@@ -254,8 +258,15 @@ def _score_jax(snapshot: Snapshot) -> ScoreResult:
     qi = np.zeros(P, np.int32)
     qi[:P0] = snapshot.queue_index
 
+    profile.note_transfer(
+        "queue_scorer", "h2d",
+        nominal, declared, usage, weight, cohort, request, qi,
+    )
     feasible, share, candidate_share = _kernel(P, Q, C, R)(
         nominal, declared, usage, weight, cohort, request, qi
+    )
+    profile.note_transfer(
+        "queue_scorer", "d2h", feasible, share, candidate_share
     )
     return ScoreResult(
         feasible=np.asarray(feasible)[:P0],

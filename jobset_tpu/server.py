@@ -34,6 +34,7 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
@@ -254,6 +255,7 @@ class ControllerServer:
         shard_id=None,
         shard_map=None,
         telemetry=None,
+        profiler=None,
     ):
         if cluster is None:
             cluster = make_cluster(clock=Clock())
@@ -264,6 +266,11 @@ class ControllerServer:
         # off); the caller owns the sampler lifecycle (CLI start/stop,
         # scenario harnesses tick synchronously on the virtual clock).
         self.telemetry = telemetry
+        # Continuous-profiling plane (obs/profile.py, docs/observability.md
+        # "Continuous profiling"): an obs.profile.StackProfiler backing
+        # GET /debug/profile. None = 404 (--profile off); the caller owns
+        # the sampler lifecycle, same contract as telemetry.
+        self.profiler = profiler
         # Sharded control plane (docs/sharding.md). A server carrying a
         # `shard_router` is the ROUTING FRONT DOOR: after flow
         # classification, jobset-keyed traffic dispatches to the owning
@@ -765,8 +772,14 @@ class ControllerServer:
             if ticks > 1 or replication_behind or (
                 store is not None and store.retry_pending
             ):
+                t0 = time.perf_counter()
                 self._refresh_watch_locked()
+                t1 = time.perf_counter()
+                self.cluster._observe_phase("watch_refresh", t1 - t0)
                 self._commit_store_locked()
+                self.cluster._observe_phase(
+                    "store_commit", time.perf_counter() - t1
+                )
 
     def pump_if_leader(self) -> bool:
         """One leader-gated pump round: acquire/renew the lease, reconcile
@@ -1533,6 +1546,43 @@ class ControllerServer:
         except obs_rules.RuleError as exc:
             return 400, {"error": str(exc)}
 
+    def _debug_profile(self, params: dict, headers=None):
+        """GET /debug/profile — the continuous-profiling plane's read
+        surface (docs/observability.md "Continuous profiling").
+
+        * no params — JSON payload: sampler state, thread-role sample
+          counts, top-N hottest frames, folded stacks, the per-interval
+          aggregate ring, per-kernel JIT cache stats, and per-lock
+          contention stats.
+        * ``?format=folded`` — bare text/plain folded-stack lines, pipe
+          straight into flamegraph.pl.
+        * ``?top=N`` — bound the hottest-frames table (default 25).
+        """
+        from .obs import contention as obs_contention
+        from .obs import profile as obs_profile
+
+        unknown = sorted(set(params) - {"format", "top"})
+        if unknown:
+            return 400, {
+                "error": f"unknown parameter {unknown[0]!r} "
+                         "(want format, top)"
+            }
+        if self.profiler is None:
+            return 404, {"error": "profiling not enabled (--profile)"}
+        fmt = params.get("format", [None])[0]
+        if fmt is not None and fmt != "folded":
+            return 400, {"error": f"unknown format {fmt!r} (want folded)"}
+        try:
+            top_n = int(params.get("top", ["25"])[0])
+        except ValueError:
+            return 400, {"error": "bad top parameter"}
+        if fmt == "folded":
+            return 200, self.profiler.folded() + "\n", "text/plain"
+        payload = self.profiler.describe(top_n=top_n)
+        payload["jit"] = obs_profile.KERNEL_CACHES.snapshot()
+        payload["locks"] = obs_contention.snapshot()
+        return 200, payload
+
     def _route_inner(self, method: str, path: str, body: bytes, headers=None,
                      watch_park: bool = True, watch_hint: float = 1.0,
                      body_obj=None):
@@ -1633,6 +1683,8 @@ class ControllerServer:
             }
         if path == "/debug/tsdb" and method == "GET":
             return self._debug_tsdb(params)
+        if path == "/debug/profile" and method == "GET":
+            return self._debug_profile(params, headers)
         if path == "/debug/alerts" and method == "GET":
             if params:
                 return 400, {

@@ -77,6 +77,27 @@ _REAL_CONDITION = threading.Condition
 _LOCK_TYPES = (type(_REAL_LOCK()), type(_REAL_RLOCK()))
 
 
+def swap_lock_attrs(obj, wrap_lock, wrap_condition=None) -> list[tuple]:
+    """Swap an instance's bare Lock/RLock (and, when ``wrap_condition``
+    is given, Condition) attributes in place for wrappers built by the
+    callbacks ``wrap(name, value)`` — the one lock-interposition seam
+    shared by :meth:`RaceHarness.adopt` and the contention profiler
+    (``obs/contention.py``). Returns ``(attr_name, original)`` pairs so
+    callers can restore. Only safe before the object's threads are
+    running: a lock swapped while held by another thread loses mutual
+    exclusion with the holder."""
+    swapped: list[tuple] = []
+    for name, value in list(vars(obj).items()):
+        if isinstance(value, _LOCK_TYPES):
+            object.__setattr__(obj, name, wrap_lock(name, value))
+            swapped.append((name, value))
+        elif wrap_condition is not None and isinstance(
+                value, _REAL_CONDITION):
+            object.__setattr__(obj, name, wrap_condition(name, value))
+            swapped.append((name, value))
+    return swapped
+
+
 @dataclass
 class _Frame:
     filename: str
@@ -352,6 +373,22 @@ def default_watchlist() -> dict[type, frozenset]:
     add(_gauge, ("_values",))
     add(_histogram, ("counts", "sum", "n", "raw", "exemplars"))
 
+    def _labeled_histogram():
+        from ..core.metrics import LabeledHistogram
+
+        return LabeledHistogram
+
+    add(_labeled_histogram, ("_children",))
+
+    def _stack_profiler():
+        from ..obs.profile import StackProfiler
+
+        return StackProfiler
+
+    add(_stack_profiler, ("_root", "_node_count", "_dropped_frames",
+                          "_samples", "_interval_counts",
+                          "_interval_samples", "_ring"))
+
     def _flow():
         from ..flow.controller import FlowController
 
@@ -582,13 +619,13 @@ class RaceHarness:
                 f"{cls.__name__} is not a watched class; pass it via "
                 "watch=/extra="
             )
-        for name, value in list(vars(obj).items()):
-            if isinstance(value, _LOCK_TYPES):
-                wrapper = _TrackedLock(self, value, name)
-                object.__setattr__(obj, name, wrapper)
-            elif isinstance(value, _REAL_CONDITION):
-                wrapper = _TrackedCondition(self, name=name, _existing=value)
-                object.__setattr__(obj, name, wrapper)
+        swap_lock_attrs(
+            obj,
+            lambda name, value: _TrackedLock(self, value, name),
+            lambda name, value: _TrackedCondition(
+                self, name=name, _existing=value
+            ),
+        )
         with self._internal:
             self._tracked_objects[id(obj)] = obj
 

@@ -35,6 +35,8 @@ def _registered_families() -> dict[str, str]:
         families[g.name] = "gauge"
     for h in metrics.ALL_HISTOGRAMS:
         families[h.name] = "histogram"
+    for lh in metrics.ALL_LABELED_HISTOGRAMS:
+        families[lh.name] = "histogram"
     return families
 
 

@@ -1,0 +1,597 @@
+"""Continuous-profiling plane tests (jobset_tpu/obs: profile.py,
+contention.py; docs/observability.md "Continuous profiling").
+
+Covers: the deterministic synchronous ``sample()`` path (folding trie,
+thread-role attribution, folded/flamegraph output, hotspot table,
+interval ring, node cap), the live daemon sampler (samples real
+threads, skips itself, survives torn passes), the lock-contention
+profiler (contended-acquire-only discipline, RLock reentrancy,
+install/uninstall through the race harness's lock seam), the
+``LabeledHistogram`` registry citizen, JIT compile/cache/transfer
+telemetry around the compile-once factories, per-tick phase
+attribution, the Telemetry.tick() error-containment regression, the
+``/debug/profile`` HTTP surface, and debug-bundle schema 1.5.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from jobset_tpu.client import ApiError, JobSetClient
+from jobset_tpu.core import metrics
+from jobset_tpu.obs import contention, profile
+from jobset_tpu.obs.profile import StackProfiler, thread_role
+from jobset_tpu.server import ControllerServer
+
+pytestmark = pytest.mark.profile
+
+
+# ---------------------------------------------------------------------------
+# Deterministic sampling: the synchronous sample(now=, frames=) path
+# ---------------------------------------------------------------------------
+
+
+def _frames(*specs):
+    """[(thread_name, 'a;b;c'), ...] -> sample() input."""
+    return [(name, stack.split(";")) for name, stack in specs]
+
+
+def test_thread_role_mapping():
+    assert thread_role("pump") == "pump"
+    assert thread_role("telemetry-sampler") == "sampler"
+    assert thread_role("profile-sampler") == "profiler"
+    assert thread_role("shard-supervisor") == "replication"
+    assert thread_role("Thread-3 (_serve)") == "handler"
+    assert thread_role("MainThread") == "main"
+    assert thread_role("weird") == "other"
+
+
+def test_sample_folds_stacks_deterministically():
+    metrics.reset()
+    p = StackProfiler(interval_s=10.0)
+    frames = _frames(
+        ("pump", "server.py:pump;cluster.py:tick;solver.py:solve"),
+        ("pump", "server.py:pump;cluster.py:tick;solver.py:solve"),
+        ("Thread-1", "server.py:handle;server.py:route"),
+        ("profile-sampler", "profile.py:_run"),  # skipped: the profiler
+    )
+    for now in (0.0, 1.0):
+        assert p.sample(now=now, frames=frames) == 3
+    # Folded output is the flamegraph contract: role-rooted, counted,
+    # sorted — byte-identical for identical driven input.
+    assert p.folded() == (
+        "handler;server.py:handle;server.py:route 2\n"
+        "pump;server.py:pump;cluster.py:tick;solver.py:solve 4"
+    )
+    assert p.roles() == {"handler": 2, "pump": 4}
+    top = p.top(3)
+    assert top[0]["frame"] == "solver.py:solve"
+    assert top[0]["self"] == 4
+    # cluster.py:tick has no self time but 4 inclusive samples.
+    tick = next(r for r in top if r["frame"] == "cluster.py:tick")
+    assert (tick["self"], tick["total"]) == (0, 4)
+    assert metrics.profile_samples_total.total() == 6.0
+    second = StackProfiler(interval_s=10.0)
+    for now in (0.0, 1.0):
+        second.sample(now=now, frames=frames)
+    assert second.folded() == p.folded()
+    metrics.reset()
+
+
+def test_interval_ring_rolls_aggregates():
+    metrics.reset()
+    p = StackProfiler(interval_s=5.0, ring_slots=3)
+    for i in range(4):
+        p.sample(now=float(i * 5), frames=_frames(("pump", "a;b")))
+    d = p.describe(top_n=5)
+    # 3 completed intervals (the 4th is still open), each 1 sample.
+    assert len(d["intervals"]) == 3
+    assert d["intervals"][0]["top"] == [{"frame": "pump;b", "self": 1}]
+    assert d["samples"] == 4
+    metrics.reset()
+
+
+def test_trie_node_cap_bounds_memory():
+    metrics.reset()
+    p = StackProfiler(max_nodes=8)
+    for i in range(50):
+        p.sample(now=0.0, frames=_frames(("pump", f"f{i};g{i}")))
+    d = p.describe()
+    assert d["trie_nodes"] <= 8
+    assert d["dropped_frames"] > 0
+    # The callback gauge reads the live node count.
+    assert ("jobset_profile_trie_nodes", d["trie_nodes"]) in [
+        (n, v) for n, _labels, v in _collect("jobset_profile_trie_nodes")
+    ]
+    metrics.reset()
+
+
+def _collect(name):
+    return [
+        (n, labels, value)
+        for n, labels, value in metrics.sample_registry()
+        if n.startswith(name)
+    ]
+
+
+def test_live_sampler_sees_threads_and_skips_itself():
+    metrics.reset()
+    stop = threading.Event()
+
+    def busy():
+        while not stop.wait(0.001):
+            pass
+
+    worker = threading.Thread(target=busy, name="pump", daemon=True)
+    worker.start()
+    p = StackProfiler(hz=200.0)
+    p.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            roles = p.roles()
+            if roles.get("pump") and roles.get("main"):
+                break
+            time.sleep(0.02)
+    finally:
+        p.stop()
+        stop.set()
+        worker.join(timeout=2.0)
+    roles = p.roles()
+    assert roles.get("pump", 0) > 0
+    assert roles.get("main", 0) > 0
+    assert "profiler" not in roles  # never samples its own stack
+    assert not p.running
+    metrics.reset()
+
+
+def test_live_sampler_survives_torn_passes():
+    metrics.reset()
+    p = StackProfiler(hz=500.0)
+    original = p._live_frames
+    calls = {"n": 0}
+
+    def torn():
+        calls["n"] += 1
+        if calls["n"] <= 3:
+            raise RuntimeError("thread died mid-walk")
+        return original()
+
+    p._live_frames = torn
+    p.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and calls["n"] <= 3:
+            time.sleep(0.01)
+        assert p.running  # the sampler thread outlived the torn passes
+    finally:
+        p.stop()
+    assert metrics.telemetry_tick_errors_total.value("profile_sample") >= 3
+    metrics.reset()
+
+
+# ---------------------------------------------------------------------------
+# Lock contention: TimedLock + the race-harness lock seam
+# ---------------------------------------------------------------------------
+
+
+def test_timed_lock_observes_only_contended_acquires():
+    metrics.reset()
+    lk = contention.TimedLock(threading.Lock(), "t.lock")
+    with lk:
+        pass  # uncontended: no sample
+    assert metrics.lock_wait_seconds.count("t.lock") == 0
+
+    lk.acquire()
+    waited = threading.Event()
+
+    def waiter():
+        lk.acquire()
+        lk.release()
+        waited.set()
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    time.sleep(0.05)
+    lk.release()
+    assert waited.wait(5.0)
+    t.join(timeout=2.0)
+    assert metrics.lock_wait_seconds.count("t.lock") == 1
+    assert metrics.lock_wait_seconds.total("t.lock") >= 0.03
+    metrics.reset()
+
+
+def test_timed_rlock_reentrancy_records_no_phantom_wait():
+    metrics.reset()
+    lk = contention.TimedLock(threading.RLock(), "t.rlock")
+    with lk:
+        with lk:  # reentrant re-acquire: non-blocking fast path
+            pass
+    assert metrics.lock_wait_seconds.count("t.rlock") == 0
+    # Non-blocking miss answers False without a sample.
+    other = contention.TimedLock(threading.Lock(), "t.other")
+    other.acquire()
+    assert other.acquire(blocking=False) is False
+    other.release()
+    assert metrics.lock_wait_seconds.count("t.other") == 0
+    metrics.reset()
+
+
+class _Locked:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition()
+        self.plain = 7
+
+
+def test_contention_profiler_installs_and_uninstalls():
+    metrics.reset()
+    obj = _Locked()
+    original = obj._lock
+    prof = contention.ContentionProfiler()
+    names = prof.instrument(obj, "obj")
+    assert names == ["obj._lock"]
+    assert isinstance(obj._lock, contention.TimedLock)
+    with obj._lock:
+        pass
+    assert obj.plain == 7  # non-lock attributes untouched
+    assert prof.names() == ["obj._lock"]
+    prof.uninstall()
+    assert obj._lock is original
+    metrics.reset()
+
+
+def test_contention_snapshot_reads_the_global_family():
+    metrics.reset()
+    metrics.lock_wait_seconds.observe(0.01, "cluster._lock")
+    metrics.lock_wait_seconds.observe(0.02, "cluster._lock")
+    snap = contention.snapshot()
+    assert snap["cluster._lock"]["waits"] == 2
+    assert abs(snap["cluster._lock"]["wait_seconds_total"] - 0.03) < 1e-9
+    assert snap["cluster._lock"]["p99_s"] > 0.0
+    metrics.reset()
+
+
+# ---------------------------------------------------------------------------
+# LabeledHistogram: registry citizenship
+# ---------------------------------------------------------------------------
+
+
+def test_labeled_histogram_samples_and_renders():
+    metrics.reset()
+    metrics.lock_wait_seconds.observe(0.5, "a")
+    metrics.lock_wait_seconds.observe(1.5, "a")
+    metrics.lock_wait_seconds.observe(0.25, "b")
+    sums = {
+        (n, labels): v for n, labels, v in metrics.sample_registry()
+        if n.startswith("jobset_lock_wait_seconds")
+    }
+    assert sums[("jobset_lock_wait_seconds_sum", (("lock", "a"),))] == 2.0
+    assert sums[("jobset_lock_wait_seconds_count", (("lock", "b"),))] == 1.0
+    text = metrics.render_prometheus()
+    assert 'jobset_lock_wait_seconds_count{lock="a"} 2' in text
+    assert 'lock="b"' in text and 'le="' in text  # full bucket ladder
+    assert metrics.lock_wait_seconds.percentile(0.5, "a") > 0.0
+    metrics.reset()
+    assert metrics.lock_wait_seconds.children() == []
+
+
+# ---------------------------------------------------------------------------
+# JIT/kernel telemetry
+# ---------------------------------------------------------------------------
+
+
+def test_timed_compile_counts_exactly_one_compile():
+    metrics.reset()
+    calls = {"n": 0}
+
+    def kernel(x):
+        calls["n"] += 1
+        return x * 2
+
+    wrapped = profile.timed_compile("test_kernel", kernel)
+    assert wrapped(3) == 6
+    assert wrapped(4) == 8
+    assert metrics.jit_compiles_total.value("test_kernel") == 1.0
+    assert metrics.jit_compile_seconds.count("test_kernel") == 1
+    assert calls["n"] == 2
+    metrics.reset()
+
+
+def test_jit_shape_call_detects_new_shapes():
+    import numpy as np
+
+    metrics.reset()
+    with profile._SEEN_LOCK:
+        profile._SEEN_SHAPES.pop("test_shape", None)
+
+    def kernel(x, iters=1):
+        return x
+
+    a = np.zeros((4, 4), dtype=np.float32)
+    b = np.zeros((8, 8), dtype=np.float32)
+    profile.jit_shape_call("test_shape", kernel, a, iters=2)
+    profile.jit_shape_call("test_shape", kernel, a, iters=2)  # cache hit
+    profile.jit_shape_call("test_shape", kernel, b, iters=2)  # new shape
+    assert metrics.jit_compiles_total.value("test_shape") == 2.0
+    with profile._SEEN_LOCK:
+        profile._SEEN_SHAPES.pop("test_shape", None)
+    metrics.reset()
+
+
+def test_note_transfer_sums_bytes_by_direction():
+    import numpy as np
+
+    metrics.reset()
+    a = np.zeros(16, dtype=np.float32)  # 64 bytes
+    profile.note_transfer("test_kernel", "h2d", a, a)
+    profile.note_transfer("test_kernel", "d2h", a)
+    profile.note_transfer("test_kernel", "h2d")  # zero bytes: no row
+    assert metrics.jit_transfer_bytes_total.value(
+        "test_kernel", "h2d"
+    ) == 128.0
+    assert metrics.jit_transfer_bytes_total.value(
+        "test_kernel", "d2h"
+    ) == 64.0
+    metrics.reset()
+
+
+def test_kernel_cache_registry_reports_factory_stats():
+    import functools
+
+    metrics.reset()
+
+    @functools.lru_cache(maxsize=None)
+    def factory(n: int):
+        return lambda x: x * n
+
+    profile.KERNEL_CACHES.register("test_factory", factory)
+    factory(2)
+    factory(2)
+    factory(3)
+    snap = profile.KERNEL_CACHES.snapshot()
+    assert snap["test_factory"]["misses"] == 2
+    assert snap["test_factory"]["hits"] == 1
+    hits = {
+        labels: v for n, labels, v in metrics.sample_registry()
+        if n == "jobset_jit_cache_hits"
+    }
+    assert hits[(("kernel", "test_factory"),)] == 1.0
+    with profile.KERNEL_CACHES._lock:
+        profile.KERNEL_CACHES._caches.pop("test_factory", None)
+    metrics.reset()
+
+
+def test_real_kernel_factories_register_and_count_compiles():
+    """The queue scorer's compile-once factory reports through the
+    registry, and its first jitted call lands one compile sample."""
+    pytest.importorskip("jax")
+    from jobset_tpu.core import features
+    from jobset_tpu.queue import scorer
+
+    metrics.reset()
+    with features.gate("TPUQueueScorer", True):
+        scorer.warm(2, 2, 1, 64)
+    snap = profile.KERNEL_CACHES.snapshot()
+    assert "queue_scorer" in snap
+    assert snap["queue_scorer"]["currsize"] >= 1
+    metrics.reset()
+
+
+# ---------------------------------------------------------------------------
+# Per-tick phase attribution
+# ---------------------------------------------------------------------------
+
+
+def test_tick_phases_are_attributed():
+    from jobset_tpu.core import make_cluster
+    from jobset_tpu.utils.clock import FakeClock
+
+    metrics.reset()
+    cluster = make_cluster(clock=FakeClock(0.0))
+    cluster.tick()
+    phases = {labels[0] for labels, _ in metrics.tick_phase_seconds.children()}
+    for phase in ("requeue", "queue_sync", "reconcile", "job_sync",
+                  "scheduler", "sync_pods", "pod_sync"):
+        assert phase in phases, phase
+    # Every observed duration is a real non-negative wall time.
+    for labels, _hist in metrics.tick_phase_seconds.children():
+        assert metrics.tick_phase_seconds.total(*labels) >= 0.0
+    metrics.reset()
+
+
+# ---------------------------------------------------------------------------
+# Telemetry.tick() hardening (regression: a poisoned stage must not
+# kill the sampler or the tick)
+# ---------------------------------------------------------------------------
+
+
+def test_telemetry_tick_contains_stage_errors():
+    from jobset_tpu.obs.tsdb import Telemetry
+    from jobset_tpu.utils.clock import FakeClock
+
+    metrics.reset()
+    clock = FakeClock(0.0)
+    tel = Telemetry(clock=clock, interval=1.0)
+
+    class _BrokenAlerts:
+        def evaluate(self, *a, **k):
+            raise RuntimeError("rule exploded")
+
+    good_alerts = tel.alerts
+    tel.alerts = _BrokenAlerts()
+    tel.tick()  # contained, not raised
+    assert metrics.telemetry_tick_errors_total.value("alerts") == 1.0
+    # The earlier stages still ran: samples were appended.
+    assert tel.tsdb.sample_count() > 0
+    tel.alerts = good_alerts
+    clock.advance(1.0)
+    tel.tick()  # the plane recovers on the next tick
+    assert metrics.telemetry_tick_errors_total.value("alerts") == 1.0
+    metrics.reset()
+
+
+def test_telemetry_sampler_thread_survives_poisoned_ticks():
+    from jobset_tpu.obs.tsdb import Telemetry
+
+    metrics.reset()
+    tel = Telemetry(interval=0.01)
+
+    class _BrokenAlerts:
+        def evaluate(self, *a, **k):
+            raise RuntimeError("rule exploded")
+
+    tel.alerts = _BrokenAlerts()
+    tel.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while (time.monotonic() < deadline
+               and metrics.telemetry_tick_errors_total.value("alerts") < 3):
+            time.sleep(0.01)
+        assert tel._thread is not None and tel._thread.is_alive()
+        assert metrics.telemetry_tick_errors_total.value("alerts") >= 3
+    finally:
+        tel.stop()
+    metrics.reset()
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface: /debug/profile (+ client + bundle schema 1.5)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def profile_server():
+    metrics.reset()
+    p = StackProfiler()
+    p.sample(now=0.0, frames=_frames(
+        ("pump", "server.py:pump;cluster.py:tick"),
+        ("pump", "server.py:pump;cluster.py:tick"),
+    ))
+    s = ControllerServer(
+        "127.0.0.1:0", tick_interval=0.05, profiler=p
+    ).start()
+    yield s, p
+    s.stop()
+    metrics.reset()
+
+
+def test_debug_profile_answers_404_without_profiler():
+    metrics.reset()
+    s = ControllerServer("127.0.0.1:0", tick_interval=0.05).start()
+    try:
+        client = JobSetClient(s.address)
+        with pytest.raises(ApiError) as exc:
+            client.profile()
+        assert exc.value.status == 404
+        assert "--profile" in exc.value.message
+    finally:
+        s.stop()
+        metrics.reset()
+
+
+def test_debug_profile_serves_snapshot_and_folded(profile_server):
+    server, _p = profile_server
+    client = JobSetClient(server.address)
+    payload = client.profile(top=5)
+    assert payload["samples"] == 2
+    assert payload["roles"] == {"pump": 2}
+    assert payload["top"][0]["frame"] == "cluster.py:tick"
+    assert "jit" in payload and "locks" in payload
+    assert len(payload["top"]) <= 5
+    folded = client.profile_folded()
+    assert folded.startswith("pump;server.py:pump;cluster.py:tick 2")
+    # Unknown / malformed params are a 400, not silently ignored.
+    for bad in ("/debug/profile?nope=1", "/debug/profile?top=x",
+                "/debug/profile?format=svg"):
+        with pytest.raises(ApiError) as exc:
+            client._request("GET", bad)
+        assert exc.value.status == 400
+
+
+def test_debug_bundle_round_trips_profile_member(profile_server, tmp_path):
+    from jobset_tpu.obs import bundle
+
+    server, _p = profile_server
+    client = JobSetClient(server.address)
+    out = tmp_path / "bundle.tgz"
+    bundle.write_bundle(client, str(out))
+    loaded = bundle.load_bundle(str(out))
+    assert loaded["manifest.json"]["schemaVersion"] == "1.5"
+    assert "profile.json" in loaded["manifest.json"]["members"]
+    prof = loaded["profile.json"]
+    assert prof["enabled"] is True
+    assert prof["samples"] == 2
+    assert prof["roles"] == {"pump": 2}
+
+
+# ---------------------------------------------------------------------------
+# Chaos soak with the profiling plane attached (the acceptance run:
+# seeded storm stays green AND byte-identical while the stack sampler,
+# contention instrumentation, and JIT telemetry are all live)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_thundering_herd_green_and_deterministic_under_profiling():
+    from jobset_tpu.chaos.scenarios import thundering_herd
+    from jobset_tpu.core import features
+    from jobset_tpu.queue import scorer
+
+    def drive() -> dict:
+        metrics.reset()
+        # JIT telemetry rides the same run: warm the compile-once scorer
+        # bucket so the kernel-cache registry has live rows to serve.
+        with features.gate("TPUQueueScorer", True):
+            scorer.warm(2, 2, 1, 64)
+        # One deliberate contended acquire so the lock-wait family has a
+        # child in this run's /debug/profile read (the storm driver is
+        # sequential — its own instrumented acquires are uncontended).
+        lk = contention.TimedLock(threading.Lock(), "soak.primer")
+        lk.acquire()
+        t = threading.Thread(target=lambda: (lk.acquire(), lk.release()),
+                             daemon=True)
+        t.start()
+        time.sleep(0.02)
+        lk.release()
+        t.join(timeout=2.0)
+        return thundering_herd(arrivals=120, seed=23, profiled=True)
+
+    first, second = drive(), drive()
+    for result in (first, second):
+        prof = result["profile"]
+        assert prof["status"] == 200
+        assert prof["samples"] > 0  # the live sampler saw the storm
+        assert "main" in prof["roles"]  # ...rooted at the driver thread
+        assert prof["locks_instrumented"]  # TimedLocks were installed
+        assert "soak.primer" in prof["lock_waits"]
+        assert "queue_scorer" in prof["jit_kernels"]
+        # The storm itself stayed green under instrumentation.
+        assert result["leaked_shed_objects"] == []
+        assert result["shed_creates"] > 0
+    # Determinism contract: everything OUTSIDE the wall-clock profile
+    # block is byte-identical across profiled runs.
+    first.pop("profile")
+    second.pop("profile")
+    assert json.dumps(first, sort_keys=True) == json.dumps(
+        second, sort_keys=True
+    )
+    metrics.reset()
+
+
+def test_debug_bundle_marks_profile_disabled_without_profiler(tmp_path):
+    from jobset_tpu.obs import bundle
+
+    metrics.reset()
+    s = ControllerServer("127.0.0.1:0", tick_interval=0.05).start()
+    try:
+        client = JobSetClient(s.address)
+        out = tmp_path / "bundle.tgz"
+        bundle.write_bundle(client, str(out))
+        loaded = bundle.load_bundle(str(out))
+        assert loaded["profile.json"] == {"enabled": False}
+    finally:
+        s.stop()
+        metrics.reset()

@@ -314,6 +314,7 @@ def test_default_rule_set_loads_and_names_match_docs_table():
         "JobSetFlowShedRateHigh",
         "JobSetShardQuorumDegraded",
         "JobSetShardMigrationAborting",
+        "JobSetLockContentionHigh",
         "JobSetSLOAdmissionFastBurn",
         "JobSetSLOAdmissionSlowBurn",
     ]
@@ -496,7 +497,7 @@ def test_traces_filters_limit_phase_and_reject_unknown_params(
 # ---------------------------------------------------------------------------
 
 
-def test_bundle_1_4_roundtrip_with_and_without_telemetry(
+def test_bundle_1_5_roundtrip_with_and_without_telemetry(
     telemetry_server, tmp_path
 ):
     from jobset_tpu.obs.bundle import (
@@ -505,7 +506,7 @@ def test_bundle_1_4_roundtrip_with_and_without_telemetry(
         write_bundle,
     )
 
-    assert BUNDLE_SCHEMA_VERSION == "1.4"
+    assert BUNDLE_SCHEMA_VERSION == "1.5"
     server, tel, clock = telemetry_server
     client = JobSetClient(server.address)
     tel.tick()
@@ -516,7 +517,7 @@ def test_bundle_1_4_roundtrip_with_and_without_telemetry(
     assert "tsdb.json" in stats["members"]
     assert "alerts.json" in stats["members"]
     bundle = load_bundle(path)
-    assert bundle["manifest.json"]["schemaVersion"] == "1.4"
+    assert bundle["manifest.json"]["schemaVersion"] == "1.5"
     assert bundle["tsdb.json"]["enabled"] is True
     assert bundle["tsdb.json"]["series"], "sampled TSDB must dump series"
     assert bundle["alerts.json"]["enabled"] is True
@@ -529,6 +530,7 @@ def test_bundle_1_4_roundtrip_with_and_without_telemetry(
         bundle = load_bundle(path)
         assert bundle["tsdb.json"] == {"enabled": False}
         assert bundle["alerts.json"] == {"enabled": False}
+        assert bundle["profile.json"] == {"enabled": False}
     finally:
         plain.stop()
 
